@@ -1,0 +1,28 @@
+"""easydarwin_tpu — a TPU-native streaming-media framework.
+
+A from-scratch re-design of the capabilities of EasyDarwin (the Darwin
+Streaming Server–derived RTSP platform, surveyed in SURVEY.md): RTSP/RTP/RTCP
+serving, live push (ANNOUNCE/RECORD) relay with keyframe-indexed fast-start
+fan-out, hinted-MP4 VOD, a JSON REST management API, and Redis/CMS-style
+cluster integration.
+
+Architecture (two tiers):
+
+* **Host tier** — protocol state machines (``protocol/``, ``server/``), the
+  relay core (``relay/``), VOD (``vod/``) and the cluster control plane
+  (``cluster/``) in Python, backed by a C++ data-plane library (``csrc/``,
+  bridged in ``native.py``) for the epoll event loop, fine-grained timer
+  wheel and batched ``sendmmsg`` packet egress.
+* **Device tier** (``ops/``, ``parallel/``) — JAX/XLA/Pallas: fixed-shape
+  packet rings, batched RTP parsing, H.264 keyframe classification and
+  ``vmap``'d per-subscriber repacketization, sharded over a
+  ``jax.sharding.Mesh`` for multi-chip scale-out.
+
+The reference's per-packet × per-subscriber copy loop
+(``ReflectorStream.cpp:1024 ReflectPackets`` → ``RTPSessionOutput::WritePacket``)
+is replaced by a single device computation that emits *only the rewritten
+per-subscriber RTP headers*; payload bytes are shared host-side and scattered
+to sockets with vectored I/O.
+"""
+
+__version__ = "0.1.0"

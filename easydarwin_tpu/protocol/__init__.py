@@ -1,0 +1,21 @@
+"""Wire-protocol parsers and builders (pure Python — the CPU oracle).
+
+Every format the device tier (``easydarwin_tpu.ops``) accelerates has its
+reference implementation here; differential tests assert bit-exact agreement.
+
+Modules
+-------
+``rtp``   RTP fixed header + extension parse/build (RFC 3550 §5.1).
+``rtcp``  RTCP SR/RR/SDES/BYE/APP parse/build (RFC 3550 §6) incl. the
+          reliable-UDP Ack/NADU APP formats the reference understands.
+``nalu``  H.264 RTP payload classification (RFC 6184): NAL unit type,
+          keyframe-first-packet / frame-first / frame-last predicates with the
+          exact semantics of the reference's ``ReflectorSender``
+          (``ReflectorStream.cpp:1403-1573``).
+``rtsp``  RTSP/1.0 request/response grammar + Transport header negotiation
+          (reference: ``RTSPRequest.cpp``, ``RTSPProtocol.cpp``).
+``sdp``   SDP parse into per-stream ``StreamInfo`` records (reference:
+          ``SDPSourceInfo.cpp``) and SDP generation for DESCRIBE answers.
+"""
+
+from . import nalu, rtcp, rtp, rtsp, sdp  # noqa: F401

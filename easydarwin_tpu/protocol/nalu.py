@@ -1,0 +1,154 @@
+"""H.264 RTP payload classification (RFC 6184) — the keyframe index oracle.
+
+Reproduces the exact predicates of the reference's ``ReflectorSender``:
+
+* ``is_keyframe_first_packet`` — ``ReflectorStream.cpp:1403-1513``: a packet
+  whose (possibly aggregated/fragmented) leading NAL unit is IDR(5), SPS(7) or
+  PPS(8).  Header size is computed as ``12 + 4*CC`` (extension ignored), the
+  packet must be ≥ 20 bytes, and for FU-A/FU-B only fragments with the start
+  bit set count.
+* ``is_frame_first_packet`` — ``ReflectorStream.cpp:1515-1557``: any leading
+  single/aggregation NAL, or a FU fragment with the start bit.
+* ``is_frame_last_packet`` — ``ReflectorStream.cpp:1559-1573``: RTP marker bit.
+
+These run per-packet on the host only as the oracle; the production path is the
+vectorized equivalent in ``easydarwin_tpu.ops.parse`` evaluated for a whole
+packet ring at once on device.
+"""
+
+from __future__ import annotations
+
+from . import rtp
+
+# NAL unit types (H.264 Annex A / RFC 6184 §5.2)
+NAL_NON_IDR = 1
+NAL_IDR = 5
+NAL_SEI = 6
+NAL_SPS = 7
+NAL_PPS = 8
+NAL_AUD = 9
+NAL_STAP_A = 24
+NAL_STAP_B = 25
+NAL_MTAP16 = 26
+NAL_MTAP24 = 27
+NAL_FU_A = 28
+NAL_FU_B = 29
+
+#: minimum packet length the reference requires before classifying
+_MIN_CLASSIFY_LEN = 20
+
+#: offset (past the RTP header) of the first aggregated NAL header byte for
+#: each aggregation packet type, per ReflectorStream.cpp:1465-1483
+_AGG_INNER_OFFSET = {NAL_STAP_A: 3, NAL_STAP_B: 5, NAL_MTAP16: 8, NAL_MTAP24: 9}
+
+KEYFRAME_NAL_TYPES = frozenset({NAL_IDR, NAL_SPS, NAL_PPS})
+
+
+def effective_nal_type(packet: bytes) -> int | None:
+    """The NAL type the reference's classifier ends up testing, or None.
+
+    Resolves aggregation packets (STAP/MTAP) to their first contained NAL and
+    FU-A/B to the fragmented NAL *only when the start bit is set* (a non-start
+    fragment keeps type 28/29, which is never a keyframe type — mirroring the
+    reference, which leaves ``nal_unit_type`` as the outer type in that case).
+    """
+    if len(packet) < _MIN_CLASSIFY_LEN:
+        return None
+    hs = rtp.header_size_cc_only(packet)
+    if len(packet) <= hs:
+        return None
+    t = packet[hs] & 0x1F
+    if t in _AGG_INNER_OFFSET:
+        off = _AGG_INNER_OFFSET[t]
+        if len(packet) > hs + off:
+            t = packet[hs + off] & 0x1F
+    elif t in (NAL_FU_A, NAL_FU_B):
+        if len(packet) > hs + 1 and packet[hs + 1] & 0x80:
+            t = packet[hs + 1] & 0x1F
+    return t
+
+
+def is_keyframe_first_packet(packet: bytes) -> bool:
+    """True iff this RTP packet starts an H.264 keyframe (IDR/SPS/PPS)."""
+    return effective_nal_type(packet) in KEYFRAME_NAL_TYPES
+
+
+def is_frame_first_packet(packet: bytes) -> bool:
+    """True iff this packet begins a (any) frame per the reference's test."""
+    if len(packet) < _MIN_CLASSIFY_LEN:
+        return False
+    hs = rtp.header_size_cc_only(packet)
+    if len(packet) <= hs:
+        return False
+    t = packet[hs] & 0x1F
+    if 1 <= t <= 27:  # single NAL or aggregation packet
+        return True
+    if t in (NAL_FU_A, NAL_FU_B):
+        return len(packet) > hs + 1 and bool(packet[hs + 1] & 0x80)
+    return False
+
+
+def is_frame_last_packet(packet: bytes) -> bool:
+    """True iff the RTP marker bit is set (reference: byte1 & 0x80, len≥20)."""
+    return len(packet) >= _MIN_CLASSIFY_LEN and bool(packet[1] & 0x80)
+
+
+def split_annexb(stream: bytes) -> list[bytes]:
+    """Split an Annex-B byte stream into NAL units (without start codes)."""
+    out: list[bytes] = []
+    i, n = 0, len(stream)
+    starts: list[int] = []
+    while i < n - 2:
+        if stream[i] == 0 and stream[i + 1] == 0:
+            if stream[i + 2] == 1:
+                starts.append(i + 3)
+                i += 3
+                continue
+            if i < n - 3 and stream[i + 2] == 0 and stream[i + 3] == 1:
+                starts.append(i + 4)
+                i += 4
+                continue
+        i += 1
+    for k, s in enumerate(starts):
+        e = n
+        if k + 1 < len(starts):
+            e = starts[k + 1]
+            while e > s and stream[e - 1] == 0:  # strip next start code prefix
+                e -= 1
+            if e > s and stream[e - 1] == 1:
+                e -= 1
+                while e > s and stream[e - 1] == 0:
+                    e -= 1
+        out.append(stream[s:e])
+    return out
+
+
+def packetize_h264(nal: bytes, *, seq: int, timestamp: int, ssrc: int,
+                   payload_type: int = 96, mtu: int = 1400,
+                   marker_on_last: bool = True) -> list[bytes]:
+    """Packetize one NAL unit into RTP packets (single NAL or FU-A).
+
+    A minimal RFC 6184 packetizer used by the test harness, the loopback
+    pusher, and the VOD fallback path for non-hinted H.264 tracks.
+    """
+    pkts: list[bytes] = []
+    if len(nal) <= mtu:
+        pkts.append(rtp.RtpPacket(
+            payload_type=payload_type, seq=seq, timestamp=timestamp,
+            ssrc=ssrc, marker=marker_on_last, payload=nal).to_bytes())
+        return pkts
+    nri = nal[0] & 0x60
+    ntype = nal[0] & 0x1F
+    fu_indicator = nri | NAL_FU_A
+    body = nal[1:]
+    first = True
+    while body:
+        chunk, body = body[:mtu - 2], body[mtu - 2:]
+        fu_header = ntype | (0x80 if first else 0) | (0x40 if not body else 0)
+        pkts.append(rtp.RtpPacket(
+            payload_type=payload_type, seq=seq, timestamp=timestamp,
+            ssrc=ssrc, marker=marker_on_last and not body,
+            payload=bytes((fu_indicator, fu_header)) + chunk).to_bytes())
+        seq = (seq + 1) & 0xFFFF
+        first = False
+    return pkts

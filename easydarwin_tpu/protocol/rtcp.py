@@ -1,0 +1,234 @@
+"""RTCP parse/build (RFC 3550 §6) — SR/RR/SDES/BYE/APP.
+
+Reference parity: ``RTCPUtilitiesLib`` (``RTCPPacket.cpp`` RR parse,
+``RTCPSRPacket.cpp`` SR+SDES+BYE generation, ``RTCPAckPacket.cpp`` the
+reliable-UDP "qtak" APP ack, ``RTCPAPPNADUPacket.cpp`` 3GPP NADU) and the
+relay's SR rewrite (``RTPSessionOutput.cpp:403-460``), which patches the SSRC
+of server-generated compounds so relayed receivers see a consistent source.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+SR, RR, SDES, BYE, APP = 200, 201, 202, 203, 204
+
+NTP_EPOCH_DELTA = 2208988800  # seconds between 1900 (NTP) and 1970 (Unix)
+
+
+class RtcpError(ValueError):
+    pass
+
+
+@dataclass
+class ReportBlock:
+    ssrc: int
+    fraction_lost: int
+    cumulative_lost: int
+    highest_seq: int
+    jitter: int
+    lsr: int
+    dlsr: int
+
+    def to_bytes(self) -> bytes:
+        lost = self.cumulative_lost & 0xFFFFFF
+        return struct.pack("!IIIIII", self.ssrc,
+                           ((self.fraction_lost & 0xFF) << 24) | lost,
+                           self.highest_seq, self.jitter, self.lsr, self.dlsr)
+
+    @classmethod
+    def parse(cls, data: bytes, off: int) -> "ReportBlock":
+        ssrc, frac_lost, hseq, jit, lsr, dlsr = struct.unpack_from("!IIIIII", data, off)
+        cum = frac_lost & 0xFFFFFF
+        if cum >= 0x800000:
+            cum -= 0x1000000
+        return cls(ssrc, frac_lost >> 24, cum, hseq, jit, lsr, dlsr)
+
+
+@dataclass
+class SenderReport:
+    ssrc: int
+    ntp_ts: int          # 64-bit NTP timestamp
+    rtp_ts: int
+    packet_count: int
+    octet_count: int
+    reports: list[ReportBlock] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        body = struct.pack("!IQIII", self.ssrc, self.ntp_ts & (2**64 - 1),
+                           self.rtp_ts & 0xFFFFFFFF, self.packet_count,
+                           self.octet_count)
+        for rb in self.reports:
+            body += rb.to_bytes()
+        return _hdr(SR, len(self.reports), len(body)) + body
+
+
+@dataclass
+class ReceiverReport:
+    ssrc: int
+    reports: list[ReportBlock] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        body = struct.pack("!I", self.ssrc)
+        for rb in self.reports:
+            body += rb.to_bytes()
+        return _hdr(RR, len(self.reports), len(body)) + body
+
+
+@dataclass
+class SdesChunk:
+    ssrc: int
+    cname: str = ""
+
+    def to_bytes(self) -> bytes:
+        name = self.cname.encode()
+        body = struct.pack("!I", self.ssrc) + bytes((1, len(name))) + name + b"\x00"
+        pad = (-len(body)) % 4
+        return body + b"\x00" * pad
+
+
+@dataclass
+class Sdes:
+    chunks: list[SdesChunk] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        body = b"".join(c.to_bytes() for c in self.chunks)
+        return _hdr(SDES, len(self.chunks), len(body)) + body
+
+
+@dataclass
+class Bye:
+    ssrcs: list[int] = field(default_factory=list)
+    reason: str = ""
+
+    def to_bytes(self) -> bytes:
+        body = b"".join(struct.pack("!I", s) for s in self.ssrcs)
+        if self.reason:
+            r = self.reason.encode()
+            body += bytes((len(r),)) + r
+            body += b"\x00" * ((-len(body)) % 4)
+        return _hdr(BYE, len(self.ssrcs), len(body)) + body
+
+
+@dataclass
+class App:
+    ssrc: int
+    name: str            # 4 chars, e.g. "qtak" (ack), "qtsn"/"PSS0" (NADU)
+    subtype: int = 0
+    data: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        body = struct.pack("!I", self.ssrc) + self.name.encode()[:4].ljust(4) + self.data
+        return _hdr(APP, self.subtype, len(body)) + body
+
+
+def _hdr(ptype: int, count: int, body_len: int) -> bytes:
+    if body_len % 4:
+        raise RtcpError("RTCP body must be 32-bit aligned")
+    return struct.pack("!BBH", 0x80 | (count & 0x1F), ptype, body_len // 4)
+
+
+def parse_compound(data: bytes) -> list[object]:
+    """Parse a compound RTCP datagram into typed packets (unknown → App/raw)."""
+    out: list[object] = []
+    off = 0
+    while off + 4 <= len(data):
+        b0, ptype, words = struct.unpack_from("!BBH", data, off)
+        if b0 >> 6 != 2:
+            raise RtcpError(f"bad RTCP version at offset {off}")
+        count = b0 & 0x1F
+        end = off + 4 + words * 4
+        if end > len(data):
+            raise RtcpError("truncated RTCP packet")
+        body = data[off + 4:end]
+        if ptype == SR and len(body) >= 24:
+            ssrc, ntp, rtp_ts, pc, oc = struct.unpack_from("!IQIII", body)
+            sr = SenderReport(ssrc, ntp, rtp_ts, pc, oc)
+            sr.reports = [ReportBlock.parse(body, 24 + i * 24)
+                          for i in range(count) if 24 + (i + 1) * 24 <= len(body)]
+            out.append(sr)
+        elif ptype == RR and len(body) >= 4:
+            ssrc = struct.unpack_from("!I", body)[0]
+            rr = ReceiverReport(ssrc)
+            rr.reports = [ReportBlock.parse(body, 4 + i * 24)
+                          for i in range(count) if 4 + (i + 1) * 24 <= len(body)]
+            out.append(rr)
+        elif ptype == BYE:
+            ssrcs = [struct.unpack_from("!I", body, i * 4)[0] for i in range(count)
+                     if (i + 1) * 4 <= len(body)]
+            bye = Bye(ssrcs)
+            roff = count * 4
+            if roff < len(body):
+                rlen = body[roff]
+                bye.reason = body[roff + 1:roff + 1 + rlen].decode("utf-8", "replace")
+            out.append(bye)
+        elif ptype == APP and len(body) >= 8:
+            ssrc = struct.unpack_from("!I", body)[0]
+            out.append(App(ssrc, body[4:8].decode("ascii", "replace"),
+                           subtype=count, data=body[8:]))
+        elif ptype == SDES:
+            sd = Sdes()
+            coff = 0
+            for _ in range(count):
+                if coff + 4 > len(body):
+                    break
+                ssrc = struct.unpack_from("!I", body, coff)[0]
+                coff += 4
+                cname = ""
+                while coff < len(body) and body[coff] != 0:
+                    item, ilen = body[coff], body[coff + 1] if coff + 1 < len(body) else 0
+                    val = body[coff + 2:coff + 2 + ilen]
+                    if item == 1:
+                        cname = val.decode("utf-8", "replace")
+                    coff += 2 + ilen
+                coff += 1                      # the terminating null
+                coff += (-coff) % 4            # chunk padding
+                sd.chunks.append(SdesChunk(ssrc, cname))
+            out.append(sd)
+        else:
+            out.append(App(0, "????", subtype=count, data=body))
+        off = end
+    return out
+
+
+def ntp_now(unix_time: float) -> int:
+    """Unix seconds (float) → 64-bit NTP timestamp."""
+    sec = int(unix_time) + NTP_EPOCH_DELTA
+    frac = int((unix_time % 1.0) * (1 << 32)) & 0xFFFFFFFF
+    return (sec << 32) | frac
+
+
+def ntp_middle32(ntp_ts: int) -> int:
+    """The LSR field: middle 32 bits of a 64-bit NTP timestamp."""
+    return (ntp_ts >> 16) & 0xFFFFFFFF
+
+
+def build_server_compound(ssrc: int, cname: str, *, unix_time: float,
+                          rtp_ts: int, packet_count: int,
+                          octet_count: int, bye: bool = False) -> bytes:
+    """SR + SDES(CNAME) [+ BYE] — what ``RTCPSRPacket`` emits each RR interval
+    (``RTPStream.cpp:1300`` SR generation, 5 s cadence)."""
+    out = SenderReport(ssrc, ntp_now(unix_time), rtp_ts, packet_count,
+                       octet_count).to_bytes()
+    out += Sdes([SdesChunk(ssrc, cname)]).to_bytes()
+    if bye:
+        out += Bye([ssrc]).to_bytes()
+    return out
+
+
+def rewrite_compound_ssrc(data: bytes, new_ssrc: int) -> bytes:
+    """Rewrite every top-level sender/source SSRC in a compound to
+    ``new_ssrc`` — the relay's SR rewrite (``RTPSessionOutput.cpp:403-460``),
+    applied so late-joined receivers see the per-output SSRC rather than the
+    pusher's."""
+    out = bytearray(data)
+    off = 0
+    while off + 8 <= len(out):
+        b0, ptype, words = struct.unpack_from("!BBH", out, off)
+        if b0 >> 6 != 2:
+            break
+        if ptype in (SR, RR, SDES, BYE, APP):
+            struct.pack_into("!I", out, off + 4, new_ssrc & 0xFFFFFFFF)
+        off += 4 + words * 4
+    return bytes(out)

@@ -1,0 +1,140 @@
+"""RTP packet parse/build (RFC 3550 §5.1).
+
+Reference behavior being reproduced: the reflector treats packets as opaque
+byte slots of at most ``kMaxReflectorPacketSize`` (2060 bytes,
+``ReflectorStream.h:127``) and reads seq/timestamp/SSRC at fixed offsets; the
+keyframe classifier computes the header size as ``12 + 4*CC`` ignoring the
+extension bit (``ReflectorStream.cpp:1457-1459``).  This module implements the
+full header (incl. extension) for correctness-critical paths and exposes the
+reference-compatible ``header_size_cc_only`` for bit-compatible classification.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+RTP_VERSION = 2
+FIXED_HEADER_LEN = 12
+#: Reference slot size: ReflectorStream.h:127 (kMaxReflectorPacketSize).
+MAX_PACKET_SIZE = 2060
+
+
+class RtpError(ValueError):
+    pass
+
+
+@dataclass
+class RtpPacket:
+    """A parsed RTP packet. ``payload`` excludes padding."""
+
+    payload_type: int
+    seq: int
+    timestamp: int
+    ssrc: int
+    marker: bool = False
+    padding: bool = False
+    csrcs: tuple[int, ...] = ()
+    extension: tuple[int, bytes] | None = None  # (profile id, data)
+    payload: bytes = b""
+    version: int = RTP_VERSION
+
+    @property
+    def header_len(self) -> int:
+        n = FIXED_HEADER_LEN + 4 * len(self.csrcs)
+        if self.extension is not None:
+            n += 4 + len(self.extension[1])
+        return n
+
+    def to_bytes(self) -> bytes:
+        b0 = (self.version << 6) | (0x20 if self.padding else 0) | (
+            0x10 if self.extension is not None else 0) | len(self.csrcs)
+        b1 = (0x80 if self.marker else 0) | (self.payload_type & 0x7F)
+        out = bytearray(struct.pack(
+            "!BBHII", b0, b1, self.seq & 0xFFFF,
+            self.timestamp & 0xFFFFFFFF, self.ssrc & 0xFFFFFFFF))
+        for c in self.csrcs:
+            out += struct.pack("!I", c & 0xFFFFFFFF)
+        if self.extension is not None:
+            profile, data = self.extension
+            if len(data) % 4:
+                raise RtpError("extension data must be a multiple of 4 bytes")
+            out += struct.pack("!HH", profile & 0xFFFF, len(data) // 4)
+            out += data
+        out += self.payload
+        return bytes(out)
+
+    @classmethod
+    def parse(cls, data: bytes) -> "RtpPacket":
+        if len(data) < FIXED_HEADER_LEN:
+            raise RtpError(f"short RTP packet: {len(data)} bytes")
+        b0, b1, seq, ts, ssrc = struct.unpack_from("!BBHII", data)
+        version = b0 >> 6
+        if version != RTP_VERSION:
+            raise RtpError(f"bad RTP version {version}")
+        cc = b0 & 0x0F
+        off = FIXED_HEADER_LEN + 4 * cc
+        if len(data) < off:
+            raise RtpError("truncated CSRC list")
+        csrcs = struct.unpack_from(f"!{cc}I", data, FIXED_HEADER_LEN) if cc else ()
+        ext = None
+        if b0 & 0x10:
+            if len(data) < off + 4:
+                raise RtpError("truncated extension header")
+            profile, words = struct.unpack_from("!HH", data, off)
+            if len(data) < off + 4 + 4 * words:
+                raise RtpError("truncated extension data")
+            ext = (profile, data[off + 4:off + 4 + 4 * words])
+            off += 4 + 4 * words
+        payload = data[off:]
+        padding = bool(b0 & 0x20)
+        if padding:
+            if not payload or payload[-1] == 0 or payload[-1] > len(payload):
+                raise RtpError("bad padding")
+            payload = payload[:-payload[-1]]
+        return cls(payload_type=b1 & 0x7F, seq=seq, timestamp=ts, ssrc=ssrc,
+                   marker=bool(b1 & 0x80), padding=padding, csrcs=tuple(csrcs),
+                   extension=ext, payload=payload)
+
+
+def header_size_cc_only(data: bytes) -> int:
+    """Header size as the reference computes it: ``12 + 4*CC``, extension bit
+    deliberately ignored (``ReflectorStream.cpp:1457-1459``)."""
+    return FIXED_HEADER_LEN + 4 * (data[0] & 0x0F)
+
+
+def peek_seq(data: bytes) -> int:
+    return struct.unpack_from("!H", data, 2)[0]
+
+
+def peek_timestamp(data: bytes) -> int:
+    return struct.unpack_from("!I", data, 4)[0]
+
+
+def peek_ssrc(data: bytes) -> int:
+    return struct.unpack_from("!I", data, 8)[0]
+
+
+def rewrite_header(data: bytes, *, seq: int | None = None,
+                   timestamp: int | None = None,
+                   ssrc: int | None = None) -> bytes:
+    """Return ``data`` with seq/timestamp/SSRC overwritten in place.
+
+    This is the scalar oracle for the device fan-out: the TPU path computes the
+    same three fields for every (subscriber, packet) pair in one batched op
+    (see ``ops.fanout``), and the egress scatters them over the shared payload.
+    """
+    out = bytearray(data)
+    if seq is not None:
+        struct.pack_into("!H", out, 2, seq & 0xFFFF)
+    if timestamp is not None:
+        struct.pack_into("!I", out, 4, timestamp & 0xFFFFFFFF)
+    if ssrc is not None:
+        struct.pack_into("!I", out, 8, ssrc & 0xFFFFFFFF)
+    return bytes(out)
+
+
+def seq_delta(a: int, b: int) -> int:
+    """Signed distance a-b in 16-bit sequence space (RFC 3550 A.1 style)."""
+    d = (a - b) & 0xFFFF
+    return d - 0x10000 if d >= 0x8000 else d

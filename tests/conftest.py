@@ -1,0 +1,17 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Real-TPU benchmarking happens only in bench.py; all tests (including the
+sharded multi-chip relay-step tests) run on the CPU backend with
+``--xla_force_host_platform_device_count=8`` so they are hermetic and fast.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

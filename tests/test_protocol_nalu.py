@@ -1,0 +1,94 @@
+from easydarwin_tpu.protocol import nalu, rtp
+
+
+def mkpkt(payload: bytes, csrcs=(), pad_to=20) -> bytes:
+    p = rtp.RtpPacket(payload_type=96, seq=1, timestamp=0, ssrc=1,
+                      csrcs=tuple(csrcs), payload=payload)
+    raw = p.to_bytes()
+    if len(raw) < pad_to:  # classifier requires >=20 bytes total
+        raw = rtp.RtpPacket(payload_type=96, seq=1, timestamp=0, ssrc=1,
+                            csrcs=tuple(csrcs),
+                            payload=payload + b"\x00" * (pad_to - len(raw))
+                            ).to_bytes()
+    return raw
+
+
+def nal_hdr(ntype, nri=3):
+    return bytes(((nri << 5) | ntype,))
+
+
+def test_single_nal_idr_sps_pps():
+    for t in (5, 7, 8):
+        assert nalu.is_keyframe_first_packet(mkpkt(nal_hdr(t) + b"\x00" * 10))
+    for t in (1, 6, 9, 12):
+        assert not nalu.is_keyframe_first_packet(mkpkt(nal_hdr(t) + b"\x00" * 10))
+
+
+def test_fua_start_bit():
+    # FU-A (28): indicator, then FU header with S bit + inner type
+    idr_start = nal_hdr(28) + bytes((0x80 | 5,)) + b"\x00" * 10
+    idr_mid = nal_hdr(28) + bytes((5,)) + b"\x00" * 10
+    non_idr = nal_hdr(28) + bytes((0x80 | 1,)) + b"\x00" * 10
+    assert nalu.is_keyframe_first_packet(mkpkt(idr_start))
+    assert not nalu.is_keyframe_first_packet(mkpkt(idr_mid))
+    assert not nalu.is_keyframe_first_packet(mkpkt(non_idr))
+    assert nalu.is_frame_first_packet(mkpkt(idr_start))
+    assert not nalu.is_frame_first_packet(mkpkt(idr_mid))
+
+
+def test_stap_a_inner():
+    # STAP-A (24): hdr, then 2-byte size, then inner NAL hdr at offset 3
+    sps_inner = nal_hdr(24) + b"\x00\x08" + nal_hdr(7) + b"\x00" * 10
+    p_inner = nal_hdr(24) + b"\x00\x08" + nal_hdr(1) + b"\x00" * 10
+    assert nalu.is_keyframe_first_packet(mkpkt(sps_inner))
+    assert not nalu.is_keyframe_first_packet(mkpkt(p_inner))
+
+
+def test_csrc_shifts_payload():
+    # With 2 CSRCs the NAL header sits 8 bytes later; the classifier must
+    # honor 12+4*CC (ReflectorStream.cpp:1457-1459).
+    raw = mkpkt(nal_hdr(5) + b"\x00" * 10, csrcs=(1, 2))
+    assert nalu.is_keyframe_first_packet(raw)
+
+
+def test_short_packet_never_classified():
+    p = rtp.RtpPacket(payload_type=96, seq=1, timestamp=0, ssrc=1,
+                      payload=nal_hdr(5)).to_bytes()
+    assert len(p) < 20
+    assert not nalu.is_keyframe_first_packet(p)
+    assert not nalu.is_frame_last_packet(p)
+
+
+def test_marker_is_frame_last():
+    p = rtp.RtpPacket(payload_type=96, seq=1, timestamp=0, ssrc=1, marker=True,
+                      payload=b"\x00" * 10).to_bytes()
+    assert nalu.is_frame_last_packet(p)
+
+
+def test_split_annexb():
+    nals = [b"\x67abc", b"\x68d", b"\x65" + b"x" * 5]
+    stream = b"\x00\x00\x00\x01" + nals[0] + b"\x00\x00\x01" + nals[1] + \
+        b"\x00\x00\x00\x01" + nals[2]
+    assert nalu.split_annexb(stream) == nals
+
+
+def test_packetize_single_and_fua_roundtrip():
+    small = nal_hdr(5) + b"k" * 50
+    pkts = nalu.packetize_h264(small, seq=10, timestamp=90000, ssrc=7)
+    assert len(pkts) == 1
+    assert nalu.is_keyframe_first_packet(pkts[0])
+    q = rtp.RtpPacket.parse(pkts[0])
+    assert q.marker and q.payload == small
+
+    big = nal_hdr(5) + bytes(range(256)) * 20  # 5121 bytes -> FU-A
+    pkts = nalu.packetize_h264(big, seq=10, timestamp=90000, ssrc=7, mtu=1400)
+    assert len(pkts) > 1
+    assert nalu.is_keyframe_first_packet(pkts[0])
+    assert all(not nalu.is_keyframe_first_packet(p) for p in pkts[1:])
+    assert nalu.is_frame_last_packet(pkts[-1])
+    # reassemble
+    body = b""
+    for praw in pkts:
+        pl = rtp.RtpPacket.parse(praw).payload
+        body += pl[2:]
+    assert bytes((pkts and rtp.RtpPacket.parse(pkts[0]).payload[0] & 0x60 | 5,)) + body == big

@@ -1,0 +1,58 @@
+import pytest
+
+from easydarwin_tpu.protocol import rtp
+
+
+def test_roundtrip_basic():
+    p = rtp.RtpPacket(payload_type=96, seq=4242, timestamp=0xDEADBEEF,
+                      ssrc=0x11223344, marker=True, payload=b"hello world")
+    q = rtp.RtpPacket.parse(p.to_bytes())
+    assert q == p
+
+
+def test_roundtrip_csrc_extension():
+    p = rtp.RtpPacket(payload_type=33, seq=1, timestamp=7, ssrc=9,
+                      csrcs=(0xA, 0xB), extension=(0xBEDE, b"\x01\x02\x03\x04"),
+                      payload=b"\x00" * 10)
+    raw = p.to_bytes()
+    q = rtp.RtpPacket.parse(raw)
+    assert q.csrcs == (0xA, 0xB)
+    assert q.extension == (0xBEDE, b"\x01\x02\x03\x04")
+    assert q.payload == b"\x00" * 10
+    assert q.header_len == 12 + 8 + 8
+
+
+def test_padding():
+    p = rtp.RtpPacket(payload_type=0, seq=5, timestamp=1, ssrc=2,
+                      payload=b"abc")
+    raw = bytearray(p.to_bytes())
+    raw[0] |= 0x20
+    raw += b"\x00\x00\x03"  # 3 bytes padding incl. count
+    q = rtp.RtpPacket.parse(bytes(raw))
+    assert q.payload == b"abc"
+    assert q.padding
+
+
+def test_bad_version_rejected():
+    with pytest.raises(rtp.RtpError):
+        rtp.RtpPacket.parse(b"\x00" * 12)
+
+
+def test_peek_and_rewrite():
+    p = rtp.RtpPacket(payload_type=96, seq=100, timestamp=9000, ssrc=77,
+                      payload=b"x" * 20)
+    raw = p.to_bytes()
+    assert rtp.peek_seq(raw) == 100
+    assert rtp.peek_timestamp(raw) == 9000
+    assert rtp.peek_ssrc(raw) == 77
+    out = rtp.rewrite_header(raw, seq=65535, timestamp=1, ssrc=0xFFFFFFFF)
+    q = rtp.RtpPacket.parse(out)
+    assert (q.seq, q.timestamp, q.ssrc) == (65535, 1, 0xFFFFFFFF)
+    assert q.payload == p.payload
+
+
+def test_seq_delta_wraparound():
+    assert rtp.seq_delta(1, 65535) == 2
+    assert rtp.seq_delta(65535, 1) == -2
+    assert rtp.seq_delta(0x8000, 0) == -0x8000
+    assert rtp.seq_delta(5, 5) == 0

@@ -1,0 +1,88 @@
+import pytest
+
+from easydarwin_tpu.protocol import rtsp
+
+
+def test_parse_simple_request():
+    r = rtsp.RtspWireReader()
+    r.feed(b"OPTIONS rtsp://h/live.sdp RTSP/1.0\r\nCSeq: 3\r\n\r\n")
+    evs = list(r.events())
+    assert len(evs) == 1
+    req = evs[0]
+    assert req.method == "OPTIONS" and req.cseq == 3
+    assert req.path() == "/live.sdp"
+
+
+def test_incremental_feed_and_body():
+    body = b"v=0\r\ns=x\r\n"
+    raw = (f"ANNOUNCE rtsp://h:554/push.sdp RTSP/1.0\r\nCSeq: 1\r\n"
+           f"Content-Type: application/sdp\r\nContent-Length: {len(body)}\r\n"
+           f"\r\n").encode() + body
+    r = rtsp.RtspWireReader()
+    for i in range(0, len(raw), 7):
+        r.feed(raw[i:i + 7])
+    evs = list(r.events())
+    assert len(evs) == 1
+    assert evs[0].method == "ANNOUNCE"
+    assert evs[0].body == body
+
+
+def test_interleaved_demux_mixed():
+    r = rtsp.RtspWireReader()
+    chunk = rtsp.frame_interleaved(0, b"\x80\x60" + b"\x00" * 10)
+    r.feed(chunk + b"TEARDOWN rtsp://h/x RTSP/1.0\r\nCSeq: 9\r\n\r\n" + chunk)
+    evs = list(r.events())
+    assert [type(e).__name__ for e in evs] == [
+        "InterleavedPacket", "RtspRequest", "InterleavedPacket"]
+    assert evs[0].channel == 0 and len(evs[0].data) == 12
+
+
+def test_transport_parse_udp():
+    t = rtsp.TransportSpec.parse("RTP/AVP;unicast;client_port=4588-4589")
+    assert not t.is_tcp and t.unicast and t.client_port == (4588, 4589)
+    assert t.mode == "PLAY"
+
+
+def test_transport_parse_tcp_record():
+    t = rtsp.TransportSpec.parse(
+        "RTP/AVP/TCP;unicast;interleaved=0-1;mode=record")
+    assert t.is_tcp and t.interleaved == (0, 1) and t.mode == "RECORD"
+
+
+def test_transport_to_header_roundtrip():
+    t = rtsp.TransportSpec.parse("RTP/AVP;unicast;client_port=9000-9001")
+    t.server_port = (6970, 6971)
+    t.ssrc = 0xABCD1234
+    hdr = t.to_header()
+    u = rtsp.TransportSpec.parse(hdr)
+    assert u.server_port == (6970, 6971)
+    assert u.ssrc == 0xABCD1234
+
+
+def test_response_build_and_parse():
+    resp = rtsp.RtspResponse(200, {"CSeq": "4", "Session": "123456"}, b"")
+    raw = resp.to_bytes()
+    assert raw.startswith(b"RTSP/1.0 200 OK\r\n")
+    r = rtsp.RtspWireReader(parse_responses=True)
+    r.feed(raw)
+    evs = list(r.events())
+    assert isinstance(evs[0], rtsp.RtspResponse)
+    assert evs[0].headers["session"] == "123456"
+
+
+def test_unknown_method_rejected():
+    r = rtsp.RtspWireReader()
+    r.feed(b"BOGUS rtsp://h/x RTSP/1.0\r\nCSeq: 1\r\n\r\n")
+    with pytest.raises(rtsp.RtspError) as ei:
+        list(r.events())
+    assert ei.value.status == 501
+
+
+def test_request_serialization_roundtrip():
+    req = rtsp.RtspRequest("SETUP", "rtsp://h/live/trackID=1",
+                           {"cseq": "2", "transport": "RTP/AVP;unicast;client_port=5000-5001"})
+    r = rtsp.RtspWireReader()
+    r.feed(req.to_bytes())
+    q = next(r.events())
+    assert q.method == "SETUP"
+    assert q.transport.client_port == (5000, 5001)

@@ -1,0 +1,102 @@
+import time
+
+from easydarwin_tpu.protocol import rtcp, sdp
+
+PUSH_SDP = """v=0
+o=- 0 0 IN IP4 127.0.0.1
+s=EasyPusher
+c=IN IP4 0.0.0.0
+t=0 0
+a=control:*
+m=video 0 RTP/AVP 96
+a=rtpmap:96 H264/90000
+a=fmtp:96 packetization-mode=1;profile-level-id=42001F
+a=control:trackID=1
+m=audio 0 RTP/AVP 97
+a=rtpmap:97 MPEG4-GENERIC/8000/1
+a=control:trackID=2
+"""
+
+
+def test_sdp_parse_streams():
+    sd = sdp.parse(PUSH_SDP)
+    assert len(sd.streams) == 2
+    v, a = sd.streams
+    assert v.media_type == "video" and v.codec == "H264"
+    assert v.clock_rate == 90000 and v.payload_type == 96
+    assert v.track_id == 1
+    assert a.media_type == "audio" and a.clock_rate == 8000
+    assert a.track_id == 2
+    assert sd.video_streams() == [v]
+
+
+def test_sdp_static_payload_defaults():
+    sd = sdp.parse("v=0\r\nm=video 0 RTP/AVP 26\r\n")
+    assert sd.streams[0].codec == "JPEG"
+    assert sd.streams[0].clock_rate == 90000
+
+
+def test_sdp_build_parse_roundtrip():
+    sd = sdp.parse(PUSH_SDP)
+    text = sdp.build(sd, server_ip="10.0.0.1", session_id=42)
+    sd2 = sdp.parse(text)
+    assert [s.codec for s in sd2.streams] == ["H264", "MPEG4-GENERIC"]
+    assert [s.track_id for s in sd2.streams] == [1, 2]
+    # canonical ordering: v,o,s,c,t first
+    kinds = [ln[0] for ln in text.strip().splitlines()]
+    assert kinds[:5] == ["v", "o", "s", "c", "t"]
+
+
+def test_sdp_cache_normalizes_paths():
+    c = sdp.SdpCache()
+    c.set("/live/cam1.sdp", "v=0")
+    assert c.get("/live/cam1") == "v=0"
+    assert c.get("/live/cam1.sdp") == "v=0"
+    c.pop("/live/cam1")
+    assert len(c) == 0
+
+
+def test_rtcp_sr_compound_roundtrip():
+    now = time.time()
+    raw = rtcp.build_server_compound(0x1234, "host.example", unix_time=now,
+                                     rtp_ts=90000, packet_count=10,
+                                     octet_count=999)
+    pkts = rtcp.parse_compound(raw)
+    assert isinstance(pkts[0], rtcp.SenderReport)
+    assert pkts[0].ssrc == 0x1234 and pkts[0].octet_count == 999
+    assert isinstance(pkts[1], rtcp.Sdes)
+    assert pkts[1].chunks[0].cname == "host.example"
+
+
+def test_rtcp_rr_parse():
+    rb = rtcp.ReportBlock(ssrc=7, fraction_lost=25, cumulative_lost=100,
+                          highest_seq=5000, jitter=30, lsr=1, dlsr=2)
+    raw = rtcp.ReceiverReport(99, [rb]).to_bytes()
+    (rr,) = rtcp.parse_compound(raw)
+    assert isinstance(rr, rtcp.ReceiverReport)
+    assert rr.ssrc == 99
+    assert rr.reports[0].fraction_lost == 25
+    assert rr.reports[0].cumulative_lost == 100
+
+
+def test_rtcp_bye_reason():
+    raw = rtcp.Bye([1, 2], "teardown").to_bytes()
+    (bye,) = rtcp.parse_compound(raw)
+    assert bye.ssrcs == [1, 2] and bye.reason == "teardown"
+
+
+def test_rtcp_ssrc_rewrite():
+    now = time.time()
+    raw = rtcp.build_server_compound(0x1234, "cn", unix_time=now, rtp_ts=1,
+                                     packet_count=1, octet_count=1)
+    out = rtcp.rewrite_compound_ssrc(raw, 0xCAFEBABE)
+    pkts = rtcp.parse_compound(out)
+    assert pkts[0].ssrc == 0xCAFEBABE
+    assert pkts[1].chunks[0].ssrc == 0xCAFEBABE
+
+
+def test_ntp_helpers():
+    ts = rtcp.ntp_now(1_700_000_000.5)
+    assert ts >> 32 == 1_700_000_000 + rtcp.NTP_EPOCH_DELTA
+    assert abs((ts & 0xFFFFFFFF) - (1 << 31)) < 10
+    assert rtcp.ntp_middle32(ts) == (ts >> 16) & 0xFFFFFFFF
