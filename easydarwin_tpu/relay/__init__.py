@@ -1,0 +1,25 @@
+"""Live-relay core (the reference's QTSSReflectorModule, re-designed).
+
+Reference parity map:
+
+* ``ring.py``      — ``ReflectorSender::fPacketQueue`` (bounded packet queue,
+  2060-byte slots, ``maxQSize`` 4000) **re-designed as a fixed-shape struct-
+  of-arrays ring** so the identical buffer feeds both the CPU fan-out loop and
+  ``jax.device_put`` for the TPU batch path.
+* ``stream.py``    — ``ReflectorStream``/``ReflectorSender``: keyframe index
+  (newest-IDR bookmark), late-joiner fast-start, bucketed output array with
+  per-bucket delay stagger, age-based eviction with bookmark pinning.
+* ``session.py``   — ``ReflectorSession``: SDP-driven stream set, output
+  registry, viewer counting, broadcast-session timeout bookkeeping.
+* ``output.py``    — ``ReflectorOutput``/``RTPSessionOutput``: the abstract
+  subscriber sink with WouldBlock bookmark-replay semantics and per-output
+  seq/SSRC/timestamp rewrite state.
+* ``fanout.py``    — the fan-out engines: ``CpuFanout`` (oracle, faithful to
+  ``ReflectorSender::ReflectPackets``) and ``TpuFanout`` (batched device
+  header-rewrite via ``easydarwin_tpu.ops``; payloads stay host-side).
+"""
+
+from .ring import PacketRing, SLOT_SIZE, PacketFlags  # noqa: F401
+from .output import RelayOutput, WriteResult  # noqa: F401
+from .stream import RelayStream, StreamSettings  # noqa: F401
+from .session import RelaySession  # noqa: F401

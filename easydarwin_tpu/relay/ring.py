@@ -1,0 +1,150 @@
+"""Fixed-shape packet ring — the shared CPU/TPU packet store.
+
+The reference keeps an intrusive linked queue of heap-allocated
+``ReflectorPacket`` objects (``ReflectorStream.h:122-180``, queue capped at
+4000 at ``ReflectorStream.cpp:1839``).  A TPU can't chase pointers, so the
+re-design is a struct-of-arrays ring with **absolute packet ids**:
+
+* ``data``     uint8  [capacity, SLOT_SIZE]  packet bytes, zero-padded
+* ``length``   int32  [capacity]
+* ``arrival``  int64  [capacity]             arrival time, ms
+* ``flags``    int32  [capacity]             bitfield (RTCP / keyframe / …)
+* ``seq``      int32  [capacity]             RTP sequence (host byte order)
+* ``timestamp``/``ssrc`` int64/int64 [capacity]
+
+A packet admitted at absolute id ``i`` lives in slot ``i % capacity`` until
+``tail`` passes it.  Bookmarks (per-output resume points, the keyframe index)
+are plain integers, immune to slot reuse because ids never repeat.  The same
+arrays are what the TPU path ships with ``device_put`` — no re-marshalling
+between the CPU oracle and the device batch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..protocol import nalu, rtp
+
+#: ReflectorStream.h:127 kMaxReflectorPacketSize
+SLOT_SIZE = 2060
+#: ReflectorStream.cpp:1839 maxQSize
+DEFAULT_CAPACITY = 4096
+
+
+class PacketFlags:
+    RTCP = 1 << 0
+    KEYFRAME_FIRST = 1 << 1      # IsKeyFrameFirstPacket
+    FRAME_FIRST = 1 << 2         # IsFrameFirstPacket
+    FRAME_LAST = 1 << 3          # marker bit
+    VIDEO = 1 << 4
+
+
+class PacketRing:
+    """Bounded packet store with absolute ids ``[tail, head)``."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 slot_size: int = SLOT_SIZE, is_video: bool = False):
+        self.capacity = capacity
+        self.slot_size = slot_size
+        self.is_video = is_video
+        self.data = np.zeros((capacity, slot_size), dtype=np.uint8)
+        self.length = np.zeros(capacity, dtype=np.int32)
+        self.arrival = np.zeros(capacity, dtype=np.int64)
+        self.flags = np.zeros(capacity, dtype=np.int32)
+        self.seq = np.zeros(capacity, dtype=np.int32)
+        self.timestamp = np.zeros(capacity, dtype=np.int64)
+        self.ssrc = np.zeros(capacity, dtype=np.int64)
+        self.head = 0            # next id to assign
+        self.tail = 0            # oldest live id
+        self.total_dropped = 0
+
+    def __len__(self) -> int:
+        return self.head - self.tail
+
+    def slot(self, pkt_id: int) -> int:
+        return pkt_id % self.capacity
+
+    def valid(self, pkt_id: int) -> bool:
+        return self.tail <= pkt_id < self.head
+
+    def push(self, packet: bytes, arrival_ms: int, *,
+             is_rtcp: bool = False) -> int:
+        """Admit one packet; classifies H.264 keyframe boundaries on ingest
+        (the reference classifies in ``ReflectorSocket::ProcessPacket``,
+        ``ReflectorStream.cpp:1869-1934``). Returns the absolute id."""
+        if len(packet) > self.slot_size:
+            packet = packet[:self.slot_size]
+        if len(self) >= self.capacity:
+            self.tail += 1          # overwrite-oldest, like maxQSize trim
+            self.total_dropped += 1
+        pid = self.head
+        s = self.slot(pid)
+        n = len(packet)
+        self.data[s, :n] = np.frombuffer(packet, dtype=np.uint8)
+        if n < self.slot_size:
+            self.data[s, n:] = 0
+        self.length[s] = n
+        self.arrival[s] = arrival_ms
+        f = 0
+        if is_rtcp:
+            f |= PacketFlags.RTCP
+        else:
+            if self.is_video:
+                f |= PacketFlags.VIDEO
+                if nalu.is_keyframe_first_packet(packet):
+                    f |= PacketFlags.KEYFRAME_FIRST
+                if nalu.is_frame_first_packet(packet):
+                    f |= PacketFlags.FRAME_FIRST
+            if nalu.is_frame_last_packet(packet):
+                f |= PacketFlags.FRAME_LAST
+            if n >= 12:
+                self.seq[s] = rtp.peek_seq(packet)
+                self.timestamp[s] = rtp.peek_timestamp(packet)
+                self.ssrc[s] = rtp.peek_ssrc(packet)
+        self.flags[s] = f
+        self.head = pid + 1
+        return pid
+
+    def get(self, pkt_id: int) -> bytes:
+        assert self.valid(pkt_id), pkt_id
+        s = self.slot(pkt_id)
+        return self.data[s, :self.length[s]].tobytes()
+
+    def get_flags(self, pkt_id: int) -> int:
+        return int(self.flags[self.slot(pkt_id)])
+
+    def get_arrival(self, pkt_id: int) -> int:
+        return int(self.arrival[self.slot(pkt_id)])
+
+    def evict_older_than(self, now_ms: int, max_age_ms: int,
+                         pin_id: int | None = None) -> int:
+        """Advance ``tail`` past packets older than ``max_age_ms`` — the
+        reference's ``RemoveOldPackets`` (``ReflectorStream.cpp:1242-1291``)
+        — but never past ``pin_id`` (bookmark pinning: packets still needed
+        by an output or by the keyframe index survive, mirroring
+        ``fNeededByOutput`` / keyframe-pinned retention)."""
+        limit = self.head if pin_id is None else min(pin_id, self.head)
+        evicted = 0
+        while self.tail < limit:
+            if now_ms - self.get_arrival(self.tail) <= max_age_ms:
+                break
+            self.tail += 1
+            evicted += 1
+        return evicted
+
+    def ids(self, start: int | None = None) -> range:
+        return range(max(self.tail, start if start is not None else self.tail),
+                     self.head)
+
+    def window_arrays(self, start: int, count: int):
+        """Contiguous view of up to ``count`` packets from absolute id
+        ``start`` as (ids, data, length, flags) — rolled so callers (the TPU
+        staging path) see them in id order even across the ring seam."""
+        start = max(start, self.tail)
+        stop = min(start + count, self.head)
+        if stop <= start:
+            z = np.zeros(0, dtype=np.int64)
+            return z, self.data[:0], self.length[:0], self.flags[:0]
+        idx = np.arange(start, stop) % self.capacity
+        return (np.arange(start, stop), self.data[idx], self.length[idx],
+                self.flags[idx])

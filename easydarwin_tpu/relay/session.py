@@ -1,0 +1,130 @@
+"""Relay session: the per-source-path unit (``ReflectorSession``).
+
+Built from a pushed (ANNOUNCE) or file-backed SDP; owns one ``RelayStream``
+per media section, keyed by track id.  The registry keyed by path replaces
+``sSessionMap`` (``QTSSReflectorModule.cpp:1379 FindOrCreateSession``).
+
+Audio/video fast-start coupling: when a video stream records a fresh
+keyframe, audio outputs that have not yet started are re-aligned so a late
+joiner's audio starts with the video GOP rather than up to ``overbuffer_ms``
+earlier (reference: audio bookmark resync on keyframe flag,
+``ReflectorStream.cpp:1915-1934``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..protocol import sdp as sdp_mod
+from .output import RelayOutput
+from .stream import RelayStream, StreamSettings
+
+
+def now_ms() -> int:
+    return int(time.monotonic() * 1000)
+
+
+class RelaySession:
+    def __init__(self, path: str, description: sdp_mod.SessionDescription,
+                 settings: StreamSettings | None = None):
+        self.path = path
+        self.description = description
+        self.settings = settings or StreamSettings()
+        self.streams: dict[int, RelayStream] = {}
+        for info in description.streams:
+            self.streams[info.track_id] = RelayStream(info, self.settings)
+        self.created_ms = now_ms()
+        self.last_ingest_ms = self.created_ms
+        self.pusher_alive = True
+
+    # -- ingest ------------------------------------------------------------
+    def push(self, track_id: int, packet: bytes, *, is_rtcp: bool = False,
+             t_ms: int | None = None) -> None:
+        st = self.streams.get(track_id)
+        if st is None:
+            return
+        t = now_ms() if t_ms is None else t_ms
+        self.last_ingest_ms = t
+        if is_rtcp:
+            st.push_rtcp(packet, t)
+        else:
+            st.push_rtp(packet, t)
+            # audio ↔ video GOP alignment for not-yet-started outputs
+            if st.has_keyframe_update:
+                st.has_keyframe_update = False
+                for other in self.streams.values():
+                    if other is st or other.info.media_type != "audio":
+                        continue
+                    for out in other.outputs:
+                        if out.bookmark is None and len(other.rtp_ring):
+                            out.bookmark = other.rtp_ring.head - 1
+
+    # -- outputs -----------------------------------------------------------
+    def add_output(self, track_id: int, output: RelayOutput) -> None:
+        st = self.streams.get(track_id)
+        if st is None:
+            raise KeyError(f"no track {track_id} in {self.path}")
+        st.add_output(output)
+
+    def remove_output(self, track_id: int, output: RelayOutput) -> bool:
+        st = self.streams.get(track_id)
+        return st.remove_output(output) if st else False
+
+    @property
+    def num_outputs(self) -> int:
+        return sum(s.num_outputs for s in self.streams.values())
+
+    # -- fan-out + maintenance --------------------------------------------
+    def reflect(self, t_ms: int | None = None) -> int:
+        t = now_ms() if t_ms is None else t_ms
+        return sum(s.reflect(t) for s in self.streams.values())
+
+    def prune(self, t_ms: int | None = None) -> int:
+        t = now_ms() if t_ms is None else t_ms
+        return sum(s.prune(t) for s in self.streams.values())
+
+    def stats(self) -> dict:
+        return {
+            "path": self.path,
+            "outputs": self.num_outputs,
+            "streams": {
+                tid: {
+                    "media": s.info.media_type, "codec": s.info.codec,
+                    "packets_in": s.stats.packets_in,
+                    "bytes_in": s.stats.bytes_in,
+                    "packets_out": s.stats.packets_out,
+                    "keyframes": s.stats.keyframes,
+                    "queue": len(s.rtp_ring),
+                } for tid, s in self.streams.items()
+            },
+        }
+
+
+class SessionRegistry:
+    """Path → RelaySession map (``sSessionMap`` / ``OSRefTable`` stand-in)."""
+
+    def __init__(self, settings: StreamSettings | None = None):
+        self.settings = settings or StreamSettings()
+        self.sessions: dict[str, RelaySession] = {}
+        self.sdp_cache = sdp_mod.SdpCache()
+
+    def find(self, path: str) -> RelaySession | None:
+        return self.sessions.get(sdp_mod._norm(path))
+
+    def find_or_create(self, path: str, sdp_text: str) -> RelaySession:
+        key = sdp_mod._norm(path)
+        sess = self.sessions.get(key)
+        if sess is None:
+            sess = RelaySession(key, sdp_mod.parse(sdp_text), self.settings)
+            self.sessions[key] = sess
+            self.sdp_cache.set(key, sdp_text)
+        return sess
+
+    def remove(self, path: str) -> None:
+        key = sdp_mod._norm(path)
+        self.sessions.pop(key, None)
+        self.sdp_cache.pop(key)
+
+    def paths(self) -> list[str]:
+        return sorted(self.sessions)

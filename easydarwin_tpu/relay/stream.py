@@ -1,0 +1,171 @@
+"""Per-track relay stream: rings, keyframe index, bucketed fan-out.
+
+``ReflectorStream`` + ``ReflectorSender`` re-designed around absolute-id
+rings.  One ``RelayStream`` per SDP media section; each owns an RTP ring and
+an RTCP ring (the reference binds a UDP socket *pair* per stream and runs two
+senders, ``ReflectorStream.h:87-180``).
+
+Fan-out follows ``ReflectorSender::ReflectPackets`` (``ReflectorStream.cpp:
+1024-1135``): outputs live in buckets of ``bucket_size``; bucket *b*'s sends
+are delayed ``b × bucket_delay_ms`` to smooth the egress burst; a packet is
+eligible for bucket *b* at ``arrival + b·delay ≤ now``.  New outputs
+fast-start from the newest keyframe bookmark when the stream is video
+(``GetNewestKeyFrameFirstPacket``, cpp:1310-1397) and otherwise from the
+newest packet inside the over-buffer window.  Eviction keeps everything any
+output still needs (bookmark pinning) up to ``max_age_ms``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..protocol.sdp import StreamInfo
+from .output import RelayOutput, WriteResult
+from .ring import DEFAULT_CAPACITY, PacketFlags, PacketRing
+
+
+@dataclass
+class StreamSettings:
+    """Tunables with the reference's defaults (``ReflectorStream.cpp:56-68``,
+    prefs table ``QTSServerPrefs.cpp``)."""
+
+    bucket_size: int = 16             # sBucketSize
+    bucket_delay_ms: int = 73         # sBucketDelayInMsec
+    overbuffer_ms: int = 10_000       # sOverBufferInMsec
+    max_age_ms: int = 20_000          # sMaxPacketAgeMSec
+    ring_capacity: int = DEFAULT_CAPACITY
+    first_timeout_ms: int = 2_000     # kFirstPacketOffsetMsec-style new-output slack
+
+
+@dataclass
+class StreamStats:
+    packets_in: int = 0
+    bytes_in: int = 0
+    packets_out: int = 0
+    stalls: int = 0
+    keyframes: int = 0
+
+
+class RelayStream:
+    def __init__(self, info: StreamInfo, settings: StreamSettings | None = None):
+        self.info = info
+        self.settings = settings or StreamSettings()
+        is_video = info.media_type == "video"
+        self.rtp_ring = PacketRing(self.settings.ring_capacity,
+                                   is_video=is_video)
+        self.rtcp_ring = PacketRing(min(256, self.settings.ring_capacity))
+        #: absolute id of the newest keyframe-first packet (video only) —
+        #: the fKeyFrameStartPacketElementPointer equivalent.
+        self.keyframe_id: int | None = None
+        self.has_keyframe_update = False     # SetHasVideoKeyFrameUpdate
+        self.buckets: list[list[RelayOutput]] = []
+        self.stats = StreamStats()
+
+    # -- ingest ------------------------------------------------------------
+    def push_rtp(self, packet: bytes, now_ms: int) -> int:
+        pid = self.rtp_ring.push(packet, now_ms)
+        self.stats.packets_in += 1
+        self.stats.bytes_in += len(packet)
+        if self.rtp_ring.get_flags(pid) & PacketFlags.KEYFRAME_FIRST:
+            self.keyframe_id = pid
+            self.has_keyframe_update = True
+            self.stats.keyframes += 1
+        return pid
+
+    def push_rtcp(self, packet: bytes, now_ms: int) -> int:
+        return self.rtcp_ring.push(packet, now_ms, is_rtcp=True)
+
+    # -- output management -------------------------------------------------
+    def add_output(self, output: RelayOutput) -> None:
+        """Place in the first bucket with a free slot, growing the bucket
+        array as needed (``ReflectorStream::AddOutput`` cpp:280-322)."""
+        for bucket in self.buckets:
+            if len(bucket) < self.settings.bucket_size:
+                bucket.append(output)
+                return
+        self.buckets.append([output])
+
+    def remove_output(self, output: RelayOutput) -> bool:
+        for bucket in self.buckets:
+            if output in bucket:
+                bucket.remove(output)
+                return True
+        return False
+
+    @property
+    def outputs(self) -> list[RelayOutput]:
+        return [o for b in self.buckets for o in b]
+
+    @property
+    def num_outputs(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+    # -- new-output placement ---------------------------------------------
+    def first_packet_for_new_output(self, now_ms: int) -> int | None:
+        """Fast-start resume point for a just-added output."""
+        ring = self.rtp_ring
+        if len(ring) == 0:
+            return None
+        if self.keyframe_id is not None and ring.valid(self.keyframe_id):
+            # newest keyframe still within the over-buffer window?
+            age = now_ms - ring.get_arrival(self.keyframe_id)
+            if age <= self.settings.overbuffer_ms:
+                return self.keyframe_id
+        # else: oldest packet younger than the over-buffer window
+        for pid in ring.ids():
+            if now_ms - ring.get_arrival(pid) <= self.settings.overbuffer_ms:
+                return pid
+        return ring.head - 1
+
+    # -- fan-out (CPU oracle) ---------------------------------------------
+    def reflect(self, now_ms: int) -> int:
+        """One fan-out pass; returns packets written.  Semantics mirror
+        ``ReflectPackets``: per-bucket delay stagger, per-output bookmark,
+        stop-on-WouldBlock (bookmark holds for replay next pass)."""
+        ring = self.rtp_ring
+        sent = 0
+        for b_idx, bucket in enumerate(self.buckets):
+            deadline = now_ms - b_idx * self.settings.bucket_delay_ms
+            for out in bucket:
+                if out.bookmark is None:
+                    out.bookmark = self.first_packet_for_new_output(now_ms)
+                    if out.bookmark is None:
+                        continue
+                if out.bookmark < ring.tail:   # evicted from under a stalled output
+                    out.bookmark = ring.tail
+                pid = out.bookmark
+                while pid < ring.head:
+                    if ring.get_arrival(pid) > deadline:
+                        break
+                    res = out.write_rtp(ring.get(pid))
+                    if res is WriteResult.WOULD_BLOCK:
+                        self.stats.stalls += 1
+                        break
+                    pid += 1
+                    if res is WriteResult.OK:
+                        sent += 1
+                out.bookmark = pid
+        self.stats.packets_out += sent
+        # relay buffered RTCP (SSRC-rewritten) to every output, newest only
+        rring = self.rtcp_ring
+        if len(rring):
+            newest = rring.head - 1
+            data = rring.get(newest)
+            for out in self.outputs:
+                out.write_rtcp(data)
+            rring.tail = rring.head
+        return sent
+
+    # -- maintenance -------------------------------------------------------
+    def prune(self, now_ms: int) -> int:
+        """Age-based eviction with bookmark + keyframe pinning
+        (``RemoveOldPackets`` cpp:1242-1291)."""
+        pins = [o.bookmark for o in self.outputs if o.bookmark is not None]
+        if self.keyframe_id is not None:
+            pins.append(self.keyframe_id)
+        pin = min(pins) if pins else None
+        n = self.rtp_ring.evict_older_than(now_ms, self.settings.max_age_ms, pin)
+        if (self.keyframe_id is not None
+                and not self.rtp_ring.valid(self.keyframe_id)):
+            self.keyframe_id = None
+        return n

@@ -1,0 +1,179 @@
+from easydarwin_tpu.protocol import nalu, rtcp, rtp, sdp
+from easydarwin_tpu.relay import (PacketRing, RelaySession, RelayStream,
+                                  StreamSettings)
+from easydarwin_tpu.relay.output import CollectingOutput
+from easydarwin_tpu.relay.ring import PacketFlags
+from easydarwin_tpu.relay.session import SessionRegistry
+
+VIDEO_SDP = ("v=0\r\nm=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+             "a=control:trackID=1\r\n")
+AV_SDP = VIDEO_SDP + ("m=audio 0 RTP/AVP 97\r\na=rtpmap:97 MPEG4-GENERIC/8000\r\n"
+                      "a=control:trackID=2\r\n")
+
+
+def vid_pkt(seq, ts=0, nal_type=1, fu_start=None, marker=False):
+    if fu_start is None:
+        payload = bytes(((3 << 5) | nal_type,)) + b"\x00" * 16
+    else:
+        payload = bytes(((3 << 5) | 28, (0x80 if fu_start else 0) | nal_type)) + b"\x00" * 16
+    return rtp.RtpPacket(payload_type=96, seq=seq, timestamp=ts, ssrc=0x5151,
+                         marker=marker, payload=payload).to_bytes()
+
+
+def mkstream(**kw) -> RelayStream:
+    info = sdp.parse(VIDEO_SDP).streams[0]
+    return RelayStream(info, StreamSettings(**kw))
+
+
+def test_ring_push_get_flags():
+    r = PacketRing(capacity=8, is_video=True)
+    pid = r.push(vid_pkt(1, nal_type=5), 1000)
+    assert r.get_flags(pid) & PacketFlags.KEYFRAME_FIRST
+    assert r.get_flags(pid) & PacketFlags.VIDEO
+    pid2 = r.push(vid_pkt(2, nal_type=1, marker=True), 1001)
+    assert r.get_flags(pid2) & PacketFlags.FRAME_LAST
+    assert not (r.get_flags(pid2) & PacketFlags.KEYFRAME_FIRST)
+    assert r.get(pid) == vid_pkt(1, nal_type=5)
+    assert int(r.seq[r.slot(pid2)]) == 2
+
+
+def test_ring_wraparound_and_drop_count():
+    r = PacketRing(capacity=4)
+    ids = [r.push(vid_pkt(i), 1000 + i) for i in range(10)]
+    assert len(r) == 4
+    assert r.total_dropped == 6
+    assert not r.valid(ids[0]) and r.valid(ids[-1])
+    assert r.get(ids[-1]) == vid_pkt(9)
+
+
+def test_basic_fanout_with_rewrite():
+    st = mkstream()
+    out = CollectingOutput(ssrc=0xAAAA, out_seq_start=100, out_ts_start=0)
+    st.add_output(out)
+    for i in range(5):
+        st.push_rtp(vid_pkt(1000 + i, ts=90_000 + i * 3000), 1000 + i)
+    st.reflect(2000)
+    assert len(out.rtp_packets) == 5
+    got = [rtp.RtpPacket.parse(p) for p in out.rtp_packets]
+    assert [g.seq for g in got] == [100, 101, 102, 103, 104]
+    assert all(g.ssrc == 0xAAAA for g in got)
+    assert got[1].timestamp - got[0].timestamp == 3000
+    # payloads bit-identical to source
+    assert got[0].payload == rtp.RtpPacket.parse(vid_pkt(1000, ts=90_000)).payload
+
+
+def test_late_joiner_fast_start_from_keyframe():
+    st = mkstream()
+    st.push_rtp(vid_pkt(1, nal_type=1), 1000)
+    st.push_rtp(vid_pkt(2, nal_type=5), 1100)      # IDR
+    st.push_rtp(vid_pkt(3, nal_type=1), 1200)
+    out = CollectingOutput(ssrc=1)
+    st.add_output(out)
+    st.reflect(1300)
+    # starts at the IDR (seq 2), not the GOP tail before it
+    seqs = [rtp.RtpPacket.parse(p).payload[0] & 0x1F for p in out.rtp_packets]
+    assert len(out.rtp_packets) == 2
+    assert seqs[0] == 5
+
+
+def test_new_output_skips_stale_when_no_keyframe():
+    st = mkstream(overbuffer_ms=1000)
+    st.push_rtp(vid_pkt(1), 0)        # age 5000 at join: outside overbuffer
+    st.push_rtp(vid_pkt(2), 4500)     # age 500: inside
+    out = CollectingOutput(ssrc=1)
+    st.add_output(out)
+    st.reflect(5000)
+    assert len(out.rtp_packets) == 1
+    assert rtp.RtpPacket.parse(out.rtp_packets[0]).payload == \
+        rtp.RtpPacket.parse(vid_pkt(2)).payload
+
+
+def test_bucket_delay_staggers_sends():
+    st = mkstream(bucket_size=1, bucket_delay_ms=100)
+    a, b = CollectingOutput(ssrc=1), CollectingOutput(ssrc=2)
+    st.add_output(a)
+    st.add_output(b)           # bucket_size=1 → second bucket
+    assert len(st.buckets) == 2
+    st.push_rtp(vid_pkt(1, nal_type=5), 1000)
+    st.reflect(1050)           # bucket1 deadline = 950 < arrival
+    assert len(a.rtp_packets) == 1 and len(b.rtp_packets) == 0
+    st.reflect(1100)           # now eligible
+    assert len(b.rtp_packets) == 1
+
+
+def test_wouldblock_bookmark_replay_no_loss_no_dup():
+    st = mkstream()
+    out = CollectingOutput(ssrc=9)
+    st.add_output(out)
+    for i in range(3):
+        st.push_rtp(vid_pkt(10 + i, ts=i * 100), 1000 + i)
+    out.block_next = 2          # stall mid-burst
+    st.reflect(2000)
+    assert len(out.rtp_packets) == 0 and out.stalls >= 1
+    st.reflect(2001)            # one more blocked write
+    st.reflect(2002)
+    assert [rtp.RtpPacket.parse(p).seq for p in out.rtp_packets] == [1, 2, 3]
+
+
+def test_prune_respects_bookmark_pin():
+    st = mkstream(max_age_ms=100)
+    out = CollectingOutput(ssrc=9)
+    st.add_output(out)
+    st.push_rtp(vid_pkt(1), 1000)
+    st.push_rtp(vid_pkt(2), 1001)
+    out.block_next = 10**9      # permanently stalled
+    st.reflect(1002)            # primes bookmark at first packet
+    assert st.prune(5000) == 0  # pinned by the stalled output
+    st.remove_output(out)
+    st.keyframe_id = None
+    assert st.prune(5000) == 2  # unpinned → age out
+
+
+def test_rtcp_relayed_with_ssrc_rewrite():
+    st = mkstream()
+    out = CollectingOutput(ssrc=0xBBBB)
+    st.add_output(out)
+    st.push_rtp(vid_pkt(1, nal_type=5), 1000)
+    sr = rtcp.build_server_compound(0x5151, "src", unix_time=1.0, rtp_ts=0,
+                                    packet_count=1, octet_count=10)
+    st.push_rtcp(sr, 1000)
+    st.reflect(1500)
+    assert len(out.rtcp_packets) == 1
+    pkts = rtcp.parse_compound(out.rtcp_packets[0])
+    assert pkts[0].ssrc == 0xBBBB
+
+
+def test_session_multi_track_and_audio_alignment():
+    sess = RelaySession("/live/cam", sdp.parse(AV_SDP))
+    assert set(sess.streams) == {1, 2}
+    aud = rtp.RtpPacket(payload_type=97, seq=1, timestamp=0, ssrc=7,
+                        payload=b"a" * 20).to_bytes()
+    out = CollectingOutput(ssrc=1)
+    sess.add_output(2, out)
+    # audio arrives before any video keyframe: output not yet primed
+    for i in range(5):
+        sess.push(2, aud, t_ms=1000 + i)
+    assert out.bookmark is None
+    sess.push(1, vid_pkt(1, nal_type=5), t_ms=1010)   # keyframe arrives
+    # audio output aligned to newest audio packet
+    assert out.bookmark == sess.streams[2].rtp_ring.head - 1
+    n = sess.reflect(2000)
+    assert n == 1   # only the aligned audio packet (+ the video has no outputs)
+
+
+def test_registry_find_or_create_and_sdp_cache():
+    reg = SessionRegistry()
+    s1 = reg.find_or_create("/live/cam1.sdp", VIDEO_SDP)
+    s2 = reg.find_or_create("/live/cam1", VIDEO_SDP)
+    assert s1 is s2
+    assert reg.sdp_cache.get("/live/cam1.sdp") == VIDEO_SDP
+    assert reg.paths() == ["/live/cam1"]
+    reg.remove("/live/cam1")
+    assert reg.find("/live/cam1") is None
+
+
+def test_stats_shape():
+    sess = RelaySession("/x", sdp.parse(AV_SDP))
+    st = sess.stats()
+    assert st["outputs"] == 0
+    assert st["streams"][1]["media"] == "video"
