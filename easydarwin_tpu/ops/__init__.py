@@ -1,0 +1,24 @@
+"""Device-tier ops (JAX/XLA/Pallas) — the TPU replacement for the
+reference's per-packet × per-subscriber reflector loop.
+
+Dataflow (north star, BASELINE config 4):
+
+    host ring ──[P,96] byte prefixes + lengths + arrivals──▶ device
+        parse.parse_packets      batched RTP header parse + H.264
+                                 keyframe/frame classification
+        gop.newest_keyframe      IDR bookmark scan
+        fanout.fanout_headers    vmap over subscribers: seq/ts rebase +
+                                 SSRC rewrite → [S,P,12] header bytes
+        fanout.eligibility       per-bucket delay stagger mask
+    device ──[S,P,12] headers + [S,P] mask──▶ host vectored egress
+
+Only rewritten 12-byte headers cross back; payload bytes never leave host
+memory (they are shared across all S subscribers and scattered with
+``sendmsg`` iovecs).  The reference instead memcpy's every packet once per
+subscriber (``ReflectorStream.cpp:1138 SendPacketsToOutput``).
+
+``transform`` holds the MXU-path kernels (8×8 DCT/IDCT/quant as batched
+matmuls) backing the config-5 transcode ladder.
+"""
+
+from . import fanout, gop, parse  # noqa: F401

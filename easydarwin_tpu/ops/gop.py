@@ -1,0 +1,57 @@
+"""Device-side GOP bookkeeping — the keyframe index and ring window ops.
+
+The reference maintains ``fKeyFrameStartPacketElementPointer`` (newest
+IDR-start packet) by checking each packet on ingest and walking pointers
+(``ReflectorStream.cpp:1292-1397``) plus a byte-oriented GOP cache
+(``CKeyFrameCache``, 2 MB cap, ``keyframecache.cpp``).  On device both
+collapse into masked reductions over the packet window.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def newest_keyframe(keyframe_first: jnp.ndarray,
+                    valid: jnp.ndarray) -> jnp.ndarray:
+    """Index of the newest valid keyframe-first packet, or -1.
+
+    keyframe_first/valid: [P] bool → int32 scalar.
+    """
+    idx = jnp.arange(keyframe_first.shape[0], dtype=jnp.int32)
+    cand = jnp.where(keyframe_first & valid, idx, -1)
+    return jnp.max(cand)
+
+
+@jax.jit
+def gop_window_mask(keyframe_first: jnp.ndarray, valid: jnp.ndarray,
+                    frame_last: jnp.ndarray) -> jnp.ndarray:
+    """[P] bool mask of the current (newest) GOP: every packet from the
+    newest keyframe-first onward.  The device equivalent of replaying
+    ``CKeyFrameCache`` to a late joiner (``keyframecache.cpp:6-118`` resets
+    the cache on each SPS and appends until the next)."""
+    start = newest_keyframe(keyframe_first, valid)
+    idx = jnp.arange(keyframe_first.shape[0], dtype=jnp.int32)
+    return valid & (start >= 0) & (idx >= start)
+
+
+@jax.jit
+def fast_start_indices(keyframe_first: jnp.ndarray, valid: jnp.ndarray,
+                       age_ms: jnp.ndarray, overbuffer_ms) -> jnp.ndarray:
+    """First packet a brand-new output should receive (scalar int32):
+    the newest in-window keyframe if one exists, else the oldest packet
+    younger than the over-buffer window, else the newest valid packet —
+    ``GetNewestKeyFrameFirstPacket`` + ``GetClientBufferStartPacketOffset``
+    semantics (``ReflectorStream.cpp:1196-1240, 1310-1397``).
+    ``age_ms`` is ``now − arrival`` per packet (int32)."""
+    P = keyframe_first.shape[0]
+    idx = jnp.arange(P, dtype=jnp.int32)
+    age_ok = valid & (age_ms.astype(jnp.int32)
+                      <= jnp.asarray(overbuffer_ms, jnp.int32))
+    kf = newest_keyframe(keyframe_first & age_ok, valid)
+    oldest_young = jnp.min(jnp.where(age_ok, idx, P))
+    newest_valid = jnp.max(jnp.where(valid, idx, -1))
+    fallback = jnp.where(oldest_young < P, oldest_young, newest_valid)
+    return jnp.where(kf >= 0, kf, fallback).astype(jnp.int32)

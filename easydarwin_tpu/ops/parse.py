@@ -1,0 +1,89 @@
+"""Batched RTP parse + H.264 classification on device.
+
+Vectorized (fixed-shape, branch-free) equivalent of the host oracle in
+``protocol.rtp`` / ``protocol.nalu`` — one fused XLA computation classifies a
+whole packet window at once instead of the reference's per-packet calls
+(``ReflectorSender::IsKeyFrameFirstPacket``, ``ReflectorStream.cpp:1403``).
+
+Inputs are ``[P, W]`` uint8 byte *prefixes* (W ≥ 32 covers every header the
+classifier can touch for CC ≤ 15 aggregation offsets; full payloads never
+need to reach the device for the fan-out path) plus ``[P]`` total lengths.
+All outputs are int32/bool ``[P]`` vectors.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+#: bytes of each packet staged to the device for parsing.  12 (fixed header)
+#: + 60 (max CSRC) + 10 (deepest aggregation peek, MTAP24 offset 9) → 96
+#: covers the worst legal case with headroom and keeps lanes aligned.
+PARSE_PREFIX = 96
+
+_KEYFRAME_TYPES = (5, 7, 8)
+#: aggregation-type → inner-NAL peek offset (ReflectorStream.cpp:1465-1483)
+_AGG_OFFSETS = ((24, 3), (25, 5), (26, 8), (27, 9))
+_MIN_CLASSIFY_LEN = 20
+
+
+def _byte_at(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """x: [P, W] int32, idx: [P] → x[p, idx[p]] with clamping."""
+    idx = jnp.clip(idx, 0, x.shape[1] - 1)
+    return jnp.take_along_axis(x, idx[:, None], axis=1)[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("is_video",))
+def parse_packets(prefix: jnp.ndarray, length: jnp.ndarray,
+                  is_video: bool = True) -> dict[str, jnp.ndarray]:
+    """Parse a ``[P, W]`` uint8 prefix batch.
+
+    Returns dict of ``[P]`` vectors: ``seq``, ``timestamp`` (uint32),
+    ``ssrc`` (uint32), ``marker``, ``payload_start`` (12+4·CC, the
+    reference's extension-blind header size), ``nal_type`` (effective, per
+    the oracle's aggregation/FU resolution), ``keyframe_first``,
+    ``frame_first``, ``frame_last`` (bool).
+    """
+    x = prefix.astype(jnp.int32)
+    length = length.astype(jnp.int32)
+    b0, b1 = x[:, 0], x[:, 1]
+    cc = b0 & 0x0F
+    hs = 12 + 4 * cc
+    seq = (x[:, 2] << 8) | x[:, 3]
+    ts = ((x[:, 4] << 24) | (x[:, 5] << 16) | (x[:, 6] << 8) | x[:, 7]
+          ).astype(jnp.uint32)
+    ssrc = ((x[:, 8] << 24) | (x[:, 9] << 16) | (x[:, 10] << 8) | x[:, 11]
+            ).astype(jnp.uint32)
+    marker = (b1 & 0x80) != 0
+
+    classifiable = (length >= _MIN_CLASSIFY_LEN) & (length > hs)
+    nal0 = _byte_at(x, hs) & 0x1F
+
+    eff = nal0
+    for agg_type, off in _AGG_OFFSETS:
+        inner = _byte_at(x, hs + off) & 0x1F
+        eff = jnp.where((nal0 == agg_type) & (length > hs + off), inner, eff)
+    fu_hdr = _byte_at(x, hs + 1)
+    is_fu = (nal0 == 28) | (nal0 == 29)
+    fu_ok = is_fu & (length > hs + 1)
+    fu_start = fu_ok & ((fu_hdr & 0x80) != 0)
+    eff = jnp.where(fu_start, fu_hdr & 0x1F, eff)
+    eff = jnp.where(classifiable, eff, -1)
+
+    kf = jnp.zeros_like(eff, dtype=bool)
+    for t in _KEYFRAME_TYPES:
+        kf |= eff == t
+    if not is_video:
+        kf = jnp.zeros_like(kf)
+
+    frame_first = classifiable & (((nal0 >= 1) & (nal0 <= 27)) | fu_start)
+    frame_last = (length >= _MIN_CLASSIFY_LEN) & marker
+
+    return {
+        "seq": seq, "timestamp": ts, "ssrc": ssrc, "marker": marker,
+        "payload_start": hs, "nal_type": eff,
+        "keyframe_first": kf & classifiable,
+        "frame_first": frame_first, "frame_last": frame_last,
+    }

@@ -62,6 +62,12 @@ class RelayOutput:
     def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
         raise NotImplementedError
 
+    def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
+        """Send a device-rewritten packet: 12-byte header + original bytes
+        from offset 12.  Default concatenates; socket-backed outputs override
+        with vectored I/O so the shared payload is never copied."""
+        return self.send_bytes(header + tail, is_rtcp=False)
+
     # -- relay-facing API --------------------------------------------------
     def write_rtp(self, packet: bytes) -> WriteResult:
         """Rewrite header per this output's state and send. The TPU engine

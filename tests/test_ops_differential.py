@@ -1,0 +1,155 @@
+"""Differential tests: device ops (on CPU backend) vs the protocol oracle.
+
+The CPU reflector path is the correctness oracle (SURVEY §4): every batched
+device op must agree bit-exactly with the per-packet Python implementation.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu.ops import fanout, gop, parse
+from easydarwin_tpu.protocol import nalu, rtp
+from easydarwin_tpu.relay.output import CollectingOutput
+
+P = parse.PARSE_PREFIX
+
+
+def stage(packets: list[bytes]):
+    n = len(packets)
+    pre = np.zeros((n, P), dtype=np.uint8)
+    ln = np.zeros(n, dtype=np.int32)
+    for i, pkt in enumerate(packets):
+        w = min(len(pkt), P)
+        pre[i, :w] = np.frombuffer(pkt[:w], dtype=np.uint8)
+        ln[i] = len(pkt)
+    return pre, ln
+
+
+def random_packet(rng: random.Random) -> bytes:
+    kind = rng.randrange(8)
+    cc = rng.choice([0, 0, 0, 1, 2, 15])
+    csrcs = tuple(rng.getrandbits(32) for _ in range(cc))
+    ntype = rng.choice([1, 5, 6, 7, 8, 9, 24, 25, 26, 27, 28, 29])
+    if ntype in (28, 29):
+        payload = bytes(((3 << 5) | ntype,
+                         (0x80 if rng.random() < 0.5 else 0) | rng.choice([1, 5, 7])))
+    elif ntype in (24, 25, 26, 27):
+        off = {24: 3, 25: 5, 26: 8, 27: 9}[ntype]
+        pad = bytes(rng.getrandbits(8) for _ in range(off - 1))
+        payload = bytes(((3 << 5) | ntype,)) + pad + bytes(((3 << 5) | rng.choice([1, 5, 7]),))
+    else:
+        payload = bytes(((3 << 5) | ntype,))
+    payload += bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 40)))
+    pkt = rtp.RtpPacket(
+        payload_type=rng.choice([96, 97, 26, 33]),
+        seq=rng.getrandbits(16), timestamp=rng.getrandbits(32),
+        ssrc=rng.getrandbits(32), marker=rng.random() < 0.3,
+        csrcs=csrcs, payload=payload).to_bytes()
+    if kind == 0:
+        pkt = pkt[:rng.randrange(4, max(5, len(pkt)))]  # truncated garbage
+    return pkt
+
+
+def test_parse_matches_oracle_fuzzed():
+    rng = random.Random(1234)
+    packets = [random_packet(rng) for _ in range(512)]
+    pre, ln = stage(packets)
+    out = {k: np.asarray(v) for k, v in parse.parse_packets(pre, ln).items()}
+    for i, pkt in enumerate(packets):
+        if len(pkt) >= 12:
+            assert out["seq"][i] == rtp.peek_seq(pkt), i
+            assert out["timestamp"][i] == rtp.peek_timestamp(pkt), i
+            assert out["ssrc"][i] == rtp.peek_ssrc(pkt), i
+            assert out["payload_start"][i] == rtp.header_size_cc_only(pkt), i
+        assert bool(out["keyframe_first"][i]) == nalu.is_keyframe_first_packet(pkt), \
+            (i, pkt.hex())
+        assert bool(out["frame_first"][i]) == nalu.is_frame_first_packet(pkt), i
+        assert bool(out["frame_last"][i]) == nalu.is_frame_last_packet(pkt), i
+
+
+def test_fanout_headers_bit_exact_vs_oracle():
+    rng = random.Random(99)
+    packets = [random_packet(rng) for _ in range(64)]
+    packets = [p for p in packets if len(p) >= 12][:48]
+    pre, ln = stage(packets)
+    n_out = 17
+    outs = [CollectingOutput(ssrc=rng.getrandbits(32),
+                             out_seq_start=rng.getrandbits(16),
+                             out_ts_start=rng.getrandbits(32))
+            for _ in range(n_out)]
+    # prime each output's rebase off the first packet (as the relay does)
+    for o in outs:
+        o.rewrite.base_src_seq = rtp.peek_seq(packets[0])
+        o.rewrite.base_src_ts = rtp.peek_timestamp(packets[0])
+    state = fanout.pack_output_state(outs)
+    fields = parse.parse_packets(pre, ln)
+    hdrs = np.asarray(fanout.fanout_headers(
+        pre[:, :2], fields["seq"], fields["timestamp"], state))
+    assert hdrs.shape == (n_out, len(packets), 12)
+    for s, o in enumerate(outs):
+        for p, pkt in enumerate(packets):
+            device_pkt = hdrs[s, p].tobytes() + pkt[12:]
+            oracle_pkt = rtp.rewrite_header(
+                pkt,
+                seq=o.rewrite.map_seq(rtp.peek_seq(pkt)),
+                timestamp=o.rewrite.map_ts(rtp.peek_timestamp(pkt)),
+                ssrc=o.rewrite.ssrc)
+            assert device_pkt == oracle_pkt, (s, p)
+
+
+def test_eligibility_bucket_stagger():
+    age = np.array([0, 50, 73, 100, 200], dtype=np.int32)
+    buckets = np.array([0, 1, 2], dtype=np.int32)
+    m = np.asarray(fanout.eligibility(age, buckets, 73))
+    # bucket 0: everything already arrived is eligible
+    assert m[0].tolist() == [True] * 5
+    # bucket 1: needs age >= 73
+    assert m[1].tolist() == [False, False, True, True, True]
+    # bucket 2: needs age >= 146
+    assert m[2].tolist() == [False, False, False, False, True]
+
+
+def test_newest_keyframe_and_gop_mask():
+    kf = np.array([False, True, False, True, False])
+    valid = np.ones(5, dtype=bool)
+    assert int(gop.newest_keyframe(kf, valid)) == 3
+    mask = np.asarray(gop.gop_window_mask(kf, valid, np.zeros(5, bool)))
+    assert mask.tolist() == [False, False, False, True, True]
+    assert int(gop.newest_keyframe(np.zeros(5, bool), valid)) == -1
+
+
+def test_fast_start_indices_matches_stream_logic():
+    # keyframe inside the window → keyframe index
+    kf = np.array([False, True, False, False])
+    valid = np.ones(4, bool)
+    age = np.array([5000, 4000, 100, 50], dtype=np.int32)
+    i = int(gop.fast_start_indices(kf, valid, age, 10_000))
+    assert i == 1
+    # keyframe too old → oldest young packet
+    age2 = np.array([30_000, 25_000, 100, 50], dtype=np.int32)
+    i2 = int(gop.fast_start_indices(kf, valid, age2, 10_000))
+    assert i2 == 2
+    # nothing young → newest valid
+    age3 = np.array([30_000, 25_000, 20_000, 15_000], dtype=np.int32)
+    i3 = int(gop.fast_start_indices(np.zeros(4, bool), valid, age3, 10_000))
+    assert i3 == 3
+
+
+def test_relay_batch_step_end_to_end_shapes():
+    rng = random.Random(7)
+    packets = [random_packet(rng) for _ in range(32)]
+    packets = [p for p in packets if len(p) >= 12][:32]
+    pre, ln = stage(packets)
+    outs = [CollectingOutput(ssrc=i) for i in range(8)]
+    for o in outs:
+        o.rewrite.base_src_seq = 0
+        o.rewrite.base_src_ts = 0
+    state = fanout.pack_output_state(outs)
+    buckets = np.array([i // 4 for i in range(8)], dtype=np.int32)
+    age = np.full(len(packets), 100, dtype=np.int32)
+    res = fanout.relay_batch_step(pre, ln, age, state, buckets, 73)
+    assert res["headers"].shape == (8, len(packets), 12)
+    assert res["mask"].shape == (8, len(packets))
+    assert bool(np.asarray(res["mask"]).all())
