@@ -83,6 +83,40 @@ def eligibility(age_ms: jnp.ndarray, bucket_of_output: jnp.ndarray,
 
 
 @jax.jit
+def relay_affine_step(prefix: jnp.ndarray, length: jnp.ndarray,
+                      out_state: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """Bandwidth-lean device step: O(S+P) results instead of O(S·P).
+
+    The per-subscriber rewrite is *affine*: ``seq' = seq + (out_seq_start −
+    base_src_seq)``, ``ts' = ts + (out_ts_start − base_src_ts)``, SSRC
+    constant per output.  So the device returns per-packet parsed fields and
+    per-output offset triples; the egress path (native sender or the
+    vectorized host renderer in ``relay.fanout``) applies the patch while
+    scattering — at memory bandwidth, with no per-unit host *compute*.
+    D2H shrinks from ``S·P·12`` bytes to ``4·(2P + 3S)``, which matters both
+    on PCIe and (drastically) on tunneled devices.
+    """
+    from .gop import newest_keyframe
+    from .parse import parse_packets
+
+    st = out_state.astype(jnp.uint32)
+    fields = parse_packets(prefix, length)
+    valid = length > 0
+    kf = fields["keyframe_first"] & valid
+    return {
+        "seq": fields["seq"].astype(jnp.uint32),
+        "timestamp": fields["timestamp"],
+        "keyframe_first": kf,
+        "frame_first": fields["frame_first"],
+        "frame_last": fields["frame_last"],
+        "newest_keyframe": newest_keyframe(kf, valid),
+        "seq_off": (st[:, 3] - st[:, 1]) & jnp.uint32(0xFFFF),
+        "ts_off": st[:, 4] - st[:, 2],
+        "ssrc": st[:, 0],
+    }
+
+
+@jax.jit
 def relay_batch_step(prefix: jnp.ndarray, length: jnp.ndarray,
                      age_ms: jnp.ndarray, out_state: jnp.ndarray,
                      bucket_of_output: jnp.ndarray,

@@ -1,0 +1,19 @@
+"""Multi-chip scale-out: mesh construction + sharded relay step.
+
+The reference scales across *machines* with Redis presence + EasyCMS
+redirection (SURVEY §5, `EasyRedisHandler.cpp:177-335`) and across *cores*
+with its task-thread pool.  Within a TPU pod the analogous axes are native
+mesh dimensions (SURVEY §2.6 mapping):
+
+* ``src``  — relay sources sharded across chips (the data-parallel axis);
+* ``sub``  — subscriber blocks sharded across chips (the tensor/fan-out
+  axis: each chip renders headers for its slice of subscribers);
+* ``win``  — the packet window sharded across chips (the sequence-parallel
+  axis: the GOP/keyframe scan becomes a ``pmax`` collective over ``win``).
+
+All collectives ride ICI inside a pod; the Redis/JSON control plane is kept
+unchanged (it is orthogonal to the data path) for multi-host DCN scale-out.
+"""
+
+from .mesh import (make_relay_mesh, sharded_relay_step,  # noqa: F401
+                   example_batch)

@@ -1,0 +1,65 @@
+"""Affine device step + host render ≡ full device render ≡ scalar oracle."""
+
+import random
+
+import numpy as np
+
+from easydarwin_tpu.ops import fanout, parse
+from easydarwin_tpu.protocol import rtp
+from easydarwin_tpu.relay.fanout import render_headers
+from easydarwin_tpu.relay.output import CollectingOutput
+
+from test_ops_differential import random_packet, stage
+
+
+def test_affine_render_matches_full_device_render():
+    rng = random.Random(3)
+    packets = [p for p in (random_packet(rng) for _ in range(128))
+               if len(p) >= 12]
+    pre, ln = stage(packets)
+    outs = [CollectingOutput(ssrc=rng.getrandbits(32),
+                             out_seq_start=rng.getrandbits(16),
+                             out_ts_start=rng.getrandbits(32))
+            for _ in range(23)]
+    for o in outs:
+        o.rewrite.base_src_seq = rng.getrandbits(16)
+        o.rewrite.base_src_ts = rng.getrandbits(32)
+    state = fanout.pack_output_state(outs)
+
+    aff = fanout.relay_affine_step(pre, ln, state)
+    host = render_headers(pre[:, :2], np.asarray(aff["seq"]),
+                          np.asarray(aff["timestamp"]),
+                          np.asarray(aff["seq_off"]),
+                          np.asarray(aff["ts_off"]), np.asarray(aff["ssrc"]))
+
+    fields = parse.parse_packets(pre, ln)
+    full = np.asarray(fanout.fanout_headers(
+        pre[:, :2], fields["seq"], fields["timestamp"], state))
+    np.testing.assert_array_equal(host, full)
+
+    # and against the scalar oracle on a sample
+    for s in (0, 11, 22):
+        for p in (0, len(packets) // 2, len(packets) - 1):
+            o = outs[s]
+            pkt = packets[p]
+            oracle = rtp.rewrite_header(
+                pkt, seq=o.rewrite.map_seq(rtp.peek_seq(pkt)),
+                timestamp=o.rewrite.map_ts(rtp.peek_timestamp(pkt)),
+                ssrc=o.rewrite.ssrc)
+            assert host[s, p].tobytes() + pkt[12:] == oracle
+
+
+def test_affine_step_keyframe_fields():
+    rng = random.Random(5)
+    packets = [p for p in (random_packet(rng) for _ in range(64))
+               if len(p) >= 12]
+    pre, ln = stage(packets)
+    state = fanout.pack_output_state([CollectingOutput(ssrc=1)])
+    aff = fanout.relay_affine_step(pre, ln, state)
+    from easydarwin_tpu.protocol import nalu
+    kf = np.asarray(aff["keyframe_first"])
+    for i, pkt in enumerate(packets):
+        assert bool(kf[i]) == nalu.is_keyframe_first_packet(pkt), i
+    nk = int(aff["newest_keyframe"])
+    expect = max((i for i in range(len(packets)) if kf[i]), default=-1)
+    assert nk == expect
