@@ -1,0 +1,75 @@
+"""CLI entry: ``python -m easydarwin_tpu [-c config.toml] [options]``.
+
+The ``main.cpp`` equivalent (CLI parse ``main.cpp:323-385``) minus the fork
+watchdog (see ``server.supervisor`` for the restart loop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+
+from .server import ServerConfig, StreamingServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="easydarwin_tpu",
+        description="TPU-native RTSP streaming/relay server")
+    p.add_argument("-c", "--config", help="TOML config file")
+    p.add_argument("-p", "--rtsp-port", type=int, help="RTSP listen port")
+    p.add_argument("--service-port", type=int, help="REST API port")
+    p.add_argument("--bind-ip", help="bind address")
+    p.add_argument("--movie-folder", help="VOD media directory")
+    p.add_argument("--tpu-fanout", action="store_true",
+                   help="enable the TPU batch fan-out engine")
+    p.add_argument("-x", "--exit-after-boot", action="store_true",
+                   help="boot, print status, exit (config check)")
+    return p
+
+
+def config_from_args(args) -> ServerConfig:
+    cfg = (ServerConfig.from_toml(args.config) if args.config
+           else ServerConfig())
+    for k in ("rtsp_port", "service_port", "bind_ip", "movie_folder"):
+        v = getattr(args, k)
+        if v is not None:
+            setattr(cfg, k, v)
+    if args.tpu_fanout:
+        cfg.tpu_fanout = True
+    return cfg
+
+
+async def amain(cfg: ServerConfig, exit_after_boot: bool = False) -> int:
+    app = StreamingServer(cfg)
+    await app.start()
+    print(f"easydarwin-tpu listening: rtsp://{cfg.bind_ip}:{app.rtsp.port} "
+          f"service http://{cfg.bind_ip}:{app.rest.port}/api/v1 "
+          f"tpu_fanout={'on' if cfg.tpu_fanout else 'off'}", flush=True)
+    if exit_after_boot:
+        await app.stop()
+        return 0
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    loop.add_signal_handler(signal.SIGHUP,
+                            lambda: cfg.update())   # RereadPrefs rebroadcast
+    await stop.wait()
+    print("shutting down...", flush=True)
+    await app.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    try:
+        return asyncio.run(amain(cfg, args.exit_after_boot))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

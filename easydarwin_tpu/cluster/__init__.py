@@ -1,0 +1,10 @@
+"""Cloud/cluster tier: EasyProtocol JSON, Redis presence, device manager.
+
+Reference parity: ``EasyProtocol/`` (JSON envelope + message IDs,
+``EasyProtocolDef.h:250-330``), ``EasyRedisModule``/``EasyRedisHandler.cpp``
+(presence + load keys with TTL), and the EasyCMS daemon
+(``EasyCMS/Server.tproj/HTTPSession.cpp`` device register / list / stream
+start-stop / PTZ / snapshot flows).
+"""
+
+from . import protocol  # noqa: F401

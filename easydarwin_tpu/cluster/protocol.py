@@ -1,0 +1,111 @@
+"""EasyProtocol-compatible JSON envelope + message vocabulary.
+
+The reference wraps every cloud/REST message as
+``{"EasyDarwin": {"Header": {CSeq, MessageType, ErrorNum, ErrorString,
+Version}, "Body": {...}}}`` (``EasyProtocolBase.cpp``, message IDs in
+``EasyProtocolDef.h:250-330``).  We keep the same wire shape so stock
+EasyDarwin tooling can talk to this server, with symbolic message names.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+ROOT = "EasyDarwin"
+VERSION = "1.0"
+
+# message types (EasyProtocolDef.h naming; values follow the MSG_ scheme)
+MSG_DS_REGISTER_REQ = 0x0001          # device → CMS register
+MSG_SD_REGISTER_ACK = 0x0002
+MSG_SD_PUSH_STREAM_REQ = 0x0003       # CMS → device: start pushing
+MSG_DS_PUSH_STREAM_ACK = 0x0004
+MSG_SD_STREAM_STOP_REQ = 0x0005
+MSG_DS_STREAM_STOP_ACK = 0x0006
+MSG_CS_DEVICE_LIST_REQ = 0x0007       # client → CMS
+MSG_SC_DEVICE_LIST_ACK = 0x0008
+MSG_CS_DEVICE_INFO_REQ = 0x0009
+MSG_SC_DEVICE_INFO_ACK = 0x000A
+MSG_CS_GET_STREAM_REQ = 0x000B        # client → CMS: want a stream
+MSG_SC_GET_STREAM_ACK = 0x000C
+MSG_CS_FREE_STREAM_REQ = 0x000D
+MSG_SC_FREE_STREAM_ACK = 0x000E
+MSG_DS_POST_SNAP_REQ = 0x000F         # device → CMS snapshot upload
+MSG_SD_POST_SNAP_ACK = 0x0010
+MSG_CS_PTZ_CTRL_REQ = 0x0011
+MSG_SC_PTZ_CTRL_ACK = 0x0012
+MSG_CS_PRESET_CTRL_REQ = 0x0013
+MSG_SC_PRESET_CTRL_ACK = 0x0014
+MSG_CS_TALKBACK_CTRL_REQ = 0x0015
+MSG_SC_TALKBACK_CTRL_ACK = 0x0016
+MSG_DS_CONTROL_PTZ_ACK = 0x0017
+MSG_SD_CONTROL_PTZ_REQ = 0x0018
+MSG_SC_SERVER_INFO_ACK = 0x0020
+MSG_SC_RTSP_LIVE_SESSIONS_ACK = 0x0021
+MSG_SC_BASE_CONFIG_ACK = 0x0022
+MSG_SC_EXCEPTION = 0x0FFF
+
+ERR_OK = 200
+ERR_UNAUTHORIZED = 401
+ERR_NOT_FOUND = 404
+ERR_BAD_REQUEST = 400
+ERR_DEVICE_OFFLINE = 600
+ERR_INTERNAL = 500
+
+_ERROR_STRINGS = {
+    ERR_OK: "Success OK", ERR_UNAUTHORIZED: "Unauthorized",
+    ERR_NOT_FOUND: "Not Found", ERR_BAD_REQUEST: "Bad Request",
+    ERR_DEVICE_OFFLINE: "Device Offline", ERR_INTERNAL: "Internal Error",
+}
+
+
+class ProtocolError(ValueError):
+    pass
+
+
+@dataclass
+class Message:
+    message_type: int
+    cseq: int = 1
+    error: int | None = None            # None for requests, set for ACKs
+    body: dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        header: dict[str, Any] = {
+            "CSeq": str(self.cseq),
+            "MessageType": f"0x{self.message_type:04X}",
+            "Version": VERSION,
+        }
+        if self.error is not None:
+            header["ErrorNum"] = str(self.error)
+            header["ErrorString"] = _ERROR_STRINGS.get(self.error, "Unknown")
+        return json.dumps({ROOT: {"Header": header, "Body": self.body}},
+                          indent=1)
+
+    @classmethod
+    def parse(cls, text: str | bytes) -> "Message":
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ProtocolError(f"bad JSON: {e}") from e
+        env = doc.get(ROOT)
+        if not isinstance(env, dict) or "Header" not in env:
+            raise ProtocolError("missing EasyDarwin envelope")
+        h = env["Header"]
+        try:
+            mt = h.get("MessageType", "0")
+            message_type = int(mt, 16) if isinstance(mt, str) else int(mt)
+        except ValueError as e:
+            raise ProtocolError(f"bad MessageType {h.get('MessageType')!r}") from e
+        err = h.get("ErrorNum")
+        return cls(
+            message_type=message_type,
+            cseq=int(h.get("CSeq", "1") or 1),
+            error=int(err) if err is not None else None,
+            body=env.get("Body") or {})
+
+
+def ack(message_type: int, cseq: int = 1, error: int = ERR_OK,
+        body: dict | None = None) -> str:
+    return Message(message_type, cseq, error, body or {}).to_json()

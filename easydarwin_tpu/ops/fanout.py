@@ -134,9 +134,10 @@ def relay_batch_step(prefix: jnp.ndarray, length: jnp.ndarray,
                              out_state)
     mask = eligibility(age_ms, bucket_of_output, bucket_delay_ms)
     valid = (length > 0)
+    sendable = (length >= 12)      # runts are never relayed (skipped host-side)
     return {
         "headers": headers,
-        "mask": mask & valid[None, :],
+        "mask": mask & sendable[None, :],
         "keyframe_first": fields["keyframe_first"],
         "newest_keyframe": newest_keyframe(fields["keyframe_first"], valid),
         "frame_last": fields["frame_last"],

@@ -5,10 +5,12 @@ Vectorized (fixed-shape, branch-free) equivalent of the host oracle in
 whole packet window at once instead of the reference's per-packet calls
 (``ReflectorSender::IsKeyFrameFirstPacket``, ``ReflectorStream.cpp:1403``).
 
-Inputs are ``[P, W]`` uint8 byte *prefixes* (W ≥ 32 covers every header the
-classifier can touch for CC ≤ 15 aggregation offsets; full payloads never
-need to reach the device for the fan-out path) plus ``[P]`` total lengths.
-All outputs are int32/bool ``[P]`` vectors.
+Inputs are ``[P, W]`` uint8 byte *prefixes* plus ``[P]`` total lengths; W
+must be ≥ ``PARSE_PREFIX`` (96): the deepest legal peek is CC=15 CSRCs +
+the MTAP24 inner-NAL offset = byte 81, and ``_byte_at`` clamps
+out-of-range column indices (a narrower buffer would silently classify
+from the wrong byte rather than error).  All outputs are int32/bool
+``[P]`` vectors.
 """
 
 from __future__ import annotations

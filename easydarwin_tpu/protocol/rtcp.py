@@ -228,7 +228,10 @@ def rewrite_compound_ssrc(data: bytes, new_ssrc: int) -> bytes:
         b0, ptype, words = struct.unpack_from("!BBH", out, off)
         if b0 >> 6 != 2:
             break
-        if ptype in (SR, RR, SDES, BYE, APP):
+        # only when the packet actually has a leading SSRC word (a BYE with
+        # count=0 or an empty SDES is 4 bytes; off+4 would be the NEXT
+        # packet's header)
+        if ptype in (SR, RR, SDES, BYE, APP) and words >= 1:
             struct.pack_into("!I", out, off + 4, new_ssrc & 0xFFFFFFFF)
         off += 4 + words * 4
     return bytes(out)

@@ -273,11 +273,13 @@ class RtspWireReader:
             pkt = InterleavedPacket(buf[1], bytes(buf[4:4 + length]))
             del buf[:4 + length]
             return pkt
-        # Tolerate stray CRLF between messages (RFC 2326 allows it).
-        while buf[:2] == b"\r\n":
-            del buf[:2]
-            if not buf:
-                return None
+        # Tolerate stray CRLF between messages (RFC 2326 allows it) — and
+        # re-dispatch afterwards: the next byte may start a '$' binary frame,
+        # which must not fall through to text parsing.
+        if buf[:2] == b"\r\n":
+            while buf[:2] == b"\r\n":
+                del buf[:2]
+            return self._next()
         end = buf.find(b"\r\n\r\n")
         if end < 0:
             if len(buf) > self.MAX_HEADER:

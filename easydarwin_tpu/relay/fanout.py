@@ -62,17 +62,30 @@ class TpuFanoutEngine:
         return flat
 
     def _prime(self, stream: RelayStream, flat, now_ms: int) -> None:
-        """New-output placement + seq/ts rebase priming, identical to the
-        scalar path (``RelayStream.reflect`` / ``write_rtp`` lazy priming)."""
+        """New-output placement + seq/ts rebase priming.
+
+        The scalar oracle latches the rebase origin exactly once, inside the
+        first ``write_rtp`` *attempt* (``RewriteState.base_src_seq < 0``
+        check — even a WOULD_BLOCK'd attempt latches).  Mirror that: latch
+        only if unlatched, from the first ring packet this output would
+        attempt this pass (bookmark advanced past runts, and only if that
+        packet is bucket-eligible now)."""
         ring = stream.rtp_ring
-        for out, _b in flat:
+        delay = stream.settings.bucket_delay_ms
+        for out, b_idx in flat:
             if out.bookmark is None:
                 out.bookmark = stream.first_packet_for_new_output(now_ms)
             if out.bookmark is not None and out.bookmark < ring.tail:
                 out.bookmark = ring.tail
-            if (out.bookmark is not None and out.packets_sent == 0
-                    and ring.valid(out.bookmark)):
-                s = ring.slot(out.bookmark)
+            if out.rewrite.base_src_seq >= 0 or out.bookmark is None:
+                continue
+            pid = out.bookmark
+            while pid < ring.head and ring.length[ring.slot(pid)] < 12:
+                pid += 1               # runts are skipped, never latched
+            if pid >= ring.head:
+                continue
+            s = ring.slot(pid)
+            if now_ms - int(ring.arrival[s]) >= b_idx * delay:
                 out.rewrite.base_src_seq = int(ring.seq[s])
                 out.rewrite.base_src_ts = int(ring.timestamp[s])
 
@@ -100,18 +113,26 @@ class TpuFanoutEngine:
             prefix, lengths.astype(np.int32), age, state, buckets,
             np.int32(stream.settings.bucket_delay_ms))
         headers = np.asarray(res["headers"])
-        mask = np.asarray(res["mask"])
 
         sent = 0
-        for s, (out, _b) in enumerate(flat):
+        delay = stream.settings.bucket_delay_ms
+        for s, (out, b_idx) in enumerate(flat):
             pid = out.bookmark
             if pid is None:
                 continue
+            deadline = now_ms - b_idx * delay
             while pid < ring.head:
                 j = pid - start
-                if j < 0 or not mask[s, j]:
+                if j < 0:
                     break
                 slot = ring.slot(pid)
+                # ordering mirrors the oracle exactly: eligibility first
+                # (break holds the bookmark), runt-skip second (advance)
+                if int(ring.arrival[slot]) > deadline:
+                    break
+                if ring.length[slot] < 12:
+                    pid += 1
+                    continue
                 payload = ring.data[slot, 12:ring.length[slot]]
                 wr = out.send_rewritten(headers[s, j].tobytes(),
                                         payload.tobytes())

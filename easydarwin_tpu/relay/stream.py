@@ -137,7 +137,11 @@ class RelayStream:
                 while pid < ring.head:
                     if ring.get_arrival(pid) > deadline:
                         break
-                    res = out.write_rtp(ring.get(pid))
+                    data = ring.get(pid)
+                    if len(data) < 12:      # runt: skip, never parse
+                        pid += 1
+                        continue
+                    res = out.write_rtp(data)
                     if res is WriteResult.WOULD_BLOCK:
                         self.stats.stalls += 1
                         break

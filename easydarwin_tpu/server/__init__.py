@@ -1,0 +1,23 @@
+"""Host-tier servers: RTSP protocol sessions + JSON REST management API.
+
+Reference parity:
+
+* ``config.py``      — the layered pref system (``QTSServerPrefs.cpp:190-280``
+  typed table, SIGHUP/REST ``RereadPrefs`` rebroadcast) as a dataclass +
+  TOML file + change hooks.
+* ``rtsp.py``        — ``RTSPSession``'s per-request role pipeline
+  (``RTSPSession.cpp:216`` state machine) as an asyncio connection handler
+  speaking OPTIONS/DESCRIBE/ANNOUNCE/SETUP/PLAY/PAUSE/RECORD/TEARDOWN/
+  GET_PARAMETER/SET_PARAMETER with interleaved-TCP and UDP transports.
+* ``transports.py``  — ``RTPStream``'s send paths (UDP ``RTPStream.cpp:1145``,
+  interleaved ``cpp:772``) + the RTP/RTCP port-pair pool
+  (``UDPSocketPool.h``) on asyncio datagram endpoints, with real
+  WouldBlock semantics from transport write-buffer high-water marks.
+* ``rest.py``        — the ``HTTPSession`` JSON API (routes
+  ``HTTPSession.cpp:365-405``) on the service port.
+* ``app.py``         — ``RunServer.cpp`` boot/supervision: wires config,
+  session registry, relay pump, timeout sweeps, REST; graceful shutdown.
+"""
+
+from .config import ServerConfig  # noqa: F401
+from .app import StreamingServer  # noqa: F401

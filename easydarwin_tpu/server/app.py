@@ -1,0 +1,155 @@
+"""Server assembly + supervision — the ``RunServer.cpp`` equivalent.
+
+Boot order mirrors ``StartServer`` (``RunServer.cpp:65-215``): config →
+session registry → listeners (RTSP + REST service port) → relay pump
+(the ReflectorSocket/IdleTask send loop, here one asyncio task, woken by
+ingest and ticking at ``reflect_interval_ms``) → timeout sweeper (15 s
+granularity, ``TimeoutTask.h:66``) → optional cluster presence task.
+
+The pump chooses per stream between the scalar CPU fan-out and the TPU
+batch engine (``relay.fanout.TpuFanoutEngine``) based on config and the
+subscriber count — the "module loaded / unloaded with CPU fallback"
+behavior the north star requires.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..relay.fanout import TpuFanoutEngine
+from ..relay.session import SessionRegistry, now_ms
+from .config import ServerConfig
+from .rest import RestApi
+from .rtsp import RtspServer
+
+
+class StreamingServer:
+    def __init__(self, config: ServerConfig | None = None, *,
+                 describe_fallback=None):
+        self.config = config or ServerConfig()
+        self.registry = SessionRegistry(self.config.stream_settings())
+        self.rtsp = RtspServer(self.config, self.registry,
+                               describe_fallback=describe_fallback,
+                               on_pump_wake=self._wake)
+        self.rest = RestApi(self.config, self)
+        self._pump_event = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+        self._running = False
+        self._restart_requested = False
+        self._engines: dict[int, TpuFanoutEngine] = {}
+        self.started_at = time.time()
+        self.config.on_change(self._on_config_change)
+
+    # ------------------------------------------------------------- control
+    async def start(self) -> None:
+        self._running = True
+        await self.rtsp.start()
+        await self.rest.start()
+        self._tasks = [
+            asyncio.create_task(self._pump_loop(), name="relay-pump"),
+            asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
+        ]
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.rtsp.stop()
+        await self.rest.stop()
+
+    def request_restart(self) -> None:
+        """REST /restart — the fork-watchdog restart analog
+        (``main.cpp:492-558``): supervisors watch this flag."""
+        self._restart_requested = True
+
+    def _on_config_change(self, cfg: ServerConfig) -> None:
+        self.registry.settings = cfg.stream_settings()
+
+    def _wake(self) -> None:
+        self._pump_event.set()
+
+    # ---------------------------------------------------------- pump loop
+    def _engine_for(self, stream) -> TpuFanoutEngine:
+        eng = self._engines.get(id(stream))
+        if eng is None:
+            eng = self._engines[id(stream)] = TpuFanoutEngine()
+        return eng
+
+    def _reflect_all(self) -> int:
+        t = now_ms()
+        sent = 0
+        use_tpu = self.config.tpu_fanout
+        for sess in list(self.registry.sessions.values()):
+            for stream in sess.streams.values():
+                if (use_tpu
+                        and stream.num_outputs >= self.config.tpu_min_outputs):
+                    sent += self._engine_for(stream).step(stream, t)
+                else:
+                    sent += stream.reflect(t)
+        return sent
+
+    async def _pump_loop(self) -> None:
+        interval = self.config.reflect_interval_ms / 1000.0
+        last_prune = 0.0
+        while self._running:
+            try:
+                await asyncio.wait_for(self._pump_event.wait(), interval)
+            except asyncio.TimeoutError:
+                pass
+            self._pump_event.clear()
+            self._reflect_all()
+            now = time.monotonic()
+            if now - last_prune >= 1.0:
+                last_prune = now
+                t = now_ms()
+                for sess in list(self.registry.sessions.values()):
+                    sess.prune(t)
+
+    async def _sweep_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.config.timeout_sweep_sec)
+            self.rtsp.sweep_timeouts()
+
+    # ------------------------------------------------------------- queries
+    def server_info(self) -> dict:
+        s = self.rtsp.stats
+        return {
+            "ServerName": "easydarwin-tpu",
+            "Version": "0.1.0",
+            "UpTimeSec": str(int(time.time() - self.started_at)),
+            "RTSPPort": str(self.rtsp.port or self.config.rtsp_port),
+            "ServicePort": str(self.rest.port or self.config.service_port),
+            "Connections": str(len(self.rtsp.connections)),
+            "PushSessions": str(len(self.registry.sessions)),
+            "Requests": str(s["requests"]),
+            "PacketsIn": str(s["packets_in"]),
+            "TpuFanout": "1" if self.config.tpu_fanout else "0",
+        }
+
+    def live_sessions(self) -> list[dict]:
+        out = []
+        for sess in self.registry.sessions.values():
+            st = sess.stats()
+            out.append({
+                "Path": sess.path,
+                "Url": f"rtsp://{self.config.wan_ip}:"
+                       f"{self.rtsp.port or self.config.rtsp_port}{sess.path}",
+                "Outputs": str(sess.num_outputs),
+                "AgeSec": str((now_ms() - sess.created_ms) // 1000),
+                "Streams": st["streams"],
+            })
+        return out
+
+    def device_stream_url(self, device: str) -> str | None:
+        path = f"/{device.strip('/')}"
+        for cand in (path, f"/live/{device.strip('/')}"):
+            if self.registry.find(cand) is not None:
+                return (f"rtsp://{self.config.wan_ip}:"
+                        f"{self.rtsp.port or self.config.rtsp_port}{cand}")
+        return None

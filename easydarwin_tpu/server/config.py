@@ -1,0 +1,106 @@
+"""Layered server configuration.
+
+The reference layers CLI getopt → XML prefs (``easydarwin.xml``) → a typed
+table of ~85 prefs with defaults (``QTSServerPrefs.cpp:190-280``) → SIGHUP /
+REST-triggered ``RereadPrefs`` role rebroadcast.  Here: a typed dataclass
+with the same key prefs, TOML load/save (stdlib ``tomllib``), and change
+listeners that components subscribe to (the RereadPrefs equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class ServerConfig:
+    # --- core ports (QTSServerPrefs: rtsp_port 222, service ports 273-274)
+    rtsp_port: int = 10554
+    service_port: int = 10008          # REST API (service_lan_port)
+    bind_ip: str = "0.0.0.0"
+    # --- relay tuning (ReflectorStream.cpp:56-68 + prefs)
+    bucket_size: int = 16
+    bucket_delay_ms: int = 73
+    overbuffer_sec: float = 10.0
+    max_packet_age_sec: float = 20.0
+    ring_capacity: int = 4096
+    reflect_interval_ms: int = 20      # sender wake cadence (ref: 200 ms)
+    # --- session management
+    rtsp_timeout_sec: int = 120        # idle RTSP session kill
+    push_timeout_sec: int = 20         # broadcaster refresh window
+    timeout_sweep_sec: int = 15        # TimeoutTask.h:66 granularity
+    # --- VOD
+    movie_folder: str = "/tmp/movies"
+    # --- device tier
+    tpu_fanout: bool = False           # batch engine instead of scalar loop
+    tpu_min_outputs: int = 8           # below this the scalar loop wins
+    # --- cluster (EasyRedisModule / EasyCMS prefs)
+    cloud_enabled: bool = False
+    redis_host: str = "127.0.0.1"
+    redis_port: int = 6379
+    server_id: str = "easydarwin-tpu-0"
+    cms_host: str = "127.0.0.1"
+    cms_port: int = 10000
+    wan_ip: str = "127.0.0.1"
+    # --- auth / misc
+    auth_enabled: bool = False
+    rest_username: str = "admin"
+    rest_password: str = "admin"
+    max_connections: int = 20000       # epollEvent.cpp:16 MAX_EPOLL_FD
+
+    _listeners: list[Callable[["ServerConfig"], None]] = field(
+        default_factory=list, repr=False, compare=False)
+
+    # -- reread-prefs machinery -------------------------------------------
+    def on_change(self, fn: Callable[["ServerConfig"], None]) -> None:
+        self._listeners.append(fn)
+
+    def update(self, **kw) -> None:
+        """Apply new values and rebroadcast (the RereadPrefs role)."""
+        for k, v in kw.items():
+            if k.startswith("_") or not hasattr(self, k):
+                raise KeyError(f"unknown pref {k!r}")
+            cur = getattr(self, k)
+            setattr(self, k, type(cur)(v) if cur is not None else v)
+        for fn in list(self._listeners):
+            fn(self)
+
+    # -- (de)serialization -------------------------------------------------
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)
+                if not f.name.startswith("_")}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServerConfig":
+        known = {f.name for f in dataclasses.fields(cls) if not f.name.startswith("_")}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_toml(cls, path: str) -> "ServerConfig":
+        with open(path, "rb") as f:
+            return cls.from_dict(tomllib.load(f))
+
+    def to_toml(self) -> str:
+        out = []
+        for k, v in self.to_dict().items():
+            if isinstance(v, bool):
+                out.append(f"{k} = {'true' if v else 'false'}")
+            elif isinstance(v, (int, float)):
+                out.append(f"{k} = {v}")
+            else:
+                out.append(f'{k} = "{v}"')
+        return "\n".join(out) + "\n"
+
+    # -- derived -----------------------------------------------------------
+    def stream_settings(self):
+        from ..relay.stream import StreamSettings
+        return StreamSettings(
+            bucket_size=self.bucket_size,
+            bucket_delay_ms=self.bucket_delay_ms,
+            overbuffer_ms=int(self.overbuffer_sec * 1000),
+            max_age_ms=int(self.max_packet_age_sec * 1000),
+            ring_capacity=self.ring_capacity)

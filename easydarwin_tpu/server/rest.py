@@ -1,0 +1,178 @@
+"""JSON REST management API on the service port.
+
+Reference parity: ``HTTPSession.cpp:318-732`` — routes at 365-405:
+``/api/v1/{login, logout, getserverinfo, getbaseconfig, setbaseconfig,
+restart, getrtsplivesessions, getdevicestream, livedevicestream}``, answers
+wrapped in the EasyProtocol envelope (``HTTPSession.cpp:655-732``).
+
+A deliberately tiny HTTP/1.1 server (no framework): parse request line +
+headers + optional body, route, answer JSON, close or keep-alive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import secrets
+import time
+from urllib.parse import parse_qs, urlparse
+
+from ..cluster import protocol as ep
+from .config import ServerConfig
+
+SERVER_NAME = "easydarwin-tpu/0.1"
+
+
+class RestApi:
+    def __init__(self, config: ServerConfig, app):
+        self.config = config
+        self.app = app                      # StreamingServer
+        self.tokens: set[str] = set()
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+        self.started_at = time.time()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.config.bind_ip,
+            self.config.service_port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                head = await reader.readuntil(b"\r\n\r\n")
+                lines = head.decode("latin-1").split("\r\n")
+                try:
+                    method, target, _version = lines[0].split(None, 2)
+                except ValueError:
+                    break
+                headers = {}
+                for ln in lines[1:]:
+                    k, _, v = ln.partition(":")
+                    if _:
+                        headers[k.strip().lower()] = v.strip()
+                body = b""
+                clen = int(headers.get("content-length", "0") or 0)
+                if clen:
+                    body = await reader.readexactly(clen)
+                status, payload = await self.route(method, target, headers,
+                                                   body)
+                data = payload.encode() if isinstance(payload, str) else payload
+                writer.write(
+                    f"HTTP/1.1 {status} {'OK' if status == 200 else 'Error'}\r\n"
+                    f"Server: {SERVER_NAME}\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(data)}\r\n"
+                    "Connection: keep-alive\r\n\r\n".encode() + data)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.LimitOverrunError):
+            pass
+        finally:
+            writer.close()
+
+    # ---------------------------------------------------------------- auth
+    def _authorized(self, headers: dict, params: dict) -> bool:
+        if not self.config.auth_enabled:
+            return True
+        token = (params.get("token", [None])[0]
+                 or headers.get("x-token"))
+        if token in self.tokens:
+            return True
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("basic "):
+            try:
+                user, _, pw = base64.b64decode(auth[6:]).decode().partition(":")
+                return (user == self.config.rest_username
+                        and pw == self.config.rest_password)
+            except Exception:
+                return False
+        return False
+
+    # --------------------------------------------------------------- route
+    async def route(self, method: str, target: str, headers: dict,
+                    body: bytes) -> tuple[int, str]:
+        url = urlparse(target)
+        path = url.path.rstrip("/").lower()
+        params = parse_qs(url.query)
+        if not path.startswith("/api/v1/"):
+            return 404, json.dumps({"error": "not found"})
+        cmd = path[len("/api/v1/"):]
+        if cmd == "login":
+            return self._login(params, headers)
+        if not self._authorized(headers, params):
+            return 401, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_UNAUTHORIZED)
+        fn = getattr(self, f"_cmd_{cmd}", None)
+        if fn is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return await fn(params, body) if asyncio.iscoroutinefunction(fn) \
+            else fn(params, body)
+
+    def _login(self, params: dict, headers: dict) -> tuple[int, str]:
+        user = params.get("username", [""])[0]
+        pw = params.get("password", [""])[0]
+        if (self.config.auth_enabled
+                and (user != self.config.rest_username
+                     or pw != self.config.rest_password)):
+            return 401, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_UNAUTHORIZED)
+        token = secrets.token_hex(16)
+        self.tokens.add(token)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK,
+                           body={"Token": token})
+
+    def _cmd_logout(self, params: dict, body: bytes) -> tuple[int, str]:
+        token = params.get("token", [""])[0]
+        self.tokens.discard(token)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK)
+
+    def _cmd_getserverinfo(self, params: dict, body: bytes) -> tuple[int, str]:
+        st = self.app.server_info()
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body=st)
+
+    def _cmd_getrtsplivesessions(self, params: dict,
+                                 body: bytes) -> tuple[int, str]:
+        sessions = self.app.live_sessions()
+        return 200, ep.ack(ep.MSG_SC_RTSP_LIVE_SESSIONS_ACK, body={
+            "SessionCount": str(len(sessions)), "Sessions": sessions})
+
+    def _cmd_getbaseconfig(self, params: dict, body: bytes) -> tuple[int, str]:
+        cfg = {k: v for k, v in self.config.to_dict().items()
+               if k != "rest_password"}
+        return 200, ep.ack(ep.MSG_SC_BASE_CONFIG_ACK, body={"Config": cfg})
+
+    def _cmd_setbaseconfig(self, params: dict, body: bytes) -> tuple[int, str]:
+        try:
+            doc = json.loads(body or b"{}")
+            changes = doc.get("Config", doc) if isinstance(doc, dict) else {}
+            self.config.update(**changes)
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": str(e)})
+        return 200, ep.ack(ep.MSG_SC_BASE_CONFIG_ACK)
+
+    def _cmd_restart(self, params: dict, body: bytes) -> tuple[int, str]:
+        self.app.request_restart()
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={"Restarting": "1"})
+
+    def _cmd_getdevicestream(self, params: dict,
+                             body: bytes) -> tuple[int, str]:
+        """Start/locate a device stream (cloud mode: asks CMS; standalone:
+        answers the local RTSP url if the path is live)."""
+        device = params.get("device", params.get("serial", [""]))[0]
+        if not device:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST)
+        url = self.app.device_stream_url(device)
+        if url is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION,
+                               error=ep.ERR_DEVICE_OFFLINE)
+        return 200, ep.ack(ep.MSG_SC_GET_STREAM_ACK, body={"URL": url})
+
+    _cmd_livedevicestream = _cmd_getdevicestream

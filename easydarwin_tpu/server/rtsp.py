@@ -1,0 +1,383 @@
+"""The RTSP session layer: per-connection request pipeline + media wiring.
+
+Reference parity: ``RTSPSession.cpp:216`` (state machine over parsed
+requests), ``QTSSReflectorModule.cpp`` request handling (``DoAnnounce`` 898,
+``DoDescribe`` 1176, ``DoSetup`` 1597, ``DoPlay`` 1867, teardown), and the
+interleaved ingest path ``QTSS_RTSPIncomingData_Role`` → ``ProcessRTPData``
+(``QTSSReflectorModule.cpp:604``).  One asyncio task per connection replaces
+the Task-thread state machine; WouldBlock backpressure is carried by the
+transport write-buffer (see ``transports``).
+
+A connection can be a *player* (DESCRIBE/SETUP/PLAY of a live path or VOD
+file), a *pusher* (ANNOUNCE/SETUP mode=record/RECORD — the EasyPusher flow),
+or a plain control connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import time
+from dataclasses import dataclass, field
+
+from ..protocol import rtsp, sdp
+from ..relay.session import RelaySession, SessionRegistry, now_ms
+from .config import ServerConfig
+from .transports import (InterleavedOutput, UdpOutput, UdpPair, UdpPortPool)
+
+SERVER_NAME = "easydarwin-tpu/0.1"
+ALLOWED = ("OPTIONS, DESCRIBE, ANNOUNCE, SETUP, PLAY, PAUSE, RECORD, "
+           "TEARDOWN, GET_PARAMETER, SET_PARAMETER")
+
+
+def _extract_track(uri_path: str) -> tuple[str, int | None]:
+    """Split '/live/cam1/trackID=2' → ('/live/cam1', 2)."""
+    low = uri_path.lower()
+    for marker in ("trackid=", "streamid=", "track"):
+        pos = low.rfind("/" + marker)
+        if pos >= 0:
+            tail = uri_path[pos + 1 + len(marker):]
+            digits = "".join(c for c in tail if c.isdigit())
+            if digits:
+                return uri_path[:pos], int(digits)
+    return uri_path, None
+
+
+@dataclass
+class _PlayerTrack:
+    track_id: int
+    output: object                      # RelayOutput
+    udp_pair: UdpPair | None = None
+
+
+@dataclass
+class _PusherTrack:
+    track_id: int
+    udp_pair: UdpPair | None = None
+
+
+class RtspConnection:
+    """One RTSP TCP connection (player, pusher, or control)."""
+
+    def __init__(self, server: "RtspServer", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.wire = rtsp.RtspWireReader()
+        self.session_id: str | None = None
+        self.path: str | None = None
+        self.relay: RelaySession | None = None
+        self.is_pusher = False
+        self.playing = False
+        self.player_tracks: dict[int, _PlayerTrack] = {}
+        self.pusher_tracks: dict[int, _PusherTrack] = {}
+        #: interleaved channel → (track_id, is_rtcp) for push ingest
+        self.channel_map: dict[int, tuple[int, bool]] = {}
+        self.last_activity = time.monotonic()
+        self.closed = False
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        self.client_ip = peer[0]
+
+    # ------------------------------------------------------------------ io
+    async def run(self) -> None:
+        try:
+            while not self.closed:
+                data = await self.reader.read(16384)
+                if not data:
+                    break
+                self.last_activity = time.monotonic()
+                self.wire.feed(data)
+                try:
+                    for ev in self.wire.events():
+                        if isinstance(ev, rtsp.InterleavedPacket):
+                            self._on_interleaved(ev)
+                        else:
+                            await self._dispatch(ev)
+                except rtsp.RtspError as e:
+                    self._reply(rtsp.RtspResponse(e.status), cseq=0)
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            await self.close()
+
+    def _reply(self, resp: rtsp.RtspResponse, cseq: int | None = None) -> None:
+        resp.headers.setdefault("CSeq", str(cseq) if cseq is not None else "0")
+        resp.headers.setdefault("Server", SERVER_NAME)
+        if self.session_id:
+            resp.headers.setdefault("Session", self.session_id)
+        self.writer.write(resp.to_bytes())
+
+    # ----------------------------------------------------------- dispatch
+    async def _dispatch(self, req: rtsp.RtspRequest) -> None:
+        self.server.stats["requests"] += 1
+        handler = getattr(self, f"_do_{req.method.lower()}", None)
+        if handler is None:
+            self._reply(rtsp.RtspResponse(501), req.cseq)
+            return
+        try:
+            await handler(req)
+        except rtsp.RtspError as e:
+            self._reply(rtsp.RtspResponse(e.status), req.cseq)
+
+    async def _do_options(self, req: rtsp.RtspRequest) -> None:
+        self._reply(rtsp.RtspResponse(200, {"Public": ALLOWED}), req.cseq)
+
+    async def _do_get_parameter(self, req: rtsp.RtspRequest) -> None:
+        self._reply(rtsp.RtspResponse(200), req.cseq)
+
+    async def _do_set_parameter(self, req: rtsp.RtspRequest) -> None:
+        self._reply(rtsp.RtspResponse(200), req.cseq)
+
+    async def _do_describe(self, req: rtsp.RtspRequest) -> None:
+        path = req.path()
+        text = await self.server.describe(path)
+        if text is None:
+            self._reply(rtsp.RtspResponse(404), req.cseq)
+            return
+        self.path = sdp._norm(path)
+        self._reply(rtsp.RtspResponse(200, {
+            "Content-Type": "application/sdp",
+            "Content-Base": req.uri.rstrip("/") + "/",
+        }, text.encode()), req.cseq)
+
+    async def _do_announce(self, req: rtsp.RtspRequest) -> None:
+        if not req.body:
+            raise rtsp.RtspError(400, "ANNOUNCE without SDP")
+        path = req.path()
+        self.relay = self.server.registry.find_or_create(
+            path, req.body.decode("utf-8", "replace"))
+        self.path = self.relay.path
+        self.is_pusher = True
+        self.server.stats["pushers"] += 1
+        self._reply(rtsp.RtspResponse(200), req.cseq)
+
+    # -- SETUP -------------------------------------------------------------
+    async def _do_setup(self, req: rtsp.RtspRequest) -> None:
+        t = req.transport
+        if t is None:
+            raise rtsp.RtspError(461)
+        base, track_id = _extract_track(req.path())
+        if self.session_id is None:
+            self.session_id = secrets.token_hex(8)
+        if t.mode == "RECORD" or self.is_pusher:
+            await self._setup_record(req, base, track_id, t)
+        else:
+            await self._setup_play(req, base, track_id, t)
+
+    async def _setup_record(self, req, base, track_id, t) -> None:
+        if self.relay is None:
+            raise rtsp.RtspError(455, "SETUP record before ANNOUNCE")
+        if track_id is None or track_id not in self.relay.streams:
+            raise rtsp.RtspError(404, f"unknown track {track_id}")
+        resp_t = rtsp.TransportSpec(protocol=t.protocol, mode="RECORD",
+                                    is_tcp=t.is_tcp)
+        if t.is_tcp:
+            ch = t.interleaved or (2 * (len(self.pusher_tracks)),
+                                   2 * len(self.pusher_tracks) + 1)
+            self.channel_map[ch[0]] = (track_id, False)
+            self.channel_map[ch[1]] = (track_id, True)
+            self.pusher_tracks[track_id] = _PusherTrack(track_id)
+            resp_t.interleaved = ch
+        else:
+            tid = track_id
+            pair = await self.server.udp_pool.allocate(
+                on_rtp=lambda d, a, tid=tid: self._udp_ingest(tid, d, False),
+                on_rtcp=lambda d, a, tid=tid: self._udp_ingest(tid, d, True))
+            self.pusher_tracks[track_id] = _PusherTrack(track_id, pair)
+            resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
+            resp_t.client_port = t.client_port
+        self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
+                    req.cseq)
+
+    async def _setup_play(self, req, base, track_id, t) -> None:
+        relay = await self.server.open_for_play(base)
+        if relay is None:
+            raise rtsp.RtspError(404)
+        self.relay = relay
+        self.path = relay.path
+        if track_id is None:
+            track_id = sorted(set(relay.streams) - set(self.player_tracks))[0] \
+                if set(relay.streams) - set(self.player_tracks) else None
+        if track_id is None or track_id not in relay.streams:
+            raise rtsp.RtspError(404, f"unknown track {track_id}")
+        ssrc = secrets.randbits(32)
+        seq0 = secrets.randbits(16)
+        resp_t = rtsp.TransportSpec(protocol=t.protocol, is_tcp=t.is_tcp)
+        resp_t.ssrc = ssrc
+        pair = None
+        if t.is_tcp:
+            ch = t.interleaved or (2 * len(self.player_tracks),
+                                   2 * len(self.player_tracks) + 1)
+            out = InterleavedOutput(self.writer.transport, ch[0], ch[1],
+                                    ssrc=ssrc, out_seq_start=seq0)
+            resp_t.interleaved = ch
+        else:
+            if not t.client_port:
+                raise rtsp.RtspError(461, "UDP SETUP without client_port")
+            pair = await self.server.udp_pool.allocate(
+                on_rtcp=lambda d, a: self.server.on_client_rtcp(self, d))
+            out = UdpOutput(pair.rtp_transport, pair.rtcp_transport,
+                            self.client_ip, t.client_port[0],
+                            t.client_port[1], ssrc=ssrc, out_seq_start=seq0)
+            resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
+            resp_t.client_port = t.client_port
+        self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
+        self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
+                    req.cseq)
+
+    async def _do_record(self, req: rtsp.RtspRequest) -> None:
+        if not self.is_pusher or self.relay is None:
+            raise rtsp.RtspError(455)
+        self.relay.pusher_alive = True
+        self._reply(rtsp.RtspResponse(200), req.cseq)
+
+    async def _do_play(self, req: rtsp.RtspRequest) -> None:
+        if self.relay is None or not self.player_tracks:
+            raise rtsp.RtspError(455)
+        infos = []
+        for tid, pt in self.player_tracks.items():
+            if pt.output not in self.relay.streams[tid].outputs:
+                self.relay.add_output(tid, pt.output)
+            infos.append(f"url={req.uri.rstrip('/')}/trackID={tid}"
+                         f";seq={pt.output.rewrite.out_seq_start}")
+        self.playing = True
+        self.server.stats["players"] += 1
+        self.server.wake_pump()
+        self._reply(rtsp.RtspResponse(200, {
+            "Range": "npt=now-", "RTP-Info": ",".join(infos)}), req.cseq)
+
+    async def _do_pause(self, req: rtsp.RtspRequest) -> None:
+        self._detach_outputs()
+        self.playing = False
+        self._reply(rtsp.RtspResponse(200), req.cseq)
+
+    async def _do_teardown(self, req: rtsp.RtspRequest) -> None:
+        self._reply(rtsp.RtspResponse(200), req.cseq)
+        await self.close()
+
+    # -------------------------------------------------------- media paths
+    def _on_interleaved(self, pkt: rtsp.InterleavedPacket) -> None:
+        """Pushed media over the RTSP TCP connection (RECORD mode)."""
+        m = self.channel_map.get(pkt.channel)
+        if m is None or self.relay is None:
+            return
+        track_id, is_rtcp = m
+        self.relay.push(track_id, pkt.data, is_rtcp=is_rtcp)
+        self.server.stats["packets_in"] += 1
+        self.server.wake_pump()
+
+    def _udp_ingest(self, track_id: int, data: bytes, is_rtcp: bool) -> None:
+        if self.relay is not None:
+            self.relay.push(track_id, data, is_rtcp=is_rtcp)
+            self.server.stats["packets_in"] += 1
+            self.server.wake_pump()
+
+    # ----------------------------------------------------------- teardown
+    def _detach_outputs(self) -> None:
+        if self.relay is None:
+            return
+        for tid, pt in self.player_tracks.items():
+            st = self.relay.streams.get(tid)
+            if st is not None:
+                st.remove_output(pt.output)
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._detach_outputs()
+        for pt in self.player_tracks.values():
+            if pt.udp_pair:
+                pt.udp_pair.close()
+        for pt in self.pusher_tracks.values():
+            if pt.udp_pair:
+                pt.udp_pair.close()
+        if self.is_pusher and self.relay is not None:
+            # pusher gone → tear down the relay session (the reference frees
+            # the ReflectorSession when the broadcast stops)
+            self.server.registry.remove(self.relay.path)
+            self.relay = None
+        self.server.connections.discard(self)
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class RtspServer:
+    """Listener + connection registry (QTSServer::CreateListeners analog)."""
+
+    def __init__(self, config: ServerConfig, registry: SessionRegistry,
+                 *, describe_fallback=None, on_pump_wake=None):
+        self.config = config
+        self.registry = registry
+        self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
+        self.connections: set[RtspConnection] = set()
+        self.stats = {"requests": 0, "pushers": 0, "players": 0,
+                      "packets_in": 0}
+        self._server: asyncio.AbstractServer | None = None
+        #: hook for VOD / other describe sources: async (path) -> sdp | None
+        self.describe_fallback = describe_fallback
+        self._on_pump_wake = on_pump_wake
+        self.port: int | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.config.bind_ip, self.config.rtsp_port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for conn in list(self.connections):
+            await conn.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_connection(self, reader, writer) -> None:
+        if len(self.connections) >= self.config.max_connections:
+            writer.close()
+            return
+        conn = RtspConnection(self, reader, writer)
+        self.connections.add(conn)
+        await conn.run()
+
+    # -- hooks -------------------------------------------------------------
+    async def describe(self, path: str) -> str | None:
+        text = self.registry.sdp_cache.get(path)
+        if text is None and self.describe_fallback is not None:
+            text = await self.describe_fallback(path)
+        return text
+
+    async def open_for_play(self, path: str) -> RelaySession | None:
+        return self.registry.find(path)
+
+    def on_client_rtcp(self, conn: RtspConnection, data: bytes) -> None:
+        """Receiver reports from UDP players (flow-control input)."""
+        self.stats.setdefault("rtcp_in", 0)
+        self.stats["rtcp_in"] += 1
+
+    def wake_pump(self) -> None:
+        if self._on_pump_wake is not None:
+            self._on_pump_wake()
+
+    def sweep_timeouts(self) -> int:
+        """Close idle connections (TimeoutTask 15 s sweep equivalent)."""
+        now = time.monotonic()
+        killed = 0
+        for conn in list(self.connections):
+            idle = now - conn.last_activity
+            limit = (self.config.push_timeout_sec if conn.is_pusher
+                     else self.config.rtsp_timeout_sec)
+            if conn.is_pusher and self.relay_active(conn):
+                limit = max(limit, self.config.push_timeout_sec)
+            if idle > limit:
+                asyncio.get_event_loop().create_task(conn.close())
+                killed += 1
+        return killed
+
+    @staticmethod
+    def relay_active(conn: RtspConnection) -> bool:
+        return (conn.relay is not None
+                and now_ms() - conn.relay.last_ingest_ms < 5_000)

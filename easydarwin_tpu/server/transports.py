@@ -1,0 +1,153 @@
+"""RTP/RTCP transports: UDP port-pair pool, UDP & interleaved outputs.
+
+Reference parity: ``UDPSocketPool`` (even-RTP/odd-RTCP port pairs,
+``UDPSocketPool.h``), ``RTPStream``'s UDP send (``RTPStream.cpp:1145``) and
+TCP interleaved send (``InterleavedWrite``, ``RTPStream.cpp:772``), the
+reflector's ingest sockets (``ReflectorSocket``), and the WouldBlock
+flow-control contract (``RTPSessionOutput.cpp:610-620``): a stalled client
+must never stall the relay — the output reports WOULD_BLOCK and replays from
+its bookmark on the next pass.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from ..protocol import rtsp
+from ..relay.output import RelayOutput, WriteResult
+
+#: default interleaved write-buffer high water mark: past this the output
+#: reports WOULD_BLOCK (the reference gets EAGAIN from a 96 KB SO_SNDBUF,
+#: TCPListenerSocket.cpp:189-190)
+HIGH_WATER = 256 * 1024
+
+
+class InterleavedOutput(RelayOutput):
+    """$-framed RTP/RTCP egress over the client's RTSP TCP connection."""
+
+    def __init__(self, transport: asyncio.WriteTransport,
+                 rtp_channel: int, rtcp_channel: int, **kw):
+        super().__init__(**kw)
+        self.transport = transport
+        self.rtp_channel = rtp_channel
+        self.rtcp_channel = rtcp_channel
+
+    def _send(self, channel: int, chunks: tuple[bytes, ...]) -> WriteResult:
+        tr = self.transport
+        if tr.is_closing():
+            return WriteResult.ERROR
+        if tr.get_write_buffer_size() > HIGH_WATER:
+            return WriteResult.WOULD_BLOCK
+        n = sum(len(c) for c in chunks)
+        tr.write(b"$" + bytes((channel,)) + n.to_bytes(2, "big"))
+        for c in chunks:
+            tr.write(c)
+        return WriteResult.OK
+
+    def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
+        ch = self.rtcp_channel if is_rtcp else self.rtp_channel
+        return self._send(ch, (data,))
+
+    def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
+        return self._send(self.rtp_channel, (header, tail))
+
+
+class UdpOutput(RelayOutput):
+    """RTP/RTCP egress to a client's UDP port pair."""
+
+    def __init__(self, rtp_transport: asyncio.DatagramTransport,
+                 rtcp_transport: asyncio.DatagramTransport | None,
+                 client_ip: str, client_rtp_port: int,
+                 client_rtcp_port: int, **kw):
+        super().__init__(**kw)
+        self.rtp_transport = rtp_transport
+        self.rtcp_transport = rtcp_transport
+        self.rtp_addr = (client_ip, client_rtp_port)
+        self.rtcp_addr = (client_ip, client_rtcp_port)
+
+    def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
+        tr = self.rtcp_transport if is_rtcp else self.rtp_transport
+        if tr is None:
+            return WriteResult.OK
+        if tr.is_closing():
+            return WriteResult.ERROR
+        tr.sendto(data, self.rtcp_addr if is_rtcp else self.rtp_addr)
+        return WriteResult.OK
+
+    def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
+        if self.rtp_transport.is_closing():
+            return WriteResult.ERROR
+        self.rtp_transport.sendto(header + tail, self.rtp_addr)
+        return WriteResult.OK
+
+
+class _DatagramSink(asyncio.DatagramProtocol):
+    def __init__(self, on_packet: Callable[[bytes, tuple], None] | None = None):
+        self.on_packet = on_packet
+        self.transport: asyncio.DatagramTransport | None = None
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        if self.on_packet is not None:
+            self.on_packet(data, addr)
+
+
+class UdpPair:
+    """One bound even/odd (RTP, RTCP) endpoint pair."""
+
+    def __init__(self, rtp_transport, rtp_proto, rtcp_transport, rtcp_proto,
+                 rtp_port: int):
+        self.rtp_transport: asyncio.DatagramTransport = rtp_transport
+        self.rtp_proto: _DatagramSink = rtp_proto
+        self.rtcp_transport: asyncio.DatagramTransport = rtcp_transport
+        self.rtcp_proto: _DatagramSink = rtcp_proto
+        self.rtp_port = rtp_port
+
+    @property
+    def rtcp_port(self) -> int:
+        return self.rtp_port + 1
+
+    def close(self) -> None:
+        for t in (self.rtp_transport, self.rtcp_transport):
+            if t and not t.is_closing():
+                t.close()
+
+
+class UdpPortPool:
+    """Allocates even/odd UDP port pairs (``UDPSocketPool`` equivalent)."""
+
+    def __init__(self, bind_ip: str = "0.0.0.0", base_port: int = 6970,
+                 max_pairs: int = 4000):
+        self.bind_ip = bind_ip
+        self.base_port = base_port
+        self.max_pairs = max_pairs
+        self._next = base_port
+
+    async def allocate(self, on_rtp=None, on_rtcp=None) -> UdpPair:
+        loop = asyncio.get_running_loop()
+        last_err: Exception | None = None
+        for _ in range(self.max_pairs):
+            port = self._next
+            self._next += 2
+            if self._next >= self.base_port + 2 * self.max_pairs:
+                self._next = self.base_port
+            try:
+                rtp_t, rtp_p = await loop.create_datagram_endpoint(
+                    lambda: _DatagramSink(on_rtp),
+                    local_addr=(self.bind_ip, port))
+                try:
+                    rtcp_t, rtcp_p = await loop.create_datagram_endpoint(
+                        lambda: _DatagramSink(on_rtcp),
+                        local_addr=(self.bind_ip, port + 1))
+                except OSError as e:
+                    rtp_t.close()
+                    last_err = e
+                    continue
+                return UdpPair(rtp_t, rtp_p, rtcp_t, rtcp_p, port)
+            except OSError as e:
+                last_err = e
+                continue
+        raise OSError(f"no free UDP port pairs: {last_err}")

@@ -1,0 +1,4 @@
+"""Support utilities: the loopback RTSP client / load generator and misc
+helpers.  The client revives the concept of the reference's
+``RTSPClientLib/ClientSession`` + ``PlayerSimulator`` (which no longer built
+there — SURVEY §4) as the framework's end-to-end test harness."""

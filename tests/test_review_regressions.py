@@ -1,0 +1,104 @@
+"""Regression tests for review findings: runt packets, zero-body RTCP,
+prime-latch divergence under eviction, CRLF+'$' coalescing."""
+
+import copy
+
+from easydarwin_tpu.protocol import rtcp, rtp, rtsp, sdp
+from easydarwin_tpu.relay import RelayStream, StreamSettings
+from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+from easydarwin_tpu.relay.output import CollectingOutput
+
+VIDEO_SDP = ("v=0\r\nm=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+             "a=control:trackID=1\r\n")
+
+
+def vid_pkt(seq, ts=0, nal_type=1):
+    payload = bytes(((3 << 5) | nal_type,)) + bytes(30)
+    return rtp.RtpPacket(payload_type=96, seq=seq, timestamp=ts, ssrc=0x77,
+                         payload=payload).to_bytes()
+
+
+def mkstream(**kw):
+    return RelayStream(sdp.parse(VIDEO_SDP).streams[0], StreamSettings(**kw))
+
+
+def test_runt_packet_does_not_crash_reflect():
+    """A <12-byte datagram in the ring must be skipped, not parsed."""
+    st = mkstream()
+    out = CollectingOutput(ssrc=1)
+    st.add_output(out)
+    st.push_rtp(vid_pkt(1, nal_type=5), 1000)
+    st.rtp_ring.push(b"\x80\x60\x00", 1001)          # 3-byte runt
+    st.push_rtp(vid_pkt(2), 1002)
+    st.reflect(2000)                                  # must not raise
+    assert len(out.rtp_packets) == 2
+    assert [rtp.RtpPacket.parse(p).payload[0] & 0x1F
+            for p in out.rtp_packets] == [5, 1]
+
+
+def test_runt_packet_tpu_engine_matches_cpu():
+    st_cpu = mkstream()
+    for o in range(3):
+        st_cpu.add_output(CollectingOutput(ssrc=o))
+    st_cpu.push_rtp(vid_pkt(1, nal_type=5), 1000)
+    st_cpu.rtp_ring.push(b"\x00\x01", 1001)
+    st_cpu.push_rtp(vid_pkt(2), 1002)
+    st_tpu = copy.deepcopy(st_cpu)
+    st_cpu.reflect(2000)
+    TpuFanoutEngine().step(st_tpu, 2000)
+    for a, b in zip(st_cpu.outputs, st_tpu.outputs):
+        assert a.rtp_packets == b.rtp_packets
+        assert a.bookmark == b.bookmark
+
+
+def test_rtcp_rewrite_zero_body_packet_safe():
+    """BYE with count=0 (4 bytes, words=0) must not corrupt the next packet."""
+    empty_bye = bytes((0x80, 203)) + (0).to_bytes(2, "big")
+    sr = rtcp.SenderReport(0x1111, 5, 6, 7, 8).to_bytes()
+    compound = empty_bye + sr
+    out = rtcp.rewrite_compound_ssrc(compound, 0xBEEF)
+    pkts = rtcp.parse_compound(out)
+    # the SR after the empty BYE survives intact with rewritten SSRC
+    srs = [p for p in pkts if isinstance(p, rtcp.SenderReport)]
+    assert len(srs) == 1
+    assert srs[0].ssrc == 0xBEEF
+    assert srs[0].packet_count == 7
+
+
+def test_prime_latch_survives_eviction_like_oracle():
+    """WOULD_BLOCK'd first write latches the rebase origin permanently —
+    even after the ring evicts that packet, both engines must keep it."""
+    st_cpu = mkstream(max_age_ms=50)
+    out_cpu = CollectingOutput(ssrc=9)
+    st_cpu.add_output(out_cpu)
+    st_cpu.push_rtp(vid_pkt(100, ts=1000, nal_type=5), 1000)
+    st_cpu.push_rtp(vid_pkt(101, ts=2000), 1001)
+    st_tpu = copy.deepcopy(st_cpu)
+    out_tpu = st_tpu.outputs[0]
+    eng = TpuFanoutEngine()
+    for o in (out_cpu, out_tpu):
+        o.block_next = 1                     # first attempt blocks
+    st_cpu.reflect(1100)
+    eng.step(st_tpu, 1100)
+    assert out_cpu.rewrite.base_src_seq == out_tpu.rewrite.base_src_seq == 100
+    # evict everything the bookmark no longer pins… force tail forward
+    for st in (st_cpu, st_tpu):
+        st.keyframe_id = None
+        st.rtp_ring.tail = st.rtp_ring.head - 1   # simulate overflow eviction
+    st_cpu.reflect(1200)
+    eng.step(st_tpu, 1200)
+    assert out_cpu.rewrite.base_src_seq == 100    # latched, not re-primed
+    assert out_tpu.rewrite.base_src_seq == 100
+    assert out_cpu.rtp_packets == out_tpu.rtp_packets
+
+
+def test_crlf_then_interleaved_frame():
+    """Stray CRLF followed by a '$' frame must demux as binary, not text."""
+    r = rtsp.RtspWireReader()
+    body = b"\x80\x60" + bytes(20) + b"\r\n\r\n" + bytes(10)  # embeds CRLFCRLF
+    r.feed(b"TEARDOWN rtsp://h/x RTSP/1.0\r\nCSeq: 1\r\n\r\n"
+           b"\r\n" + rtsp.frame_interleaved(0, body))
+    evs = list(r.events())
+    assert [type(e).__name__ for e in evs] == ["RtspRequest",
+                                               "InterleavedPacket"]
+    assert evs[1].data == body

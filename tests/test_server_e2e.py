@@ -1,0 +1,233 @@
+"""End-to-end loopback: push (ANNOUNCE/RECORD) → relay → play (PLAY).
+
+The network-level equivalent of BASELINE config 1: an EasyPusher-style
+client pushes H.264/AAC over interleaved TCP, PLAY clients receive the
+relayed stream; assertions check SDP service, payload bit-equality,
+keyframe fast-start, REST visibility, and teardown.
+"""
+
+import asyncio
+
+import pytest
+
+from easydarwin_tpu.protocol import nalu, rtp, sdp
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.utils.client import RtspClient
+
+PUSH_SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=pushtest\r\n"
+            "c=IN IP4 0.0.0.0\r\nt=0 0\r\na=control:*\r\n"
+            "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+            "a=control:trackID=1\r\n")
+
+
+def vid_pkt(seq, ts, nal_type=1, marker=False):
+    payload = bytes(((3 << 5) | nal_type,)) + bytes((seq + i) & 0xFF
+                                                    for i in range(40))
+    return rtp.RtpPacket(payload_type=96, seq=seq & 0xFFFF, timestamp=ts,
+                         ssrc=0xDEAD, marker=marker, payload=payload
+                         ).to_bytes()
+
+
+@pytest.fixture
+def cfg():
+    return ServerConfig(rtsp_port=0, service_port=0, reflect_interval_ms=5,
+                        bind_ip="127.0.0.1")
+
+
+async def _start(cfg):
+    app = StreamingServer(cfg)
+    await app.start()
+    return app
+
+
+@pytest.mark.asyncio
+async def test_push_play_roundtrip_interleaved(cfg):
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/cam1.sdp"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+
+        sent = []
+        for i in range(5):
+            p = vid_pkt(100 + i, i * 3000, nal_type=5 if i == 0 else 1)
+            sent.append(p)
+            pusher.push_packet(0, p)
+
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        sd = await player.play_start(uri)
+        assert sd.streams and sd.streams[0].codec == "H264"
+
+        got = [await player.recv_interleaved(0) for _ in range(5)]
+        # payloads bit-identical; headers rewritten (new ssrc, rebased seq)
+        for s, g in zip(sent, got):
+            ps, pg = rtp.RtpPacket.parse(s), rtp.RtpPacket.parse(g)
+            assert pg.payload == ps.payload
+            assert pg.ssrc != ps.ssrc
+        seqs = [rtp.RtpPacket.parse(g).seq for g in got]
+        assert seqs == [(seqs[0] + i) & 0xFFFF for i in range(5)]
+
+        # live packets flow too
+        p = vid_pkt(105, 90_000, marker=True)
+        pusher.push_packet(0, p)
+        g = await player.recv_interleaved(0)
+        assert rtp.RtpPacket.parse(g).payload == rtp.RtpPacket.parse(p).payload
+        assert player.stats.packets == 6 and player.stats.lost == 0
+
+        await player.teardown(uri)
+        await pusher.close()
+        await player.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_late_joiner_gets_keyframe_fast_start(cfg):
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/cam2"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        # a GOP: IDR at seq 10, P-frames after
+        for i in range(8):
+            pusher.push_packet(0, vid_pkt(10 + i, 0, nal_type=5 if i == 0 else 1))
+        await asyncio.sleep(0.05)
+
+        late = RtspClient()
+        await late.connect("127.0.0.1", app.rtsp.port)
+        await late.play_start(uri)
+        first = await late.recv_interleaved(0)
+        # fast-start: the first delivered packet is the IDR, not the tail
+        assert nalu.is_keyframe_first_packet(first)
+        await late.close()
+        await pusher.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_play_unknown_path_404(cfg):
+    app = await _start(cfg)
+    try:
+        c = RtspClient()
+        await c.connect("127.0.0.1", app.rtsp.port)
+        r = await c.request("DESCRIBE", f"rtsp://127.0.0.1:{app.rtsp.port}/nope")
+        assert r.status == 404
+        r = await c.request("OPTIONS", "*")
+        assert r.status == 200 and "PLAY" in r.headers.get("public", "")
+        await c.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_udp_play_transport(cfg):
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/cam3"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(1, 0, nal_type=5))
+
+        # bind our own UDP pair as the "client"
+        loop = asyncio.get_running_loop()
+        got: asyncio.Queue = asyncio.Queue()
+
+        class Sink(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                got.put_nowait(data)
+
+        rtp_t, _ = await loop.create_datagram_endpoint(
+            Sink, local_addr=("127.0.0.1", 0))
+        rtp_port = rtp_t.get_extra_info("sockname")[1]
+        rtcp_t, _ = await loop.create_datagram_endpoint(
+            Sink, local_addr=("127.0.0.1", 0))
+        rtcp_port = rtcp_t.get_extra_info("sockname")[1]
+
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        await player.play_start(uri, tcp=False,
+                                client_ports=[(rtp_port, rtcp_port)])
+        t = player.transports[0]
+        assert t.server_port is not None
+
+        pusher.push_packet(0, vid_pkt(2, 3000))
+        data = await asyncio.wait_for(got.get(), 5.0)
+        assert rtp.RtpPacket.parse(data).payload == \
+            rtp.RtpPacket.parse(vid_pkt(1, 0, nal_type=5)).payload
+        rtp_t.close()
+        rtcp_t.close()
+        await player.close()
+        await pusher.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_pusher_teardown_removes_session(cfg):
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/cam4"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        assert app.registry.find("/live/cam4") is not None
+        await pusher.teardown(uri)
+        await asyncio.sleep(0.05)
+        assert app.registry.find("/live/cam4") is None
+        await pusher.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_rest_api_endpoints(cfg):
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/cam5"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+
+        import json
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       app.rest.port)
+
+        async def get(path, body=b"", method="GET"):
+            writer.write(
+                f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            head = await reader.readuntil(b"\r\n\r\n")
+            status = int(head.split(b" ")[1])
+            clen = int([ln for ln in head.split(b"\r\n")
+                        if ln.lower().startswith(b"content-length")][0]
+                       .split(b":")[1])
+            return status, json.loads(await reader.readexactly(clen))
+
+        st, doc = await get("/api/v1/getserverinfo")
+        assert st == 200
+        body = doc["EasyDarwin"]["Body"]
+        assert body["PushSessions"] == "1"
+
+        st, doc = await get("/api/v1/getrtsplivesessions")
+        sess = doc["EasyDarwin"]["Body"]["Sessions"]
+        assert len(sess) == 1 and sess[0]["Path"] == "/live/cam5"
+
+        st, doc = await get("/api/v1/getbaseconfig")
+        assert doc["EasyDarwin"]["Body"]["Config"]["rtsp_port"] == 0
+
+        st, doc = await get(
+            "/api/v1/setbaseconfig",
+            json.dumps({"Config": {"bucket_delay_ms": 50}}).encode(), "POST")
+        assert st == 200 and app.config.bucket_delay_ms == 50
+
+        st, doc = await get("/api/v1/bogus")
+        assert st == 404
+        writer.close()
+        await pusher.close()
+    finally:
+        await app.stop()
