@@ -1,0 +1,256 @@
+// edtpu_core — native data-plane for easydarwin_tpu. See edtpu_core.h.
+#include "edtpu_core.h"
+
+#include <algorithm>
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstring>
+#include <map>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <vector>
+
+namespace {
+constexpr int kSendBatch = 512;
+constexpr int kRecvBatch = 64;
+
+inline void render_header(uint8_t *dst, const uint8_t *src, uint32_t seq_off,
+                          uint32_t ts_off, uint32_t ssrc) {
+  // bytes 0-1 verbatim (V/P/X/CC, M/PT)
+  dst[0] = src[0];
+  dst[1] = src[1];
+  uint16_t seq = static_cast<uint16_t>((src[2] << 8) | src[3]);
+  seq = static_cast<uint16_t>(seq + seq_off);
+  dst[2] = static_cast<uint8_t>(seq >> 8);
+  dst[3] = static_cast<uint8_t>(seq);
+  uint32_t ts = (static_cast<uint32_t>(src[4]) << 24) |
+                (static_cast<uint32_t>(src[5]) << 16) |
+                (static_cast<uint32_t>(src[6]) << 8) | src[7];
+  ts += ts_off;
+  dst[4] = static_cast<uint8_t>(ts >> 24);
+  dst[5] = static_cast<uint8_t>(ts >> 16);
+  dst[6] = static_cast<uint8_t>(ts >> 8);
+  dst[7] = static_cast<uint8_t>(ts);
+  dst[8] = static_cast<uint8_t>(ssrc >> 24);
+  dst[9] = static_cast<uint8_t>(ssrc >> 16);
+  dst[10] = static_cast<uint8_t>(ssrc >> 8);
+  dst[11] = static_cast<uint8_t>(ssrc);
+}
+}  // namespace
+
+extern "C" {
+
+const char *ed_version(void) { return "edtpu_core 0.1.0"; }
+
+int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
+                           const int32_t *ring_len, int32_t capacity,
+                           int32_t slot_size, const uint32_t *seq_off,
+                           const uint32_t *ts_off, const uint32_t *ssrc,
+                           const ed_dest *dest, int32_t n_outs,
+                           const ed_sendop *ops, int32_t n_ops) {
+  if (n_ops <= 0) return 0;
+  std::vector<mmsghdr> msgs(kSendBatch);
+  std::vector<iovec> iovs(static_cast<size_t>(kSendBatch) * 2);
+  std::vector<sockaddr_in> addrs(kSendBatch);
+  // stack of rendered headers for the in-flight batch
+  std::vector<uint8_t> hdrs(static_cast<size_t>(kSendBatch) * 12);
+
+  int32_t done = 0;
+  while (done < n_ops) {
+    int batch = 0;
+    for (; batch < kSendBatch && done + batch < n_ops; ++batch) {
+      const ed_sendop &op = ops[done + batch];
+      if (op.slot < 0 || op.slot >= capacity || op.out < 0 ||
+          op.out >= n_outs)
+        return -EINVAL;
+      const uint8_t *pkt = ring_data +
+                           static_cast<size_t>(op.slot) * slot_size;
+      int32_t len = ring_len[op.slot];
+      if (len < 12 || len > slot_size) return -EINVAL;
+      uint8_t *h = hdrs.data() + static_cast<size_t>(batch) * 12;
+      render_header(h, pkt, seq_off[op.out], ts_off[op.out], ssrc[op.out]);
+      iovec *iv = &iovs[static_cast<size_t>(batch) * 2];
+      iv[0].iov_base = h;
+      iv[0].iov_len = 12;
+      iv[1].iov_base = const_cast<uint8_t *>(pkt) + 12;
+      iv[1].iov_len = static_cast<size_t>(len - 12);
+      sockaddr_in &sa = addrs[batch];
+      std::memset(&sa, 0, sizeof(sa));
+      sa.sin_family = AF_INET;
+      sa.sin_addr.s_addr = dest[op.out].ip_be;
+      sa.sin_port = dest[op.out].port_be;
+      mmsghdr &m = msgs[batch];
+      std::memset(&m, 0, sizeof(m));
+      m.msg_hdr.msg_name = &sa;
+      m.msg_hdr.msg_namelen = sizeof(sa);
+      m.msg_hdr.msg_iov = iv;
+      m.msg_hdr.msg_iovlen = 2;
+    }
+    int sent = 0;
+    while (sent < batch) {
+      int n = sendmmsg(fd, msgs.data() + sent, batch - sent, 0);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK)
+          return done + sent;  // WouldBlock: caller keeps its bookmark
+        return -errno;
+      }
+      sent += n;
+    }
+    done += batch;
+  }
+  return done;
+}
+
+int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
+                         int32_t capacity, int32_t slot_size,
+                         const uint32_t *seq_off, const uint32_t *ts_off,
+                         const uint32_t *ssrc, int32_t n_outs,
+                         const ed_sendop *ops, int32_t n_ops, uint8_t *out,
+                         int32_t out_stride, int32_t *out_lens) {
+  for (int32_t i = 0; i < n_ops; ++i) {
+    const ed_sendop &op = ops[i];
+    if (op.slot < 0 || op.slot >= capacity || op.out < 0 || op.out >= n_outs)
+      return -EINVAL;
+    const uint8_t *pkt = ring_data + static_cast<size_t>(op.slot) * slot_size;
+    int32_t len = ring_len[op.slot];
+    if (len < 12 || len > slot_size || len > out_stride) return -EINVAL;
+    uint8_t *dst = out + static_cast<size_t>(i) * out_stride;
+    render_header(dst, pkt, seq_off[op.out], ts_off[op.out], ssrc[op.out]);
+    std::memcpy(dst + 12, pkt + 12, static_cast<size_t>(len - 12));
+    out_lens[i] = len;
+  }
+  return n_ops;
+}
+
+int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
+                      int64_t *ring_arrival, int32_t capacity,
+                      int32_t slot_size, int64_t now_ms, int64_t *head,
+                      int32_t max_pkts) {
+  int32_t total = 0;
+  std::vector<mmsghdr> msgs(kRecvBatch);
+  std::vector<iovec> iovs(kRecvBatch);
+  while (total < max_pkts) {
+    int want = std::min<int32_t>(kRecvBatch, max_pkts - total);
+    for (int i = 0; i < want; ++i) {
+      int64_t slot = (*head + i) % capacity;
+      iovs[i].iov_base = ring_data + slot * slot_size;
+      iovs[i].iov_len = static_cast<size_t>(slot_size);
+      std::memset(&msgs[i], 0, sizeof(mmsghdr));
+      msgs[i].msg_hdr.msg_iov = &iovs[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+    }
+    int n = recvmmsg(fd, msgs.data(), want, MSG_DONTWAIT, nullptr);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return -errno;
+    }
+    if (n == 0) break;
+    for (int i = 0; i < n; ++i) {
+      int64_t slot = (*head + i) % capacity;
+      ring_len[slot] = static_cast<int32_t>(msgs[i].msg_len);
+      ring_arrival[slot] = now_ms;
+    }
+    *head += n;
+    total += n;
+    if (n < want) break;
+  }
+  return total;
+}
+
+/* ------------------------------------------------------------- timer wheel */
+
+struct ed_wheel {
+  // 1 ms hashed wheel: 4096 buckets; overflow handled by re-hashing rounds.
+  static constexpr int kSlots = 4096;
+  struct Entry {
+    int64_t id;
+    int64_t fire_ms;
+    int64_t user_data;
+  };
+  std::vector<Entry> slots[kSlots];
+  std::map<int64_t, int> where;  // id -> slot (for cancel)
+  int64_t now_ms;
+  int64_t next_id = 1;
+  int32_t pending = 0;
+};
+
+ed_wheel *ed_wheel_new(int64_t now_ms) {
+  auto *w = new ed_wheel();
+  w->now_ms = now_ms;
+  return w;
+}
+
+void ed_wheel_free(ed_wheel *w) { delete w; }
+
+int64_t ed_wheel_schedule(ed_wheel *w, int64_t delay_ms, int64_t user_data) {
+  if (delay_ms < 0) delay_ms = 0;
+  int64_t fire = w->now_ms + delay_ms;
+  int slot = static_cast<int>(fire % ed_wheel::kSlots);
+  int64_t id = w->next_id++;
+  w->slots[slot].push_back({id, fire, user_data});
+  w->where[id] = slot;
+  w->pending++;
+  return id;
+}
+
+int ed_wheel_cancel(ed_wheel *w, int64_t timer_id) {
+  auto it = w->where.find(timer_id);
+  if (it == w->where.end()) return 0;
+  auto &vec = w->slots[it->second];
+  for (auto e = vec.begin(); e != vec.end(); ++e) {
+    if (e->id == timer_id) {
+      vec.erase(e);
+      w->where.erase(it);
+      w->pending--;
+      return 1;
+    }
+  }
+  w->where.erase(it);
+  return 0;
+}
+
+int32_t ed_wheel_advance(ed_wheel *w, int64_t now_ms, int64_t *out,
+                         int32_t max_out) {
+  int32_t fired = 0;
+  if (now_ms <= w->now_ms) return 0;
+  // bound the walk: never more than one full wheel revolution
+  int64_t steps = now_ms - w->now_ms;
+  if (steps > ed_wheel::kSlots) steps = ed_wheel::kSlots;
+  // if we jumped more than a revolution, every slot needs a scan anyway
+  for (int64_t t = 0; t < steps && fired < max_out; ++t) {
+    int64_t tick = w->now_ms + 1 + t;
+    auto &vec = w->slots[tick % ed_wheel::kSlots];
+    for (size_t i = 0; i < vec.size() && fired < max_out;) {
+      if (vec[i].fire_ms <= now_ms) {
+        out[fired++] = vec[i].user_data;
+        w->where.erase(vec[i].id);
+        vec[i] = vec.back();
+        vec.pop_back();
+        w->pending--;
+      } else {
+        ++i;
+      }
+    }
+  }
+  w->now_ms = now_ms;
+  return fired;
+}
+
+int64_t ed_wheel_next(const ed_wheel *w, int64_t now_ms) {
+  int64_t best = -1;
+  for (int s = 0; s < ed_wheel::kSlots; ++s) {
+    for (const auto &e : w->slots[s]) {
+      int64_t d = e.fire_ms - now_ms;
+      if (d < 0) d = 0;
+      if (best < 0 || d < best) best = d;
+    }
+  }
+  if (best > 3600000) best = 3600000;
+  return best;
+}
+
+int32_t ed_wheel_pending(const ed_wheel *w) { return w->pending; }
+
+}  // extern "C"

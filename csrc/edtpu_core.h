@@ -1,0 +1,105 @@
+/* edtpu_core — native data-plane for easydarwin_tpu.
+ *
+ * C ABI consumed via ctypes (easydarwin_tpu/native.py).  Covers the pieces
+ * the reference implements natively and that Python cannot do at line rate
+ * (SURVEY §2.1): the reflector egress loop (SendPacketsToOutput /
+ * RTPStream::Write — here one sendmmsg batch with per-packet affine header
+ * render + shared-payload iovecs), the ingest socket pump
+ * (ReflectorSocket::GetIncomingData — here recvmmsg straight into ring
+ * slots), and the timer machinery (Task.cpp heap + 10 ms floor — here a
+ * hashed wheel at 1 ms granularity).
+ */
+#ifndef EDTPU_CORE_H
+#define EDTPU_CORE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char *ed_version(void);
+
+/* ---------------------------------------------------------------- egress */
+
+/* One send op: packet (ring slot) -> subscriber (output index). */
+typedef struct {
+  int32_t slot;      /* ring slot index */
+  int32_t out;       /* subscriber index */
+} ed_sendop;
+
+/* Batched UDP fan-out with on-the-fly affine header rewrite.
+ *
+ * ring_data:  [capacity, slot_size] uint8 — packet bytes (RTP from byte 0)
+ * ring_len:   [capacity] int32
+ * seq_off/ts_off/ssrc: [n_outs] uint32 — per-subscriber affine params
+ * dest_addr:  [n_outs] {uint32 be_ip, uint16 be_port} packed (see ed_dest)
+ * ops:        [n_ops] ed_sendop
+ * fd:         one unconnected UDP socket used for all sends
+ *
+ * For each op: renders the 12-byte rewritten header on the stack
+ * (seq+=seq_off mod 2^16, ts+=ts_off, ssrc=ssrc[out]; bytes 0-1 copied)
+ * and sends [header | payload(12..len)] as a 2-element iovec, batched
+ * through sendmmsg in groups of ED_SEND_BATCH.  Returns ops sent, or
+ * negative errno.  EAGAIN stops the batch and returns the count so far
+ * (callers keep bookmarks, reference WouldBlock semantics). */
+typedef struct {
+  uint32_t ip_be;    /* network byte order IPv4 */
+  uint16_t port_be;  /* network byte order */
+  uint16_t _pad;
+} ed_dest;
+
+int32_t ed_fanout_send_udp(int fd,
+                           const uint8_t *ring_data, const int32_t *ring_len,
+                           int32_t capacity, int32_t slot_size,
+                           const uint32_t *seq_off, const uint32_t *ts_off,
+                           const uint32_t *ssrc, const ed_dest *dest,
+                           int32_t n_outs,
+                           const ed_sendop *ops, int32_t n_ops);
+
+/* Same render, but into a caller buffer instead of the wire: out must hold
+ * n_ops * (12 + max payload) — used for interleaved/TCP paths and tests.
+ * out_lens[i] receives each rendered packet's length.  Returns n rendered. */
+int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
+                         int32_t capacity, int32_t slot_size,
+                         const uint32_t *seq_off, const uint32_t *ts_off,
+                         const uint32_t *ssrc, int32_t n_outs,
+                         const ed_sendop *ops, int32_t n_ops,
+                         uint8_t *out, int32_t out_stride,
+                         int32_t *out_lens);
+
+/* ---------------------------------------------------------------- ingest */
+
+/* Drain up to max_pkts datagrams from fd (non-blocking, recvmmsg) directly
+ * into ring slots starting at *head (mod capacity), writing lengths and
+ * arrival_ms.  Returns datagrams read (0 if none), negative errno on error;
+ * *head is advanced. */
+int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
+                      int64_t *ring_arrival, int32_t capacity,
+                      int32_t slot_size, int64_t now_ms,
+                      int64_t *head, int32_t max_pkts);
+
+/* ------------------------------------------------------------- timer wheel */
+
+/* Hashed timer wheel, 1 ms ticks (vs the reference's 10 ms scheduler floor,
+ * Task.cpp:334).  Single-threaded use from the owner loop. */
+typedef struct ed_wheel ed_wheel;
+
+ed_wheel *ed_wheel_new(int64_t now_ms);
+void ed_wheel_free(ed_wheel *w);
+/* schedule returns a timer id (>0) firing at now+delay_ms */
+int64_t ed_wheel_schedule(ed_wheel *w, int64_t delay_ms, int64_t user_data);
+int ed_wheel_cancel(ed_wheel *w, int64_t timer_id);
+/* advance to now_ms; expired user_data values are copied into out (up to
+ * max_out); returns number expired */
+int32_t ed_wheel_advance(ed_wheel *w, int64_t now_ms, int64_t *out,
+                         int32_t max_out);
+/* ms until next timer from now_ms, or -1 if none (capped at 3600000) */
+int64_t ed_wheel_next(const ed_wheel *w, int64_t now_ms);
+int32_t ed_wheel_pending(const ed_wheel *w);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

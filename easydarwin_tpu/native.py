@@ -1,0 +1,226 @@
+"""ctypes bridge to the C++ data-plane (csrc/libedtpu_core.so).
+
+Auto-builds with ``make`` on first use when the shared object is missing
+(g++ is part of the supported toolchain); every entry point degrades
+gracefully — callers check ``available()`` and fall back to the Python/numpy
+paths, the same CPU-fallback discipline the TPU engine follows.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "csrc")
+_SO = os.path.join(_CSRC, "libedtpu_core.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+class SendOp(ctypes.Structure):
+    _fields_ = [("slot", ctypes.c_int32), ("out", ctypes.c_int32)]
+
+
+class Dest(ctypes.Structure):
+    _fields_ = [("ip_be", ctypes.c_uint32), ("port_be", ctypes.c_uint16),
+                ("_pad", ctypes.c_uint16)]
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-s", "-C", _CSRC], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.ed_version.restype = ctypes.c_char_p
+        lib.ed_fanout_send_udp.restype = ctypes.c_int32
+        lib.ed_fanout_send_udp.argtypes = [
+            ctypes.c_int, u8p, i32p, ctypes.c_int32, ctypes.c_int32,
+            u32p, u32p, u32p, ctypes.POINTER(Dest), ctypes.c_int32,
+            ctypes.POINTER(SendOp), ctypes.c_int32]
+        lib.ed_fanout_render.restype = ctypes.c_int32
+        lib.ed_fanout_render.argtypes = [
+            u8p, i32p, ctypes.c_int32, ctypes.c_int32,
+            u32p, u32p, u32p, ctypes.c_int32,
+            ctypes.POINTER(SendOp), ctypes.c_int32,
+            u8p, ctypes.c_int32, i32p]
+        lib.ed_udp_ingest.restype = ctypes.c_int32
+        lib.ed_udp_ingest.argtypes = [
+            ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, i64p, ctypes.c_int32]
+        lib.ed_wheel_new.restype = ctypes.c_void_p
+        lib.ed_wheel_new.argtypes = [ctypes.c_int64]
+        lib.ed_wheel_free.argtypes = [ctypes.c_void_p]
+        lib.ed_wheel_schedule.restype = ctypes.c_int64
+        lib.ed_wheel_schedule.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_int64]
+        lib.ed_wheel_cancel.restype = ctypes.c_int
+        lib.ed_wheel_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ed_wheel_advance.restype = ctypes.c_int32
+        lib.ed_wheel_advance.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         i64p, ctypes.c_int32]
+        lib.ed_wheel_next.restype = ctypes.c_int64
+        lib.ed_wheel_next.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ed_wheel_pending.restype = ctypes.c_int32
+        lib.ed_wheel_pending.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def version() -> str | None:
+    lib = _load()
+    return lib.ed_version().decode() if lib else None
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _u32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def make_dests(addrs: list[tuple[str, int]]) -> ctypes.Array:
+    arr = (Dest * len(addrs))()
+    for i, (ip, port) in enumerate(addrs):
+        arr[i].ip_be = struct.unpack("=I", socket.inet_aton(ip))[0]
+        arr[i].port_be = socket.htons(port)
+    return arr
+
+
+def make_ops(pairs: list[tuple[int, int]]) -> ctypes.Array:
+    arr = (SendOp * len(pairs))()
+    for i, (slot, out) in enumerate(pairs):
+        arr[i].slot = slot
+        arr[i].out = out
+    return arr
+
+
+def fanout_send_udp(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
+                    seq_off: np.ndarray, ts_off: np.ndarray,
+                    ssrc: np.ndarray, dests, ops, n_ops: int) -> int:
+    lib = _load()
+    assert lib is not None
+    assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+    return lib.ed_fanout_send_udp(
+        fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1],
+        _u32(np.ascontiguousarray(seq_off, np.uint32)),
+        _u32(np.ascontiguousarray(ts_off, np.uint32)),
+        _u32(np.ascontiguousarray(ssrc, np.uint32)),
+        dests, len(dests), ops, n_ops)
+
+
+def fanout_render(ring_data: np.ndarray, ring_len: np.ndarray,
+                  seq_off: np.ndarray, ts_off: np.ndarray, ssrc: np.ndarray,
+                  ops, n_ops: int, out_stride: int
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    lib = _load()
+    assert lib is not None
+    out = np.zeros((n_ops, out_stride), dtype=np.uint8)
+    lens = np.zeros(n_ops, dtype=np.int32)
+    r = lib.ed_fanout_render(
+        _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1],
+        _u32(np.ascontiguousarray(seq_off, np.uint32)),
+        _u32(np.ascontiguousarray(ts_off, np.uint32)),
+        _u32(np.ascontiguousarray(ssrc, np.uint32)),
+        len(ssrc), ops, n_ops, _u8(out), out_stride, _i32(lens))
+    if r < 0:
+        raise OSError(-r, os.strerror(-r))
+    return out, lens
+
+
+def udp_ingest(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
+               ring_arrival: np.ndarray, now_ms: int, head: int,
+               max_pkts: int = 256) -> tuple[int, int]:
+    """Returns (n_read, new_head)."""
+    lib = _load()
+    assert lib is not None
+    h = ctypes.c_int64(head)
+    n = lib.ed_udp_ingest(
+        fd, _u8(ring_data), _i32(ring_len), _i64(ring_arrival),
+        ring_data.shape[0], ring_data.shape[1], now_ms,
+        ctypes.byref(h), max_pkts)
+    if n < 0:
+        raise OSError(-n, os.strerror(-n))
+    return n, h.value
+
+
+class TimerWheel:
+    """1 ms hashed timer wheel (finer than the reference's 10 ms floor)."""
+
+    def __init__(self, now_ms: int = 0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._w = lib.ed_wheel_new(now_ms)
+
+    def close(self):
+        if self._w:
+            self._lib.ed_wheel_free(self._w)
+            self._w = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def schedule(self, delay_ms: int, user_data: int) -> int:
+        return self._lib.ed_wheel_schedule(self._w, delay_ms, user_data)
+
+    def cancel(self, timer_id: int) -> bool:
+        return bool(self._lib.ed_wheel_cancel(self._w, timer_id))
+
+    def advance(self, now_ms: int, max_out: int = 1024) -> list[int]:
+        out = np.zeros(max_out, dtype=np.int64)
+        n = self._lib.ed_wheel_advance(self._w, now_ms, _i64(out), max_out)
+        return out[:n].tolist()
+
+    def next_deadline(self, now_ms: int) -> int:
+        return self._lib.ed_wheel_next(self._w, now_ms)
+
+    @property
+    def pending(self) -> int:
+        return self._lib.ed_wheel_pending(self._w)
