@@ -29,9 +29,11 @@ class StreamingServer:
                  describe_fallback=None):
         self.config = config or ServerConfig()
         self.registry = SessionRegistry(self.config.stream_settings())
+        from ..vod.session import VodService
+        self.vod = VodService(self.config.movie_folder)
         self.rtsp = RtspServer(self.config, self.registry,
                                describe_fallback=describe_fallback,
-                               on_pump_wake=self._wake)
+                               on_pump_wake=self._wake, vod=self.vod)
         self.rest = RestApi(self.config, self)
         self._pump_event = asyncio.Event()
         self._tasks: list[asyncio.Task] = []

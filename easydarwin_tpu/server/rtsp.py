@@ -68,6 +68,8 @@ class RtspConnection:
         self.session_id: str | None = None
         self.path: str | None = None
         self.relay: RelaySession | None = None
+        self.vod_file = None                 # Mp4File when playing VOD
+        self.vod_session = None              # FileSession
         self.is_pusher = False
         self.playing = False
         self.player_tracks: dict[int, _PlayerTrack] = {}
@@ -194,7 +196,8 @@ class RtspConnection:
     async def _setup_play(self, req, base, track_id, t) -> None:
         relay = await self.server.open_for_play(base)
         if relay is None:
-            raise rtsp.RtspError(404)
+            await self._setup_play_vod(req, base, track_id, t)
+            return
         self.relay = relay
         self.path = relay.path
         if track_id is None:
@@ -202,6 +205,14 @@ class RtspConnection:
                 if set(relay.streams) - set(self.player_tracks) else None
         if track_id is None or track_id not in relay.streams:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
+        out, resp_t, pair = await self._make_output(t)
+        self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
+        self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
+                    req.cseq)
+
+    async def _make_output(self, t: rtsp.TransportSpec):
+        """Create the egress output for one SETUP'd track (shared between
+        live-relay and VOD play paths)."""
         ssrc = secrets.randbits(32)
         seq0 = secrets.randbits(16)
         resp_t = rtsp.TransportSpec(protocol=t.protocol, is_tcp=t.is_tcp)
@@ -223,6 +234,25 @@ class RtspConnection:
                             t.client_port[1], ssrc=ssrc, out_seq_start=seq0)
             resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
             resp_t.client_port = t.client_port
+        return out, resp_t, pair
+
+    async def _setup_play_vod(self, req, base, track_id, t) -> None:
+        """SETUP on a file path (QTSSFileModule DoSetup equivalent)."""
+        if self.vod_file is None:
+            vod = self.server.vod
+            f = vod.open(base) if vod is not None else None
+            if f is None:
+                raise rtsp.RtspError(404)
+            self.vod_file = f
+            self.path = base
+        n_tracks = sum(1 for tr in (self.vod_file.video_track(),
+                                    self.vod_file.audio_track())
+                       if tr is not None)
+        if track_id is None:
+            track_id = len(self.player_tracks) + 1
+        if not 1 <= track_id <= n_tracks:
+            raise rtsp.RtspError(404, f"unknown track {track_id}")
+        out, resp_t, pair = await self._make_output(t)
         self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
                     req.cseq)
@@ -234,6 +264,9 @@ class RtspConnection:
         self._reply(rtsp.RtspResponse(200), req.cseq)
 
     async def _do_play(self, req: rtsp.RtspRequest) -> None:
+        if self.vod_file is not None:
+            await self._do_play_vod(req)
+            return
         if self.relay is None or not self.player_tracks:
             raise rtsp.RtspError(455)
         infos = []
@@ -248,7 +281,36 @@ class RtspConnection:
         self._reply(rtsp.RtspResponse(200, {
             "Range": "npt=now-", "RTP-Info": ",".join(infos)}), req.cseq)
 
+    async def _do_play_vod(self, req: rtsp.RtspRequest) -> None:
+        from ..vod.session import FileSession
+        if not self.player_tracks:
+            raise rtsp.RtspError(455)
+        start_npt = 0.0
+        rng = req.headers.get("range", "")
+        if rng.startswith("npt="):
+            try:
+                start_npt = float(rng[4:].split("-")[0] or 0.0)
+            except ValueError:
+                start_npt = 0.0
+        if self.vod_session is not None:
+            self.vod_session.stop()
+        outputs = {tid: pt.output for tid, pt in self.player_tracks.items()}
+        self.vod_session = FileSession(self.vod_file, outputs,
+                                       start_npt=start_npt)
+        self.vod_session.start()
+        self.playing = True
+        self.server.stats["players"] += 1
+        infos = ",".join(
+            f"url={req.uri.rstrip('/')}/trackID={tid}"
+            f";seq={pt.output.rewrite.out_seq_start}"
+            for tid, pt in self.player_tracks.items())
+        self._reply(rtsp.RtspResponse(200, {
+            "Range": f"npt={start_npt:.3f}-", "RTP-Info": infos}), req.cseq)
+
     async def _do_pause(self, req: rtsp.RtspRequest) -> None:
+        if self.vod_session is not None:
+            self.vod_session.stop()
+            self.vod_session = None
         self._detach_outputs()
         self.playing = False
         self._reply(rtsp.RtspResponse(200), req.cseq)
@@ -287,6 +349,12 @@ class RtspConnection:
         if self.closed:
             return
         self.closed = True
+        if self.vod_session is not None:
+            self.vod_session.stop()
+            self.vod_session = None
+        if self.vod_file is not None:
+            self.vod_file.close()
+            self.vod_file = None
         self._detach_outputs()
         for pt in self.player_tracks.values():
             if pt.udp_pair:
@@ -310,9 +378,10 @@ class RtspServer:
     """Listener + connection registry (QTSServer::CreateListeners analog)."""
 
     def __init__(self, config: ServerConfig, registry: SessionRegistry,
-                 *, describe_fallback=None, on_pump_wake=None):
+                 *, describe_fallback=None, on_pump_wake=None, vod=None):
         self.config = config
         self.registry = registry
+        self.vod = vod                       # VodService or None
         self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
         self.connections: set[RtspConnection] = set()
         self.stats = {"requests": 0, "pushers": 0, "players": 0,
@@ -346,6 +415,8 @@ class RtspServer:
     # -- hooks -------------------------------------------------------------
     async def describe(self, path: str) -> str | None:
         text = self.registry.sdp_cache.get(path)
+        if text is None and self.vod is not None:
+            text = await self.vod.describe(path)
         if text is None and self.describe_fallback is not None:
             text = await self.describe_fallback(path)
         return text

@@ -1,0 +1,21 @@
+"""VOD tier: MP4 reading/writing, RTP packetization, paced file sessions.
+
+Reference parity: ``QTFileLib`` (11K LoC MP4/MOV atom parser + hint-track
+RTP packetizer behind ``QTRTPFile``), ``QTSSFileModule`` (DESCRIBE/SETUP/
+PLAY + the ``SendPackets`` pacing loop), and ``RtspRecordModule``'s
+``EasyMP4Writer`` (the recording muxer).
+
+Modules:
+* ``mp4``        — box/atom parser → ``Mp4File`` with per-track sample
+                   tables (stsd/stts/stsc/stsz/stco/stss/ctts walkers).
+* ``mp4_writer`` — minimal faststart muxer (ftyp+moov+mdat) for recording
+                   and test fixtures.
+* ``packetizer`` — sample → RTP: H.264 AVCC→FU-A/single-NAL (RFC 6184),
+                   AAC→mpeg4-generic (RFC 3640), plus hint-track samples
+                   (RFC 3984-era 'rtp ' constructors) when present.
+* ``session``    — ``FileSession``: the RTPSendPackets-style paced sender
+                   feeding RelayOutput sinks.
+"""
+
+from .mp4 import Mp4File  # noqa: F401
+from .session import FileSession  # noqa: F401

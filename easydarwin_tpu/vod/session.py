@@ -1,0 +1,177 @@
+"""Paced VOD sessions + the VOD service hook for the RTSP server.
+
+Reference parity: ``QTSSFileModule``'s play loop (``SendPackets``
+``QTSSFileModule.cpp:1489``): pull packets in timestamp order, write until
+the next packet's due time is in the future, report that time back to the
+scheduler, re-arm.  Here the "module" is an asyncio task per playing client
+session with the same pull-pace-sleep structure; WouldBlock from an output
+retries the same packet on the next wake (bookmark semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+from ..relay.output import RelayOutput, WriteResult
+from .mp4 import Mp4Error, Mp4File
+from .packetizer import AacPacketizer, H264Packetizer, sdp_for_file
+from ..protocol import sdp as sdp_mod
+
+
+class FileSession:
+    """One playing client of one file: per-track packetizers + pacing."""
+
+    def __init__(self, file: Mp4File, outputs: dict[int, RelayOutput],
+                 *, start_npt: float = 0.0, speed: float = 1.0):
+        self.file = file
+        self.outputs = outputs
+        self.speed = max(speed, 0.01)
+        self._cursors: dict[int, int] = {}        # track_id -> sample index
+        self._packetizers: dict[int, object] = {}
+        self._pending: dict[int, list[bytes]] = {}
+        self._task: asyncio.Task | None = None
+        self.packets_sent = 0
+        self.done = False
+        track_no = 0
+        v = file.video_track()
+        if v is not None:
+            track_no += 1
+            if track_no in outputs:
+                out = outputs[track_no]
+                self._packetizers[track_no] = H264Packetizer(
+                    v, ssrc=out.rewrite.ssrc,
+                    seq_start=out.rewrite.out_seq_start)
+                self._cursors[track_no] = self._seek_index(v, start_npt)
+                self._pending[track_no] = []
+        a = file.audio_track()
+        if a is not None:
+            track_no += 1
+            if track_no in outputs:
+                out = outputs[track_no]
+                self._packetizers[track_no] = AacPacketizer(
+                    a, ssrc=out.rewrite.ssrc,
+                    seq_start=out.rewrite.out_seq_start)
+                self._cursors[track_no] = self._seek_index(a, start_npt)
+                self._pending[track_no] = []
+        self.start_npt = start_npt
+
+    @staticmethod
+    def _seek_index(track, npt: float) -> int:
+        if npt <= 0 or track.n_samples == 0:
+            return 0
+        target = int(npt * track.info.timescale)
+        import numpy as np
+        i = int(np.searchsorted(track.dts, target))
+        i = min(i, track.n_samples - 1)
+        return track.sync_sample_at_or_before(i)
+
+    # -- pull-pace loop ----------------------------------------------------
+    def _track_of(self, track_id: int):
+        p = self._packetizers[track_id]
+        return p.track
+
+    def _next_due(self) -> tuple[int | None, float]:
+        """(track_id, npt seconds) of the earliest unsent sample."""
+        best, best_t = None, float("inf")
+        for tid, cur in self._cursors.items():
+            tr = self._track_of(tid)
+            if self._pending[tid]:
+                t = self._pending_npt.get(tid, 0.0)
+                if t < best_t:
+                    best, best_t = tid, t
+                continue
+            if cur >= tr.n_samples:
+                continue
+            t = tr.sample_time_sec(cur)
+            if t < best_t:
+                best, best_t = tid, t
+        return best, best_t
+
+    async def run(self) -> None:
+        t0 = time.monotonic() - self.start_npt / self.speed
+        self._pending_npt: dict[int, float] = {}
+        while True:
+            tid, npt = self._next_due()
+            if tid is None:
+                self.done = True
+                return
+            due = t0 + npt / self.speed
+            delay = due - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(min(delay, 0.5))
+                continue
+            if not self._pending[tid]:
+                tr = self._track_of(tid)
+                cur = self._cursors[tid]
+                data = self.file.read_sample(tr, cur)
+                self._pending[tid] = self._packetizers[tid].packetize_sample(
+                    data, cur)
+                self._pending_npt[tid] = npt
+                self._cursors[tid] = cur + 1
+            out = self.outputs[tid]
+            q = self._pending[tid]
+            while q:
+                res = out.send_bytes(q[0], is_rtcp=False)
+                if res is WriteResult.WOULD_BLOCK:
+                    await asyncio.sleep(0.02)      # bookmark: retry same pkt
+                    break
+                q.pop(0)
+                if res is WriteResult.OK:
+                    out.packets_sent += 1
+                    self.packets_sent += 1
+                elif res is WriteResult.ERROR:
+                    self.done = True
+                    return
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run(), name="vod-session")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+
+class VodService:
+    """Path → file resolution + SDP generation (the FileModule's Route +
+    Describe roles).  Paths map under ``movie_folder``; '.sdp' suffixes and
+    URL dots are normalized like the reference's path translation."""
+
+    def __init__(self, movie_folder: str):
+        self.movie_folder = movie_folder
+        self._cache: dict[str, Mp4File] = {}
+
+    def resolve(self, path: str) -> str | None:
+        rel = path.lstrip("/")
+        if rel.endswith(".sdp"):
+            rel = rel[:-4]
+        cand = os.path.normpath(os.path.join(self.movie_folder, rel))
+        if not cand.startswith(os.path.abspath(self.movie_folder)
+                               if os.path.isabs(self.movie_folder)
+                               else os.path.normpath(self.movie_folder)):
+            return None                       # path traversal guard
+        for p in (cand, cand + ".mp4", cand + ".mov", cand + ".m4v"):
+            if os.path.isfile(p):
+                return p
+        return None
+
+    def open(self, path: str) -> Mp4File | None:
+        fp = self.resolve(path)
+        if fp is None:
+            return None
+        try:
+            return Mp4File(fp)
+        except (Mp4Error, OSError):
+            return None
+
+    async def describe(self, path: str) -> str | None:
+        f = self.open(path)
+        if f is None:
+            return None
+        try:
+            sd = sdp_for_file(f, name=os.path.basename(path))
+            return sdp_mod.build(sd)
+        finally:
+            f.close()

@@ -1,0 +1,169 @@
+"""VOD: muxer↔parser round-trip, packetization, SDP, paced e2e PLAY."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu.protocol import nalu, rtp, sdp
+from easydarwin_tpu.vod.mp4 import Mp4File
+from easydarwin_tpu.vod.mp4_writer import Mp4Writer
+from easydarwin_tpu.vod.packetizer import (H264Packetizer, sdp_for_file,
+                                           split_avcc)
+from easydarwin_tpu.vod.session import VodService
+
+SPS = bytes((0x67, 0x42, 0x00, 0x1F, 0xAA, 0xBB, 0xCC, 0xDD, 0xEE, 0xFF))
+PPS = bytes((0x68, 0xCE, 0x3C, 0x80, 0x11, 0x22, 0x33, 0x44))
+
+
+def avcc_sample(*nals: bytes) -> bytes:
+    out = b""
+    for n in nals:
+        out += len(n).to_bytes(4, "big") + n
+    return out
+
+
+def write_fixture(path, n_frames=30, fps=30, with_audio=True):
+    w = Mp4Writer(path)
+    v = w.add_h264_track(SPS, PPS, 640, 480, timescale=90000)
+    a = w.add_aac_track(bytes((0x11, 0x90)), 8000, 1) if with_audio else None
+    dur = 90000 // fps
+    for i in range(n_frames):
+        idr = i % 10 == 0
+        nal = bytes((0x65 if idr else 0x41,)) + bytes((i,)) * (200 if idr else 80)
+        w.write_sample(v, avcc_sample(nal), dur, sync=idr)
+    if a is not None:
+        for i in range(n_frames):
+            w.write_sample(a, bytes((0xFF, i)) * 20, 1024, sync=True)
+    w.close()
+    return path
+
+
+@pytest.fixture
+def fixture_mp4(tmp_path):
+    return write_fixture(str(tmp_path / "clip.mp4"))
+
+
+def test_muxer_parser_roundtrip(fixture_mp4):
+    f = Mp4File(fixture_mp4)
+    v = f.video_track()
+    a = f.audio_track()
+    assert v is not None and a is not None
+    assert v.info.codec == "avc1" and v.info.width == 640
+    assert v.info.sps == [SPS] and v.info.pps == [PPS]
+    assert v.n_samples == 30
+    assert v.sync.sum() == 3                      # IDR every 10
+    assert int(v.dts[1]) == 3000
+    assert a.info.codec == "mp4a" and a.info.sample_rate == 8000
+    assert a.info.audio_config == bytes((0x11, 0x90))
+    # sample read-back
+    s0 = f.read_sample(v, 0)
+    nals = split_avcc(s0)
+    assert len(nals) == 1 and nals[0][0] == 0x65
+    assert f.read_sample(a, 3) == bytes((0xFF, 3)) * 20
+    # keyframe navigation
+    assert v.sync_sample_at_or_before(14) == 10
+    f.close()
+
+
+def test_sdp_for_file(fixture_mp4):
+    f = Mp4File(fixture_mp4)
+    sd = sdp_for_file(f)
+    text = sdp.build(sd)
+    sd2 = sdp.parse(text)
+    assert [s.codec for s in sd2.streams] == ["H264", "MPEG4-GENERIC"]
+    assert "sprop-parameter-sets" in sd2.streams[0].fmtp
+    assert "config=1190" in sd2.streams[1].fmtp
+    assert "range" in sd2.attributes
+    f.close()
+
+
+def test_h264_packetizer_idr_gets_parameter_sets(fixture_mp4):
+    f = Mp4File(fixture_mp4)
+    v = f.video_track()
+    p = H264Packetizer(v, ssrc=7, seq_start=100)
+    pkts = p.packetize_sample(f.read_sample(v, 0), 0)
+    # SPS, PPS, IDR → ≥3 packets, seq contiguous, same timestamp
+    assert len(pkts) >= 3
+    parsed = [rtp.RtpPacket.parse(x) for x in pkts]
+    assert [x.seq for x in parsed] == list(range(100, 100 + len(parsed)))
+    assert len({x.timestamp for x in parsed}) == 1
+    assert parsed[0].payload[0] & 0x1F == 7       # SPS first
+    assert nalu.is_keyframe_first_packet(pkts[0])
+    assert parsed[-1].marker                       # last NAL gets marker
+    # non-sync sample: no parameter sets
+    pk2 = p.packetize_sample(f.read_sample(v, 1), 1)
+    assert rtp.RtpPacket.parse(pk2[0]).payload[0] & 0x1F == 1
+    f.close()
+
+
+def test_vod_service_resolution(tmp_path, fixture_mp4):
+    svc = VodService(str(tmp_path))
+    assert svc.resolve("/clip.mp4") == fixture_mp4
+    assert svc.resolve("/clip") == fixture_mp4
+    assert svc.resolve("/clip.sdp") == fixture_mp4
+    assert svc.resolve("/../etc/passwd") is None
+    assert svc.resolve("/missing") is None
+
+
+@pytest.mark.asyncio
+async def test_vod_e2e_play(tmp_path):
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    write_fixture(str(tmp_path / "movie.mp4"), n_frames=10, fps=100,
+                  with_audio=False)
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       movie_folder=str(tmp_path))
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/movie.mp4"
+        c = RtspClient()
+        await c.connect("127.0.0.1", app.rtsp.port)
+        sd = await c.play_start(uri)
+        assert sd.streams[0].codec == "H264"
+        got = []
+        # 10 frames @100fps: IDR sample yields 3 pkts (SPS/PPS/IDR)
+        for _ in range(6):
+            got.append(await c.recv_interleaved(0, timeout=5))
+        types = [rtp.RtpPacket.parse(g).payload[0] & 0x1F for g in got]
+        assert types[:3] == [7, 8, 5]              # fast-start with SPS/PPS
+        assert c.stats.lost == 0
+        await c.teardown(uri)
+        await c.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_vod_play_with_range_seek(tmp_path):
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    write_fixture(str(tmp_path / "m2.mp4"), n_frames=30, fps=100,
+                  with_audio=False)
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       movie_folder=str(tmp_path))
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/m2"
+        c = RtspClient()
+        await c.connect("127.0.0.1", app.rtsp.port)
+        r = await c.request("DESCRIBE", uri, {"accept": "application/sdp"})
+        assert r.status == 200
+        await c.request("SETUP", f"{uri}/trackID=1",
+                        {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+        r = await c.request("PLAY", uri, {"range": "npt=0.15-"})
+        assert r.status == 200
+        assert r.headers["range"].startswith("npt=0.1")
+        first = await c.recv_interleaved(0, timeout=5)
+        # seek to 0.15s @100fps → sample 15 → snaps back to IDR at sample 10
+        p = rtp.RtpPacket.parse(first)
+        assert p.payload[0] & 0x1F == 7            # SPS of the IDR sample
+        assert p.timestamp == 10 * 900
+        await c.close()
+    finally:
+        await app.stop()
