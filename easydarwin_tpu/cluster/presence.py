@@ -1,0 +1,118 @@
+"""Cluster presence + load balancing over Redis.
+
+Reference parity: ``EasyRedisHandler.cpp`` —
+* ``EasyDarwin:{id}`` presence hash {IP, HTTP, RTSP, Load} with 15 s TTL,
+  re-asserted by the 5 s server tick (``RedisTTL``, cpp:160-213; tick at
+  ``RunServer.cpp:640-652``);
+* per-live-stream ``Live:{name}`` hash with 150 s TTL (cpp:246-278);
+* least-loaded EasyDarwin selection for stream placement (the CMS flavor's
+  ``RedisGetAssociatedDarwin``).
+A dead server or stale stream simply ages out of discovery — liveness *is*
+the TTL, exactly the reference's failure-detection story (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+SERVER_TTL_SEC = 15          # EasyRedisHandler.cpp:177
+STREAM_TTL_SEC = 150         # EasyRedisHandler.cpp:272
+TICK_SEC = 5                 # RunServer.cpp:642
+
+
+class PresenceService:
+    def __init__(self, redis, server_id: str, *, ip: str, rtsp_port: int,
+                 http_port: int, tick_sec: float = TICK_SEC):
+        self.redis = redis
+        self.server_id = server_id
+        self.ip = ip
+        self.rtsp_port = rtsp_port
+        self.http_port = http_port
+        self.tick_sec = tick_sec
+        self.load = 0
+        self._streams: set[str] = set()
+        self._task: asyncio.Task | None = None
+        self.ticks = 0
+
+    @property
+    def server_key(self) -> str:
+        return f"EasyDarwin:{self.server_id}"
+
+    # -- assertion ---------------------------------------------------------
+    async def assert_presence(self) -> None:
+        await self.redis.hset(self.server_key, {
+            "IP": self.ip, "RTSP": str(self.rtsp_port),
+            "HTTP": str(self.http_port), "Load": str(self.load)})
+        await self.redis.expire(self.server_key, SERVER_TTL_SEC)
+        for name in list(self._streams):
+            key = f"Live:{name}"
+            await self.redis.hset(key, {
+                "Server": self.server_id, "IP": self.ip,
+                "RTSP": str(self.rtsp_port)})
+            await self.redis.expire(key, STREAM_TTL_SEC)
+        self.ticks += 1
+
+    def add_stream(self, name: str) -> None:
+        self._streams.add(name.strip("/"))
+
+    async def remove_stream(self, name: str) -> None:
+        name = name.strip("/")
+        self._streams.discard(name)
+        await self.redis.delete(f"Live:{name}")
+
+    def set_load(self, load: int) -> None:
+        self.load = load
+
+    async def sync_streams(self, names) -> None:
+        """Reconcile the advertised stream set with the live session list."""
+        want = {n.strip("/") for n in names}
+        for gone in self._streams - want:
+            await self.remove_stream(gone)
+        self._streams |= want
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        await self.assert_presence()
+        self._task = asyncio.create_task(self._loop(), name="presence")
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.redis.delete(self.server_key)
+        for name in list(self._streams):
+            await self.remove_stream(name)
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick_sec)
+            try:
+                await self.assert_presence()
+            except Exception:
+                pass                     # redis gone: keep trying (reconnect)
+
+    # -- discovery (CMS side) ---------------------------------------------
+    @staticmethod
+    async def list_servers(redis) -> list[dict]:
+        out = []
+        for key in await redis.keys("EasyDarwin:*"):
+            h = await redis.hgetall(key)
+            if h:
+                h["Id"] = key.split(":", 1)[1]
+                out.append(h)
+        return out
+
+    @staticmethod
+    async def pick_least_loaded(redis) -> dict | None:
+        servers = await PresenceService.list_servers(redis)
+        if not servers:
+            return None
+        return min(servers, key=lambda h: int(h.get("Load", "0") or 0))
+
+    @staticmethod
+    async def find_stream(redis, name: str) -> dict | None:
+        h = await redis.hgetall(f"Live:{name.strip('/')}")
+        return h or None

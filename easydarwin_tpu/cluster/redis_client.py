@@ -1,0 +1,279 @@
+"""Redis client (RESP2) + in-memory fake with TTLs.
+
+Reference parity: ``EasyRedisClient`` (vendored hiredis + C++ wrapper:
+connect-with-timeout, command, pipeline) — rebuilt as a small asyncio RESP2
+codec.  ``InMemoryRedis`` implements the command subset the presence layer
+uses (hset/hgetall/expire/setex/del/keys/ttl/get/set/ping) with an
+injectable clock, serving as the hermetic test backend; ``MiniRedisServer``
+wraps it behind real RESP sockets so the wire codec is integration-tested
+without a redis installation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import time
+from typing import Any
+
+
+class RedisError(Exception):
+    pass
+
+
+# --------------------------------------------------------------- wire codec
+def encode_command(*args) -> bytes:
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        elif isinstance(a, (int, float)):
+            a = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+    return b"".join(out)
+
+
+async def read_reply(reader: asyncio.StreamReader) -> Any:
+    line = (await reader.readline()).rstrip(b"\r\n")
+    if not line:
+        raise RedisError("connection closed")
+    t, rest = line[:1], line[1:]
+    if t == b"+":
+        return rest.decode()
+    if t == b"-":
+        raise RedisError(rest.decode())
+    if t == b":":
+        return int(rest)
+    if t == b"$":
+        n = int(rest)
+        if n < 0:
+            return None
+        data = await reader.readexactly(n + 2)
+        return data[:-2]
+    if t == b"*":
+        n = int(rest)
+        if n < 0:
+            return None
+        return [await read_reply(reader) for _ in range(n)]
+    raise RedisError(f"bad RESP type {t!r}")
+
+
+class AsyncRedis:
+    """Minimal asyncio Redis connection with pipelining."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout: float = 3.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._r: asyncio.StreamReader | None = None
+        self._w: asyncio.StreamWriter | None = None
+
+    async def connect(self) -> None:
+        self._r, self._w = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout)
+
+    async def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
+            self._r = self._w = None
+
+    @property
+    def connected(self) -> bool:
+        return self._w is not None and not self._w.is_closing()
+
+    async def execute(self, *args) -> Any:
+        if not self.connected:
+            await self.connect()
+        self._w.write(encode_command(*args))
+        await self._w.drain()
+        return await asyncio.wait_for(read_reply(self._r), self.timeout)
+
+    async def pipeline(self, commands: list[tuple]) -> list[Any]:
+        if not self.connected:
+            await self.connect()
+        self._w.write(b"".join(encode_command(*c) for c in commands))
+        await self._w.drain()
+        return [await asyncio.wait_for(read_reply(self._r), self.timeout)
+                for _ in commands]
+
+    # convenience
+    async def ping(self) -> bool:
+        return await self.execute("PING") == "PONG"
+
+    async def hset(self, key: str, mapping: dict) -> None:
+        flat: list = []
+        for k, v in mapping.items():
+            flat += [k, v]
+        await self.execute("HSET", key, *flat)
+
+    async def hgetall(self, key: str) -> dict:
+        raw = await self.execute("HGETALL", key) or []
+        it = iter(raw)
+        return {k.decode() if isinstance(k, bytes) else k:
+                v.decode() if isinstance(v, bytes) else v
+                for k, v in zip(it, it)}
+
+    async def expire(self, key: str, seconds: int) -> None:
+        await self.execute("EXPIRE", key, seconds)
+
+    async def delete(self, key: str) -> None:
+        await self.execute("DEL", key)
+
+    async def keys(self, pattern: str) -> list[str]:
+        raw = await self.execute("KEYS", pattern) or []
+        return [k.decode() if isinstance(k, bytes) else k for k in raw]
+
+
+# ------------------------------------------------------------ in-memory fake
+class InMemoryRedis:
+    """Async-compatible fake with TTL semantics and injectable clock."""
+
+    def __init__(self, clock=None):
+        self._clock = clock or time.monotonic
+        self._data: dict[str, Any] = {}
+        self._expiry: dict[str, float] = {}
+
+    # -- clock/TTL ---------------------------------------------------------
+    def _alive(self, key: str) -> bool:
+        exp = self._expiry.get(key)
+        if exp is not None and self._clock() >= exp:
+            self._data.pop(key, None)
+            self._expiry.pop(key, None)
+            return False
+        return key in self._data
+
+    # -- API mirror --------------------------------------------------------
+    async def connect(self) -> None:
+        pass
+
+    async def close(self) -> None:
+        pass
+
+    connected = True
+
+    async def ping(self) -> bool:
+        return True
+
+    async def hset(self, key: str, mapping: dict) -> None:
+        if not self._alive(key) or not isinstance(self._data.get(key), dict):
+            self._data[key] = {}
+        self._data[key].update({str(k): str(v) for k, v in mapping.items()})
+
+    async def hgetall(self, key: str) -> dict:
+        return dict(self._data.get(key, {})) if self._alive(key) else {}
+
+    async def set(self, key: str, value: str) -> None:
+        self._data[key] = str(value)
+        self._expiry.pop(key, None)
+
+    async def get(self, key: str):
+        return self._data.get(key) if self._alive(key) else None
+
+    async def expire(self, key: str, seconds: int) -> None:
+        if self._alive(key):
+            self._expiry[key] = self._clock() + seconds
+
+    async def ttl(self, key: str) -> int:
+        if not self._alive(key):
+            return -2
+        exp = self._expiry.get(key)
+        return -1 if exp is None else max(0, int(exp - self._clock()))
+
+    async def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+        self._expiry.pop(key, None)
+
+    async def keys(self, pattern: str = "*") -> list[str]:
+        return [k for k in list(self._data) if self._alive(k)
+                and fnmatch.fnmatch(k, pattern)]
+
+    async def execute(self, *args):
+        cmd = args[0].upper()
+        if cmd == "PING":
+            return "PONG"
+        if cmd == "HSET":
+            key = args[1]
+            it = iter(args[2:])
+            await self.hset(key, dict(zip(it, it)))
+            return len(args[2:]) // 2
+        if cmd == "HGETALL":
+            d = await self.hgetall(args[1])
+            out = []
+            for k, v in d.items():
+                out += [k.encode(), str(v).encode()]
+            return out
+        if cmd == "EXPIRE":
+            await self.expire(args[1], int(args[2]))
+            return 1
+        if cmd == "DEL":
+            await self.delete(args[1])
+            return 1
+        if cmd == "KEYS":
+            return [k.encode() for k in await self.keys(args[1])]
+        if cmd == "SET":
+            await self.set(args[1], args[2])
+            return "OK"
+        if cmd == "GET":
+            v = await self.get(args[1])
+            return None if v is None else str(v).encode()
+        if cmd == "TTL":
+            return await self.ttl(args[1])
+        raise RedisError(f"unsupported command {cmd}")
+
+
+# --------------------------------------------------------- mini RESP server
+class MiniRedisServer:
+    """Real RESP sockets in front of an InMemoryRedis (codec integration)."""
+
+    def __init__(self, backend: InMemoryRedis | None = None):
+        self.backend = backend or InMemoryRedis()
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    async def start(self, host="127.0.0.1", port=0) -> None:
+        self._server = await asyncio.start_server(self._client, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _client(self, reader, writer) -> None:
+        try:
+            while True:
+                line = (await reader.readline()).rstrip(b"\r\n")
+                if not line:
+                    break
+                if line[:1] != b"*":
+                    break
+                n = int(line[1:])
+                args = []
+                for _ in range(n):
+                    hdr = (await reader.readline()).rstrip(b"\r\n")
+                    ln = int(hdr[1:])
+                    data = await reader.readexactly(ln + 2)
+                    args.append(data[:-2].decode())
+                try:
+                    res = await self.backend.execute(*args)
+                    writer.write(_encode_reply(res))
+                except RedisError as e:
+                    writer.write(b"-ERR " + str(e).encode() + b"\r\n")
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, ValueError):
+            pass
+        finally:
+            writer.close()
+
+
+def _encode_reply(res) -> bytes:
+    if res is None:
+        return b"$-1\r\n"
+    if isinstance(res, str):
+        return b"+" + res.encode() + b"\r\n"
+    if isinstance(res, int):
+        return b":%d\r\n" % res
+    if isinstance(res, bytes):
+        return b"$%d\r\n%s\r\n" % (len(res), res)
+    if isinstance(res, list):
+        return b"*%d\r\n" % len(res) + b"".join(_encode_reply(x) for x in res)
+    raise RedisError(f"cannot encode {type(res)}")

@@ -26,7 +26,7 @@ from .rtsp import RtspServer
 
 class StreamingServer:
     def __init__(self, config: ServerConfig | None = None, *,
-                 describe_fallback=None):
+                 describe_fallback=None, redis_client=None):
         self.config = config or ServerConfig()
         self.registry = SessionRegistry(self.config.stream_settings())
         from ..vod.session import VodService
@@ -41,6 +41,8 @@ class StreamingServer:
         self._restart_requested = False
         self._engines: dict[int, TpuFanoutEngine] = {}
         self.started_at = time.time()
+        self.presence = None
+        self._redis_client = redis_client
         self.config.on_change(self._on_config_change)
 
     # ------------------------------------------------------------- control
@@ -52,9 +54,25 @@ class StreamingServer:
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
         ]
+        if self.config.cloud_enabled:
+            from ..cluster.presence import PresenceService
+            from ..cluster.redis_client import AsyncRedis
+            redis = self._redis_client or AsyncRedis(
+                self.config.redis_host, self.config.redis_port)
+            self.presence = PresenceService(
+                redis, self.config.server_id, ip=self.config.wan_ip,
+                rtsp_port=self.rtsp.port or self.config.rtsp_port,
+                http_port=self.rest.port or self.config.service_port)
+            try:
+                await self.presence.start()
+            except Exception:
+                self.presence = None       # redis unreachable: run standalone
 
     async def stop(self) -> None:
         self._running = False
+        if self.presence is not None:
+            await self.presence.stop()
+            self.presence = None
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -112,6 +130,14 @@ class StreamingServer:
                 t = now_ms()
                 for sess in list(self.registry.sessions.values()):
                     sess.prune(t)
+                if self.presence is not None:
+                    self.presence.set_load(sum(
+                        s.num_outputs
+                        for s in self.registry.sessions.values()))
+                    try:
+                        await self.presence.sync_streams(self.registry.paths())
+                    except Exception:
+                        pass
 
     async def _sweep_loop(self) -> None:
         while self._running:

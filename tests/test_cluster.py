@@ -1,0 +1,195 @@
+"""Cluster tier: EasyProtocol, Redis clients, presence, CMS platform e2e."""
+
+import asyncio
+
+import pytest
+
+from easydarwin_tpu.cluster import protocol as ep
+from easydarwin_tpu.cluster.cms import CmsServer
+from easydarwin_tpu.cluster.device import CmsClient, SimDevice
+from easydarwin_tpu.cluster.presence import PresenceService
+from easydarwin_tpu.cluster.redis_client import (AsyncRedis, InMemoryRedis,
+                                                 MiniRedisServer, RedisError)
+
+
+def test_protocol_roundtrip():
+    m = ep.Message(ep.MSG_CS_GET_STREAM_REQ, cseq=7,
+                   body={"Serial": "cam1", "Channel": "0"})
+    text = m.to_json()
+    p = ep.Message.parse(text)
+    assert p.message_type == ep.MSG_CS_GET_STREAM_REQ
+    assert p.cseq == 7 and p.error is None
+    assert p.body["Serial"] == "cam1"
+    a = ep.Message.parse(ep.ack(ep.MSG_SC_GET_STREAM_ACK, 7, ep.ERR_OK,
+                                {"URL": "rtsp://x"}))
+    assert a.error == 200 and a.body["URL"] == "rtsp://x"
+
+
+def test_protocol_parse_errors():
+    with pytest.raises(ep.ProtocolError):
+        ep.Message.parse("not json")
+    with pytest.raises(ep.ProtocolError):
+        ep.Message.parse("{}")
+    with pytest.raises(ep.ProtocolError):
+        ep.Message.parse('{"EasyDarwin": {"Header": {"MessageType": "zz"}}}')
+
+
+@pytest.mark.asyncio
+async def test_inmemory_redis_ttl_with_fake_clock():
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    await r.hset("EasyDarwin:a", {"Load": "3"})
+    await r.expire("EasyDarwin:a", 15)
+    assert await r.hgetall("EasyDarwin:a") == {"Load": "3"}
+    t[0] = 14.9
+    assert await r.keys("EasyDarwin:*") == ["EasyDarwin:a"]
+    t[0] = 15.1
+    assert await r.keys("EasyDarwin:*") == []
+    assert await r.hgetall("EasyDarwin:a") == {}
+
+
+@pytest.mark.asyncio
+async def test_resp_client_against_mini_server():
+    srv = MiniRedisServer()
+    await srv.start()
+    try:
+        c = AsyncRedis("127.0.0.1", srv.port)
+        assert await c.ping()
+        await c.hset("k", {"a": "1", "b": "2"})
+        assert await c.hgetall("k") == {"a": "1", "b": "2"}
+        await c.expire("k", 100)
+        assert await c.execute("TTL", "k") > 90
+        assert await c.keys("k*") == ["k"]
+        res = await c.pipeline([("SET", "x", "v"), ("GET", "x")])
+        assert res[0] == "OK" and res[1] == b"v"
+        await c.delete("k")
+        assert await c.keys("k*") == []
+        with pytest.raises(RedisError):
+            await c.execute("BOGUSCMD")
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_presence_assert_and_pick_least_loaded():
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    a = PresenceService(r, "srv-a", ip="10.0.0.1", rtsp_port=554,
+                        http_port=8000)
+    b = PresenceService(r, "srv-b", ip="10.0.0.2", rtsp_port=554,
+                        http_port=8000)
+    a.set_load(10)
+    b.set_load(2)
+    await a.assert_presence()
+    await b.assert_presence()
+    pick = await PresenceService.pick_least_loaded(r)
+    assert pick["Id"] == "srv-b"
+    # stream advertisement + TTL death
+    a.add_stream("/live/cam1")
+    await a.assert_presence()
+    assert (await PresenceService.find_stream(r, "live/cam1"))["Server"] == "srv-a"
+    t[0] = 151
+    assert await PresenceService.find_stream(r, "live/cam1") is None
+    assert await PresenceService.pick_least_loaded(r) is None  # all aged out
+
+
+@pytest.mark.asyncio
+async def test_cms_platform_e2e_device_to_player():
+    """The reference's §3.5 flow: device registers → client asks CMS for the
+    stream → CMS picks the least-loaded media server from Redis → device
+    pushes there → client plays the relayed stream."""
+    from easydarwin_tpu.protocol import rtp
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    redis = InMemoryRedis()
+    media = StreamingServer(ServerConfig(
+        rtsp_port=0, service_port=0, bind_ip="127.0.0.1", wan_ip="127.0.0.1",
+        cloud_enabled=True, server_id="media-1", reflect_interval_ms=5),
+        redis_client=redis)
+    await media.start()
+    cms = CmsServer(redis, bind_ip="127.0.0.1")
+    await cms.start()
+
+    PUSH_SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=dev\r\n"
+                "c=IN IP4 0.0.0.0\r\nt=0 0\r\na=control:*\r\n"
+                "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+                "a=control:trackID=1\r\n")
+
+    pusher = RtspClient()
+
+    def vid(seq, nal=5):
+        return rtp.RtpPacket(payload_type=96, seq=seq, timestamp=seq * 3000,
+                             ssrc=0xCA4, payload=bytes(((3 << 5) | nal,))
+                             + bytes(30)).to_bytes()
+
+    async def on_push(body):
+        # the "firmware": ANNOUNCE to the URL the CMS chose
+        url = body["URL"]
+        host, port = body["IP"], int(body["Port"])
+        await pusher.connect(host, port)
+        await pusher.push_start(url, PUSH_SDP)
+        for i in range(5):
+            pusher.push_packet(0, vid(100 + i, nal=5 if i == 0 else 1))
+        return True
+
+    dev = SimDevice("cam0042", on_push=on_push)
+    try:
+        await dev.connect("127.0.0.1", cms.port)
+        client = CmsClient("127.0.0.1", cms.port)
+        devs = await client.device_list()
+        assert devs[0]["Serial"] == "cam0042" and devs[0]["Online"] == "1"
+
+        ack = await client.get_stream("cam0042")
+        assert ack.error == ep.ERR_OK, ack.body
+        url = ack.body["URL"]
+        assert url.startswith("rtsp://127.0.0.1:")
+
+        player = RtspClient()
+        await player.connect("127.0.0.1", media.rtsp.port)
+        await player.play_start(url)
+        first = await player.recv_interleaved(0)
+        assert rtp.RtpPacket.parse(first).payload[0] & 0x1F == 5
+
+        # PTZ forwarding reaches the device
+        ptz = await client.ptz("cam0042", "left")
+        assert ptz.error == ep.ERR_OK
+        await asyncio.sleep(0.05)
+        assert dev.ctrl_log and dev.ctrl_log[0]["Command"] == "left"
+
+        # second stream request reuses the running push
+        ack2 = await client.get_stream("cam0042")
+        assert ack2.body["URL"] == url
+
+        # snapshot upload
+        snap_url = await dev.post_snapshot("127.0.0.1", cms.port,
+                                           b"\xff\xd8fakejpeg\xff\xd9")
+        assert snap_url.startswith("file://")
+        with open(snap_url[7:], "rb") as f:
+            assert f.read() == b"\xff\xd8fakejpeg\xff\xd9"
+
+        await player.close()
+    finally:
+        await dev.close()
+        await pusher.close()
+        await cms.stop()
+        await media.stop()
+
+
+@pytest.mark.asyncio
+async def test_cms_offline_device_and_unknown():
+    redis = InMemoryRedis()
+    cms = CmsServer(redis, bind_ip="127.0.0.1")
+    await cms.start()
+    try:
+        client = CmsClient("127.0.0.1", cms.port)
+        ack = await client.get_stream("ghost")
+        assert ack.error == ep.ERR_DEVICE_OFFLINE
+        ptz = await client.ptz("ghost", "up")
+        assert ptz.error == ep.ERR_DEVICE_OFFLINE
+        info = await client.request(ep.MSG_CS_DEVICE_INFO_REQ,
+                                    {"Serial": "ghost"})
+        assert info.error == ep.ERR_NOT_FOUND
+    finally:
+        await cms.stop()
