@@ -1,0 +1,193 @@
+"""Transform-domain ops for the transcode ladder (BASELINE config 5).
+
+The reference has no transcoder (EasyHLS was closed-source — SURVEY §2.3);
+this is new, TPU-first machinery: 8×8 DCT/IDCT expressed as ONE batched
+``[N, 64] @ [64, 64]`` matmul via the Kronecker identity
+``vec(Cᵀ·X·C) = (Cᵀ ⊗ Cᵀ)·vec(X)`` — MXU-shaped (the per-block 8×8 matmul
+form would waste the 128×128 systolic array), arbitrary batch, bf16-friendly.
+Quantization follows the JPEG/H.263 convention (base table × quality scale).
+
+Scope note: bitstream entropy (CAVLC/CABAC) decode/encode stays on the host
+(native tier); the device owns the dense transform/quant math, which is
+where the FLOPs are.  ``decode_blocks_pallas`` fuses dequant → IDCT →
++128 level shift → clip in one VMEM pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ----------------------------------------------------------------- DCT bases
+
+def dct_matrix() -> np.ndarray:
+    """Orthonormal 8-point DCT-II matrix C: y = C @ x."""
+    C = np.zeros((8, 8), dtype=np.float64)
+    for k in range(8):
+        a = np.sqrt(1 / 8) if k == 0 else np.sqrt(2 / 8)
+        for n in range(8):
+            C[k, n] = a * np.cos(np.pi * (2 * n + 1) * k / 16)
+    return C
+
+
+@functools.lru_cache(maxsize=None)
+def _kron_mats() -> tuple[np.ndarray, np.ndarray]:
+    """(forward, inverse) 64×64 operators on row-major vec'd blocks.
+
+    forward: vec(C X Cᵀ) = (C ⊗ C) vec(X)   (2-D DCT of spatial block X)
+    inverse: vec(Cᵀ Y C) = (Cᵀ ⊗ Cᵀ) vec(Y)
+    """
+    C = dct_matrix()
+    fwd = np.kron(C, C)
+    inv = np.kron(C.T, C.T)
+    return (fwd.astype(np.float32), inv.astype(np.float32))
+
+
+def dct_blocks(x: jnp.ndarray) -> jnp.ndarray:
+    """[N, 64] spatial → [N, 64] coefficients (row-major 8×8 blocks)."""
+    fwd, _ = _kron_mats()
+    return x @ jnp.asarray(fwd).T
+
+
+def idct_blocks(y: jnp.ndarray) -> jnp.ndarray:
+    """[N, 64] coefficients → [N, 64] spatial."""
+    _, inv = _kron_mats()
+    return y @ jnp.asarray(inv).T
+
+
+# -------------------------------------------------------------- quantization
+
+#: JPEG Annex K luminance base table, row-major (the de-facto baseline the
+#: reference-era tooling used for intra quant).
+JPEG_LUMA_QT = np.array([
+    16, 11, 10, 16, 24, 40, 51, 61,
+    12, 12, 14, 19, 26, 58, 60, 55,
+    14, 13, 16, 24, 40, 57, 69, 56,
+    14, 17, 22, 29, 51, 87, 80, 62,
+    18, 22, 37, 56, 68, 109, 103, 77,
+    24, 35, 55, 64, 81, 104, 113, 92,
+    49, 64, 78, 87, 103, 121, 120, 101,
+    72, 92, 95, 98, 112, 100, 103, 99], dtype=np.float32)
+
+
+def quality_table(quality: int) -> np.ndarray:
+    """JPEG quality (1-100) → effective quant table [64]."""
+    quality = int(np.clip(quality, 1, 100))
+    scale = 5000 / quality if quality < 50 else 200 - 2 * quality
+    qt = np.floor((JPEG_LUMA_QT * scale + 50) / 100)
+    return np.clip(qt, 1, 255).astype(np.float32)
+
+
+@jax.jit
+def quantize(coef: jnp.ndarray, qtable: jnp.ndarray) -> jnp.ndarray:
+    """[N,64] float coefficients → int32 levels (round-half-away)."""
+    return jnp.round(coef / qtable[None, :]).astype(jnp.int32)
+
+
+@jax.jit
+def dequantize(levels: jnp.ndarray, qtable: jnp.ndarray) -> jnp.ndarray:
+    return levels.astype(jnp.float32) * qtable[None, :]
+
+
+# ------------------------------------------------------------------- zigzag
+
+@functools.lru_cache(maxsize=None)
+def zigzag_order() -> np.ndarray:
+    """[64] indices mapping raster order → zigzag scan order."""
+    # odd diagonals run down-left (i ascending), even ones up-right
+    order = sorted(((i + j, i if (i + j) % 2 else j, i, j)
+                    for i in range(8) for j in range(8)))
+    return np.array([i * 8 + j for (_, _, i, j) in order], dtype=np.int32)
+
+
+def to_zigzag(levels: jnp.ndarray) -> jnp.ndarray:
+    return levels[:, jnp.asarray(zigzag_order())]
+
+
+def from_zigzag(z: jnp.ndarray) -> jnp.ndarray:
+    inv = np.argsort(zigzag_order())
+    return z[:, jnp.asarray(inv)]
+
+
+# ----------------------------------------------------- encode / decode paths
+
+@jax.jit
+def encode_blocks(pixels: jnp.ndarray, qtable: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [N,64] spatial blocks → int32 quantized coefficient levels."""
+    x = pixels.astype(jnp.float32) - 128.0
+    return quantize(dct_blocks(x), qtable)
+
+
+@jax.jit
+def decode_blocks(levels: jnp.ndarray, qtable: jnp.ndarray) -> jnp.ndarray:
+    """int32 levels → uint8 [N,64] spatial blocks (dequant+IDCT+shift+clip)."""
+    x = idct_blocks(dequantize(levels, qtable)) + 128.0
+    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+
+
+@jax.jit
+def requantize(levels: jnp.ndarray, qtable_in: jnp.ndarray,
+               qtable_out: jnp.ndarray) -> jnp.ndarray:
+    """Transform-domain bitrate step-down: dequant with the source table,
+    requant with a coarser one — the inner op of the transcode ladder
+    (no IDCT round-trip needed for same-resolution rungs)."""
+    return quantize(dequantize(levels, qtable_in), qtable_out)
+
+
+def transcode_ladder(levels: jnp.ndarray, qtable_in: jnp.ndarray,
+                     qualities: tuple[int, ...]) -> list[jnp.ndarray]:
+    """One decode-side coefficient block set → N ladder rungs."""
+    return [requantize(levels, qtable_in, jnp.asarray(quality_table(q)))
+            for q in qualities]
+
+
+# ------------------------------------------------------------ pallas kernel
+
+TILE = 256     # blocks per grid step ([256, 64] f32 tiles in VMEM)
+
+
+def _decode_kernel(levels_ref, qt_ref, inv_ref, out_ref):
+    x = levels_ref[:].astype(jnp.float32) * qt_ref[:]      # dequant (bcast)
+    y = jax.lax.dot_general(x, inv_ref[:],
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    out_ref[:] = jnp.clip(jnp.round(y + 128.0), 0, 255).astype(jnp.uint8)
+
+
+def decode_blocks_pallas(levels: jnp.ndarray, qtable: jnp.ndarray,
+                         *, interpret: bool = False) -> jnp.ndarray:
+    """Fused dequant→IDCT→shift→clip as one Pallas kernel.
+
+    levels [N,64] int32 (N a multiple of TILE — pad with zero blocks),
+    qtable [1,64] f32.  The 64×64 inverse operator rides along in VMEM and
+    hits the MXU via dot_general.  ``interpret=True`` runs on CPU for tests.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = levels.shape[0]
+    pad = (-n) % TILE
+    if pad:
+        levels = jnp.concatenate(
+            [levels, jnp.zeros((pad, 64), levels.dtype)], axis=0)
+    _, inv = _kron_mats()
+    grid = levels.shape[0] // TILE
+    out = pl.pallas_call(
+        _decode_kernel,
+        out_shape=jax.ShapeDtypeStruct((levels.shape[0], 64), jnp.uint8),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((TILE, 64), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 64), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((64, 64), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE, 64), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(levels, qtable.reshape(1, 64).astype(jnp.float32),
+      jnp.asarray(inv))          # contraction ((1,),(1,)) ≡ x @ inv.T
+    return out[:n]

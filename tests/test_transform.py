@@ -1,0 +1,88 @@
+"""Transform ops: DCT bases, kron equivalence, quant, Pallas fusion."""
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu.ops import transform as tf
+
+
+def naive_dct2(block8: np.ndarray) -> np.ndarray:
+    C = tf.dct_matrix()
+    return C @ block8 @ C.T
+
+
+def test_dct_matrix_orthonormal():
+    C = tf.dct_matrix()
+    np.testing.assert_allclose(C @ C.T, np.eye(8), atol=1e-12)
+
+
+def test_kron_equals_naive_2d_dct():
+    rng = np.random.default_rng(0)
+    blocks = rng.uniform(-128, 127, size=(17, 8, 8))
+    flat = blocks.reshape(17, 64).astype(np.float32)
+    coef = np.asarray(tf.dct_blocks(flat))
+    for i in range(17):
+        np.testing.assert_allclose(coef[i].reshape(8, 8),
+                                   naive_dct2(blocks[i]), rtol=1e-4,
+                                   atol=1e-2)
+
+
+def test_idct_inverts_dct():
+    rng = np.random.default_rng(1)
+    x = rng.uniform(-128, 127, size=(32, 64)).astype(np.float32)
+    back = np.asarray(tf.idct_blocks(tf.dct_blocks(x)))
+    np.testing.assert_allclose(back, x, atol=1e-2)
+
+
+def test_quality_tables_monotone():
+    q10, q50, q90 = (tf.quality_table(q) for q in (10, 50, 90))
+    assert (q10 >= q50).all() and (q50 >= q90).all()
+    np.testing.assert_array_equal(tf.quality_table(50), tf.JPEG_LUMA_QT)
+
+
+def test_encode_decode_roundtrip_high_quality():
+    rng = np.random.default_rng(2)
+    pixels = rng.integers(0, 256, size=(64, 64), dtype=np.uint8)
+    qt = tf.quality_table(95)
+    levels = tf.encode_blocks(pixels, qt)
+    out = np.asarray(tf.decode_blocks(levels, qt))
+    err = np.abs(out.astype(int) - pixels.astype(int))
+    assert err.mean() < 3.5 and err.max() <= 40
+
+
+def test_zigzag_roundtrip_and_energy_compaction():
+    rng = np.random.default_rng(3)
+    pixels = rng.integers(0, 256, size=(8, 64), dtype=np.uint8)
+    levels = tf.encode_blocks(pixels, tf.quality_table(50))
+    z = tf.to_zigzag(levels)
+    np.testing.assert_array_equal(np.asarray(tf.from_zigzag(z)),
+                                  np.asarray(levels))
+    assert tf.zigzag_order()[0] == 0 and tf.zigzag_order()[1] == 1
+    # DC + low-freq first: first zigzag coeffs carry most magnitude
+    mags = np.abs(np.asarray(z)).mean(axis=0)
+    assert mags[:8].sum() > mags[-32:].sum()
+
+
+def test_requantize_ladder_coarsens():
+    rng = np.random.default_rng(4)
+    pixels = rng.integers(0, 256, size=(32, 64), dtype=np.uint8)
+    qt_in = tf.quality_table(90)
+    levels = tf.encode_blocks(pixels, qt_in)
+    rungs = tf.transcode_ladder(levels, qt_in, (80, 50, 20))
+    nz = [int((np.asarray(r) != 0).sum()) for r in rungs]
+    assert nz[0] >= nz[1] >= nz[2]          # coarser → sparser
+    assert nz[2] < int((np.asarray(levels) != 0).sum())
+
+
+def test_pallas_decode_matches_jnp():
+    rng = np.random.default_rng(5)
+    pixels = rng.integers(0, 256, size=(300, 64), dtype=np.uint8)
+    qt = tf.quality_table(75)
+    levels = tf.encode_blocks(pixels, qt)
+    ref = np.asarray(tf.decode_blocks(levels, qt))
+    out = np.asarray(tf.decode_blocks_pallas(levels, qt, interpret=True))
+    # identical up to rounding at the clip boundary
+    assert out.shape == ref.shape
+    diff = np.abs(out.astype(int) - ref.astype(int))
+    assert diff.max() <= 1
+    assert (diff > 0).mean() < 0.01
