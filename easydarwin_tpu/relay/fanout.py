@@ -133,6 +133,9 @@ class TpuFanoutEngine:
                 if ring.length[slot] < 12:
                     pid += 1
                     continue
+                if not out.thinning.admit(int(ring.flags[slot])):
+                    pid += 1
+                    continue
                 payload = ring.data[slot, 12:ring.length[slot]]
                 wr = out.send_rewritten(headers[s, j].tobytes(),
                                         payload.tobytes())

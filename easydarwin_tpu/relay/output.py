@@ -51,12 +51,18 @@ class RelayOutput:
 
     def __init__(self, *, ssrc: int = 0, out_seq_start: int = 1,
                  out_ts_start: int = 0):
+        from .quality import ThinningFilter
         self.bookmark: int | None = None      # next ring id; None = not primed
         self.rewrite = RewriteState(ssrc=ssrc, out_seq_start=out_seq_start,
                                     out_ts_start=out_ts_start)
+        self.thinning = ThinningFilter()
         self.packets_sent = 0
         self.bytes_sent = 0
         self.stalls = 0
+
+    def on_receiver_report(self, fraction_lost: float) -> int:
+        """RTCP RR feedback → quality level (FlowControl role input)."""
+        return self.thinning.controller.on_receiver_report(fraction_lost)
 
     # -- transport ---------------------------------------------------------
     def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:

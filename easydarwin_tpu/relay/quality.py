@@ -1,0 +1,110 @@
+"""Quality adaptation: RTCP-feedback-driven thinning/thickening.
+
+Reference parity: ``QTSSFlowControlModule.cpp:94-441`` (RTCP loss/buffer
+feedback → thin/thick decisions with hysteresis; default tolerances from
+its pref table: thin when loss > 30%% once or > 10%% repeatedly, thicken
+after several clean reports) and ``RTPStream``'s quality levels
+(``RTPStream.h:144-174``).
+
+The reference thins hinted VOD media per-track; a relay only knows frame
+boundaries and keyframes (the ingest classifier), so thinning here drops
+*complete frames* per output:
+
+====  =========================================
+0     full stream
+1     drop every second non-key frame
+2     key frames (IDR/SPS/PPS GOP heads) only
+3     video muted (audio continues)
+====  =========================================
+
+Decisions live per output (one slow client must not thin the others —
+exactly why the reference keeps quality on the RTPStream).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .ring import PacketFlags
+
+MAX_LEVEL = 3
+
+# hysteresis thresholds (QTSSFlowControlModule pref defaults)
+LOSS_THIN_NOW = 0.30        # one report above this → thin immediately
+LOSS_THIN_SLOW = 0.10       # this many...
+NUM_LOSSES_TO_THIN = 3      # ...consecutive reports above SLOW → thin
+LOSS_THICK_BELOW = 0.03     # reports below this...
+NUM_CLEAN_TO_THICK = 6      # ...this many times → thicken one level
+
+
+@dataclass
+class QualityController:
+    level: int = 0
+    _lossy_reports: int = 0
+    _clean_reports: int = 0
+    thins: int = 0
+    thickens: int = 0
+
+    def on_receiver_report(self, fraction_lost: float) -> int:
+        """Feed one RR's loss fraction (0..1); returns the new level."""
+        if fraction_lost >= LOSS_THIN_NOW:
+            self._bump(+1)
+            self._lossy_reports = self._clean_reports = 0
+            return self.level
+        if fraction_lost >= LOSS_THIN_SLOW:
+            self._lossy_reports += 1
+            self._clean_reports = 0
+            if self._lossy_reports >= NUM_LOSSES_TO_THIN:
+                self._bump(+1)
+                self._lossy_reports = 0
+        elif fraction_lost <= LOSS_THICK_BELOW:
+            self._clean_reports += 1
+            self._lossy_reports = 0
+            if self._clean_reports >= NUM_CLEAN_TO_THICK:
+                self._bump(-1)
+                self._clean_reports = 0
+        else:
+            self._lossy_reports = self._clean_reports = 0
+        return self.level
+
+    def _bump(self, d: int) -> None:
+        new = max(0, min(MAX_LEVEL, self.level + d))
+        if new > self.level:
+            self.thins += 1
+        elif new < self.level:
+            self.thickens += 1
+        self.level = new
+
+
+@dataclass
+class ThinningFilter:
+    """Per-output frame-granular packet filter driven by a quality level."""
+
+    controller: QualityController = field(default_factory=QualityController)
+    _frame_index: int = 0
+    _dropping_frame: bool = False
+    dropped: int = 0
+
+    def admit(self, flags: int) -> bool:
+        """Decide for one packet (classification flags from the ring)."""
+        level = self.controller.level
+        if not flags & PacketFlags.VIDEO:
+            return True                      # audio always flows
+        is_key = bool(flags & PacketFlags.KEYFRAME_FIRST)
+        if flags & PacketFlags.FRAME_FIRST:
+            self._frame_index += 1
+            if level == 0:
+                self._dropping_frame = False
+            elif level == 1:
+                self._dropping_frame = (not is_key
+                                        and self._frame_index % 2 == 0)
+            elif level == 2:
+                self._dropping_frame = not is_key
+            else:
+                self._dropping_frame = True
+        elif level >= 3:
+            self._dropping_frame = True
+        if self._dropping_frame:
+            self.dropped += 1
+            return False
+        return True

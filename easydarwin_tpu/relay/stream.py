@@ -141,6 +141,9 @@ class RelayStream:
                     if len(data) < 12:      # runt: skip, never parse
                         pid += 1
                         continue
+                    if not out.thinning.admit(ring.get_flags(pid)):
+                        pid += 1            # thinned: frame dropped for this
+                        continue            # output only (quality level)
                     res = out.write_rtp(data)
                     if res is WriteResult.WOULD_BLOCK:
                         self.stats.stalls += 1

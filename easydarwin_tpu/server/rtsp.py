@@ -321,14 +321,16 @@ class RtspConnection:
 
     # -------------------------------------------------------- media paths
     def _on_interleaved(self, pkt: rtsp.InterleavedPacket) -> None:
-        """Pushed media over the RTSP TCP connection (RECORD mode)."""
+        """Pushed media (RECORD mode) or player RTCP feedback."""
         m = self.channel_map.get(pkt.channel)
-        if m is None or self.relay is None:
+        if m is not None and self.relay is not None:
+            track_id, is_rtcp = m
+            self.relay.push(track_id, pkt.data, is_rtcp=is_rtcp)
+            self.server.stats["packets_in"] += 1
+            self.server.wake_pump()
             return
-        track_id, is_rtcp = m
-        self.relay.push(track_id, pkt.data, is_rtcp=is_rtcp)
-        self.server.stats["packets_in"] += 1
-        self.server.wake_pump()
+        if self.player_tracks and pkt.channel % 2 == 1:
+            self.server.on_client_rtcp(self, pkt.data)
 
     def _udp_ingest(self, track_id: int, data: bytes, is_rtcp: bool) -> None:
         if self.relay is not None:
@@ -425,9 +427,23 @@ class RtspServer:
         return self.registry.find(path)
 
     def on_client_rtcp(self, conn: RtspConnection, data: bytes) -> None:
-        """Receiver reports from UDP players (flow-control input)."""
+        """Receiver reports from players → per-output quality adaptation
+        (the QTSS_RTCPProcess_Role → FlowControlModule pipeline)."""
+        from ..protocol import rtcp as rtcp_mod
         self.stats.setdefault("rtcp_in", 0)
         self.stats["rtcp_in"] += 1
+        try:
+            pkts = rtcp_mod.parse_compound(data)
+        except rtcp_mod.RtcpError:
+            return
+        outputs = {pt.output.rewrite.ssrc: pt.output
+                   for pt in conn.player_tracks.values()}
+        for p in pkts:
+            if isinstance(p, rtcp_mod.ReceiverReport):
+                for rb in p.reports:
+                    out = outputs.get(rb.ssrc)
+                    if out is not None:
+                        out.on_receiver_report(rb.fraction_lost / 256.0)
 
     def wake_pump(self) -> None:
         if self._on_pump_wake is not None:

@@ -1,0 +1,107 @@
+"""Flow control / thinning: hysteresis, frame-granular filtering, RR plumbing."""
+
+import copy
+
+from easydarwin_tpu.protocol import rtcp, rtp, sdp
+from easydarwin_tpu.relay import RelayStream, StreamSettings
+from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+from easydarwin_tpu.relay.output import CollectingOutput
+from easydarwin_tpu.relay.quality import (NUM_CLEAN_TO_THICK,
+                                          NUM_LOSSES_TO_THIN,
+                                          QualityController)
+
+VIDEO_SDP = ("v=0\r\nm=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+             "a=control:trackID=1\r\n")
+
+
+def vid_pkt(seq, nal_type=1, marker=False):
+    return rtp.RtpPacket(payload_type=96, seq=seq, timestamp=seq * 3000,
+                         ssrc=0x11, marker=marker,
+                         payload=bytes(((3 << 5) | nal_type,)) + bytes(30)
+                         ).to_bytes()
+
+
+def test_controller_hysteresis():
+    c = QualityController()
+    assert c.on_receiver_report(0.5) == 1          # catastrophic → thin now
+    for _ in range(NUM_LOSSES_TO_THIN - 1):
+        assert c.on_receiver_report(0.15) == 1
+    assert c.on_receiver_report(0.15) == 2         # sustained → thin again
+    assert c.on_receiver_report(0.05) == 2         # mid-band: no change
+    for _ in range(NUM_CLEAN_TO_THICK - 1):
+        assert c.on_receiver_report(0.0) == 2
+    assert c.on_receiver_report(0.0) == 1          # clean streak → thicken
+    # bounded at MAX_LEVEL
+    for _ in range(10):
+        c.on_receiver_report(0.9)
+    assert c.level == 3
+
+
+def push_gop(st, base_seq, n_frames=6):
+    """One IDR + n-1 P frames, 1 packet per frame."""
+    for i in range(n_frames):
+        st.push_rtp(vid_pkt(base_seq + i, nal_type=5 if i == 0 else 1,
+                            marker=True), 1000 + base_seq + i)
+
+
+def test_thinning_levels_drop_frames():
+    st = RelayStream(sdp.parse(VIDEO_SDP).streams[0], StreamSettings())
+    full = CollectingOutput(ssrc=1)
+    thin2 = CollectingOutput(ssrc=2)
+    thin2.thinning.controller.level = 2            # keyframes only
+    mute = CollectingOutput(ssrc=3)
+    mute.thinning.controller.level = 3
+    for o in (full, thin2, mute):
+        st.add_output(o)
+    push_gop(st, 100, 6)
+    st.reflect(5000)
+    assert len(full.rtp_packets) == 6
+    assert len(thin2.rtp_packets) == 1             # just the IDR
+    assert rtp.RtpPacket.parse(thin2.rtp_packets[0]).payload[0] & 0x1F == 5
+    assert len(mute.rtp_packets) == 0
+    assert mute.thinning.dropped == 6
+
+
+def test_thinning_seq_stays_gapless_for_receiver():
+    """Thinned outputs still emit rebased sequence numbers in stream order —
+    gaps appear (receiver sees loss), matching how the reference's thinning
+    behaves (it drops packets, not renumbers)."""
+    st = RelayStream(sdp.parse(VIDEO_SDP).streams[0], StreamSettings())
+    o = CollectingOutput(ssrc=9, out_seq_start=50)
+    o.thinning.controller.level = 2
+    st.add_output(o)
+    push_gop(st, 200, 4)
+    st.reflect(2000)                               # start at first GOP's IDR
+    push_gop(st, 204, 4)
+    st.reflect(5000)
+    seqs = [rtp.RtpPacket.parse(p).seq for p in o.rtp_packets]
+    assert seqs == [50, 54]                        # two IDRs, 4 apart
+
+
+def test_tpu_engine_matches_cpu_with_thinning():
+    st_cpu = RelayStream(sdp.parse(VIDEO_SDP).streams[0], StreamSettings())
+    a = CollectingOutput(ssrc=1)
+    b = CollectingOutput(ssrc=2)
+    b.thinning.controller.level = 1                # every 2nd non-key frame
+    st_cpu.add_output(a)
+    st_cpu.add_output(b)
+    push_gop(st_cpu, 300, 10)
+    st_tpu = copy.deepcopy(st_cpu)
+    st_cpu.reflect(5000)
+    TpuFanoutEngine().step(st_tpu, 5000)
+    for x, y in zip(st_cpu.outputs, st_tpu.outputs):
+        assert x.rtp_packets == y.rtp_packets
+        assert x.bookmark == y.bookmark
+    assert len(st_cpu.outputs[1].rtp_packets) < len(st_cpu.outputs[0].rtp_packets)
+
+
+def test_rr_fraction_lost_drives_output():
+    o = CollectingOutput(ssrc=0xABCD)
+    # fraction_lost is /256 on the wire
+    level = o.on_receiver_report(200 / 256.0)
+    assert level == 1
+    rb = rtcp.ReportBlock(ssrc=0xABCD, fraction_lost=200, cumulative_lost=10,
+                          highest_seq=100, jitter=5, lsr=0, dlsr=0)
+    raw = rtcp.ReceiverReport(7, [rb]).to_bytes()
+    (rr,) = rtcp.parse_compound(raw)
+    assert rr.reports[0].fraction_lost == 200
