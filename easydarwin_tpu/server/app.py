@@ -31,9 +31,28 @@ class StreamingServer:
         self.registry = SessionRegistry(self.config.stream_settings())
         from ..vod.session import VodService
         self.vod = VodService(self.config.movie_folder)
+        self.auth = None
+        if self.config.rtsp_auth_enabled:
+            from .auth import AuthService, AccessRules, UsersFile
+            rules = AccessRules()
+            rules.protect("/", [])          # valid-user everywhere by default
+            self.auth = AuthService(
+                UsersFile(self.config.users_file or None),
+                rules, scheme=self.config.auth_scheme)
+        self.access_log = None
+        self.error_log = None
+        if self.config.access_log_enabled:
+            import os
+            from ..utils.logs import AccessLog, ErrorLog
+            self.access_log = AccessLog(
+                os.path.join(self.config.log_folder, "access.log"))
+            self.error_log = ErrorLog(
+                os.path.join(self.config.log_folder, "error.log"),
+                verbosity=self.config.error_log_verbosity)
         self.rtsp = RtspServer(self.config, self.registry,
                                describe_fallback=describe_fallback,
-                               on_pump_wake=self._wake, vod=self.vod)
+                               on_pump_wake=self._wake, vod=self.vod,
+                               auth=self.auth, access_log=self.access_log)
         self.rest = RestApi(self.config, self)
         self._pump_event = asyncio.Event()
         self._tasks: list[asyncio.Task] = []

@@ -49,7 +49,14 @@ class ServerConfig:
     auth_enabled: bool = False
     rest_username: str = "admin"
     rest_password: str = "admin"
+    rtsp_auth_enabled: bool = False
+    users_file: str = ""               # qtpasswd-style user:realm:ha1
+    auth_scheme: str = "digest"        # digest | basic
     max_connections: int = 20000       # epollEvent.cpp:16 MAX_EPOLL_FD
+    # --- logging (QTSSRollingLog / AccessLog / ErrorLog prefs)
+    log_folder: str = "/tmp/edtpu_logs"
+    access_log_enabled: bool = True
+    error_log_verbosity: str = "info"  # fatal|warning|info|debug
 
     _listeners: list[Callable[["ServerConfig"], None]] = field(
         default_factory=list, repr=False, compare=False)

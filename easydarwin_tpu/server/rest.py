@@ -66,10 +66,12 @@ class RestApi:
                 status, payload = await self.route(method, target, headers,
                                                    body)
                 data = payload.encode() if isinstance(payload, str) else payload
+                ctype = ("text/html" if data[:2] in (b"<!", b"<h")
+                         else "application/json")
                 writer.write(
                     f"HTTP/1.1 {status} {'OK' if status == 200 else 'Error'}\r\n"
                     f"Server: {SERVER_NAME}\r\n"
-                    "Content-Type: application/json\r\n"
+                    f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(data)}\r\n"
                     "Connection: keep-alive\r\n\r\n".encode() + data)
                 await writer.drain()
@@ -103,6 +105,8 @@ class RestApi:
         url = urlparse(target)
         path = url.path.rstrip("/").lower()
         params = parse_qs(url.query)
+        if path == "/stats":
+            return 200, self._webstats_html()
         if not path.startswith("/api/v1/"):
             return 404, json.dumps({"error": "not found"})
         cmd = path[len("/api/v1/"):]
@@ -176,3 +180,25 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_GET_STREAM_ACK, body={"URL": url})
 
     _cmd_livedevicestream = _cmd_getdevicestream
+
+    def _webstats_html(self) -> str:
+        """HTML stats page (QTSSWebStatsModule.cpp:86-992 equivalent,
+        served from the service port instead of RTSP-port HTTP GET)."""
+        info = self.app.server_info()
+        sessions = self.app.live_sessions()
+        rows = "".join(
+            f"<tr><td>{s['Path']}</td><td>{s['Outputs']}</td>"
+            f"<td>{s['AgeSec']}s</td><td><code>{s['Url']}</code></td></tr>"
+            for s in sessions)
+        infos = "".join(f"<tr><td>{k}</td><td>{v}</td></tr>"
+                        for k, v in info.items())
+        return (
+            "<!doctype html><html><head><title>easydarwin-tpu stats"
+            "</title><style>body{font-family:monospace;margin:2em}"
+            "table{border-collapse:collapse;margin:1em 0}"
+            "td,th{border:1px solid #999;padding:4px 10px}</style></head>"
+            f"<body><h1>easydarwin-tpu</h1><h2>Server</h2>"
+            f"<table>{infos}</table>"
+            f"<h2>Live sessions ({len(sessions)})</h2>"
+            f"<table><tr><th>Path</th><th>Outputs</th><th>Age</th>"
+            f"<th>URL</th></tr>{rows}</table></body></html>")

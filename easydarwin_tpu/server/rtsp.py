@@ -65,6 +65,7 @@ class RtspConnection:
         self.reader = reader
         self.writer = writer
         self.wire = rtsp.RtspWireReader()
+        self.uri = ""
         self.session_id: str | None = None
         self.path: str | None = None
         self.relay: RelaySession | None = None
@@ -78,6 +79,9 @@ class RtspConnection:
         self.channel_map: dict[int, tuple[int, bool]] = {}
         self.last_activity = time.monotonic()
         self.closed = False
+        self.auth_user: str | None = None
+        self.user_agent = ""
+        self.created_at = time.monotonic()
         peer = writer.get_extra_info("peername") or ("?", 0)
         self.client_ip = peer[0]
 
@@ -118,6 +122,21 @@ class RtspConnection:
         if handler is None:
             self._reply(rtsp.RtspResponse(501), req.cseq)
             return
+        if ua := req.headers.get("user-agent"):
+            self.user_agent = ua
+        if req.uri != "*":
+            self.uri = req.uri
+        auth = self.server.auth
+        if (auth is not None
+                and req.method in ("DESCRIBE", "SETUP", "ANNOUNCE", "PLAY",
+                                   "RECORD")):
+            allowed, user = auth.authorize(
+                req.path(), req.method, req.headers.get("authorization"))
+            if not allowed:
+                self._reply(rtsp.RtspResponse(401, {
+                    "WWW-Authenticate": auth.challenge()}), req.cseq)
+                return
+            self.auth_user = user
         try:
             await handler(req)
         except rtsp.RtspError as e:
@@ -351,6 +370,7 @@ class RtspConnection:
         if self.closed:
             return
         self.closed = True
+        self.server.on_session_closed(self)
         if self.vod_session is not None:
             self.vod_session.stop()
             self.vod_session = None
@@ -380,10 +400,13 @@ class RtspServer:
     """Listener + connection registry (QTSServer::CreateListeners analog)."""
 
     def __init__(self, config: ServerConfig, registry: SessionRegistry,
-                 *, describe_fallback=None, on_pump_wake=None, vod=None):
+                 *, describe_fallback=None, on_pump_wake=None, vod=None,
+                 auth=None, access_log=None):
         self.config = config
         self.registry = registry
         self.vod = vod                       # VodService or None
+        self.auth = auth                     # AuthService or None
+        self.access_log = access_log         # AccessLog or None
         self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
         self.connections: set[RtspConnection] = set()
         self.stats = {"requests": 0, "pushers": 0, "players": 0,
@@ -425,6 +448,25 @@ class RtspServer:
 
     async def open_for_play(self, path: str) -> RelaySession | None:
         return self.registry.find(path)
+
+    def on_session_closed(self, conn: RtspConnection) -> None:
+        """ClientSessionClosing → access-log record (AccessLogModule role)."""
+        if self.access_log is None or (not conn.player_tracks
+                                       and not conn.is_pusher):
+            return
+        from ..utils.logs import AccessRecord
+        sent = sum(pt.output.packets_sent
+                   for pt in conn.player_tracks.values())
+        nbytes = sum(pt.output.bytes_sent
+                     for pt in conn.player_tracks.values())
+        any_udp = any(pt.udp_pair for pt in conn.player_tracks.values())
+        self.access_log.record(AccessRecord(
+            client_ip=conn.client_ip, uri=conn.uri or conn.path or "-",
+            method="RECORD" if conn.is_pusher else "PLAY",
+            duration_sec=time.monotonic() - conn.created_at,
+            bytes_sent=nbytes, packets_sent=sent,
+            user_agent=conn.user_agent,
+            transport="UDP" if any_udp else "TCP"))
 
     def on_client_rtcp(self, conn: RtspConnection, data: bytes) -> None:
         """Receiver reports from players → per-output quality adaptation
