@@ -54,9 +54,15 @@ class RelayStream:
         self.rtp_ring = PacketRing(self.settings.ring_capacity,
                                    is_video=is_video)
         self.rtcp_ring = PacketRing(min(256, self.settings.ring_capacity))
-        #: absolute id of the newest keyframe-first packet (video only) —
-        #: the fKeyFrameStartPacketElementPointer equivalent.
+        #: absolute id of the newest keyframe *run head* (video only).
+        #: The reference keeps the newest keyframe-first packet
+        #: (fKeyFrameStartPacketElementPointer) — which, when a pusher sends
+        #: SPS/PPS/IDR as separate packets, lands on the IDR and drops the
+        #: parameter sets for late joiners.  We instead pin the first packet
+        #: of a consecutive keyframe-classified run (the SPS), so fast-start
+        #: always delivers the whole GOP head.
         self.keyframe_id: int | None = None
+        self._kf_run_active = False
         self.has_keyframe_update = False     # SetHasVideoKeyFrameUpdate
         self.buckets: list[list[RelayOutput]] = []
         self.stats = StreamStats()
@@ -67,9 +73,13 @@ class RelayStream:
         self.stats.packets_in += 1
         self.stats.bytes_in += len(packet)
         if self.rtp_ring.get_flags(pid) & PacketFlags.KEYFRAME_FIRST:
-            self.keyframe_id = pid
-            self.has_keyframe_update = True
-            self.stats.keyframes += 1
+            if not self._kf_run_active:
+                self.keyframe_id = pid
+                self.has_keyframe_update = True
+                self.stats.keyframes += 1
+                self._kf_run_active = True
+        else:
+            self._kf_run_active = False
         return pid
 
     def push_rtcp(self, packet: bytes, now_ms: int) -> int:

@@ -54,6 +54,8 @@ class StreamingServer:
                                on_pump_wake=self._wake, vod=self.vod,
                                auth=self.auth, access_log=self.access_log)
         self.rest = RestApi(self.config, self)
+        from ..vod.record import RecordingManager
+        self.recordings = RecordingManager()
         self._pump_event = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._running = False

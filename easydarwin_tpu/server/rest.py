@@ -181,6 +181,35 @@ class RestApi:
 
     _cmd_livedevicestream = _cmd_getdevicestream
 
+    def _cmd_startrecord(self, params: dict, body: bytes) -> tuple[int, str]:
+        """Attach an MP4 recorder to a live session (RtspRecordModule)."""
+        path = params.get("path", [""])[0]
+        sess = self.app.registry.find(path) if path else None
+        if sess is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        import os
+        fname = params.get("file", [""])[0] or (
+            sess.path.strip("/").replace("/", "_")
+            + time.strftime("_%Y%m%d%H%M%S") + ".mp4")
+        full = os.path.join(self.config.movie_folder, os.path.basename(fname))
+        os.makedirs(self.config.movie_folder, exist_ok=True)
+        try:
+            self.app.recordings.start(sess, full)
+        except ValueError as e:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": str(e)})
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK,
+                           body={"Recording": sess.path, "File": full})
+
+    def _cmd_stoprecord(self, params: dict, body: bytes) -> tuple[int, str]:
+        path = params.get("path", [""])[0]
+        try:
+            res = self.app.recordings.stop(path)
+        except KeyError:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "File": res["path"], "Samples": str(res["samples"])})
+
     def _webstats_html(self) -> str:
         """HTML stats page (QTSSWebStatsModule.cpp:86-992 equivalent,
         served from the service port instead of RTSP-port HTTP GET)."""
