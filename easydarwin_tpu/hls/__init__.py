@@ -1,0 +1,10 @@
+"""HLS output tier.
+
+The reference only *referenced* an HLS module — EasyHLS was a closed
+commercial SDK and no source ships (SURVEY §2.3) — so this is new code:
+live relay → fMP4 (CMAF) segments + m3u8 playlists, attached to a relay
+session as a ``RelayOutput`` sink (like the recorder) and served from the
+service port (``/hls/<path>/index.m3u8``).
+"""
+
+from .segmenter import HlsOutput, HlsService  # noqa: F401

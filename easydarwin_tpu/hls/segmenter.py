@@ -1,0 +1,250 @@
+"""Live fMP4 HLS segmenter.
+
+One ``HlsOutput`` per published stream: depacketizes the relayed H.264,
+cuts segments on IDR boundaries near the target duration, and keeps a
+sliding window of in-memory CMAF fragments:
+
+* init segment — ``ftyp`` + ``moov`` (with ``mvex/trex``: sample tables
+  live in the fragments),
+* media segments — ``styp`` + ``moof`` (mfhd/tfhd/tfdt/trun) + ``mdat``,
+* playlist — live sliding-window ``#EXT-X-MAP`` m3u8.
+
+The transcode ladder (ops.transform) will fan one ingest into N
+``HlsOutput``s at different rungs; this module is the mux/serve half.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..relay.output import RelayOutput, WriteResult
+from ..vod.depacketize import AccessUnit, H264Depacketizer
+from ..vod.mp4_writer import box, full_box
+
+VIDEO_CLOCK = 90000
+
+
+def _init_segment(sps: bytes, pps: bytes) -> bytes:
+    avcc = box(b"avcC",
+               bytes((1, sps[1] if len(sps) > 1 else 66,
+                      sps[2] if len(sps) > 2 else 0,
+                      sps[3] if len(sps) > 3 else 30, 0xFF, 0xE1)),
+               struct.pack(">H", len(sps)), sps, bytes((1,)),
+               struct.pack(">H", len(pps)), pps)
+    entry = struct.pack(">I4s", 86 + len(avcc), b"avc1") + bytes(6) + \
+        struct.pack(">H", 1) + bytes(16) + struct.pack(">HH", 0, 0) + \
+        struct.pack(">II", 0x00480000, 0x00480000) + bytes(4) + \
+        struct.pack(">H", 1) + bytes(32) + struct.pack(">Hh", 0x18, -1) + avcc
+    stsd = full_box(b"stsd", 0, 0, struct.pack(">I", 1), entry)
+    stbl = box(b"stbl", stsd,
+               full_box(b"stts", 0, 0, bytes(4)),
+               full_box(b"stsc", 0, 0, bytes(4)),
+               full_box(b"stsz", 0, 0, bytes(8)),
+               full_box(b"stco", 0, 0, bytes(4)))
+    url = full_box(b"url ", 0, 1)
+    dinf = box(b"dinf", full_box(b"dref", 0, 0, struct.pack(">I", 1), url))
+    minf = box(b"minf", full_box(b"vmhd", 0, 1, bytes(8)), dinf, stbl)
+    mdhd = full_box(b"mdhd", 0, 0,
+                    struct.pack(">IIII", 0, 0, VIDEO_CLOCK, 0),
+                    struct.pack(">HH", 0x55C4, 0))
+    hdlr = full_box(b"hdlr", 0, 0, bytes(4), b"vide", bytes(12),
+                    b"easydarwin-tpu\x00")
+    mdia = box(b"mdia", mdhd, hdlr, minf)
+    tkhd = full_box(b"tkhd", 0, 7, struct.pack(">IIIII", 0, 0, 1, 0, 0),
+                    bytes(8), struct.pack(">hhhH", 0, 0, 0, 0), bytes(2),
+                    struct.pack(">9I", 0x10000, 0, 0, 0, 0x10000, 0, 0, 0,
+                                0x40000000),
+                    struct.pack(">II", 0, 0))
+    trak = box(b"trak", tkhd, mdia)
+    trex = full_box(b"trex", 0, 0, struct.pack(">IIIII", 1, 1, 0, 0, 0))
+    mvex = box(b"mvex", trex)
+    mvhd = full_box(b"mvhd", 0, 0,
+                    struct.pack(">IIII", 0, 0, VIDEO_CLOCK, 0),
+                    struct.pack(">IH", 0x00010000, 0x0100), bytes(10),
+                    struct.pack(">9I", 0x10000, 0, 0, 0, 0x10000, 0, 0, 0,
+                                0x40000000), bytes(24),
+                    struct.pack(">I", 2))
+    return box(b"ftyp", b"iso6", struct.pack(">I", 0), b"iso6cmfc") + \
+        box(b"moov", mvhd, trak, mvex)
+
+
+def _media_segment(seq: int, base_dts: int,
+                   samples: list[tuple[bytes, int, bool]]) -> bytes:
+    """samples: [(avcc_data, duration, is_sync)]"""
+    mdat_payload = b"".join(s[0] for s in samples)
+    mfhd = full_box(b"mfhd", 0, 0, struct.pack(">I", seq))
+    # tfhd: default-base-is-moof | track id
+    tfhd = full_box(b"tfhd", 0, 0x020000, struct.pack(">I", 1))
+    tfdt = full_box(b"tfdt", 1, 0, struct.pack(">Q", base_dts))
+    # trun: data-offset | sample-duration | sample-size | sample-flags
+    flags = 0x000001 | 0x000100 | 0x000200 | 0x000400
+    rows = b""
+    for data, dur, sync in samples:
+        sflags = 0x02000000 if sync else 0x01010000
+        rows += struct.pack(">III", dur, len(data), sflags)
+    trun_len = 8 + 4 + 4 + 4 + 12 * len(samples)
+    moof_len = 8 + len(mfhd) + 8 + len(tfhd) + len(tfdt) + trun_len
+    data_offset = moof_len + 8
+    trun = full_box(b"trun", 0, flags,
+                    struct.pack(">Ii", len(samples), data_offset), rows)
+    traf = box(b"traf", tfhd, tfdt, trun)
+    moof = box(b"moof", mfhd, traf)
+    return box(b"styp", b"msdh", struct.pack(">I", 0), b"msdhmsix") + \
+        moof + box(b"mdat", mdat_payload)
+
+
+@dataclass
+class Segment:
+    seq: int
+    duration_sec: float
+    data: bytes
+
+
+class HlsOutput(RelayOutput):
+    """Relay sink producing a sliding window of CMAF segments."""
+
+    def __init__(self, *, target_duration: float = 2.0, window: int = 6):
+        super().__init__(ssrc=0x415)
+        self.target_duration = target_duration
+        self.window = window
+        self.depack = H264Depacketizer()
+        self.init_segment: bytes | None = None
+        self.segments: list[Segment] = []
+        self.media_seq = 0            # seq of segments[0]
+        self._pending: list[AccessUnit] = []
+        self._seg_start_ts: int | None = None
+        self._last_ts: int | None = None
+
+    def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
+        if is_rtcp:
+            return WriteResult.OK
+        self.depack.push(data)
+        for au in self.depack.pop_units():
+            self._on_unit(au)
+        return WriteResult.OK
+
+    def _on_unit(self, au: AccessUnit) -> None:
+        if self.init_segment is None:
+            if not (self.depack.sps and self.depack.pps and au.is_idr):
+                return
+            self.init_segment = _init_segment(self.depack.sps,
+                                              self.depack.pps)
+        if self._seg_start_ts is None:
+            if not au.is_idr:
+                return                    # segments must start on IDR
+            self._seg_start_ts = au.timestamp
+        elapsed = ((au.timestamp - self._seg_start_ts) & 0xFFFFFFFF) / VIDEO_CLOCK
+        if au.is_idr and self._pending and elapsed >= self.target_duration:
+            self._cut()
+            self._seg_start_ts = au.timestamp
+        self._pending.append(au)
+        self._last_ts = au.timestamp
+
+    def _cut(self) -> None:
+        if not self._pending:
+            return
+        base = self._pending[0].timestamp
+        samples = []
+        for i, au in enumerate(self._pending):
+            if i + 1 < len(self._pending):
+                dur = (self._pending[i + 1].timestamp - au.timestamp) \
+                    & 0xFFFFFFFF
+            else:
+                dur = VIDEO_CLOCK // 30
+            if not 0 < dur < VIDEO_CLOCK * 10:
+                dur = VIDEO_CLOCK // 30
+            samples.append((au.to_avcc(), dur, au.is_idr))
+        total = sum(d for _, d, _ in samples) / VIDEO_CLOCK
+        seq = self.media_seq + len(self.segments)
+        self.segments.append(Segment(seq, total,
+                                     _media_segment(seq, base, samples)))
+        self._pending = []
+        while len(self.segments) > self.window:
+            self.segments.pop(0)
+            self.media_seq += 1
+
+    # -- serving -----------------------------------------------------------
+    def playlist(self, base_url: str = "") -> str:
+        lines = ["#EXTM3U", "#EXT-X-VERSION:7",
+                 f"#EXT-X-TARGETDURATION:{int(self.target_duration + 1)}",
+                 f"#EXT-X-MEDIA-SEQUENCE:{self.media_seq}",
+                 f'#EXT-X-MAP:URI="{base_url}init.mp4"']
+        for s in self.segments:
+            lines.append(f"#EXTINF:{s.duration_sec:.3f},")
+            lines.append(f"{base_url}seg{s.seq}.m4s")
+        return "\n".join(lines) + "\n"
+
+    def get_segment(self, seq: int) -> bytes | None:
+        for s in self.segments:
+            if s.seq == seq:
+                return s.data
+        return None
+
+
+class HlsService:
+    """Manages HlsOutputs per live path + serves playlist/segments."""
+
+    def __init__(self, registry, *, target_duration: float = 2.0,
+                 window: int = 6):
+        self.registry = registry
+        self.target_duration = target_duration
+        self.window = window
+        self.outputs: dict[str, tuple[object, int, HlsOutput]] = {}
+
+    def start(self, path: str) -> HlsOutput:
+        from ..protocol.sdp import _norm
+        key = _norm(path)
+        if key in self.outputs:
+            return self.outputs[key][2]
+        sess = self.registry.find(key)
+        if sess is None:
+            raise KeyError(key)
+        vids = [tid for tid, st in sess.streams.items()
+                if st.info.media_type == "video"]
+        if not vids:
+            raise ValueError("no video track")
+        out = HlsOutput(target_duration=self.target_duration,
+                        window=self.window)
+        sess.add_output(vids[0], out)
+        self.outputs[key] = (sess, vids[0], out)
+        return out
+
+    def stop(self, path: str) -> None:
+        from ..protocol.sdp import _norm
+        key = _norm(path)
+        if key in self.outputs:
+            sess, tid, out = self.outputs.pop(key)
+            sess.remove_output(tid, out)
+
+    def serve(self, url_path: str) -> tuple[str, bytes | str] | None:
+        """Resolve /hls/<stream-path>/<file> → (content_type, body)."""
+        if not url_path.startswith("/hls/"):
+            return None
+        rest = url_path[5:]
+        if "/" not in rest:
+            return None
+        stream_path, fname = rest.rsplit("/", 1)
+        key = "/" + stream_path.strip("/")
+        entry = self.outputs.get(key)
+        if entry is None:
+            try:
+                self.start(key)
+            except (KeyError, ValueError):
+                return None
+            entry = self.outputs[key]
+        out = entry[2]
+        if fname in ("index.m3u8", "playlist.m3u8"):
+            return ("application/vnd.apple.mpegurl", out.playlist())
+        if fname == "init.mp4":
+            if out.init_segment is None:
+                return None
+            return ("video/mp4", out.init_segment)
+        if fname.startswith("seg") and fname.endswith(".m4s"):
+            try:
+                seq = int(fname[3:-4])
+            except ValueError:
+                return None
+            data = out.get_segment(seq)
+            return ("video/iso.segment", data) if data is not None else None
+        return None

@@ -55,7 +55,9 @@ class StreamingServer:
                                auth=self.auth, access_log=self.access_log)
         self.rest = RestApi(self.config, self)
         from ..vod.record import RecordingManager
+        from ..hls import HlsService
         self.recordings = RecordingManager()
+        self.hls = HlsService(self.registry)
         self._pump_event = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._running = False

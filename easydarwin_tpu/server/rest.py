@@ -63,11 +63,13 @@ class RestApi:
                 clen = int(headers.get("content-length", "0") or 0)
                 if clen:
                     body = await reader.readexactly(clen)
-                status, payload = await self.route(method, target, headers,
-                                                   body)
+                res = await self.route(method, target, headers, body)
+                status, payload = res[0], res[1]
+                ctype = res[2] if len(res) > 2 else None
                 data = payload.encode() if isinstance(payload, str) else payload
-                ctype = ("text/html" if data[:2] in (b"<!", b"<h")
-                         else "application/json")
+                if ctype is None:
+                    ctype = ("text/html" if data[:2] in (b"<!", b"<h")
+                             else "application/json")
                 writer.write(
                     f"HTTP/1.1 {status} {'OK' if status == 200 else 'Error'}\r\n"
                     f"Server: {SERVER_NAME}\r\n"
@@ -107,6 +109,12 @@ class RestApi:
         params = parse_qs(url.query)
         if path == "/stats":
             return 200, self._webstats_html()
+        if path.startswith("/hls/") and self.app.hls is not None:
+            served = self.app.hls.serve(url.path)
+            if served is None:
+                return 404, json.dumps({"error": "not found"})
+            ctype, data = served
+            return 200, data, ctype
         if not path.startswith("/api/v1/"):
             return 404, json.dumps({"error": "not found"})
         cmd = path[len("/api/v1/"):]

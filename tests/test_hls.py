@@ -1,0 +1,155 @@
+"""HLS: segment cutting, playlist, CMAF box structure, HTTP serving."""
+
+import asyncio
+import io
+import struct
+
+import pytest
+
+from easydarwin_tpu.hls.segmenter import HlsOutput
+from easydarwin_tpu.protocol import nalu
+from easydarwin_tpu.vod.mp4 import _scan
+
+SPS = bytes((0x67, 0x42, 0x00, 0x1F)) + bytes(range(8))
+PPS = bytes((0x68, 0xCE, 0x3C, 0x80, 1, 2, 3, 4))
+
+
+def feed_stream(out: HlsOutput, *, n_gops=4, gop_len=10, fps=30, seq0=0):
+    """Push n_gops GOPs of 1-packet frames at fps."""
+    seq = seq0
+    frame = 0
+    for g in range(n_gops):
+        for i in range(gop_len):
+            idr = i == 0
+            ts = int(frame * 90000 / fps)
+            pkts = []
+            if idr:
+                for cfg in (SPS, PPS):
+                    pkts += nalu.packetize_h264(cfg, seq=seq, timestamp=ts,
+                                                ssrc=1, marker_on_last=False)
+                    seq += 1
+            nal = bytes((0x65 if idr else 0x41,)) + bytes((frame,)) * 300
+            pkts += nalu.packetize_h264(nal, seq=seq, timestamp=ts, ssrc=1)
+            seq += 1
+            for p in pkts:
+                out.send_bytes(p, is_rtcp=False)
+            frame += 1
+    return frame
+
+
+def boxes_of(data: bytes):
+    return [b.kind for b in _scan(io.BufferedReader(io.BytesIO(data)),
+                                  0, len(data))]
+
+
+def test_segments_cut_on_idr_near_target():
+    out = HlsOutput(target_duration=0.3, window=10)
+    # 10-frame GOPs @30fps = 0.333s per GOP → one segment per GOP
+    feed_stream(out, n_gops=4, gop_len=10)
+    assert out.init_segment is not None
+    assert len(out.segments) == 3              # 4th GOP still pending
+    for s in out.segments:
+        assert 0.2 < s.duration_sec < 0.5
+
+
+def test_init_and_media_segment_structure():
+    out = HlsOutput(target_duration=0.3)
+    feed_stream(out, n_gops=3, gop_len=10)
+    kinds = boxes_of(out.init_segment)
+    assert kinds == [b"ftyp", b"moov"]
+    seg = out.segments[0]
+    kinds = boxes_of(seg.data)
+    assert kinds == [b"styp", b"moof", b"mdat"]
+    # trun sample count == frames per segment (10)
+    moof_off = seg.data.find(b"moof") - 4
+    trun_off = seg.data.find(b"trun") - 4
+    n_samples = struct.unpack_from(">I", seg.data, trun_off + 12)[0]
+    assert n_samples == 10
+    # first sample flagged sync (IDR)
+    first_flags = struct.unpack_from(">I", seg.data, trun_off + 20 + 8)[0]
+    assert first_flags == 0x02000000
+
+
+def test_sliding_window_and_media_sequence():
+    out = HlsOutput(target_duration=0.3, window=3)
+    feed_stream(out, n_gops=8, gop_len=10)
+    assert len(out.segments) == 3
+    assert out.media_seq == 4                  # 7 cut, window keeps 4,5,6
+    pl = out.playlist("x/")
+    assert "#EXT-X-MEDIA-SEQUENCE:4" in pl
+    assert "x/seg4.m4s" in pl and "x/seg6.m4s" in pl
+    assert "seg0.m4s" not in pl
+    assert '#EXT-X-MAP:URI="x/init.mp4"' in pl
+    assert out.get_segment(3) is None          # rolled out
+    assert out.get_segment(5) is not None
+
+
+@pytest.mark.asyncio
+async def test_hls_http_serving_e2e(tmp_path):
+    from easydarwin_tpu.protocol import rtp
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, log_folder=str(tmp_path))
+    app = StreamingServer(cfg)
+    app.hls.target_duration = 0.2
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/hlscam"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(
+            uri, "v=0\r\nm=video 0 RTP/AVP 96\r\n"
+                 "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+
+        # request the playlist first: auto-attaches the HLS output
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       app.rest.port)
+
+        async def get(path):
+            writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            head = await reader.readuntil(b"\r\n\r\n")
+            clen = int([l for l in head.split(b"\r\n")
+                        if l.lower().startswith(b"content-length")][0]
+                       .split(b":")[1])
+            ctype = [l for l in head.split(b"\r\n")
+                     if l.lower().startswith(b"content-type")][0]
+            return (int(head.split(b" ")[1]), ctype.decode(),
+                    await reader.readexactly(clen))
+
+        st, ct, body = await get("/hls/live/hlscam/index.m3u8")
+        assert st == 200 and "mpegurl" in ct
+        # now push media so segments accumulate
+        seq = 0
+        for gop in range(3):
+            for i in range(8):
+                ts = (gop * 8 + i) * 3000
+                if i == 0:
+                    for cfg in (SPS, PPS):
+                        pusher.push_packet(0, rtp.RtpPacket(
+                            payload_type=96, seq=seq, timestamp=ts, ssrc=1,
+                            payload=cfg).to_bytes())
+                        seq += 1
+                nal = bytes((0x65 if i == 0 else 0x41,)) + bytes(200)
+                pusher.push_packet(0, rtp.RtpPacket(
+                    payload_type=96, seq=seq, timestamp=ts, ssrc=1,
+                    marker=True, payload=nal).to_bytes())
+                seq += 1
+        await asyncio.sleep(0.1)
+        st, ct, body = await get("/hls/live/hlscam/index.m3u8")
+        assert st == 200
+        text = body.decode()
+        assert "#EXTINF" in text and "seg0.m4s" in text
+        st, ct, body = await get("/hls/live/hlscam/init.mp4")
+        assert st == 200 and ct.endswith("video/mp4") and body[4:8] == b"ftyp"
+        st, ct, body = await get("/hls/live/hlscam/seg0.m4s")
+        assert st == 200 and b"moof" in body[:100]
+        st, ct, body = await get("/hls/live/hlscam/seg99.m4s")
+        assert st == 404
+        st, ct, body = await get("/hls/nonexistent/x/index.m3u8")
+        assert st == 404
+        writer.close()
+        await pusher.close()
+    finally:
+        await app.stop()
