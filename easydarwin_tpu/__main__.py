@@ -26,6 +26,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable the TPU batch fan-out engine")
     p.add_argument("-x", "--exit-after-boot", action="store_true",
                    help="boot, print status, exit (config check)")
+    p.add_argument("-w", "--watchdog", action="store_true",
+                   help="run under the auto-restart supervisor")
     return p
 
 
@@ -56,14 +58,26 @@ async def amain(cfg: ServerConfig, exit_after_boot: bool = False) -> int:
         loop.add_signal_handler(sig, stop.set)
     loop.add_signal_handler(signal.SIGHUP,
                             lambda: cfg.update())   # RereadPrefs rebroadcast
-    await stop.wait()
-    print("shutting down...", flush=True)
+    done, _ = await asyncio.wait(
+        [asyncio.create_task(stop.wait()),
+         asyncio.create_task(app.restart_event.wait())],
+        return_when=asyncio.FIRST_COMPLETED)
+    restarting = app.restart_event.is_set() and not stop.is_set()
+    print("restarting..." if restarting else "shutting down...", flush=True)
     await app.stop()
-    return 0
+    from .server.supervisor import EXIT_RESTART
+    return EXIT_RESTART if restarting else 0
 
 
 def main(argv=None) -> int:
+    import sys
     args = build_parser().parse_args(argv)
+    if args.watchdog:
+        from .server.supervisor import run_supervised
+        child = [sys.executable, "-m", "easydarwin_tpu"] + [
+            a for a in (sys.argv[1:] if argv is None else argv)
+            if a not in ("-w", "--watchdog")]
+        return run_supervised(child)
     cfg = config_from_args(args)
     try:
         return asyncio.run(amain(cfg, args.exit_after_boot))

@@ -62,6 +62,7 @@ class StreamingServer:
         self._tasks: list[asyncio.Task] = []
         self._running = False
         self._restart_requested = False
+        self.restart_event = asyncio.Event()
         self._engines: dict[int, TpuFanoutEngine] = {}
         self.started_at = time.time()
         self.presence = None
@@ -69,10 +70,19 @@ class StreamingServer:
         self.config.on_change(self._on_config_change)
 
     # ------------------------------------------------------------- control
+    @property
+    def modules(self):
+        return self.rtsp.modules
+
+    def register_module(self, module) -> None:
+        """QTSS_Register + AddModule equivalent."""
+        self.rtsp.modules.register(module)
+
     async def start(self) -> None:
         self._running = True
         await self.rtsp.start()
         await self.rest.start()
+        self.rtsp.modules.run_initialize(self)
         self._tasks = [
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
@@ -93,6 +103,7 @@ class StreamingServer:
 
     async def stop(self) -> None:
         self._running = False
+        self.rtsp.modules.run_shutdown(self)
         if self.presence is not None:
             await self.presence.stop()
             self.presence = None
@@ -107,12 +118,14 @@ class StreamingServer:
         await self.rest.stop()
 
     def request_restart(self) -> None:
-        """REST /restart — the fork-watchdog restart analog
-        (``main.cpp:492-558``): supervisors watch this flag."""
+        """REST /restart: under the supervisor (server.supervisor) the main
+        loop exits with EXIT_RESTART and the watchdog relaunches."""
         self._restart_requested = True
+        self.restart_event.set()
 
     def _on_config_change(self, cfg: ServerConfig) -> None:
         self.registry.settings = cfg.stream_settings()
+        self.rtsp.modules.run_reread_prefs(cfg)
 
     def _wake(self) -> None:
         self._pump_event.set()

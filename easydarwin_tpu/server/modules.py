@@ -1,0 +1,122 @@
+"""Module/role system — the QTSS plugin architecture, re-designed.
+
+Reference parity: ``APIStubLib/QTSS.h`` roles + ``QTSSModule`` registration
++ ``QTSServer::BuildModuleRoleArrays`` dispatch (``QTSServer.cpp:285``).
+The reference's reflective attribute dictionaries (``QTSSDictionary``) exist
+to let C plugins poke server state without headers; in Python the natural
+equivalent is plain objects + typed hook points, so the role pipeline is
+kept and the dictionary indirection is dropped.
+
+Roles (named after their QTSS counterparts):
+
+* ``initialize(server)`` / ``shutdown(server)``
+* ``reread_prefs(config)``                    — QTSS_RereadPrefs_Role
+* ``rtsp_filter(conn, req) -> RtspResponse|None``   — QTSS_RTSPFilter_Role:
+  may answer the request outright (used by web-stats-style modules)
+* ``rtsp_route(conn, req) -> None``           — QTSS_RTSPRoute_Role
+* ``authorize(conn, req) -> bool|None``       — QTSS_RTSPAuthorize_Role:
+  False forbids, True allows, None = no opinion
+* ``rtsp_postprocess(conn, req, resp)``       — QTSS_RTSPPostProcessor_Role
+* ``session_closing(conn)``                   — QTSS_ClientSessionClosing_Role
+* ``incoming_rtp(session, track_id, packet)`` — QTSS_RTSPIncomingData_Role
+
+Modules are registered in priority order; filter/authorize short-circuit
+like the reference's role arrays.
+"""
+
+from __future__ import annotations
+
+from ..protocol import rtsp
+
+
+class Module:
+    """Subclass and override the roles you register for."""
+
+    name = "module"
+
+    def initialize(self, server) -> None:
+        pass
+
+    def shutdown(self, server) -> None:
+        pass
+
+    def reread_prefs(self, config) -> None:
+        pass
+
+    def rtsp_filter(self, conn, req: rtsp.RtspRequest):
+        return None
+
+    def rtsp_route(self, conn, req: rtsp.RtspRequest) -> None:
+        return None
+
+    def authorize(self, conn, req: rtsp.RtspRequest):
+        return None
+
+    def rtsp_postprocess(self, conn, req: rtsp.RtspRequest,
+                         resp: rtsp.RtspResponse) -> None:
+        return None
+
+    def session_closing(self, conn) -> None:
+        return None
+
+    def incoming_rtp(self, session, track_id: int, packet: bytes) -> None:
+        return None
+
+
+class ModuleRegistry:
+    def __init__(self):
+        self.modules: list[Module] = []
+
+    def register(self, module: Module) -> None:
+        self.modules.append(module)
+
+    def unregister(self, module: Module) -> None:
+        if module in self.modules:
+            self.modules.remove(module)
+
+    # -- dispatch (role arrays) -------------------------------------------
+    def run_initialize(self, server) -> None:
+        for m in self.modules:
+            m.initialize(server)
+
+    def run_shutdown(self, server) -> None:
+        for m in self.modules:
+            m.shutdown(server)
+
+    def run_reread_prefs(self, config) -> None:
+        for m in self.modules:
+            m.reread_prefs(config)
+
+    def run_filter(self, conn, req):
+        """First module answering wins (QTSSModule kRTSPFilter semantics)."""
+        for m in self.modules:
+            resp = m.rtsp_filter(conn, req)
+            if resp is not None:
+                return resp
+        return None
+
+    def run_route(self, conn, req) -> None:
+        for m in self.modules:
+            m.rtsp_route(conn, req)
+
+    def run_authorize(self, conn, req) -> bool:
+        """False if any module forbids (all abstaining → allowed)."""
+        for m in self.modules:
+            v = m.authorize(conn, req)
+            if v is False:
+                return False
+            if v is True:
+                return True
+        return True
+
+    def run_postprocess(self, conn, req, resp) -> None:
+        for m in self.modules:
+            m.rtsp_postprocess(conn, req, resp)
+
+    def run_session_closing(self, conn) -> None:
+        for m in self.modules:
+            m.session_closing(conn)
+
+    def run_incoming_rtp(self, session, track_id, packet) -> None:
+        for m in self.modules:
+            m.incoming_rtp(session, track_id, packet)

@@ -113,6 +113,7 @@ class RtspConnection:
         resp.headers.setdefault("Server", SERVER_NAME)
         if self.session_id:
             resp.headers.setdefault("Session", self.session_id)
+        self._last_response = resp
         self.writer.write(resp.to_bytes())
 
     # ----------------------------------------------------------- dispatch
@@ -126,6 +127,13 @@ class RtspConnection:
             self.user_agent = ua
         if req.uri != "*":
             self.uri = req.uri
+        mods = self.server.modules
+        # Filter role: a module may answer the request outright
+        filtered = mods.run_filter(self, req)
+        if filtered is not None:
+            self._reply(filtered, req.cseq)
+            return
+        mods.run_route(self, req)
         auth = self.server.auth
         if (auth is not None
                 and req.method in ("DESCRIBE", "SETUP", "ANNOUNCE", "PLAY",
@@ -137,10 +145,16 @@ class RtspConnection:
                     "WWW-Authenticate": auth.challenge()}), req.cseq)
                 return
             self.auth_user = user
+        if not mods.run_authorize(self, req):
+            self._reply(rtsp.RtspResponse(403), req.cseq)
+            return
+        self._last_response = None
         try:
             await handler(req)
         except rtsp.RtspError as e:
             self._reply(rtsp.RtspResponse(e.status), req.cseq)
+        if self._last_response is not None:
+            mods.run_postprocess(self, req, self._last_response)
 
     async def _do_options(self, req: rtsp.RtspRequest) -> None:
         self._reply(rtsp.RtspResponse(200, {"Public": ALLOWED}), req.cseq)
@@ -344,6 +358,9 @@ class RtspConnection:
         m = self.channel_map.get(pkt.channel)
         if m is not None and self.relay is not None:
             track_id, is_rtcp = m
+            if not is_rtcp:
+                self.server.modules.run_incoming_rtp(self.relay, track_id,
+                                                     pkt.data)
             self.relay.push(track_id, pkt.data, is_rtcp=is_rtcp)
             self.server.stats["packets_in"] += 1
             self.server.wake_pump()
@@ -370,6 +387,7 @@ class RtspConnection:
         if self.closed:
             return
         self.closed = True
+        self.server.modules.run_session_closing(self)
         self.server.on_session_closed(self)
         if self.vod_session is not None:
             self.vod_session.stop()
@@ -407,6 +425,8 @@ class RtspServer:
         self.vod = vod                       # VodService or None
         self.auth = auth                     # AuthService or None
         self.access_log = access_log         # AccessLog or None
+        from .modules import ModuleRegistry
+        self.modules = ModuleRegistry()
         self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
         self.connections: set[RtspConnection] = set()
         self.stats = {"requests": 0, "pushers": 0, "players": 0,

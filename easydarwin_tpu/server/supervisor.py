@@ -1,0 +1,48 @@
+"""Process watchdog — the fork-based auto-restart loop.
+
+Reference parity: ``main.cpp:492-558`` — the parent forks the server child,
+waits, and restarts it on crash or on the deliberate restart exit code
+(exit −2 → restart, ``RunServer.cpp:711-717``), honoring the
+``auto_restart`` pref and rate-limiting runaway crash loops.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+#: child exit code meaning "restart me" (REST /restart, SIGHUP-style)
+EXIT_RESTART = 2
+#: give up if the child dies this many times within WINDOW_SEC
+MAX_CRASHES = 5
+WINDOW_SEC = 60.0
+
+
+def run_supervised(child_argv: list[str], *, auto_restart: bool = True,
+                   spawn=None, sleep=time.sleep,
+                   log=lambda m: print(m, file=sys.stderr, flush=True)) -> int:
+    """Run the child command under supervision; returns the final exit code.
+
+    ``spawn``/``sleep``/``log`` are injectable for tests.
+    """
+    spawn = spawn or (lambda argv: subprocess.call(argv))
+    crashes: list[float] = []
+    while True:
+        code = spawn(child_argv)
+        if code == 0:
+            return 0
+        if code == EXIT_RESTART:
+            log("supervisor: restart requested, relaunching")
+            continue
+        if not auto_restart:
+            return code
+        now = time.monotonic()
+        crashes = [t for t in crashes if now - t < WINDOW_SEC] + [now]
+        if len(crashes) >= MAX_CRASHES:
+            log(f"supervisor: {len(crashes)} crashes in {WINDOW_SEC:.0f}s, "
+                "giving up")
+            return code
+        delay = min(2.0 ** len(crashes), 15.0)
+        log(f"supervisor: child exited {code}, restarting in {delay:.0f}s")
+        sleep(delay)

@@ -1,0 +1,133 @@
+"""Module/role pipeline + supervisor watchdog."""
+
+import asyncio
+
+import pytest
+
+from easydarwin_tpu.protocol import rtsp
+from easydarwin_tpu.server.modules import Module, ModuleRegistry
+from easydarwin_tpu.server.supervisor import (EXIT_RESTART, MAX_CRASHES,
+                                              run_supervised)
+
+
+class Probe(Module):
+    name = "probe"
+
+    def __init__(self, **behavior):
+        self.calls = []
+        self.behavior = behavior
+
+    def initialize(self, server):
+        self.calls.append("initialize")
+
+    def shutdown(self, server):
+        self.calls.append("shutdown")
+
+    def reread_prefs(self, config):
+        self.calls.append("reread")
+
+    def rtsp_filter(self, conn, req):
+        self.calls.append(f"filter:{req.method}")
+        return self.behavior.get("filter_response")
+
+    def rtsp_route(self, conn, req):
+        self.calls.append("route")
+
+    def authorize(self, conn, req):
+        self.calls.append("authorize")
+        return self.behavior.get("authorize")
+
+    def rtsp_postprocess(self, conn, req, resp):
+        self.calls.append(f"post:{resp.status}")
+
+    def session_closing(self, conn):
+        self.calls.append("closing")
+
+
+def test_registry_filter_short_circuits():
+    reg = ModuleRegistry()
+    a = Probe(filter_response=rtsp.RtspResponse(200, {"X-From": "a"}))
+    b = Probe()
+    reg.register(a)
+    reg.register(b)
+    req = rtsp.RtspRequest("OPTIONS", "*", {"cseq": "1"})
+    resp = reg.run_filter(None, req)
+    assert resp.headers["X-From"] == "a"
+    assert b.calls == []                     # never reached
+
+
+def test_registry_authorize_semantics():
+    reg = ModuleRegistry()
+    reg.register(Probe())                    # abstains
+    assert reg.run_authorize(None, None) is True
+    deny = Probe(authorize=False)
+    reg.register(deny)
+    assert reg.run_authorize(None, None) is False
+    # an explicit allow earlier in the chain wins (reference ordering)
+    reg2 = ModuleRegistry()
+    reg2.register(Probe(authorize=True))
+    reg2.register(Probe(authorize=False))
+    assert reg2.run_authorize(None, None) is True
+
+
+@pytest.mark.asyncio
+async def test_module_pipeline_in_server(tmp_path):
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0,
+                                       bind_ip="127.0.0.1",
+                                       log_folder=str(tmp_path)))
+    probe = Probe()
+    app.register_module(probe)
+    await app.start()
+    try:
+        assert "initialize" in probe.calls
+        c = RtspClient()
+        await c.connect("127.0.0.1", app.rtsp.port)
+        r = await c.request("OPTIONS", "*")
+        assert r.status == 200
+        assert "filter:OPTIONS" in probe.calls
+        assert "route" in probe.calls
+        assert "post:200" in probe.calls
+        await c.close()
+        await asyncio.sleep(0.05)
+        assert "closing" in probe.calls
+        app.config.update(bucket_delay_ms=50)
+        assert "reread" in probe.calls
+    finally:
+        await app.stop()
+    assert "shutdown" in probe.calls
+
+
+def test_supervisor_restart_code_then_clean_exit():
+    codes = [EXIT_RESTART, EXIT_RESTART, 0]
+    spawned = []
+
+    def spawn(argv):
+        spawned.append(list(argv))
+        return codes.pop(0)
+
+    rc = run_supervised(["child"], spawn=spawn, sleep=lambda s: None,
+                        log=lambda m: None)
+    assert rc == 0 and len(spawned) == 3
+
+
+def test_supervisor_crash_loop_gives_up():
+    n = [0]
+
+    def spawn(argv):
+        n[0] += 1
+        return 1
+
+    rc = run_supervised(["child"], spawn=spawn, sleep=lambda s: None,
+                        log=lambda m: None)
+    assert rc == 1
+    assert n[0] == MAX_CRASHES
+
+
+def test_supervisor_no_auto_restart():
+    rc = run_supervised(["child"], auto_restart=False,
+                        spawn=lambda a: 7, sleep=lambda s: None,
+                        log=lambda m: None)
+    assert rc == 7
