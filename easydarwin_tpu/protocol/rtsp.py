@@ -22,6 +22,11 @@ METHODS = (
     "RECORD", "GET_PARAMETER", "SET_PARAMETER", "REDIRECT",
 )
 
+#: HTTP verbs accepted on the RTSP port for RTSP-over-HTTP tunneling and
+#: icy/HTTP side-channels (RTSPSession's HTTP-tunnel states,
+#: ``RTSPSession.cpp:1339-1459``)
+HTTP_METHODS = ("GET", "POST")
+
 #: status code → reason phrase (subset of RTSPProtocol.cpp's table)
 STATUS_PHRASES = {
     100: "Continue", 200: "OK", 201: "Created", 250: "Low on Storage Space",
@@ -305,7 +310,7 @@ class RtspWireReader:
         if len(parts) != 3:
             raise RtspError(400, f"bad request line {first!r}")
         method, uri, version = parts
-        if method not in METHODS:
+        if method not in METHODS and method not in HTTP_METHODS:
             raise RtspError(501, f"unknown method {method!r}")
         return RtspRequest(method=method, uri=uri, headers=headers, body=body,
                            version=version)

@@ -56,8 +56,11 @@ class StreamingServer:
         self.rest = RestApi(self.config, self)
         from ..vod.record import RecordingManager
         from ..hls import HlsService
+        from .mp3 import Mp3Service
         self.recordings = RecordingManager()
         self.hls = HlsService(self.registry)
+        self.mp3 = Mp3Service(self.config.movie_folder)
+        self.rtsp.http_get_handler = self._rtsp_port_http_get
         self._pump_event = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._running = False
@@ -179,6 +182,22 @@ class StreamingServer:
         while self._running:
             await asyncio.sleep(self.config.timeout_sweep_sec)
             self.rtsp.sweep_timeouts()
+
+    async def _rtsp_port_http_get(self, conn, target: str,
+                                  headers: dict) -> bool:
+        """Plain HTTP GET on the RTSP port: icy MP3 streams + stats page."""
+        path = target.split("?")[0]
+        if path.lower().endswith(".mp3"):
+            await self.mp3.stream(conn.writer, path, headers)
+            return True
+        if path in ("/", "/stats"):
+            html = self.rest._webstats_html().encode()
+            conn.writer.write(
+                b"HTTP/1.0 200 OK\r\nContent-Type: text/html\r\n"
+                b"Content-Length: " + str(len(html)).encode() + b"\r\n\r\n"
+                + html)
+            return True
+        return False
 
     # ------------------------------------------------------------- queries
     def server_info(self) -> dict:

@@ -1,0 +1,106 @@
+"""MP3 / Shoutcast-style icy HTTP streaming.
+
+Reference parity: ``QTSSMP3StreamingModule.cpp`` (2.9K LoC): HTTP GET of an
+.mp3 path on the RTSP port answers an icy (Shoutcast) stream — paced at the
+file's bitrate, with ``icy-metaint`` StreamTitle metadata blocks when the
+client sent ``Icy-MetaData: 1``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+#: MPEG1 Layer III bitrate table (kbps), index 1..14
+_BITRATES = (0, 32, 40, 48, 56, 64, 80, 96, 112, 128, 160, 192, 224, 256,
+             320, 0)
+_SAMPLE_RATES = (44100, 48000, 32000, 0)
+
+META_INT = 8192
+
+
+def parse_mp3_bitrate(data: bytes) -> int:
+    """Find the first MPEG1-L3 frame header; returns kbps (default 128)."""
+    for i in range(len(data) - 4):
+        b0, b1, b2 = data[i], data[i + 1], data[i + 2]
+        if b0 == 0xFF and (b1 & 0xE0) == 0xE0:
+            version = (b1 >> 3) & 0x03
+            layer = (b1 >> 1) & 0x03
+            if version == 3 and layer == 1:          # MPEG1 Layer III
+                bi = (b2 >> 4) & 0x0F
+                sr = _SAMPLE_RATES[(b2 >> 2) & 0x03]
+                if 0 < bi < 15 and sr:
+                    return _BITRATES[bi]
+    return 128
+
+
+class Mp3Service:
+    def __init__(self, movie_folder: str):
+        self.movie_folder = movie_folder
+        self.streams_served = 0
+
+    def resolve(self, path: str) -> str | None:
+        if not path.lower().endswith(".mp3"):
+            return None
+        cand = os.path.normpath(
+            os.path.join(self.movie_folder, path.lstrip("/")))
+        root = os.path.normpath(self.movie_folder)
+        if not cand.startswith(root) or not os.path.isfile(cand):
+            return None
+        return cand
+
+    async def stream(self, writer: asyncio.StreamWriter, path: str,
+                     headers: dict, *, loop: bool = False,
+                     pace: bool = True) -> None:
+        """Write the icy response + paced MP3 bytes until EOF/disconnect."""
+        fp = self.resolve(path)
+        if fp is None:
+            writer.write(b"HTTP/1.0 404 Not Found\r\n\r\n")
+            return
+        want_meta = headers.get("icy-metadata", "0").strip() == "1"
+        title = os.path.splitext(os.path.basename(fp))[0]
+        head = ["ICY 200 OK", "icy-name: easydarwin-tpu",
+                "Content-Type: audio/mpeg", "icy-pub: 0"]
+        if want_meta:
+            head.append(f"icy-metaint:{META_INT}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        self.streams_served += 1
+
+        with open(fp, "rb") as f:
+            first = f.read(4096)
+            kbps = parse_mp3_bitrate(first)
+            f.seek(0)
+            bytes_per_sec = kbps * 1000 // 8
+            meta = _meta_block(title) if want_meta else b""
+            sent_since_meta = 0
+            while True:
+                chunk = f.read(4096)
+                if not chunk:
+                    if loop:
+                        f.seek(0)
+                        continue
+                    break
+                if want_meta:
+                    out = bytearray()
+                    for b in chunk:
+                        out.append(b)
+                        sent_since_meta += 1
+                        if sent_since_meta == META_INT:
+                            out += meta
+                            sent_since_meta = 0
+                    writer.write(bytes(out))
+                else:
+                    writer.write(chunk)
+                try:
+                    await writer.drain()
+                except ConnectionError:
+                    return
+                if pace:
+                    await asyncio.sleep(len(chunk) / bytes_per_sec)
+
+
+def _meta_block(title: str) -> bytes:
+    text = f"StreamTitle='{title}';".encode()
+    pad = (-len(text)) % 16
+    blocks = (len(text) + pad) // 16
+    return bytes((blocks,)) + text + b"\x00" * pad

@@ -88,25 +88,113 @@ class RtspConnection:
     # ------------------------------------------------------------------ io
     async def run(self) -> None:
         try:
+            first = await self.reader.read(16384)
+            if not first:
+                await self.close()
+                return
+            if first.startswith(b"GET ") or first.startswith(b"POST"):
+                # HTTP on the RTSP port: RTSP-over-HTTP tunnel, icy MP3, or
+                # the stats page (RTSPSession.cpp:1339-1459 tunnel states;
+                # MP3StreamingModule; WebStatsModule RTSP-port GET)
+                await self._run_http(first)
+                return
+            self._feed(first)
+            await self._drain_events()
             while not self.closed:
                 data = await self.reader.read(16384)
                 if not data:
                     break
-                self.last_activity = time.monotonic()
-                self.wire.feed(data)
-                try:
-                    for ev in self.wire.events():
-                        if isinstance(ev, rtsp.InterleavedPacket):
-                            self._on_interleaved(ev)
-                        else:
-                            await self._dispatch(ev)
-                except rtsp.RtspError as e:
-                    self._reply(rtsp.RtspResponse(e.status), cseq=0)
-                    break
+                self._feed(data)
+                await self._drain_events()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
+        except rtsp.RtspError as e:
+            self._reply(rtsp.RtspResponse(e.status), cseq=0)
         finally:
             await self.close()
+
+    def _feed(self, data: bytes) -> None:
+        self.last_activity = time.monotonic()
+        self.wire.feed(data)
+
+    async def _drain_events(self) -> None:
+        for ev in self.wire.events():
+            if isinstance(ev, rtsp.InterleavedPacket):
+                self._on_interleaved(ev)
+            else:
+                await self._dispatch(ev)
+
+    # ------------------------------------------------ HTTP on the RTSP port
+    async def _run_http(self, first: bytes) -> None:
+        buf = bytearray(first)
+        while b"\r\n\r\n" not in buf:
+            data = await self.reader.read(16384)
+            if not data:
+                return
+            buf += data
+        head_end = buf.index(b"\r\n\r\n")
+        lines = bytes(buf[:head_end]).decode("latin-1").split("\r\n")
+        rest = bytes(buf[head_end + 4:])
+        try:
+            method, target, _ver = lines[0].split(None, 2)
+        except ValueError:
+            return
+        headers = {}
+        for ln in lines[1:]:
+            k, sep, v = ln.partition(":")
+            if sep:
+                headers[k.strip().lower()] = v.strip()
+        cookie = headers.get("x-sessioncookie")
+        if method == "GET" and cookie:
+            await self._tunnel_get(cookie)
+        elif method == "POST" and cookie:
+            await self._tunnel_post(cookie, rest)
+        elif method == "GET":
+            await self.server.handle_http_get(self, target, headers)
+
+    async def _tunnel_get(self, cookie: str) -> None:
+        """The data half of an RTSP-over-HTTP tunnel: hold the connection,
+        answer the tunnel preamble; all RTSP replies/media flow here."""
+        self.writer.write(
+            b"HTTP/1.0 200 OK\r\nServer: " + SERVER_NAME.encode() +
+            b"\r\nConnection: close\r\nCache-Control: no-store\r\n"
+            b"Pragma: no-cache\r\n"
+            b"Content-Type: application/x-rtsp-tunnelled\r\n\r\n")
+        self.server.tunnels[cookie] = self
+        try:
+            while not self.closed:        # hold open; client sends nothing
+                data = await self.reader.read(4096)
+                if not data:
+                    break
+        finally:
+            self.server.tunnels.pop(cookie, None)
+
+    async def _tunnel_post(self, cookie: str, initial: bytes) -> None:
+        """The command half: base64-encoded RTSP arrives here; decode and
+        execute against the GET-side connection (replies go to its writer)."""
+        import base64
+        target = self.server.tunnels.get(cookie)
+        if target is None:
+            self.writer.write(b"HTTP/1.0 404 Not Found\r\n\r\n")
+            return
+        b64 = bytearray()
+
+        async def feed(raw: bytes) -> None:
+            b64.extend(c for c in raw if c not in b" \r\n\t")
+            n = len(b64) // 4 * 4
+            if n:
+                decoded = base64.b64decode(bytes(b64[:n]))
+                del b64[:n]
+                target.wire.feed(decoded)
+                await target._drain_events()
+
+        await feed(initial)
+        while not self.closed and not target.closed:
+            data = await self.reader.read(16384)
+            if not data:
+                break
+            self.last_activity = time.monotonic()
+            await feed(data)
 
     def _reply(self, resp: rtsp.RtspResponse, cseq: int | None = None) -> None:
         resp.headers.setdefault("CSeq", str(cseq) if cseq is not None else "0")
@@ -427,6 +515,10 @@ class RtspServer:
         self.access_log = access_log         # AccessLog or None
         from .modules import ModuleRegistry
         self.modules = ModuleRegistry()
+        #: RTSP-over-HTTP tunnels: x-sessioncookie → GET-side connection
+        self.tunnels: dict[str, RtspConnection] = {}
+        #: hook for plain HTTP GET on the RTSP port (mp3/stats); set by app
+        self.http_get_handler = None
         self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
         self.connections: set[RtspConnection] = set()
         self.stats = {"requests": 0, "pushers": 0, "players": 0,
@@ -468,6 +560,14 @@ class RtspServer:
 
     async def open_for_play(self, path: str) -> RelaySession | None:
         return self.registry.find(path)
+
+    async def handle_http_get(self, conn: RtspConnection, target: str,
+                              headers: dict) -> None:
+        if self.http_get_handler is not None:
+            handled = await self.http_get_handler(conn, target, headers)
+            if handled:
+                return
+        conn.writer.write(b"HTTP/1.0 404 Not Found\r\n\r\n")
 
     def on_session_closed(self, conn: RtspConnection) -> None:
         """ClientSessionClosing → access-log record (AccessLogModule role)."""

@@ -1,0 +1,115 @@
+"""RTSP-over-HTTP tunneling + icy MP3 streaming + RTSP-port stats page."""
+
+import asyncio
+import base64
+
+import pytest
+
+from easydarwin_tpu.server.mp3 import parse_mp3_bitrate, _meta_block
+
+
+def mp3_frame(bitrate_idx=9, n=100):
+    """Fake MPEG1-L3 CBR frames: 0xFF 0xFB header (v1, L3, 44.1 kHz)."""
+    hdr = bytes((0xFF, 0xFB, (bitrate_idx << 4) | 0x00, 0x00))
+    frame = hdr + bytes(413 - 4)          # 128 kbps @44.1k → 417B frames
+    return frame * n
+
+
+def test_parse_mp3_bitrate():
+    assert parse_mp3_bitrate(mp3_frame(9)) == 128
+    assert parse_mp3_bitrate(mp3_frame(14)) == 320
+    assert parse_mp3_bitrate(b"\x00" * 100) == 128   # fallback
+
+
+def test_meta_block_padding():
+    b = _meta_block("song")
+    assert b[0] == len(b[1:]) // 16
+    assert b[1:].startswith(b"StreamTitle='song';")
+    assert len(b[1:]) % 16 == 0
+
+
+@pytest.mark.asyncio
+async def test_icy_stream_over_rtsp_port(tmp_path):
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+
+    (tmp_path / "song.mp3").write_bytes(mp3_frame(9, n=50))
+    app = StreamingServer(ServerConfig(
+        rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+        movie_folder=str(tmp_path), log_folder=str(tmp_path)))
+    await app.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       app.rtsp.port)
+        writer.write(b"GET /song.mp3 HTTP/1.0\r\nHost: x\r\n"
+                     b"Icy-MetaData: 1\r\n\r\n")
+        head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 5)
+        assert head.startswith(b"ICY 200 OK")
+        assert b"icy-metaint:8192" in head
+        body = await asyncio.wait_for(reader.readexactly(9000), 10)
+        assert body[:4] == bytes((0xFF, 0xFB, 0x90, 0x00))
+        # metadata block injected after exactly 8192 audio bytes
+        meta_len_byte = body[8192]
+        assert meta_len_byte > 0
+        meta = body[8193:8193 + meta_len_byte * 16]
+        assert meta.startswith(b"StreamTitle='song';")
+        writer.close()
+
+        # stats page over the RTSP port
+        r2, w2 = await asyncio.open_connection("127.0.0.1", app.rtsp.port)
+        w2.write(b"GET /stats HTTP/1.0\r\n\r\n")
+        page = await asyncio.wait_for(r2.read(65536), 5)
+        assert b"easydarwin-tpu" in page and b"200 OK" in page
+        w2.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_rtsp_over_http_tunnel_e2e(tmp_path):
+    """QuickTime-style tunnel: GET holds the data channel, POST carries
+    base64 RTSP; DESCRIBE of a live push answers over the GET side."""
+    from easydarwin_tpu.protocol import rtsp as rtsp_mod
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    app = StreamingServer(ServerConfig(
+        rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+        log_folder=str(tmp_path)))
+    await app.start()
+    try:
+        # publish something to DESCRIBE
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/tun"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(
+            uri, "v=0\r\nm=video 0 RTP/AVP 96\r\n"
+                 "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+
+        cookie = "deadbeefcafe1234"
+        # GET half
+        gr, gw = await asyncio.open_connection("127.0.0.1", app.rtsp.port)
+        gw.write(f"GET /live/tun HTTP/1.0\r\nx-sessioncookie: {cookie}\r\n"
+                 f"Accept: application/x-rtsp-tunnelled\r\n\r\n".encode())
+        head = await asyncio.wait_for(gr.readuntil(b"\r\n\r\n"), 5)
+        assert b"200 OK" in head
+        assert b"application/x-rtsp-tunnelled" in head
+
+        # POST half with a base64'd DESCRIBE
+        pr, pw = await asyncio.open_connection("127.0.0.1", app.rtsp.port)
+        pw.write(f"POST /live/tun HTTP/1.0\r\nx-sessioncookie: {cookie}\r\n"
+                 f"Content-Length: 32767\r\n\r\n".encode())
+        req = (f"DESCRIBE {uri} RTSP/1.0\r\nCSeq: 1\r\n"
+               f"Accept: application/sdp\r\n\r\n").encode()
+        pw.write(base64.b64encode(req))
+        await pw.drain()
+
+        # the RTSP answer arrives on the GET connection, unencoded
+        resp = await asyncio.wait_for(gr.read(4096), 5)
+        assert resp.startswith(b"RTSP/1.0 200 OK")
+        assert b"H264/90000" in resp
+
+        pw.close()
+        gw.close()
+        await pusher.close()
+    finally:
+        await app.stop()
