@@ -1,0 +1,226 @@
+"""Reliable UDP: resend window, RTT/cwnd tracking, overbuffer pacing.
+
+Reference parity: the reliable-RTP kit behind ``RTPStream::ReliableRTPWrite``
+(``RTPStream.cpp:825``) —
+
+* ``RTPBandwidthTracker.cpp``: Karn-style smoothed RTT (SRTT/RTTVAR → RTO)
+  and a byte congestion window with slow-start + congestion avoidance;
+* ``RTPPacketResender.cpp``: per-stream window of unacked packets, resend on
+  RTO expiry with backoff, give-up after max resends;
+* ``RTPOverbufferWindow.cpp``: how far ahead of real-time the sender may run
+  (client-side buffer budget), with the send-ahead window from prefs;
+* ``RTCPAckPacket.cpp``: the 'qtak' APP ack — first seq + following bit
+  mask of additional acks.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..protocol import rtp
+from ..protocol.rtcp import App
+
+ACK_NAME = "qtak"
+LEGACY_ACK_NAME = "ack "
+
+
+# ------------------------------------------------------------- RTT / cwnd
+class BandwidthTracker:
+    """SRTT/RTTVAR/RTO + byte congestion window (slow start → avoidance)."""
+
+    MIN_RTO_MS = 250          # reference clamps retransmit timeout
+    MAX_RTO_MS = 24_000
+    MSS = 1466                # segment size used for window arithmetic
+
+    def __init__(self, *, initial_window: int = 3 * 1466):
+        self.srtt_ms: float | None = None
+        self.rttvar_ms = 0.0
+        self.cwnd = float(initial_window)
+        self.ssthresh = 64 * 1024.0
+        self.bytes_in_flight = 0
+        self.acks = 0
+        self.losses = 0
+
+    @property
+    def rto_ms(self) -> float:
+        if self.srtt_ms is None:
+            return 1000.0
+        return min(max(self.srtt_ms + 4 * self.rttvar_ms, self.MIN_RTO_MS),
+                   self.MAX_RTO_MS)
+
+    def can_send(self, nbytes: int) -> bool:
+        return self.bytes_in_flight + nbytes <= self.cwnd
+
+    def on_sent(self, nbytes: int) -> None:
+        self.bytes_in_flight += nbytes
+
+    def on_ack(self, nbytes: int, rtt_ms: float | None) -> None:
+        self.bytes_in_flight = max(0, self.bytes_in_flight - nbytes)
+        self.acks += 1
+        if rtt_ms is not None:           # Karn: only unambiguous samples
+            if self.srtt_ms is None:
+                self.srtt_ms = rtt_ms
+                self.rttvar_ms = rtt_ms / 2
+            else:
+                self.rttvar_ms += 0.25 * (abs(self.srtt_ms - rtt_ms)
+                                          - self.rttvar_ms)
+                self.srtt_ms += 0.125 * (rtt_ms - self.srtt_ms)
+        if self.cwnd < self.ssthresh:
+            self.cwnd += self.MSS                      # slow start
+        else:
+            self.cwnd += self.MSS * self.MSS / self.cwnd   # avoidance
+
+    def on_loss(self, nbytes: int) -> None:
+        self.bytes_in_flight = max(0, self.bytes_in_flight - nbytes)
+        self.losses += 1
+        self.ssthresh = max(self.cwnd / 2, 2 * self.MSS)
+        self.cwnd = self.ssthresh
+
+
+# --------------------------------------------------------------- resender
+@dataclass
+class _Pending:
+    data: bytes
+    first_sent_ms: int
+    last_sent_ms: int
+    resends: int = 0
+
+
+class PacketResender:
+    MAX_RESENDS = 4           # then give up (counted as loss)
+
+    def __init__(self, tracker: BandwidthTracker):
+        self.tracker = tracker
+        self.pending: dict[int, _Pending] = {}
+        self.resent = 0
+        self.expired = 0
+
+    def add(self, seq: int, data: bytes, now_ms: int) -> None:
+        self.pending[seq & 0xFFFF] = _Pending(data, now_ms, now_ms)
+        self.tracker.on_sent(len(data))
+
+    def ack(self, seq: int, now_ms: int) -> bool:
+        p = self.pending.pop(seq & 0xFFFF, None)
+        if p is None:
+            return False
+        rtt = (now_ms - p.first_sent_ms) if p.resends == 0 else None
+        self.tracker.on_ack(len(p.data), rtt)
+        return True
+
+    def due_for_resend(self, now_ms: int) -> list[tuple[int, bytes]]:
+        """Packets past RTO: returns them for retransmission; drops ones
+        past MAX_RESENDS (loss)."""
+        rto = self.tracker.rto_ms
+        out: list[tuple[int, bytes]] = []
+        for seq in list(self.pending):
+            p = self.pending[seq]
+            if now_ms - p.last_sent_ms < rto * (2 ** p.resends):
+                continue
+            if p.resends >= self.MAX_RESENDS:
+                del self.pending[seq]
+                self.expired += 1
+                self.tracker.on_loss(len(p.data))
+                continue
+            p.resends += 1
+            p.last_sent_ms = now_ms
+            self.resent += 1
+            self.tracker.on_loss(0)      # window backoff without deflating
+            out.append((seq, p.data))
+        return out
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.pending)
+
+
+# -------------------------------------------------------- overbuffer window
+class OverbufferWindow:
+    """Send-ahead budget: may we transmit a packet whose play-out time is
+    ``ahead_ms`` in the future?  (``RTPOverbufferWindow.cpp`` semantics:
+    unlimited window pref = always yes; otherwise bounded by the window
+    minus what's already been sent ahead.)"""
+
+    def __init__(self, *, window_ms: int = 10_000,
+                 max_send_ahead_ms: int = 25_000):
+        self.window_ms = window_ms
+        self.max_send_ahead_ms = max_send_ahead_ms
+
+    def can_send(self, packet_playout_ms: int, now_ms: int) -> bool:
+        ahead = packet_playout_ms - now_ms
+        if ahead <= 0:
+            return True                   # due or late: always sendable
+        if self.window_ms <= 0:
+            return True                   # unlimited overbuffering
+        return ahead <= min(self.window_ms, self.max_send_ahead_ms)
+
+    def suggested_wakeup(self, packet_playout_ms: int, now_ms: int) -> int:
+        """When to retry a deferred packet (ms from now)."""
+        return max(packet_playout_ms - self.window_ms - now_ms, 10)
+
+
+# ------------------------------------------------------------ ack parsing
+def build_ack(ssrc: int, first_seq: int, extra_mask: int = 0,
+              mask_bytes: int = 4) -> bytes:
+    """Build a 'qtak' APP ack: first seq + bit mask of following seqs."""
+    payload = struct.pack(">HH", first_seq & 0xFFFF, 0)
+    payload += extra_mask.to_bytes(mask_bytes, "big")
+    if len(payload) % 4:
+        payload += b"\x00" * (4 - len(payload) % 4)
+    return App(ssrc, ACK_NAME, data=payload).to_bytes()
+
+
+def parse_ack(app: App) -> list[int]:
+    """'qtak'/'ack ' APP → acked sequence numbers (first + mask bits,
+    bit i of the mask acking ``first_seq + 1 + i`` — RTCPAckPacket's
+    layout)."""
+    if app.name not in (ACK_NAME, LEGACY_ACK_NAME) or len(app.data) < 4:
+        return []
+    first_seq = struct.unpack_from(">H", app.data, 0)[0]
+    seqs = [first_seq]
+    mask = app.data[4:]
+    for byte_i, b in enumerate(mask):
+        for bit in range(8):
+            if b & (0x80 >> bit):
+                seqs.append((first_seq + 1 + byte_i * 8 + bit) & 0xFFFF)
+    return seqs
+
+
+# ------------------------------------------------------- output decorator
+class ReliableUdpOutput:
+    """Wraps a RelayOutput with ack/resend bookkeeping.
+
+    ``write(packet, now)`` sends through the underlying output when the
+    congestion window allows (else reports WouldBlock, preserving bookmark
+    replay); ``on_rtcp_app`` consumes client acks; ``tick`` retransmits."""
+
+    def __init__(self, inner):
+        from .output import WriteResult
+        self._WriteResult = WriteResult
+        self.inner = inner
+        self.tracker = BandwidthTracker()
+        self.resender = PacketResender(self.tracker)
+
+    def write(self, packet: bytes, now_ms: int):
+        WR = self._WriteResult
+        if not self.tracker.can_send(len(packet)):
+            return WR.WOULD_BLOCK
+        res = self.inner.send_bytes(packet, is_rtcp=False)
+        if res is WR.OK:
+            self.resender.add(rtp.peek_seq(packet), packet, now_ms)
+        return res
+
+    def on_rtcp_app(self, app: App, now_ms: int) -> int:
+        n = 0
+        for seq in parse_ack(app):
+            if self.resender.ack(seq, now_ms):
+                n += 1
+        return n
+
+    def tick(self, now_ms: int) -> int:
+        WR = self._WriteResult
+        n = 0
+        for _seq, data in self.resender.due_for_resend(now_ms):
+            if self.inner.send_bytes(data, is_rtcp=False) is WR.OK:
+                n += 1
+        return n

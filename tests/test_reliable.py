@@ -1,0 +1,112 @@
+"""Reliable UDP: RTT/cwnd, resend window, overbuffer, qtak acks."""
+
+from easydarwin_tpu.protocol import rtcp, rtp
+from easydarwin_tpu.relay.output import CollectingOutput, WriteResult
+from easydarwin_tpu.relay.reliable import (BandwidthTracker, OverbufferWindow,
+                                           PacketResender, ReliableUdpOutput,
+                                           build_ack, parse_ack)
+
+
+def pkt(seq, size=100):
+    return rtp.RtpPacket(payload_type=96, seq=seq, timestamp=0, ssrc=1,
+                         payload=bytes(size)).to_bytes()
+
+
+def test_rtt_estimation_and_rto():
+    t = BandwidthTracker()
+    assert t.rto_ms == 1000.0                  # no samples yet
+    t.on_sent(100)
+    t.on_ack(100, rtt_ms=100.0)
+    assert t.srtt_ms == 100.0
+    t.on_sent(100)
+    t.on_ack(100, rtt_ms=200.0)
+    assert 100 < t.srtt_ms < 200
+    assert t.rto_ms >= BandwidthTracker.MIN_RTO_MS
+
+
+def test_cwnd_slow_start_then_loss_halves():
+    t = BandwidthTracker()
+    w0 = t.cwnd
+    for _ in range(5):
+        t.on_sent(1000)
+        t.on_ack(1000, 50.0)
+    assert t.cwnd > w0                          # slow-start growth
+    grown = t.cwnd
+    t.on_loss(0)
+    assert t.cwnd < grown
+    assert t.cwnd >= 2 * t.MSS
+
+
+def test_resender_ack_and_timeout_flow():
+    t = BandwidthTracker()
+    r = PacketResender(t)
+    r.add(10, pkt(10), now_ms=1000)
+    r.add(11, pkt(11), now_ms=1000)
+    assert r.in_flight == 2
+    assert r.ack(10, now_ms=1100)
+    assert not r.ack(10, now_ms=1100)           # double-ack ignored
+    assert t.srtt_ms == 100.0
+    # seq 11 hits RTO → resent with backoff
+    due = r.due_for_resend(now_ms=1000 + int(t.rto_ms) + 1)
+    assert [s for s, _ in due] == [11]
+    assert r.resent == 1
+    # exponential backoff: not due again immediately
+    assert r.due_for_resend(now_ms=1000 + int(t.rto_ms) + 2) == []
+
+
+def test_resender_gives_up_after_max_resends():
+    t = BandwidthTracker()
+    r = PacketResender(t)
+    r.add(5, pkt(5), now_ms=0)
+    now = 0
+    for i in range(PacketResender.MAX_RESENDS):
+        now += int(t.rto_ms * (2 ** i)) + 10
+        assert r.due_for_resend(now), i
+    now += int(t.rto_ms * (2 ** PacketResender.MAX_RESENDS)) + 10
+    assert r.due_for_resend(now) == []
+    assert r.expired == 1 and r.in_flight == 0
+
+
+def test_overbuffer_window():
+    w = OverbufferWindow(window_ms=10_000)
+    assert w.can_send(500, now_ms=1000)          # already due
+    assert w.can_send(10_500, now_ms=1000)       # 9.5 s ahead: inside
+    assert not w.can_send(12_000, now_ms=1000)   # 11 s ahead: outside
+    unlimited = OverbufferWindow(window_ms=0)
+    assert unlimited.can_send(10**9, now_ms=0)
+    assert w.suggested_wakeup(12_000, 1000) == 1000
+
+
+def test_ack_build_parse_roundtrip():
+    raw = build_ack(0x77, first_seq=100, extra_mask=0b1010 << 28)
+    pkts = rtcp.parse_compound(raw)
+    (app,) = pkts
+    seqs = parse_ack(app)
+    assert seqs == [100, 101, 103]               # first + mask bits 0,2
+    assert parse_ack(rtcp.App(1, "xxxx", data=b"\x00\x00\x00\x00")) == []
+
+
+def test_reliable_output_end_to_end():
+    inner = CollectingOutput(ssrc=9)
+    rel = ReliableUdpOutput(inner)
+    now = 1000
+    sent = 0
+    blocked = 0
+    for i in range(100):
+        res = rel.write(pkt(i, size=1000), now)
+        if res is WriteResult.OK:
+            sent += 1
+        else:
+            blocked += 1
+    assert blocked > 0                            # cwnd throttles the burst
+    assert rel.tracker.bytes_in_flight > 0
+    # client acks everything sent so far → window opens
+    for i in range(sent):
+        rel.resender.ack(i, now + 50)
+    assert rel.tracker.bytes_in_flight == 0
+    assert rel.write(pkt(500), now + 60) is WriteResult.OK
+    # unacked → retransmitted through the inner output on tick
+    before = len(inner.rtp_packets)
+    n = rel.tick(now + 60 + int(rel.tracker.rto_ms) + 1)
+    assert n == 1
+    assert len(inner.rtp_packets) == before + 1
