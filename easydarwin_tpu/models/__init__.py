@@ -1,0 +1,10 @@
+"""Flagship device pipelines ("model families" of this framework).
+
+* ``relay_pipeline``     — the north-star live-relay step (BASELINE
+  config 4): parse → classify → GOP scan → per-subscriber fan-out params.
+* ``transcode_pipeline`` — the config-5 bitrate ladder: transform-domain
+  decode → requantize rungs → re-encoded levels, MXU-shaped.
+"""
+
+from .relay_pipeline import RelayPipeline  # noqa: F401
+from .transcode_pipeline import TranscodePipeline  # noqa: F401

@@ -1,0 +1,87 @@
+"""The flagship relay pipeline: one configurable, jittable device step.
+
+Wraps the ops tier into a shape-stable callable used by the graft entry,
+the bench, and the server's TPU engine.  Two parse backends (fused Pallas
+kernel or the jnp reference — bit-identical, differentially tested) and
+two output modes:
+
+* ``affine`` (production): O(S+P) rewrite parameters, egress renders;
+* ``headers``: full [S, P, 12] rendered headers on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import fanout as fanout_ops
+from ..ops import gop as gop_ops
+from ..ops.parse import PARSE_PREFIX, parse_packets
+from ..ops.parse_pallas import parse_packets_pallas
+
+
+@dataclass(frozen=True)
+class RelayPipelineConfig:
+    window: int = 256            # packets per source per pass (P)
+    subscribers: int = 256       # outputs per source (S)
+    prefix_width: int = PARSE_PREFIX
+    bucket_delay_ms: int = 73
+    use_pallas_parse: bool = False
+    mode: str = "affine"         # "affine" | "headers"
+
+
+class RelayPipeline:
+    def __init__(self, config: RelayPipelineConfig | None = None):
+        self.config = config or RelayPipelineConfig()
+        self._step = jax.jit(functools.partial(
+            _pipeline_step,
+            use_pallas=self.config.use_pallas_parse,
+            mode=self.config.mode,
+            bucket_delay_ms=self.config.bucket_delay_ms))
+
+    def __call__(self, prefix, length, age_ms, out_state, buckets):
+        return self._step(prefix, length, age_ms, out_state, buckets)
+
+    @property
+    def step_fn(self):
+        return self._step
+
+    def example_args(self, n_src: int = 1):
+        from ..parallel.mesh import example_batch
+        c = self.config
+        prefix, length, age, out_state, buckets = example_batch(
+            n_src=n_src, n_sub=c.subscribers, n_pkt=c.window,
+            width=c.prefix_width)
+        if n_src == 1:
+            return (prefix[0], length[0], age[0], out_state[0], buckets[0])
+        return (prefix, length, age, out_state, buckets)
+
+
+def _pipeline_step(prefix, length, age_ms, out_state, buckets, *,
+                   use_pallas: bool, mode: str, bucket_delay_ms: int):
+    parse_fn = parse_packets_pallas if use_pallas else parse_packets
+    fields = parse_fn(prefix, length)
+    valid = length > 0
+    kf = fields["keyframe_first"] & valid
+    out = {
+        "seq": fields["seq"].astype(jnp.uint32),
+        "timestamp": fields["timestamp"],
+        "keyframe_first": kf,
+        "frame_last": fields["frame_last"],
+        "newest_keyframe": gop_ops.newest_keyframe(kf, valid),
+        "fast_start": gop_ops.fast_start_indices(kf, valid, age_ms, 10_000),
+        "mask": (fanout_ops.eligibility(age_ms, buckets, bucket_delay_ms)
+                 & (length >= 12)[None, :]),
+    }
+    st = out_state.astype(jnp.uint32)
+    if mode == "affine":
+        out["seq_off"] = (st[:, 3] - st[:, 1]) & jnp.uint32(0xFFFF)
+        out["ts_off"] = st[:, 4] - st[:, 2]
+        out["ssrc"] = st[:, 0]
+    else:
+        out["headers"] = fanout_ops.fanout_headers(
+            prefix[:, :2], fields["seq"], fields["timestamp"], out_state)
+    return out

@@ -1,0 +1,70 @@
+"""Config-5 transcode pipeline: transform-domain bitrate ladder.
+
+One jitted step takes a batch of quantized 8×8 coefficient blocks (the
+entropy-decoded intra blocks of an H.264/MJPEG source — entropy coding
+stays host-side, ARCHITECTURE §8) and produces every ladder rung:
+
+* per rung: requantized levels (``ops.transform.requantize`` — no IDCT
+  round-trip) + nonzero counts (the rate proxy driving rung selection);
+* optionally decoded pixels for the top rung (feeding preview/JPEG snaps).
+
+All rungs share the dequantized intermediate; XLA fuses the whole ladder
+into a couple of MXU/VPU passes over the batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import transform as tf
+
+
+@dataclass(frozen=True)
+class TranscodeConfig:
+    qualities: tuple[int, ...] = (80, 50, 25)
+    source_quality: int = 90
+    decode_pixels: bool = False
+
+
+class TranscodePipeline:
+    def __init__(self, config: TranscodeConfig | None = None):
+        self.config = config or TranscodeConfig()
+        qt_in = tf.quality_table(self.config.source_quality)
+        qt_rungs = np.stack([tf.quality_table(q)
+                             for q in self.config.qualities])
+        self._step = jax.jit(functools.partial(
+            _ladder_step, qt_in=jnp.asarray(qt_in),
+            qt_rungs=jnp.asarray(qt_rungs),
+            decode_pixels=self.config.decode_pixels))
+
+    def __call__(self, levels: jnp.ndarray) -> dict:
+        """levels: [N, 64] int32 quantized coefficients → rung outputs."""
+        return self._step(levels)
+
+    @property
+    def step_fn(self):
+        return self._step
+
+    def example_args(self, n_blocks: int = 512):
+        rng = np.random.default_rng(0)
+        pixels = rng.integers(0, 256, size=(n_blocks, 64), dtype=np.uint8)
+        levels = tf.encode_blocks(
+            pixels, jnp.asarray(tf.quality_table(self.config.source_quality)))
+        return (np.asarray(levels),)
+
+
+def _ladder_step(levels, *, qt_in, qt_rungs, decode_pixels: bool):
+    coef = tf.dequantize(levels, qt_in)                  # shared intermediate
+    R = qt_rungs.shape[0]
+    rung_levels = jax.vmap(lambda qt: tf.quantize(coef, qt))(qt_rungs)
+    nonzeros = jnp.sum(rung_levels != 0, axis=(1, 2))    # [R] rate proxy
+    out = {"rungs": rung_levels, "nonzeros": nonzeros}
+    if decode_pixels:
+        x = tf.idct_blocks(coef) + 128.0
+        out["pixels"] = jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+    return out

@@ -1,0 +1,128 @@
+"""Pallas kernel for the packet parse/classify hot op.
+
+Same contract as ``parse.parse_packets`` (the jnp reference), fused into a
+single VMEM pass per tile of packets.  TPU-friendly formulation: the only
+data-dependent indices are the header-size-relative byte peeks
+(``hs = 12 + 4·CC``), and CC has just 16 possible values — so each needed
+byte is computed as a sum of 16 *static* column slices masked by
+``CC == k``, avoiding per-row dynamic gathers entirely (Mosaic lowers the
+whole kernel to vector selects).
+
+Outputs are packed as two arrays to keep the out_specs simple:
+``words  [P, 4] uint32``  — seq, timestamp, ssrc, payload_start
+``flagsv [P, 4] int32``   — nal_type, keyframe_first, frame_first, frame_last
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .parse import PARSE_PREFIX, _AGG_OFFSETS, _KEYFRAME_TYPES, \
+    _MIN_CLASSIFY_LEN
+
+TILE = 256
+
+
+def _byte_at_hs_plus(x: jnp.ndarray, cc: jnp.ndarray, delta: int
+                     ) -> jnp.ndarray:
+    """x[p, 12 + 4*cc[p] + delta] via 16 masked static slices."""
+    out = jnp.zeros(x.shape[0], dtype=jnp.int32)
+    for k in range(16):
+        col = 12 + 4 * k + delta
+        if col < x.shape[1]:
+            out = jnp.where(cc == k, x[:, col], out)
+    return out
+
+
+def _parse_tile(x: jnp.ndarray, length: jnp.ndarray):
+    b0, b1 = x[:, 0], x[:, 1]
+    cc = b0 & 0x0F
+    hs = 12 + 4 * cc
+    seq = ((x[:, 2] << 8) | x[:, 3]).astype(jnp.uint32)
+    ts = ((x[:, 4] << 24) | (x[:, 5] << 16) | (x[:, 6] << 8) | x[:, 7]
+          ).astype(jnp.uint32)
+    ssrc = ((x[:, 8] << 24) | (x[:, 9] << 16) | (x[:, 10] << 8) | x[:, 11]
+            ).astype(jnp.uint32)
+    marker = (b1 & 0x80) != 0
+    classifiable = (length >= _MIN_CLASSIFY_LEN) & (length > hs)
+    nal0 = _byte_at_hs_plus(x, cc, 0) & 0x1F
+    eff = nal0
+    for agg_type, off in _AGG_OFFSETS:
+        inner = _byte_at_hs_plus(x, cc, off) & 0x1F
+        eff = jnp.where((nal0 == agg_type) & (length > hs + off), inner, eff)
+    fu_hdr = _byte_at_hs_plus(x, cc, 1)
+    is_fu = (nal0 == 28) | (nal0 == 29)
+    fu_start = is_fu & (length > hs + 1) & ((fu_hdr & 0x80) != 0)
+    eff = jnp.where(fu_start, fu_hdr & 0x1F, eff)
+    eff = jnp.where(classifiable, eff, -1)
+    kf = jnp.zeros_like(eff, dtype=bool)
+    for t in _KEYFRAME_TYPES:
+        kf |= eff == t
+    kf &= classifiable
+    frame_first = classifiable & (((nal0 >= 1) & (nal0 <= 27)) | fu_start)
+    frame_last = (length >= _MIN_CLASSIFY_LEN) & marker
+    words = jnp.stack([seq, ts, ssrc, hs.astype(jnp.uint32)], axis=-1)
+    flagsv = jnp.stack([eff, kf.astype(jnp.int32),
+                        frame_first.astype(jnp.int32),
+                        frame_last.astype(jnp.int32)], axis=-1)
+    return words, flagsv
+
+
+def _kernel(prefix_ref, length_ref, words_ref, flags_ref):
+    x = prefix_ref[:].astype(jnp.int32)
+    length = length_ref[:].astype(jnp.int32)
+    words, flagsv = _parse_tile(x, length)
+    words_ref[:] = words
+    flags_ref[:] = flagsv
+
+
+def parse_packets_pallas(prefix: jnp.ndarray, length: jnp.ndarray,
+                         interpret: bool | None = None
+                         ) -> dict[str, jnp.ndarray]:
+    """Pallas-fused parse; same results as ``parse.parse_packets``.
+
+    ``interpret`` defaults to True on the CPU backend (tests/fallback) and
+    False on TPU.  Not jitted itself — callers jit the surrounding step.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+
+    n = prefix.shape[0]
+    pad = (-n) % TILE
+    if pad:
+        prefix = jnp.concatenate(
+            [prefix, jnp.zeros((pad, prefix.shape[1]), prefix.dtype)])
+        length = jnp.concatenate([length, jnp.zeros(pad, length.dtype)])
+    grid = prefix.shape[0] // TILE
+    words, flagsv = pl.pallas_call(
+        _kernel,
+        out_shape=(jax.ShapeDtypeStruct((prefix.shape[0], 4), jnp.uint32),
+                   jax.ShapeDtypeStruct((prefix.shape[0], 4), jnp.int32)),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((TILE, prefix.shape[1]), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((TILE, 4), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((TILE, 4), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(prefix, length.astype(jnp.int32))
+    words, flagsv = words[:n], flagsv[:n]
+    return {
+        "seq": words[:, 0], "timestamp": words[:, 1], "ssrc": words[:, 2],
+        "payload_start": words[:, 3].astype(jnp.int32),
+        "nal_type": flagsv[:, 0],
+        "keyframe_first": flagsv[:, 1].astype(bool),
+        "frame_first": flagsv[:, 2].astype(bool),
+        "frame_last": flagsv[:, 3].astype(bool),
+        "marker": flagsv[:, 3].astype(bool),
+    }
