@@ -7,6 +7,7 @@
 #include <cstring>
 #include <map>
 #include <netinet/in.h>
+#include <netinet/udp.h>
 #include <sys/socket.h>
 #include <vector>
 
@@ -102,6 +103,145 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
   return done;
 }
 
+#ifndef UDP_SEGMENT
+#define UDP_SEGMENT 103
+#endif
+#ifndef UDP_MAX_SEGMENTS
+#define UDP_MAX_SEGMENTS 64
+#endif
+
+int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
+                               const int32_t *ring_len, int32_t capacity,
+                               int32_t slot_size, const uint32_t *seq_off,
+                               const uint32_t *ts_off, const uint32_t *ssrc,
+                               const ed_dest *dest, int32_t n_outs,
+                               const ed_sendop *ops, int32_t n_ops) {
+  if (n_ops <= 0) return 0;
+  // One super-send = one msg_hdr with [hdr|payload] iovec pairs for a run of
+  // same-subscriber, same-size packets, plus a UDP_SEGMENT cmsg.
+  constexpr int kSupers = 64;  // super-sends per sendmmsg flush
+  constexpr size_t kMaxGsoBytes = 65000;  // < 65507 UDP payload ceiling
+  struct Super {
+    sockaddr_in sa;
+    alignas(cmsghdr) char ctl[CMSG_SPACE(sizeof(uint16_t))];
+    int n_segs = 0;
+    int n_ops = 0;  // ops consumed by this super (== n_segs)
+  };
+  std::vector<mmsghdr> msgs(kSupers);
+  std::vector<Super> supers(kSupers);
+  // worst case: every segment is its own iovec pair
+  std::vector<iovec> iovs(static_cast<size_t>(kSupers) * 2 * UDP_MAX_SEGMENTS);
+  std::vector<uint8_t> hdrs(static_cast<size_t>(kSupers) * UDP_MAX_SEGMENTS *
+                            12);
+  size_t iov_used = 0, hdr_used = 0;
+
+  int32_t done = 0;  // ops fully handed to the kernel
+  int32_t staged = 0;  // ops rendered into the current flush window
+  int n_super = 0;
+
+  auto flush = [&]() -> int32_t {
+    int sent = 0;
+    while (sent < n_super) {
+      int n = sendmmsg(fd, msgs.data() + sent, n_super - sent, 0);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        int32_t ops_sent = 0;
+        for (int i = 0; i < sent; ++i) ops_sent += supers[i].n_ops;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return ops_sent;
+        return -errno;
+      }
+      sent += n;
+    }
+    int32_t ops_sent = 0;
+    for (int i = 0; i < n_super; ++i) ops_sent += supers[i].n_ops;
+    n_super = 0;
+    staged = 0;
+    iov_used = 0;
+    hdr_used = 0;
+    return ops_sent;
+  };
+
+  while (done + staged < n_ops) {
+    // start a new run: consecutive ops with one subscriber and uniform size
+    const ed_sendop &first = ops[done + staged];
+    if (first.slot < 0 || first.slot >= capacity || first.out < 0 ||
+        first.out >= n_outs)
+      return -EINVAL;
+    int32_t gs_len = ring_len[first.slot];
+    if (gs_len < 12 || gs_len > slot_size) return -EINVAL;
+    uint16_t gs_size = static_cast<uint16_t>(gs_len);  // 12B hdr + payload
+
+    Super &sp = supers[n_super];
+    sp.n_segs = 0;
+    sp.n_ops = 0;
+    std::memset(&sp.sa, 0, sizeof(sp.sa));
+    sp.sa.sin_family = AF_INET;
+    sp.sa.sin_addr.s_addr = dest[first.out].ip_be;
+    sp.sa.sin_port = dest[first.out].port_be;
+    iovec *run_iov = &iovs[iov_used];
+    size_t bytes = 0;
+
+    while (done + staged < n_ops && sp.n_segs < UDP_MAX_SEGMENTS) {
+      const ed_sendop &op = ops[done + staged];
+      if (op.out != first.out) break;
+      if (op.slot < 0 || op.slot >= capacity) return -EINVAL;
+      int32_t len = ring_len[op.slot];
+      if (len < 12 || len > slot_size) return -EINVAL;
+      // every segment but the last must be exactly gs_size; a shorter
+      // packet may close the run, a longer one must start a new run
+      if (len > gs_size) break;
+      if (bytes + static_cast<size_t>(len) > kMaxGsoBytes) break;
+      const uint8_t *pkt = ring_data + static_cast<size_t>(op.slot) * slot_size;
+      uint8_t *h = hdrs.data() + hdr_used;
+      hdr_used += 12;
+      render_header(h, pkt, seq_off[op.out], ts_off[op.out], ssrc[op.out]);
+      iovec *iv = &iovs[iov_used];
+      iov_used += 2;
+      iv[0].iov_base = h;
+      iv[0].iov_len = 12;
+      iv[1].iov_base = const_cast<uint8_t *>(pkt) + 12;
+      iv[1].iov_len = static_cast<size_t>(len - 12);
+      bytes += static_cast<size_t>(len);
+      sp.n_segs++;
+      sp.n_ops++;
+      staged++;
+      if (len < gs_size) break;  // short segment ends the super-datagram
+    }
+
+    mmsghdr &m = msgs[n_super];
+    std::memset(&m, 0, sizeof(m));
+    m.msg_hdr.msg_name = &sp.sa;
+    m.msg_hdr.msg_namelen = sizeof(sp.sa);
+    m.msg_hdr.msg_iov = run_iov;
+    m.msg_hdr.msg_iovlen = static_cast<size_t>(sp.n_segs) * 2;
+    if (sp.n_segs > 1) {
+      m.msg_hdr.msg_control = sp.ctl;
+      m.msg_hdr.msg_controllen = sizeof(sp.ctl);
+      cmsghdr *cm = CMSG_FIRSTHDR(&m.msg_hdr);
+      cm->cmsg_level = SOL_UDP;
+      cm->cmsg_type = UDP_SEGMENT;
+      cm->cmsg_len = CMSG_LEN(sizeof(uint16_t));
+      std::memcpy(CMSG_DATA(cm), &gs_size, sizeof(uint16_t));
+    }
+    n_super++;
+
+    if (n_super == kSupers ||
+        iov_used + 2 * UDP_MAX_SEGMENTS > iovs.size()) {
+      int32_t r = flush();
+      if (r < 0) return r;
+      done += r;
+      if (r < staged) return done;  // EAGAIN mid-window: bookmark kept
+      staged = 0;
+    }
+  }
+  if (n_super > 0) {
+    int32_t r = flush();
+    if (r < 0) return r;
+    done += r;
+  }
+  return done;
+}
+
 int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
                          int32_t capacity, int32_t slot_size,
                          const uint32_t *seq_off, const uint32_t *ts_off,
@@ -155,6 +295,37 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
     *head += n;
     total += n;
     if (n < want) break;
+  }
+  return total;
+}
+
+int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds) {
+  constexpr int kBatch = 64;
+  constexpr size_t kSeg = 2048;
+  static thread_local std::vector<uint8_t> scratch(kBatch * kSeg);
+  mmsghdr msgs[kBatch];
+  iovec iovs[kBatch];
+  for (int i = 0; i < kBatch; ++i) {
+    iovs[i].iov_base = scratch.data() + static_cast<size_t>(i) * kSeg;
+    iovs[i].iov_len = kSeg;
+  }
+  int64_t total = 0;
+  for (int32_t f = 0; f < n_fds; ++f) {
+    for (;;) {
+      for (int i = 0; i < kBatch; ++i) {
+        std::memset(&msgs[i], 0, sizeof(mmsghdr));
+        msgs[i].msg_hdr.msg_iov = &iovs[i];
+        msgs[i].msg_hdr.msg_iovlen = 1;
+      }
+      int n = recvmmsg(fds[f], msgs, kBatch, MSG_DONTWAIT, nullptr);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;  // EAGAIN or a dead socket: move on
+      }
+      if (n == 0) break;
+      total += n;
+      if (n < kBatch) break;
+    }
   }
   return total;
 }

@@ -58,6 +58,24 @@ int32_t ed_fanout_send_udp(int fd,
                            int32_t n_outs,
                            const ed_sendop *ops, int32_t n_ops);
 
+/* Same contract as ed_fanout_send_udp, but runs of consecutive ops that
+ * target the same subscriber are coalesced into UDP_SEGMENT (GSO)
+ * super-datagrams: one udp_sendmsg carries up to ~46 equal-size segments
+ * (last may be shorter), cutting per-datagram syscall/route/skb setup ~40x.
+ * A mid-run length change or subscriber change flushes the current
+ * super-send, so variable-size traffic degrades gracefully toward the
+ * plain path.  Returns ops sent (EAGAIN stops at a super-send boundary,
+ * preserving bookmark semantics), or negative errno; -EOPNOTSUPP/-EINVAL
+ * from the first send may mean no kernel GSO — callers fall back. */
+int32_t ed_fanout_send_udp_gso(int fd,
+                               const uint8_t *ring_data,
+                               const int32_t *ring_len,
+                               int32_t capacity, int32_t slot_size,
+                               const uint32_t *seq_off, const uint32_t *ts_off,
+                               const uint32_t *ssrc, const ed_dest *dest,
+                               int32_t n_outs,
+                               const ed_sendop *ops, int32_t n_ops);
+
 /* Same render, but into a caller buffer instead of the wire: out must hold
  * n_ops * (12 + max payload) — used for interleaved/TCP paths and tests.
  * out_lens[i] receives each rendered packet's length.  Returns n rendered. */
@@ -79,6 +97,11 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
                       int64_t *ring_arrival, int32_t capacity,
                       int32_t slot_size, int64_t now_ms,
                       int64_t *head, int32_t max_pkts);
+
+/* Discard-drain every pending datagram on each fd (recvmmsg, MSG_DONTWAIT).
+ * A cheap stand-in for N subscriber read loops: one syscall drains a batch,
+ * no per-datagram userspace work.  Returns total datagrams discarded. */
+int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds);
 
 /* ------------------------------------------------------------- timer wheel */
 

@@ -76,11 +76,9 @@ def _pipeline_step(prefix, length, age_ms, out_state, buckets, *,
         "mask": (fanout_ops.eligibility(age_ms, buckets, bucket_delay_ms)
                  & (length >= 12)[None, :]),
     }
-    st = out_state.astype(jnp.uint32)
     if mode == "affine":
-        out["seq_off"] = (st[:, 3] - st[:, 1]) & jnp.uint32(0xFFFF)
-        out["ts_off"] = st[:, 4] - st[:, 2]
-        out["ssrc"] = st[:, 0]
+        (out["seq_off"], out["ts_off"],
+         out["ssrc"]) = fanout_ops.affine_params(out_state)
     else:
         out["headers"] = fanout_ops.fanout_headers(
             prefix[:, :2], fields["seq"], fields["timestamp"], out_state)

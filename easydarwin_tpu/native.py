@@ -65,6 +65,10 @@ def _load():
             ctypes.c_int, u8p, i32p, ctypes.c_int32, ctypes.c_int32,
             u32p, u32p, u32p, ctypes.POINTER(Dest), ctypes.c_int32,
             ctypes.POINTER(SendOp), ctypes.c_int32]
+        lib.ed_fanout_send_udp_gso.restype = ctypes.c_int32
+        lib.ed_fanout_send_udp_gso.argtypes = lib.ed_fanout_send_udp.argtypes
+        lib.ed_udp_drain.restype = ctypes.c_int64
+        lib.ed_udp_drain.argtypes = [i32p, ctypes.c_int32]
         lib.ed_fanout_render.restype = ctypes.c_int32
         lib.ed_fanout_render.argtypes = [
             u8p, i32p, ctypes.c_int32, ctypes.c_int32,
@@ -148,6 +152,32 @@ def fanout_send_udp(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         _u32(np.ascontiguousarray(ts_off, np.uint32)),
         _u32(np.ascontiguousarray(ssrc, np.uint32)),
         dests, len(dests), ops, n_ops)
+
+
+def fanout_send_udp_gso(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
+                        seq_off: np.ndarray, ts_off: np.ndarray,
+                        ssrc: np.ndarray, dests, ops, n_ops: int) -> int:
+    """GSO egress: same-subscriber runs coalesce into UDP_SEGMENT
+    super-datagrams (~40x fewer udp_sendmsg traversals). Negative return
+    may mean the kernel lacks GSO — callers fall back to fanout_send_udp."""
+    lib = _load()
+    assert lib is not None
+    assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+    return lib.ed_fanout_send_udp_gso(
+        fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1],
+        _u32(np.ascontiguousarray(seq_off, np.uint32)),
+        _u32(np.ascontiguousarray(ts_off, np.uint32)),
+        _u32(np.ascontiguousarray(ssrc, np.uint32)),
+        dests, len(dests), ops, n_ops)
+
+
+def udp_drain(fds: list[int]) -> int:
+    """Discard-drain all pending datagrams on the given sockets."""
+    lib = _load()
+    assert lib is not None
+    arr = np.asarray(fds, dtype=np.int32)
+    return lib.ed_udp_drain(_i32(arr), len(fds))
 
 
 def fanout_render(ring_data: np.ndarray, ring_len: np.ndarray,

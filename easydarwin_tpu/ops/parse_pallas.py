@@ -10,7 +10,8 @@ whole kernel to vector selects).
 
 Outputs are packed as two arrays to keep the out_specs simple:
 ``words  [P, 4] uint32``  — seq, timestamp, ssrc, payload_start
-``flagsv [P, 4] int32``   — nal_type, keyframe_first, frame_first, frame_last
+``flagsv [P, 5] int32``   — nal_type, keyframe_first, frame_first,
+frame_last, marker
 """
 
 from __future__ import annotations
@@ -67,7 +68,8 @@ def _parse_tile(x: jnp.ndarray, length: jnp.ndarray):
     words = jnp.stack([seq, ts, ssrc, hs.astype(jnp.uint32)], axis=-1)
     flagsv = jnp.stack([eff, kf.astype(jnp.int32),
                         frame_first.astype(jnp.int32),
-                        frame_last.astype(jnp.int32)], axis=-1)
+                        frame_last.astype(jnp.int32),
+                        marker.astype(jnp.int32)], axis=-1)
     return words, flagsv
 
 
@@ -103,7 +105,7 @@ def parse_packets_pallas(prefix: jnp.ndarray, length: jnp.ndarray,
     words, flagsv = pl.pallas_call(
         _kernel,
         out_shape=(jax.ShapeDtypeStruct((prefix.shape[0], 4), jnp.uint32),
-                   jax.ShapeDtypeStruct((prefix.shape[0], 4), jnp.int32)),
+                   jax.ShapeDtypeStruct((prefix.shape[0], 5), jnp.int32)),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((TILE, prefix.shape[1]), lambda i: (i, 0),
@@ -112,7 +114,7 @@ def parse_packets_pallas(prefix: jnp.ndarray, length: jnp.ndarray,
         ],
         out_specs=(pl.BlockSpec((TILE, 4), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM),
-                   pl.BlockSpec((TILE, 4), lambda i: (i, 0),
+                   pl.BlockSpec((TILE, 5), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)),
         interpret=interpret,
     )(prefix, length.astype(jnp.int32))
@@ -124,5 +126,5 @@ def parse_packets_pallas(prefix: jnp.ndarray, length: jnp.ndarray,
         "keyframe_first": flagsv[:, 1].astype(bool),
         "frame_first": flagsv[:, 2].astype(bool),
         "frame_last": flagsv[:, 3].astype(bool),
-        "marker": flagsv[:, 3].astype(bool),
+        "marker": flagsv[:, 4].astype(bool),
     }

@@ -49,6 +49,35 @@ def test_affine_render_matches_full_device_render():
             assert host[s, p].tobytes() + pkt[12:] == oracle
 
 
+def test_packed_step_equals_dict_step():
+    """relay_affine_step_packed ∘ unpack_affine ≡ vmap(relay_affine_step)."""
+    rng = random.Random(7)
+    n_src, n_sub = 3, 9
+    packets = [p for p in (random_packet(rng) for _ in range(32))
+               if len(p) >= 12]
+    pre1, ln1 = stage(packets)
+    pre = np.broadcast_to(pre1[None], (n_src,) + pre1.shape).copy()
+    ln = np.broadcast_to(ln1[None], (n_src,) + ln1.shape).copy()
+    outs = [CollectingOutput(ssrc=rng.getrandbits(32),
+                             out_seq_start=rng.getrandbits(16),
+                             out_ts_start=rng.getrandbits(32))
+            for _ in range(n_sub)]
+    state1 = fanout.pack_output_state(outs)
+    state = np.broadcast_to(state1[None], (n_src,) + state1.shape).copy()
+
+    packed = np.asarray(fanout.relay_affine_step_packed(pre, ln, state))
+    assert packed.shape == (n_src, 3 * n_sub + 1)
+    seq_off, ts_off, ssrc, kf = fanout.unpack_affine(packed, n_sub)
+
+    import jax
+    ref = jax.vmap(fanout.relay_affine_step)(pre, ln, state)
+    np.testing.assert_array_equal(seq_off, np.asarray(ref["seq_off"]))
+    np.testing.assert_array_equal(ts_off, np.asarray(ref["ts_off"]))
+    np.testing.assert_array_equal(ssrc, np.asarray(ref["ssrc"]))
+    np.testing.assert_array_equal(
+        kf.astype(np.int32), np.asarray(ref["newest_keyframe"]).astype(np.int32))
+
+
 def test_affine_step_keyframe_fields():
     rng = random.Random(5)
     packets = [p for p in (random_packet(rng) for _ in range(64))

@@ -18,7 +18,7 @@ def test_pallas_parse_matches_jnp_fuzzed():
     out = {k: np.asarray(v) for k, v in
            parse_packets_pallas(pre, ln, interpret=True).items()}
     for key in ("seq", "timestamp", "ssrc", "payload_start", "nal_type",
-                "keyframe_first", "frame_first", "frame_last"):
+                "keyframe_first", "frame_first", "frame_last", "marker"):
         np.testing.assert_array_equal(out[key], ref[key], err_msg=key)
 
 
