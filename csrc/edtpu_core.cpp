@@ -127,28 +127,36 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
     int n_segs = 0;
     int n_ops = 0;  // ops consumed by this super (== n_segs)
   };
-  std::vector<mmsghdr> msgs(kSupers);
-  std::vector<Super> supers(kSupers);
+  // per-thread scratch: this runs once per source per window
+  static thread_local std::vector<mmsghdr> msgs(kSupers);
+  static thread_local std::vector<Super> supers(kSupers);
   // worst case: every segment is its own iovec pair
-  std::vector<iovec> iovs(static_cast<size_t>(kSupers) * 2 * UDP_MAX_SEGMENTS);
-  std::vector<uint8_t> hdrs(static_cast<size_t>(kSupers) * UDP_MAX_SEGMENTS *
-                            12);
+  static thread_local std::vector<iovec> iovs(
+      static_cast<size_t>(kSupers) * 2 * UDP_MAX_SEGMENTS);
+  static thread_local std::vector<uint8_t> hdrs(
+      static_cast<size_t>(kSupers) * UDP_MAX_SEGMENTS * 12);
   size_t iov_used = 0, hdr_used = 0;
 
   int32_t done = 0;  // ops fully handed to the kernel
   int32_t staged = 0;  // ops rendered into the current flush window
   int n_super = 0;
+  int flush_err = 0;  // hard errno from the last flush (0 = none)
 
+  // Returns ops actually handed to the kernel (counting partially-flushed
+  // windows), sets flush_err on a hard error.  Callers add the count to
+  // `done` before acting on the error, so a caller retrying the remainder
+  // through the non-GSO path never duplicates a delivered datagram.
   auto flush = [&]() -> int32_t {
     int sent = 0;
+    flush_err = 0;
     while (sent < n_super) {
       int n = sendmmsg(fd, msgs.data() + sent, n_super - sent, 0);
       if (n < 0) {
         if (errno == EINTR) continue;
+        if (errno != EAGAIN && errno != EWOULDBLOCK) flush_err = errno;
         int32_t ops_sent = 0;
         for (int i = 0; i < sent; ++i) ops_sent += supers[i].n_ops;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) return ops_sent;
-        return -errno;
+        return ops_sent;
       }
       sent += n;
     }
@@ -228,16 +236,16 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
     if (n_super == kSupers ||
         iov_used + 2 * UDP_MAX_SEGMENTS > iovs.size()) {
       int32_t r = flush();
-      if (r < 0) return r;
       done += r;
+      if (flush_err) return done > 0 ? done : -flush_err;
       if (r < staged) return done;  // EAGAIN mid-window: bookmark kept
       staged = 0;
     }
   }
   if (n_super > 0) {
     int32_t r = flush();
-    if (r < 0) return r;
     done += r;
+    if (flush_err && done == 0) return -flush_err;
   }
   return done;
 }

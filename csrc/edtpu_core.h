@@ -64,9 +64,11 @@ int32_t ed_fanout_send_udp(int fd,
  * (last may be shorter), cutting per-datagram syscall/route/skb setup ~40x.
  * A mid-run length change or subscriber change flushes the current
  * super-send, so variable-size traffic degrades gracefully toward the
- * plain path.  Returns ops sent (EAGAIN stops at a super-send boundary,
- * preserving bookmark semantics), or negative errno; -EOPNOTSUPP/-EINVAL
- * from the first send may mean no kernel GSO — callers fall back. */
+ * plain path.  Returns ops handed to the kernel (EAGAIN and hard errors
+ * both stop at a super-send boundary and report the delivered count, so
+ * a caller retrying the remainder never duplicates a datagram);
+ * negative errno only when NOTHING was sent — -EINVAL/-EOPNOTSUPP there
+ * means no kernel GSO and callers fall back to ed_fanout_send_udp. */
 int32_t ed_fanout_send_udp_gso(int fd,
                                const uint8_t *ring_data,
                                const int32_t *ring_len,

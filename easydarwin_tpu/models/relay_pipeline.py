@@ -31,6 +31,7 @@ class RelayPipelineConfig:
     bucket_delay_ms: int = 73
     use_pallas_parse: bool = False
     mode: str = "affine"         # "affine" | "headers"
+    codec: str = "h264"          # "h264" | "mjpeg" (per-stream classifier)
 
 
 class RelayPipeline:
@@ -40,7 +41,8 @@ class RelayPipeline:
             _pipeline_step,
             use_pallas=self.config.use_pallas_parse,
             mode=self.config.mode,
-            bucket_delay_ms=self.config.bucket_delay_ms))
+            bucket_delay_ms=self.config.bucket_delay_ms,
+            codec=self.config.codec))
 
     def __call__(self, prefix, length, age_ms, out_state, buckets):
         return self._step(prefix, length, age_ms, out_state, buckets)
@@ -61,9 +63,16 @@ class RelayPipeline:
 
 
 def _pipeline_step(prefix, length, age_ms, out_state, buckets, *,
-                   use_pallas: bool, mode: str, bucket_delay_ms: int):
-    parse_fn = parse_packets_pallas if use_pallas else parse_packets
-    fields = parse_fn(prefix, length)
+                   use_pallas: bool, mode: str, bucket_delay_ms: int,
+                   codec: str = "h264"):
+    # the Pallas kernel is the H.264 hot path; MJPEG classification is a
+    # cheap jnp formula, so it always takes the reference path
+    from ..ops.parse import normalize_codec
+    if normalize_codec(codec) != "h264":
+        fields = parse_packets(prefix, length, codec=codec)
+    else:
+        parse_fn = parse_packets_pallas if use_pallas else parse_packets
+        fields = parse_fn(prefix, length)
     valid = length > 0
     kf = fields["keyframe_first"] & valid
     out = {

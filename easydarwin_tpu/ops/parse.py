@@ -37,19 +37,23 @@ def _byte_at(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.take_along_axis(x, idx[:, None], axis=1)[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("is_video",))
-def parse_packets(prefix: jnp.ndarray, length: jnp.ndarray,
-                  is_video: bool = True) -> dict[str, jnp.ndarray]:
-    """Parse a ``[P, W]`` uint8 prefix batch.
+def normalize_codec(codec: str) -> str:
+    """Map SDP / user codec spellings onto the two classifier families.
 
-    Returns dict of ``[P]`` vectors: ``seq``, ``timestamp`` (uint32),
-    ``ssrc`` (uint32), ``marker``, ``payload_start`` (12+4·CC, the
-    reference's extension-blind header size), ``nal_type`` (effective, per
-    the oracle's aggregation/FU resolution), ``keyframe_first``,
-    ``frame_first``, ``frame_last`` (bool).
-    """
-    x = prefix.astype(jnp.int32)
-    length = length.astype(jnp.int32)
+    "H264"/"AVC" → "h264"; "JPEG"/"MJPEG" (RFC 2435) → "mjpeg".  Unknown
+    names raise — silently falling through to the NALU walk would
+    mis-classify every packet of a non-H.264 stream."""
+    c = codec.strip().lower()
+    if c in ("h264", "avc", "avc1", ""):
+        return "h264"
+    if c in ("mjpeg", "jpeg", "mjpg"):
+        return "mjpeg"
+    raise ValueError(f"unsupported video codec for device classify: {codec!r}")
+
+
+def _fixed_header_fields(x: jnp.ndarray):
+    """Shared RTP fixed-header extraction: (b0, b1, cc, hs, seq, ts, ssrc,
+    marker) — the one place that knows the wire byte offsets."""
     b0, b1 = x[:, 0], x[:, 1]
     cc = b0 & 0x0F
     hs = 12 + 4 * cc
@@ -59,6 +63,31 @@ def parse_packets(prefix: jnp.ndarray, length: jnp.ndarray,
     ssrc = ((x[:, 8] << 24) | (x[:, 9] << 16) | (x[:, 10] << 8) | x[:, 11]
             ).astype(jnp.uint32)
     marker = (b1 & 0x80) != 0
+    return b0, b1, cc, hs, seq, ts, ssrc, marker
+
+
+@functools.partial(jax.jit, static_argnames=("is_video", "codec"))
+def parse_packets(prefix: jnp.ndarray, length: jnp.ndarray,
+                  is_video: bool = True, codec: str = "h264"
+                  ) -> dict[str, jnp.ndarray]:
+    """Parse a ``[P, W]`` uint8 prefix batch.
+
+    Returns dict of ``[P]`` vectors: ``seq``, ``timestamp`` (uint32),
+    ``ssrc`` (uint32), ``marker``, ``payload_start`` (12+4·CC, the
+    reference's extension-blind header size), ``nal_type`` (effective, per
+    the oracle's aggregation/FU resolution), ``keyframe_first``,
+    ``frame_first``, ``frame_last`` (bool).
+
+    ``codec`` selects the classifier (static — one compiled program per
+    stream codec): "h264" walks NALU types; "mjpeg" (RFC 2435) marks
+    fragment-offset-0 packets keyframe-first, mirroring
+    ``protocol.mjpeg.is_frame_first_packet``.
+    """
+    if normalize_codec(codec) == "mjpeg":
+        return _parse_packets_mjpeg(prefix, length, is_video)
+    x = prefix.astype(jnp.int32)
+    length = length.astype(jnp.int32)
+    b0, b1, cc, hs, seq, ts, ssrc, marker = _fixed_header_fields(x)
 
     classifiable = (length >= _MIN_CLASSIFY_LEN) & (length > hs)
     nal0 = _byte_at(x, hs) & 0x1F
@@ -88,4 +117,28 @@ def parse_packets(prefix: jnp.ndarray, length: jnp.ndarray,
         "payload_start": hs, "nal_type": eff,
         "keyframe_first": kf & classifiable,
         "frame_first": frame_first, "frame_last": frame_last,
+    }
+
+
+def _parse_packets_mjpeg(prefix: jnp.ndarray, length: jnp.ndarray,
+                         is_video: bool) -> dict[str, jnp.ndarray]:
+    """RFC 2435 classification: frame start ⇔ 24-bit fragment offset 0.
+
+    The offset lives at payload bytes 1-3 (after the 8-byte main JPEG
+    header begins at ``hs``); every frame start is a keyframe because JPEG
+    frames are independently decodable."""
+    x = prefix.astype(jnp.int32)
+    length = length.astype(jnp.int32)
+    _b0, _b1, _cc, hs, seq, ts, ssrc, marker = _fixed_header_fields(x)
+    classifiable = length >= hs + 8           # full RFC 2435 main header
+    frag_off = ((_byte_at(x, hs + 1) << 16) | (_byte_at(x, hs + 2) << 8)
+                | _byte_at(x, hs + 3))
+    frame_first = classifiable & (frag_off == 0)
+    kf = frame_first if is_video else jnp.zeros_like(frame_first)
+    return {
+        "seq": seq, "timestamp": ts, "ssrc": ssrc, "marker": marker,
+        "payload_start": hs, "nal_type": jnp.full_like(seq, -1),
+        "keyframe_first": kf,
+        "frame_first": frame_first,
+        "frame_last": classifiable & marker,
     }

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..protocol import nalu, rtp
+from ..protocol import mjpeg, nalu, rtp
 
 #: ReflectorStream.h:127 kMaxReflectorPacketSize
 SLOT_SIZE = 2060
@@ -43,10 +43,17 @@ class PacketRing:
     """Bounded packet store with absolute ids ``[tail, head)``."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
-                 slot_size: int = SLOT_SIZE, is_video: bool = False):
+                 slot_size: int = SLOT_SIZE, is_video: bool = False,
+                 codec: str | None = None):
+        """``codec`` selects the ingest classifier: "H264" (default for
+        video) walks NALU types; "JPEG"/"MJPEG" (RFC 2435) marks every
+        fragment-offset-0 packet keyframe-first — each JPEG frame is
+        independently decodable, so MJPEG late-joiners fast-start on any
+        frame boundary (the reference only special-cases H.264)."""
         self.capacity = capacity
         self.slot_size = slot_size
         self.is_video = is_video
+        self.codec = (codec or ("H264" if is_video else "")).upper()
         self.data = np.zeros((capacity, slot_size), dtype=np.uint8)
         self.length = np.zeros(capacity, dtype=np.int32)
         self.arrival = np.zeros(capacity, dtype=np.int64)
@@ -91,10 +98,14 @@ class PacketRing:
         else:
             if self.is_video:
                 f |= PacketFlags.VIDEO
-                if nalu.is_keyframe_first_packet(packet):
-                    f |= PacketFlags.KEYFRAME_FIRST
-                if nalu.is_frame_first_packet(packet):
-                    f |= PacketFlags.FRAME_FIRST
+                if self.codec in ("JPEG", "MJPEG", "MJPG"):
+                    if mjpeg.is_frame_first_packet(packet):
+                        f |= PacketFlags.KEYFRAME_FIRST | PacketFlags.FRAME_FIRST
+                else:
+                    if nalu.is_keyframe_first_packet(packet):
+                        f |= PacketFlags.KEYFRAME_FIRST
+                    if nalu.is_frame_first_packet(packet):
+                        f |= PacketFlags.FRAME_FIRST
             if nalu.is_frame_last_packet(packet):
                 f |= PacketFlags.FRAME_LAST
             if n >= 12:

@@ -52,7 +52,8 @@ class RelayStream:
         self.settings = settings or StreamSettings()
         is_video = info.media_type == "video"
         self.rtp_ring = PacketRing(self.settings.ring_capacity,
-                                   is_video=is_video)
+                                   is_video=is_video,
+                                   codec=info.codec or None)
         self.rtcp_ring = PacketRing(min(256, self.settings.ring_capacity))
         #: absolute id of the newest keyframe *run head* (video only).
         #: The reference keeps the newest keyframe-first packet

@@ -1,0 +1,146 @@
+"""RTP/JPEG (RFC 2435): headers, packetize/depacketize, classification,
+ring ingest, and the device classifier vs the host oracle."""
+
+import random
+
+import numpy as np
+
+from easydarwin_tpu.ops import parse
+from easydarwin_tpu.protocol import mjpeg, rtp
+from easydarwin_tpu.relay.ring import PacketFlags, PacketRing
+
+from test_ops_differential import stage
+
+
+def test_header_roundtrip_plain():
+    h = mjpeg.JpegHeader(fragment_offset=0x0102, type=1, q=60,
+                         width=640, height=480)
+    payload = mjpeg.build_payload(h, b"scan")
+    h2, frag = mjpeg.parse_payload(payload)
+    assert (h2.fragment_offset, h2.type, h2.q, h2.width, h2.height) == \
+        (0x0102, 1, 60, 640, 480)
+    assert frag == b"scan"
+
+
+def test_header_roundtrip_restart_and_qtables():
+    qt = mjpeg.make_qtables(75)
+    h = mjpeg.JpegHeader(fragment_offset=0, type=65, q=200, width=320,
+                         height=240, restart_interval=4, qtables=qt)
+    h2, frag = mjpeg.parse_payload(mjpeg.build_payload(h, b"x" * 9))
+    assert h2.restart_interval == 4
+    assert h2.qtables == qt
+    assert frag == b"x" * 9
+
+
+def test_make_qtables_q50_is_base():
+    qt = mjpeg.make_qtables(50)
+    assert qt[:64] == mjpeg._LUMA_Q
+    assert qt[64:] == mjpeg._CHROMA_Q
+    # monotone: lower Q → coarser quantization
+    assert mjpeg.make_qtables(10)[0] > qt[0] > mjpeg.make_qtables(90)[0]
+
+
+def test_packetize_fragments_and_classify():
+    rng = random.Random(1)
+    scan = bytes(rng.getrandbits(8) for _ in range(5000))
+    pkts = mjpeg.packetize_jpeg(scan, width=640, height=480, seq=100,
+                                timestamp=90_000, ssrc=0xABC, mtu=1400)
+    assert len(pkts) > 3
+    # only the first fragment is a frame/keyframe start
+    assert mjpeg.is_frame_first_packet(pkts[0])
+    assert not any(mjpeg.is_frame_first_packet(p) for p in pkts[1:])
+    # marker only on the last
+    markers = [rtp.RtpPacket.parse(p).marker for p in pkts]
+    assert markers == [False] * (len(pkts) - 1) + [True]
+    # offsets are contiguous and cover the scan
+    total = 0
+    for p in pkts:
+        h, frag = mjpeg.parse_payload(rtp.RtpPacket.parse(p).payload)
+        assert h.fragment_offset == total
+        total += len(frag)
+    assert total == len(scan)
+
+
+def test_depacketize_roundtrip_jfif():
+    rng = random.Random(2)
+    scan = bytes(rng.getrandbits(8) for _ in range(3000))
+    pkts = mjpeg.packetize_jpeg(scan, width=320, height=240, seq=7,
+                                timestamp=1234, ssrc=9, q=80, mtu=500)
+    d = mjpeg.JpegDepacketizer()
+    out = None
+    for p in pkts:
+        got = d.push(p)
+        assert out is None
+        out = got if got is not None else out
+        if p is not pkts[-1]:
+            assert got is None or p is pkts[-1]
+    assert out is not None and d.frames_out == 1
+    assert out.startswith(b"\xff\xd8")            # SOI
+    assert out.endswith(b"\xff\xd9")              # EOI
+    assert scan in out                            # scan bytes intact
+    # SOF0 carries the dimensions
+    i = out.find(b"\xff\xc0")
+    assert i > 0
+    h, w = int.from_bytes(out[i + 5:i + 7], "big"), \
+        int.from_bytes(out[i + 7:i + 9], "big")
+    assert (w, h) == (320, 240)
+
+
+def test_depacketize_drops_on_gap():
+    scan = bytes(range(256)) * 8
+    pkts = mjpeg.packetize_jpeg(scan, width=160, height=120, seq=0,
+                                timestamp=5, ssrc=1, mtu=300)
+    assert len(pkts) >= 3
+    d = mjpeg.JpegDepacketizer()
+    for p in pkts[:1] + pkts[2:]:                 # lose the 2nd fragment
+        out = d.push(p)
+        assert out is None
+    assert d.frames_dropped == 1 and d.frames_out == 0
+
+
+def test_ring_classifies_mjpeg_keyframes():
+    ring = PacketRing(64, is_video=True, codec="JPEG")
+    scan = bytes(100) * 30
+    pkts = mjpeg.packetize_jpeg(scan, width=160, height=120, seq=0,
+                                timestamp=5, ssrc=1, mtu=600)
+    ids = [ring.push(p, 0) for p in pkts]
+    flags = [ring.get_flags(i) for i in ids]
+    assert flags[0] & PacketFlags.KEYFRAME_FIRST
+    assert flags[0] & PacketFlags.FRAME_FIRST
+    assert not any(f & PacketFlags.KEYFRAME_FIRST for f in flags[1:])
+    assert flags[-1] & PacketFlags.FRAME_LAST
+
+
+def test_codec_normalization():
+    import pytest
+    assert parse.normalize_codec("JPEG") == "mjpeg"
+    assert parse.normalize_codec("mjpg") == "mjpeg"
+    assert parse.normalize_codec("H264") == "h264"
+    assert parse.normalize_codec("") == "h264"
+    with pytest.raises(ValueError):
+        parse.normalize_codec("VP8")
+    # SDP-spelled codec goes straight through parse_packets
+    out = parse.parse_packets(np.zeros((4, 96), np.uint8),
+                              np.full(4, 30, np.int32), codec="JPEG")
+    assert int(np.asarray(out["nal_type"])[0]) == -1
+
+
+def test_device_mjpeg_classifier_matches_oracle():
+    rng = random.Random(3)
+    packets = []
+    for _ in range(6):                            # 6 frames, several frags
+        scan = bytes(rng.getrandbits(8) for _ in range(rng.randrange(500, 3000)))
+        packets += mjpeg.packetize_jpeg(scan, width=640, height=480,
+                                        seq=rng.getrandbits(16),
+                                        timestamp=rng.getrandbits(32),
+                                        ssrc=1, mtu=700)
+    packets.append(b"\x80\x1a\x00\x01")           # runt
+    pre, ln = stage(packets)
+    out = parse.parse_packets(pre, ln, codec="mjpeg")
+    kf = np.asarray(out["keyframe_first"])
+    ff = np.asarray(out["frame_first"])
+    for i, p in enumerate(packets):
+        expect = mjpeg.is_frame_first_packet(p)
+        assert bool(kf[i]) == expect, i
+        assert bool(ff[i]) == expect, i
+    assert np.asarray(out["nal_type"])[0] == -1

@@ -76,6 +76,39 @@ def test_late_joiner_fast_start_from_keyframe():
     assert seqs[0] == 5
 
 
+def test_late_joiner_fast_start_mjpeg_frame_boundary():
+    """BASELINE config 3 (mixed codecs): an MJPEG late-joiner fast-starts
+    at the newest frame start, never mid-frame."""
+    from easydarwin_tpu.protocol import mjpeg
+    from easydarwin_tpu.relay.stream import RelayStream
+
+    info = sdp.parse("v=0\r\nm=video 0 RTP/AVP 26\r\n"
+                     "a=rtpmap:26 JPEG/90000\r\na=control:trackID=1\r\n"
+                     ).streams[0]
+    assert info.codec == "JPEG"
+    st = RelayStream(info)
+    pkts = []
+    for ts in (1000, 4000):                   # two frames, 3 fragments each
+        pkts += mjpeg.packetize_jpeg(bytes(1200), width=160, height=120,
+                                     seq=len(pkts), timestamp=ts, ssrc=5,
+                                     mtu=500)
+    assert len(pkts) >= 6
+    for i, p in enumerate(pkts):
+        st.push_rtp(p, 1000 + i)
+    out = CollectingOutput(ssrc=1)
+    st.add_output(out)
+    st.reflect(2000)
+    # starts exactly at the 2nd frame's first fragment
+    first = rtp.RtpPacket.parse(out.rtp_packets[0])
+    h, _ = mjpeg.parse_payload(first.payload)
+    assert h.fragment_offset == 0
+    # all relayed packets belong to one (the newest) frame
+    assert len({rtp.RtpPacket.parse(p).timestamp
+                for p in out.rtp_packets}) == 1
+    n_frame2 = len(pkts) - len(pkts) // 2
+    assert len(out.rtp_packets) == n_frame2
+
+
 def test_new_output_skips_stale_when_no_keyframe():
     st = mkstream(overbuffer_ms=1000)
     st.push_rtp(vid_pkt(1), 0)        # age 5000 at join: outside overbuffer
