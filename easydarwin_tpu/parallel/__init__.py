@@ -17,3 +17,5 @@ unchanged (it is orthogonal to the data path) for multi-host DCN scale-out.
 
 from .mesh import (make_relay_mesh, sharded_relay_step,  # noqa: F401
                    example_batch)
+from .distributed import (init_from_env, make_cluster_mesh,  # noqa: F401
+                          process_span)

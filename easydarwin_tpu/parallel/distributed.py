@@ -1,0 +1,107 @@
+"""Multi-host scale-out: the distributed communication backend.
+
+The reference scales across machines with a control plane only — Redis
+presence keys plus EasyCMS redirection (``EasyRedisHandler.cpp:177-335``);
+each EasyDarwin's data plane is confined to one box.  Here the data plane
+itself can span hosts: JAX collectives ride **ICI** inside a slice and
+**DCN** across hosts, so a relay fleet can shard sources/subscribers over
+a multi-host pod while keeping the same Redis/EasyProtocol control plane
+(``cluster/``) for discovery.
+
+Wire-up order on every host of the fleet::
+
+    from easydarwin_tpu.parallel import distributed
+    distributed.init_from_env()          # jax.distributed.initialize
+    mesh = distributed.make_cluster_mesh(sub=2)   # DCN-aware relay mesh
+    step = parallel.mesh.sharded_relay_step(mesh)
+
+Axis placement matters: ``src`` (sources) is the outermost axis and the
+only one allowed to cross the DCN boundary — per-source relay math is
+embarrassingly parallel, so DCN carries zero steady-state traffic.
+``sub``/``win`` collectives (keyframe ``pmax``, fleet ``psum``) stay on
+ICI within each host's slice.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXES, make_relay_mesh
+
+_initialized = False
+
+
+def init_from_env(coordinator: str | None = None,
+                  num_processes: int | None = None,
+                  process_id: int | None = None) -> bool:
+    """Initialize ``jax.distributed`` for multi-host operation.
+
+    Arguments fall back to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``; cloud TPU metadata makes even those optional).
+    A no-op (returns False) when neither arguments nor env describe a
+    fleet — single-host deployments never pay the rendezvous.
+    Idempotent: repeated calls after success return True.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_str = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(num_str) if num_str else None)
+    pid_str = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(pid_str) if pid_str else None)
+    if coordinator is None and num_processes is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def make_cluster_mesh(*, sub: int = 1, win: int = 1,
+                      devices=None) -> Mesh:
+    """Relay mesh for the whole fleet, DCN-aware.
+
+    Devices are laid out host-major: ``src`` is factored as
+    ``(num_hosts × local_src)`` so slicing the ``src`` axis never splits a
+    host's devices across a DCN boundary, and the ``sub``/``win``
+    collectives (pmax/psum) always resolve within one host's ICI domain.
+    Requires ``sub·win`` to divide each host's local device count.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if n % (sub * win):
+        raise ValueError(f"{n} devices not divisible by sub*win={sub * win}")
+    # host-major ordering: jax.devices() already groups by process; make it
+    # explicit so a reordered backend cannot interleave hosts inside a slice
+    devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    arr = np.array(devices).reshape(n // (sub * win), sub, win)
+    return Mesh(arr, AXES)
+
+
+def process_span(mesh: Mesh) -> dict:
+    """Describe how the mesh maps onto processes (for REST getserverinfo
+    and logs): total hosts, local device count, and whether any non-src
+    axis crosses a process boundary (it never should — see module doc)."""
+    devs = mesh.devices
+    procs = {d.process_index for d in devs.flat}
+    cross = False
+    for i in range(devs.shape[0]):
+        if len({d.process_index for d in devs[i].flat}) > 1:
+            cross = True
+    return {"num_processes": len(procs),
+            "local_devices": jax.local_device_count(),
+            "non_src_axis_crosses_hosts": cross,
+            "mesh_shape": dict(zip(AXES, devs.shape))}
+
+
+__all__ = ["init_from_env", "make_cluster_mesh", "make_relay_mesh",
+           "process_span"]

@@ -123,6 +123,51 @@ class App:
         return _hdr(APP, self.subtype, len(body)) + body
 
 
+@dataclass
+class NaduBlock:
+    """One per-source block of a 3GPP TS 26.234 NADU APP packet
+    (``RTCPAPPNADUPacket.cpp``): receiver buffer feedback driving the
+    reference's rate adaptation alongside thinning."""
+
+    ssrc: int
+    playout_delay_ms: int = 0xFFFF    # 0xFFFF = not known
+    nsn: int = 0                      # next RTP seq to decode
+    nun: int = 0                      # next ADU to decode (5 bits)
+    free_buffer_64b: int = 0          # free buffer space, 64-byte units
+
+    def to_bytes(self) -> bytes:
+        return struct.pack("!IHHBBH", self.ssrc,
+                           self.playout_delay_ms & 0xFFFF, self.nsn & 0xFFFF,
+                           0, self.nun & 0x1F, self.free_buffer_64b & 0xFFFF)
+
+    @classmethod
+    def parse(cls, body: bytes, off: int) -> "NaduBlock":
+        ssrc, delay, nsn, _rsvd, nun, fbs = struct.unpack_from(
+            "!IHHBBH", body, off)
+        return cls(ssrc, delay, nsn, nun & 0x1F, fbs)
+
+
+@dataclass
+class Nadu:
+    """NADU APP packet: name "PSS0", one 12-byte block per observed SSRC."""
+
+    ssrc: int                         # sender of the feedback
+    blocks: list[NaduBlock] = field(default_factory=list)
+
+    NAME = "PSS0"
+
+    def to_bytes(self) -> bytes:
+        return App(self.ssrc, self.NAME, subtype=0,
+                   data=b"".join(b.to_bytes() for b in self.blocks)).to_bytes()
+
+    @classmethod
+    def from_app(cls, app: "App") -> "Nadu | None":
+        if app.name != cls.NAME or len(app.data) % 12:
+            return None
+        return cls(app.ssrc, [NaduBlock.parse(app.data, i)
+                              for i in range(0, len(app.data), 12)])
+
+
 def _hdr(ptype: int, count: int, body_len: int) -> bytes:
     if body_len % 4:
         raise RtcpError("RTCP body must be 32-bit aligned")
@@ -165,8 +210,9 @@ def parse_compound(data: bytes) -> list[object]:
             out.append(bye)
         elif ptype == APP and len(body) >= 8:
             ssrc = struct.unpack_from("!I", body)[0]
-            out.append(App(ssrc, body[4:8].decode("ascii", "replace"),
-                           subtype=count, data=body[8:]))
+            app = App(ssrc, body[4:8].decode("ascii", "replace"),
+                      subtype=count, data=body[8:])
+            out.append(Nadu.from_app(app) or app)
         elif ptype == SDES:
             sd = Sdes()
             coff = 0

@@ -67,3 +67,36 @@ def test_win_axis_keyframe_offset():
     args = shard_args(mesh, prefix, length, age, out_state, buckets)
     _h, _m, kf, _t = step(*args)
     assert int(np.asarray(kf)[0]) == 61
+
+
+def test_cluster_mesh_host_major_and_span():
+    from easydarwin_tpu.parallel import distributed
+
+    mesh = distributed.make_cluster_mesh(sub=2, win=2)
+    assert mesh.devices.shape == (2, 2, 2)
+    span = distributed.process_span(mesh)
+    assert span["num_processes"] == 1          # single-process test env
+    assert span["non_src_axis_crosses_hosts"] is False
+    assert span["mesh_shape"] == {"src": 2, "sub": 2, "win": 2}
+    with pytest.raises(ValueError):
+        distributed.make_cluster_mesh(sub=3)   # 8 % 3 != 0
+
+
+def test_cluster_mesh_runs_sharded_step():
+    from easydarwin_tpu.parallel import distributed
+
+    mesh = distributed.make_cluster_mesh(sub=2, win=2)
+    step = sharded_relay_step(mesh)
+    args = example_batch(n_src=2, n_sub=4, n_pkt=32)
+    headers, mask, kf, eligible = step(*shard_args(mesh, *args))
+    assert headers.shape == (2, 4, 32, 12)
+    assert int(kf[0]) >= 0
+
+
+def test_init_from_env_noop_without_fleet(monkeypatch):
+    from easydarwin_tpu.parallel import distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.init_from_env() is False   # single host: no rendezvous

@@ -100,3 +100,24 @@ def test_ntp_helpers():
     assert ts >> 32 == 1_700_000_000 + rtcp.NTP_EPOCH_DELTA
     assert abs((ts & 0xFFFFFFFF) - (1 << 31)) < 10
     assert rtcp.ntp_middle32(ts) == (ts >> 16) & 0xFFFFFFFF
+
+
+def test_rtcp_nadu_roundtrip():
+    n = rtcp.Nadu(0x1234, [
+        rtcp.NaduBlock(0xAAAA, playout_delay_ms=250, nsn=500, nun=3,
+                       free_buffer_64b=1024),
+        rtcp.NaduBlock(0xBBBB)])
+    wire = n.to_bytes()
+    (got,) = rtcp.parse_compound(wire)
+    assert isinstance(got, rtcp.Nadu)
+    assert got.ssrc == 0x1234 and len(got.blocks) == 2
+    b0 = got.blocks[0]
+    assert (b0.ssrc, b0.playout_delay_ms, b0.nsn, b0.nun,
+            b0.free_buffer_64b) == (0xAAAA, 250, 500, 3, 1024)
+    assert got.blocks[1].playout_delay_ms == 0xFFFF   # "not known" default
+
+
+def test_rtcp_non_nadu_app_stays_app():
+    a = rtcp.App(7, "qtak", data=b"\x00" * 8)
+    (got,) = rtcp.parse_compound(a.to_bytes())
+    assert isinstance(got, rtcp.App) and got.name == "qtak"
