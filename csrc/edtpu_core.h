@@ -102,8 +102,16 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
 
 /* Discard-drain every pending datagram on each fd (recvmmsg, MSG_DONTWAIT).
  * A cheap stand-in for N subscriber read loops: one syscall drains a batch,
- * no per-datagram userspace work.  Returns total datagrams discarded. */
+ * no per-datagram userspace work (zero-length iovecs + MSG_TRUNC — the
+ * kernel frees each datagram without copying payload).  Returns total
+ * datagrams discarded. */
 int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds);
+
+/* As ed_udp_drain, but also sums the true (pre-truncation) datagram sizes
+ * into *out_bytes.  With UDP_GRO receivers a "datagram" here is a coalesced
+ * super-datagram; bytes / wire-packet-size recovers the wire count. */
+int64_t ed_udp_drain_ex(const int32_t *fds, int32_t n_fds,
+                        int64_t *out_bytes);
 
 /* ------------------------------------------------------------- timer wheel */
 

@@ -55,6 +55,19 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
+        if not hasattr(lib, "ed_udp_drain_ex"):
+            # stale prebuilt .so from an older source tree: rebuild in place
+            # (make relinks to a fresh inode, so a second dlopen maps the
+            # new library; the old one is never deleted, in case no
+            # toolchain is present) and re-load once
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                return None
+            if not hasattr(lib, "ed_udp_drain_ex"):
+                return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -69,6 +82,8 @@ def _load():
         lib.ed_fanout_send_udp_gso.argtypes = lib.ed_fanout_send_udp.argtypes
         lib.ed_udp_drain.restype = ctypes.c_int64
         lib.ed_udp_drain.argtypes = [i32p, ctypes.c_int32]
+        lib.ed_udp_drain_ex.restype = ctypes.c_int64
+        lib.ed_udp_drain_ex.argtypes = [i32p, ctypes.c_int32, i64p]
         lib.ed_fanout_render.restype = ctypes.c_int32
         lib.ed_fanout_render.argtypes = [
             u8p, i32p, ctypes.c_int32, ctypes.c_int32,
@@ -178,6 +193,18 @@ def udp_drain(fds: list[int]) -> int:
     assert lib is not None
     arr = np.asarray(fds, dtype=np.int32)
     return lib.ed_udp_drain(_i32(arr), len(fds))
+
+
+def udp_drain_ex(fds: list[int]) -> tuple[int, int]:
+    """Discard-drain; returns (messages, total_bytes).  With UDP_GRO
+    receivers, messages are coalesced super-datagrams and
+    bytes // wire_packet_size recovers the wire-packet count."""
+    lib = _load()
+    assert lib is not None
+    arr = np.asarray(fds, dtype=np.int32)
+    b = ctypes.c_int64(0)
+    n = lib.ed_udp_drain_ex(_i32(arr), len(fds), ctypes.byref(b))
+    return n, b.value
 
 
 def fanout_render(ring_data: np.ndarray, ring_len: np.ndarray,

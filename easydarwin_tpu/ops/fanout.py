@@ -145,6 +145,39 @@ def relay_affine_step_packed(prefix: jnp.ndarray, length: jnp.ndarray,
         [out["seq_off"], out["ts_off"], out["ssrc"], kf], axis=-1)
 
 
+#: bytes appended to each packet prefix to carry its length (le32)
+WINDOW_EXTRA = 4
+
+
+def pack_window(prefix, length):
+    """Host helper: [..., P, 96] prefixes + [..., P] lengths → ONE uint8
+    array [..., P, 100] (length rides as 4 trailing le bytes).
+
+    A tunneled device pays a fixed RPC cost per transfer; fusing the two
+    H2D arrays halves the upload round-trips per window."""
+    import numpy as np
+    prefix = np.asarray(prefix, np.uint8)
+    length = np.ascontiguousarray(length, "<u4")  # le bytes match the decode
+    lb = length[..., None].view(np.uint8)
+    return np.concatenate([prefix, lb], axis=-1)
+
+
+@jax.jit
+def relay_affine_step_window(window: jnp.ndarray,
+                             out_state: jnp.ndarray) -> jnp.ndarray:
+    """``relay_affine_step_packed`` taking the fused ``pack_window`` layout.
+
+    ``window``: [N_SRC, P, 96+4] uint8 — the only per-pass H2D transfer;
+    ``out_state``: [N_SRC, S, STATE_COLS] uint32 — subscriber state, kept
+    device-resident by the caller (it changes on subscribe/unsubscribe, not
+    per window, so it should never ride the per-window upload)."""
+    prefix = window[:, :, :96]
+    lb = window[:, :, 96:].astype(jnp.uint32)
+    length = (lb[..., 0] | (lb[..., 1] << 8) | (lb[..., 2] << 16)
+              | (lb[..., 3] << 24)).astype(jnp.int32)
+    return relay_affine_step_packed(prefix, length, out_state)
+
+
 def unpack_affine(packed, n_sub: int):
     """Host-side views into the packed egress params.
 

@@ -42,9 +42,11 @@ def init_from_env(coordinator: str | None = None,
 
     Arguments fall back to the standard env vars
     (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
-    ``JAX_PROCESS_ID``; cloud TPU metadata makes even those optional).
-    A no-op (returns False) when neither arguments nor env describe a
-    fleet — single-host deployments never pay the rendezvous.
+    ``JAX_PROCESS_ID``).  A no-op (returns False) when neither arguments
+    nor env describe a fleet — single-host deployments never pay the
+    rendezvous.  On cloud TPU pods where the runtime supplies rendezvous
+    metadata, set ``JAX_NUM_PROCESSES`` (or pass any argument) to opt in;
+    ``jax.distributed.initialize`` then fills the gaps from metadata.
     Idempotent: repeated calls after success return True.
     """
     global _initialized
@@ -57,6 +59,8 @@ def init_from_env(coordinator: str | None = None,
     pid_str = os.environ.get("JAX_PROCESS_ID")
     process_id = process_id if process_id is not None else (
         int(pid_str) if pid_str else None)
+    # a process id alone can never describe a fleet — require a coordinator
+    # or a process count (argument or env) before paying the rendezvous
     if coordinator is None and num_processes is None:
         return False
     jax.distributed.initialize(coordinator_address=coordinator,
@@ -83,6 +87,17 @@ def make_cluster_mesh(*, sub: int = 1, win: int = 1,
     # host-major ordering: jax.devices() already groups by process; make it
     # explicit so a reordered backend cannot interleave hosts inside a slice
     devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+    # every host must contribute whole (sub × win) tiles, or a src row
+    # would straddle hosts and put sub/win collectives on DCN
+    per_host: dict[int, int] = {}
+    for d in devices:
+        per_host[d.process_index] = per_host.get(d.process_index, 0) + 1
+    for proc, cnt in per_host.items():
+        if cnt % (sub * win):
+            raise ValueError(
+                f"host {proc} has {cnt} devices, not divisible by "
+                f"sub*win={sub * win}; a src row would cross the DCN "
+                f"boundary (see module doc)")
     arr = np.array(devices).reshape(n // (sub * win), sub, win)
     return Mesh(arr, AXES)
 

@@ -78,6 +78,30 @@ def test_packed_step_equals_dict_step():
         kf.astype(np.int32), np.asarray(ref["newest_keyframe"]).astype(np.int32))
 
 
+def test_window_step_equals_packed_step():
+    """pack_window ∘ relay_affine_step_window ≡ relay_affine_step_packed
+    (the fused single-H2D layout decodes to the same egress params)."""
+    rng = random.Random(11)
+    n_src, n_sub = 2, 7
+    packets = [p for p in (random_packet(rng) for _ in range(48))
+               if len(p) >= 12]
+    pre1, ln1 = stage(packets)
+    pre = np.broadcast_to(pre1[None], (n_src,) + pre1.shape).copy()
+    ln = np.broadcast_to(ln1[None], (n_src,) + ln1.shape).copy()
+    outs = [CollectingOutput(ssrc=rng.getrandbits(32),
+                             out_seq_start=rng.getrandbits(16),
+                             out_ts_start=rng.getrandbits(32))
+            for _ in range(n_sub)]
+    state1 = fanout.pack_output_state(outs)
+    state = np.broadcast_to(state1[None], (n_src,) + state1.shape).copy()
+
+    window = fanout.pack_window(pre, ln)
+    assert window.shape == pre.shape[:-1] + (96 + fanout.WINDOW_EXTRA,)
+    via_window = np.asarray(fanout.relay_affine_step_window(window, state))
+    via_packed = np.asarray(fanout.relay_affine_step_packed(pre, ln, state))
+    np.testing.assert_array_equal(via_window, via_packed)
+
+
 def test_affine_step_keyframe_fields():
     rng = random.Random(5)
     packets = [p for p in (random_packet(rng) for _ in range(64))
