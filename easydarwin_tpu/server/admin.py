@@ -1,0 +1,110 @@
+"""Admin dictionary-tree browse API (QTSSAdminModule parity).
+
+Reference: ``QTSSAdminModule.cpp:365-1073`` + ``AdminQuery.cpp`` +
+``AdminElementNode.cpp`` — the legacy ``/modules/admin`` API walks the
+server's reflective attribute dictionaries as a filesystem-like tree with
+``command=get|set`` queries, ``*`` wildcards and an optional recurse flag.
+
+Here the same browse semantics sit on the JSON REST port: the tree is
+assembled on demand from live server state (info, prefs, sessions,
+modules), paths are ``/``-separated with a trailing ``*`` to list
+children, and ``command=set`` writes a pref through the same validated
+``ServerConfig.update`` path the setbaseconfig route uses.  The mongoose
+web UI is intentionally superseded by ``/stats`` + this endpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+#: role hook names on server.modules.Module (QTSSModule.h:126-163 analogue)
+ROLE_HOOKS = ("initialize", "shutdown", "reread_prefs", "rtsp_filter",
+              "rtsp_route", "authorize", "rtsp_postprocess",
+              "session_closing", "incoming_rtp")
+
+
+def _roles_of(module) -> list[str]:
+    """Roles a module registers for = hooks it overrides (the dispatch
+    arrays in QTSServer::BuildModuleRoleArrays, rebuilt by reflection)."""
+    return sorted(r for r in ROLE_HOOKS
+                  if any(r in klass.__dict__
+                         for klass in type(module).__mro__[:-2]))
+
+
+def build_tree(app) -> dict[str, Any]:
+    """Assemble the browseable dictionary tree from live server state.
+
+    Mirrors the reference's top-level element list (AdminElementNode
+    ``GetElementFromArray``): server attributes, prefs, connected
+    sessions, loaded modules."""
+    sessions = {}
+    for s in app.live_sessions():
+        sessions[s["Path"].strip("/").replace("/", "~")] = dict(s)
+    cfg = {k: v for k, v in app.config.to_dict().items()
+           if k != "rest_password"}
+    return {
+        "server": {
+            "info": dict(app.server_info()),
+            "prefs": cfg,
+            "sessions": sessions,
+            "modules": {m.name: {"roles": _roles_of(m)}
+                        for m in getattr(app.modules, "modules", [])},
+        },
+    }
+
+
+def query(app, path: str, *, recurse: bool = False) -> tuple[int, Any]:
+    """``command=get`` — resolve a tree path.
+
+    Returns (status, payload).  A trailing ``*`` lists children one level
+    deep (or the whole subtree with ``recurse``); a concrete path returns
+    the node value.  Unknown paths → 404, like the reference's
+    404-in-body answers (QTSSAdminModule.cpp ReportErr)."""
+    tree: Any = build_tree(app)
+    parts = [p for p in path.strip("/").split("/") if p]
+    wildcard = bool(parts) and parts[-1] == "*"
+    if wildcard:
+        parts = parts[:-1]
+    node = tree
+    for part in parts:
+        if not isinstance(node, dict) or part not in node:
+            return 404, {"error": f"no such path: {path}"}
+        node = node[part]
+    if wildcard:
+        if not isinstance(node, dict):
+            return 400, {"error": "wildcard on a leaf"}
+        if recurse:
+            return 200, node
+        return 200, {k: (v if not isinstance(v, dict) else "*container*")
+                     for k, v in node.items()}
+    return 200, node
+
+
+def set_pref(app, path: str, value: str) -> tuple[int, Any]:
+    """``command=set`` — write one pref (server/prefs/<name> only; the
+    reference likewise only honors sets on preference attributes)."""
+    parts = [p for p in path.strip("/").split("/") if p]
+    if len(parts) != 3 or parts[:2] != ["server", "prefs"]:
+        return 400, {"error": "set supports server/prefs/<name> only"}
+    name = parts[2]
+    current = app.config.to_dict()
+    if name not in current:
+        return 404, {"error": f"no such pref: {name}"}
+    old = current[name]
+    # coerce through the current value's type, as GenerateXMLPrefs did
+    try:
+        if isinstance(old, bool):
+            new: Any = value.lower() in ("1", "true", "yes", "on")
+        elif isinstance(old, int):
+            new = int(value)
+        elif isinstance(old, float):
+            new = float(value)
+        else:
+            new = value
+        app.config.update(**{name: new})
+    except (TypeError, ValueError) as e:
+        return 400, {"error": str(e)}
+    if name == "rest_password":        # match the read-side redaction
+        return 200, {name: "(redacted)"}
+    return 200, {name: new, "was": old}

@@ -218,6 +218,29 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
             "File": res["path"], "Samples": str(res["samples"])})
 
+    def _cmd_admin(self, params: dict, body: bytes) -> tuple[int, str]:
+        """Dictionary-tree browse (QTSSAdminModule's /modules/admin API):
+        ``?path=server/prefs/*&command=get[&recurse=1]`` or
+        ``?path=server/prefs/<name>&command=set&value=...``."""
+        from . import admin
+        path = params.get("path", ["server/*"])[0]
+        command = params.get("command", ["get"])[0].lower()
+        if command == "set":
+            status, payload = admin.set_pref(
+                self.app, path, params.get("value", [""])[0])
+        elif command == "get":
+            recurse = params.get("recurse", ["0"])[0] in ("1", "true")
+            status, payload = admin.query(self.app, path, recurse=recurse)
+        else:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": f"unknown command {command}"})
+        if status != 200:
+            return status, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND
+                                  if status == 404 else ep.ERR_BAD_REQUEST,
+                                  body=payload)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK,
+                           body={"Path": path, "Value": payload})
+
     def _webstats_html(self) -> str:
         """HTML stats page (QTSSWebStatsModule.cpp:86-992 equivalent,
         served from the service port instead of RTSP-port HTTP GET)."""

@@ -1,0 +1,97 @@
+"""Small request-metadata parsers (CommonUtilitiesLib misc parity).
+
+* ``parse_user_agent`` — ``UserAgentParser.{h,cpp}``: streaming-client
+  User-Agent strings of the form ``QTS (qtid=...;qtver=...;os=...)`` →
+  the six DSS attributes (qtid/qtver/lang/os/osver/cpu), used by the
+  access log's c-playerid/c-playerversion/c-os/c-osversion/c-cpu columns.
+* ``QueryParamList`` — ``QueryParamList.cpp``: ordered, case-insensitive
+  URL query parameter list (the admin API's ``command=...&parameters`` ).
+* ``rfc1123_date`` / ``parse_rfc1123`` — ``DateTranslator.cpp``: the HTTP
+  Date header format the reference renders into responses.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import re
+import time
+from urllib.parse import parse_qsl, unquote
+
+#: the six attributes DSS understands (UserAgentParser.h:62-70)
+UA_ATTRIBUTES = ("qtid", "qtver", "lang", "os", "osver", "cpu")
+
+
+def parse_user_agent(value: str) -> dict[str, str]:
+    """User-Agent → {attribute: value} for the known DSS attributes.
+
+    Grammar (UserAgentParser.cpp Parse): everything inside the first
+    parenthesized group is ``name=value;`` pairs; values may themselves be
+    parenthesized (e.g. ``os=Mac%20OS%20X``); unknown names are ignored."""
+    out: dict[str, str] = {}
+    start = value.find("(")
+    end = value.rfind(")")
+    body = value[start + 1:end] if 0 <= start < end else value
+    for part in body.split(";"):
+        name, sep, val = part.partition("=")
+        if not sep:
+            continue
+        name = name.strip().lower()
+        if name not in UA_ATTRIBUTES:
+            continue
+        val = unquote(val.strip()).strip('"')
+        if val.startswith("(") and val.endswith(")"):
+            val = val[1:-1]
+        if name not in out:                  # first occurrence wins
+            out[name] = val
+    return out
+
+
+class QueryParamList:
+    """Ordered multi-value query parameter list, case-insensitive names.
+
+    The reference walks the raw query string into a queue of name/value
+    pairs and answers ``DoFindCGIValueForParam`` lookups; both ``&`` and
+    ``;`` separate pairs (QueryParamList.cpp ParseNextParameter)."""
+
+    def __init__(self, query: str):
+        # split on BOTH separators (mixed "a=1&b=2;c=3" is legal to the
+        # reference's parser), then decode each pair
+        self._pairs: list[tuple[str, str]] = []
+        for part in re.split("[&;]", query.lstrip("?")):
+            if not part:
+                continue
+            for name, val in parse_qsl(part, keep_blank_values=True):
+                self._pairs.append((name.lower(), val))
+
+    def get(self, name: str, default: str | None = None) -> str | None:
+        name = name.lower()
+        for n, v in self._pairs:
+            if n == name:
+                return v
+        return default
+
+    def get_all(self, name: str) -> list[str]:
+        name = name.lower()
+        return [v for n, v in self._pairs if n == name]
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    def __iter__(self):
+        return iter(self._pairs)
+
+
+def rfc1123_date(ts: float | None = None) -> str:
+    """Unix time → ``Sun, 06 Nov 1994 08:49:37 GMT`` (DateTranslator's
+    UpdateDateBuffer format, also the HTTP Date header)."""
+    return email.utils.formatdate(
+        time.time() if ts is None else ts, usegmt=True)
+
+
+def parse_rfc1123(value: str) -> float | None:
+    """Inverse of ``rfc1123_date``; honors the timezone field; None on
+    unparseable input."""
+    parsed = email.utils.parsedate_tz(value)
+    if parsed is None:
+        return None
+    return float(email.utils.mktime_tz(parsed))

@@ -102,7 +102,8 @@ class AccessRecord:
 
 W3C_FIELDS = ("c-ip date time cs-uri cs-method sc-status x-duration "
               "sc-bytes sc-packets x-packets-lost cs(User-Agent) "
-              "x-transport")
+              "x-transport c-playerid c-playerversion c-os c-osversion "
+              "c-cpu")
 
 
 class AccessLog:
@@ -118,8 +119,14 @@ class AccessLog:
             self.log.write_line(f"#Fields: {W3C_FIELDS}")
         now = time.gmtime()
         ua = (r.user_agent or "-").replace(" ", "_")
+        # c-playerid/... columns from the DSS User-Agent grammar
+        # (UserAgentParser parity; "-" when the client doesn't send them)
+        from .http_misc import parse_user_agent
+        att = parse_user_agent(r.user_agent or "")
+        cols = " ".join((att.get(k) or "-").replace(" ", "_")
+                        for k in ("qtid", "qtver", "os", "osver", "cpu"))
         self.log.write_line(
             f"{r.client_ip} {time.strftime('%Y-%m-%d', now)} "
             f"{time.strftime('%H:%M:%S', now)} {r.uri} {r.method} "
             f"{r.status} {r.duration_sec:.1f} {r.bytes_sent} "
-            f"{r.packets_sent} {r.packets_lost} {ua} {r.transport}")
+            f"{r.packets_sent} {r.packets_lost} {ua} {r.transport} {cols}")

@@ -1,0 +1,157 @@
+"""x-RTP-Meta-Info, UA/query/date utils, admin dictionary-tree browse."""
+
+import json
+import struct
+
+from easydarwin_tpu.protocol import rtp_meta
+from easydarwin_tpu.utils import http_misc
+
+RTP_HDR = bytes([0x80, 96, 0x12, 0x34]) + (9000).to_bytes(4, "big") \
+    + (0xDEAD).to_bytes(4, "big")
+
+
+def test_meta_header_roundtrip():
+    ids = rtp_meta.parse_header("tt;ft=1;sq=2;md=3")
+    assert ids == {"tt": rtp_meta.UNCOMPRESSED, "ft": 1, "sq": 2, "md": 3}
+    assert rtp_meta.build_header(ids) == "tt;ft=1;sq=2;md=3"
+    # unknown names dropped, junk tolerated
+    assert rtp_meta.parse_header("zz=9;;x;pp") == {
+        "pp": rtp_meta.UNCOMPRESSED}
+
+
+def test_meta_packet_uncompressed_roundtrip():
+    pkt = rtp_meta.build_packet(
+        RTP_HDR, media=b"payload-bytes", transmit_time=123456789,
+        frame_type=rtp_meta.FRAME_KEY, seq=0x1234, packet_number=77,
+        packet_position=4096)
+    info = rtp_meta.parse_packet(pkt)
+    assert info.transmit_time == 123456789
+    assert info.frame_type == rtp_meta.FRAME_KEY
+    assert info.seq == 0x1234
+    assert info.packet_number == 77
+    assert info.packet_position == 4096
+    assert info.media == b"payload-bytes"
+    assert rtp_meta.strip_to_rtp(pkt) == RTP_HDR + b"payload-bytes"
+
+
+def test_meta_packet_compressed_roundtrip():
+    ids = {"tt": 0, "ft": 1, "sq": 2, "md": 3}
+    pkt = rtp_meta.build_packet(RTP_HDR, media=b"m" * 40, field_ids=ids,
+                                transmit_time=55, frame_type=rtp_meta.FRAME_P,
+                                seq=9)
+    # compressed fields really use the 0x80|id form
+    assert pkt[12] == 0x80 | 0
+    info = rtp_meta.parse_packet(pkt, ids)
+    assert (info.transmit_time, info.frame_type, info.seq) == (55, 3, 9)
+    assert info.media == b"m" * 40
+    # without the negotiated map the compressed ids are unknowable
+    blind = rtp_meta.parse_packet(pkt)
+    assert blind.transmit_time is None
+
+
+def test_meta_packet_empty_media():
+    # a trailing zero-length md field still parses (media == b"")
+    for ids in (None, {"md": 3}):
+        pkt = rtp_meta.build_packet(RTP_HDR, media=b"", field_ids=ids)
+        info = rtp_meta.parse_packet(pkt, ids)
+        assert info is not None and info.media == b""
+        assert rtp_meta.strip_to_rtp(pkt, ids) == RTP_HDR
+
+
+def test_meta_packet_corrupt():
+    # wrong length for a fixed-size field → parse failure, like the
+    # reference's kFieldLengthValidator check
+    bad = RTP_HDR + b"sq" + struct.pack(">H", 5) + b"12345"
+    assert rtp_meta.parse_packet(bad) is None
+    assert rtp_meta.parse_packet(b"\x80") is None
+    # truncated field data
+    bad2 = RTP_HDR + b"md" + struct.pack(">H", 99) + b"xx"
+    assert rtp_meta.parse_packet(bad2) is None
+
+
+def test_user_agent_parse():
+    ua = ("QTS (qtid=QuickTime;qtver=7.0.4;lang=en;os=Mac%20OS%20X;"
+          "osver=10.4.6;cpu=PPC) custom/1.0")
+    d = http_misc.parse_user_agent(ua)
+    assert d["qtid"] == "QuickTime"
+    assert d["qtver"] == "7.0.4"
+    assert d["os"] == "Mac OS X"
+    assert d["cpu"] == "PPC"
+    assert http_misc.parse_user_agent("VLC/3.0") == {}
+
+
+def test_query_param_list():
+    q = http_misc.QueryParamList("command=GET&Path=server%2Fprefs&x=1&x=2")
+    assert q.get("COMMAND") == "GET"
+    assert q.get("path") == "server/prefs"
+    assert q.get_all("x") == ["1", "2"]
+    assert q.get("missing", "d") == "d"
+    # semicolon separators, as the reference accepts — even mixed
+    q2 = http_misc.QueryParamList("a=1;b=2")
+    assert q2.get("b") == "2"
+    q3 = http_misc.QueryParamList("a=1&b=2;c=3")
+    assert (q3.get("a"), q3.get("b"), q3.get("c")) == ("1", "2", "3")
+
+
+def test_rfc1123_date_roundtrip():
+    s = http_misc.rfc1123_date(784111777.0)
+    assert s == "Sun, 06 Nov 1994 08:49:37 GMT"
+    assert http_misc.parse_rfc1123(s) == 784111777.0
+    assert http_misc.parse_rfc1123("not a date") is None
+    # non-GMT zones are honored, not silently dropped
+    assert http_misc.parse_rfc1123(
+        "Sun, 06 Nov 1994 08:49:37 +0200") == 784111777.0 - 7200
+
+
+def test_admin_tree_browse():
+    from easydarwin_tpu.server.app import StreamingServer
+    from easydarwin_tpu.server.config import ServerConfig
+    from easydarwin_tpu.server import admin
+
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0))
+    status, listing = admin.query(app, "server/*")
+    assert status == 200 and set(listing) >= {"info", "prefs", "sessions"}
+    status, prefs = admin.query(app, "server/prefs/*", recurse=True)
+    assert status == 200 and "rtsp_port" in prefs
+    assert "rest_password" not in prefs
+    status, port = admin.query(app, "server/prefs/rtsp_port")
+    assert status == 200 and port == 0
+    status, _ = admin.query(app, "server/nope")
+    assert status == 404
+
+    # set through the validated config path, with type coercion
+    status, res = admin.set_pref(app, "server/prefs/bucket_delay_ms", "55")
+    assert status == 200 and app.config.bucket_delay_ms == 55
+    status, _ = admin.set_pref(app, "server/prefs/nope", "1")
+    assert status == 404
+    status, _ = admin.set_pref(app, "server/other/x", "1")
+    assert status == 400
+    # the password never echoes through the set path either
+    status, res = admin.set_pref(app, "server/prefs/rest_password", "s3cret")
+    assert status == 200 and "s3cret" not in str(res) and "was" not in res
+
+
+def test_admin_rest_endpoint():
+    import asyncio
+    from easydarwin_tpu.server.app import StreamingServer
+    from easydarwin_tpu.server.config import ServerConfig
+    from easydarwin_tpu.server.rest import RestApi
+
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0))
+    api = RestApi(app.config, app)
+
+    async def go():
+        res = await api.route(
+            "GET", "/api/v1/admin?path=server/prefs/*&command=get", {}, b"")
+        assert res[0] == 200
+        doc = json.loads(res[1])
+        assert "rtsp_port" in doc["EasyDarwin"]["Body"]["Value"]
+        res = await api.route(
+            "GET", "/api/v1/admin?path=server/prefs/bucket_delay_ms"
+            "&command=set&value=99", {}, b"")
+        assert res[0] == 200 and app.config.bucket_delay_ms == 99
+        res = await api.route(
+            "GET", "/api/v1/admin?path=server/zz&command=get", {}, b"")
+        assert res[0] == 404
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
