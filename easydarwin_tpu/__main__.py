@@ -22,6 +22,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--service-port", type=int, help="REST API port")
     p.add_argument("--bind-ip", help="bind address")
     p.add_argument("--movie-folder", help="VOD media directory")
+    p.add_argument("--module-folder",
+                   help="directory of plugin .py modules (LoadModules)")
     p.add_argument("--tpu-fanout", action="store_true",
                    help="enable the TPU batch fan-out engine")
     p.add_argument("-x", "--exit-after-boot", action="store_true",
@@ -34,7 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
 def config_from_args(args) -> ServerConfig:
     cfg = (ServerConfig.from_toml(args.config) if args.config
            else ServerConfig())
-    for k in ("rtsp_port", "service_port", "bind_ip", "movie_folder"):
+    for k in ("rtsp_port", "service_port", "bind_ip", "movie_folder",
+              "module_folder"):
         v = getattr(args, k)
         if v is not None:
             setattr(cfg, k, v)

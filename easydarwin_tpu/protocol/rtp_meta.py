@@ -159,7 +159,11 @@ def build_packet(rtp_header: bytes, *, media: bytes,
     field_ids = field_ids or {}
 
     def tlv(name: str, payload: bytes) -> bytes:
-        fid = field_ids.get(name, UNCOMPRESSED)
+        # md can never be compressed — its payload exceeds a 1-byte length
+        # (reference asserts kUncompressed for kMediaDataField,
+        # QTHintTrack.cpp:1363, and patches a 16-bit length at :1472)
+        fid = UNCOMPRESSED if name == "md" else field_ids.get(name,
+                                                              UNCOMPRESSED)
         if fid >= 0:
             if len(payload) > 0xFF:
                 raise ValueError(f"{name}: compressed field too long")

@@ -29,7 +29,15 @@ class StreamInfo:
     control: str = ""                 # raw control attribute value
     buffer_delay: float = 3.0         # a=x-bufferdelay
     fmtp: str = ""
+    connection: str = ""              # per-media c= override (multicast relay)
     attributes: dict[str, str] = field(default_factory=dict)
+
+    def dest_address(self, session_connection: str = "") -> str:
+        """The ingest destination from the media-level ``c=`` (falling back
+        to the session-level one): ``IN IP4 239.1.2.3/127`` → ``239.1.2.3``."""
+        conn = self.connection or session_connection
+        parts = conn.split()
+        return parts[-1].split("/")[0] if parts else ""
 
 
 @dataclass
@@ -82,8 +90,11 @@ def parse(text: str | bytes) -> SessionDescription:
             sd.session_name = val
         elif kind == "o":
             sd.origin = val
-        elif kind == "c" and cur is None:
-            sd.connection = val
+        elif kind == "c":
+            if cur is None:
+                sd.connection = val
+            else:
+                cur.connection = val
         elif kind == "a":
             name, _, aval = val.partition(":")
             if cur is None:

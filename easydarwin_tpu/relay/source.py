@@ -1,0 +1,204 @@
+"""SDP-file relay sources: UDP/multicast broadcast ingest.
+
+Reference parity: the reflector's second ingest mode.  Besides ANNOUNCE
+push, ``QTSSReflectorModule`` relays *broadcasts* described by an on-disk
+``.sdp`` file in the movie folder (``DoDescribe`` →
+``FindOrCreateSession``, ``QTSSReflectorModule.cpp:1379``): each media
+section names a UDP port (``m=`` line) and destination (``c=`` line), and
+``ReflectorStream::BindSockets`` binds those ports — joining the IGMP group
+when the ``c=`` address is multicast — so the server can pick a live
+RTP broadcast off the wire and fan it out to unicast RTSP players.
+
+Here each source is a set of asyncio datagram endpoints feeding
+``RelaySession.push``; sockets bind the SDP ports (RTP even / RTCP odd)
+and join multicast groups via ``IP_ADD_MEMBERSHIP``.  Sources are created
+lazily on DESCRIBE/SETUP of a path whose ``<path>.sdp`` exists under the
+movie folder, and reaped by the timeout sweep once viewerless.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ipaddress
+import os
+import socket
+import time
+
+from ..protocol import sdp as sdp_mod
+from .session import RelaySession, SessionRegistry
+
+
+def _is_multicast(addr: str) -> bool:
+    try:
+        return ipaddress.ip_address(addr).is_multicast
+    except ValueError:
+        return False
+
+
+class _IngestProtocol(asyncio.DatagramProtocol):
+    def __init__(self, on_packet):
+        self._on_packet = on_packet
+
+    def datagram_received(self, data, addr):
+        self._on_packet(data)
+
+    def error_received(self, exc):
+        pass
+
+
+async def _open_ingest_socket(port: int, group: str | None, on_packet,
+                              iface_ip: str = "0.0.0.0"):
+    """Bind an ingest socket like ``ReflectorStream::BindSockets``: reusable
+    wildcard bind on the SDP port, plus IGMP join for multicast groups."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("0.0.0.0", port))
+        if group is not None:
+            mreq = socket.inet_aton(group) + socket.inet_aton(iface_ip)
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+        sock.setblocking(False)
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: _IngestProtocol(on_packet), sock=sock)
+    except OSError:
+        sock.close()
+        raise
+    return transport
+
+
+class BroadcastSource:
+    """One live .sdp-described source: bound sockets + its relay session."""
+
+    def __init__(self, path: str, session: RelaySession):
+        self.path = path
+        self.session = session
+        self.transports: list[asyncio.DatagramTransport] = []
+        self.created_at = time.monotonic()
+
+    def close(self) -> None:
+        for t in self.transports:
+            t.close()
+        self.transports.clear()
+
+
+class SdpFileRelaySource:
+    """Movie-folder ``.sdp`` → broadcast relay sessions.
+
+    ``describe(path)`` serves the client-facing SDP (ports zeroed so the
+    player SETUPs through RTSP, exactly like the reflector's rewritten
+    DESCRIBE answer); ``open(path)`` binds ingest and registers the relay
+    session; ``sweep()`` reaps viewerless sources after ``idle_timeout``.
+    """
+
+    def __init__(self, movie_folder: str, registry: SessionRegistry,
+                 *, idle_timeout: float = 20.0, on_ingest=None):
+        self.movie_folder = movie_folder
+        self.registry = registry
+        self.idle_timeout = idle_timeout
+        self.sources: dict[str, BroadcastSource] = {}
+        #: optional hook(path) called on every ingested datagram (pump wake)
+        self.on_ingest = on_ingest
+        self._idle_since: dict[str, float] = {}
+        self._open_lock = asyncio.Lock()    # concurrent SETUPs of one path
+
+    # -- lookup ------------------------------------------------------------
+    def sdp_file_for(self, path: str) -> str | None:
+        rel = sdp_mod._norm(path).lstrip("/")
+        if not rel:
+            return None
+        root = os.path.normpath(os.path.abspath(self.movie_folder))
+        cand = os.path.normpath(os.path.join(root, rel + ".sdp"))
+        if not cand.startswith(root + os.sep):
+            return None                     # traversal attempt
+        return cand if os.path.isfile(cand) else None
+
+    async def describe(self, path: str) -> str | None:
+        fname = self.sdp_file_for(path)
+        if fname is None:
+            return None
+        sd = sdp_mod.parse(_read(fname))
+        # client-facing copy: strip ingest transport details
+        for s in sd.streams:
+            s.connection = ""
+        sd.connection = ""
+        return sdp_mod.build(sd)
+
+    # -- activation --------------------------------------------------------
+    async def open(self, path: str) -> RelaySession | None:
+        key = sdp_mod._norm(path)
+        async with self._open_lock:
+            src = self.sources.get(key)
+            if src is not None:
+                return src.session
+            fname = self.sdp_file_for(path)
+            if fname is None:
+                return None
+            text = _read(fname)
+            session = self.registry.find_or_create(key, text)
+            src = BroadcastSource(key, session)
+            sd = session.description
+            try:
+                for info in sd.streams:
+                    if not info.port:
+                        continue
+                    dest = info.dest_address(sd.connection)
+                    group = dest if _is_multicast(dest) else None
+                    src.transports.append(await _open_ingest_socket(
+                        info.port, group,
+                        self._make_cb(src, info.track_id, is_rtcp=False)))
+                    src.transports.append(await _open_ingest_socket(
+                        info.port + 1, group,
+                        self._make_cb(src, info.track_id, is_rtcp=True)))
+            except OSError:
+                src.close()
+                self.registry.remove(key)
+                return None
+            # the cached SDP is what DESCRIBE serves: replace the raw file
+            # text (ingest ports, multicast groups) with the client-facing
+            # version so live-session describe stays transport-free
+            for s in sd.streams:
+                s.connection = ""
+            client_sd = sdp_mod.build(sd)
+            self.registry.sdp_cache.set(key, client_sd)
+            self.sources[key] = src
+            return session
+
+    def _make_cb(self, src: BroadcastSource, track_id: int, *, is_rtcp: bool):
+        def cb(data: bytes) -> None:
+            src.session.push(track_id, data, is_rtcp=is_rtcp)
+            if not is_rtcp and self.on_ingest is not None:
+                self.on_ingest(src.path)
+        return cb
+
+    # -- teardown ----------------------------------------------------------
+    def close_source(self, path: str) -> None:
+        src = self.sources.pop(sdp_mod._norm(path), None)
+        if src is not None:
+            src.close()
+            self.registry.remove(src.path)
+        self._idle_since.pop(sdp_mod._norm(path), None)
+
+    def sweep(self, now: float | None = None) -> int:
+        """Reap sources with no viewers (broadcaster-timeout analogue,
+        ``ReflectorStream.h:255`` refresh / kill-when-viewerless pref)."""
+        t = time.monotonic() if now is None else now
+        killed = 0
+        for key, src in list(self.sources.items()):
+            if src.session.num_outputs > 0:
+                self._idle_since.pop(key, None)
+                continue
+            first = self._idle_since.setdefault(key, t)
+            if t - first >= self.idle_timeout:
+                self.close_source(key)
+                killed += 1
+        return killed
+
+    def close_all(self) -> None:
+        for key in list(self.sources):
+            self.close_source(key)
+
+
+def _read(fname: str) -> str:
+    with open(fname, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()

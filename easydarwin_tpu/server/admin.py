@@ -27,9 +27,11 @@ ROLE_HOOKS = ("initialize", "shutdown", "reread_prefs", "rtsp_filter",
 def _roles_of(module) -> list[str]:
     """Roles a module registers for = hooks it overrides (the dispatch
     arrays in QTSServer::BuildModuleRoleArrays, rebuilt by reflection)."""
+    from .modules import Module
     return sorted(r for r in ROLE_HOOKS
                   if any(r in klass.__dict__
-                         for klass in type(module).__mro__[:-2]))
+                         for klass in type(module).__mro__
+                         if klass is not Module and klass is not object))
 
 
 def build_tree(app) -> dict[str, Any]:

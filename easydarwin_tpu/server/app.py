@@ -53,6 +53,11 @@ class StreamingServer:
                                describe_fallback=describe_fallback,
                                on_pump_wake=self._wake, vod=self.vod,
                                auth=self.auth, access_log=self.access_log)
+        from ..relay.source import SdpFileRelaySource
+        self.relay_source = SdpFileRelaySource(
+            self.config.movie_folder, self.registry,
+            on_ingest=lambda _path: self._wake())
+        self.rtsp.relay_source = self.relay_source
         self.rest = RestApi(self.config, self)
         from ..vod.record import RecordingManager
         from ..hls import HlsService
@@ -83,6 +88,16 @@ class StreamingServer:
 
     async def start(self) -> None:
         self._running = True
+        # plugins register before the listeners accept anything, so their
+        # filter/authorize hooks cover every request (the reference loads
+        # modules before CreateListeners' ports go live too)
+        if self.config.module_folder:
+            from .modules import load_modules_from
+            for m in load_modules_from(
+                    self.config.module_folder,
+                    on_error=lambda f, e: self.error_log
+                    and self.error_log.warning(f"module {f} failed: {e}")):
+                self.register_module(m)
         await self.rtsp.start()
         await self.rest.start()
         self.rtsp.modules.run_initialize(self)
@@ -117,6 +132,7 @@ class StreamingServer:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        self.relay_source.close_all()
         await self.rtsp.stop()
         await self.rest.stop()
 
@@ -182,6 +198,7 @@ class StreamingServer:
         while self._running:
             await asyncio.sleep(self.config.timeout_sweep_sec)
             self.rtsp.sweep_timeouts()
+            self.relay_source.sweep()
 
     async def _rtsp_port_http_get(self, conn, target: str,
                                   headers: dict) -> bool:

@@ -34,6 +34,8 @@ class ServerConfig:
     timeout_sweep_sec: int = 15        # TimeoutTask.h:66 granularity
     # --- VOD
     movie_folder: str = "/tmp/movies"
+    # --- dynamic modules (QTSServer::LoadModules / module_folder pref)
+    module_folder: str = ""            # "" = no dynamic modules
     # --- device tier
     tpu_fanout: bool = False           # batch engine instead of scalar loop
     tpu_min_outputs: int = 8           # below this the scalar loop wins

@@ -120,3 +120,65 @@ class ModuleRegistry:
     def run_incoming_rtp(self, session, track_id, packet) -> None:
         for m in self.modules:
             m.incoming_rtp(session, track_id, packet)
+
+
+# -- dynamic loading (QTSServer::LoadModules / OSCodeFragment parity) --------
+
+def load_modules_from(folder: str, *, on_error=None) -> list[Module]:
+    """Scan ``folder`` for ``*.py`` plugin files and instantiate their
+    modules, the way ``QTSServer::LoadModules`` (``QTSServer.cpp:283``)
+    dlopens every file in ``module_folder`` via ``OSCodeFragment``.
+
+    A plugin file may provide, in order of precedence:
+
+    * ``EDTPU_MODULES`` — a list of ``Module`` instances or classes;
+    * ``register() -> Module | list[Module]`` — a factory;
+    * top-level ``Module`` subclasses (each is instantiated).
+
+    A broken plugin is skipped (the reference logs and continues too);
+    ``on_error(filename, exc)`` observes failures.
+    """
+    import importlib.util
+    import os
+    import sys
+
+    loaded: list[Module] = []
+    if not folder or not os.path.isdir(folder):
+        return loaded
+    for fname in sorted(os.listdir(folder)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        path = os.path.join(folder, fname)
+        name = "edtpu_plugin_" + fname[:-3]
+        try:
+            spec = importlib.util.spec_from_file_location(name, path)
+            py = importlib.util.module_from_spec(spec)
+            sys.modules[name] = py          # importlib recipe: before exec
+            try:
+                spec.loader.exec_module(py)
+            except BaseException:
+                sys.modules.pop(name, None)
+                raise
+            loaded.extend(_modules_in(py))
+        except Exception as e:              # plugin bugs must not kill boot
+            if on_error is not None:
+                on_error(fname, e)
+    return loaded
+
+
+def _modules_in(py) -> list[Module]:
+    def inst(x):
+        return x() if isinstance(x, type) else x
+
+    if hasattr(py, "EDTPU_MODULES"):
+        return [inst(m) for m in py.EDTPU_MODULES]
+    if hasattr(py, "register") and callable(py.register):
+        out = py.register()
+        return [inst(m) for m in (out if isinstance(out, list) else [out])]
+    # fallback: leaf Module subclasses *defined in this file* — imported
+    # classes and intermediate bases must not be double-registered
+    cands = [cls for cls in vars(py).values()
+             if isinstance(cls, type) and issubclass(cls, Module)
+             and cls is not Module and cls.__module__ == py.__name__]
+    return [cls() for cls in cands
+            if not any(cls is not o and issubclass(o, cls) for o in cands)]

@@ -520,6 +520,8 @@ class RtspServer:
         #: hook for plain HTTP GET on the RTSP port (mp3/stats); set by app
         self.http_get_handler = None
         self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
+        #: SdpFileRelaySource for .sdp-described UDP/multicast broadcasts
+        self.relay_source = None
         self.connections: set[RtspConnection] = set()
         self.stats = {"requests": 0, "pushers": 0, "players": 0,
                       "packets_in": 0}
@@ -551,7 +553,11 @@ class RtspServer:
 
     # -- hooks -------------------------------------------------------------
     async def describe(self, path: str) -> str | None:
+        # live sessions (pushed or already-opened broadcasts) win over
+        # on-disk .sdp files, which win over VOD assets
         text = self.registry.sdp_cache.get(path)
+        if text is None and self.relay_source is not None:
+            text = await self.relay_source.describe(path)
         if text is None and self.vod is not None:
             text = await self.vod.describe(path)
         if text is None and self.describe_fallback is not None:
@@ -559,7 +565,10 @@ class RtspServer:
         return text
 
     async def open_for_play(self, path: str) -> RelaySession | None:
-        return self.registry.find(path)
+        sess = self.registry.find(path)
+        if sess is None and self.relay_source is not None:
+            sess = await self.relay_source.open(path)
+        return sess
 
     async def handle_http_get(self, conn: RtspConnection, target: str,
                               headers: dict) -> None:

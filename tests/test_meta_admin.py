@@ -155,3 +155,16 @@ def test_admin_rest_endpoint():
         assert res[0] == 404
 
     asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())
+
+
+def test_md_always_uncompressed_even_when_negotiated_compressed():
+    """md cannot be compressed (reference asserts kUncompressed for
+    kMediaDataField, QTHintTrack.cpp:1363): a negotiated md id must not
+    cap media at 255 bytes nor emit a compressed md TLV."""
+    ids = rtp_meta.parse_header("tt;ft=1;sq=2;md=3")
+    media = bytes(range(256)) * 4           # 1024 B > 1-byte length
+    pkt = rtp_meta.build_packet(RTP_HDR, media=media, field_ids=ids,
+                                frame_type=2, seq=7)
+    info = rtp_meta.parse_packet(pkt, ids)
+    assert info is not None and info.media == media
+    assert rtp_meta.strip_to_rtp(pkt, ids) == RTP_HDR[:12] + media

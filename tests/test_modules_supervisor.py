@@ -131,3 +131,85 @@ def test_supervisor_no_auto_restart():
                         spawn=lambda a: 7, sleep=lambda s: None,
                         log=lambda m: None)
     assert rc == 7
+
+
+# -- dynamic loading (QTSServer::LoadModules parity) -------------------------
+
+
+def _write_plugins(d):
+    (d / "10_list.py").write_text(
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class A(Module):\n    name = 'a'\n"
+        "EDTPU_MODULES = [A, A()]\n")
+    (d / "20_factory.py").write_text(
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class B(Module):\n    name = 'b'\n"
+        "def register():\n    return B()\n")
+    (d / "30_classes.py").write_text(
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class C(Module):\n    name = 'c'\n"
+        "class D(Module):\n    name = 'd'\n")
+    (d / "40_broken.py").write_text("raise RuntimeError('boom')\n")
+    (d / "_private.py").write_text("raise AssertionError('must not load')\n")
+    (d / "notes.txt").write_text("ignored\n")
+
+
+def test_load_modules_from_folder(tmp_path):
+    from easydarwin_tpu.server.modules import load_modules_from
+    _write_plugins(tmp_path)
+    errors = []
+    mods = load_modules_from(str(tmp_path),
+                             on_error=lambda f, e: errors.append(f))
+    assert sorted(m.name for m in mods) == ["a", "a", "b", "c", "d"]
+    assert errors == ["40_broken.py"]
+    assert load_modules_from("") == []
+    assert load_modules_from(str(tmp_path / "nope")) == []
+
+
+@pytest.mark.asyncio
+async def test_server_boots_with_module_folder(tmp_path):
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    plug = tmp_path / "plugins"
+    plug.mkdir()
+    (plug / "hello.py").write_text(
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class Hello(Module):\n"
+        "    name = 'hello'\n"
+        "    def initialize(self, server):\n"
+        "        server.rtsp.stats['hello_inited'] = True\n")
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       module_folder=str(plug), access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        assert any(m.name == "hello" for m in app.modules.modules)
+        assert app.rtsp.stats.get("hello_inited") is True
+    finally:
+        await app.stop()
+
+
+def test_load_modules_leaf_classes_only(tmp_path):
+    """Fallback discovery: imported classes and intermediate bases are not
+    double-registered; plugin modules land in sys.modules before exec."""
+    from easydarwin_tpu.server.modules import load_modules_from
+    (tmp_path / "tree.py").write_text(
+        "import sys\n"
+        "assert __name__ in sys.modules          # importlib recipe honored\n"
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class Base(Module):\n    name = 'base'\n"
+        "class Leaf(Base):\n    name = 'leaf'\n")
+    mods = load_modules_from(str(tmp_path))
+    assert [m.name for m in mods] == ["leaf"]
+
+
+def test_load_modules_ignores_imported_subclasses(tmp_path):
+    from easydarwin_tpu.server.modules import load_modules_from
+    (tmp_path / "one.py").write_text(
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class Mine(Module):\n    name = 'mine'\n")
+    (tmp_path / "two.py").write_text(
+        "from edtpu_plugin_one import Mine    # imported, not defined here\n"
+        "from easydarwin_tpu.server.modules import Module\n"
+        "class Other(Module):\n    name = 'other'\n")
+    mods = load_modules_from(str(tmp_path))
+    assert sorted(m.name for m in mods) == ["mine", "other"]
