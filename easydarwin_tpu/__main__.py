@@ -26,6 +26,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory of plugin .py modules (LoadModules)")
     p.add_argument("--tpu-fanout", action="store_true",
                    help="enable the TPU batch fan-out engine")
+    p.add_argument("-S", "--stats-interval", type=int, metavar="N",
+                   help="print status columns every N seconds (-S display)")
+    p.add_argument("--status-file", help="write a JSON status snapshot here "
+                   "on an interval (server_status equivalent)")
     p.add_argument("-x", "--exit-after-boot", action="store_true",
                    help="boot, print status, exit (config check)")
     p.add_argument("-w", "--watchdog", action="store_true",
@@ -43,6 +47,10 @@ def config_from_args(args) -> ServerConfig:
             setattr(cfg, k, v)
     if args.tpu_fanout:
         cfg.tpu_fanout = True
+    if args.stats_interval is not None:
+        cfg.stats_interval_sec = args.stats_interval
+    if args.status_file is not None:
+        cfg.status_file_path = args.status_file
     return cfg
 
 

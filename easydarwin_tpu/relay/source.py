@@ -117,12 +117,11 @@ class SdpFileRelaySource:
         fname = self.sdp_file_for(path)
         if fname is None:
             return None
-        sd = sdp_mod.parse(_read(fname))
-        # client-facing copy: strip ingest transport details
-        for s in sd.streams:
-            s.connection = ""
-        sd.connection = ""
-        return sdp_mod.build(sd)
+        try:
+            text = _read(fname)
+        except OSError:                     # unreadable/deleted mid-request
+            return None
+        return _client_facing(sdp_mod.parse(text))
 
     # -- activation --------------------------------------------------------
     async def open(self, path: str) -> RelaySession | None:
@@ -134,10 +133,19 @@ class SdpFileRelaySource:
             fname = self.sdp_file_for(path)
             if fname is None:
                 return None
-            text = _read(fname)
+            try:
+                text = _read(fname)
+            except OSError:                 # unreadable/deleted mid-request
+                return None
             session = self.registry.find_or_create(key, text)
             src = BroadcastSource(key, session)
             sd = session.description
+            # find_or_create cached the raw file text; replace it with the
+            # client-facing version NOW, before any bind awaits, so a
+            # concurrent DESCRIBE can never serve ingest ports/groups
+            # (fresh parse: session.description keeps the bind addresses)
+            self.registry.sdp_cache.set(
+                key, _client_facing(sdp_mod.parse(text)))
             try:
                 for info in sd.streams:
                     if not info.port:
@@ -154,13 +162,6 @@ class SdpFileRelaySource:
                 src.close()
                 self.registry.remove(key)
                 return None
-            # the cached SDP is what DESCRIBE serves: replace the raw file
-            # text (ingest ports, multicast groups) with the client-facing
-            # version so live-session describe stays transport-free
-            for s in sd.streams:
-                s.connection = ""
-            client_sd = sdp_mod.build(sd)
-            self.registry.sdp_cache.set(key, client_sd)
             self.sources[key] = src
             return session
 
@@ -197,6 +198,16 @@ class SdpFileRelaySource:
     def close_all(self) -> None:
         for key in list(self.sources):
             self.close_source(key)
+
+
+def _client_facing(sd: sdp_mod.SessionDescription) -> str:
+    """Strip ingest transport (session- and media-level ``c=``; ``build``
+    zeroes the ``m=`` ports) for the SDP served to players.  Mutates its
+    argument — callers pass a throwaway parse."""
+    for s in sd.streams:
+        s.connection = ""
+    sd.connection = ""
+    return sdp_mod.build(sd)
 
 
 def _read(fname: str) -> str:

@@ -73,6 +73,7 @@ class StreamingServer:
         self.restart_event = asyncio.Event()
         self._engines: dict[int, TpuFanoutEngine] = {}
         self.started_at = time.time()
+        self.status = None
         self.presence = None
         self._redis_client = redis_client
         self.config.on_change(self._on_config_change)
@@ -105,6 +106,11 @@ class StreamingServer:
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
         ]
+        if self.config.stats_interval_sec or self.config.status_file_path:
+            from .status import StatusMonitor
+            self.status = StatusMonitor(self)
+            self._tasks.append(
+                asyncio.create_task(self._status_loop(), name="status"))
         if self.config.cloud_enabled:
             from ..cluster.presence import PresenceService
             from ..cluster.redis_client import AsyncRedis
@@ -193,6 +199,33 @@ class StreamingServer:
                         await self.presence.sync_streams(self.registry.paths())
                     except Exception:
                         pass
+
+    async def _status_loop(self) -> None:
+        """The 1 Hz supervisor's status duties (RunServer.cpp:620-719):
+        console columns every ``stats_interval_sec``, status file every
+        ``status_file_interval_sec``."""
+        import sys
+        last_file = 0.0
+        interval = self.config.stats_interval_sec or 1
+        while self._running:
+            await asyncio.sleep(interval)
+            snap = self.status.sample()     # ONE sample per tick: sample()
+            # moves the rate baseline, so console and file must share it
+            if self.config.stats_interval_sec:
+                if self.status.needs_header():
+                    print(self.status.header_line(), file=sys.stderr)
+                print(self.status.console_line(snap), file=sys.stderr,
+                      flush=True)
+            now = time.monotonic()
+            if (self.config.status_file_path
+                    and now - last_file
+                    >= self.config.status_file_interval_sec):
+                last_file = now
+                try:
+                    self.status.write_file(self.config.status_file_path,
+                                           snap)
+                except OSError:
+                    pass
 
     async def _sweep_loop(self) -> None:
         while self._running:

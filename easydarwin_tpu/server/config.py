@@ -55,6 +55,13 @@ class ServerConfig:
     users_file: str = ""               # qtpasswd-style user:realm:ha1
     auth_scheme: str = "digest"        # digest | basic
     max_connections: int = 20000       # epollEvent.cpp:16 MAX_EPOLL_FD
+    # per-IP cap (QTSSSpamDefenseModule num_conns_per_ip; 0 = unlimited,
+    # matching the reference's Linux build which omits the module)
+    max_connections_per_ip: int = 0
+    # --- status (RunServer.cpp:248-483: -S console + server_status file)
+    stats_interval_sec: int = 0        # 0 = console display off
+    status_file_path: str = ""         # "" = no status file
+    status_file_interval_sec: int = 10
     # --- logging (QTSSRollingLog / AccessLog / ErrorLog prefs)
     log_folder: str = "/tmp/edtpu_logs"
     access_log_enabled: bool = True

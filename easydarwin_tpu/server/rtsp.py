@@ -547,6 +547,14 @@ class RtspServer:
         if len(self.connections) >= self.config.max_connections:
             writer.close()
             return
+        # per-IP cap (QTSSSpamDefenseModule): refuse before spending a task
+        per_ip = self.config.max_connections_per_ip
+        if per_ip:
+            peer = writer.get_extra_info("peername")
+            ip = peer[0] if peer else ""
+            if sum(1 for c in self.connections if c.client_ip == ip) >= per_ip:
+                writer.close()
+                return
         conn = RtspConnection(self, reader, writer)
         self.connections.add(conn)
         await conn.run()

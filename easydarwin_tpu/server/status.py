@@ -1,0 +1,95 @@
+"""Runtime status: operator console columns + interval status file.
+
+Reference parity: the ``-S n`` stats console (``RunServer.cpp:397-483`` —
+1 Hz column printout of RTP conns/packet rates/late/quality the operators
+eyeballed as their "test suite") and the ``server_status`` plist written on
+an interval (``RunServer.cpp:248-388``).  The plist format is Apple legacy;
+the idiomatic carrier today is a JSON snapshot with the same fields, which
+also feeds the REST ``getserverinfo`` answer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+#: console column layout (name, width) — RunServer.cpp:427-446 equivalents
+COLUMNS = (("RTSP", 6), ("Push", 6), ("Play", 6), ("PktsIn", 10),
+           ("PktsOut", 10), ("InRate/s", 10), ("OutRate/s", 10),
+           ("Queue", 7), ("UpMin", 7))
+
+
+class StatusMonitor:
+    """Samples server counters, derives rates, renders console lines and
+    JSON snapshots.  Pure (no I/O of its own) except ``write_file``."""
+
+    def __init__(self, app):
+        self.app = app
+        self._last_t: float | None = None
+        self._last_in = 0
+        self._last_out = 0
+        self._lines_printed = 0
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self) -> dict:
+        app = self.app
+        s = app.rtsp.stats
+        pkts_out = sum(st.stats.packets_out
+                       for sess in app.registry.sessions.values()
+                       for st in sess.streams.values())
+        queued = sum(len(st.rtp_ring)
+                     for sess in app.registry.sessions.values()
+                     for st in sess.streams.values())
+        players = sum(sess.num_outputs
+                      for sess in app.registry.sessions.values())
+        now = time.monotonic()
+        in_rate = out_rate = 0.0
+        if self._last_t is not None and now > self._last_t:
+            dt = now - self._last_t
+            in_rate = (s["packets_in"] - self._last_in) / dt
+            out_rate = (pkts_out - self._last_out) / dt
+        self._last_t = now
+        self._last_in = s["packets_in"]
+        self._last_out = pkts_out
+        return {
+            "rtsp_connections": len(app.rtsp.connections),
+            "push_sessions": len(app.registry.sessions),
+            "players": players,
+            "packets_in": s["packets_in"],
+            "packets_out": pkts_out,
+            "in_rate": round(in_rate, 1),
+            "out_rate": round(out_rate, 1),
+            "queued_packets": queued,
+            "uptime_sec": int(time.time() - app.started_at),
+            "requests": s["requests"],
+        }
+
+    # -- console (the -S display) -----------------------------------------
+    def console_line(self, sample: dict | None = None) -> str:
+        d = self.sample() if sample is None else sample
+        vals = (d["rtsp_connections"], d["push_sessions"], d["players"],
+                d["packets_in"], d["packets_out"], d["in_rate"],
+                d["out_rate"], d["queued_packets"], d["uptime_sec"] // 60)
+        line = "".join(str(v).rjust(w) for (_, w), v in zip(COLUMNS, vals))
+        self._lines_printed += 1
+        return line
+
+    def header_line(self) -> str:
+        return "".join(name.rjust(w) for name, w in COLUMNS)
+
+    def needs_header(self, every: int = 20) -> bool:
+        """Reprint the header every N lines, as the reference console does."""
+        return self._lines_printed % every == 0
+
+    # -- status file (the server_status plist) -----------------------------
+    def write_file(self, path: str, sample: dict | None = None) -> None:
+        """``sample`` lets one tick share a single sample() with the console
+        — sample() moves the rate baseline, so calling it twice per tick
+        would make the second reader's rates ~0 forever."""
+        snap = dict(self.sample() if sample is None else sample,
+                    written_at=int(time.time()), server="easydarwin-tpu")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=1)
+        os.replace(tmp, path)           # atomic: readers never see a torn file

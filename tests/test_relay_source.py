@@ -244,3 +244,38 @@ async def test_concurrent_open_creates_one_source(tmp_path):
     # exactly one RTP+RTCP transport pair bound
     assert len(svc.sources["/c"].transports) == 2
     svc.close_all()
+
+
+@pytest.mark.asyncio
+async def test_session_level_multicast_c_never_leaks(tmp_path):
+    """The common broadcast shape puts the multicast group in the
+    session-level c= line; neither describe() nor the post-open cached SDP
+    may serve it (clients honoring a multicast c= would bypass RTSP)."""
+    port = free_udp_port()
+    (tmp_path / "g.sdp").write_text(broadcast_sdp(port, "239.8.8.8"))
+    reg = SessionRegistry()
+    svc = SdpFileRelaySource(str(tmp_path), reg)
+    text = await svc.describe("/g")
+    assert "239.8.8.8" not in text
+    sess = await svc.open("/g")
+    if sess is None:
+        pytest.skip("multicast join unsupported in this environment")
+    cached = reg.sdp_cache.get("/g")
+    assert "239.8.8.8" not in cached and f" {port} " not in cached
+    # the session's own description keeps the bind address (open() used it)
+    assert sess.description.connection.endswith("239.8.8.8")
+    svc.close_all()
+
+
+@pytest.mark.asyncio
+async def test_unreadable_sdp_file_is_a_clean_404(tmp_path):
+    port = free_udp_port()
+    f = tmp_path / "p.sdp"
+    f.write_text(broadcast_sdp(port))
+    svc = SdpFileRelaySource(str(tmp_path), SessionRegistry())
+    os.chmod(f, 0)
+    if os.access(f, os.R_OK):               # running as root: chmod no-op
+        pytest.skip("cannot make file unreadable (root)")
+    assert await svc.describe("/p") is None  # no exception → RTSP 404
+    assert await svc.open("/p") is None
+    os.chmod(f, 0o644)

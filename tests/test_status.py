@@ -1,0 +1,107 @@
+"""Status console + status file (RunServer.cpp:248-483 parity) and the
+per-IP connection cap (QTSSSpamDefenseModule)."""
+
+import asyncio
+import json
+
+import pytest
+
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.server.status import COLUMNS, StatusMonitor
+from easydarwin_tpu.utils.client import RtspClient
+
+
+@pytest.mark.asyncio
+async def test_status_monitor_samples_and_console(tmp_path):
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        mon = StatusMonitor(app)
+        d = mon.sample()
+        assert d["rtsp_connections"] == 0 and d["push_sessions"] == 0
+        # a live pusher moves the counters
+        sdp = ("v=0\r\ns=x\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+               "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+        app.registry.find_or_create("/s1", sdp)
+        app.registry.find("/s1").push(1, b"\x80\x60" + bytes(30))
+        d2 = mon.sample()
+        assert d2["push_sessions"] == 1 and d2["packets_in"] == 0
+        header, line = mon.header_line(), mon.console_line()
+        assert len(header) == sum(w for _, w in COLUMNS)
+        assert len(line) == len(header)
+        # header cadence: first line printed → reprint at the 20th
+        assert not mon.needs_header()
+        mon._lines_printed = 20
+        assert mon.needs_header()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_status_file_written_atomically(tmp_path):
+    path = str(tmp_path / "server_status.json")
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False, status_file_path=path,
+                       stats_interval_sec=0, status_file_interval_sec=1)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        app.status.write_file(path)
+        snap = json.loads(open(path).read())
+        assert snap["server"] == "easydarwin-tpu"
+        assert "packets_in" in snap and "uptime_sec" in snap
+        # the interval loop exists when configured
+        assert any(t.get_name() == "status" for t in app._tasks)
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_per_ip_connection_cap():
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False, max_connections_per_ip=2)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        c1, c2 = RtspClient(), RtspClient()
+        await c1.connect("127.0.0.1", app.rtsp.port)
+        await c2.connect("127.0.0.1", app.rtsp.port)
+        r = await c1.request("OPTIONS", "*")
+        assert r.status == 200
+        await asyncio.sleep(0.05)
+        assert len(app.rtsp.connections) == 2
+        # the third connection from the same IP is refused at accept
+        reader3, writer3 = await asyncio.open_connection(
+            "127.0.0.1", app.rtsp.port)
+        got = await asyncio.wait_for(reader3.read(1), 2.0)
+        assert got == b""               # closed without serving
+        assert len(app.rtsp.connections) == 2
+        writer3.close()
+        await c1.close()
+        await c2.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_console_and_file_share_one_sample(tmp_path):
+    """sample() moves the rate baseline; the status loop must not zero the
+    file's rates by sampling twice per tick."""
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        mon = StatusMonitor(app)
+        mon.sample()
+        app.rtsp.stats["packets_in"] += 500
+        await asyncio.sleep(0.05)
+        snap = mon.sample()
+        assert snap["in_rate"] > 0
+        path = str(tmp_path / "st.json")
+        mon.write_file(path, snap)          # shared sample, not a re-sample
+        assert json.loads(open(path).read())["in_rate"] == snap["in_rate"]
+    finally:
+        await app.stop()
