@@ -19,7 +19,11 @@ import numpy as np
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "csrc")
-_SO = os.path.join(_CSRC, "libedtpu_core.so")
+# EDTPU_CORE_SO overrides the library path (sanitizer builds: make
+# asan/tsan in csrc/ produce instrumented .so variants for the CI jobs
+# the reference never had)
+_SO = os.environ.get("EDTPU_CORE_SO",
+                     os.path.join(_CSRC, "libedtpu_core.so"))
 _lock = threading.Lock()
 _lib = None
 _tried = False

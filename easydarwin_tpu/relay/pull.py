@@ -1,0 +1,180 @@
+"""RTSP pull relay: chain servers by pulling a remote stream into the
+local reflector.
+
+Reference parity: the relay direction EasyDarwin inherited from DSS's
+``QTSSSplitterModule`` (vestigial, ``QTSSSplitterModule.cpp:664``) and
+Easy's ``EasyRelaySession`` (``RTSPClientLib/RTSPRelaySession.h:39``, an
+RTSP-client-driven relay that never shipped working code): the server acts
+as an RTSP *player* toward an upstream ``rtsp://`` URL and re-publishes
+the stream under a local path, where the normal reflector fan-out (and the
+TPU batch engine) serves local players.  This is how multi-hop
+distribution trees are built out of single servers.
+
+One ``PullRelay`` = one upstream TCP-interleaved session feeding one
+``RelaySession``; ``PullRelayManager`` owns them, is driven by the REST
+``startpullrelay``/``stoppullrelay`` commands, and sweeps dead pulls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from urllib.parse import urlparse
+
+from ..utils.client import RtspClient
+from .session import RelaySession, SessionRegistry
+
+
+class PullError(Exception):
+    pass
+
+
+def parse_rtsp_url(url: str) -> tuple[str, int, str]:
+    u = urlparse(url)
+    if u.scheme != "rtsp" or not u.hostname:
+        raise PullError(f"not an rtsp:// URL: {url!r}")
+    return u.hostname, u.port or 554, u.path or "/"
+
+
+class PullRelay:
+    """One upstream pull session (EasyRelaySession equivalent)."""
+
+    def __init__(self, local_path: str, url: str, registry: SessionRegistry,
+                 *, on_packet=None):
+        self.local_path = local_path
+        self.url = url
+        self.registry = registry
+        self.on_packet = on_packet          # pump-wake hook
+        self.client = RtspClient()
+        self.session: RelaySession | None = None
+        self.started_at = time.time()
+        self.alive = False
+        self._forward_task: asyncio.Task | None = None
+        #: interleaved channel → (track_id, is_rtcp)
+        self._channel_map: dict[int, tuple[int, bool]] = {}
+
+    async def start(self, timeout: float = 10.0) -> None:
+        host, port, _path = parse_rtsp_url(self.url)
+        self.client.enable_any_queue()      # before any packet can arrive
+        try:
+            await asyncio.wait_for(self.client.connect(host, port), timeout)
+            sd = await self.client.play_start(self.url, tcp=True)
+        except (OSError, asyncio.TimeoutError, AssertionError) as e:
+            await self.client.close()
+            raise PullError(f"upstream {self.url}: {e}") from e
+        if not sd.streams:
+            await self.client.close()
+            raise PullError(f"upstream {self.url}: SDP has no streams")
+        for i, st in enumerate(sd.streams):
+            self._channel_map[2 * i] = (st.track_id, False)
+            self._channel_map[2 * i + 1] = (st.track_id, True)
+        self.session = self.registry.find_or_create(self.local_path, sd.raw)
+        self.alive = True
+        self._forward_task = asyncio.create_task(
+            self._forward_loop(), name=f"pull:{self.local_path}")
+
+    async def _forward_loop(self) -> None:
+        """Upstream interleaved packets → local relay ingest.
+
+        Reads the client's channel queues (fed by its socket reader task)
+        and pushes into the RelaySession exactly as an ANNOUNCE pusher's
+        packets would arrive."""
+        client = self.client
+        try:
+            while True:
+                ch, data = await client.recv_any()
+                if ch < 0:                  # upstream EOF
+                    break
+                mapped = self._channel_map.get(ch)
+                if mapped is None or self.session is None:
+                    continue
+                track_id, is_rtcp = mapped
+                self.session.push(track_id, data, is_rtcp=is_rtcp)
+                if not is_rtcp and self.on_packet is not None:
+                    self.on_packet(self.local_path)
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+        finally:
+            self.alive = False
+
+    async def stop(self) -> None:
+        self.alive = False
+        if self._forward_task is not None:
+            self._forward_task.cancel()
+            try:
+                await self._forward_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.client.teardown(self.url)
+        await self.client.close()
+        self.registry.remove(self.local_path)
+        self.session = None
+
+    def stats(self) -> dict:
+        return {
+            "path": self.local_path, "url": self.url,
+            "alive": self.alive,
+            "uptime_sec": int(time.time() - self.started_at),
+            "packets": self.client.stats.packets,
+            "lost": self.client.stats.lost,
+        }
+
+
+class PullRelayManager:
+    def __init__(self, registry: SessionRegistry, *, on_packet=None):
+        self.registry = registry
+        self.on_packet = on_packet
+        self.pulls: dict[str, PullRelay] = {}
+        self._lock = asyncio.Lock()         # concurrent REST start/stop
+
+    async def start_pull(self, local_path: str, url: str) -> PullRelay:
+        key = local_path.rstrip("/") or "/"
+        async with self._lock:
+            old = self.pulls.get(key)
+            if old is not None:
+                if old.alive:
+                    raise PullError(f"pull already active on {key}")
+                # dead-but-unswept: fully retire it (close its upstream
+                # socket, drop its stale session/SDP) before restarting
+                self.pulls.pop(key, None)
+                await old.stop()
+            elif self.registry.find(key) is not None:
+                raise PullError(f"{key} already has a live session")
+            pull = PullRelay(key, url, self.registry,
+                             on_packet=self.on_packet)
+            await pull.start()
+            self.pulls[key] = pull
+            return pull
+
+    async def stop_pull(self, local_path: str) -> dict:
+        key = local_path.rstrip("/") or "/"
+        async with self._lock:
+            pull = self.pulls.pop(key, None)
+            if pull is None:
+                raise KeyError(key)
+            st = pull.stats()
+            await pull.stop()
+            return st
+
+    def list_pulls(self) -> list[dict]:
+        return [p.stats() for p in self.pulls.values()]
+
+    async def stop_all(self) -> None:
+        for key in list(self.pulls):
+            try:
+                await self.stop_pull(key)
+            except KeyError:
+                pass
+
+    async def sweep(self) -> int:
+        """Retire dead pulls (upstream EOF) so their paths free up — and
+        close their upstream sockets; the cluster re-register story
+        (SURVEY §5) applies: a watcher or operator re-issues
+        startpullrelay."""
+        async with self._lock:
+            dead = [k for k, p in self.pulls.items() if not p.alive]
+            for k in dead:
+                pull = self.pulls.pop(k, None)
+                if pull is not None:
+                    await pull.stop()
+            return len(dead)

@@ -58,6 +58,9 @@ class StreamingServer:
             self.config.movie_folder, self.registry,
             on_ingest=lambda _path: self._wake())
         self.rtsp.relay_source = self.relay_source
+        from ..relay.pull import PullRelayManager
+        self.pulls = PullRelayManager(self.registry,
+                                      on_packet=lambda _path: self._wake())
         self.rest = RestApi(self.config, self)
         from ..vod.record import RecordingManager
         from ..hls import HlsService
@@ -139,6 +142,7 @@ class StreamingServer:
             except (asyncio.CancelledError, Exception):
                 pass
         self.relay_source.close_all()
+        await self.pulls.stop_all()
         await self.rtsp.stop()
         await self.rest.stop()
 
@@ -206,20 +210,28 @@ class StreamingServer:
         ``status_file_interval_sec``."""
         import sys
         last_file = 0.0
-        interval = self.config.stats_interval_sec or 1
+        # tick fast enough for BOTH outputs: -S 60 must not stretch a 10 s
+        # file cadence to 60 s
+        enabled = [i for i in (self.config.stats_interval_sec,
+                               self.config.status_file_interval_sec
+                               if self.config.status_file_path else 0) if i]
+        interval = min(enabled) if enabled else 1
+        last_console = 0.0
         while self._running:
             await asyncio.sleep(interval)
             snap = self.status.sample()     # ONE sample per tick: sample()
             # moves the rate baseline, so console and file must share it
-            if self.config.stats_interval_sec:
+            now = time.monotonic()
+            if (self.config.stats_interval_sec and now - last_console
+                    >= self.config.stats_interval_sec - interval / 2):
+                last_console = now
                 if self.status.needs_header():
                     print(self.status.header_line(), file=sys.stderr)
                 print(self.status.console_line(snap), file=sys.stderr,
                       flush=True)
-            now = time.monotonic()
             if (self.config.status_file_path
                     and now - last_file
-                    >= self.config.status_file_interval_sec):
+                    >= self.config.status_file_interval_sec - interval / 2):
                 last_file = now
                 try:
                     self.status.write_file(self.config.status_file_path,
@@ -232,6 +244,7 @@ class StreamingServer:
             await asyncio.sleep(self.config.timeout_sweep_sec)
             self.rtsp.sweep_timeouts()
             self.relay_source.sweep()
+            await self.pulls.sweep()
 
     async def _rtsp_port_http_get(self, conn, target: str,
                                   headers: dict) -> bool:

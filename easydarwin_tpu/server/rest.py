@@ -218,6 +218,38 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
             "File": res["path"], "Samples": str(res["samples"])})
 
+    async def _cmd_startpullrelay(self, params: dict,
+                                  body: bytes) -> tuple[int, str]:
+        """Pull a remote rtsp:// stream into a local path (EasyRelaySession
+        direction: server chains act as players toward upstreams)."""
+        from ..relay.pull import PullError
+        url = params.get("url", [""])[0]
+        path = params.get("path", [""])[0]
+        if not url or not path:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": "need url= and path="})
+        try:
+            pull = await self.app.pulls.start_pull(path, url)
+        except PullError as e:
+            return 502, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": str(e)})
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Pull": pull.local_path, "Url": pull.url})
+
+    async def _cmd_stoppullrelay(self, params: dict,
+                                 body: bytes) -> tuple[int, str]:
+        path = params.get("path", [""])[0]
+        try:
+            st = await self.app.pulls.stop_pull(path)
+        except KeyError:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Pull": st["path"], "Packets": str(st["packets"])})
+
+    def _cmd_getpullrelays(self, params: dict, body: bytes) -> tuple[int, str]:
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Pulls": self.app.pulls.list_pulls()})
+
     def _cmd_admin(self, params: dict, body: bytes) -> tuple[int, str]:
         """Dictionary-tree browse (QTSSAdminModule's /modules/admin API):
         ``?path=server/prefs/*&command=get[&recurse=1]`` or

@@ -495,7 +495,9 @@ class RtspConnection:
             # the ReflectorSession when the broadcast stops)
             self.server.registry.remove(self.relay.path)
             self.relay = None
-        self.server.connections.discard(self)
+        if self in self.server.connections:
+            self.server.connections.discard(self)
+            self.server.on_ip_disconnect(self.client_ip)
         try:
             self.writer.close()
         except Exception:
@@ -523,6 +525,8 @@ class RtspServer:
         #: SdpFileRelaySource for .sdp-described UDP/multicast broadcasts
         self.relay_source = None
         self.connections: set[RtspConnection] = set()
+        #: live connection count per client IP (O(1) SpamDefense check)
+        self._per_ip: dict[str, int] = {}
         self.stats = {"requests": 0, "pushers": 0, "players": 0,
                       "packets_in": 0}
         self._server: asyncio.AbstractServer | None = None
@@ -549,15 +553,22 @@ class RtspServer:
             return
         # per-IP cap (QTSSSpamDefenseModule): refuse before spending a task
         per_ip = self.config.max_connections_per_ip
-        if per_ip:
-            peer = writer.get_extra_info("peername")
-            ip = peer[0] if peer else ""
-            if sum(1 for c in self.connections if c.client_ip == ip) >= per_ip:
-                writer.close()
-                return
+        peer = writer.get_extra_info("peername")
+        ip = peer[0] if peer else ""
+        if per_ip and self._per_ip.get(ip, 0) >= per_ip:
+            writer.close()
+            return
         conn = RtspConnection(self, reader, writer)
         self.connections.add(conn)
+        self._per_ip[ip] = self._per_ip.get(ip, 0) + 1
         await conn.run()
+
+    def on_ip_disconnect(self, ip: str) -> None:
+        n = self._per_ip.get(ip, 0) - 1
+        if n > 0:
+            self._per_ip[ip] = n
+        else:
+            self._per_ip.pop(ip, None)
 
     # -- hooks -------------------------------------------------------------
     async def describe(self, path: str) -> str | None:

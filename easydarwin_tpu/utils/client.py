@@ -57,6 +57,9 @@ class RtspClient:
         self._responses: asyncio.Queue = asyncio.Queue()
         #: interleaved channel → asyncio.Queue of payload bytes
         self.channels: dict[int, asyncio.Queue] = {}
+        #: set by enable_any_queue(): single (channel, data) stream instead
+        #: of per-channel queues (pull-relay forwarding wants arrival order)
+        self.any_queue: asyncio.Queue | None = None
         self.stats = ReceiverStats()
         self._reader_task: asyncio.Task | None = None
 
@@ -75,6 +78,13 @@ class RtspClient:
             self.writer.close()
 
     async def _read_loop(self) -> None:
+        try:
+            await self._read_loop_inner()
+        finally:
+            if self.any_queue is not None:      # EOF sentinel for recv_any
+                self.any_queue.put_nowait((-1, b""))
+
+    async def _read_loop_inner(self) -> None:
         while True:
             data = await self.reader.read(16384)
             if not data:
@@ -82,10 +92,14 @@ class RtspClient:
             self.wire.feed(data)
             for ev in self.wire.events():
                 if isinstance(ev, rtsp.InterleavedPacket):
-                    q = self.channels.setdefault(ev.channel, asyncio.Queue())
                     if ev.channel % 2 == 0:
                         self.stats.on_packet(ev.data)
-                    q.put_nowait(ev.data)
+                    if self.any_queue is not None:
+                        self.any_queue.put_nowait((ev.channel, ev.data))
+                    else:
+                        q = self.channels.setdefault(ev.channel,
+                                                     asyncio.Queue())
+                        q.put_nowait(ev.data)
                 else:
                     self._responses.put_nowait(ev)
 
@@ -112,6 +126,16 @@ class RtspClient:
                                timeout: float = 5.0) -> bytes:
         q = self.channels.setdefault(channel, asyncio.Queue())
         return await asyncio.wait_for(q.get(), timeout)
+
+    def enable_any_queue(self) -> None:
+        """Switch to arrival-order (channel, data) delivery via recv_any."""
+        self.any_queue = asyncio.Queue()
+
+    async def recv_any(self) -> tuple[int, bytes]:
+        """Next (channel, data) in arrival order; (-1, b"") on EOF."""
+        if self.any_queue is None:
+            self.enable_any_queue()
+        return await self.any_queue.get()
 
     # ---------------------------------------------------------- push flow
     async def push_start(self, uri: str, sdp_text: str,

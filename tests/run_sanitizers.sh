@@ -1,0 +1,34 @@
+#!/bin/sh
+# ASan/TSan runs of the native data-plane tests (SURVEY §5: the reference
+# had no race/memory tooling; these are the CI-job equivalents).
+#
+#   tests/run_sanitizers.sh [asan|tsan|all]
+#
+# Builds the instrumented .so variants and runs tests/test_native_core.py
+# against each with the sanitizer runtime preloaded (ctypes loads the .so
+# into an uninstrumented python, so the runtime must come in via
+# LD_PRELOAD).
+set -eu
+cd "$(dirname "$0")/.."
+MODE="${1:-all}"
+
+run_one() {
+    san="$1"; so="csrc/libedtpu_core_${san}.so"
+    make -s -C csrc "$san"
+    rt=$(g++ -print-file-name="lib${san}.so")
+    [ -f "$rt" ] || { echo "lib${san}.so runtime not found, skipping"; return 0; }
+    echo "== ${san}: pytest tests/test_native_core.py =="
+    env EDTPU_CORE_SO="$PWD/$so" LD_PRELOAD="$rt" \
+        ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+        TSAN_OPTIONS=halt_on_error=1 \
+        JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_native_core.py -q -p no:cacheprovider
+}
+
+case "$MODE" in
+    asan) run_one asan ;;
+    tsan) run_one tsan ;;
+    all)  run_one asan; run_one tsan ;;
+    *) echo "usage: $0 [asan|tsan|all]" >&2; exit 2 ;;
+esac
+echo "sanitizer runs clean"

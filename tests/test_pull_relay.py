@@ -1,0 +1,166 @@
+"""RTSP pull relay: server B pulls a live stream from server A and serves
+local players (EasyRelaySession / QTSSSplitterModule direction)."""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from easydarwin_tpu.protocol import rtp
+from easydarwin_tpu.relay.pull import PullError, parse_rtsp_url
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.utils.client import RtspClient
+
+PUSH_SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=chain\r\n"
+            "c=IN IP4 0.0.0.0\r\nt=0 0\r\na=control:*\r\n"
+            "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+            "a=control:trackID=1\r\n")
+
+
+def vid_pkt(seq, ts, nal_type=1):
+    payload = bytes(((3 << 5) | nal_type,)) + bytes((seq + i) & 0xFF
+                                                    for i in range(40))
+    return rtp.RtpPacket(payload_type=96, seq=seq & 0xFFFF, timestamp=ts,
+                         ssrc=0xCAFE, payload=payload).to_bytes()
+
+
+def test_parse_rtsp_url():
+    assert parse_rtsp_url("rtsp://h:10554/live/x") == ("h", 10554, "/live/x")
+    assert parse_rtsp_url("rtsp://h/live/x") == ("h", 554, "/live/x")
+    with pytest.raises(PullError):
+        parse_rtsp_url("http://h/live/x")
+
+
+async def _server(**kw):
+    cfg = ServerConfig(rtsp_port=0, service_port=0, reflect_interval_ms=5,
+                       bind_ip="127.0.0.1", access_log_enabled=False, **kw)
+    app = StreamingServer(cfg)
+    await app.start()
+    return app
+
+
+@pytest.mark.asyncio
+async def test_pull_relay_chain_end_to_end():
+    a = await _server()
+    b = await _server()
+    try:
+        # pusher feeds server A
+        a_uri = f"rtsp://127.0.0.1:{a.rtsp.port}/live/src"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", a.rtsp.port)
+        await pusher.push_start(a_uri, PUSH_SDP)
+        sent = [vid_pkt(40 + i, i * 3000, nal_type=5 if i == 0 else 1)
+                for i in range(3)]
+        for p in sent:
+            pusher.push_packet(0, p)
+
+        # server B pulls A's stream under a local path
+        pull = await b.pulls.start_pull("/relayed/src", a_uri)
+        assert pull.alive and b.registry.find("/relayed/src") is not None
+
+        # a player on B sees payload-identical packets
+        player = RtspClient()
+        await player.connect("127.0.0.1", b.rtsp.port)
+        sd = await player.play_start(
+            f"rtsp://127.0.0.1:{b.rtsp.port}/relayed/src")
+        assert sd.streams[0].codec == "H264"
+        # live packets flow across the chain
+        live = [vid_pkt(43 + i, (3 + i) * 3000) for i in range(3)]
+        for p in live:
+            pusher.push_packet(0, p)
+        got = [await asyncio.wait_for(player.recv_interleaved(0), 5.0)
+               for _ in range(3)]
+        sent_payloads = [rtp.RtpPacket.parse(p).payload for p in sent + live]
+        for g in got:
+            assert rtp.RtpPacket.parse(g).payload in sent_payloads
+
+        st = pull.stats()
+        assert st["alive"] and st["packets"] >= 3
+        res = await b.pulls.stop_pull("/relayed/src")
+        assert res["packets"] >= 3
+        assert b.registry.find("/relayed/src") is None
+        await player.close()
+        await pusher.close()
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_pull_relay_rest_control():
+    a = await _server()
+    b = await _server()
+    try:
+        a_uri = f"rtsp://127.0.0.1:{a.rtsp.port}/live/cam"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", a.rtsp.port)
+        await pusher.push_start(a_uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(1, 0, nal_type=5))
+
+        base = f"http://127.0.0.1:{b.rest.port}/api/v1"
+
+        def get(url):
+            return json.loads(urllib.request.urlopen(url, timeout=5).read())
+
+        start = await asyncio.to_thread(
+            get, f"{base}/startpullrelay?path=/mirror&url={a_uri}")
+        assert start["EasyDarwin"]["Body"]["Pull"] == "/mirror"
+        lst = await asyncio.to_thread(get, f"{base}/getpullrelays")
+        pulls = lst["EasyDarwin"]["Body"]["Pulls"]
+        assert len(pulls) == 1 and pulls[0]["url"] == a_uri
+        # duplicate start on the same path is refused
+        try:
+            await asyncio.to_thread(
+                get, f"{base}/startpullrelay?path=/mirror&url={a_uri}")
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 502
+        assert raised
+        stop = await asyncio.to_thread(
+            get, f"{base}/stoppullrelay?path=/mirror")
+        assert stop["EasyDarwin"]["Body"]["Pull"] == "/mirror"
+        await pusher.close()
+    finally:
+        await b.stop()
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_dead_upstream_swept():
+    a = await _server()
+    b = await _server()
+    try:
+        a_uri = f"rtsp://127.0.0.1:{a.rtsp.port}/live/ephemeral"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", a.rtsp.port)
+        await pusher.push_start(a_uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(1, 0, nal_type=5))
+        await b.pulls.start_pull("/dead", a_uri)
+        # upstream goes away: pusher disconnect tears A's session down,
+        # which closes B's player connection → forward loop exits
+        await pusher.close()
+        await a.stop()
+        for _ in range(100):
+            if not b.pulls.pulls["/dead"].alive:
+                break
+            await asyncio.sleep(0.05)
+        assert not b.pulls.pulls["/dead"].alive
+        dead_client = b.pulls.pulls["/dead"].client
+        assert await b.pulls.sweep() == 1
+        assert b.registry.find("/dead") is None and not b.pulls.pulls
+        # the upstream socket was actually closed, not leaked
+        assert dead_client.writer is None or dead_client.writer.is_closing()
+    finally:
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_pull_refuses_occupied_path():
+    b = await _server()
+    try:
+        b.registry.find_or_create("/busy", PUSH_SDP)
+        with pytest.raises(PullError):
+            await b.pulls.start_pull("/busy", "rtsp://127.0.0.1:1/x")
+    finally:
+        await b.stop()

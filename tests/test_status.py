@@ -81,6 +81,17 @@ async def test_per_ip_connection_cap():
         writer3.close()
         await c1.close()
         await c2.close()
+        # the counter releases on disconnect: new connections are accepted
+        for _ in range(100):
+            if not app.rtsp._per_ip:
+                break
+            await asyncio.sleep(0.02)
+        assert app.rtsp._per_ip == {}
+        c4 = RtspClient()
+        await c4.connect("127.0.0.1", app.rtsp.port)
+        r = await c4.request("OPTIONS", "*")
+        assert r.status == 200
+        await c4.close()
     finally:
         await app.stop()
 
