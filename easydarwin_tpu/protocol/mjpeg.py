@@ -230,6 +230,20 @@ class JpegDepacketizer:
         self.frames_dropped = 0
 
     def push(self, packet: bytes) -> bytes | None:
+        parts = self.push_parts(packet)
+        if parts is None:
+            return None
+        header, scan, _ts = parts
+        jfif = make_jfif_headers(header, header.qtables)
+        if not scan.endswith(b"\xff\xd9"):
+            scan += b"\xff\xd9"            # EOI
+        return jfif + scan
+
+    def push_parts(self, packet: bytes
+                   ) -> tuple[JpegHeader, bytes, int] | None:
+        """Like push() but returns (header, raw scan, rtp timestamp) —
+        the transcode ladder wants the entropy-coded scan, not a JFIF
+        container."""
         pkt = rtp.RtpPacket.parse(packet)
         header, frag = parse_payload(pkt.payload)
         if self._cur is None or pkt.timestamp != self._cur.timestamp:
@@ -254,8 +268,4 @@ class JpegDepacketizer:
             scan += part
         self._cur = None
         self.frames_out += 1
-        jfif = make_jfif_headers(f.header, f.header.qtables)
-        body = bytes(scan)
-        if not body.endswith(b"\xff\xd9"):
-            body += b"\xff\xd9"            # EOI
-        return jfif + body
+        return f.header, bytes(scan), f.timestamp

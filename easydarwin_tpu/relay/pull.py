@@ -96,8 +96,16 @@ class PullRelay:
             pass
         finally:
             self.alive = False
+            # release the session NOW, exactly as a pusher disconnect tears
+            # its session down — a later ANNOUNCE must get a fresh session,
+            # never adopt a dead pull's (ownership-checked: a session some
+            # other producer already replaced is left alone)
+            if self.registry.find(self.local_path) is self.session:
+                self.registry.remove(self.local_path)
+            self.session = None
 
     async def stop(self) -> None:
+        was_alive = self.alive
         self.alive = False
         if self._forward_task is not None:
             self._forward_task.cancel()
@@ -105,9 +113,13 @@ class PullRelay:
                 await self._forward_task
             except (asyncio.CancelledError, Exception):
                 pass
-        await self.client.teardown(self.url)
+        if was_alive:       # dead upstream: TEARDOWN would just time out
+            await self.client.teardown(self.url)
         await self.client.close()
-        self.registry.remove(self.local_path)
+        # remove only OUR session — a pusher may have re-announced the path
+        # after this pull died, and that live broadcast must survive
+        if self.registry.find(self.local_path) is self.session:
+            self.registry.remove(self.local_path)
         self.session = None
 
     def stats(self) -> dict:

@@ -67,6 +67,9 @@ class StreamingServer:
         from .mp3 import Mp3Service
         self.recordings = RecordingManager()
         self.hls = HlsService(self.registry)
+        from ..models.mjpeg_ladder import MjpegTranscodeService
+        self.transcodes = MjpegTranscodeService(
+            self.registry, on_frame=lambda _path: self._wake())
         self.mp3 = Mp3Service(self.config.movie_folder)
         self.rtsp.http_get_handler = self._rtsp_port_http_get
         self._pump_event = asyncio.Event()
@@ -142,6 +145,7 @@ class StreamingServer:
             except (asyncio.CancelledError, Exception):
                 pass
         self.relay_source.close_all()
+        self.transcodes.stop_all()
         await self.pulls.stop_all()
         await self.rtsp.stop()
         await self.rest.stop()
@@ -244,6 +248,7 @@ class StreamingServer:
             await asyncio.sleep(self.config.timeout_sweep_sec)
             self.rtsp.sweep_timeouts()
             self.relay_source.sweep()
+            self.transcodes.sweep()
             await self.pulls.sweep()
 
     async def _rtsp_port_http_get(self, conn, target: str,

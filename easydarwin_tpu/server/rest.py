@@ -250,6 +250,43 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
             "Pulls": self.app.pulls.list_pulls()})
 
+    def _cmd_starttranscode(self, params: dict,
+                            body: bytes) -> tuple[int, str]:
+        """Start an on-TPU MJPEG bitrate ladder on a live path; the rungs
+        appear as {path}@q{Q} live streams."""
+        path = params.get("path", [""])[0]
+        try:
+            rungs = tuple(int(q) for q in
+                          params.get("rungs", ["40,20"])[0].split(",") if q)
+        except ValueError:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": "rungs must be integers"})
+        try:
+            out = self.app.transcodes.start(path, rungs)
+        except KeyError:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        except ValueError as e:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": str(e)})
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Transcode": out.source_path,
+            "Rungs": [r.session.path for r in out.rungs]})
+
+    def _cmd_stoptranscode(self, params: dict,
+                           body: bytes) -> tuple[int, str]:
+        path = params.get("path", [""])[0]
+        try:
+            st = self.app.transcodes.stop(path)
+        except KeyError:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Transcode": st["path"], "FramesIn": str(st["frames_in"])})
+
+    def _cmd_gettranscodes(self, params: dict,
+                           body: bytes) -> tuple[int, str]:
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Transcodes": self.app.transcodes.list_ladders()})
+
     def _cmd_admin(self, params: dict, body: bytes) -> tuple[int, str]:
         """Dictionary-tree browse (QTSSAdminModule's /modules/admin API):
         ``?path=server/prefs/*&command=get[&recurse=1]`` or

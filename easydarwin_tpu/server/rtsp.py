@@ -554,7 +554,7 @@ class RtspServer:
         # per-IP cap (QTSSSpamDefenseModule): refuse before spending a task
         per_ip = self.config.max_connections_per_ip
         peer = writer.get_extra_info("peername")
-        ip = peer[0] if peer else ""
+        ip = peer[0] if peer else "?"       # same fallback as client_ip
         if per_ip and self._per_ip.get(ip, 0) >= per_ip:
             writer.close()
             return

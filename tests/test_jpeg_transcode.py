@@ -1,0 +1,259 @@
+"""Config-5 transcode path: JPEG entropy codec + on-device MJPEG ladder.
+
+The codec is validated three ways: exact roundtrip on synthetic
+coefficients, cross-check against PIL (a real JPEG decoder must read what
+we write), and end-to-end: push an RTP/JPEG stream, start a ladder, PLAY
+a rung, and decode what arrives.
+"""
+
+import asyncio
+import io
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu.protocol import jpeg_entropy as je
+from easydarwin_tpu.protocol import mjpeg, rtp
+from easydarwin_tpu.relay.session import SessionRegistry
+from easydarwin_tpu.models.mjpeg_ladder import (MjpegTranscodeService,
+                                                _rung_sdp)
+
+
+def sparse_levels(rng, n, density=6):
+    arr = np.zeros((n, 64), np.int16)
+    for b in arr:
+        b[0] = rng.integers(-180, 180)
+        for k in rng.integers(1, 64, size=density):
+            b[k] = rng.integers(-60, 60)
+    return arr
+
+
+# ------------------------------------------------------------------ codec
+
+
+@pytest.mark.parametrize("jtype,w,h", [(1, 32, 32), (0, 48, 16),
+                                       (1, 64, 48)])
+def test_entropy_roundtrip(jtype, w, h):
+    rng = np.random.default_rng(hash((jtype, w)) & 0xFFFF)
+    gw, gh = je.mcu_grid(w, h, jtype)
+    n = gw * gh
+    n_y = 4 if jtype == 1 else 2
+    levels = [sparse_levels(rng, n * n_y), sparse_levels(rng, n),
+              sparse_levels(rng, n)]
+    scan = je.encode_scan(levels, jtype)
+    out = je.decode_scan(scan, w, h, jtype)
+    for a, b in zip(levels, out):
+        assert np.array_equal(a, b)
+
+
+def test_entropy_extremes():
+    """Max-category coefficients, all-zero blocks, long zero runs (ZRL)."""
+    levels = [np.zeros((4, 64), np.int16), np.zeros((1, 64), np.int16),
+              np.zeros((1, 64), np.int16)]
+    levels[0][0][0] = 1023
+    levels[0][0][63] = -1       # forces 3× ZRL then coeff at the end
+    levels[0][1][0] = -1023
+    scan = je.encode_scan(levels, 1)
+    out = je.decode_scan(scan, 16, 16, 1)
+    for a, b in zip(levels, out):
+        assert np.array_equal(a, b)
+
+
+def test_codec_writes_real_jpeg():
+    """PIL must decode our JFIF output to the source image (gradient)."""
+    PIL = pytest.importorskip("PIL.Image")
+    from easydarwin_tpu.ops import transform
+
+    w = h = 32
+    q = 80
+    qt = mjpeg.make_qtables(q)
+    zz = transform.zigzag_order()
+
+    def enc(pix, qtab_zz):
+        qn = np.empty(64, np.float32)
+        qn[zz] = qtab_zz
+        coef = np.asarray(transform.dct_blocks(
+            np.asarray(pix.reshape(-1, 64) - 128.0, np.float32)))
+        return np.round(coef / qn).astype(np.int16)[:, zz]
+
+    ymat = np.tile(np.linspace(40, 220, w, dtype=np.float32), (h, 1))
+    yb = [ymat[my * 16 + sy * 8:my * 16 + sy * 8 + 8,
+               mx * 16 + sx * 8:mx * 16 + sx * 8 + 8]
+          for my in range(2) for mx in range(2)
+          for sy in range(2) for sx in range(2)]
+    qy = np.frombuffer(qt[:64], np.uint8).astype(np.float32)
+    qc = np.frombuffer(qt[64:], np.uint8).astype(np.float32)
+    Y = enc(np.stack(yb), qy)
+    C = enc(np.full((4, 8, 8), 128.0, np.float32), qc)
+    scan = je.encode_scan([Y, C.copy(), C.copy()], 1)
+    hdr = mjpeg.JpegHeader(type=1, q=q, width=w, height=h, qtables=qt)
+    jfif = mjpeg.make_jfif_headers(hdr, qt) + scan + b"\xff\xd9"
+    img = PIL.open(io.BytesIO(jfif))
+    img.load()
+    arr = np.asarray(img.convert("L"), np.float32)
+    assert np.abs(arr - ymat).mean() < 8.0
+
+
+# ------------------------------------------------------------------ ladder
+
+
+def make_mjpeg_packets(seq0=1, ts=9000, w=32, h=32, q=80):
+    rng = np.random.default_rng(3)
+    gw, gh = je.mcu_grid(w, h, 1)
+    n = gw * gh
+    levels = [sparse_levels(rng, n * 4), sparse_levels(rng, n),
+              sparse_levels(rng, n)]
+    scan = je.encode_scan(levels, 1)
+    return levels, mjpeg.packetize_jpeg(scan, width=w, height=h, seq=seq0,
+                                        timestamp=ts, ssrc=0xF00D,
+                                        type_=1, q=q)
+
+
+MJPEG_SDP = ("v=0\r\ns=cam\r\nt=0 0\r\nm=video 0 RTP/AVP 26\r\n"
+             "a=rtpmap:26 JPEG/90000\r\na=control:trackID=1\r\n")
+
+
+def test_ladder_produces_decodable_smaller_rungs():
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", (40, 10))
+    levels, pkts = make_mjpeg_packets()
+    for p in pkts:
+        src.push(1, p)
+    src.reflect()                   # pump the fan-out to the ladder tap
+    assert out.frames_in == 1 and out.decode_errors == 0
+    st = out.stats()
+    assert [r["q"] for r in st["rungs"]] == [40, 10]
+    # rungs exist as live sessions with packets queued
+    sizes = []
+    for r in out.rungs:
+        rs = reg.find(r.session.path)
+        assert rs is not None and r.frames == 1
+        stream = rs.streams[1]
+        assert stream.stats.packets_in >= 1
+        sizes.append(r.bytes_out)
+        # the rung's packets reassemble into a decodable frame whose
+        # levels match an exact host-side requantization oracle
+        dep = mjpeg.JpegDepacketizer()
+        got = None
+        ring = stream.rtp_ring
+        for i in ring.ids():
+            got = dep.push_parts(ring.get(i)) or got
+        assert got is not None
+        hdr, scan, _ts = got
+        y, cb, cr = je.decode_scan(scan, 32, 32, 1)
+        qt_in = mjpeg.make_qtables(80)
+        qt_out = mjpeg.make_qtables(r.q)
+        qy_in = np.frombuffer(qt_in[:64], np.uint8).astype(np.float64)
+        qy_out = np.frombuffer(qt_out[:64], np.uint8).astype(np.float64)
+        oracle = np.round(levels[0].astype(np.float64) * qy_in / qy_out)
+        assert np.abs(y.astype(np.float64) - oracle).max() <= 1
+    assert sizes[1] <= sizes[0]     # q10 rung is no bigger than q40
+    stopped = svc.stop("/cam")
+    assert stopped["frames_in"] == 1
+    assert reg.find("/cam@q40") is None and reg.find("/cam@q10") is None
+    assert src.num_outputs == 0
+
+
+def test_ladder_requires_mjpeg_track():
+    reg = SessionRegistry()
+    reg.find_or_create("/h264", "v=0\r\ns=x\r\nt=0 0\r\n"
+                       "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+                       "a=control:trackID=1\r\n")
+    svc = MjpegTranscodeService(reg)
+    with pytest.raises(ValueError):
+        svc.start("/h264")
+    with pytest.raises(KeyError):
+        svc.start("/nope")
+
+
+@pytest.mark.asyncio
+async def test_transcode_rest_and_play_e2e():
+    """Push MJPEG → REST starttranscode → PLAY a rung over RTSP."""
+    import json
+    import urllib.request
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, reflect_interval_ms=5,
+                       bind_ip="127.0.0.1", access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/mcam"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, MJPEG_SDP.replace("m=video 0",
+                                                       "m=video 0"))
+        base = f"http://127.0.0.1:{app.rest.port}/api/v1"
+
+        def get(url):
+            return json.loads(urllib.request.urlopen(url, timeout=5).read())
+
+        start = await asyncio.to_thread(
+            get, f"{base}/starttranscode?path=/live/mcam&rungs=30")
+        assert start["EasyDarwin"]["Body"]["Rungs"] == ["/live/mcam@q30"]
+
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        sd = await player.play_start(
+            f"rtsp://127.0.0.1:{app.rtsp.port}/live/mcam@q30")
+        assert sd.streams[0].codec == "JPEG"
+
+        _levels, pkts = make_mjpeg_packets(ts=18000)
+        for p in pkts:
+            pusher.push_packet(0, p)
+        dep = mjpeg.JpegDepacketizer()
+        frame = None
+        for _ in range(12):
+            data = await asyncio.wait_for(player.recv_interleaved(0), 5.0)
+            frame = dep.push(data)
+            if frame is not None:
+                break
+        assert frame is not None and frame.startswith(b"\xff\xd8")
+        lst = await asyncio.to_thread(get, f"{base}/gettranscodes")
+        assert lst["EasyDarwin"]["Body"]["Transcodes"][0]["frames_in"] >= 1
+        stop = await asyncio.to_thread(
+            get, f"{base}/stoptranscode?path=/live/mcam")
+        assert stop["EasyDarwin"]["Body"]["Transcode"] == "/live/mcam"
+        await player.close()
+        await pusher.close()
+    finally:
+        await app.stop()
+
+
+def test_ladder_swept_when_source_dies_and_restart_works():
+    """Pusher disconnect removes the source session; the sweep retires the
+    ladder and its rungs so a re-announce + fresh starttranscode works."""
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    svc.start("/cam", (40,))
+    reg.remove("/cam")                      # pusher gone
+    assert svc.sweep() == 1
+    assert not svc.ladders and reg.find("/cam@q40") is None
+    # re-announce → new session → transcode restarts cleanly
+    src2 = reg.find_or_create("/cam", MJPEG_SDP)
+    out2 = svc.start("/cam", (40,))
+    assert out2.source_session is src2
+    svc.stop_all()
+
+
+def test_ladder_rejects_invalid_rungs():
+    reg = SessionRegistry()
+    reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    for bad in ((150,), (-5,), (0,), ()):
+        with pytest.raises(ValueError):
+            svc.start("/cam", bad)
+
+
+def test_ladder_stop_normalizes_path():
+    reg = SessionRegistry()
+    reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    svc.start("/cam", (40,))
+    reg.remove("/cam")                      # source gone, ladder remains
+    st = svc.stop("/cam/")                  # un-normalized form still stops
+    assert st["path"] == "/cam" and not svc.ladders

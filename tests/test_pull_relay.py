@@ -164,3 +164,30 @@ async def test_pull_refuses_occupied_path():
             await b.pulls.start_pull("/busy", "rtsp://127.0.0.1:1/x")
     finally:
         await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_dead_pull_never_removes_a_reannounced_session():
+    """A pusher that takes over a dead pull's path must survive the sweep
+    (ownership check in PullRelay.stop)."""
+    a = await _server()
+    b = await _server()
+    try:
+        a_uri = f"rtsp://127.0.0.1:{a.rtsp.port}/live/x"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", a.rtsp.port)
+        await pusher.push_start(a_uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(1, 0, nal_type=5))
+        await b.pulls.start_pull("/x", a_uri)
+        await pusher.close()
+        await a.stop()
+        for _ in range(100):
+            if not b.pulls.pulls["/x"].alive:
+                break
+            await asyncio.sleep(0.05)
+        # a local pusher re-announces /x on B before the sweep runs
+        takeover = b.registry.find_or_create("/x", PUSH_SDP)
+        assert await b.pulls.sweep() == 1
+        assert b.registry.find("/x") is takeover    # survived the sweep
+    finally:
+        await b.stop()
