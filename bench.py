@@ -193,15 +193,10 @@ def tpu_native_rate(ring, lens, addrs, drain, *, force_cpu=False,
         res = np.asarray(queue.pop(0))                 # one tiny transfer
         queue.append(dispatch())                       # overlap with egress
         seq_off, ts_off, ssrc, kf = unpack_affine(res, n_sub_per_src)
-        seq_off = np.ascontiguousarray(seq_off)
-        ts_off = np.ascontiguousarray(ts_off)
-        ssrc = np.ascontiguousarray(ssrc)
-        u = 0
-        for src in range(N_SRC):
-            sent = send_fn(
-                send_sock.fileno(), ring, lens, seq_off[src], ts_off[src],
-                ssrc[src], dests, ops, n_ops)
-            u += max(sent, 0)
+        # ONE C call sends all sources' windows (multi-source egress)
+        u = max(0, native.fanout_send_multi(
+            send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
+            dests, ops, n_ops, use_gso=gso))
         units += u
         pass_times.append(time.perf_counter() - p0)
         pass_units.append(u)

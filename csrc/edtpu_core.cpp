@@ -253,6 +253,38 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
   return done;
 }
 
+// Multi-source egress: one call sends `n_src` sources sharing a ring and
+// op list, with per-source rewrite params laid out as [n_src, n_outs]
+// row-major (exactly the packed device result after unpack).  Cuts the
+// per-window Python->C transition count from n_src to 1 on the hot loop.
+// `use_gso` selects the UDP_SEGMENT path.  Returns total ops sent or
+// -errno on a hard error with nothing sent.
+int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
+                             const int32_t *ring_len, int32_t capacity,
+                             int32_t slot_size, const uint32_t *seq_off,
+                             const uint32_t *ts_off, const uint32_t *ssrc,
+                             int32_t n_src, int32_t param_stride,
+                             const ed_dest *dest,
+                             int32_t n_outs, const ed_sendop *ops,
+                             int32_t n_ops, int32_t use_gso) {
+  if (param_stride < n_outs) return -EINVAL;
+  int64_t total = 0;
+  for (int32_t s = 0; s < n_src; ++s) {
+    const uint32_t *sq = seq_off + static_cast<size_t>(s) * param_stride;
+    const uint32_t *ts = ts_off + static_cast<size_t>(s) * param_stride;
+    const uint32_t *sc = ssrc + static_cast<size_t>(s) * param_stride;
+    int32_t r = use_gso
+        ? ed_fanout_send_udp_gso(fd, ring_data, ring_len, capacity,
+                                 slot_size, sq, ts, sc, dest, n_outs, ops,
+                                 n_ops)
+        : ed_fanout_send_udp(fd, ring_data, ring_len, capacity, slot_size,
+                             sq, ts, sc, dest, n_outs, ops, n_ops);
+    if (r < 0) return total > 0 ? static_cast<int32_t>(total) : r;
+    total += r;
+  }
+  return static_cast<int32_t>(total);
+}
+
 int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
                          int32_t capacity, int32_t slot_size,
                          const uint32_t *seq_off, const uint32_t *ts_off,

@@ -78,6 +78,21 @@ int32_t ed_fanout_send_udp_gso(int fd,
                                int32_t n_outs,
                                const ed_sendop *ops, int32_t n_ops);
 
+/* Multi-source egress: n_src sources share ring_data/ops; rewrite params
+ * are [n_src, param_stride] row-major (the packed device result; the
+ * stride may exceed n_outs when fewer sockets stand in for the logical
+ * subscriber population).  One Python->C transition per window instead
+ * of n_src.  use_gso selects the UDP_SEGMENT path.  Returns total ops
+ * sent; negative errno only when nothing was sent. */
+int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
+                             const int32_t *ring_len, int32_t capacity,
+                             int32_t slot_size, const uint32_t *seq_off,
+                             const uint32_t *ts_off, const uint32_t *ssrc,
+                             int32_t n_src, int32_t param_stride,
+                             const ed_dest *dest,
+                             int32_t n_outs, const ed_sendop *ops,
+                             int32_t n_ops, int32_t use_gso);
+
 /* Same render, but into a caller buffer instead of the wire: out must hold
  * n_ops * (12 + max payload) — used for interleaved/TCP paths and tests.
  * out_lens[i] receives each rendered packet's length.  Returns n rendered. */

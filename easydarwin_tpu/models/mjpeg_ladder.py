@@ -64,6 +64,9 @@ class MjpegLadderOutput(RelayOutput):
         self.frames_in = 0
         self.decode_errors = 0
         self.source_session = None          # set by the service on attach
+        #: RFC 2435 §4.2: in-band tables (Q 128..254) may ride only in the
+        #: first frame — receivers cache them per Q value
+        self._qt_cache: dict[int, bytes] = {}
 
     # thinning/rewrite are meaningless for a transcoder tap
     def write_rtp(self, packet: bytes) -> WriteResult:
@@ -76,7 +79,7 @@ class MjpegLadderOutput(RelayOutput):
         if parts is not None:
             try:
                 self._transcode_frame(*parts)
-            except (je.JpegEntropyError, mjpeg.MjpegError, ValueError):
+            except Exception:   # a bad frame must never kill the fan-out
                 self.decode_errors += 1
         self.packets_sent += 1
         self.bytes_sent += len(data)
@@ -90,8 +93,17 @@ class MjpegLadderOutput(RelayOutput):
         w, h = header.width, header.height
         if not w or not h:
             return
-        qt_in = header.qtables or mjpeg.make_qtables(
-            header.q if 1 <= header.q <= 99 else 99)
+        if header.qtables:
+            qt_in = header.qtables
+            self._qt_cache[header.q] = qt_in
+        elif header.q >= 128:
+            qt_in = self._qt_cache.get(header.q)
+            if qt_in is None:       # tables not seen yet: cannot requantize
+                self.decode_errors += 1
+                return
+        else:
+            qt_in = mjpeg.make_qtables(header.q if 1 <= header.q <= 99
+                                       else 99)
         if len(qt_in) < 128:
             qt_in = (qt_in + qt_in)[:128]
         qy_in = np.frombuffer(qt_in[:64], np.uint8).astype(np.int32)
@@ -102,9 +114,14 @@ class MjpegLadderOutput(RelayOutput):
         y32 = y.astype(np.int32)
         chroma32 = np.concatenate([cb, cr], axis=0).astype(np.int32)
         for rung in self.rungs:
-            # the device does all blocks of the frame in two batched calls
-            y2 = np.asarray(requantize(y32, qy_in, rung.qy), np.int16)
-            c2 = np.asarray(requantize(chroma32, qc_in, rung.qc), np.int16)
+            # the device does all blocks of the frame in two batched calls;
+            # clamp to the baseline-codable range (|AC| <= 1023 keeps the
+            # Huffman category <= 10 and |DC diff| <= 2046 < 2047) so an
+            # up-quality rung can never produce unencodable coefficients
+            y2 = np.clip(np.asarray(requantize(y32, qy_in, rung.qy)),
+                         -1023, 1023).astype(np.int16)
+            c2 = np.clip(np.asarray(requantize(chroma32, qc_in, rung.qc)),
+                         -1023, 1023).astype(np.int16)
             n = len(cb)
             new_scan = je.encode_scan([y2, c2[:n], c2[n:]], jt)
             pkts = mjpeg.packetize_jpeg(
@@ -139,20 +156,24 @@ class MjpegTranscodeService:
         self.ladders: dict[str, MjpegLadderOutput] = {}
 
     def start(self, path: str, qualities: tuple[int, ...] = (40, 20)):
-        bad = [q for q in qualities if not 1 <= int(q) <= 99]
+        qualities = tuple(dict.fromkeys(int(q) for q in qualities))  # dedup
+        bad = [q for q in qualities if not 1 <= q <= 99]
         if bad or not qualities:
             raise ValueError(f"rung qualities must be 1..99, got {bad}")
         sess = self.registry.find(path)
         if sess is None:
             raise KeyError(path)
         video = next((tid for tid, st in sess.streams.items()
-                      if st.info.codec == "JPEG"), None)
+                      if st.info.codec in ("JPEG", "MJPEG", "MJPG")), None)
         if video is None:
             raise ValueError(f"{path} has no MJPEG video track")
         key = sess.path
         if key in self.ladders:
             raise ValueError(f"transcode already active on {key}")
-        out = MjpegLadderOutput(key, self.registry, tuple(qualities),
+        for q in qualities:     # a rung path must not steal a live session
+            if self.registry.find(f"{key}@q{q}") is not None:
+                raise ValueError(f"{key}@q{q} is already a live session")
+        out = MjpegLadderOutput(key, self.registry, qualities,
                                 on_frame=self.on_frame)
         out.source_session = sess
         sess.add_output(video, out)

@@ -59,7 +59,7 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        if not hasattr(lib, "ed_udp_drain_ex"):
+        if not hasattr(lib, "ed_fanout_send_multi"):
             # stale prebuilt .so from an older source tree: rebuild in place
             # (make relinks to a fresh inode, so a second dlopen maps the
             # new library; the old one is never deleted, in case no
@@ -70,7 +70,7 @@ def _load():
                 lib = ctypes.CDLL(_SO)
             except OSError:
                 return None
-            if not hasattr(lib, "ed_udp_drain_ex"):
+            if not hasattr(lib, "ed_fanout_send_multi"):
                 return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -84,6 +84,12 @@ def _load():
             ctypes.POINTER(SendOp), ctypes.c_int32]
         lib.ed_fanout_send_udp_gso.restype = ctypes.c_int32
         lib.ed_fanout_send_udp_gso.argtypes = lib.ed_fanout_send_udp.argtypes
+        lib.ed_fanout_send_multi.restype = ctypes.c_int32
+        lib.ed_fanout_send_multi.argtypes = [
+            ctypes.c_int, u8p, i32p, ctypes.c_int32, ctypes.c_int32,
+            u32p, u32p, u32p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(Dest), ctypes.c_int32, ctypes.POINTER(SendOp),
+            ctypes.c_int32, ctypes.c_int32]
         lib.ed_udp_drain.restype = ctypes.c_int64
         lib.ed_udp_drain.argtypes = [i32p, ctypes.c_int32]
         lib.ed_udp_drain_ex.restype = ctypes.c_int64
@@ -189,6 +195,30 @@ def fanout_send_udp_gso(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         _u32(np.ascontiguousarray(ts_off, np.uint32)),
         _u32(np.ascontiguousarray(ssrc, np.uint32)),
         dests, len(dests), ops, n_ops)
+
+
+def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
+                      seq_off: np.ndarray, ts_off: np.ndarray,
+                      ssrc: np.ndarray, dests, ops, n_ops: int,
+                      *, use_gso: bool = True) -> int:
+    """Multi-source egress: ``seq_off``/``ts_off``/``ssrc`` are
+    [n_src, n_outs]; ONE C call sends every source's window (the hot loop
+    makes one Python→C transition per pass instead of n_src)."""
+    lib = _load()
+    assert lib is not None
+    assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+    seq = np.ascontiguousarray(seq_off, np.uint32)
+    ts = np.ascontiguousarray(ts_off, np.uint32)
+    sc = np.ascontiguousarray(ssrc, np.uint32)
+    assert seq.ndim == 2 and seq.shape == ts.shape == sc.shape
+    # the param row may be wider than the dest table (fewer real sockets
+    # than logical subscribers); ops only reference outs < len(dests)
+    assert seq.shape[1] >= len(dests)
+    return lib.ed_fanout_send_multi(
+        fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1],
+        _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
+        dests, len(dests), ops, n_ops, 1 if use_gso else 0)
 
 
 def udp_drain(fds: list[int]) -> int:

@@ -192,7 +192,11 @@ class StreamingServer:
             except asyncio.TimeoutError:
                 pass
             self._pump_event.clear()
-            self._reflect_all()
+            try:
+                self._reflect_all()
+            except Exception as e:      # one bad output must never halt
+                if self.error_log:      # fan-out for every session
+                    self.error_log.warning(f"reflect error: {e!r}")
             now = time.monotonic()
             if now - last_prune >= 1.0:
                 last_prune = now

@@ -257,3 +257,140 @@ def test_ladder_stop_normalizes_path():
     reg.remove("/cam")                      # source gone, ladder remains
     st = svc.stop("/cam/")                  # un-normalized form still stops
     assert st["path"] == "/cam" and not svc.ladders
+
+
+def test_codec_fuzz_vs_pil():
+    """Randomized images × qualities × sampling types: every JFIF we emit
+    must be decodable by PIL with pixels close to our own decode path."""
+    PIL = pytest.importorskip("PIL.Image")
+    from easydarwin_tpu.ops import transform
+
+    zz = transform.zigzag_order()
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        jt = int(rng.integers(0, 2))
+        w = int(rng.integers(2, 5)) * 16
+        h = int(rng.integers(2, 5)) * (16 if jt == 1 else 8)
+        q = int(rng.integers(25, 95))
+        qt = mjpeg.make_qtables(q)
+        gw, gh = je.mcu_grid(w, h, jt)
+        n = gw * gh
+        n_y = 4 if jt == 1 else 2
+
+        def enc(pix, qtab_zz):
+            qn = np.empty(64, np.float32)
+            qn[zz] = qtab_zz
+            coef = np.asarray(transform.dct_blocks(
+                np.asarray(pix.reshape(-1, 64) - 128.0, np.float32)))
+            return np.round(coef / qn).astype(np.int16)[:, zz]
+
+        # smooth random field (JPEG-friendly): low-freq cosine mixture
+        xs = np.linspace(0, np.pi * rng.uniform(1, 3), w)
+        ys = np.linspace(0, np.pi * rng.uniform(1, 3), h)
+        ymat = (128 + 90 * np.outer(np.cos(ys), np.cos(xs))).astype(np.float32)
+        qy = np.frombuffer(qt[:64], np.uint8).astype(np.float32)
+        qc = np.frombuffer(qt[64:], np.uint8).astype(np.float32)
+        mh = 16 if jt == 1 else 8
+        yb = []
+        for my in range(gh):
+            for mx in range(gw):
+                for sy in range(mh // 8):
+                    for sx in range(2):
+                        y0, x0 = my * mh + sy * 8, mx * 16 + sx * 8
+                        yb.append(ymat[y0:y0 + 8, x0:x0 + 8])
+        Y = enc(np.stack(yb), qy)
+        C = enc(np.full((n, 8, 8), 128.0, np.float32), qc)
+        scan = je.encode_scan([Y, C.copy(), C.copy()], jt)
+        # roundtrip exactness
+        back = je.decode_scan(scan, w, h, jt)
+        assert np.array_equal(back[0], Y), f"trial {trial}"
+        # PIL decodability + fidelity
+        hdr = mjpeg.JpegHeader(type=jt, q=q, width=w, height=h, qtables=qt)
+        jfif = mjpeg.make_jfif_headers(hdr, qt) + scan + b"\xff\xd9"
+        img = PIL.open(io.BytesIO(jfif))
+        img.load()
+        arr = np.asarray(img.convert("L"), np.float32)
+        err = np.abs(arr - ymat).mean()
+        assert err < 12.0, f"trial {trial}: jt={jt} {w}x{h} q={q} err={err}"
+
+
+def test_up_quality_rung_clamps_instead_of_crashing():
+    """Requantizing q=20 source levels with a q=95 table grows magnitudes
+    past the Huffman-codable range; the ladder must clamp and keep the
+    stream alive (an escaped KeyError used to kill the global pump)."""
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", (95,))
+    _levels, pkts = make_mjpeg_packets(q=20)    # coarse source tables
+    for p in pkts:
+        src.push(1, p)
+    src.reflect()
+    assert out.frames_in == 1 and out.decode_errors == 0
+    assert out.rungs[0].frames == 1             # rung emitted, not crashed
+    # emitted scan is decodable and within the clamped range
+    rung_stream = reg.find("/cam@q95").streams[1]
+    dep = mjpeg.JpegDepacketizer()
+    got = None
+    for i in rung_stream.rtp_ring.ids():
+        got = dep.push_parts(rung_stream.rtp_ring.get(i)) or got
+    y, _cb, _cr = je.decode_scan(got[1], 32, 32, 1)
+    assert np.abs(y).max() <= 1023
+    svc.stop_all()
+
+
+def test_rung_dedup_and_collision_guard():
+    reg = SessionRegistry()
+    reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", (40, 40, 20))       # dup collapses
+    assert [r.q for r in out.rungs] == [40, 20]
+    svc.stop("/cam")
+    # a live session occupying a rung path blocks the ladder
+    reg.find_or_create("/cam@q40", MJPEG_SDP)
+    with pytest.raises(ValueError):
+        svc.start("/cam", (40,))
+
+
+def test_mjpeg_codec_aliases_accepted():
+    reg = SessionRegistry()
+    reg.find_or_create("/m", MJPEG_SDP.replace("JPEG/90000", "MJPEG/90000"))
+    svc = MjpegTranscodeService(reg)
+    assert svc.start("/m", (50,)) is not None
+    svc.stop_all()
+
+
+def test_inband_qtables_cached_across_frames():
+    """Q>=128: tables ride only in the first frame; later frames must use
+    the cached tables (RFC 2435 §4.2), not a fallback."""
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", (40,))
+    rng = np.random.default_rng(3)
+    gw, gh = je.mcu_grid(32, 32, 1)
+    n = gw * gh
+    levels = [sparse_levels(rng, n * 4), sparse_levels(rng, n),
+              sparse_levels(rng, n)]
+    scan = je.encode_scan(levels, 1)
+    qt = mjpeg.make_qtables(75)
+    # frame 1: in-band tables; frame 2: same Q id, no tables
+    f1 = mjpeg.packetize_jpeg(scan, width=32, height=32, seq=1,
+                              timestamp=9000, ssrc=1, type_=1, q=200,
+                              qtables=qt)
+    f2 = mjpeg.packetize_jpeg(scan, width=32, height=32,
+                              seq=1 + len(f1), timestamp=18000, ssrc=1,
+                              type_=1, q=200)
+    for p in f1 + f2:
+        src.push(1, p)
+    src.reflect()
+    assert out.frames_in == 2 and out.decode_errors == 0
+    assert out.rungs[0].frames == 2
+    # tables never seen at all → frame skipped and counted, no crash
+    out._qt_cache.clear()
+    for p in mjpeg.packetize_jpeg(scan, width=32, height=32, seq=50,
+                                  timestamp=27000, ssrc=1, type_=1, q=200):
+        src.push(1, p)
+    src.reflect()
+    assert out.decode_errors == 1 and out.rungs[0].frames == 2
+    svc.stop_all()

@@ -201,3 +201,45 @@ def test_timer_wheel_fire_order_and_cancel():
     d = w.schedule(3, 444)
     assert w.advance(7003) == [444]
     w.close()
+
+
+def test_fanout_send_multi_matches_per_source_calls():
+    """One multi-source call delivers exactly what n_src single-source
+    calls deliver, for both GSO and plain paths."""
+    subs = []
+    for _ in range(2):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        s.settimeout(2)
+        subs.append(s)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    pkts = [pkt(10, 0, b"x" * 64), pkt(11, 90, b"y" * 64)]
+    data, lens = make_ring(pkts)
+    n_src, n_out = 3, 2
+    rng = np.random.default_rng(5)
+    seq = rng.integers(0, 5000, size=(n_src, n_out)).astype(np.uint32)
+    ts = rng.integers(0, 5000, size=(n_src, n_out)).astype(np.uint32)
+    ssrc = rng.integers(0, 2**32, size=(n_src, n_out)).astype(np.uint32)
+    dests = native.make_dests([s.getsockname() for s in subs])
+    ops = native.make_ops([(s, o) for o in range(n_out) for s in range(2)])
+    for use_gso in (False, True):
+        n = native.fanout_send_multi(send_sock.fileno(), data, lens,
+                                     seq, ts, ssrc, dests, ops, 4,
+                                     use_gso=use_gso)
+        if n < 0 and use_gso:
+            pytest.skip(f"kernel without UDP GSO ({n})")
+        assert n == n_src * 4
+        for o, sub in enumerate(subs):
+            got = sorted((sub.recv(4096) for _ in range(n_src * 2)),
+                         key=lambda d: (rtp.peek_seq(d), d[8:12]))
+            expect = sorted(
+                (rtp.rewrite_header(
+                    pkts[s], seq=(10 + s + int(seq[src][o])) & 0xFFFF,
+                    timestamp=(s * 90 + int(ts[src][o])) & 0xFFFFFFFF,
+                    ssrc=int(ssrc[src][o]))
+                 for src in range(n_src) for s in range(2)),
+                key=lambda d: (rtp.peek_seq(d), d[8:12]))
+            assert got == expect, (use_gso, o)
+    for s in subs:
+        s.close()
+    send_sock.close()
