@@ -19,6 +19,8 @@ No reference counterpart — new code, like the HLS tier.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from ..protocol import jpeg_entropy as je
@@ -34,9 +36,68 @@ def _rung_sdp(path: str) -> str:
             "a=control:trackID=1\r\n")
 
 
+def parse_rung(spec) -> tuple[int, int]:
+    """Rung spec → (quality, scale).  ``40`` or ``"40"`` = quality-only;
+    ``"40s2"`` = quality 40 at half resolution (DCT-domain downscale)."""
+    if isinstance(spec, int):
+        return spec, 1
+    s = str(spec).strip().lower()
+    scale = 1
+    if "s" in s:
+        s, _, sc = s.partition("s")
+        scale = int(sc)
+        if scale not in (1, 2):
+            raise ValueError(f"unsupported rung scale s{sc}")
+    return int(s), scale
+
+
+def rung_suffix(q: int, scale: int) -> str:
+    return f"@q{q}" + ("s2" if scale == 2 else "")
+
+
+@functools.lru_cache(maxsize=64)
+def _quad_index(jt: int, gw: int, gh: int
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """(y_idx, c_idx): for each output block (in output-MCU order), the 4
+    source blocks [tl, tr, bl, br] (in input-MCU order) whose 2×2 tile it
+    downsamples.  Component block-grid geometry per RTP/JPEG type."""
+    gw2, gh2 = gw // 2, gh // 2
+    if jt == 1:                         # 4:2:0: Y grid [2gh, 2gw]
+        def yin(by, bx):
+            return (by // 2 * gw + bx // 2) * 4 + (by % 2) * 2 + (bx % 2)
+
+        def yout(by, bx):
+            return (by // 2 * gw2 + bx // 2) * 4 + (by % 2) * 2 + (bx % 2)
+        yh, yw = 2 * gh2, 2 * gw2
+    else:                               # 4:2:2: Y grid [gh, 2gw]
+        def yin(by, bx):
+            return (by * gw + bx // 2) * 2 + (bx % 2)
+
+        def yout(by, bx):
+            return (by * gw2 + bx // 2) * 2 + (bx % 2)
+        yh, yw = gh2, 2 * gw2
+    n_y = yh * yw
+    y_idx = np.zeros((n_y, 4), np.int32)
+    for by in range(yh):
+        for bx in range(yw):
+            y_idx[yout(by, bx)] = [yin(2 * by, 2 * bx),
+                                   yin(2 * by, 2 * bx + 1),
+                                   yin(2 * by + 1, 2 * bx),
+                                   yin(2 * by + 1, 2 * bx + 1)]
+    c_idx = np.zeros((gh2 * gw2, 4), np.int32)
+    for my in range(gh2):
+        for mx in range(gw2):
+            c_idx[my * gw2 + mx] = [(2 * my) * gw + 2 * mx,
+                                    (2 * my) * gw + 2 * mx + 1,
+                                    (2 * my + 1) * gw + 2 * mx,
+                                    (2 * my + 1) * gw + 2 * mx + 1]
+    return y_idx, c_idx
+
+
 class _Rung:
-    def __init__(self, q: int, session):
+    def __init__(self, q: int, scale: int, session):
         self.q = q
+        self.scale = scale
         self.session = session
         self.qtables = mjpeg.make_qtables(q)
         self.qy = np.frombuffer(self.qtables[:64], np.uint8).astype(np.int32)
@@ -44,6 +105,7 @@ class _Rung:
         self.seq = 1
         self.frames = 0
         self.bytes_out = 0
+        self.skipped = 0        # frames whose dims don't support the scale
 
 
 class MjpegLadderOutput(RelayOutput):
@@ -51,18 +113,21 @@ class MjpegLadderOutput(RelayOutput):
     pattern) and feeds the rung sessions."""
 
     def __init__(self, source_path: str, registry: SessionRegistry,
-                 qualities: tuple[int, ...], *, on_frame=None):
+                 rungs: tuple[tuple[int, int], ...], *, on_frame=None):
         super().__init__(ssrc=0)
         self.source_path = source_path
         self.registry = registry
         self.on_frame = on_frame            # pump-wake hook
         self.depacketizer = mjpeg.JpegDepacketizer()
-        self.rungs = [
-            _Rung(q, registry.find_or_create(f"{source_path}@q{q}",
-                                             _rung_sdp(f"{source_path}@q{q}")))
-            for q in qualities]
+        self.rungs = []
+        for q, scale in rungs:
+            path = source_path + rung_suffix(q, scale)
+            self.rungs.append(
+                _Rung(q, scale,
+                      registry.find_or_create(path, _rung_sdp(path))))
         self.frames_in = 0
         self.decode_errors = 0
+        self.last_error = ""                # last swallowed frame exception
         self.source_session = None          # set by the service on attach
         #: RFC 2435 §4.2: in-band tables (Q 128..254) may ride only in the
         #: first frame — receivers cache them per Q value
@@ -79,8 +144,9 @@ class MjpegLadderOutput(RelayOutput):
         if parts is not None:
             try:
                 self._transcode_frame(*parts)
-            except Exception:   # a bad frame must never kill the fan-out
+            except Exception as e:  # a bad frame must never kill fan-out
                 self.decode_errors += 1
+                self.last_error = repr(e)   # surfaced via stats()
         self.packets_sent += 1
         self.bytes_sent += len(data)
         return WriteResult.OK
@@ -113,20 +179,36 @@ class MjpegLadderOutput(RelayOutput):
         self.frames_in += 1
         y32 = y.astype(np.int32)
         chroma32 = np.concatenate([cb, cr], axis=0).astype(np.int32)
+        n = len(cb)
+        # frame-invariant downscale inputs (zigzag→natural reorder + quad
+        # gathers) are computed ONCE, shared across every s2 rung
+        quads = None
+        if any(r.scale == 2 for r in self.rungs):
+            quads = self._frame_quads(jt, w, h, y32, chroma32, n)
         for rung in self.rungs:
-            # the device does all blocks of the frame in two batched calls;
-            # clamp to the baseline-codable range (|AC| <= 1023 keeps the
-            # Huffman category <= 10 and |DC diff| <= 2046 < 2047) so an
-            # up-quality rung can never produce unencodable coefficients
-            y2 = np.clip(np.asarray(requantize(y32, qy_in, rung.qy)),
-                         -1023, 1023).astype(np.int16)
-            c2 = np.clip(np.asarray(requantize(chroma32, qc_in, rung.qc)),
-                         -1023, 1023).astype(np.int16)
-            n = len(cb)
-            new_scan = je.encode_scan([y2, c2[:n], c2[n:]], jt)
+            if rung.scale == 2:
+                if quads is None:
+                    rung.skipped += 1       # dims don't halve MCU-aligned
+                    continue
+                y2, c2, n2, w2, h2 = self._downscale_rung(
+                    rung, quads, qy_in, qc_in, w, h)
+            else:
+                # the device does all blocks of the frame in two batched
+                # calls; clamp to the baseline-codable range (|AC| <= 1023
+                # keeps the Huffman category <= 10 and |DC diff| <= 2046 <
+                # 2047) so an up-quality rung can never produce
+                # unencodable coefficients
+                y2 = np.clip(np.asarray(requantize(y32, qy_in, rung.qy)),
+                             -1023, 1023).astype(np.int16)
+                c2 = np.clip(np.asarray(requantize(chroma32, qc_in,
+                                                   rung.qc)),
+                             -1023, 1023).astype(np.int16)
+                n2, w2, h2 = n, w, h
+            new_scan = je.encode_scan([y2, c2[:n2], c2[n2:]], jt)
             pkts = mjpeg.packetize_jpeg(
-                new_scan, width=w, height=h, seq=rung.seq,
-                timestamp=timestamp, ssrc=0x54C0DE ^ rung.q,
+                new_scan, width=w2, height=h2, seq=rung.seq,
+                timestamp=timestamp,
+                ssrc=0x54C0DE ^ rung.q ^ (rung.scale << 8),
                 type_=jt, q=rung.q)
             rung.seq = (rung.seq + len(pkts)) & 0xFFFF
             rung.frames += 1
@@ -136,13 +218,65 @@ class MjpegLadderOutput(RelayOutput):
         if self.on_frame is not None:
             self.on_frame(self.source_path)
 
+    @staticmethod
+    def _frame_quads(jt, w, h, y32, chroma32, n_chroma):
+        """Zigzag→natural reorder + 2×2 quad gathers for one frame, or
+        None when the dims cannot halve MCU-aligned (input MCU grid must
+        be even in both axes)."""
+        from ..ops.transform import zigzag_order
+
+        gw, gh = je.mcu_grid(w, h, jt)
+        mw, mh = (16, 16) if jt == 1 else (16, 8)
+        if gw % 2 or gh % 2 or w % (2 * mw) or h % (2 * mh):
+            return None
+        zz = zigzag_order()
+        y_idx, c_idx = _quad_index(jt, gw, gh)
+
+        def nat(levels_zz):
+            out = np.empty_like(levels_zz)
+            out[:, zz] = levels_zz
+            return out
+
+        c_nat = nat(chroma32)
+        cb_q = c_nat[:n_chroma][c_idx].reshape(-1, 4, 64)
+        cr_q = c_nat[n_chroma:][c_idx].reshape(-1, 4, 64)
+        return {
+            "zz": zz,
+            "y": nat(y32)[y_idx].reshape(-1, 4, 64),
+            "c": np.concatenate([cb_q, cr_q], axis=0),
+            "n_chroma_out": len(cb_q),
+        }
+
+    @staticmethod
+    def _downscale_rung(rung, quads, qy_in, qc_in, w, h):
+        """Half-resolution rung: the DCT-domain downscale operator — ONE
+        [N, 256] @ [256, 64] MXU matmul per component batch."""
+        from ..ops.transform import requantize_downscale2x
+
+        zz = quads["zz"]
+
+        def qt_nat(qt_zz):
+            out = np.empty(64, np.int32)
+            out[zz] = qt_zz
+            return out
+
+        y2 = np.asarray(requantize_downscale2x(
+            quads["y"], qt_nat(qy_in), qt_nat(rung.qy)))
+        c2 = np.asarray(requantize_downscale2x(
+            quads["c"], qt_nat(qc_in), qt_nat(rung.qc)))
+        y2 = np.clip(y2, -1023, 1023).astype(np.int16)[:, zz]
+        c2 = np.clip(c2, -1023, 1023).astype(np.int16)[:, zz]
+        return y2, c2, quads["n_chroma_out"], w // 2, h // 2
+
     def stats(self) -> dict:
         return {
             "path": self.source_path,
             "frames_in": self.frames_in,
             "decode_errors": self.decode_errors,
-            "rungs": [{"q": r.q, "path": r.session.path, "frames": r.frames,
-                       "bytes_out": r.bytes_out} for r in self.rungs],
+            "last_error": self.last_error,
+            "rungs": [{"q": r.q, "scale": r.scale, "path": r.session.path,
+                       "frames": r.frames, "bytes_out": r.bytes_out,
+                       "skipped": r.skipped} for r in self.rungs],
         }
 
 
@@ -155,10 +289,12 @@ class MjpegTranscodeService:
         self.on_frame = on_frame
         self.ladders: dict[str, MjpegLadderOutput] = {}
 
-    def start(self, path: str, qualities: tuple[int, ...] = (40, 20)):
-        qualities = tuple(dict.fromkeys(int(q) for q in qualities))  # dedup
-        bad = [q for q in qualities if not 1 <= q <= 99]
-        if bad or not qualities:
+    def start(self, path: str, rungs=(40, 20)):
+        """``rungs``: quality ints or ``"Qs2"`` strings (half-resolution
+        DCT-domain downscale rungs)."""
+        specs = tuple(dict.fromkeys(parse_rung(r) for r in rungs))  # dedup
+        bad = [q for q, _s in specs if not 1 <= q <= 99]
+        if bad or not specs:
             raise ValueError(f"rung qualities must be 1..99, got {bad}")
         sess = self.registry.find(path)
         if sess is None:
@@ -170,10 +306,11 @@ class MjpegTranscodeService:
         key = sess.path
         if key in self.ladders:
             raise ValueError(f"transcode already active on {key}")
-        for q in qualities:     # a rung path must not steal a live session
-            if self.registry.find(f"{key}@q{q}") is not None:
-                raise ValueError(f"{key}@q{q} is already a live session")
-        out = MjpegLadderOutput(key, self.registry, qualities,
+        for q, s in specs:      # a rung path must not steal a live session
+            if self.registry.find(key + rung_suffix(q, s)) is not None:
+                raise ValueError(
+                    f"{key}{rung_suffix(q, s)} is already a live session")
+        out = MjpegLadderOutput(key, self.registry, specs,
                                 on_frame=self.on_frame)
         out.source_session = sess
         sess.add_output(video, out)

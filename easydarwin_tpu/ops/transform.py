@@ -191,3 +191,56 @@ def decode_blocks_pallas(levels: jnp.ndarray, qtable: jnp.ndarray,
     )(levels, qtable.reshape(1, 64).astype(jnp.float32),
       jnp.asarray(inv))          # contraction ((1,),(1,)) ≡ x @ inv.T
     return out[:n]
+
+
+# ------------------------------------------------- DCT-domain 2x downscale
+
+@functools.lru_cache(maxsize=None)
+def downscale2x_operator() -> np.ndarray:
+    """[256, 64] linear map: a 2×2 quad of dequantized 8×8 DCT blocks →
+    the 8×8 DCT block of the half-resolution tile.
+
+    Built numerically as DCT ∘ avgpool2 ∘ IDCT over the 16×16 tile the
+    quad reconstructs; being a fixed linear operator it turns resolution
+    downscaling into ONE ``[N, 256] @ [256, 64]`` matmul on the MXU — no
+    pixel round-trip ever materializes.  Quad layout is row-major:
+    [top-left, top-right, bottom-left, bottom-right], each block vec'd
+    row-major (natural order, not zigzag)."""
+    _, inv = _kron_mats()                      # [64, 64] coeff → spatial
+    eye = np.eye(256, dtype=np.float64)
+    quads = eye.reshape(256, 2, 2, 8, 8)       # [in, qy, qx, 8, 8]
+    # IDCT each 8×8 block of each basis vector
+    blocks = quads.reshape(256, 4, 64) @ inv.astype(np.float64).T
+    blocks = blocks.reshape(256, 2, 2, 8, 8)
+    # assemble 16×16 tiles
+    tile = np.zeros((256, 16, 16))
+    for qy in range(2):
+        for qx in range(2):
+            tile[:, qy * 8:qy * 8 + 8, qx * 8:qx * 8 + 8] = \
+                blocks[:, qy, qx]
+    # 2×2 average pool → 8×8
+    pooled = tile.reshape(256, 8, 2, 8, 2).mean(axis=(2, 4))
+    # forward DCT of the pooled tile
+    fwd, _ = _kron_mats()
+    out = pooled.reshape(256, 64) @ fwd.astype(np.float64).T
+    return out.astype(np.float32)              # [256, 64]
+
+
+@jax.jit
+def downscale2x_blocks(quads: jnp.ndarray) -> jnp.ndarray:
+    """[N, 256] dequantized coefficient quads → [N, 64] half-res
+    coefficients (natural order)."""
+    M = jnp.asarray(downscale2x_operator())
+    return jnp.matmul(quads, M, precision="highest")
+
+
+@jax.jit
+def requantize_downscale2x(quads: jnp.ndarray, qtable_in: jnp.ndarray,
+                           qtable_out: jnp.ndarray) -> jnp.ndarray:
+    """Quantized quad levels → quantized half-res levels: dequant (input
+    table broadcast over the 4 blocks), one MXU matmul, requant."""
+    deq = quads.reshape(-1, 4, 64) * qtable_in[None, None, :]
+    out = jnp.matmul(deq.reshape(-1, 256),
+                     jnp.asarray(downscale2x_operator()),
+                     precision="highest")
+    return jnp.round(out / qtable_out[None, :]).astype(jnp.int32)

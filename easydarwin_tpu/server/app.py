@@ -176,11 +176,18 @@ class StreamingServer:
         use_tpu = self.config.tpu_fanout
         for sess in list(self.registry.sessions.values()):
             for stream in sess.streams.values():
-                if (use_tpu
-                        and stream.num_outputs >= self.config.tpu_min_outputs):
-                    sent += self._engine_for(stream).step(stream, t)
-                else:
-                    sent += stream.reflect(t)
+                # per-stream guard: one bad output (broken socket, buggy
+                # transcoder tap) must never halt fan-out for the rest
+                try:
+                    if (use_tpu and stream.num_outputs
+                            >= self.config.tpu_min_outputs):
+                        sent += self._engine_for(stream).step(stream, t)
+                    else:
+                        sent += stream.reflect(t)
+                except Exception as e:
+                    if self.error_log:
+                        self.error_log.warning(
+                            f"reflect error on {sess.path}: {e!r}")
         return sent
 
     async def _pump_loop(self) -> None:
@@ -192,11 +199,7 @@ class StreamingServer:
             except asyncio.TimeoutError:
                 pass
             self._pump_event.clear()
-            try:
-                self._reflect_all()
-            except Exception as e:      # one bad output must never halt
-                if self.error_log:      # fan-out for every session
-                    self.error_log.warning(f"reflect error: {e!r}")
+            self._reflect_all()
             now = time.monotonic()
             if now - last_prune >= 1.0:
                 last_prune = now

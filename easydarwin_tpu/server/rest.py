@@ -255,12 +255,8 @@ class RestApi:
         """Start an on-TPU MJPEG bitrate ladder on a live path; the rungs
         appear as {path}@q{Q} live streams."""
         path = params.get("path", [""])[0]
-        try:
-            rungs = tuple(int(q) for q in
-                          params.get("rungs", ["40,20"])[0].split(",") if q)
-        except ValueError:
-            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
-                               body={"Detail": "rungs must be integers"})
+        rungs = tuple(q for q in
+                      params.get("rungs", ["40,20"])[0].split(",") if q)
         try:
             out = self.app.transcodes.start(path, rungs)
         except KeyError:

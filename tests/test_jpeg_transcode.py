@@ -394,3 +394,120 @@ def test_inband_qtables_cached_across_frames():
     src.reflect()
     assert out.decode_errors == 1 and out.rungs[0].frames == 2
     svc.stop_all()
+
+
+# --------------------------------------------------------- downscale rung
+
+
+def test_downscale_operator_matches_spatial_oracle():
+    from easydarwin_tpu.ops import transform as t
+    rng = np.random.default_rng(0)
+    quads = rng.normal(0, 30, size=(16, 256)).astype(np.float32)
+    out = np.asarray(t.downscale2x_blocks(quads))
+    fwd, inv = t._kron_mats()
+    blocks = quads.reshape(16, 4, 64) @ inv.T
+    tiles = np.zeros((16, 16, 16))
+    for i, q in enumerate(blocks.reshape(16, 2, 2, 8, 8)):
+        for qy in range(2):
+            for qx in range(2):
+                tiles[i, qy * 8:qy * 8 + 8, qx * 8:qx * 8 + 8] = q[qy, qx]
+    pooled = tiles.reshape(16, 8, 2, 8, 2).mean(axis=(2, 4))
+    oracle = pooled.reshape(16, 64) @ fwd.astype(np.float64).T
+    assert np.abs(out - oracle).max() < 1e-3
+
+
+def test_parse_rung_specs():
+    from easydarwin_tpu.models.mjpeg_ladder import parse_rung, rung_suffix
+    assert parse_rung(40) == (40, 1)
+    assert parse_rung("40") == (40, 1)
+    assert parse_rung("20s2") == (20, 2)
+    assert rung_suffix(20, 2) == "@q20s2"
+    with pytest.raises(ValueError):
+        parse_rung("20s3")
+    with pytest.raises(ValueError):
+        parse_rung("abc")
+
+
+def test_downscale_rung_produces_half_res_pil_decodable():
+    """64x64 gradient at q80 → s2 rung must be a decodable 32x32 JPEG
+    whose pixels match the 2x2-downsampled source."""
+    PIL = pytest.importorskip("PIL.Image")
+    from easydarwin_tpu.ops import transform
+
+    w = h = 64
+    q = 80
+    qt = mjpeg.make_qtables(q)
+    zz = transform.zigzag_order()
+
+    def enc(pix, qtab_zz):
+        qn = np.empty(64, np.float32)
+        qn[zz] = qtab_zz
+        coef = np.asarray(transform.dct_blocks(
+            np.asarray(pix.reshape(-1, 64) - 128.0, np.float32)))
+        return np.round(coef / qn).astype(np.int16)[:, zz]
+
+    xs = np.linspace(0, np.pi * 1.5, w)
+    ymat = (128 + 80 * np.outer(np.cos(np.linspace(0, np.pi, h)),
+                                np.cos(xs))).astype(np.float32)
+    gw, gh = je.mcu_grid(w, h, 1)
+    yb = [ymat[my * 16 + sy * 8:my * 16 + sy * 8 + 8,
+               mx * 16 + sx * 8:mx * 16 + sx * 8 + 8]
+          for my in range(gh) for mx in range(gw)
+          for sy in range(2) for sx in range(2)]
+    qy = np.frombuffer(qt[:64], np.uint8).astype(np.float32)
+    qc = np.frombuffer(qt[64:], np.uint8).astype(np.float32)
+    Y = enc(np.stack(yb), qy)
+    C = enc(np.full((gw * gh, 8, 8), 128.0, np.float32), qc)
+    scan = je.encode_scan([Y, C.copy(), C.copy()], 1)
+    pkts = mjpeg.packetize_jpeg(scan, width=w, height=h, seq=1,
+                                timestamp=9000, ssrc=7, type_=1, q=q)
+
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", ("70s2",))
+    for p in pkts:
+        src.push(1, p)
+    src.reflect()
+    assert out.frames_in == 1 and out.decode_errors == 0
+    rung = out.rungs[0]
+    assert rung.scale == 2 and rung.frames == 1 and rung.skipped == 0
+    assert rung.session.path == "/cam@q70s2"
+
+    stream = reg.find("/cam@q70s2").streams[1]
+    dep = mjpeg.JpegDepacketizer()
+    frame = None
+    for i in stream.rtp_ring.ids():
+        frame = dep.push(stream.rtp_ring.get(i)) or frame
+    assert frame is not None
+    img = PIL.open(io.BytesIO(frame))
+    img.load()
+    assert img.size == (32, 32)
+    arr = np.asarray(img.convert("L"), np.float32)
+    downsampled = ymat.reshape(32, 2, 32, 2).mean(axis=(1, 3))
+    assert np.abs(arr - downsampled).mean() < 10.0
+    svc.stop_all()
+
+
+def test_downscale_rung_skips_unalignable_frames():
+    """A 48x48 4:2:0 frame (3x3 MCU grid, odd) cannot halve to
+    MCU-aligned dims: the s2 rung skips it while quality rungs emit."""
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", (40, "40s2"))
+    _levels, pkts = make_mjpeg_packets(w=48, h=48)
+    for p in pkts:
+        src.push(1, p)
+    src.reflect()
+    q_rung, s_rung = out.rungs
+    assert q_rung.frames == 1 and q_rung.skipped == 0
+    assert s_rung.frames == 0 and s_rung.skipped == 1
+    assert out.decode_errors == 0
+    # an alignable 32x32 frame then emits on BOTH rungs
+    _l2, pkts2 = make_mjpeg_packets(seq0=40, ts=18000)
+    for p in pkts2:
+        src.push(1, p)
+    src.reflect()
+    assert q_rung.frames == 2 and s_rung.frames == 1
+    svc.stop_all()
