@@ -223,26 +223,18 @@ class MjpegLadderOutput(RelayOutput):
         """Zigzag→natural reorder + 2×2 quad gathers for one frame, or
         None when the dims cannot halve MCU-aligned (input MCU grid must
         be even in both axes)."""
-        from ..ops.transform import zigzag_order
+        from ..ops.transform import from_zigzag_np
 
         gw, gh = je.mcu_grid(w, h, jt)
         mw, mh = (16, 16) if jt == 1 else (16, 8)
         if gw % 2 or gh % 2 or w % (2 * mw) or h % (2 * mh):
             return None
-        zz = zigzag_order()
         y_idx, c_idx = _quad_index(jt, gw, gh)
-
-        def nat(levels_zz):
-            out = np.empty_like(levels_zz)
-            out[:, zz] = levels_zz
-            return out
-
-        c_nat = nat(chroma32)
+        c_nat = from_zigzag_np(chroma32)
         cb_q = c_nat[:n_chroma][c_idx].reshape(-1, 4, 64)
         cr_q = c_nat[n_chroma:][c_idx].reshape(-1, 4, 64)
         return {
-            "zz": zz,
-            "y": nat(y32)[y_idx].reshape(-1, 4, 64),
+            "y": from_zigzag_np(y32)[y_idx].reshape(-1, 4, 64),
             "c": np.concatenate([cb_q, cr_q], axis=0),
             "n_chroma_out": len(cb_q),
         }
@@ -251,21 +243,15 @@ class MjpegLadderOutput(RelayOutput):
     def _downscale_rung(rung, quads, qy_in, qc_in, w, h):
         """Half-resolution rung: the DCT-domain downscale operator — ONE
         [N, 256] @ [256, 64] MXU matmul per component batch."""
-        from ..ops.transform import requantize_downscale2x
-
-        zz = quads["zz"]
-
-        def qt_nat(qt_zz):
-            out = np.empty(64, np.int32)
-            out[zz] = qt_zz
-            return out
+        from ..ops.transform import (from_zigzag_np, requantize_downscale2x,
+                                     to_zigzag_np)
 
         y2 = np.asarray(requantize_downscale2x(
-            quads["y"], qt_nat(qy_in), qt_nat(rung.qy)))
+            quads["y"], from_zigzag_np(qy_in), from_zigzag_np(rung.qy)))
         c2 = np.asarray(requantize_downscale2x(
-            quads["c"], qt_nat(qc_in), qt_nat(rung.qc)))
-        y2 = np.clip(y2, -1023, 1023).astype(np.int16)[:, zz]
-        c2 = np.clip(c2, -1023, 1023).astype(np.int16)[:, zz]
+            quads["c"], from_zigzag_np(qc_in), from_zigzag_np(rung.qc)))
+        y2 = to_zigzag_np(np.clip(y2, -1023, 1023).astype(np.int16))
+        c2 = to_zigzag_np(np.clip(c2, -1023, 1023).astype(np.int16))
         return y2, c2, quads["n_chroma_out"], w // 2, h // 2
 
     def stats(self) -> dict:

@@ -112,6 +112,18 @@ def from_zigzag(z: jnp.ndarray) -> jnp.ndarray:
     return z[:, jnp.asarray(inv)]
 
 
+def to_zigzag_np(natural: np.ndarray) -> np.ndarray:
+    """Host-side ``to_zigzag`` ([..., 64] natural → zigzag) — the entropy
+    codec and ladder reorder on the host, off the device round-trip."""
+    return natural[..., zigzag_order()]
+
+
+def from_zigzag_np(z: np.ndarray) -> np.ndarray:
+    out = np.empty_like(z)
+    out[..., zigzag_order()] = z
+    return out
+
+
 # ----------------------------------------------------- encode / decode paths
 
 @jax.jit
