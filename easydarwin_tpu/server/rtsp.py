@@ -415,9 +415,24 @@ class RtspConnection:
                 start_npt = 0.0
         if self.vod_session is not None:
             self.vod_session.stop()
+        # Scale (fast-forward factor) and Speed (delivery-rate factor)
+        # both map onto the pacing divisor (QTSSFileModule's Speed
+        # handling; DSS's Scale support is likewise delivery-side)
+        extra = {}
+        speed = 1.0
+        for hdr in ("scale", "speed"):
+            v = req.headers.get(hdr, "")
+            if v:
+                try:
+                    f = abs(float(v))   # reverse play unsupported: the
+                    if 0.01 <= f <= 8.0:  # echoed value is what's applied
+                        speed *= f
+                        extra[hdr.capitalize()] = f"{f:g}"
+                except ValueError:
+                    pass
         outputs = {tid: pt.output for tid, pt in self.player_tracks.items()}
         self.vod_session = FileSession(self.vod_file, outputs,
-                                       start_npt=start_npt)
+                                       start_npt=start_npt, speed=speed)
         self.vod_session.start()
         self.playing = True
         self.server.stats["players"] += 1
@@ -426,7 +441,8 @@ class RtspConnection:
             f";seq={pt.output.rewrite.out_seq_start}"
             for tid, pt in self.player_tracks.items())
         self._reply(rtsp.RtspResponse(200, {
-            "Range": f"npt={start_npt:.3f}-", "RTP-Info": infos}), req.cseq)
+            "Range": f"npt={start_npt:.3f}-", "RTP-Info": infos,
+            **extra}), req.cseq)
 
     async def _do_pause(self, req: rtsp.RtspRequest) -> None:
         if self.vod_session is not None:

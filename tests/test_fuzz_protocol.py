@@ -98,7 +98,6 @@ def test_nalu_classify_fuzz():
 
 
 def test_mjpeg_payload_fuzz():
-    _levels = None
     scan = bytes(range(48))
     valid = mjpeg.packetize_jpeg(scan, width=16, height=16, seq=1,
                                  timestamp=0, ssrc=1)[0]

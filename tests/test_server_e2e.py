@@ -231,3 +231,49 @@ async def test_rest_api_endpoints(cfg):
         await pusher.close()
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_config2_fanout_16_players_no_loss(cfg):
+    """BASELINE config-2 shape (scaled to CI): one push source, 16
+    concurrent interleaved players, every player receives every payload
+    exactly once, keyframe fast-start for late joiners."""
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/fan"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(0, 0, nal_type=5))
+
+        players = []
+        for _ in range(16):
+            p = RtspClient()
+            await p.connect("127.0.0.1", app.rtsp.port)
+            await p.play_start(uri)
+            players.append(p)
+
+        n_pkts = 40
+        for i in range(1, n_pkts + 1):
+            pusher.push_packet(0, vid_pkt(i, i * 3000,
+                                          nal_type=5 if i % 10 == 0 else 1))
+            if i % 8 == 0:
+                await asyncio.sleep(0.01)
+
+        for p in players:
+            got = []
+            # players joined after the first packet: fast-start replays
+            # from the newest keyframe, then the live tail
+            for _ in range(n_pkts + 1):
+                try:
+                    got.append(await asyncio.wait_for(
+                        p.recv_interleaved(0), 5.0))
+                except asyncio.TimeoutError:
+                    break
+            assert len(got) >= n_pkts, len(got)
+            assert p.stats.lost == 0 and p.stats.duplicates == 0
+        for p in players:
+            await p.close()
+        await pusher.close()
+    finally:
+        await app.stop()

@@ -167,3 +167,52 @@ async def test_vod_play_with_range_seek(tmp_path):
         await c.close()
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_vod_play_with_scale_header(tmp_path):
+    """Scale: 2.0 halves the wall-clock delivery time (DSS Speed/Scale
+    delivery-side semantics); the header is echoed in the PLAY answer."""
+    import time
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    movies = tmp_path / "m"
+    movies.mkdir()
+    write_fixture(str(movies / "clip.mp4"), n_frames=12, with_audio=False)
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0,
+                                       bind_ip="127.0.0.1",
+                                       movie_folder=str(movies),
+                                       access_log_enabled=False))
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/clip.mp4"
+        c = RtspClient()
+        await c.connect("127.0.0.1", app.rtsp.port)
+        r = await c.request("DESCRIBE", uri, {"accept": "application/sdp"})
+        sd = sdp.parse(r.body)
+        r = await c.request(
+            "SETUP", f"{uri}/trackID={sd.streams[0].track_id}",
+            {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+        assert r.status == 200
+        t0 = time.monotonic()
+        r = await c.request("PLAY", uri, {"scale": "2.0"})
+        assert r.status == 200 and r.headers.get("scale") == "2"
+        got = 0
+        last_pkt_at = t0
+        while True:
+            try:
+                await asyncio.wait_for(c.recv_interleaved(0), 3.0)
+                got += 1
+                last_pkt_at = time.monotonic()
+            except asyncio.TimeoutError:
+                break
+        # 12 frames at 30 fps = 0.4 s of media; at 2x the LAST packet
+        # must arrive well under the 1x wall time (jitter headroom: the
+        # delivery itself takes ~0.2 s)
+        assert got >= 12
+        assert last_pkt_at - t0 < 0.38, last_pkt_at - t0
+        await c.teardown(uri)
+        await c.close()
+    finally:
+        await app.stop()
