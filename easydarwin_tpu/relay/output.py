@@ -56,6 +56,10 @@ class RelayOutput:
         self.rewrite = RewriteState(ssrc=ssrc, out_seq_start=out_seq_start,
                                     out_ts_start=out_ts_start)
         self.thinning = ThinningFilter()
+        #: negotiated x-RTP-Meta-Info {field: compressed id} (SETUP header;
+        #: None = plain RTP).  Wrapping covers both the scalar write_rtp
+        #: path and the TPU engine's send_rewritten path.
+        self.meta_field_ids: dict[str, int] | None = None
         self.packets_sent = 0
         self.bytes_sent = 0
         self.stalls = 0
@@ -72,7 +76,27 @@ class RelayOutput:
         """Send a device-rewritten packet: 12-byte header + original bytes
         from offset 12.  Default concatenates; socket-backed outputs override
         with vectored I/O so the shared payload is never copied."""
+        if self.meta_field_ids is not None:
+            return self.send_bytes(self._wrap_meta(header, tail),
+                                   is_rtcp=False)
         return self.send_bytes(header + tail, is_rtcp=False)
+
+    def _wrap_meta(self, header: bytes, payload: bytes) -> bytes:
+        """RTP → x-RTP-Meta-Info packet with the negotiated fields
+        (reference: RTPStream's meta-info send path, RTPMetaInfoLib).
+
+        ``sq`` carries the seq of the packet AS SENT — the reference does
+        the same (QTHintTrack.cpp:1355 writes hdrData.rtpSequenceNumber,
+        the sent packet's own number), so clients correlate md with the
+        RTP header, not with source-side numbering."""
+        import time
+
+        from ..protocol import rtp_meta
+        ids = self.meta_field_ids
+        return rtp_meta.build_packet(
+            header, media=payload, field_ids=ids,
+            transmit_time=int(time.time() * 1000) if "tt" in ids else None,
+            seq=rtp.peek_seq(header) if "sq" in ids else None)
 
     # -- relay-facing API --------------------------------------------------
     def write_rtp(self, packet: bytes) -> WriteResult:
@@ -87,6 +111,8 @@ class RelayOutput:
             seq=rw.map_seq(rtp.peek_seq(packet)),
             timestamp=rw.map_ts(rtp.peek_timestamp(packet)),
             ssrc=rw.ssrc)
+        if self.meta_field_ids is not None:
+            out = self._wrap_meta(out[:12], out[12:])
         res = self.send_bytes(out, is_rtcp=False)
         if res is WriteResult.OK:
             self.packets_sent += 1

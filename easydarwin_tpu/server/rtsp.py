@@ -327,9 +327,32 @@ class RtspConnection:
         if track_id is None or track_id not in relay.streams:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
         out, resp_t, pair = await self._make_output(t)
+        extra = self._negotiate_meta_info(req, out)
         self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
-        self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
-                    req.cseq)
+        self._reply(rtsp.RtspResponse(200, {
+            "Transport": resp_t.to_header(), **extra}), req.cseq)
+
+    #: x-RTP-Meta-Info fields this server can fill (tt transmit-time,
+    #: sq sequence, md media; DSS's pp/pn/ft need hint-track context)
+    META_SUPPORTED = ("tt", "sq", "md")
+
+    def _negotiate_meta_info(self, req, out) -> dict:
+        """DSS QT-client extension: a SETUP carrying ``x-RTP-Meta-Info``
+        lists wanted fields; the answer assigns compressed ids and the
+        output wraps packets in the meta-info format
+        (``RTPMetaInfoLib``; ``RTPStream`` send path)."""
+        from ..protocol import rtp_meta
+        want = req.headers.get("x-rtp-meta-info", "")
+        if not want:
+            return {}
+        requested = rtp_meta.parse_header(want)
+        granted = {f: i for i, f in enumerate(
+            f for f in self.META_SUPPORTED if f in requested)}
+        if "md" not in granted:
+            return {}                   # md is mandatory for a media stream
+        granted["md"] = rtp_meta.UNCOMPRESSED   # md is never compressed
+        out.meta_field_ids = granted
+        return {"x-RTP-Meta-Info": rtp_meta.build_header(granted)}
 
     async def _make_output(self, t: rtsp.TransportSpec):
         """Create the egress output for one SETUP'd track (shared between
@@ -415,24 +438,33 @@ class RtspConnection:
                 start_npt = 0.0
         if self.vod_session is not None:
             self.vod_session.stop()
-        # Scale (fast-forward factor) and Speed (delivery-rate factor)
-        # both map onto the pacing divisor (QTSSFileModule's Speed
-        # handling; DSS's Scale support is likewise delivery-side)
+        # Speed (RFC 2326 §12.35): delivery-rate factor, timestamps
+        # untouched.  Scale (§12.34): viewing-rate factor — delivery is
+        # paced faster AND RTP timestamps are compressed by the factor so
+        # a compliant client actually renders fast-forward.  Reverse play
+        # (negative Scale) is unsupported and ignored, not silently
+        # converted to forward.
         extra = {}
         speed = 1.0
+        ts_scale = 1.0
         for hdr in ("scale", "speed"):
             v = req.headers.get(hdr, "")
-            if v:
-                try:
-                    f = abs(float(v))   # reverse play unsupported: the
-                    if 0.01 <= f <= 8.0:  # echoed value is what's applied
-                        speed *= f
-                        extra[hdr.capitalize()] = f"{f:g}"
-                except ValueError:
-                    pass
+            if not v:
+                continue
+            try:
+                f = float(v)
+            except ValueError:
+                continue
+            if not 0.01 <= f <= 8.0:
+                continue
+            speed *= f
+            if hdr == "scale":
+                ts_scale = f
+            extra[hdr.capitalize()] = f"{f:g}"
         outputs = {tid: pt.output for tid, pt in self.player_tracks.items()}
         self.vod_session = FileSession(self.vod_file, outputs,
-                                       start_npt=start_npt, speed=speed)
+                                       start_npt=start_npt, speed=speed,
+                                       ts_scale=ts_scale)
         self.vod_session.start()
         self.playing = True
         self.server.stats["players"] += 1

@@ -50,6 +50,9 @@ class InterleavedOutput(RelayOutput):
         return self._send(ch, (data,))
 
     def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
+        if self.meta_field_ids is not None:     # negotiated meta-info wrap
+            return self.send_bytes(self._wrap_meta(header, tail),
+                                   is_rtcp=False)
         return self._send(self.rtp_channel, (header, tail))
 
 
@@ -76,6 +79,9 @@ class UdpOutput(RelayOutput):
         return WriteResult.OK
 
     def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
+        if self.meta_field_ids is not None:     # negotiated meta-info wrap
+            return self.send_bytes(self._wrap_meta(header, tail),
+                                   is_rtcp=False)
         if self.rtp_transport.is_closing():
             return WriteResult.ERROR
         self.rtp_transport.sendto(header + tail, self.rtp_addr)

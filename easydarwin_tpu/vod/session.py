@@ -24,10 +24,14 @@ class FileSession:
     """One playing client of one file: per-track packetizers + pacing."""
 
     def __init__(self, file: Mp4File, outputs: dict[int, RelayOutput],
-                 *, start_npt: float = 0.0, speed: float = 1.0):
+                 *, start_npt: float = 0.0, speed: float = 1.0,
+                 ts_scale: float = 1.0):
         self.file = file
         self.outputs = outputs
         self.speed = max(speed, 0.01)
+        #: Scale support: RTP timestamps are divided by this so the media
+        #: clock advances `ts_scale`× per wall second (RFC 2326 §12.34)
+        self.ts_scale = max(ts_scale, 0.01)
         self._cursors: dict[int, int] = {}        # track_id -> sample index
         self._packetizers: dict[int, object] = {}
         self._pending: dict[int, list[bytes]] = {}
@@ -106,8 +110,14 @@ class FileSession:
                 tr = self._track_of(tid)
                 cur = self._cursors[tid]
                 data = self.file.read_sample(tr, cur)
-                self._pending[tid] = self._packetizers[tid].packetize_sample(
-                    data, cur)
+                pkts = self._packetizers[tid].packetize_sample(data, cur)
+                if self.ts_scale != 1.0:
+                    from ..protocol import rtp as rtp_mod
+                    pkts = [rtp_mod.rewrite_header(
+                        p, timestamp=int(rtp_mod.peek_timestamp(p)
+                                         / self.ts_scale) & 0xFFFFFFFF)
+                        for p in pkts]
+                self._pending[tid] = pkts
                 self._pending_npt[tid] = npt
                 self._cursors[tid] = cur + 1
             out = self.outputs[tid]

@@ -168,3 +168,37 @@ def test_md_always_uncompressed_even_when_negotiated_compressed():
     info = rtp_meta.parse_packet(pkt, ids)
     assert info is not None and info.media == media
     assert rtp_meta.strip_to_rtp(pkt, ids) == RTP_HDR[:12] + media
+
+
+def test_meta_wrap_covers_socket_send_rewritten_paths():
+    """The TPU engine emits via the socket outputs' send_rewritten
+    overrides; when meta-info is negotiated they must wrap too."""
+    from easydarwin_tpu.server.transports import InterleavedOutput
+
+    class FakeTransport:
+        def __init__(self):
+            self.chunks = []
+
+        def is_closing(self):
+            return False
+
+        def get_write_buffer_size(self):
+            return 0
+
+        def write(self, data):
+            self.chunks.append(bytes(data))
+
+    tr = FakeTransport()
+    out = InterleavedOutput(tr, 0, 1, ssrc=7)
+    ids = rtp_meta.parse_header("tt=0;sq=1;md")
+    out.meta_field_ids = ids
+    header = bytes([0x80, 96, 0x12, 0x34]) + bytes(8)
+    tail = b"payload-bytes"
+    assert out.send_rewritten(header, tail).name == "OK"
+    framed = b"".join(tr.chunks)
+    assert framed[0:1] == b"$"
+    pkt = framed[4:]
+    info = rtp_meta.parse_packet(pkt, ids)
+    assert info is not None and info.media == tail
+    assert info.seq == 0x1234           # seq of the packet as sent
+    assert rtp_meta.strip_to_rtp(pkt, ids) == header + tail
