@@ -38,8 +38,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args) -> ServerConfig:
-    cfg = (ServerConfig.from_toml(args.config) if args.config
-           else ServerConfig())
+    if args.config and args.config.endswith(".xml"):
+        # reference easydarwin.xml migration path
+        from .server.config import load_reference_xml
+        cfg, unmapped = load_reference_xml(args.config)
+        if unmapped:
+            print(f"note: {len(unmapped)} reference prefs have no "
+                  f"counterpart here (first few: {unmapped[:5]})",
+                  flush=True)
+    elif args.config:
+        cfg = ServerConfig.from_toml(args.config)
+    else:
+        cfg = ServerConfig()
     for k in ("rtsp_port", "service_port", "bind_ip", "movie_folder",
               "module_folder"):
         v = getattr(args, k)

@@ -120,3 +120,109 @@ class ServerConfig:
             overbuffer_ms=int(self.overbuffer_sec * 1000),
             max_age_ms=int(self.max_packet_age_sec * 1000),
             ring_capacity=self.ring_capacity)
+
+
+# -- reference easydarwin.xml migration --------------------------------------
+
+def _verbosity(v: str) -> str:
+    i = int(v)
+    if not 0 <= i <= 4:                 # DSS levels 0..4; reject garbage
+        raise ValueError(f"verbosity {v!r} out of range")
+    return ("fatal", "warning", "info", "info", "debug")[i]
+
+
+#: reference pref name → (our field, converter).  Server-level prefs plus
+#: the per-module sections users actually tune (QTSServerPrefs.cpp:190-280,
+#: ReflectorStream::Register, EasyRedisModule prefs).
+_XML_SERVER_MAP = {
+    "rtsp_port": ("rtsp_port", int),                 # LIST-PREF: first value
+    "service_lan_port": ("service_port", int),
+    # http_service_port is DSS's RTSP-over-HTTP tunneling port, NOT the
+    # REST service port — tunneling here rides the RTSP port itself, so
+    # the pref is intentionally left unmapped
+    "service_wan_ip": ("wan_ip", str),
+    "bind_ip_addr": ("bind_ip",
+                     lambda v: "0.0.0.0" if v in ("", "0") else v),
+    "movie_folder": ("movie_folder", str),
+    "maximum_connections": ("max_connections", int),
+    "rtsp_session_timeout": ("rtsp_timeout_sec", int),
+    "enable_cloud_platform": ("cloud_enabled", lambda v: v == "true"),
+    "authentication_scheme": ("auth_scheme", str),
+    "error_logfile_verbosity": ("error_log_verbosity", _verbosity),
+    "monitor_stats_file_name": ("status_file_path", str),
+    "monitor_stats_file_interval_seconds": ("status_file_interval_sec", int),
+}
+
+_XML_MODULE_MAP = {
+    ("QTSSReflectorModule", "reflector_bucket_offset_delay_msec"):
+        ("bucket_delay_ms", int),
+    ("QTSSReflectorModule", "reflector_buffer_size_sec"):
+        ("overbuffer_sec", float),
+    ("QTSSReflectorModule", "timeout_broadcaster_session_secs"):
+        ("push_timeout_sec", int),
+    ("QTSSAccessLogModule", "request_logging"):
+        ("access_log_enabled", lambda v: v == "true"),
+    ("EasyRedisModule", "redis_ip"): ("redis_host", str),
+    ("EasyRedisModule", "redis_port"): ("redis_port", int),
+    ("EasyCMSModule", "cms_ip"): ("cms_host", str),
+    ("EasyCMSModule", "cms_port"): ("cms_port", int),
+}
+
+
+def load_reference_xml(path: str) -> tuple["ServerConfig", list[str]]:
+    """Load the reference's ``easydarwin.xml`` (the DSS ``PREF``/``MODULE``
+    DTD, ``PrefsSourceLib/XMLPrefsParser.cpp``) into a ``ServerConfig``.
+
+    Returns ``(config, unmapped)`` — ``unmapped`` lists reference pref
+    names with no counterpart here (thinning windows, reliable-UDP
+    internals, … — tuned automatically in this implementation), so a
+    migrating operator can see exactly what was dropped.
+    """
+    import xml.etree.ElementTree as ET
+
+    root = ET.parse(path).getroot()
+    cfg = ServerConfig()
+    unmapped: list[str] = []
+    monitor_enabled = False
+
+    def pref_value(el, label: str) -> str:
+        if el.tag == "LIST-PREF":
+            vals = el.findall("VALUE")
+            if len(vals) > 1:           # only the first value carries over
+                unmapped.append(
+                    f"{label} (extra values dropped: "
+                    f"{[(v.text or '').strip() for v in vals[1:]]})")
+            return (vals[0].text or "").strip() if vals else ""
+        return (el.text or "").strip()
+
+    def apply(el, label: str, ent) -> None:
+        if ent is None:
+            unmapped.append(label)
+            return
+        field, conv = ent
+        raw = pref_value(el, label)
+        try:
+            setattr(cfg, field, conv(raw))
+        except ValueError:              # mapped name, malformed value
+            unmapped.append(f"{label} (invalid value {raw!r})")
+
+    server = root.find("SERVER")
+    for el in (server if server is not None else []):
+        if el.tag not in ("PREF", "LIST-PREF"):
+            continue
+        name = el.get("NAME", "")
+        if name == "enable_monitor_stats_file":
+            monitor_enabled = pref_value(el, name) == "true"
+            continue
+        apply(el, name, _XML_SERVER_MAP.get(name))
+    for mod in root.findall("MODULE"):
+        mod_name = mod.get("NAME", "")
+        for el in mod:
+            if el.tag not in ("PREF", "LIST-PREF"):
+                continue
+            name = el.get("NAME", "")
+            apply(el, f"{mod_name}/{name}",
+                  _XML_MODULE_MAP.get((mod_name, name)))
+    if not monitor_enabled:
+        cfg.status_file_path = ""       # file name without the enable flag
+    return cfg, unmapped

@@ -1,0 +1,128 @@
+"""Reference easydarwin.xml migration (PrefsSourceLib/XMLPrefsParser.cpp
+DTD) — a reference operator's config file must load directly."""
+
+import pytest
+
+from easydarwin_tpu.server.config import ServerConfig, load_reference_xml
+
+REFERENCE_XML = """<?xml version ="1.0"?>
+<CONFIGURATION>
+  <SERVER>
+    <PREF NAME="rtsp_session_timeout" TYPE="UInt32" >90</PREF>
+    <PREF NAME="maximum_connections" TYPE="SInt32" >2000</PREF>
+    <PREF NAME="bind_ip_addr" >0</PREF>
+    <PREF NAME="movie_folder" >/srv/movies</PREF>
+    <PREF NAME="error_logfile_verbosity" TYPE="UInt32" >2</PREF>
+    <PREF NAME="enable_cloud_platform" TYPE="bool" >true</PREF>
+    <PREF NAME="authentication_scheme" >basic</PREF>
+    <PREF NAME="enable_monitor_stats_file" TYPE="bool" >true</PREF>
+    <PREF NAME="monitor_stats_file_name" >server_status</PREF>
+    <PREF NAME="monitor_stats_file_interval_seconds" TYPE="UInt32" >10</PREF>
+    <PREF NAME="run_num_threads" TYPE="UInt32" >4</PREF>
+    <LIST-PREF NAME="rtsp_port" TYPE="UInt16" >
+      <VALUE>554</VALUE>
+      <VALUE>10554</VALUE>
+    </LIST-PREF>
+    <PREF NAME="service_lan_port" TYPE="UInt16" >10008</PREF>
+    <PREF NAME="service_wan_ip" >203.0.113.7</PREF>
+  </SERVER>
+  <MODULE NAME="QTSSAccessLogModule" >
+    <PREF NAME="request_logging" TYPE="bool" >false</PREF>
+  </MODULE>
+  <MODULE NAME="QTSSReflectorModule" >
+    <PREF NAME="reflector_bucket_offset_delay_msec" TYPE="UInt32" >60</PREF>
+    <PREF NAME="reflector_buffer_size_sec" TYPE="UInt32" >2</PREF>
+    <PREF NAME="timeout_broadcaster_session_secs" TYPE="UInt32" >30</PREF>
+    <PREF NAME="ip_allow_list" >127.0.0.*</PREF>
+  </MODULE>
+  <MODULE NAME="EasyRedisModule" >
+    <PREF NAME="redis_ip" >10.1.2.3</PREF>
+    <PREF NAME="redis_port" TYPE="UInt16" >6380</PREF>
+    <PREF NAME="redis_password" >admin</PREF>
+  </MODULE>
+</CONFIGURATION>
+"""
+
+
+def test_reference_xml_round(tmp_path):
+    p = tmp_path / "easydarwin.xml"
+    p.write_text(REFERENCE_XML)
+    cfg, unmapped = load_reference_xml(str(p))
+    assert cfg.rtsp_port == 554                  # first LIST-PREF value
+    assert cfg.service_port == 10008
+    assert cfg.bind_ip == "0.0.0.0"
+    assert cfg.movie_folder == "/srv/movies"
+    assert cfg.max_connections == 2000
+    assert cfg.rtsp_timeout_sec == 90
+    assert cfg.cloud_enabled is True
+    assert cfg.auth_scheme == "basic"
+    assert cfg.error_log_verbosity == "info"
+    assert cfg.wan_ip == "203.0.113.7"
+    assert cfg.status_file_path == "server_status"
+    assert cfg.status_file_interval_sec == 10
+    assert cfg.bucket_delay_ms == 60
+    assert cfg.overbuffer_sec == 2.0
+    assert cfg.push_timeout_sec == 30
+    assert cfg.access_log_enabled is False
+    assert cfg.redis_host == "10.1.2.3" and cfg.redis_port == 6380
+    # dropped prefs are reported, not silently eaten
+    assert "run_num_threads" in unmapped
+    assert "QTSSReflectorModule/ip_allow_list" in unmapped
+    assert "EasyRedisModule/redis_password" in unmapped
+
+
+def test_monitor_file_requires_enable_flag(tmp_path):
+    xml = REFERENCE_XML.replace(
+        '<PREF NAME="enable_monitor_stats_file" TYPE="bool" >true</PREF>',
+        '<PREF NAME="enable_monitor_stats_file" TYPE="bool" >false</PREF>')
+    p = tmp_path / "e.xml"
+    p.write_text(xml)
+    cfg, _ = load_reference_xml(str(p))
+    assert cfg.status_file_path == ""            # name without enable = off
+
+
+def test_actual_reference_shipped_xml_loads():
+    """The file the reference actually ships must load without error."""
+    import os
+    path = "/root/reference/EasyDarwin/WinNTSupport/easydarwin.xml"
+    if not os.path.isfile(path):
+        pytest.skip("reference tree not mounted")
+    cfg, unmapped = load_reference_xml(path)
+    assert cfg.rtsp_port == 554
+    assert cfg.service_port == 10008
+    assert cfg.auth_scheme == "digest"
+    assert cfg.bucket_delay_ms == 73
+    assert len(unmapped) > 40                    # the long tail is reported
+
+
+def test_cli_accepts_xml_config(tmp_path):
+    from easydarwin_tpu.__main__ import build_parser, config_from_args
+    p = tmp_path / "cfg.xml"
+    p.write_text(REFERENCE_XML)
+    args = build_parser().parse_args(["-c", str(p), "-p", "0"])
+    cfg = config_from_args(args)
+    assert cfg.movie_folder == "/srv/movies"
+    assert cfg.rtsp_port == 0                    # CLI overrides XML
+
+
+def test_dropped_list_values_and_bad_values_reported(tmp_path):
+    xml = """<?xml version ="1.0"?>
+<CONFIGURATION><SERVER>
+  <LIST-PREF NAME="rtsp_port"><VALUE>554</VALUE><VALUE>10554</VALUE></LIST-PREF>
+  <PREF NAME="maximum_connections">abc</PREF>
+  <PREF NAME="error_logfile_verbosity">-1</PREF>
+  <PREF NAME="http_service_port">80</PREF>
+  <PREF NAME="service_lan_port">10008</PREF>
+</SERVER></CONFIGURATION>"""
+    p = tmp_path / "e.xml"
+    p.write_text(xml)
+    cfg, unmapped = load_reference_xml(str(p))
+    assert cfg.rtsp_port == 554
+    assert cfg.max_connections == 20000          # default kept, not 'abc'
+    assert cfg.error_log_verbosity == "info"     # default kept, not aliased
+    assert cfg.service_port == 10008             # NOT clobbered by port 80
+    joined = "\n".join(unmapped)
+    assert "extra values dropped" in joined and "10554" in joined
+    assert "maximum_connections (invalid value 'abc')" in joined
+    assert "error_logfile_verbosity (invalid value '-1')" in joined
+    assert "http_service_port" in joined         # tunneling port != REST
