@@ -38,7 +38,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args) -> ServerConfig:
-    if args.config and args.config.endswith(".xml"):
+    is_xml = False
+    if args.config:
+        with open(args.config, "rb") as f:
+            head = f.read(256).lstrip()
+        # sniff content, not filename: reference configs travel under
+        # arbitrary names (easydarwin.conf, EASYDARWIN.XML, ...)
+        is_xml = head.startswith((b"<?xml", b"<!DOCTYPE", b"<CONFIGURATION"))
+    if is_xml:
         # reference easydarwin.xml migration path
         from .server.config import load_reference_xml
         cfg, unmapped = load_reference_xml(args.config)

@@ -124,6 +124,16 @@ class ServerConfig:
 
 # -- reference easydarwin.xml migration --------------------------------------
 
+def _bool(v: str) -> bool:
+    """Strict DSS bool: anything but true/false is reported, not coerced
+    (a hand-edited 'True'/'1' must not silently become False)."""
+    if v == "true":
+        return True
+    if v == "false":
+        return False
+    raise ValueError(f"not a DSS bool: {v!r}")
+
+
 def _verbosity(v: str) -> str:
     i = int(v)
     if not 0 <= i <= 4:                 # DSS levels 0..4; reject garbage
@@ -146,7 +156,7 @@ _XML_SERVER_MAP = {
     "movie_folder": ("movie_folder", str),
     "maximum_connections": ("max_connections", int),
     "rtsp_session_timeout": ("rtsp_timeout_sec", int),
-    "enable_cloud_platform": ("cloud_enabled", lambda v: v == "true"),
+    "enable_cloud_platform": ("cloud_enabled", _bool),
     "authentication_scheme": ("auth_scheme", str),
     "error_logfile_verbosity": ("error_log_verbosity", _verbosity),
     "monitor_stats_file_name": ("status_file_path", str),
@@ -161,7 +171,7 @@ _XML_MODULE_MAP = {
     ("QTSSReflectorModule", "timeout_broadcaster_session_secs"):
         ("push_timeout_sec", int),
     ("QTSSAccessLogModule", "request_logging"):
-        ("access_log_enabled", lambda v: v == "true"),
+        ("access_log_enabled", _bool),
     ("EasyRedisModule", "redis_ip"): ("redis_host", str),
     ("EasyRedisModule", "redis_port"): ("redis_port", int),
     ("EasyCMSModule", "cms_ip"): ("cms_host", str),

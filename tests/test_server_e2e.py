@@ -370,3 +370,38 @@ async def test_tpu_fanout_engine_serves_players_end_to_end():
         await pusher.close()
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_glass_to_glass_latency_under_budget(cfg):
+    """BASELINE budget: <200 ms added latency.  Through the full server
+    (ingest → ring → fan-out → interleaved egress) the push→receive
+    delta for live packets must stay well inside it on the CPU path."""
+    import time
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/lat"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(0, 0, nal_type=5))
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        await player.play_start(uri)
+        await asyncio.wait_for(player.recv_interleaved(0), 5.0)
+
+        lat_ms = []
+        for i in range(1, 21):
+            t0 = time.monotonic()
+            pusher.push_packet(0, vid_pkt(i, i * 3000))
+            await asyncio.wait_for(player.recv_interleaved(0), 5.0)
+            lat_ms.append((time.monotonic() - t0) * 1000)
+        lat_ms.sort()
+        p50, p95 = lat_ms[len(lat_ms) // 2], lat_ms[-2]
+        # reflect_interval_ms=5 in cfg: p50 should sit near one pump tick
+        assert p50 < 60, f"p50 {p50:.1f} ms"
+        assert p95 < 200, f"p95 {p95:.1f} ms (BASELINE budget)"
+        await player.close()
+        await pusher.close()
+    finally:
+        await app.stop()
