@@ -19,7 +19,10 @@ No reference counterpart — new code, like the HLS tier.
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import functools
+import threading
 
 import numpy as np
 
@@ -113,18 +116,31 @@ class MjpegLadderOutput(RelayOutput):
     pattern) and feeds the rung sessions."""
 
     def __init__(self, source_path: str, registry: SessionRegistry,
-                 rungs: tuple[tuple[int, int], ...], *, on_frame=None):
+                 rungs: tuple[tuple[int, int], ...], *, on_frame=None,
+                 executor: concurrent.futures.ThreadPoolExecutor | None = None):
         super().__init__(ssrc=0)
         self.source_path = source_path
         self.registry = registry
         self.on_frame = on_frame            # pump-wake hook
+        # The entropy codec is CPython bit twiddling (hundreds of ms for a
+        # VGA frame) — it must never run on the event loop.  With a running
+        # loop + executor, frames are transcoded on the worker thread and
+        # the freshly packetized rungs are pushed back via
+        # call_soon_threadsafe; when behind, older pending frames are
+        # dropped (MJPEG frames are independent).  Without a loop (unit
+        # tests, offline tools) the path stays synchronous.
+        self._executor = executor
+        self._lock = threading.Lock()
+        self._pending = None                # newest undecoded frame parts
+        self._busy = False
+        self.frames_dropped = 0
         self.depacketizer = mjpeg.JpegDepacketizer()
         self.rungs = []
         for q, scale in rungs:
             path = source_path + rung_suffix(q, scale)
-            self.rungs.append(
-                _Rung(q, scale,
-                      registry.find_or_create(path, _rung_sdp(path))))
+            sess = registry.find_or_create(path, _rung_sdp(path))
+            sess.owner = self
+            self.rungs.append(_Rung(q, scale, sess))
         self.frames_in = 0
         self.decode_errors = 0
         self.last_error = ""                # last swallowed frame exception
@@ -143,22 +159,88 @@ class MjpegLadderOutput(RelayOutput):
         parts = self.depacketizer.push_parts(data)
         if parts is not None:
             try:
-                self._transcode_frame(*parts)
-            except Exception as e:  # a bad frame must never kill fan-out
-                self.decode_errors += 1
-                self.last_error = repr(e)   # surfaced via stats()
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                loop = None
+            if loop is None or self._executor is None:
+                self._run_frame(parts, loop=None)
+            else:
+                self._enqueue(parts, loop)
         self.packets_sent += 1
         self.bytes_sent += len(data)
         return WriteResult.OK
 
+    def _enqueue(self, parts, loop) -> None:
+        """Hand a complete frame to the worker; newest frame wins."""
+        with self._lock:
+            if self._pending is not None:
+                self.frames_dropped += 1
+            self._pending = parts
+            if self._busy:
+                return
+            self._busy = True
+        try:
+            self._executor.submit(self._drain, loop)
+        except RuntimeError:        # executor shut down: degrade to inline
+            self._drain(None)
+
+    def _drain(self, loop) -> None:
+        while True:
+            with self._lock:
+                parts = self._pending
+                self._pending = None
+                if parts is None:
+                    self._busy = False
+                    return
+            try:
+                self._run_frame(parts, loop=loop)
+            except Exception as e:  # _busy MUST reset via the loop above
+                self.decode_errors += 1
+                self.last_error = repr(e)
+
+    def _run_frame(self, parts, *, loop) -> None:
+        try:
+            deliveries = self._transcode_frame(*parts)
+        except Exception as e:  # a bad frame must never kill fan-out
+            self.decode_errors += 1
+            self.last_error = repr(e)   # surfaced via stats()
+            return
+        if deliveries is None:
+            return
+        if loop is None:
+            self._deliver(deliveries)
+        else:
+            try:
+                loop.call_soon_threadsafe(self._deliver, deliveries)
+            except RuntimeError:        # loop closed mid-shutdown: drop
+                return
+
+    def _deliver(self, deliveries) -> None:
+        """Push freshly packetized rungs into their sessions (event-loop
+        thread when threaded; rung sessions are not thread-safe)."""
+        try:
+            for rung, pkts in deliveries:
+                rung.frames += 1
+                rung.bytes_out += sum(len(p) for p in pkts)
+                for p in pkts:
+                    rung.session.push(1, p)
+            if self.on_frame is not None:
+                self.on_frame(self.source_path)
+        except Exception as e:  # downstream push must never kill fan-out
+            self.decode_errors += 1
+            self.last_error = repr(e)
+
     def _transcode_frame(self, header: mjpeg.JpegHeader, scan: bytes,
-                         timestamp: int) -> None:
+                         timestamp: int) -> list | None:
+        """Decode + requantize + re-encode one frame.  Returns the
+        per-rung packet lists for ``_deliver`` (session pushes happen on
+        the event-loop thread, not here)."""
         from ..ops.transform import requantize
 
         jt = header.type & 1
         w, h = header.width, header.height
         if not w or not h:
-            return
+            return None
         if header.qtables:
             qt_in = header.qtables
             self._qt_cache[header.q] = qt_in
@@ -166,7 +248,7 @@ class MjpegLadderOutput(RelayOutput):
             qt_in = self._qt_cache.get(header.q)
             if qt_in is None:       # tables not seen yet: cannot requantize
                 self.decode_errors += 1
-                return
+                return None
         else:
             qt_in = mjpeg.make_qtables(header.q if 1 <= header.q <= 99
                                        else 99)
@@ -185,6 +267,7 @@ class MjpegLadderOutput(RelayOutput):
         quads = None
         if any(r.scale == 2 for r in self.rungs):
             quads = self._frame_quads(jt, w, h, y32, chroma32, n)
+        deliveries = []
         for rung in self.rungs:
             if rung.scale == 2:
                 if quads is None:
@@ -211,12 +294,8 @@ class MjpegLadderOutput(RelayOutput):
                 ssrc=0x54C0DE ^ rung.q ^ (rung.scale << 8),
                 type_=jt, q=rung.q)
             rung.seq = (rung.seq + len(pkts)) & 0xFFFF
-            rung.frames += 1
-            rung.bytes_out += sum(len(p) for p in pkts)
-            for p in pkts:
-                rung.session.push(1, p)
-        if self.on_frame is not None:
-            self.on_frame(self.source_path)
+            deliveries.append((rung, pkts))
+        return deliveries
 
     @staticmethod
     def _frame_quads(jt, w, h, y32, chroma32, n_chroma):
@@ -258,6 +337,7 @@ class MjpegLadderOutput(RelayOutput):
         return {
             "path": self.source_path,
             "frames_in": self.frames_in,
+            "frames_dropped": self.frames_dropped,
             "decode_errors": self.decode_errors,
             "last_error": self.last_error,
             "rungs": [{"q": r.q, "scale": r.scale, "path": r.session.path,
@@ -274,6 +354,9 @@ class MjpegTranscodeService:
         self.registry = registry
         self.on_frame = on_frame
         self.ladders: dict[str, MjpegLadderOutput] = {}
+        # one worker serializes all ladders' entropy coding off the loop
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="mjpeg-ladder")
 
     def start(self, path: str, rungs=(40, 20)):
         """``rungs``: quality ints or ``"Qs2"`` strings (half-resolution
@@ -297,7 +380,8 @@ class MjpegTranscodeService:
                 raise ValueError(
                     f"{key}{rung_suffix(q, s)} is already a live session")
         out = MjpegLadderOutput(key, self.registry, specs,
-                                on_frame=self.on_frame)
+                                on_frame=self.on_frame,
+                                executor=self._executor)
         out.source_session = sess
         sess.add_output(video, out)
         self.ladders[key] = out
@@ -318,8 +402,9 @@ class MjpegTranscodeService:
             for tid in list(src.streams):
                 src.streams[tid].remove_output(out)
         for rung in out.rungs:
-            # rung sessions are ours unless something replaced them
-            if self.registry.find(rung.session.path) is rung.session:
+            # rung sessions are ours unless something replaced/adopted them
+            if (self.registry.find(rung.session.path) is rung.session
+                    and rung.session.owner is out):
                 self.registry.remove(rung.session.path)
         return st
 
@@ -343,3 +428,4 @@ class MjpegTranscodeService:
                 self.stop(key)
             except KeyError:
                 pass
+        self._executor.shutdown(wait=False)

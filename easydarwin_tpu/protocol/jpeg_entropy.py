@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from .mjpeg import (_AC_CODELENS, _AC_SYMBOLS, _DC_CODELENS, _DC_SYMBOLS)
+from .mjpeg import (_AC_CHROMA_CODELENS, _AC_CHROMA_SYMBOLS, _AC_CODELENS,
+                    _AC_SYMBOLS, _DC_CHROMA_CODELENS, _DC_CHROMA_SYMBOLS,
+                    _DC_CODELENS, _DC_SYMBOLS)
 
 
 class JpegEntropyError(ValueError):
@@ -58,6 +60,18 @@ _DC_DECODE = _build_decode(_DC_CODELENS, _DC_SYMBOLS)
 _AC_DECODE = _build_decode(_AC_CODELENS, _AC_SYMBOLS)
 _DC_ENCODE = _build_encode(_DC_CODELENS, _DC_SYMBOLS)
 _AC_ENCODE = _build_encode(_AC_CODELENS, _AC_SYMBOLS)
+_DC_CHROMA_DECODE = _build_decode(_DC_CHROMA_CODELENS, _DC_CHROMA_SYMBOLS)
+_AC_CHROMA_DECODE = _build_decode(_AC_CHROMA_CODELENS, _AC_CHROMA_SYMBOLS)
+_DC_CHROMA_ENCODE = _build_encode(_DC_CHROMA_CODELENS, _DC_CHROMA_SYMBOLS)
+_AC_CHROMA_ENCODE = _build_encode(_AC_CHROMA_CODELENS, _AC_CHROMA_SYMBOLS)
+
+#: per-component (DC decode, AC decode) — comp 0 luma, comps 1-2 chroma
+_DECODE_TABLES = ((_DC_DECODE, _AC_DECODE),
+                  (_DC_CHROMA_DECODE, _AC_CHROMA_DECODE),
+                  (_DC_CHROMA_DECODE, _AC_CHROMA_DECODE))
+_ENCODE_TABLES = ((_DC_ENCODE, _AC_ENCODE),
+                  (_DC_CHROMA_ENCODE, _AC_CHROMA_ENCODE),
+                  (_DC_CHROMA_ENCODE, _AC_CHROMA_ENCODE))
 
 #: blocks per MCU by RTP/JPEG type & 1 — type 0 = 4:2:2 (Y Y Cb Cr),
 #: type 1 = 4:2:0 (Y Y Y Y Cb Cr); component index per block
@@ -164,7 +178,7 @@ def decode_scan(scan: bytes, width: int, height: int, jtype: int,
             r.align_and_skip_restart()
             pred = [0, 0, 0]
         for comp in comps:
-            dc_tab, ac_tab = _DC_DECODE, _AC_DECODE
+            dc_tab, ac_tab = _DECODE_TABLES[comp]
             blk = out[comp][idx[comp]]
             idx[comp] += 1
             t = r.huffman(dc_tab)
@@ -231,13 +245,14 @@ def encode_scan(levels: list[np.ndarray], jtype: int) -> bytes:
     w = _BitWriter()
     for _mcu in range(n_mcus):
         for comp in comps:
+            dc_enc, ac_enc = _ENCODE_TABLES[comp]
             blk = levels[comp][idx[comp]]
             idx[comp] += 1
             dc = int(blk[0])
             diff = dc - pred[comp]
             pred[comp] = dc
             t = _category(diff)
-            code, nb = _DC_ENCODE[t]
+            code, nb = dc_enc[t]
             w.bits(code, nb)
             if t:
                 w.bits(diff if diff >= 0 else diff + (1 << t) - 1, t)
@@ -252,16 +267,16 @@ def encode_scan(levels: list[np.ndarray], jtype: int) -> bytes:
                     run += 1
                     k += 1
                 while run >= 16:
-                    code, nb = _AC_ENCODE[0xF0]
+                    code, nb = ac_enc[0xF0]
                     w.bits(code, nb)        # ZRL
                     run -= 16
                 v = int(blk[k])
                 s = _category(v)
-                code, nb = _AC_ENCODE[(run << 4) | s]
+                code, nb = ac_enc[(run << 4) | s]
                 w.bits(code, nb)
                 w.bits(v if v >= 0 else v + (1 << s) - 1, s)
                 k += 1
             if last_nz < 63:
-                code, nb = _AC_ENCODE[0x00]
+                code, nb = ac_enc[0x00]
                 w.bits(code, nb)            # EOB
     return w.flush()

@@ -69,6 +69,7 @@ class PullRelay:
             self._channel_map[2 * i] = (st.track_id, False)
             self._channel_map[2 * i + 1] = (st.track_id, True)
         self.session = self.registry.find_or_create(self.local_path, sd.raw)
+        self.session.owner = self
         self.alive = True
         self._forward_task = asyncio.create_task(
             self._forward_loop(), name=f"pull:{self.local_path}")
@@ -99,8 +100,10 @@ class PullRelay:
             # release the session NOW, exactly as a pusher disconnect tears
             # its session down — a later ANNOUNCE must get a fresh session,
             # never adopt a dead pull's (ownership-checked: a session some
-            # other producer already replaced is left alone)
-            if self.registry.find(self.local_path) is self.session:
+            # other producer already replaced or adopted is left alone)
+            if (self.registry.find(self.local_path) is self.session
+                    and self.session is not None
+                    and self.session.owner is self):
                 self.registry.remove(self.local_path)
             self.session = None
 
@@ -116,9 +119,11 @@ class PullRelay:
         if was_alive:       # dead upstream: TEARDOWN would just time out
             await self.client.teardown(self.url)
         await self.client.close()
-        # remove only OUR session — a pusher may have re-announced the path
-        # after this pull died, and that live broadcast must survive
-        if self.registry.find(self.local_path) is self.session:
+        # remove only OUR session — a pusher may have re-announced or
+        # adopted the path after this pull died; that broadcast survives
+        if (self.registry.find(self.local_path) is self.session
+                and self.session is not None
+                and self.session.owner is self):
             self.registry.remove(self.local_path)
         self.session = None
 

@@ -37,6 +37,13 @@ class RelaySession:
         self.created_ms = now_ms()
         self.last_ingest_ms = self.created_ms
         self.pusher_alive = True
+        #: the object feeding this session (RTSP pusher connection,
+        #: PullRelay, BroadcastSource, transcode service) — identity-based
+        #: ownership so teardown paths never remove a session something
+        #: else has since taken over.  An ANNOUNCE on an existing path
+        #: ADOPTS the session (find_or_create returns the same object), so
+        #: `registry.find(p) is session` alone cannot detect takeover.
+        self.owner: object | None = None
 
     # -- ingest ------------------------------------------------------------
     def push(self, track_id: int, packet: bytes, *, is_rtcp: bool = False,

@@ -137,7 +137,15 @@ class SdpFileRelaySource:
                 text = _read(fname)
             except OSError:                 # unreadable/deleted mid-request
                 return None
+            # Ownership: a live session on this path already has a feeder
+            # (ANNOUNCE pusher, pull relay) — serve it as-is.  Binding our
+            # broadcast ingest sockets onto someone else's session would
+            # double-feed it and later teardown would remove a session we
+            # never owned.
+            if self.registry.find(key) is not None:
+                return self.registry.find(key)
             session = self.registry.find_or_create(key, text)
+            session.owner = self
             src = BroadcastSource(key, session)
             sd = session.description
             # find_or_create cached the raw file text; replace it with the
@@ -160,7 +168,11 @@ class SdpFileRelaySource:
                         self._make_cb(src, info.track_id, is_rtcp=True)))
             except OSError:
                 src.close()
-                self.registry.remove(key)
+                # tear down only if still ours — an ANNOUNCE during the
+                # awaited binds ADOPTS the session (owner re-stamped)
+                if (self.registry.find(key) is session
+                        and session.owner is self):
+                    self.registry.remove(key)
                 return None
             self.sources[key] = src
             return session
@@ -177,7 +189,9 @@ class SdpFileRelaySource:
         src = self.sources.pop(sdp_mod._norm(path), None)
         if src is not None:
             src.close()
-            self.registry.remove(src.path)
+            sess = self.registry.find(src.path)
+            if sess is src.session and sess.owner is self:
+                self.registry.remove(src.path)
         self._idle_since.pop(sdp_mod._norm(path), None)
 
     def sweep(self, now: float | None = None) -> int:

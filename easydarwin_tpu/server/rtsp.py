@@ -271,6 +271,7 @@ class RtspConnection:
         path = req.path()
         self.relay = self.server.registry.find_or_create(
             path, req.body.decode("utf-8", "replace"))
+        self.relay.owner = self         # ANNOUNCE takes ownership (adoption)
         self.path = self.relay.path
         self.is_pusher = True
         self.server.stats["pushers"] += 1
@@ -454,8 +455,11 @@ class RtspConnection:
             try:
                 f = float(v)
             except ValueError:
-                continue
-            if not 0.01 <= f <= 8.0:
+                f = None
+            if f is None or not 0.01 <= f <= 8.0:
+                # RFC 2326 §12.34: the response carries the value actually
+                # used — a rejected request plays at 1x and must say so
+                extra[hdr.capitalize()] = "1"
                 continue
             speed *= f
             if hdr == "scale":

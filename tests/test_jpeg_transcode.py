@@ -94,6 +94,95 @@ def test_codec_writes_real_jpeg():
     assert np.abs(arr - ymat).mean() < 8.0
 
 
+def _parse_jfif(data: bytes):
+    """Minimal JFIF marker walk → (w, h, {tq: zigzag qtable}, dri, scan)."""
+    pos = 2
+    qt = {}
+    dri = 0
+    w = h = None
+    while pos < len(data) - 1:
+        assert data[pos] == 0xFF, hex(data[pos])
+        m = data[pos + 1]
+        if m == 0xD9:
+            break
+        seglen = int.from_bytes(data[pos + 2:pos + 4], "big")
+        body = data[pos + 4:pos + 2 + seglen]
+        if m == 0xDB:
+            i = 0
+            while i < len(body):
+                assert body[i] >> 4 == 0, "8-bit tables only"
+                qt[body[i] & 0xF] = np.frombuffer(body[i + 1:i + 65], np.uint8)
+                i += 65
+        elif m == 0xC0:
+            h = int.from_bytes(body[1:3], "big")
+            w = int.from_bytes(body[3:5], "big")
+        elif m == 0xDD:
+            dri = int.from_bytes(body[:2], "big")
+        elif m == 0xDA:
+            return w, h, qt, dri, data[pos + 2 + seglen:]
+        pos += 2 + seglen
+    raise AssertionError("no SOS")
+
+
+def test_decode_libjpeg_scan():
+    """A stock libjpeg (PIL) 4:2:0 scan — standard Annex-K luma AND chroma
+    tables — must entropy-decode and reconstruct close to the source.
+    Regression: round-1 codec applied luma Huffman tables to chroma blocks
+    and raised 'invalid Huffman code' on every real encoder's output."""
+    PIL = pytest.importorskip("PIL.Image")
+    from easydarwin_tpu.ops import transform
+
+    w = h = 48
+    ymat = (np.add.outer(np.linspace(30, 200, h), np.linspace(0, 255, w))
+            / 2).astype(np.uint8)
+    rgb = np.stack([ymat, np.flipud(ymat), np.fliplr(ymat)], axis=-1)
+    buf = io.BytesIO()
+    PIL.fromarray(rgb, "RGB").save(buf, "JPEG", quality=85, subsampling=2)
+    W, H, qt, dri, scan = _parse_jfif(buf.getvalue())
+    assert (W, H) == (w, h)
+    y, cb, cr = je.decode_scan(scan, W, H, 1, restart_interval=dri)
+    assert np.any(cb) or np.any(cr)         # chroma actually coded
+
+    # Reconstruct the Y plane and compare to PIL's own decode of itself.
+    zz = transform.zigzag_order()
+    deq = np.zeros((len(y), 64), np.float32)
+    deq[:, zz] = y.astype(np.float32) * qt[0].astype(np.float32)
+    pix = np.asarray(transform.idct_blocks(deq)).reshape(-1, 8, 8) + 128.0
+    gw, _gh = je.mcu_grid(W, H, 1)
+    recon = np.zeros((H, W), np.float32)
+    for blk_i, blk in enumerate(pix):
+        mcu, sub = divmod(blk_i, 4)
+        my, mx = divmod(mcu, gw)
+        sy, sx = divmod(sub, 2)
+        recon[my * 16 + sy * 8:my * 16 + sy * 8 + 8,
+              mx * 16 + sx * 8:mx * 16 + sx * 8 + 8] = blk
+    ref = np.asarray(PIL.open(io.BytesIO(buf.getvalue())).convert("YCbCr"),
+                     np.float32)[:, :, 0]
+    assert np.abs(recon - ref).mean() < 3.0
+
+
+def test_reencoded_libjpeg_frame_pil_decodable():
+    """decode_scan → encode_scan → make_jfif_headers of a real libjpeg frame
+    must itself be decodable by PIL (chroma DHT slots carry chroma tables)."""
+    PIL = pytest.importorskip("PIL.Image")
+    w = h = 32
+    arr = np.stack([np.tile(np.linspace(0, 255, w), (h, 1)).astype(np.uint8)] * 3,
+                   axis=-1)
+    buf = io.BytesIO()
+    PIL.fromarray(arr, "RGB").save(buf, "JPEG", quality=75, subsampling=2)
+    W, H, qt, dri, scan = _parse_jfif(buf.getvalue())
+    levels = je.decode_scan(scan, W, H, 1, restart_interval=dri)
+    rescan = je.encode_scan(levels, 1)
+    qtables = bytes(qt[0]) + bytes(qt.get(1, qt[0]))
+    hdr = mjpeg.JpegHeader(type=1, q=255, width=W, height=H, qtables=qtables)
+    jfif = mjpeg.make_jfif_headers(hdr, qtables) + rescan + b"\xff\xd9"
+    img = PIL.open(io.BytesIO(jfif))
+    img.load()
+    orig = np.asarray(PIL.open(io.BytesIO(buf.getvalue())).convert("L"),
+                      np.float32)
+    assert np.abs(np.asarray(img.convert("L"), np.float32) - orig).mean() < 2.0
+
+
 # ------------------------------------------------------------------ ladder
 
 
@@ -166,6 +255,33 @@ def test_ladder_requires_mjpeg_track():
         svc.start("/h264")
     with pytest.raises(KeyError):
         svc.start("/nope")
+
+
+@pytest.mark.asyncio
+async def test_ladder_transcode_off_event_loop():
+    """Under a running loop the entropy codec runs on the worker thread:
+    every frame is either transcoded (delivered back via the loop) or
+    dropped-when-behind — never executed inline in send_bytes."""
+    reg = SessionRegistry()
+    src = reg.find_or_create("/cam", MJPEG_SDP)
+    svc = MjpegTranscodeService(reg)
+    out = svc.start("/cam", (40,))
+    n = 6
+    for i in range(n):
+        _levels, pkts = make_mjpeg_packets(seq0=1 + i * 10, ts=9000 * (i + 1))
+        for p in pkts:
+            src.push(1, p)
+        src.reflect()
+    for _ in range(250):
+        with out._lock:
+            idle = not out._busy and out._pending is None
+        if idle and out.rungs[0].frames == out.frames_in:
+            break
+        await asyncio.sleep(0.02)
+    assert out.frames_in + out.frames_dropped == n
+    assert out.frames_in >= 1 and out.decode_errors == 0
+    assert out.rungs[0].frames == out.frames_in
+    svc.stop_all()
 
 
 @pytest.mark.asyncio

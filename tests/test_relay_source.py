@@ -268,6 +268,28 @@ async def test_session_level_multicast_c_never_leaks(tmp_path):
 
 
 @pytest.mark.asyncio
+async def test_adopted_session_survives_source_teardown(tmp_path):
+    """An ANNOUNCE pusher ADOPTS the path's session (same object,
+    owner re-stamped).  close_source() must then release only the bound
+    sockets — never the pusher's live session or its cached SDP."""
+    port = free_udp_port()
+    (tmp_path / "a.sdp").write_text(broadcast_sdp(port))
+    reg = SessionRegistry()
+    svc = SdpFileRelaySource(str(tmp_path), reg)
+    sess = await svc.open("/a")
+    assert sess is not None and sess.owner is svc
+    # pusher adopts mid-life (what _do_announce does)
+    pusher = object()
+    sess.owner = pusher
+    svc.close_source("/a")
+    assert reg.find("/a") is sess           # session survived
+    assert "/a" not in svc.sources          # sockets released
+    # and a path someone else already owns is served as-is, no new binds
+    sess2 = await svc.open("/a")
+    assert sess2 is sess and "/a" not in svc.sources
+
+
+@pytest.mark.asyncio
 async def test_unreadable_sdp_file_is_a_clean_404(tmp_path):
     port = free_udp_port()
     f = tmp_path / "p.sdp"

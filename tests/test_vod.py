@@ -227,8 +227,10 @@ async def test_vod_play_with_scale_header(tmp_path):
 
 @pytest.mark.asyncio
 async def test_vod_negative_scale_ignored(tmp_path):
-    """Reverse play is unsupported: 'Scale: -2.0' must not be echoed nor
-    converted into forward fast-forward."""
+    """Reverse play is unsupported: 'Scale: -2.0' must not be converted
+    into forward fast-forward, and the response must carry the value
+    actually applied (Scale: 1, RFC 2326 §12.34) so the client knows its
+    request was refused."""
     from easydarwin_tpu.server import ServerConfig, StreamingServer
     from easydarwin_tpu.utils.client import RtspClient
 
@@ -249,7 +251,7 @@ async def test_vod_negative_scale_ignored(tmp_path):
         await c.request("SETUP", f"{uri}/trackID={sd.streams[0].track_id}",
                         {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
         r = await c.request("PLAY", uri, {"scale": "-2.0"})
-        assert r.status == 200 and "scale" not in r.headers
+        assert r.status == 200 and r.headers.get("scale") == "1"
         conn = next(iter(app.rtsp.connections))
         assert conn.vod_session.speed == 1.0
         assert conn.vod_session.ts_scale == 1.0
