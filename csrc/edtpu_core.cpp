@@ -94,7 +94,11 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
         if (errno == EINTR) continue;
         if (errno == EAGAIN || errno == EWOULDBLOCK)
           return done + sent;  // WouldBlock: caller keeps its bookmark
-        return -errno;
+        // hard mid-batch error: report what WAS delivered (callers advance
+        // bookmarks past it and never re-send delivered datagrams) — the
+        // same contract as the GSO path's `done > 0 ? done : -flush_err`
+        int32_t got = done + sent;
+        return got > 0 ? got : -errno;
       }
       sent += n;
     }

@@ -164,6 +164,17 @@ def make_ops(pairs: list[tuple[int, int]]) -> ctypes.Array:
     return arr
 
 
+def ops_from_numpy(arr: np.ndarray):
+    """[N, 2] int32 C-contiguous (slot, out) rows → SendOp pointer.
+
+    The live fan-out builds its op list with numpy slicing (no per-op
+    Python); the int32 pair layout matches ``struct ed_sendop`` exactly.
+    The array must stay alive for the duration of the native call."""
+    assert arr.dtype == np.int32 and arr.ndim == 2 and arr.shape[1] == 2
+    assert arr.flags.c_contiguous
+    return ctypes.cast(arr.ctypes.data, ctypes.POINTER(SendOp))
+
+
 def fanout_send_udp(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
                     seq_off: np.ndarray, ts_off: np.ndarray,
                     ssrc: np.ndarray, dests, ops, n_ops: int) -> int:

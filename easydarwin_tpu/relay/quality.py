@@ -85,6 +85,12 @@ class ThinningFilter:
     _dropping_frame: bool = False
     dropped: int = 0
 
+    def passthrough(self) -> bool:
+        """True while the filter cannot drop anything (level 0, not mid
+        frame-drop) — the native batched egress bypasses ``admit`` for
+        such outputs and must route through the scalar path otherwise."""
+        return self.controller.level == 0 and not self._dropping_frame
+
     def admit(self, flags: int) -> bool:
         """Decide for one packet (classification flags from the ring)."""
         level = self.controller.level

@@ -168,6 +168,8 @@ class StreamingServer:
         eng = self._engines.get(id(stream))
         if eng is None:
             eng = self._engines[id(stream)] = TpuFanoutEngine()
+        egress = self.rtsp.shared_egress
+        eng.egress_fd = egress.fileno() if egress is not None else None
         return eng
 
     def _reflect_all(self) -> int:

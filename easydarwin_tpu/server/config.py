@@ -39,6 +39,10 @@ class ServerConfig:
     # --- device tier
     tpu_fanout: bool = False           # batch engine instead of scalar loop
     tpu_min_outputs: int = 8           # below this the scalar loop wins
+    # shared UDP egress pair for players (RTPSocketPool/UDPDemuxer shape;
+    # required by the native sendmmsg/GSO fan-out). Falls back to per-client
+    # port pairs when off or when the native core is unavailable.
+    shared_udp_egress: bool = True
     # --- cluster (EasyRedisModule / EasyCMS prefs)
     cloud_enabled: bool = False
     redis_host: str = "127.0.0.1"

@@ -329,9 +329,24 @@ class RtspConnection:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
         out, resp_t, pair = await self._make_output(t)
         extra = self._negotiate_meta_info(req, out)
-        self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
+        self._install_player_track(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {
             "Transport": resp_t.to_header(), **extra}), req.cseq)
+
+    def _install_player_track(self, track_id, out, pair) -> None:
+        """Land a SETUP'd output, releasing any replaced track's transport
+        and registering native outputs for RTCP demux only AFTER every
+        fallible step succeeded (no leak on a failed SETUP)."""
+        egress = self.server.shared_egress
+        old = self.player_tracks.get(track_id)
+        if old is not None:
+            if old.udp_pair:
+                old.udp_pair.close()
+            elif egress is not None and hasattr(old.output, "rtcp_addr"):
+                egress.unregister(old.output, self)
+        self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
+        if egress is not None and pair is None and hasattr(out, "rtcp_addr"):
+            egress.register(out, self)
 
     #: x-RTP-Meta-Info fields this server can fill (tt transmit-time,
     #: sq sequence, md media; DSS's pp/pn/ft need hint-track context)
@@ -372,12 +387,23 @@ class RtspConnection:
         else:
             if not t.client_port:
                 raise rtsp.RtspError(461, "UDP SETUP without client_port")
-            pair = await self.server.udp_pool.allocate(
-                on_rtcp=lambda d, a: self.server.on_client_rtcp(self, d))
-            out = UdpOutput(pair.rtp_transport, pair.rtcp_transport,
-                            self.client_ip, t.client_port[0],
-                            t.client_port[1], ssrc=ssrc, out_seq_start=seq0)
-            resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
+            egress = self.server.shared_egress
+            if egress is not None and egress.active:
+                # shared-pair egress (RTPSocketPool shape): the native
+                # batched fan-out path serves this output
+                from .egress import NativeUdpOutput
+                out = NativeUdpOutput(egress, self.client_ip,
+                                      t.client_port[0], t.client_port[1],
+                                      ssrc=ssrc, out_seq_start=seq0)
+                resp_t.server_port = (egress.rtp_port, egress.rtcp_port)
+            else:
+                pair = await self.server.udp_pool.allocate(
+                    on_rtcp=lambda d, a: self.server.on_client_rtcp(self, d))
+                out = UdpOutput(pair.rtp_transport, pair.rtcp_transport,
+                                self.client_ip, t.client_port[0],
+                                t.client_port[1], ssrc=ssrc,
+                                out_seq_start=seq0)
+                resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
             resp_t.client_port = t.client_port
         return out, resp_t, pair
 
@@ -398,7 +424,7 @@ class RtspConnection:
         if not 1 <= track_id <= n_tracks:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
         out, resp_t, pair = await self._make_output(t)
-        self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
+        self._install_player_track(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
                     req.cseq)
 
@@ -536,9 +562,12 @@ class RtspConnection:
             self.vod_file.close()
             self.vod_file = None
         self._detach_outputs()
+        egress = self.server.shared_egress
         for pt in self.player_tracks.values():
             if pt.udp_pair:
                 pt.udp_pair.close()
+            elif egress is not None and hasattr(pt.output, "rtcp_addr"):
+                egress.unregister(pt.output, self)
         for pt in self.pusher_tracks.values():
             if pt.udp_pair:
                 pt.udp_pair.close()
@@ -574,6 +603,10 @@ class RtspServer:
         #: hook for plain HTTP GET on the RTSP port (mp3/stats); set by app
         self.http_get_handler = None
         self.udp_pool = UdpPortPool(bind_ip="0.0.0.0")
+        #: shared (RTP, RTCP) egress pair for UDP players — the reference's
+        #: RTPSocketPool shared-pair + UDPDemuxer design; doorway to the
+        #: native batched egress (server/egress.py). None until start().
+        self.shared_egress = None
         #: SdpFileRelaySource for .sdp-described UDP/multicast broadcasts
         self.relay_source = None
         self.connections: set[RtspConnection] = set()
@@ -591,10 +624,18 @@ class RtspServer:
         self._server = await asyncio.start_server(
             self._on_connection, self.config.bind_ip, self.config.rtsp_port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.config.shared_udp_egress:
+            from .egress import SharedUdpEgress
+            self.shared_egress = SharedUdpEgress(self.config.bind_ip)
+            await self.shared_egress.start()
+            self.shared_egress.on_rtcp = self.on_client_rtcp
 
     async def stop(self) -> None:
         for conn in list(self.connections):
             await conn.close()
+        if self.shared_egress is not None:
+            self.shared_egress.close()
+            self.shared_egress = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
