@@ -39,9 +39,19 @@ inline void render_header(uint8_t *dst, const uint8_t *src, uint32_t seq_off,
 }
 }  // namespace
 
+namespace {
+// why the last send path stopped short: 0 = completed, EAGAIN/EWOULDBLOCK
+// = flow control (caller keeps bookmarks and replays), anything else = a
+// hard per-datagram error (caller skips past it, oracle ERROR semantics).
+// Partial counts alone cannot distinguish the two cases.
+thread_local int g_stop_errno = 0;
+}  // namespace
+
 extern "C" {
 
 const char *ed_version(void) { return "edtpu_core 0.1.0"; }
+
+int32_t ed_last_send_errno(void) { return g_stop_errno; }
 
 int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
                            const int32_t *ring_len, int32_t capacity,
@@ -49,6 +59,7 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
                            const uint32_t *ts_off, const uint32_t *ssrc,
                            const ed_dest *dest, int32_t n_outs,
                            const ed_sendop *ops, int32_t n_ops) {
+  g_stop_errno = 0;
   if (n_ops <= 0) return 0;
   std::vector<mmsghdr> msgs(kSendBatch);
   std::vector<iovec> iovs(static_cast<size_t>(kSendBatch) * 2);
@@ -92,11 +103,13 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
       int n = sendmmsg(fd, msgs.data() + sent, batch - sent, 0);
       if (n < 0) {
         if (errno == EINTR) continue;
+        g_stop_errno = errno;
         if (errno == EAGAIN || errno == EWOULDBLOCK)
           return done + sent;  // WouldBlock: caller keeps its bookmark
         // hard mid-batch error: report what WAS delivered (callers advance
         // bookmarks past it and never re-send delivered datagrams) — the
-        // same contract as the GSO path's `done > 0 ? done : -flush_err`
+        // same contract as the GSO path's `done > 0 ? done : -flush_err`;
+        // ed_last_send_errno() tells the caller the stop was hard
         int32_t got = done + sent;
         return got > 0 ? got : -errno;
       }
@@ -123,6 +136,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
                                const uint32_t *ts_off, const uint32_t *ssrc,
                                const ed_dest *dest, int32_t n_outs,
                                const ed_sendop *ops, int32_t n_ops) {
+  g_stop_errno = 0;
   if (n_ops <= 0) return 0;
   // One super-send = one msg_hdr with [hdr|payload] iovec pairs for a run of
   // same-subscriber, same-size packets, plus a UDP_SEGMENT cmsg.
@@ -160,6 +174,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
       int n = sendmmsg(fd, msgs.data() + sent, n_super - sent, 0);
       if (n < 0) {
         if (errno == EINTR) continue;
+        g_stop_errno = errno;
         if (errno != EAGAIN && errno != EWOULDBLOCK) flush_err = errno;
         int32_t ops_sent = 0;
         for (int i = 0; i < sent; ++i) ops_sent += supers[i].n_ops;
@@ -287,6 +302,44 @@ int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
     total += r;
   }
   return static_cast<int32_t>(total);
+}
+
+int32_t ed_scalar_baseline_send(int fd, const uint8_t *ring_data,
+                                const int32_t *ring_len, int32_t capacity,
+                                int32_t slot_size, const uint32_t *seq_off,
+                                const uint32_t *ts_off, const uint32_t *ssrc,
+                                const ed_dest *dest, int32_t n_outs,
+                                const ed_sendop *ops, int32_t n_ops) {
+  g_stop_errno = 0;
+  uint8_t scratch[65536];
+  for (int32_t i = 0; i < n_ops; ++i) {
+    const ed_sendop &op = ops[i];
+    if (op.slot < 0 || op.slot >= capacity || op.out < 0 || op.out >= n_outs)
+      return -EINVAL;
+    const uint8_t *pkt = ring_data + static_cast<size_t>(op.slot) * slot_size;
+    int32_t len = ring_len[op.slot];
+    if (len < 12 || len > slot_size ||
+        len > static_cast<int32_t>(sizeof(scratch)))
+      return -EINVAL;
+    std::memcpy(scratch, pkt, static_cast<size_t>(len));
+    render_header(scratch, pkt, seq_off[op.out], ts_off[op.out],
+                  ssrc[op.out]);
+    sockaddr_in sa;
+    std::memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = dest[op.out].ip_be;
+    sa.sin_port = dest[op.out].port_be;
+    for (;;) {
+      ssize_t r = sendto(fd, scratch, static_cast<size_t>(len), 0,
+                         reinterpret_cast<sockaddr *>(&sa), sizeof(sa));
+      if (r >= 0) break;
+      if (errno == EINTR) continue;
+      g_stop_errno = errno;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return i;
+      return i > 0 ? i : -errno;
+    }
+  }
+  return n_ops;
 }
 
 int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,

@@ -21,6 +21,12 @@ extern "C" {
 
 const char *ed_version(void);
 
+/* Why the calling thread's last send entry point stopped short of n_ops:
+ * 0 = completed, EAGAIN/EWOULDBLOCK = flow control (keep bookmarks,
+ * replay), anything else = a hard per-datagram error (skip past it —
+ * the scalar oracle's WriteResult.ERROR advance).  Thread-local. */
+int32_t ed_last_send_errno(void);
+
 /* ---------------------------------------------------------------- egress */
 
 /* One send op: packet (ring slot) -> subscriber (output index). */
@@ -92,6 +98,21 @@ int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
                              const ed_dest *dest,
                              int32_t n_outs, const ed_sendop *ops,
                              int32_t n_ops, int32_t use_gso);
+
+/* The REFERENCE architecture in C, for an honest vs_baseline: one thread,
+ * one sendto(2) per (packet, output) with a scalar in-buffer header patch —
+ * the ReflectorSender hot loop (ReflectorStream.cpp:1024-1185 →
+ * RTPStream.cpp:1145 UDP send) with zero batching, exactly what a faithful
+ * C port of the reference would execute per datagram.  A per-op ~len-byte
+ * scratch memcpy stands in for the reference's in-place header rewrite
+ * (sub-1us next to the syscall).  Returns ops sent; EAGAIN stops and
+ * returns the count so far; negative errno only when nothing was sent. */
+int32_t ed_scalar_baseline_send(int fd, const uint8_t *ring_data,
+                                const int32_t *ring_len, int32_t capacity,
+                                int32_t slot_size, const uint32_t *seq_off,
+                                const uint32_t *ts_off, const uint32_t *ssrc,
+                                const ed_dest *dest, int32_t n_outs,
+                                const ed_sendop *ops, int32_t n_ops);
 
 /* Same render, but into a caller buffer instead of the wire: out must hold
  * n_ops * (12 + max payload) — used for interleaved/TCP paths and tests.

@@ -59,7 +59,7 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        if not hasattr(lib, "ed_fanout_send_multi"):
+        if not hasattr(lib, "ed_last_send_errno"):   # newest symbol
             # stale prebuilt .so from an older source tree: rebuild in place
             # (make relinks to a fresh inode, so a second dlopen maps the
             # new library; the old one is never deleted, in case no
@@ -70,7 +70,7 @@ def _load():
                 lib = ctypes.CDLL(_SO)
             except OSError:
                 return None
-            if not hasattr(lib, "ed_fanout_send_multi"):
+            if not hasattr(lib, "ed_last_send_errno"):
                 return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -90,6 +90,10 @@ def _load():
             u32p, u32p, u32p, ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(Dest), ctypes.c_int32, ctypes.POINTER(SendOp),
             ctypes.c_int32, ctypes.c_int32]
+        lib.ed_scalar_baseline_send.restype = ctypes.c_int32
+        lib.ed_scalar_baseline_send.argtypes = lib.ed_fanout_send_udp.argtypes
+        lib.ed_last_send_errno.restype = ctypes.c_int32
+        lib.ed_last_send_errno.argtypes = []
         lib.ed_udp_drain.restype = ctypes.c_int64
         lib.ed_udp_drain.argtypes = [i32p, ctypes.c_int32]
         lib.ed_udp_drain_ex.restype = ctypes.c_int64
@@ -230,6 +234,31 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         ring_data.shape[0], ring_data.shape[1],
         _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
         dests, len(dests), ops, n_ops, 1 if use_gso else 0)
+
+
+def last_send_errno() -> int:
+    """Why the calling thread's last send stopped short (see C header)."""
+    lib = _load()
+    assert lib is not None
+    return lib.ed_last_send_errno()
+
+
+def scalar_baseline_send(fd: int, ring_data: np.ndarray,
+                         ring_len: np.ndarray, seq_off: np.ndarray,
+                         ts_off: np.ndarray, ssrc: np.ndarray,
+                         dests, ops, n_ops: int) -> int:
+    """The reference's scalar hot loop in C (one sendto per packet per
+    output, single thread) — the honest vs_baseline denominator."""
+    lib = _load()
+    assert lib is not None
+    assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+    return lib.ed_scalar_baseline_send(
+        fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1],
+        _u32(np.ascontiguousarray(seq_off, np.uint32)),
+        _u32(np.ascontiguousarray(ts_off, np.uint32)),
+        _u32(np.ascontiguousarray(ssrc, np.uint32)),
+        dests, len(dests), ops, n_ops)
 
 
 def udp_drain(fds: list[int]) -> int:

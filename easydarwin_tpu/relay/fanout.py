@@ -15,6 +15,8 @@ delivered by ``TpuFanoutEngine.step`` equal those of ``RelayStream.reflect``.
 
 from __future__ import annotations
 
+import errno as errno_mod
+
 import numpy as np
 
 from ..ops import fanout as fanout_ops
@@ -87,6 +89,7 @@ class TpuFanoutEngine:
         self.native_passes = 0
         self.device_param_refreshes = 0
         self.last_newest_keyframe = -1
+        self.send_errors = 0                # hard per-datagram send errors
         # GSO is tried per pass until proven broken: single-segment supers
         # succeed even without kernel UDP_SEGMENT, so success alone must
         # never latch it on; two passes where GSO fails but plain sendmmsg
@@ -279,11 +282,19 @@ class TpuFanoutEngine:
                     self._gso_disabled = True
         elif self._gso_strikes:
             self._gso_strikes = 0
-        if r < 0:                           # hard error: retry next pass
-            stream.stats.stalls += 1
-            return 0
+        hard = False
+        if r < 0:
+            # hard error with nothing sent: fall through to accounting as
+            # r=0/hard so the poisoned output is skipped, not retried
+            # forever (the scalar oracle advances on WriteResult.ERROR too)
+            hard = True
+            r = 0
+        elif r < total:
+            hard = native.last_send_errno() not in (
+                0, errno_mod.EAGAIN, errno_mod.EWOULDBLOCK)
         # bookmark/stat accounting, exact under partial (EAGAIN) sends
         taken = 0
+        hard_consumed = False
         for (out, hi, pids, _slots, lens), n in zip(per_out, counts):
             k = min(max(r - taken, 0), n)
             taken += n
@@ -293,6 +304,13 @@ class TpuFanoutEngine:
                 continue
             if k == n:
                 out.bookmark = start + hi
+            elif hard and not hard_consumed:
+                # the datagram at the boundary failed hard (unroutable/
+                # rejected destination): drop this output's remainder for
+                # the pass so it cannot starve the outputs behind it
+                hard_consumed = True
+                out.bookmark = start + hi
+                self.send_errors += n - k
             else:
                 out.bookmark = int(pids[k])  # first unsent packet
                 out.stalls += 1

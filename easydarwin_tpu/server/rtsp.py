@@ -573,8 +573,12 @@ class RtspConnection:
                 pt.udp_pair.close()
         if self.is_pusher and self.relay is not None:
             # pusher gone → tear down the relay session (the reference frees
-            # the ReflectorSession when the broadcast stops)
-            self.server.registry.remove(self.relay.path)
+            # the ReflectorSession when the broadcast stops) — but only if
+            # still OURS: a re-ANNOUNCE adopts the session (owner re-stamped)
+            # and that live broadcast must survive our disconnect
+            if (self.server.registry.find(self.relay.path) is self.relay
+                    and self.relay.owner is self):
+                self.server.registry.remove(self.relay.path)
             self.relay = None
         if self in self.server.connections:
             self.server.connections.discard(self)

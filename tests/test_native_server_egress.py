@@ -179,6 +179,81 @@ async def test_rtcp_feedback_demuxes_on_shared_pair():
         await app.stop()
 
 
+def test_poisoned_destination_cannot_starve_other_outputs():
+    """A hard-failing destination (port 0 → EINVAL from sendto) must be
+    skipped past, oracle WriteResult.ERROR style — not retried in place
+    forever, which would starve every output ordered after it."""
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    from easydarwin_tpu.protocol import sdp
+    from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+    from easydarwin_tpu.relay.output import RelayOutput
+    from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+
+    sdp_txt = ("v=0\r\ns=x\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+               "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+    st = RelayStream(sdp.parse(sdp_txt).streams[0],
+                     StreamSettings(bucket_delay_ms=0))
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.setblocking(False)
+    bad = RelayOutput(ssrc=1, out_seq_start=10)
+    bad.native_addr = ("127.0.0.1", 0)          # sendto(port 0) → EINVAL
+    good = RelayOutput(ssrc=2, out_seq_start=20)
+    good.native_addr = rx.getsockname()
+    st.add_output(bad)
+    st.add_output(good)
+    n = 6
+    for i in range(n):
+        st.push_rtp(struct.pack("!BBHII", 0x80, 96, 100 + i, 9000, 0xAB)
+                    + bytes(40), 0)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    eng = TpuFanoutEngine(egress_fd=tx.fileno())
+    sent = 0
+    for _ in range(4):                          # a few passes may be needed
+        sent += eng.step(st, 1000)
+        if good.packets_sent >= n:
+            break
+    assert good.packets_sent == n               # good output fully served
+    assert bad.bookmark == st.rtp_ring.head     # poisoned output skipped
+    assert eng.send_errors >= 1
+    got = drain_sock(rx)
+    assert len(got) == n
+    tx.close()
+    rx.close()
+
+
+@pytest.mark.asyncio
+async def test_reannounce_adoption_survives_old_pusher_close():
+    """Pusher A announces, pusher B re-announces (adopts) the same path;
+    A's disconnect must not tear down B's live session."""
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/adopt"
+        a = RtspClient()
+        await a.connect("127.0.0.1", app.rtsp.port)
+        await a.push_start(uri, H264_SDP)
+        sess_a = app.registry.find("/live/adopt")
+        assert sess_a is not None
+        b = RtspClient()
+        await b.connect("127.0.0.1", app.rtsp.port)
+        await b.push_start(uri, H264_SDP)       # adopts the same session
+        assert app.registry.find("/live/adopt") is sess_a
+        await a.close()
+        await asyncio.sleep(0.05)
+        # B owns it now: the session must have survived A's close
+        assert app.registry.find("/live/adopt") is sess_a
+        await b.close()
+        await asyncio.sleep(0.05)
+        assert app.registry.find("/live/adopt") is None
+    finally:
+        await app.stop()
+
+
 @pytest.mark.asyncio
 async def test_udp_play_falls_back_without_shared_egress():
     """shared_udp_egress=False restores the per-client port-pair path."""
