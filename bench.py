@@ -283,7 +283,7 @@ def server_engine_rate(addrs, *, n_outputs=256, seconds=3.0
 
     from easydarwin_tpu.protocol import sdp
     from easydarwin_tpu.relay.fanout import TpuFanoutEngine
-    from easydarwin_tpu.relay.output import RelayOutput
+    from easydarwin_tpu.relay.output import CollectingOutput
     from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
 
     sdp_txt = ("v=0\r\ns=b\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
@@ -294,8 +294,8 @@ def server_engine_rate(addrs, *, n_outputs=256, seconds=3.0
     rng = np.random.default_rng(3)
     outs = []
     for i in range(n_outputs):
-        o = RelayOutput(ssrc=int(rng.integers(0, 2**32)),
-                        out_seq_start=int(rng.integers(0, 2**16)))
+        o = CollectingOutput(ssrc=int(rng.integers(0, 2**32)),
+                             out_seq_start=int(rng.integers(0, 2**16)))
         o.native_addr = addrs[i % len(addrs)]   # 4 logical per real socket
         st.add_output(o)
         outs.append(o)

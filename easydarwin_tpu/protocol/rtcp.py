@@ -263,21 +263,66 @@ def build_server_compound(ssrc: int, cname: str, *, unix_time: float,
     return out
 
 
+def _walk_compound(data):
+    """Yield ``(offset, ptype, words)`` for each top-level packet of a
+    compound — the one header walk all the rewrite helpers share."""
+    off = 0
+    while off + 8 <= len(data):
+        b0, ptype, words = struct.unpack_from("!BBH", data, off)
+        if b0 >> 6 != 2:
+            return
+        yield off, ptype, words
+        off += 4 + words * 4
+
+
+def compound_has_sr(data: bytes) -> bool:
+    """Cheap top-level scan: does this compound carry a sender report?"""
+    return any(ptype == SR for _off, ptype, _w in _walk_compound(data))
+
+
+def rebase_compound(data: bytes, new_ssrc: int, *, unix_time: float,
+                    rtp_ts_now: int, packet_count: int | None = None,
+                    octet_count: int | None = None) -> bytes:
+    """Relay a pusher's RTCP compound onto one output's timeline.
+
+    The reference's ``RTPSessionOutput::RewriteRTCP``
+    (``RTPSessionOutput.cpp:403-460``): every top-level SSRC becomes the
+    output's, and each SR additionally gets its NTP timestamp set to NOW
+    and its RTP timestamp set to the *output-timeline* RTP time
+    corresponding to now (the caller maps it through ``RewriteState`` —
+    round 1 forwarded the source-timeline pair, which was wrong for every
+    client using it for A/V sync).  ``packet_count``/``octet_count``
+    replace the SR's sender stats with the output's own (the reference
+    doubles the pusher's counts in place, a hack we do not mirror)."""
+    out = bytearray(data)
+    for off, ptype, words in _walk_compound(out):
+        # only when the packet actually has a leading SSRC word (a BYE
+        # with count=0 or an empty SDES is 4 bytes)
+        if ptype in (SR, RR, SDES, BYE, APP) and words >= 1:
+            struct.pack_into("!I", out, off + 4, new_ssrc & 0xFFFFFFFF)
+        if ptype == SR and words >= 6:
+            struct.pack_into("!Q", out, off + 8,
+                             ntp_now(unix_time) & (2**64 - 1))
+            struct.pack_into("!I", out, off + 16, rtp_ts_now & 0xFFFFFFFF)
+            if packet_count is not None:
+                struct.pack_into("!I", out, off + 20,
+                                 packet_count & 0xFFFFFFFF)
+            if octet_count is not None:
+                struct.pack_into("!I", out, off + 24,
+                                 octet_count & 0xFFFFFFFF)
+    return bytes(out)
+
+
 def rewrite_compound_ssrc(data: bytes, new_ssrc: int) -> bytes:
     """Rewrite every top-level sender/source SSRC in a compound to
     ``new_ssrc`` — the relay's SR rewrite (``RTPSessionOutput.cpp:403-460``),
     applied so late-joined receivers see the per-output SSRC rather than the
     pusher's."""
     out = bytearray(data)
-    off = 0
-    while off + 8 <= len(out):
-        b0, ptype, words = struct.unpack_from("!BBH", out, off)
-        if b0 >> 6 != 2:
-            break
+    for off, ptype, words in _walk_compound(out):
         # only when the packet actually has a leading SSRC word (a BYE with
         # count=0 or an empty SDES is 4 bytes; off+4 would be the NEXT
         # packet's header)
         if ptype in (SR, RR, SDES, BYE, APP) and words >= 1:
             struct.pack_into("!I", out, off + 4, new_ssrc & 0xFFFFFFFF)
-        off += 4 + words * 4
     return bytes(out)

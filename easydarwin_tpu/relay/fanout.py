@@ -161,13 +161,8 @@ class TpuFanoutEngine:
             sent += self._native_step(stream, fast, now_ms)
         if slow:
             sent += self._batch_header_step(stream, slow, now_ms)
-        # RTCP relay identical to the scalar path
-        rring = stream.rtcp_ring
-        if len(rring):
-            newest = rring.get(rring.head - 1)
-            for out, _b in flat:
-                out.write_rtcp(newest)
-            rring.tail = rring.head
+        # RTCP relay + SR origination, identical to the scalar path
+        stream.relay_rtcp(now_ms)
         stream.stats.packets_out += sent
         self.steps += 1
         self.packets_sent += sent
@@ -317,7 +312,9 @@ class TpuFanoutEngine:
                 stream.stats.stalls += 1
             if k:
                 out.packets_sent += k
-                out.bytes_sent += int(lens[:k].sum())
+                sent_bytes = int(lens[:k].sum())
+                out.bytes_sent += sent_bytes
+                out.payload_octets += sent_bytes - 12 * k
         self.native_sent += r
         self.native_passes += 1
         return int(r)
@@ -377,6 +374,7 @@ class TpuFanoutEngine:
                 if wr is WriteResult.OK:
                     out.packets_sent += 1
                     out.bytes_sent += 12 + len(payload)
+                    out.payload_octets += len(payload)
                     sent += 1
             out.bookmark = pid
         return sent

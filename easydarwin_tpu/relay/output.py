@@ -62,7 +62,13 @@ class RelayOutput:
         self.meta_field_ids: dict[str, int] | None = None
         self.packets_sent = 0
         self.bytes_sent = 0
+        #: RTP payload octets only (no 12-byte header, no meta-info wrap) —
+        #: the RFC 3550 sender-octet-count definition the SRs report
+        self.payload_octets = 0
         self.stalls = 0
+        #: monotonic ms of the last SR this output received (relayed or
+        #: originated) — drives the 5 s origination cadence
+        self.last_sr_ms = 0
 
     def on_receiver_report(self, fraction_lost: float) -> int:
         """RTCP RR feedback → quality level (FlowControl role input)."""
@@ -117,19 +123,34 @@ class RelayOutput:
         if res is WriteResult.OK:
             self.packets_sent += 1
             self.bytes_sent += len(out)
+            self.payload_octets += max(len(packet) - 12, 0)
         elif res is WriteResult.WOULD_BLOCK:
             self.stalls += 1
         return res
 
-    def write_rtcp(self, packet: bytes) -> WriteResult:
-        """Relay an RTCP compound with the SSRC swapped to this output's
-        (``RTPSessionOutput.cpp:403-460``)."""
-        out = rtcp.rewrite_compound_ssrc(packet, self.rewrite.ssrc)
+    def write_rtcp(self, packet: bytes, *,
+                   src_ts_now: int | None = None,
+                   unix_time: float | None = None) -> WriteResult:
+        """Relay an RTCP compound onto this output's timeline
+        (``RTPSessionOutput.cpp:403-460``): SSRC swapped always; when the
+        caller supplies the stream's source-timeline "RTP time of now"
+        and the rebase is latched, contained SRs get NTP←now and
+        RTP←map_ts(now) so the forwarded ntp/rtp pair is valid on the
+        OUTPUT timeline (round 1 forwarded the source-timeline pair)."""
+        rw = self.rewrite
+        if src_ts_now is not None and rw.base_src_ts >= 0:
+            out = rtcp.rebase_compound(
+                packet, rw.ssrc,
+                unix_time=unix_time if unix_time is not None else 0.0,
+                rtp_ts_now=rw.map_ts(src_ts_now),
+                packet_count=self.packets_sent,
+                octet_count=self.payload_octets)
+        else:
+            out = rtcp.rewrite_compound_ssrc(packet, rw.ssrc)
         res = self.send_bytes(out, is_rtcp=True)
-        if res is WriteResult.OK:
-            self.packets_sent += 1
-            self.bytes_sent += len(out)
-        elif res is WriteResult.WOULD_BLOCK:
+        # packets_sent/bytes_sent stay RTP-only: they feed the SR sender
+        # stats, which RFC 3550 defines over RTP data packets
+        if res is WriteResult.WOULD_BLOCK:
             self.stalls += 1
         return res
 

@@ -19,9 +19,14 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..protocol import rtcp as rtcp_mod
 from ..protocol.sdp import StreamInfo
 from .output import RelayOutput, WriteResult
 from .ring import DEFAULT_CAPACITY, PacketFlags, PacketRing
+
+#: SR origination / upstream-RR cadence (``ReflectorStream.h:341``
+#: kRRInterval = 5 s; ``RTPStream.cpp:1300`` SR gen rides the same clock)
+SR_INTERVAL_MS = 5000
 
 
 @dataclass
@@ -67,12 +72,43 @@ class RelayStream:
         self.has_keyframe_update = False     # SetHasVideoKeyFrameUpdate
         self.buckets: list[list[RelayOutput]] = []
         self.stats = StreamStats()
+        #: upstream RTCP: where receiver reports to the pusher go
+        #: (interleaved channel writer or UDP sendto closure); set by the
+        #: ingest owner.  ``ReflectorStream.h:341`` kRRInterval behavior.
+        self.upstream_rtcp = None
+        #: who installed upstream_rtcp (connection identity) — a closed
+        #: pusher clears only its own closure, never an adopter's
+        self.upstream_rtcp_owner = None
+        self.last_upstream_rr_ms = 0
+        #: earliest moment any output could need an originated SR — lets
+        #: the per-step relay_rtcp call early-return without touching the
+        #: output list (it is on the fan-out hot path)
+        self._next_sr_due_ms = 0
+        #: reception accounting for those RRs (RFC 3550 A.3)
+        self._rr_base_seq: int | None = None
+        self._rr_max_seq = 0
+        self._rr_cycles = 0
+        self._rr_received = 0
+        self._rr_prev_expected = 0
+        self._rr_prev_received = 0
 
     # -- ingest ------------------------------------------------------------
     def push_rtp(self, packet: bytes, now_ms: int) -> int:
         pid = self.rtp_ring.push(packet, now_ms)
         self.stats.packets_in += 1
         self.stats.bytes_in += len(packet)
+        if len(packet) >= 12:
+            seq = int(self.rtp_ring.seq[self.rtp_ring.slot(pid)])
+            if self._rr_base_seq is None:
+                self._rr_base_seq = seq
+                self._rr_max_seq = seq
+            else:
+                delta = (seq - self._rr_max_seq) & 0xFFFF
+                if delta < 0x8000:              # in-order / small gap
+                    if seq < self._rr_max_seq:
+                        self._rr_cycles += 1    # wrapped
+                    self._rr_max_seq = seq
+            self._rr_received += 1
         if self.rtp_ring.get_flags(pid) & PacketFlags.KEYFRAME_FIRST:
             if not self._kf_run_active:
                 self.keyframe_id = pid
@@ -90,6 +126,7 @@ class RelayStream:
     def add_output(self, output: RelayOutput) -> None:
         """Place in the first bucket with a free slot, growing the bucket
         array as needed (``ReflectorStream::AddOutput`` cpp:280-322)."""
+        self._next_sr_due_ms = 0        # new output: SR due immediately
         for bucket in self.buckets:
             if len(bucket) < self.settings.bucket_size:
                 bucket.append(output)
@@ -164,15 +201,104 @@ class RelayStream:
                         sent += 1
                 out.bookmark = pid
         self.stats.packets_out += sent
-        # relay buffered RTCP (SSRC-rewritten) to every output, newest only
-        rring = self.rtcp_ring
-        if len(rring):
-            newest = rring.head - 1
-            data = rring.get(newest)
-            for out in self.outputs:
-                out.write_rtcp(data)
-            rring.tail = rring.head
+        self.relay_rtcp(now_ms)
         return sent
+
+    # -- RTCP relay + SR origination --------------------------------------
+    def src_ts_now(self, now_ms: int) -> int | None:
+        """Source-timeline RTP timestamp corresponding to ``now_ms`` —
+        newest packet's timestamp extrapolated by its age at the stream
+        clock rate (the reference extrapolates from its base arrival the
+        same way, ``RTPSessionOutput.cpp:436-446``)."""
+        ring = self.rtp_ring
+        if len(ring) == 0:
+            return None
+        s = ring.slot(ring.head - 1)
+        age_ms = max(now_ms - int(ring.arrival[s]), 0)
+        rate = self.info.clock_rate or 90000
+        return (int(ring.timestamp[s]) + age_ms * rate // 1000) & 0xFFFFFFFF
+
+    def relay_rtcp(self, now_ms: int) -> None:
+        """Forward the newest pusher RTCP compound (rebased onto each
+        output's timeline) and originate SRs for outputs that have not
+        seen one for ``SR_INTERVAL_MS`` (``RTPStream.cpp:1300`` SR gen —
+        without this, a pusher that sends no RTCP leaves every player
+        with no NTP↔RTP mapping and therefore no A/V sync).
+
+        Wall time for the SR NTP field derives from the relay's monotonic
+        clock: all streams of a session share it, which is the property
+        receivers need for cross-stream sync (and it keeps the scalar
+        and TPU engines byte-identical for differential tests)."""
+        rring = self.rtcp_ring
+        if len(rring) == 0 and now_ms < self._next_sr_due_ms:
+            return                  # hot path: nothing buffered, none due
+        unix_time = now_ms / 1000.0
+        ts_now = self.src_ts_now(now_ms)
+        outputs = self.outputs
+        if len(rring):
+            newest = rring.get(rring.head - 1)
+            has_sr = rtcp_mod.compound_has_sr(newest)
+            for out in outputs:
+                if has_sr and out.rewrite.base_src_ts < 0:
+                    # cannot rebase yet: forwarding the source-timeline
+                    # ntp/rtp pair would poison the client's sync; the
+                    # origination below covers it right after the latch
+                    continue
+                out.write_rtcp(newest, src_ts_now=ts_now,
+                               unix_time=unix_time)
+                if has_sr:
+                    out.last_sr_ms = now_ms
+            rring.tail = rring.head
+        next_due = now_ms + SR_INTERVAL_MS
+        for out in outputs:
+            if out.rewrite.base_src_ts < 0:
+                next_due = now_ms      # re-check every pass until latched
+                continue
+            if ts_now is not None and (
+                    out.last_sr_ms == 0            # 0 = never: first SR
+                    or now_ms - out.last_sr_ms >= SR_INTERVAL_MS):
+                out.last_sr_ms = now_ms
+                sr = rtcp_mod.build_server_compound(
+                    out.rewrite.ssrc, "easydarwin-tpu",
+                    unix_time=unix_time,
+                    rtp_ts=out.rewrite.map_ts(ts_now),
+                    packet_count=out.packets_sent,
+                    octet_count=out.payload_octets)
+                out.send_bytes(sr, is_rtcp=True)
+            next_due = min(next_due, out.last_sr_ms + SR_INTERVAL_MS)
+        self._next_sr_due_ms = next_due
+
+    def send_upstream_rr(self, now_ms: int) -> bool:
+        """Receiver report to the broadcaster every 5 s so pushers see
+        liveness/quality (``ReflectorStream.h:341`` kRRInterval; round 1
+        sent nothing upstream).  Returns True when one was sent."""
+        if (self.upstream_rtcp is None or self._rr_base_seq is None
+                or now_ms - self.last_upstream_rr_ms < SR_INTERVAL_MS):
+            return False
+        self.last_upstream_rr_ms = now_ms
+        ext_max = (self._rr_cycles << 16) | self._rr_max_seq
+        expected = ext_max - self._rr_base_seq + 1
+        lost = max(expected - self._rr_received, 0)
+        d_exp = expected - self._rr_prev_expected
+        d_rcv = self._rr_received - self._rr_prev_received
+        self._rr_prev_expected = expected
+        self._rr_prev_received = self._rr_received
+        frac = 0
+        if d_exp > 0 and d_exp > d_rcv:
+            frac = min(int(((d_exp - d_rcv) << 8) / d_exp), 255)
+        src_ssrc = int(self.rtp_ring.ssrc[
+            self.rtp_ring.slot(self.rtp_ring.head - 1)]) \
+            if len(self.rtp_ring) else 0
+        rr = rtcp_mod.ReceiverReport(
+            0x45445450,  # "EDTP" reporter identity
+            [rtcp_mod.ReportBlock(src_ssrc, frac, lost, ext_max,
+                                  0, 0, 0)]).to_bytes()
+        try:
+            self.upstream_rtcp(rr)
+        except Exception:
+            self.upstream_rtcp = None       # dead transport: stop trying
+            self.upstream_rtcp_owner = None
+        return True
 
     # -- maintenance -------------------------------------------------------
     def prune(self, now_ms: int) -> int:

@@ -208,6 +208,8 @@ class StreamingServer:
                 t = now_ms()
                 for sess in list(self.registry.sessions.values()):
                     sess.prune(t)
+                    for st in sess.streams.values():
+                        st.send_upstream_rr(t)  # 5 s pusher liveness RRs
                 if self.presence is not None:
                     self.presence.set_load(sum(
                         s.num_outputs

@@ -304,11 +304,19 @@ class RtspConnection:
             self.channel_map[ch[1]] = (track_id, True)
             self.pusher_tracks[track_id] = _PusherTrack(track_id)
             resp_t.interleaved = ch
+            # receiver reports ride back on the RTCP channel
+            # (ReflectorStream.h:341 kRRInterval liveness to the pusher)
+            st = self.relay.streams.get(track_id)
+            if st is not None:
+                st.upstream_rtcp = (
+                    lambda d, c=ch[1]: self.send_interleaved(c, d))
+                st.upstream_rtcp_owner = self
         else:
             tid = track_id
             pair = await self.server.udp_pool.allocate(
                 on_rtp=lambda d, a, tid=tid: self._udp_ingest(tid, d, False),
-                on_rtcp=lambda d, a, tid=tid: self._udp_ingest(tid, d, True))
+                on_rtcp=lambda d, a, tid=tid: self._udp_ingest(
+                    tid, d, True, addr=a))
             self.pusher_tracks[track_id] = _PusherTrack(track_id, pair)
             resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
             resp_t.client_port = t.client_port
@@ -534,11 +542,28 @@ class RtspConnection:
         if self.player_tracks and pkt.channel % 2 == 1:
             self.server.on_client_rtcp(self, pkt.data)
 
-    def _udp_ingest(self, track_id: int, data: bytes, is_rtcp: bool) -> None:
+    def send_interleaved(self, channel: int, data: bytes) -> None:
+        """Write one $-framed packet on this connection (server→client)."""
+        if not self.writer.is_closing():
+            self.writer.write(b"$" + bytes([channel])
+                              + len(data).to_bytes(2, "big") + data)
+
+    def _udp_ingest(self, track_id: int, data: bytes, is_rtcp: bool,
+                    addr=None) -> None:
         if self.relay is not None:
             self.relay.push(track_id, data, is_rtcp=is_rtcp)
             self.server.stats["packets_in"] += 1
             self.server.wake_pump()
+            if is_rtcp and addr is not None:
+                # learn the pusher's RTCP address once → upstream RRs
+                st = self.relay.streams.get(track_id)
+                pt = self.pusher_tracks.get(track_id)
+                if (st is not None and st.upstream_rtcp is None
+                        and pt is not None and pt.udp_pair is not None):
+                    tr = pt.udp_pair.rtcp_transport
+                    st.upstream_rtcp = (
+                        lambda d, t=tr, a=addr: t.sendto(d, a))
+                    st.upstream_rtcp_owner = self
 
     # ----------------------------------------------------------- teardown
     def _detach_outputs(self) -> None:
@@ -572,6 +597,13 @@ class RtspConnection:
             if pt.udp_pair:
                 pt.udp_pair.close()
         if self.is_pusher and self.relay is not None:
+            # our upstream-RR closures reference this (dying) connection —
+            # clear them so an adopted session re-learns the new pusher's
+            # RTCP path instead of writing into a closed transport forever
+            for st in self.relay.streams.values():
+                if st.upstream_rtcp_owner is self:
+                    st.upstream_rtcp = None
+                    st.upstream_rtcp_owner = None
             # pusher gone → tear down the relay session (the reference frees
             # the ReflectorSession when the broadcast stops) — but only if
             # still OURS: a re-ANNOUNCE adopts the session (owner re-stamped)

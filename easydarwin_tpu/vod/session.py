@@ -14,6 +14,7 @@ import asyncio
 import os
 import time
 
+from ..protocol import rtp
 from ..relay.output import RelayOutput, WriteResult
 from .mp4 import Mp4Error, Mp4File
 from .packetizer import AacPacketizer, H264Packetizer, sdp_for_file
@@ -93,10 +94,46 @@ class FileSession:
                 best, best_t = tid, t
         return best, best_t
 
+    #: SR cadence (RTPStream.cpp:1300 SR gen per RR interval; round 1's
+    #: VOD path sent no SRs at all → no client A/V sync)
+    SR_INTERVAL_SEC = 5.0
+
+    def _clock_rate(self, tid: int) -> int:
+        from .packetizer import AacPacketizer, RTP_CLOCK_VIDEO
+        p = self._packetizers[tid]
+        if isinstance(p, AacPacketizer):
+            tr = p.track
+            return tr.info.sample_rate or tr.info.timescale or 90000
+        return RTP_CLOCK_VIDEO
+
+    def _maybe_send_srs(self, now: float) -> None:
+        """Originate SR+SDES per track every 5 s: ntp=now, rtp=the media
+        timestamp playing at now (last sent ts extrapolated at the track
+        clock, honoring Speed/Scale)."""
+        from ..protocol import rtcp
+        for tid, (last_ts, last_wall) in list(self._sr_ref.items()):
+            if now - self._last_sr.get(tid, 0.0) < self.SR_INTERVAL_SEC:
+                continue
+            self._last_sr[tid] = now
+            out = self.outputs[tid]
+            rate = self._clock_rate(tid)
+            rtp_now = int(last_ts + (now - last_wall) * rate
+                          * self.speed / self.ts_scale) & 0xFFFFFFFF
+            out.send_bytes(rtcp.build_server_compound(
+                out.rewrite.ssrc, "easydarwin-tpu", unix_time=time.time(),
+                rtp_ts=rtp_now, packet_count=self._sr_pkts.get(tid, 0),
+                octet_count=self._sr_octets.get(tid, 0)), is_rtcp=True)
+
     async def run(self) -> None:
         t0 = time.monotonic() - self.start_npt / self.speed
         self._pending_npt: dict[int, float] = {}
+        #: per track: (rtp_ts of newest sent packet, wall time it was sent)
+        self._sr_ref: dict[int, tuple[int, float]] = {}
+        self._last_sr: dict[int, float] = {}
+        self._sr_pkts: dict[int, int] = {}
+        self._sr_octets: dict[int, int] = {}
         while True:
+            self._maybe_send_srs(time.monotonic())
             tid, npt = self._next_due()
             if tid is None:
                 self.done = True
@@ -112,9 +149,8 @@ class FileSession:
                 data = self.file.read_sample(tr, cur)
                 pkts = self._packetizers[tid].packetize_sample(data, cur)
                 if self.ts_scale != 1.0:
-                    from ..protocol import rtp as rtp_mod
-                    pkts = [rtp_mod.rewrite_header(
-                        p, timestamp=int(rtp_mod.peek_timestamp(p)
+                    pkts = [rtp.rewrite_header(
+                        p, timestamp=int(rtp.peek_timestamp(p)
                                          / self.ts_scale) & 0xFFFFFFFF)
                         for p in pkts]
                 self._pending[tid] = pkts
@@ -122,18 +158,26 @@ class FileSession:
                 self._cursors[tid] = cur + 1
             out = self.outputs[tid]
             q = self._pending[tid]
+            last_sent = None
             while q:
                 res = out.send_bytes(q[0], is_rtcp=False)
                 if res is WriteResult.WOULD_BLOCK:
                     await asyncio.sleep(0.02)      # bookmark: retry same pkt
                     break
-                q.pop(0)
+                pkt = q.pop(0)
                 if res is WriteResult.OK:
                     out.packets_sent += 1
                     self.packets_sent += 1
+                    last_sent = pkt
+                    self._sr_pkts[tid] = self._sr_pkts.get(tid, 0) + 1
+                    self._sr_octets[tid] = (self._sr_octets.get(tid, 0)
+                                            + max(len(pkt) - 12, 0))
                 elif res is WriteResult.ERROR:
                     self.done = True
                     return
+            if last_sent is not None:   # once per sample, not per packet
+                self._sr_ref[tid] = (rtp.peek_timestamp(last_sent),
+                                     time.monotonic())
 
     def start(self) -> None:
         self._task = asyncio.create_task(self.run(), name="vod-session")

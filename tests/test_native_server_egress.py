@@ -188,7 +188,7 @@ def test_poisoned_destination_cannot_starve_other_outputs():
         pytest.skip("native core unavailable")
     from easydarwin_tpu.protocol import sdp
     from easydarwin_tpu.relay.fanout import TpuFanoutEngine
-    from easydarwin_tpu.relay.output import RelayOutput
+    from easydarwin_tpu.relay.output import CollectingOutput
     from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
 
     sdp_txt = ("v=0\r\ns=x\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
@@ -198,9 +198,9 @@ def test_poisoned_destination_cannot_starve_other_outputs():
     rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     rx.bind(("127.0.0.1", 0))
     rx.setblocking(False)
-    bad = RelayOutput(ssrc=1, out_seq_start=10)
+    bad = CollectingOutput(ssrc=1, out_seq_start=10)
     bad.native_addr = ("127.0.0.1", 0)          # sendto(port 0) → EINVAL
-    good = RelayOutput(ssrc=2, out_seq_start=20)
+    good = CollectingOutput(ssrc=2, out_seq_start=20)
     good.native_addr = rx.getsockname()
     st.add_output(bad)
     st.add_output(good)

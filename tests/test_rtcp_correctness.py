@@ -1,0 +1,254 @@
+"""RTCP correctness (VERDICT r1 missing items 1-4).
+
+* relayed SRs are rebased onto each output's timeline (ntp←now,
+  rtp←map_ts(now)) — ``RTPSessionOutput.cpp:403-460`` semantics;
+* the server ORIGINATES SRs on a 5 s cadence when the pusher sends no
+  RTCP, and for VOD playback;
+* receiver reports flow upstream to the pusher every 5 s;
+* scalar oracle and TPU engine emit byte-identical RTCP.
+"""
+
+import asyncio
+import copy
+import struct
+
+import pytest
+
+from easydarwin_tpu.protocol import rtcp, rtp, sdp
+from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+from easydarwin_tpu.relay.output import CollectingOutput
+from easydarwin_tpu.relay.stream import RelayStream, SR_INTERVAL_MS, StreamSettings
+
+VIDEO_SDP = ("v=0\r\ns=x\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+             "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+
+
+def make_pkt(seq, ts, ssrc=0xFEED):
+    return (struct.pack("!BBHII", 0x80, 96, seq, ts, ssrc)
+            + bytes([0x65]) + bytes(30))
+
+
+def make_stream(**kw):
+    return RelayStream(sdp.parse(VIDEO_SDP).streams[0],
+                       StreamSettings(bucket_delay_ms=0, **kw))
+
+
+def pusher_sr(ssrc=0xFEED, ntp=0x11112222_33334444, rtp_ts=50_000):
+    return (rtcp.SenderReport(ssrc, ntp, rtp_ts, 7, 700).to_bytes()
+            + rtcp.Sdes([rtcp.SdesChunk(ssrc, "pusher")]).to_bytes())
+
+
+def find_sr(compound: bytes) -> rtcp.SenderReport:
+    pkts = rtcp.parse_compound(compound)
+    srs = [p for p in pkts if isinstance(p, rtcp.SenderReport)]
+    assert srs, pkts
+    return srs[0]
+
+
+def test_relayed_sr_rebased_to_output_timeline():
+    st = make_stream()
+    out = CollectingOutput(ssrc=0xAA, out_seq_start=100, out_ts_start=5000)
+    st.add_output(out)
+    st.push_rtp(make_pkt(10, 90_000), 1000)
+    st.push_rtp(make_pkt(11, 93_000), 1500)
+    st.push_rtcp(pusher_sr(), 1500)
+    st.reflect(2000)
+    assert out.rtcp_packets
+    sr = find_sr(out.rtcp_packets[0])
+    assert sr.ssrc == 0xAA                      # output SSRC, not pusher's
+    # ntp = "now" on the relay clock (now_ms/1000), not the pusher's ntp
+    assert sr.ntp_ts == rtcp.ntp_now(2000 / 1000.0)
+    # rtp = output-timeline time of now: newest src ts (93000 @1500ms)
+    # extrapolated 500ms at 90kHz, then mapped through the rebase
+    src_ts_now = 93_000 + 500 * 90_000 // 1000
+    assert sr.rtp_ts == out.rewrite.map_ts(src_ts_now)
+    assert sr.packet_count == out.packets_sent
+    # the pusher's SDES stays, SSRC-rewritten
+    sdes = [p for p in rtcp.parse_compound(out.rtcp_packets[0])
+            if isinstance(p, rtcp.Sdes)]
+    assert sdes and sdes[0].chunks[0].ssrc == 0xAA
+
+
+def test_sr_originated_without_pusher_rtcp():
+    st = make_stream()
+    out = CollectingOutput(ssrc=0xBB, out_seq_start=1, out_ts_start=0)
+    st.add_output(out)
+    st.push_rtp(make_pkt(1, 10_000), 1000)
+    st.reflect(1000)
+    assert len(out.rtcp_packets) == 1           # SR originated immediately
+    sr = find_sr(out.rtcp_packets[0])
+    assert sr.ssrc == 0xBB
+    assert sr.rtp_ts == out.rewrite.map_ts(10_000)
+    # cadence: nothing new inside the 5 s window, one more after it
+    st.push_rtp(make_pkt(2, 13_000), 2000)
+    st.reflect(2000)
+    assert len(out.rtcp_packets) == 1
+    st.push_rtp(make_pkt(3, 16_000), 1000 + SR_INTERVAL_MS)
+    st.reflect(1000 + SR_INTERVAL_MS)
+    assert len(out.rtcp_packets) == 2
+
+
+def test_rtcp_byte_identical_scalar_vs_engine():
+    st_cpu = make_stream()
+    for i, ssrc in enumerate((1, 2, 3)):
+        st_cpu.add_output(CollectingOutput(ssrc=ssrc, out_seq_start=10 * i,
+                                           out_ts_start=1000 * i))
+    for i in range(4):
+        st_cpu.push_rtp(make_pkt(50 + i, 90_000 + 3000 * i), 1000 + 10 * i)
+    st_cpu.push_rtcp(pusher_sr(), 1040)
+    st_tpu = copy.deepcopy(st_cpu)
+    st_cpu.reflect(2000)
+    TpuFanoutEngine().step(st_tpu, 2000)
+    for a, b in zip(st_cpu.outputs, st_tpu.outputs):
+        assert a.rtcp_packets == b.rtcp_packets
+        assert a.rtp_packets == b.rtp_packets
+
+
+def test_upstream_rr_to_pusher():
+    st = make_stream()
+    sent = []
+    st.upstream_rtcp = sent.append
+    # seq 100..109 with 110,111 missing then 112: 3 received of 13 expected
+    for seq in (100, 101, 105):
+        st.push_rtp(make_pkt(seq, 1000 * seq), 1000)
+    assert st.send_upstream_rr(SR_INTERVAL_MS + 1)     # first after 5 s
+    assert not st.send_upstream_rr(SR_INTERVAL_MS + 2)  # cadence holds
+    rr = rtcp.parse_compound(sent[0])[0]
+    assert isinstance(rr, rtcp.ReceiverReport)
+    rb = rr.reports[0]
+    assert rb.ssrc == 0xFEED                    # reports on the pusher SSRC
+    assert rb.highest_seq == 105
+    assert rb.cumulative_lost == 3              # 102,103,104
+    assert rb.fraction_lost == int((3 << 8) / 6)
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("tpu", [False, True])
+async def test_player_receives_rebased_srs_e2e(tpu):
+    """A live player gets SRs whose rtp_ts rides the REBASED timeline it
+    observes in its RTP packets — on both the scalar and TPU engines."""
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, bucket_delay_ms=0,
+                       tpu_fanout=tpu, tpu_min_outputs=1,
+                       access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/sr{int(tpu)}"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, VIDEO_SDP)
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        player.enable_any_queue()
+        await player.play_start(uri)            # TCP interleaved
+        for i in range(4):
+            pusher.push_packet(0, make_pkt(500 + i, 90_000 + 3000 * i))
+        rtp_ts = None
+        sr = None
+        for _ in range(200):
+            ch, data = await asyncio.wait_for(player.recv_any(), 5.0)
+            if ch == 0 and len(data) >= 12:
+                rtp_ts = rtp.peek_timestamp(data)
+            elif ch == 1:
+                try:
+                    sr = find_sr(data)
+                except AssertionError:
+                    continue
+                break
+        assert sr is not None and rtp_ts is not None
+        # SR rtp_ts sits on the output's rebased timeline: within a few
+        # seconds (at 90 kHz) of the media timestamps the player received
+        delta = (sr.rtp_ts - rtp_ts) & 0xFFFFFFFF
+        if delta >= 0x80000000:
+            delta -= 0x100000000
+        assert abs(delta) < 3 * 90_000, (sr.rtp_ts, rtp_ts)
+        await player.close()
+        await pusher.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_vod_playback_sends_srs(tmp_path):
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+    from test_vod import write_fixture
+
+    movies = tmp_path / "m"
+    movies.mkdir()
+    write_fixture(str(movies / "clip.mp4"), n_frames=12, with_audio=False)
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0,
+                                       bind_ip="127.0.0.1",
+                                       movie_folder=str(movies),
+                                       access_log_enabled=False))
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/clip.mp4"
+        c = RtspClient()
+        await c.connect("127.0.0.1", app.rtsp.port)
+        c.enable_any_queue()
+        await c.play_start(uri)
+        sr = None
+        last_ts = None
+        for _ in range(300):
+            ch, data = await asyncio.wait_for(c.recv_any(), 5.0)
+            if ch == 0 and len(data) >= 12:
+                last_ts = rtp.peek_timestamp(data)
+            elif ch == 1:
+                try:
+                    sr = find_sr(data)
+                except AssertionError:
+                    continue
+                if last_ts is not None:
+                    break
+        assert sr is not None and last_ts is not None
+        delta = (sr.rtp_ts - last_ts) & 0xFFFFFFFF
+        if delta >= 0x80000000:
+            delta -= 0x100000000
+        assert abs(delta) < 3 * 90_000
+        assert sr.packet_count >= 1
+        await c.teardown(uri)
+        await c.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_pusher_receives_upstream_rrs():
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/upstream"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        pusher.enable_any_queue()
+        await pusher.push_start(uri, VIDEO_SDP)
+        for i in range(3):
+            pusher.push_packet(0, make_pkt(1 + i, 3000 * i))
+        # force the cadence due instead of waiting 5 real seconds
+        st = app.registry.find("/live/upstream").streams[1]
+        st.last_upstream_rr_ms = -SR_INTERVAL_MS
+        rr = None
+        for _ in range(200):
+            ch, data = await asyncio.wait_for(pusher.recv_any(), 5.0)
+            if ch == 1:
+                pkts = rtcp.parse_compound(data)
+                rrs = [p for p in pkts if isinstance(p, rtcp.ReceiverReport)]
+                if rrs:
+                    rr = rrs[0]
+                    break
+        assert rr is not None
+        assert rr.reports[0].ssrc == 0xFEED
+        assert rr.reports[0].highest_seq == 3
+        await pusher.close()
+    finally:
+        await app.stop()
