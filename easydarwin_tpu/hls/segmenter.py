@@ -106,6 +106,13 @@ class HlsOutput(RelayOutput):
 
     def __init__(self, *, target_duration: float = 2.0, window: int = 6):
         super().__init__(ssrc=0x415)
+        # identity rewrite: every rendition of one path keeps the SOURCE
+        # timestamps, so variant timelines (tfdt) stay aligned and ABR
+        # switching between rungs never jumps in presentation time
+        self.rewrite.base_src_seq = 0
+        self.rewrite.base_src_ts = 0
+        self.rewrite.out_seq_start = 0
+        self.rewrite.out_ts_start = 0
         self.target_duration = target_duration
         self.window = window
         self.depack = H264Depacketizer()
@@ -115,6 +122,9 @@ class HlsOutput(RelayOutput):
         self._pending: list[AccessUnit] = []
         self._seg_start_ts: int | None = None
         self._last_ts: int | None = None
+        # rolling bitrate observation for the master playlist
+        self._obs_bytes = 0
+        self._obs_sec = 0.0
 
     def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
         if is_rtcp:
@@ -157,8 +167,10 @@ class HlsOutput(RelayOutput):
             samples.append((au.to_avcc(), dur, au.is_idr))
         total = sum(d for _, d, _ in samples) / VIDEO_CLOCK
         seq = self.media_seq + len(self.segments)
-        self.segments.append(Segment(seq, total,
-                                     _media_segment(seq, base, samples)))
+        seg = Segment(seq, total, _media_segment(seq, base, samples))
+        self.segments.append(seg)
+        self._obs_bytes += len(seg.data)
+        self._obs_sec += total
         self._pending = []
         while len(self.segments) > self.window:
             self.segments.pop(0)
@@ -181,59 +193,189 @@ class HlsOutput(RelayOutput):
                 return s.data
         return None
 
+    def codec_string(self) -> str:
+        """RFC 6381 codec tag from the SPS profile/compat/level bytes."""
+        sps = self.depack.sps
+        if sps and len(sps) >= 4:
+            return f"avc1.{sps[1]:02X}{sps[2]:02X}{sps[3]:02X}"
+        return "avc1.42E01E"
+
+    def observed_bandwidth(self) -> int:
+        """Peak-ish bits/s over the segments produced so far (0 = none)."""
+        if self._obs_sec <= 0:
+            return 0
+        return int(self._obs_bytes * 8 / self._obs_sec)
+
+
+class _HlsEntry:
+    """One published path: the full-rate rendition plus temporal rungs."""
+
+    def __init__(self, sess, track_id: int):
+        self.sess = sess
+        self.track_id = track_id
+        #: rendition name → HlsOutput; "" = source frame rate, "rN" =
+        #: thinning level N (1 = half rate, 2 = keyframes only)
+        self.renditions: dict[str, HlsOutput] = {}
+
+
+#: default temporal ladder for master.m3u8 (frame-granular thinning —
+#: H.264 rungs with NO re-encode: level 1 halves the frame rate, level 2
+#: keeps GOP heads only; level 3 mutes video entirely so it is not a
+#: valid rendition).  Matches the reference's own thinning behavior
+#: (RTPStream.h:144-174): streams whose dropped frames are referenced
+#: show artifacts, exactly as the reference's thinning does.
+DEFAULT_RUNGS = (1, 2)
+MAX_RUNG_LEVEL = 2
+#: BANDWIDTH fallbacks per rendition before any segment is observed
+_NOMINAL_BW = {"": 2_000_000, "r1": 1_200_000, "r2": 400_000}
+
 
 class HlsService:
-    """Manages HlsOutputs per live path + serves playlist/segments."""
+    """Manages per-path HLS entries (full rendition + temporal rungs) and
+    serves master/rendition playlists + segments.
+
+    BASELINE config-5's mux half: one live H.264 push → multi-rendition
+    ``master.m3u8``.  The rungs reuse the relay's frame-granular thinning
+    (``relay.quality.ThinningFilter``) pinned at a fixed level, so every
+    rendition is a valid lower-frame-rate H.264 stream with zero
+    re-encoding (the MJPEG requant ladder is the transcode half; H.264
+    entropy re-coding is a serial-decoder problem with no TPU win)."""
 
     def __init__(self, registry, *, target_duration: float = 2.0,
                  window: int = 6):
         self.registry = registry
         self.target_duration = target_duration
         self.window = window
-        self.outputs: dict[str, tuple[object, int, HlsOutput]] = {}
+        self.outputs: dict[str, _HlsEntry] = {}
 
-    def start(self, path: str) -> HlsOutput:
+    def _rendition(self, entry: _HlsEntry, name: str) -> HlsOutput:
+        out = entry.renditions.get(name)
+        if out is None:
+            out = HlsOutput(target_duration=self.target_duration,
+                            window=self.window)
+            if name:
+                out.thinning.controller.level = int(name[1:])
+            entry.renditions[name] = out
+            entry.sess.add_output(entry.track_id, out)
+        return out
+
+    def _retire(self, key: str, entry: _HlsEntry) -> None:
+        for out in entry.renditions.values():
+            entry.sess.remove_output(entry.track_id, out)
+
+    def _fresh_entry(self, key: str) -> _HlsEntry | None:
+        """Current entry for ``key`` — retiring it first if the source
+        session was replaced (publisher reconnect) so viewers never get a
+        frozen playlist bound to a dead session."""
+        entry = self.outputs.get(key)
+        if entry is not None and self.registry.find(key) is not entry.sess:
+            self.outputs.pop(key)
+            self._retire(key, entry)
+            entry = None
+        return entry
+
+    def start(self, path: str, rungs: tuple[int, ...] = (),
+              *, include_source: bool = True) -> HlsOutput | None:
+        """Publish ``path`` over HLS; returns the full-rate rendition (or
+        None with ``include_source=False``).  ``rungs`` adds temporal
+        renditions (thinning levels 1..MAX_RUNG_LEVEL); out-of-range
+        levels raise ValueError rather than advertising a dead variant."""
         from ..protocol.sdp import _norm
         key = _norm(path)
-        if key in self.outputs:
-            return self.outputs[key][2]
-        sess = self.registry.find(key)
-        if sess is None:
-            raise KeyError(key)
-        vids = [tid for tid, st in sess.streams.items()
-                if st.info.media_type == "video"]
-        if not vids:
-            raise ValueError("no video track")
-        out = HlsOutput(target_duration=self.target_duration,
-                        window=self.window)
-        sess.add_output(vids[0], out)
-        self.outputs[key] = (sess, vids[0], out)
+        levels = [int(r) for r in rungs]
+        if any(not 1 <= r <= MAX_RUNG_LEVEL for r in levels):
+            raise ValueError(f"rung levels must be 1..{MAX_RUNG_LEVEL}")
+        entry = self._fresh_entry(key)
+        if entry is None:
+            sess = self.registry.find(key)
+            if sess is None:
+                raise KeyError(key)
+            vids = [tid for tid, st in sess.streams.items()
+                    if st.info.media_type == "video"]
+            if not vids:
+                raise ValueError("no video track")
+            entry = self.outputs[key] = _HlsEntry(sess, vids[0])
+        out = self._rendition(entry, "") if include_source else None
+        for level in levels:
+            self._rendition(entry, f"r{level}")
         return out
 
     def stop(self, path: str) -> None:
         from ..protocol.sdp import _norm
         key = _norm(path)
-        if key in self.outputs:
-            sess, tid, out = self.outputs.pop(key)
-            sess.remove_output(tid, out)
+        entry = self.outputs.pop(key, None)
+        if entry is not None:
+            self._retire(key, entry)
+
+    def sweep(self) -> int:
+        """Retire entries whose source session is gone or was replaced."""
+        dead = [k for k, e in self.outputs.items()
+                if self.registry.find(k) is not e.sess]
+        for k in dead:
+            self._retire(k, self.outputs.pop(k))
+        return len(dead)
+
+    def list_streams(self) -> list[dict]:
+        return [{
+            "path": key,
+            "renditions": [{
+                "name": name or "source",
+                "uri": (f"{name}/index.m3u8" if name else "index.m3u8"),
+                "segments": len(out.segments),
+                "bandwidth": out.observed_bandwidth(),
+            } for name, out in sorted(entry.renditions.items())],
+        } for key, entry in self.outputs.items()]
+
+    def master_playlist(self, entry: _HlsEntry) -> str:
+        lines = ["#EXTM3U", "#EXT-X-VERSION:7"]
+        for name in sorted(entry.renditions, key=lambda n: (n != "", n)):
+            out = entry.renditions[name]
+            bw = out.observed_bandwidth() or _NOMINAL_BW.get(name, 800_000)
+            lines.append(f"#EXT-X-STREAM-INF:BANDWIDTH={bw},"
+                         f'CODECS="{out.codec_string()}"')
+            lines.append(f"{name}/index.m3u8" if name else "index.m3u8")
+        return "\n".join(lines) + "\n"
 
     def serve(self, url_path: str) -> tuple[str, bytes | str] | None:
-        """Resolve /hls/<stream-path>/<file> → (content_type, body)."""
+        """Resolve ``/hls/<stream-path>[/rN]/<file>`` → (content_type,
+        body).  ``master.m3u8`` auto-starts the default temporal ladder;
+        a rendition playlist auto-starts just that rendition."""
         if not url_path.startswith("/hls/"):
             return None
         rest = url_path[5:]
         if "/" not in rest:
             return None
         stream_path, fname = rest.rsplit("/", 1)
-        key = "/" + stream_path.strip("/")
+        rendition = ""
+        parts = stream_path.rsplit("/", 1)
+        if (len(parts) == 2 and len(parts[1]) == 2
+                and parts[1][0] == "r" and parts[1][1].isdigit()):
+            stream_path, rendition = parts
+        from ..protocol.sdp import _norm
+        key = _norm("/" + stream_path.strip("/"))
+        try:
+            if fname == "master.m3u8":
+                # idempotent: upgrades an existing single-variant entry
+                # to the default ladder too
+                self.start(key, DEFAULT_RUNGS)
+            elif rendition and (self._fresh_entry(key) is None
+                                or rendition not in
+                                self.outputs[key].renditions):
+                self.start(key, (int(rendition[1:]),),
+                           include_source=False)
+            elif self._fresh_entry(key) is None:
+                self.start(key)
+        except (KeyError, ValueError):
+            return None
         entry = self.outputs.get(key)
         if entry is None:
-            try:
-                self.start(key)
-            except (KeyError, ValueError):
-                return None
-            entry = self.outputs[key]
-        out = entry[2]
+            return None
+        if fname == "master.m3u8":
+            return ("application/vnd.apple.mpegurl",
+                    self.master_playlist(entry))
+        out = entry.renditions.get(rendition)
+        if out is None:
+            return None
         if fname in ("index.m3u8", "playlist.m3u8"):
             return ("application/vnd.apple.mpegurl", out.playlist())
         if fname == "init.mp4":

@@ -260,6 +260,7 @@ class StreamingServer:
             self.rtsp.sweep_timeouts()
             self.relay_source.sweep()
             self.transcodes.sweep()
+            self.hls.sweep()
             await self.pulls.sweep()
 
     async def _rtsp_port_http_get(self, conn, target: str,

@@ -283,6 +283,42 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
             "Transcodes": self.app.transcodes.list_ladders()})
 
+    def _cmd_starthls(self, params: dict, body: bytes) -> tuple[int, str]:
+        """Publish a live path over HLS with a temporal rendition ladder
+        (config-5 mux): one call → multi-rendition master.m3u8."""
+        from ..hls.segmenter import DEFAULT_RUNGS
+        from ..protocol.sdp import _norm
+        path = params.get("path", [""])[0]
+        rungs_raw = params.get("rungs", [""])[0]
+        try:
+            rungs = (tuple(int(r) for r in rungs_raw.split(",") if r)
+                     if rungs_raw else DEFAULT_RUNGS)
+            self.app.hls.start(path, rungs)
+        except KeyError:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        except ValueError as e:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               error_string=str(e))
+        key = _norm(path)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Master": f"/hls{key}/master.m3u8",
+            "Renditions": ["index.m3u8"]
+            + [f"r{int(r)}/index.m3u8" for r in rungs]})
+
+    def _cmd_stophls(self, params: dict, body: bytes) -> tuple[int, str]:
+        from ..protocol.sdp import _norm
+        path = params.get("path", [""])[0]
+        key = _norm(path)
+        if key not in self.app.hls.outputs:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        self.app.hls.stop(path)
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={"Hls": key})
+
+    def _cmd_gethlsstreams(self, params: dict,
+                           body: bytes) -> tuple[int, str]:
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Streams": self.app.hls.list_streams()})
+
     def _cmd_admin(self, params: dict, body: bytes) -> tuple[int, str]:
         """Dictionary-tree browse (QTSSAdminModule's /modules/admin API):
         ``?path=server/prefs/*&command=get[&recurse=1]`` or

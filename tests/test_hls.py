@@ -84,6 +84,204 @@ def test_sliding_window_and_media_sequence():
     assert out.get_segment(5) is not None
 
 
+VIDEO_SDP = ("v=0\r\ns=x\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+             "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+
+
+def feed_session(sess, *, n_gops=4, gop_len=10, fps=30, now0=1000):
+    from easydarwin_tpu.protocol import nalu as nalu_mod
+    seq = 0
+    frame = 0
+    for g in range(n_gops):
+        for i in range(gop_len):
+            idr = i == 0
+            ts = int(frame * 90000 / fps)
+            t = now0 + int(frame * 1000 / fps)
+            pkts = []
+            if idr:
+                for cfg in (SPS, PPS):
+                    pkts += nalu_mod.packetize_h264(
+                        cfg, seq=seq, timestamp=ts, ssrc=1,
+                        marker_on_last=False)
+                    seq += 1
+            nal = bytes((0x65 if idr else 0x41,)) + bytes((frame & 0xFF,)) * 300
+            pkts += nalu_mod.packetize_h264(nal, seq=seq, timestamp=ts,
+                                            ssrc=1)
+            seq += 1
+            for p in pkts:
+                sess.push(1, p, t_ms=t)
+            sess.reflect(t)
+            frame += 1
+    return frame
+
+
+def test_hls_temporal_rungs_multi_rendition():
+    """config-5 mux: full + r1 (half fps) + r2 (IDR-only) renditions from
+    ONE ingest, no re-encode; the master playlist lists all three."""
+    from easydarwin_tpu.hls.segmenter import HlsService
+    from easydarwin_tpu.relay.session import SessionRegistry
+
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/cam", VIDEO_SDP)
+    # zero the bucket stagger so a synthetic clock reflects promptly
+    for st in sess.streams.values():
+        st.settings.bucket_delay_ms = 0
+    svc = HlsService(reg, target_duration=0.3)
+    svc.start("/cam", (1, 2))
+    entry = svc.outputs["/cam"]
+    assert set(entry.renditions) == {"", "r1", "r2"}
+    feed_session(sess, n_gops=5, gop_len=10)
+    full, r1, r2 = (entry.renditions[n] for n in ("", "r1", "r2"))
+    assert full.segments and r1.segments and r2.segments
+    # frame counts per segment drop down the ladder
+    def frames_in(out):
+        return sum(struct.unpack_from(
+            ">I", s.data, s.data.find(b"trun") - 4 + 12)[0]
+            for s in out.segments)
+    assert frames_in(full) > frames_in(r1) > frames_in(r2)
+    # r2 carries only sync samples (IDR-only rendition)
+    for s in r2.segments:
+        trun = s.data.find(b"trun") - 4
+        n = struct.unpack_from(">I", s.data, trun + 12)[0]
+        for k in range(n):
+            flags = struct.unpack_from(">I", s.data, trun + 20 + 12 * k + 8)[0]
+            assert flags == 0x02000000
+    master = svc.master_playlist(entry)
+    assert master.count("#EXT-X-STREAM-INF") == 3
+    assert "index.m3u8" in master and "r1/index.m3u8" in master \
+        and "r2/index.m3u8" in master
+    assert 'CODECS="avc1.42001F"' in master
+    svc.stop("/cam")
+    assert sess.num_outputs == 0
+
+
+def test_hls_rendition_timelines_aligned_and_service_hygiene():
+    """Review regressions: (a) all renditions share the SOURCE timeline
+    (aligned tfdt for ABR switching); (b) master.m3u8 upgrades an entry
+    auto-started without rungs; (c) a rendition-only fetch does not
+    attach an unrequested full-rate segmenter; (d) rung 3 (video mute)
+    is rejected; (e) a replaced source session retires the stale entry."""
+    from easydarwin_tpu.hls.segmenter import HlsService
+    from easydarwin_tpu.relay.session import SessionRegistry
+
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/cam", VIDEO_SDP)
+    for st in sess.streams.values():
+        st.settings.bucket_delay_ms = 0
+    svc = HlsService(reg, target_duration=0.3)
+    # (c) rendition-only auto-start
+    assert svc.serve("/hls/cam/r2/index.m3u8") is not None
+    assert set(svc.outputs["/cam"].renditions) == {"r2"}
+    # (b) master upgrades to the full ladder
+    ct, master = svc.serve("/hls/cam/master.m3u8")
+    assert master.count("#EXT-X-STREAM-INF") == 3
+    assert set(svc.outputs["/cam"].renditions) == {"", "r1", "r2"}
+    # (a) aligned timelines: tfdt of each rendition's first segment uses
+    # the same source timestamps
+    feed_session(sess, n_gops=5, gop_len=10)
+    entry = svc.outputs["/cam"]
+    def first_tfdt(out):
+        d = out.segments[0].data
+        off = d.find(b"tfdt") - 4
+        return struct.unpack_from(">Q", d, off + 12)[0]
+    bases = {name: first_tfdt(out) for name, out in entry.renditions.items()
+             if out.segments}
+    assert len(set(bases.values())) == 1, bases
+    # (d) mute level rejected
+    with pytest.raises(ValueError):
+        svc.start("/cam", (3,))
+    # (e) replaced session retires the stale entry on next access
+    reg.remove("/cam")
+    sess2 = reg.find_or_create("/cam", VIDEO_SDP)
+    svc.start("/cam")
+    assert svc.outputs["/cam"].sess is sess2
+    assert sess.num_outputs == 0                # old outputs detached
+
+
+@pytest.mark.asyncio
+async def test_config5_rest_to_master_playlist_16_sources(tmp_path):
+    """BASELINE config 5 shape: 16 live H.264 pushes → one REST call each
+    → multi-rendition master.m3u8 with fetchable rendition media."""
+    import json
+    from easydarwin_tpu.protocol import rtp
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, bucket_delay_ms=0,
+                       log_folder=str(tmp_path))
+    app = StreamingServer(cfg)
+    app.hls.target_duration = 0.2
+    await app.start()
+    try:
+        n_src = 16
+        pushers = []
+        for s in range(n_src):
+            p = RtspClient()
+            await p.connect("127.0.0.1", app.rtsp.port)
+            await p.push_start(
+                f"rtsp://127.0.0.1:{app.rtsp.port}/live/c{s}", VIDEO_SDP)
+            pushers.append(p)
+
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       app.rest.port)
+
+        async def get(path):
+            writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            head = await reader.readuntil(b"\r\n\r\n")
+            clen = int([l for l in head.split(b"\r\n")
+                        if l.lower().startswith(b"content-length")][0]
+                       .split(b":")[1])
+            return (int(head.split(b" ")[1]),
+                    await reader.readexactly(clen))
+
+        for s in range(n_src):                  # ONE REST call per source
+            st, body = await get(f"/api/v1/starthls?path=/live/c{s}")
+            assert st == 200
+            ack = json.loads(body)["EasyDarwin"]["Body"]
+            assert ack["Master"] == f"/hls/live/c{s}/master.m3u8"
+
+        seqs = [0] * n_src
+        for gop in range(3):
+            for i in range(6):
+                for s in range(n_src):
+                    ts = (gop * 6 + i) * 3000
+                    if i == 0:
+                        for cfgn in (SPS, PPS):
+                            pushers[s].push_packet(0, rtp.RtpPacket(
+                                payload_type=96, seq=seqs[s], timestamp=ts,
+                                ssrc=1, payload=cfgn).to_bytes())
+                            seqs[s] += 1
+                    nal = bytes((0x65 if i == 0 else 0x41,)) + bytes(200)
+                    pushers[s].push_packet(0, rtp.RtpPacket(
+                        payload_type=96, seq=seqs[s], timestamp=ts, ssrc=1,
+                        marker=True, payload=nal).to_bytes())
+                    seqs[s] += 1
+                await asyncio.sleep(0.01)
+        await asyncio.sleep(0.2)
+
+        for s in (0, 7, 15):                    # spot-check across sources
+            st, body = await get(f"/hls/live/c{s}/master.m3u8")
+            assert st == 200
+            master = body.decode()
+            assert master.count("#EXT-X-STREAM-INF") == 3
+            st, body = await get(f"/hls/live/c{s}/r2/index.m3u8")
+            assert st == 200 and b"#EXTINF" in body
+            st, body = await get(f"/hls/live/c{s}/r2/init.mp4")
+            assert st == 200 and body[4:8] == b"ftyp"
+            st, body = await get(f"/hls/live/c{s}/r2/seg0.m4s")
+            assert st == 200 and b"moof" in body[:100]
+        st, body = await get("/api/v1/gethlsstreams")
+        assert st == 200
+        streams = json.loads(body)["EasyDarwin"]["Body"]["Streams"]
+        assert len(streams) == n_src
+        writer.close()
+        for p in pushers:
+            await p.close()
+    finally:
+        await app.stop()
+
+
 @pytest.mark.asyncio
 async def test_hls_http_serving_e2e(tmp_path):
     from easydarwin_tpu.protocol import rtp
