@@ -19,6 +19,7 @@ import errno as errno_mod
 
 import numpy as np
 
+from ..ops import device_ring
 from ..ops import fanout as fanout_ops
 from ..ops import parse as parse_ops
 from .output import RelayOutput, WriteResult
@@ -100,6 +101,17 @@ class TpuFanoutEngine:
         self._params = None                 # ([1,S] seq_off, ts_off, ssrc)
         self._dests_key = None
         self._dests = None
+        # HBM-resident GOP ring (SURVEY §5 long-context analogue): the
+        # classification window lives on the device; each pass APPENDS
+        # only the new packets' prefixes (async dispatch, no sync), so
+        # per-pass H2D is O(new packets) instead of O(window) — round 1
+        # re-staged the whole prefix window on every params refresh.
+        self._dring: device_ring.RingState | None = None
+        self._dring_appended = 0            # host pid appended up to
+        self._dring_base = 0                # host pid of device abs id 0
+        self._dring_epoch = 0               # arrival-ms epoch (int32 room)
+        self.h2d_appended_bytes = 0
+        self.h2d_window_equiv_bytes = 0     # what per-pass restaging costs
 
     # -- helpers -----------------------------------------------------------
     def _flat_outputs(self, stream: RelayStream):
@@ -177,9 +189,41 @@ class TpuFanoutEngine:
             self._dests_key = key
         return self._dests
 
-    def _device_params(self, fast, data_window: np.ndarray,
-                       lengths: np.ndarray, start: int):
-        """Affine egress params from the device step.
+    def _ring_sync(self, ring, now_ms: int) -> None:
+        """Append packets the device ring has not seen yet (O(new) H2D,
+        async dispatch — nothing blocks until a params refresh fetches)."""
+        if self._dring is None:
+            self._dring = device_ring.init_ring(ring.capacity)
+            self._dring_appended = self._dring_base = max(
+                ring.tail, ring.head - ring.capacity)
+            self._dring_epoch = now_ms
+        if ring.head - self._dring_appended > ring.capacity:
+            # fell too far behind (burst > capacity): restart the window
+            self._dring = device_ring.init_ring(ring.capacity)
+            self._dring_appended = self._dring_base = \
+                ring.head - ring.capacity
+            self._dring_epoch = now_ms
+        n_new = ring.head - self._dring_appended
+        if n_new <= 0:
+            return
+        ids, data, lengths, _f = ring.window_arrays(self._dring_appended,
+                                                    n_new)
+        b_pad = _pow2(len(ids), 16)
+        prefix = np.zeros((b_pad, self.prefix_width), np.uint8)
+        prefix[:len(ids)] = data[:, :self.prefix_width]
+        length = np.zeros(b_pad, np.int32)
+        length[:len(ids)] = lengths
+        arrival = np.zeros(b_pad, np.int32)
+        arrival[:len(ids)] = (ring.arrival[ids % ring.capacity]
+                              - self._dring_epoch).astype(np.int32)
+        self._dring = device_ring.append(
+            self._dring, prefix, length, arrival, np.int32(len(ids)))
+        self._dring_appended = ring.head
+        self.h2d_appended_bytes += b_pad * (self.prefix_width + 8)
+
+    def _device_params(self, fast, ring, now_ms: int):
+        """Affine egress params from the device step over the RESIDENT
+        window (``ops.device_ring``) — no window re-staging.
 
         The params depend only on per-output rewrite state, not packet
         content, so they are recomputed ONLY when membership or rebase
@@ -193,23 +237,20 @@ class TpuFanoutEngine:
             return self._params
         S = len(fast)
         s_pad = _pow2(S, 8)
-        P = len(lengths)
-        p_pad = _pow2(max(P, 1), 32)
-        prefix = np.zeros((p_pad, 96), np.uint8)
-        prefix[:P] = data_window[:, :96]
-        length = np.zeros(p_pad, np.int32)
-        length[:P] = lengths
-        window = fanout_ops.pack_window(prefix[None], length[None])
-        state = np.zeros((1, s_pad, fanout_ops.STATE_COLS), np.uint32)
-        state[0, :S] = np.asarray(
+        state = np.zeros((s_pad, fanout_ops.STATE_COLS), np.uint32)
+        state[:S] = np.asarray(
             fanout_ops.pack_output_state([o for o, _ in fast]))
-        packed = np.asarray(
-            fanout_ops.relay_affine_step_window(window, state))
-        seq_off, ts_off, ssrc, kf = fanout_ops.unpack_affine(packed, s_pad)
-        self.last_newest_keyframe = start + int(kf[0]) if kf[0] >= 0 else -1
-        self._params = (np.ascontiguousarray(seq_off[:, :S]),
-                        np.ascontiguousarray(ts_off[:, :S]),
-                        np.ascontiguousarray(ssrc[:, :S]))
+        res = device_ring.query(self._dring, state,
+                                np.int32(now_ms - self._dring_epoch))
+        seq_off = np.asarray(res["seq_off"])[None, :S]
+        ts_off = np.asarray(res["ts_off"])[None, :S]
+        ssrc = np.asarray(res["ssrc"])[None, :S]
+        kf_abs = int(res["newest_keyframe_abs"])
+        self.last_newest_keyframe = (self._dring_base + kf_abs
+                                     if kf_abs >= 0 else -1)
+        self._params = (np.ascontiguousarray(seq_off),
+                        np.ascontiguousarray(ts_off),
+                        np.ascontiguousarray(ssrc))
         self._params_key = key
         self.device_param_refreshes += 1
         return self._params
@@ -229,8 +270,9 @@ class TpuFanoutEngine:
         idx = (ids % ring.capacity).astype(np.int32)
         arrivals = ring.arrival[idx]        # nondecreasing (ingest clock)
         valid = lengths >= 12
-        seq_off, ts_off, ssrc = self._device_params(fast, data, lengths,
-                                                    start)
+        self._ring_sync(ring, now_ms)
+        self.h2d_window_equiv_bytes += len(ids) * (self.prefix_width + 8)
+        seq_off, ts_off, ssrc = self._device_params(fast, ring, now_ms)
         # per-output eligible spans (numpy slices, no per-op Python)
         per_out = []                        # (out, hi, pids, slots, lens)
         total = 0
