@@ -206,11 +206,12 @@ class TpuFanoutEngine:
         n_new = ring.head - self._dring_appended
         if n_new <= 0:
             return
-        ids, data, lengths, _f = ring.window_arrays(self._dring_appended,
-                                                    n_new)
+        ids, lengths, _f = ring.window_meta(self._dring_appended, n_new)
         b_pad = _pow2(len(ids), 16)
         prefix = np.zeros((b_pad, self.prefix_width), np.uint8)
-        prefix[:len(ids)] = data[:, :self.prefix_width]
+        # advanced index with a column slice: copies only the prefix bytes
+        prefix[:len(ids)] = ring.data[ids % ring.capacity,
+                                      :self.prefix_width]
         length = np.zeros(b_pad, np.int32)
         length[:len(ids)] = lengths
         arrival = np.zeros(b_pad, np.int32)
@@ -262,11 +263,10 @@ class TpuFanoutEngine:
         ring = stream.rtp_ring
         delay = stream.settings.bucket_delay_ms
         start = min(o.bookmark for o, _ in fast)
-        ids, data, lengths, _flags = ring.window_arrays(
-            start, ring.head - start)
+        ids, lengths, _flags = ring.window_meta(start, ring.head - start)
         if len(ids) == 0:
             return 0
-        start = int(ids[0])                 # window_arrays clamps to tail
+        start = int(ids[0])                 # window_meta clamps to tail
         idx = (ids % ring.capacity).astype(np.int32)
         arrivals = ring.arrival[idx]        # nondecreasing (ingest clock)
         valid = lengths >= 12
@@ -304,12 +304,14 @@ class TpuFanoutEngine:
                 pos += n
         dests = self._dests_for(fast)
         ops = native.ops_from_numpy(ops_np)
+        used_gso = not self._gso_disabled
         r = -1
-        if not self._gso_disabled:
+        if used_gso:
             r = native.fanout_send_multi(
                 self.egress_fd, ring.data, ring.length, seq_off, ts_off,
                 ssrc, dests, ops, total, use_gso=True)
         if r < 0:                           # GSO off/unsupported/failed
+            used_gso = False
             r = native.fanout_send_multi(
                 self.egress_fd, ring.data, ring.length, seq_off, ts_off,
                 ssrc, dests, ops, total, use_gso=False)
@@ -329,6 +331,25 @@ class TpuFanoutEngine:
         elif r < total:
             hard = native.last_send_errno() not in (
                 0, errno_mod.EAGAIN, errno_mod.EWOULDBLOCK)
+            if hard and used_gso:
+                # A partial GSO pass stopped on a hard errno.  On a kernel
+                # without UDP_SEGMENT a single-segment super succeeds while
+                # a later multi-segment one fails EINVAL — that is a GSO
+                # failure, not a poisoned destination (ADVICE r2 medium).
+                # Retry the unsent remainder through plain sendmmsg before
+                # condemning anyone; count the strike either way.
+                self._gso_strikes += 1
+                if self._gso_strikes >= 2:
+                    self._gso_disabled = True
+                rem = ops_np[r:]            # row slice stays C-contiguous
+                r2 = native.fanout_send_multi(
+                    self.egress_fd, ring.data, ring.length, seq_off,
+                    ts_off, ssrc, dests, native.ops_from_numpy(rem),
+                    total - r, use_gso=False)
+                if r2 >= 0:
+                    r += r2
+                    hard = r < total and native.last_send_errno() not in (
+                        0, errno_mod.EAGAIN, errno_mod.EWOULDBLOCK)
         # bookmark/stat accounting, exact under partial (EAGAIN) sends
         taken = 0
         hard_consumed = False

@@ -147,6 +147,20 @@ class PacketRing:
         return range(max(self.tail, start if start is not None else self.tail),
                      self.head)
 
+    def window_meta(self, start: int, count: int):
+        """(ids, length, flags) of up to ``count`` packets from absolute id
+        ``start`` — metadata only, NO payload copy.  The native egress path
+        reads ``self.data`` in place, so handing it the full
+        ``window_arrays`` copy was an O(window × slot) memcpy whose result
+        was discarded (ADVICE r2)."""
+        start = max(start, self.tail)
+        stop = min(start + count, self.head)
+        if stop <= start:
+            z = np.zeros(0, dtype=np.int64)
+            return z, self.length[:0], self.flags[:0]
+        idx = np.arange(start, stop) % self.capacity
+        return np.arange(start, stop), self.length[idx], self.flags[idx]
+
     def window_arrays(self, start: int, count: int):
         """Contiguous view of up to ``count`` packets from absolute id
         ``start`` as (ids, data, length, flags) — rolled so callers (the TPU

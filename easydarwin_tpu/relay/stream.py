@@ -17,6 +17,8 @@ output still needs (bookmark pinning) up to ``max_age_ms``.
 
 from __future__ import annotations
 
+import random
+import time
 from dataclasses import dataclass, field
 
 from ..protocol import rtcp as rtcp_mod
@@ -80,6 +82,15 @@ class RelayStream:
         #: pusher clears only its own closure, never an adopter's
         self.upstream_rtcp_owner = None
         self.last_upstream_rr_ms = 0
+        #: random per-stream reporter identity for upstream RRs — a fixed
+        #: constant collides across tracks/sessions at the pusher and could
+        #: collide with a media SSRC (ADVICE r2)
+        self.reporter_ssrc = random.getrandbits(32)
+        #: wall-clock anchor for RTCP NTP fields: latched on first use so
+        #: SR timestamps advance on the relay's monotonic clock but sit at
+        #: real absolute NTP time (the reference uses wall clock; a
+        #: monotonic-only value lands near the 1970 epoch — ADVICE r2)
+        self._wall_base: float | None = None
         #: earliest moment any output could need an originated SR — lets
         #: the per-step relay_rtcp call early-return without touching the
         #: output list (it is on the fan-out hot path)
@@ -94,6 +105,10 @@ class RelayStream:
 
     # -- ingest ------------------------------------------------------------
     def push_rtp(self, packet: bytes, now_ms: int) -> int:
+        if self._wall_base is None:
+            # latch the RTCP wall anchor at first ingest so engines
+            # stepping a copied stream state share the exact base
+            self._wall_base = time.time() - now_ms / 1000.0
         pid = self.rtp_ring.push(packet, now_ms)
         self.stats.packets_in += 1
         self.stats.bytes_in += len(packet)
@@ -225,14 +240,17 @@ class RelayStream:
         without this, a pusher that sends no RTCP leaves every player
         with no NTP↔RTP mapping and therefore no A/V sync).
 
-        Wall time for the SR NTP field derives from the relay's monotonic
-        clock: all streams of a session share it, which is the property
-        receivers need for cross-stream sync (and it keeps the scalar
-        and TPU engines byte-identical for differential tests)."""
+        SR NTP time = a wall-clock base latched once per stream plus the
+        monotonic delta: intra-session deltas stay monotonic (cross-stream
+        sync works) while absolute times are real NTP wall clock, matching
+        the reference and this repo's VOD path.  Both engines share the
+        stream object, so differential tests stay byte-identical."""
         rring = self.rtcp_ring
         if len(rring) == 0 and now_ms < self._next_sr_due_ms:
             return                  # hot path: nothing buffered, none due
-        unix_time = now_ms / 1000.0
+        if self._wall_base is None:
+            self._wall_base = time.time() - now_ms / 1000.0
+        unix_time = self._wall_base + now_ms / 1000.0
         ts_now = self.src_ts_now(now_ms)
         outputs = self.outputs
         if len(rring):
@@ -290,7 +308,7 @@ class RelayStream:
             self.rtp_ring.slot(self.rtp_ring.head - 1)]) \
             if len(self.rtp_ring) else 0
         rr = rtcp_mod.ReceiverReport(
-            0x45445450,  # "EDTP" reporter identity
+            self.reporter_ssrc,
             [rtcp_mod.ReportBlock(src_ssrc, frac, lost, ext_max,
                                   0, 0, 0)]).to_bytes()
         try:

@@ -140,11 +140,39 @@ class SharedUdpEgress:
             conns = self._by_ip.get(addr[0])
             if not conns:
                 return
-            conn = conns[0] if len(set(map(id, conns))) == 1 else None
+            if len(set(map(id, conns))) == 1:
+                conn = conns[0]
+            else:
+                # several connections behind one IP (NAT): match the RR's
+                # report-block SSRCs against each candidate's output SSRCs
+                # instead of dropping the feedback (ADVICE r2)
+                conn = self._match_by_ssrc(conns, data)
             if conn is None:
                 return
         if self.on_rtcp is not None:
             self.on_rtcp(conn, data)
+
+    @staticmethod
+    def _match_by_ssrc(conns, data: bytes):
+        """The connection whose outputs own an SSRC this compound reports
+        on — None when zero or several match (still ambiguous)."""
+        from ..protocol import rtcp as rtcp_mod
+        try:
+            pkts = rtcp_mod.parse_compound(data)
+        except rtcp_mod.RtcpError:
+            return None
+        reported = {rb.ssrc for p in pkts
+                    if isinstance(p, rtcp_mod.ReceiverReport)
+                    for rb in p.reports}
+        if not reported:
+            return None
+        matches = []
+        for conn in conns:
+            tracks = getattr(conn, "player_tracks", None) or {}
+            owned = {pt.output.rewrite.ssrc for pt in tracks.values()}
+            if owned & reported:
+                matches.append(conn)
+        return matches[0] if len(matches) == 1 else None
 
     # -- scalar sends ------------------------------------------------------
     def send_rtp(self, data: bytes, addr) -> WriteResult:

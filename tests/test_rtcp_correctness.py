@@ -56,8 +56,8 @@ def test_relayed_sr_rebased_to_output_timeline():
     assert out.rtcp_packets
     sr = find_sr(out.rtcp_packets[0])
     assert sr.ssrc == 0xAA                      # output SSRC, not pusher's
-    # ntp = "now" on the relay clock (now_ms/1000), not the pusher's ntp
-    assert sr.ntp_ts == rtcp.ntp_now(2000 / 1000.0)
+    # ntp = "now": wall-clock base + monotonic delta, not the pusher's ntp
+    assert sr.ntp_ts == rtcp.ntp_now(st._wall_base + 2000 / 1000.0)
     # rtp = output-timeline time of now: newest src ts (93000 @1500ms)
     # extrapolated 500ms at 90kHz, then mapped through the rebase
     src_ts_now = 93_000 + 500 * 90_000 // 1000
