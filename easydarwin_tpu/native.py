@@ -215,10 +215,12 @@ def fanout_send_udp_gso(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
 def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
                       seq_off: np.ndarray, ts_off: np.ndarray,
                       ssrc: np.ndarray, dests, ops, n_ops: int,
-                      *, use_gso: bool = True) -> int:
+                      *, use_gso: bool | int = True) -> int:
     """Multi-source egress: ``seq_off``/``ts_off``/``ssrc`` are
     [n_src, n_outs]; ONE C call sends every source's window (the hot loop
-    makes one Python→C transition per pass instead of n_src)."""
+    makes one Python→C transition per pass instead of n_src).
+
+    ``use_gso``: 0/False plain sendmmsg, 1/True UDP_SEGMENT."""
     lib = _load()
     assert lib is not None
     assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
@@ -233,7 +235,7 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
         ring_data.shape[0], ring_data.shape[1],
         _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
-        dests, len(dests), ops, n_ops, 1 if use_gso else 0)
+        dests, len(dests), ops, n_ops, int(use_gso))
 
 
 def last_send_errno() -> int:

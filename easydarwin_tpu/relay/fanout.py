@@ -271,7 +271,12 @@ class TpuFanoutEngine:
         arrivals = ring.arrival[idx]        # nondecreasing (ingest clock)
         valid = lengths >= 12
         self._ring_sync(ring, now_ms)
-        self.h2d_window_equiv_bytes += len(ids) * (self.prefix_width + 8)
+        # counterfactual H2D of a design that re-stages the device's full
+        # classification window every pass (what keeping the window fresh
+        # without a resident ring costs); h2d_appended_bytes is the O(new)
+        # actual.  The ratio is the device-ring saving (VERDICT r2 item 6).
+        live_window = ring.head - max(ring.tail, ring.head - ring.capacity)
+        self.h2d_window_equiv_bytes += live_window * (self.prefix_width + 8)
         seq_off, ts_off, ssrc = self._device_params(fast, ring, now_ms)
         # per-output eligible spans (numpy slices, no per-op Python)
         per_out = []                        # (out, hi, pids, slots, lens)
