@@ -38,6 +38,8 @@ class BandwidthTracker:
         self.rttvar_ms = 0.0
         self.cwnd = float(initial_window)
         self.ssthresh = 64 * 1024.0
+        #: client-advertised ceiling (x-Retransmit window=KB); None = none
+        self.max_cwnd: float | None = None
         self.bytes_in_flight = 0
         self.acks = 0
         self.losses = 0
@@ -70,12 +72,20 @@ class BandwidthTracker:
             self.cwnd += self.MSS                      # slow start
         else:
             self.cwnd += self.MSS * self.MSS / self.cwnd   # avoidance
+        if self.max_cwnd is not None:
+            self.cwnd = min(self.cwnd, self.max_cwnd)
 
     def on_loss(self, nbytes: int) -> None:
         self.bytes_in_flight = max(0, self.bytes_in_flight - nbytes)
         self.losses += 1
         self.ssthresh = max(self.cwnd / 2, 2 * self.MSS)
         self.cwnd = self.ssthresh
+
+    def deflate(self, nbytes: int) -> None:
+        """Remove expired bytes from flight WITHOUT a window backoff —
+        the resender applies one multiplicative decrease per loss sweep
+        (standard congestion response), not one per lost packet."""
+        self.bytes_in_flight = max(0, self.bytes_in_flight - nbytes)
 
 
 # --------------------------------------------------------------- resender
@@ -110,23 +120,29 @@ class PacketResender:
 
     def due_for_resend(self, now_ms: int) -> list[tuple[int, bytes]]:
         """Packets past RTO: returns them for retransmission; drops ones
-        past MAX_RESENDS (loss)."""
+        past MAX_RESENDS (loss).  The whole sweep is ONE congestion event:
+        a burst loss halves the window once, not once per packet (a
+        per-packet decrease collapses a 64 KB window to the 2·MSS floor
+        in a single pump tick)."""
         rto = self.tracker.rto_ms
         out: list[tuple[int, bytes]] = []
+        congested = False
         for seq in list(self.pending):
             p = self.pending[seq]
             if now_ms - p.last_sent_ms < rto * (2 ** p.resends):
                 continue
+            congested = True
             if p.resends >= self.MAX_RESENDS:
                 del self.pending[seq]
                 self.expired += 1
-                self.tracker.on_loss(len(p.data))
+                self.tracker.deflate(len(p.data))
                 continue
             p.resends += 1
             p.last_sent_ms = now_ms
             self.resent += 1
-            self.tracker.on_loss(0)      # window backoff without deflating
             out.append((seq, p.data))
+        if congested:
+            self.tracker.on_loss(0)      # one backoff per sweep
         return out
 
     @property
@@ -187,40 +203,81 @@ def parse_ack(app: App) -> list[int]:
 
 
 # ------------------------------------------------------- output decorator
-class ReliableUdpOutput:
-    """Wraps a RelayOutput with ack/resend bookkeeping.
+from .output import RelayOutput, WriteResult  # noqa: E402
 
-    ``write(packet, now)`` sends through the underlying output when the
-    congestion window allows (else reports WouldBlock, preserving bookmark
-    replay); ``on_rtcp_app`` consumes client acks; ``tick`` retransmits."""
 
-    def __init__(self, inner):
-        from .output import WriteResult
-        self._WriteResult = WriteResult
-        self.inner = inner
+class ReliableUdpOutput(RelayOutput):
+    """PRODUCTION reliable-UDP output: decorates a transport output
+    (shared-egress ``NativeUdpOutput`` or per-connection ``UdpOutput``)
+    with the resend window — the ``RTPStream::ReliableRTPWrite`` path
+    (``RTPStream.cpp:825``) as a ``RelayOutput``:
+
+    * ``send_bytes`` gates data packets on the congestion window
+      (WouldBlock ⇒ the relay keeps the bookmark and replays — exactly the
+      reference's flow-control contract) and records every sent packet,
+      keyed by its OUTPUT sequence number, for retransmission;
+    * ``on_rtcp_app`` consumes client 'qtak'/'ack ' acks from the RTCP
+      demux (``RTCPAckPacket.cpp`` format);
+    * ``tick`` retransmits RTO-expired packets (called from the server
+      pump each pass).
+
+    Engines route it down the batch-header path (no ``native_addr``), so
+    per-packet bookkeeping survives TPU batching.  The rewrite/thinning
+    state is SHARED with the wrapped transport, keeping the device's
+    affine-params view consistent."""
+
+    def __init__(self, transport: RelayOutput, *,
+                 window_kb: int | None = None, clock=None):
+        super().__init__()
+        self.transport = transport
+        self.rewrite = transport.rewrite        # shared rebase state
+        self.thinning = transport.thinning
+        self.meta_field_ids = transport.meta_field_ids
         self.tracker = BandwidthTracker()
+        if window_kb is not None:
+            # client-advertised buffer (x-Retransmit;window=N, in KB):
+            # never grow the send window past what the client can hold
+            # (window=0 clamps to the 2*MSS floor, not to "unlimited")
+            cap = max(int(window_kb) * 1024, 2 * BandwidthTracker.MSS)
+            self.tracker.max_cwnd = float(cap)
+            self.tracker.ssthresh = min(self.tracker.ssthresh, float(cap))
         self.resender = PacketResender(self.tracker)
+        import time as _time
+        self._clock = clock or (lambda: int(_time.monotonic() * 1000))
 
-    def write(self, packet: bytes, now_ms: int):
-        WR = self._WriteResult
-        if not self.tracker.can_send(len(packet)):
-            return WR.WOULD_BLOCK
-        res = self.inner.send_bytes(packet, is_rtcp=False)
-        if res is WR.OK:
-            self.resender.add(rtp.peek_seq(packet), packet, now_ms)
+    @property
+    def rtcp_addr(self):
+        return self.transport.rtcp_addr         # RTCP demux registration
+
+    @property
+    def rtp_addr(self):
+        return self.transport.rtp_addr
+
+    def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
+        if is_rtcp:
+            return self.transport.send_bytes(data, is_rtcp=True)
+        if not self.tracker.can_send(len(data)):
+            return WriteResult.WOULD_BLOCK
+        res = self.transport.send_bytes(data, is_rtcp=False)
+        if res is WriteResult.OK:
+            self.resender.add(rtp.peek_seq(data), data, self._clock())
         return res
 
-    def on_rtcp_app(self, app: App, now_ms: int) -> int:
+    def on_rtcp_app(self, app: App, now_ms: int | None = None) -> int:
+        now = now_ms if now_ms is not None else self._clock()
         n = 0
         for seq in parse_ack(app):
-            if self.resender.ack(seq, now_ms):
+            if self.resender.ack(seq, now):
                 n += 1
         return n
 
-    def tick(self, now_ms: int) -> int:
-        WR = self._WriteResult
+    def tick(self, now_ms: int | None = None) -> int:
+        """Retransmit RTO-expired packets (ungated: retransmits must not
+        starve behind fresh data, matching the reference resender)."""
+        now = now_ms if now_ms is not None else self._clock()
         n = 0
-        for _seq, data in self.resender.due_for_resend(now_ms):
-            if self.inner.send_bytes(data, is_rtcp=False) is WR.OK:
+        for _seq, data in self.resender.due_for_resend(now):
+            if self.transport.send_bytes(data, is_rtcp=False) \
+                    is WriteResult.OK:
                 n += 1
         return n

@@ -73,6 +73,9 @@ class RelayStream:
         self._kf_run_active = False
         self.has_keyframe_update = False     # SetHasVideoKeyFrameUpdate
         self.buckets: list[list[RelayOutput]] = []
+        #: outputs needing per-pass retransmit sweeps (reliable-UDP); kept
+        #: separately so the pump pays nothing when none exist
+        self.tickable_outputs: list[RelayOutput] = []
         self.stats = StreamStats()
         #: upstream RTCP: where receiver reports to the pusher go
         #: (interleaved channel writer or UDP sendto closure); set by the
@@ -142,6 +145,8 @@ class RelayStream:
         """Place in the first bucket with a free slot, growing the bucket
         array as needed (``ReflectorStream::AddOutput`` cpp:280-322)."""
         self._next_sr_due_ms = 0        # new output: SR due immediately
+        if hasattr(output, "tick"):     # reliable-UDP retransmit sweeps
+            self.tickable_outputs.append(output)
         for bucket in self.buckets:
             if len(bucket) < self.settings.bucket_size:
                 bucket.append(output)
@@ -149,6 +154,8 @@ class RelayStream:
         self.buckets.append([output])
 
     def remove_output(self, output: RelayOutput) -> bool:
+        if output in self.tickable_outputs:
+            self.tickable_outputs.remove(output)
         for bucket in self.buckets:
             if output in bucket:
                 bucket.remove(output)

@@ -186,6 +186,10 @@ class StreamingServer:
                         sent += self._engine_for(stream).step(stream, t)
                     else:
                         sent += stream.reflect(t)
+                    for out in stream.tickable_outputs:
+                        # reliable-UDP retransmit sweep (RTO-expired
+                        # packets; RTPPacketResender resend-on-interval)
+                        sent += out.tick(t)
                 except Exception as e:
                     if self.error_log:
                         self.error_log.warning(

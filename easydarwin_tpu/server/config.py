@@ -43,6 +43,9 @@ class ServerConfig:
     # required by the native sendmmsg/GSO fan-out). Falls back to per-client
     # port pairs when off or when the native core is unavailable.
     shared_udp_egress: bool = True
+    # x-Retransmit (reliable UDP) negotiation in SETUP — the reference's
+    # reliable_udp pref (QTSServerPrefs; RTPStream.cpp:448 gate)
+    reliable_udp: bool = True
     # --- cluster (EasyRedisModule / EasyCMS prefs)
     cloud_enabled: bool = False
     redis_host: str = "127.0.0.1"

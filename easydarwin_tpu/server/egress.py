@@ -150,7 +150,7 @@ class SharedUdpEgress:
             if conn is None:
                 return
         if self.on_rtcp is not None:
-            self.on_rtcp(conn, data)
+            self.on_rtcp(conn, data, addr)
 
     @staticmethod
     def _match_by_ssrc(conns, data: bytes):

@@ -337,9 +337,34 @@ class RtspConnection:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
         out, resp_t, pair = await self._make_output(t)
         extra = self._negotiate_meta_info(req, out)
+        out, rel_extra = self._negotiate_retransmit(req, out, t)
+        extra.update(rel_extra)
         self._install_player_track(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {
             "Transport": resp_t.to_header(), **extra}), req.cseq)
+
+    def _negotiate_retransmit(self, req, out, t):
+        """Reliable-UDP negotiation: a UDP SETUP carrying
+        ``x-Retransmit: our-retransmit[;window=KB]`` gets its output
+        wrapped in the resend window and the header echoed back
+        (``RTSPRequest::ParseRetransmitHeader`` RTSPRequest.cpp:530-560;
+        ``RTPStream::SendSetupResponse`` RTPStream.cpp:616 echo).  TCP
+        transports never downgrade (reference: only UDP upgrades)."""
+        hdr = req.headers.get("x-retransmit", "")
+        if (t.is_tcp or not self.server.config.reliable_udp
+                or "our-retransmit" not in hdr.lower()):
+            return out, {}
+        window_kb = None
+        for part in hdr.split(";"):
+            k, _, v = part.partition("=")
+            if k.strip().lower() == "window":
+                try:
+                    window_kb = int(v.strip())
+                except ValueError:
+                    pass
+        from ..relay.reliable import ReliableUdpOutput
+        return (ReliableUdpOutput(out, window_kb=window_kb),
+                {"x-Retransmit": hdr})
 
     def _install_player_track(self, track_id, out, pair) -> None:
         """Land a SETUP'd output, releasing any replaced track's transport
@@ -406,7 +431,7 @@ class RtspConnection:
                 resp_t.server_port = (egress.rtp_port, egress.rtcp_port)
             else:
                 pair = await self.server.udp_pool.allocate(
-                    on_rtcp=lambda d, a: self.server.on_client_rtcp(self, d))
+                    on_rtcp=lambda d, a: self.server.on_client_rtcp(self, d, a))
                 out = UdpOutput(pair.rtp_transport, pair.rtcp_transport,
                                 self.client_ip, t.client_port[0],
                                 t.client_port[1], ssrc=ssrc,
@@ -432,9 +457,10 @@ class RtspConnection:
         if not 1 <= track_id <= n_tracks:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
         out, resp_t, pair = await self._make_output(t)
+        out, rel_extra = self._negotiate_retransmit(req, out, t)
         self._install_player_track(track_id, out, pair)
-        self._reply(rtsp.RtspResponse(200, {"Transport": resp_t.to_header()}),
-                    req.cseq)
+        self._reply(rtsp.RtspResponse(200, {
+            "Transport": resp_t.to_header(), **rel_extra}), req.cseq)
 
     async def _do_record(self, req: rtsp.RtspRequest) -> None:
         if not self.is_pusher or self.relay is None:
@@ -745,9 +771,11 @@ class RtspServer:
             user_agent=conn.user_agent,
             transport="UDP" if any_udp else "TCP"))
 
-    def on_client_rtcp(self, conn: RtspConnection, data: bytes) -> None:
+    def on_client_rtcp(self, conn: RtspConnection, data: bytes,
+                       addr=None) -> None:
         """Receiver reports from players → per-output quality adaptation
-        (the QTSS_RTCPProcess_Role → FlowControlModule pipeline)."""
+        (the QTSS_RTCPProcess_Role → FlowControlModule pipeline), and
+        'qtak' acks → the reliable-UDP resend window."""
         from ..protocol import rtcp as rtcp_mod
         self.stats.setdefault("rtcp_in", 0)
         self.stats["rtcp_in"] += 1
@@ -757,12 +785,40 @@ class RtspServer:
             return
         outputs = {pt.output.rewrite.ssrc: pt.output
                    for pt in conn.player_tracks.values()}
+        # the RTCP source address names the track (each SETUP registers its
+        # own client rtcp port) — required for acks, whose 16-bit seq
+        # spaces collide across tracks (a video ack must never pop an
+        # audio packet from its resend window)
+        addr_out = None
+        if addr is not None:
+            for pt in conn.player_tracks.values():
+                if getattr(pt.output, "rtcp_addr", None) == tuple(addr):
+                    addr_out = pt.output
+                    break
         for p in pkts:
             if isinstance(p, rtcp_mod.ReceiverReport):
                 for rb in p.reports:
                     out = outputs.get(rb.ssrc)
                     if out is not None:
                         out.on_receiver_report(rb.fraction_lost / 256.0)
+            elif isinstance(p, rtcp_mod.App):
+                # RTCPAckPacket → RTPPacketResender::AckPacket path.
+                # Route: exact track by RTCP source addr, else by the
+                # App's SSRC, else (single reliable track only) fall back
+                # to it — never broadcast across colliding seq spaces
+                if addr_out is not None:
+                    targets = [addr_out]
+                elif p.ssrc in outputs:
+                    targets = [outputs[p.ssrc]]
+                else:
+                    targets = [o for o in outputs.values()
+                               if hasattr(o, "on_rtcp_app")]
+                    if len(targets) != 1:
+                        continue
+                for out in targets:
+                    ack_fn = getattr(out, "on_rtcp_app", None)
+                    if ack_fn is not None:
+                        ack_fn(p)
 
     def wake_pump(self) -> None:
         if self._on_pump_wake is not None:

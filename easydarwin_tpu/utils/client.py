@@ -160,12 +160,14 @@ class RtspClient:
 
     # ---------------------------------------------------------- play flow
     async def play_start(self, uri: str, *, tcp: bool = True,
-                         client_ports: list[tuple[int, int]] | None = None
+                         client_ports: list[tuple[int, int]] | None = None,
+                         setup_headers: dict | None = None
                          ) -> sdp.SessionDescription:
         r = await self.request("DESCRIBE", uri, {"accept": "application/sdp"})
         assert r.status == 200, r.status
         sd = sdp.parse(r.body)
         self.transports = []
+        self.setup_responses = []
         for i, st in enumerate(sd.streams):
             if tcp:
                 t = f"RTP/AVP/TCP;unicast;interleaved={2*i}-{2*i+1}"
@@ -173,8 +175,9 @@ class RtspClient:
                 cp = client_ports[i]
                 t = f"RTP/AVP;unicast;client_port={cp[0]}-{cp[1]}"
             r = await self.request("SETUP", f"{uri}/trackID={st.track_id}",
-                                   {"transport": t})
+                                   {"transport": t, **(setup_headers or {})})
             assert r.status == 200, r.status
+            self.setup_responses.append(r)
             self.transports.append(rtsp.TransportSpec.parse(
                 r.headers.get("transport", "RTP/AVP")))
         r = await self.request("PLAY", uri)

@@ -134,6 +134,10 @@ class FileSession:
         self._sr_octets: dict[int, int] = {}
         while True:
             self._maybe_send_srs(time.monotonic())
+            for o in self.outputs.values():
+                tick = getattr(o, "tick", None)
+                if tick is not None:      # reliable-UDP retransmit sweep
+                    tick()
             tid, npt = self._next_due()
             if tid is None:
                 self.done = True

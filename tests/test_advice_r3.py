@@ -172,7 +172,7 @@ def test_shared_ip_rtcp_demux_matches_by_ssrc():
     a, b = _FakeConn(0x111), _FakeConn(0x222)
     eg._by_ip["10.0.0.9"] = [a, b]
     hits = []
-    eg.on_rtcp = lambda conn, data: hits.append(conn)
+    eg.on_rtcp = lambda conn, data, addr=None: hits.append(conn)
     eg._on_rtcp(_rr_for(0x222), ("10.0.0.9", 59999))
     assert hits == [b]
     eg._on_rtcp(_rr_for(0x111), ("10.0.0.9", 58888))

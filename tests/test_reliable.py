@@ -87,26 +87,50 @@ def test_ack_build_parse_roundtrip():
 
 
 def test_reliable_output_end_to_end():
-    inner = CollectingOutput(ssrc=9)
-    rel = ReliableUdpOutput(inner)
-    now = 1000
+    inner = CollectingOutput(ssrc=9, out_seq_start=0)
+    clock = {"t": 1000}
+    rel = ReliableUdpOutput(inner, clock=lambda: clock["t"])
     sent = 0
     blocked = 0
     for i in range(100):
-        res = rel.write(pkt(i, size=1000), now)
+        res = rel.write_rtp(pkt(100 + i, size=1000))
         if res is WriteResult.OK:
             sent += 1
         else:
             blocked += 1
     assert blocked > 0                            # cwnd throttles the burst
     assert rel.tracker.bytes_in_flight > 0
-    # client acks everything sent so far → window opens
-    for i in range(sent):
-        rel.resender.ack(i, now + 50)
+    assert rel.resender.in_flight == sent
+    # client acks everything sent so far (output seqs 0..sent-1) → opens
+    for s in range(sent):
+        rel.resender.ack(s, clock["t"] + 50)
     assert rel.tracker.bytes_in_flight == 0
-    assert rel.write(pkt(500), now + 60) is WriteResult.OK
+    assert rel.write_rtp(pkt(500)) is WriteResult.OK
     # unacked → retransmitted through the inner output on tick
     before = len(inner.rtp_packets)
-    n = rel.tick(now + 60 + int(rel.tracker.rto_ms) + 1)
+    n = rel.tick(clock["t"] + 60 + int(rel.tracker.rto_ms) + 1)
     assert n == 1
     assert len(inner.rtp_packets) == before + 1
+
+
+def test_window_kb_caps_cwnd():
+    inner = CollectingOutput(ssrc=9, out_seq_start=0)
+    rel = ReliableUdpOutput(inner, window_kb=8, clock=lambda: 0)
+    assert rel.tracker.max_cwnd == 8 * 1024
+    for i in range(200):
+        if rel.write_rtp(pkt(i, size=1000)) is WriteResult.OK:
+            rel.resender.ack(i, 10)               # instant acks: cwnd grows
+    assert rel.tracker.cwnd <= 8 * 1024           # never past client window
+
+
+def test_on_rtcp_app_acks_by_output_seq():
+    inner = CollectingOutput(ssrc=9, out_seq_start=40)
+    rel = ReliableUdpOutput(inner, clock=lambda: 100)
+    for i in range(3):
+        assert rel.write_rtp(pkt(700 + i)) is WriteResult.OK
+    assert rel.resender.in_flight == 3
+    # parse the App from its own wire form to mirror the demux path
+    acked = rel.on_rtcp_app(
+        rtcp.parse_compound(build_ack(9, 40, 0x80000000))[0])
+    assert acked == 2                             # seq 40 + mask bit 0 (41)
+    assert rel.resender.in_flight == 1
