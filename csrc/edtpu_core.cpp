@@ -394,8 +394,16 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
     if (n == 0) break;
     for (int i = 0; i < n; ++i) {
       int64_t slot = (*head + i) % capacity;
-      ring_len[slot] = static_cast<int32_t>(msgs[i].msg_len);
+      int32_t len = static_cast<int32_t>(msgs[i].msg_len);
+      if (len > slot_size) len = slot_size;  // kernel-truncated datagram
+      ring_len[slot] = len;
       ring_arrival[slot] = now_ms;
+      // preserve the ring's zero-padded-slot invariant (a reused slot
+      // would otherwise leak its previous occupant's bytes past len into
+      // the device prefix staging)
+      if (len < slot_size)
+        std::memset(ring_data + slot * slot_size + len, 0,
+                    static_cast<size_t>(slot_size - len));
     }
     *head += n;
     total += n;

@@ -149,6 +149,16 @@ class PacketResender:
     def in_flight(self) -> int:
         return len(self.pending)
 
+    def next_deadline_ms(self, now_ms: int) -> int:
+        """ms until the earliest pending packet's RTO fires (0 = due now,
+        -1 = nothing pending) — feeds the server's timer-wheel pacing."""
+        if not self.pending:
+            return -1
+        rto = self.tracker.rto_ms
+        due = min(p.last_sent_ms + rto * (2 ** p.resends)
+                  for p in self.pending.values())
+        return max(int(due - now_ms), 0)
+
 
 # -------------------------------------------------------- overbuffer window
 class OverbufferWindow:

@@ -74,24 +74,13 @@ class PacketRing:
     def valid(self, pkt_id: int) -> bool:
         return self.tail <= pkt_id < self.head
 
-    def push(self, packet: bytes, arrival_ms: int, *,
-             is_rtcp: bool = False) -> int:
-        """Admit one packet; classifies H.264 keyframe boundaries on ingest
-        (the reference classifies in ``ReflectorSocket::ProcessPacket``,
-        ``ReflectorStream.cpp:1869-1934``). Returns the absolute id."""
-        if len(packet) > self.slot_size:
-            packet = packet[:self.slot_size]
-        if len(self) >= self.capacity:
-            self.tail += 1          # overwrite-oldest, like maxQSize trim
-            self.total_dropped += 1
-        pid = self.head
-        s = self.slot(pid)
-        n = len(packet)
-        self.data[s, :n] = np.frombuffer(packet, dtype=np.uint8)
-        if n < self.slot_size:
-            self.data[s, n:] = 0
-        self.length[s] = n
-        self.arrival[s] = arrival_ms
+    def classify_slot(self, s: int, packet: bytes, *,
+                      is_rtcp: bool = False) -> None:
+        """Flags + parsed RTP fields for a just-filled slot — the single
+        definition shared by the Python ``push`` path and the native
+        recvmmsg drain (the reference classifies in
+        ``ReflectorSocket::ProcessPacket``, ``ReflectorStream.cpp:
+        1869-1934``)."""
         f = 0
         if is_rtcp:
             f |= PacketFlags.RTCP
@@ -108,13 +97,58 @@ class PacketRing:
                         f |= PacketFlags.FRAME_FIRST
             if nalu.is_frame_last_packet(packet):
                 f |= PacketFlags.FRAME_LAST
-            if n >= 12:
+            if len(packet) >= 12:
                 self.seq[s] = rtp.peek_seq(packet)
                 self.timestamp[s] = rtp.peek_timestamp(packet)
                 self.ssrc[s] = rtp.peek_ssrc(packet)
         self.flags[s] = f
+
+    def push(self, packet: bytes, arrival_ms: int, *,
+             is_rtcp: bool = False) -> int:
+        """Admit one packet; classifies H.264 keyframe boundaries on
+        ingest. Returns the absolute id."""
+        if len(packet) > self.slot_size:
+            packet = packet[:self.slot_size]
+        if len(self) >= self.capacity:
+            self.tail += 1          # overwrite-oldest, like maxQSize trim
+            self.total_dropped += 1
+        pid = self.head
+        s = self.slot(pid)
+        n = len(packet)
+        self.data[s, :n] = np.frombuffer(packet, dtype=np.uint8)
+        if n < self.slot_size:
+            self.data[s, n:] = 0
+        self.length[s] = n
+        self.arrival[s] = arrival_ms
+        self.classify_slot(s, packet, is_rtcp=is_rtcp)
         self.head = pid + 1
         return pid
+
+    def native_drain(self, fd: int, now_ms: int, max_pkts: int = 512) -> int:
+        """Drain pending datagrams from ``fd`` straight into ring slots via
+        the native recvmmsg batcher (``csrc ed_udp_ingest`` — one syscall
+        per 64-datagram batch, the reference's ``ReflectorSocket::
+        GetIncomingData`` role, ``EventContext.cpp:190-335`` event drain),
+        then classify the new packets.  Returns packets admitted."""
+        from .. import native
+        # never drain more than one ring's worth in a single call so the
+        # overwrite-oldest accounting below stays exact
+        max_pkts = min(max_pkts, self.capacity)
+        n, new_head = native.udp_ingest(
+            fd, self.data, self.length, self.arrival, now_ms, self.head,
+            max_pkts)
+        if n <= 0:
+            return 0
+        for pid in range(self.head, new_head):
+            s = self.slot(pid)
+            self.classify_slot(
+                s, self.data[s, :self.length[s]].tobytes())
+        self.head = new_head
+        if len(self) > self.capacity:       # burst wrapped the ring
+            dropped = len(self) - self.capacity
+            self.tail += dropped
+            self.total_dropped += dropped
+        return n
 
     def get(self, pkt_id: int) -> bytes:
         assert self.valid(pkt_id), pkt_id

@@ -58,14 +58,33 @@ class RelaySession:
         else:
             st.push_rtp(packet, t)
             # audio ↔ video GOP alignment for not-yet-started outputs
-            if st.has_keyframe_update:
-                st.has_keyframe_update = False
-                for other in self.streams.values():
-                    if other is st or other.info.media_type != "audio":
-                        continue
-                    for out in other.outputs:
-                        if out.bookmark is None and len(other.rtp_ring):
-                            out.bookmark = other.rtp_ring.head - 1
+            self._kf_resync(st)
+
+    def _kf_resync(self, st) -> None:
+        if not st.has_keyframe_update:
+            return
+        st.has_keyframe_update = False
+        for other in self.streams.values():
+            if other is st or other.info.media_type != "audio":
+                continue
+            for out in other.outputs:
+                if out.bookmark is None and len(other.rtp_ring):
+                    out.bookmark = other.rtp_ring.head - 1
+
+    def drain_native(self, track_id: int, fd: int,
+                     max_pkts: int = 512) -> int:
+        """Batch-ingest a pusher's RTP socket via the native recvmmsg
+        drain (one syscall per 64 datagrams) with the same housekeeping
+        as per-packet ``push``.  Returns packets admitted."""
+        st = self.streams.get(track_id)
+        if st is None:
+            return 0
+        t = now_ms()
+        n = st.drain_rtp_native(fd, t, max_pkts)
+        if n:
+            self.last_ingest_ms = t
+            self._kf_resync(st)
+        return n
 
     # -- outputs -----------------------------------------------------------
     def add_output(self, track_id: int, output: RelayOutput) -> None:

@@ -76,6 +76,9 @@ class RelayStream:
         #: outputs needing per-pass retransmit sweeps (reliable-UDP); kept
         #: separately so the pump pays nothing when none exist
         self.tickable_outputs: list[RelayOutput] = []
+        #: native recvmmsg ingest counters (amortization evidence)
+        self.native_ingest_batches = 0
+        self.native_ingest_pkts = 0
         self.stats = StreamStats()
         #: upstream RTCP: where receiver reports to the pusher go
         #: (interleaved channel writer or UDP sendto closure); set by the
@@ -107,16 +110,17 @@ class RelayStream:
         self._rr_prev_received = 0
 
     # -- ingest ------------------------------------------------------------
-    def push_rtp(self, packet: bytes, now_ms: int) -> int:
-        if self._wall_base is None:
-            # latch the RTCP wall anchor at first ingest so engines
-            # stepping a copied stream state share the exact base
-            self._wall_base = time.time() - now_ms / 1000.0
-        pid = self.rtp_ring.push(packet, now_ms)
+    def _note_rtp_ingested(self, pid: int) -> None:
+        """Per-packet ingest bookkeeping from ring state: RR reception
+        accounting (RFC 3550 A.3) + keyframe-run bookmark.  Shared by the
+        Python push path and the native recvmmsg drain."""
+        ring = self.rtp_ring
+        s = ring.slot(pid)
+        n = int(ring.length[s])
         self.stats.packets_in += 1
-        self.stats.bytes_in += len(packet)
-        if len(packet) >= 12:
-            seq = int(self.rtp_ring.seq[self.rtp_ring.slot(pid)])
+        self.stats.bytes_in += n
+        if n >= 12:
+            seq = int(ring.seq[s])
             if self._rr_base_seq is None:
                 self._rr_base_seq = seq
                 self._rr_max_seq = seq
@@ -127,7 +131,7 @@ class RelayStream:
                         self._rr_cycles += 1    # wrapped
                     self._rr_max_seq = seq
             self._rr_received += 1
-        if self.rtp_ring.get_flags(pid) & PacketFlags.KEYFRAME_FIRST:
+        if int(ring.flags[s]) & PacketFlags.KEYFRAME_FIRST:
             if not self._kf_run_active:
                 self.keyframe_id = pid
                 self.has_keyframe_update = True
@@ -135,7 +139,32 @@ class RelayStream:
                 self._kf_run_active = True
         else:
             self._kf_run_active = False
+
+    def push_rtp(self, packet: bytes, now_ms: int) -> int:
+        if self._wall_base is None:
+            # latch the RTCP wall anchor at first ingest so engines
+            # stepping a copied stream state share the exact base
+            self._wall_base = time.time() - now_ms / 1000.0
+        pid = self.rtp_ring.push(packet, now_ms)
+        self._note_rtp_ingested(pid)
         return pid
+
+    def drain_rtp_native(self, fd: int, now_ms: int,
+                         max_pkts: int = 512) -> int:
+        """Batch-drain a pusher's RTP socket straight into the ring
+        (recvmmsg, no per-datagram Python callback), then run the same
+        per-packet bookkeeping the push path does.  Returns packets
+        admitted this call."""
+        if self._wall_base is None:
+            self._wall_base = time.time() - now_ms / 1000.0
+        pre = self.rtp_ring.head
+        n = self.rtp_ring.native_drain(fd, now_ms, max_pkts)
+        for pid in range(pre, self.rtp_ring.head):
+            self._note_rtp_ingested(pid)
+        if n > 0:
+            self.native_ingest_batches += 1
+            self.native_ingest_pkts += n
+        return n
 
     def push_rtcp(self, packet: bytes, now_ms: int) -> int:
         return self.rtcp_ring.push(packet, now_ms, is_rtcp=True)
@@ -324,6 +353,38 @@ class RelayStream:
             self.upstream_rtcp = None       # dead transport: stop trying
             self.upstream_rtcp_owner = None
         return True
+
+    def next_deadline_ms(self, now_ms: int) -> int:
+        """ms until this stream next needs a pump pass without new ingest:
+        the earliest FUTURE bucket-delay release among held-back packets,
+        or the earliest future reliable-UDP RTO.  -1 = nothing scheduled.
+        Feeds the 1 ms timer wheel that paces the pump (vs the
+        reference's 10 ms scheduler floor, ``Task.cpp:334``).
+
+        Already-due work is never reported: a packet that is eligible but
+        unsent is WOULD_BLOCK-stalled, and a time-based wake cannot make a
+        blocked socket writable — re-arming a 0 ms timer would spin the
+        pump at ~1 kHz until the client drains."""
+        best = -1
+        ring = self.rtp_ring
+        delay = self.settings.bucket_delay_ms
+        for b_idx, bucket in enumerate(self.buckets):
+            if b_idx == 0:
+                continue               # bucket 0 has no stagger delay
+            for out in bucket:
+                bm = out.bookmark
+                if bm is None or bm >= ring.head:
+                    continue
+                if bm < ring.tail:
+                    bm = ring.tail
+                d = int(ring.arrival[ring.slot(bm)]) + b_idx * delay - now_ms
+                if d > 0 and (best < 0 or d < best):
+                    best = d
+        for out in self.tickable_outputs:
+            d = out.resender.next_deadline_ms(now_ms)
+            if d > 0 and (best < 0 or d < best):
+                best = d
+        return best
 
     # -- maintenance -------------------------------------------------------
     def prune(self, now_ms: int) -> int:

@@ -196,16 +196,57 @@ class StreamingServer:
                             f"reflect error on {sess.path}: {e!r}")
         return sent
 
+    def _make_pump_wheel(self):
+        """1 ms native timer wheel pacing the pump below the fixed tick
+        (``csrc ed_wheel``; the reference's scheduler has a 10 ms floor,
+        ``Task.cpp:334-335``).  Streams post their earliest bucket-delay
+        release / reliable-UDP RTO here; the pump sleeps until the wheel's
+        next deadline instead of a full reflect interval."""
+        from .. import native
+        if not native.available():
+            return None
+        try:
+            return native.TimerWheel(now_ms())
+        except RuntimeError:
+            return None
+
+    def _schedule_stream_deadlines(self, wheel, t: int) -> None:
+        for sess in self.registry.sessions.values():
+            for stream in sess.streams.values():
+                d = stream.next_deadline_ms(t)
+                key = id(stream)
+                cur = self._wheel_sched.get(key)
+                if d < 0:
+                    continue
+                due = t + d
+                if cur is not None and cur[1] <= due and cur[1] >= t:
+                    continue            # an earlier-or-equal timer pends
+                if cur is not None:
+                    wheel.cancel(cur[0])
+                self._wheel_sched[key] = (wheel.schedule(d, key), due)
+
     async def _pump_loop(self) -> None:
         interval = self.config.reflect_interval_ms / 1000.0
         last_prune = 0.0
+        wheel = self._make_pump_wheel()
+        self._wheel_sched: dict[int, tuple[int, int]] = {}
         while self._running:
+            timeout = interval
+            if wheel is not None and wheel.pending:
+                nd = wheel.next_deadline(now_ms())
+                if nd >= 0:
+                    timeout = min(interval, max(nd, 1) / 1000.0)
             try:
-                await asyncio.wait_for(self._pump_event.wait(), interval)
+                await asyncio.wait_for(self._pump_event.wait(), timeout)
             except asyncio.TimeoutError:
                 pass
             self._pump_event.clear()
+            if wheel is not None:
+                for key in wheel.advance(now_ms()):
+                    self._wheel_sched.pop(key, None)
             self._reflect_all()
+            if wheel is not None:
+                self._schedule_stream_deadlines(wheel, now_ms())
             now = time.monotonic()
             if now - last_prune >= 1.0:
                 last_prune = now

@@ -46,6 +46,10 @@ class ServerConfig:
     # x-Retransmit (reliable UDP) negotiation in SETUP — the reference's
     # reliable_udp pref (QTSServerPrefs; RTPStream.cpp:448 gate)
     reliable_udp: bool = True
+    # UDP push ingest via the native recvmmsg ring drain (one syscall per
+    # 64 datagrams) instead of per-datagram asyncio callbacks; falls back
+    # automatically when the native core is unavailable
+    native_ingest: bool = True
     # --- cluster (EasyRedisModule / EasyCMS prefs)
     cloud_enabled: bool = False
     redis_host: str = "127.0.0.1"

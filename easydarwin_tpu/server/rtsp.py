@@ -313,10 +313,21 @@ class RtspConnection:
                 st.upstream_rtcp_owner = self
         else:
             tid = track_id
-            pair = await self.server.udp_pool.allocate(
-                on_rtp=lambda d, a, tid=tid: self._udp_ingest(tid, d, False),
-                on_rtcp=lambda d, a, tid=tid: self._udp_ingest(
-                    tid, d, True, addr=a))
+            from .. import native
+            if self.server.config.native_ingest and native.available():
+                # recvmmsg batch drain straight into the ring — no
+                # per-datagram Python on the push ingest path
+                pair = await self.server.udp_pool.allocate_native(
+                    on_readable=lambda fd, tid=tid:
+                        self._native_rtp_drain(tid, fd),
+                    on_rtcp=lambda d, a, tid=tid: self._udp_ingest(
+                        tid, d, True, addr=a))
+            else:
+                pair = await self.server.udp_pool.allocate(
+                    on_rtp=lambda d, a, tid=tid: self._udp_ingest(
+                        tid, d, False),
+                    on_rtcp=lambda d, a, tid=tid: self._udp_ingest(
+                        tid, d, True, addr=a))
             self.pusher_tracks[track_id] = _PusherTrack(track_id, pair)
             resp_t.server_port = (pair.rtp_port, pair.rtcp_port)
             resp_t.client_port = t.client_port
@@ -573,6 +584,17 @@ class RtspConnection:
         if not self.writer.is_closing():
             self.writer.write(b"$" + bytes([channel])
                               + len(data).to_bytes(2, "big") + data)
+
+    def _native_rtp_drain(self, track_id: int, fd: int) -> None:
+        """Readiness-edge callback for a pusher's native-ingest RTP
+        socket: one call drains the whole pending batch into the ring."""
+        if self.relay is None:
+            return
+        n = self.relay.drain_native(track_id, fd)
+        if n:
+            self.last_activity = time.monotonic()
+            self.server.stats["packets_in"] += n
+            self.server.wake_pump()
 
     def _udp_ingest(self, track_id: int, data: bytes, is_rtcp: bool,
                     addr=None) -> None:

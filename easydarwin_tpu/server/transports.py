@@ -122,6 +122,37 @@ class UdpPair:
                 t.close()
 
 
+class NativeIngestPair(UdpPair):
+    """A ``UdpPair`` whose RTP side is a plain non-blocking socket drained
+    by a readiness callback in native recvmmsg batches — the reference's
+    event-drain role (``EventContext.cpp:190-335`` →
+    ``ReflectorSocket::GetIncomingData``) with one syscall per 64
+    datagrams instead of one asyncio callback per datagram."""
+
+    def __init__(self, rtp_sock, rtcp_transport, rtcp_proto, rtp_port: int,
+                 loop, on_readable):
+        self.rtp_sock = rtp_sock
+        self.rtp_transport = None
+        self.rtp_proto = None
+        self.rtcp_transport = rtcp_transport
+        self.rtcp_proto = rtcp_proto
+        self.rtp_port = rtp_port
+        self._loop = loop
+        self._fd = rtp_sock.fileno()
+        loop.add_reader(self._fd, on_readable, self._fd)
+
+    def close(self) -> None:
+        if self.rtp_sock is not None:
+            try:
+                self._loop.remove_reader(self._fd)
+            except Exception:
+                pass
+            self.rtp_sock.close()
+            self.rtp_sock = None
+        if self.rtcp_transport and not self.rtcp_transport.is_closing():
+            self.rtcp_transport.close()
+
+
 class UdpPortPool:
     """Allocates even/odd UDP port pairs (``UDPSocketPool`` equivalent)."""
 
@@ -132,7 +163,11 @@ class UdpPortPool:
         self.max_pairs = max_pairs
         self._next = base_port
 
-    async def allocate(self, on_rtp=None, on_rtcp=None) -> UdpPair:
+    async def _scan(self, make_rtp, on_rtcp):
+        """Shared even/odd port scan: ``make_rtp(loop, port)`` returns
+        ``(rtp_obj, close_fn)`` or raises OSError; the odd RTCP endpoint
+        binds second with rollback.  Returns (rtp_obj, rtcp_t, rtcp_p,
+        port)."""
         loop = asyncio.get_running_loop()
         last_err: Exception | None = None
         for _ in range(self.max_pairs):
@@ -141,19 +176,52 @@ class UdpPortPool:
             if self._next >= self.base_port + 2 * self.max_pairs:
                 self._next = self.base_port
             try:
-                rtp_t, rtp_p = await loop.create_datagram_endpoint(
-                    lambda: _DatagramSink(on_rtp),
-                    local_addr=(self.bind_ip, port))
-                try:
-                    rtcp_t, rtcp_p = await loop.create_datagram_endpoint(
-                        lambda: _DatagramSink(on_rtcp),
-                        local_addr=(self.bind_ip, port + 1))
-                except OSError as e:
-                    rtp_t.close()
-                    last_err = e
-                    continue
-                return UdpPair(rtp_t, rtp_p, rtcp_t, rtcp_p, port)
+                rtp_obj, rtp_close = await make_rtp(loop, port)
             except OSError as e:
                 last_err = e
                 continue
+            try:
+                rtcp_t, rtcp_p = await loop.create_datagram_endpoint(
+                    lambda: _DatagramSink(on_rtcp),
+                    local_addr=(self.bind_ip, port + 1))
+            except OSError as e:
+                rtp_close()
+                last_err = e
+                continue
+            return rtp_obj, rtcp_t, rtcp_p, port
         raise OSError(f"no free UDP port pairs: {last_err}")
+
+    async def allocate(self, on_rtp=None, on_rtcp=None) -> UdpPair:
+        async def make_rtp(loop, port):
+            rtp_t, rtp_p = await loop.create_datagram_endpoint(
+                lambda: _DatagramSink(on_rtp),
+                local_addr=(self.bind_ip, port))
+            return (rtp_t, rtp_p), rtp_t.close
+
+        (rtp_t, rtp_p), rtcp_t, rtcp_p, port = await self._scan(make_rtp,
+                                                                on_rtcp)
+        return UdpPair(rtp_t, rtp_p, rtcp_t, rtcp_p, port)
+
+    async def allocate_native(self, on_readable, on_rtcp=None
+                              ) -> NativeIngestPair:
+        """Pair whose RTP socket feeds the native recvmmsg drain:
+        ``on_readable(fd)`` runs once per readiness edge and drains a
+        whole batch, instead of one asyncio callback per datagram."""
+        import socket as socket_mod
+
+        async def make_rtp(loop, port):
+            s = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+            s.setblocking(False)
+            try:
+                s.bind((self.bind_ip, port))
+                s.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF,
+                             1 << 21)
+            except OSError:
+                s.close()
+                raise
+            return s, s.close
+
+        rtp_sock, rtcp_t, rtcp_p, port = await self._scan(make_rtp, on_rtcp)
+        loop = asyncio.get_running_loop()
+        return NativeIngestPair(rtp_sock, rtcp_t, rtcp_p, port, loop,
+                                on_readable)

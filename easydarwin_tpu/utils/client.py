@@ -145,12 +145,15 @@ class RtspClient:
             "content-type": "application/sdp"}, sdp_text.encode())
         assert r.status == 200, r.status
         sd = sdp.parse(sdp_text)
+        self.push_transports = []
         for i, st in enumerate(sd.streams):
             t = (f"RTP/AVP/TCP;unicast;interleaved={2*i}-{2*i+1};mode=record"
                  if tcp else "RTP/AVP;unicast;client_port=0-1;mode=record")
             r = await self.request("SETUP", f"{uri}/trackID={st.track_id}",
                                    {"transport": t})
             assert r.status == 200, r.status
+            self.push_transports.append(rtsp.TransportSpec.parse(
+                r.headers.get("transport", "RTP/AVP")))
         r = await self.request("RECORD", uri)
         assert r.status == 200, r.status
 

@@ -1,0 +1,149 @@
+"""Native recvmmsg push ingest + timer-wheel pump pacing (VERDICT r2
+item 5, second ask): UDP push tracks drain via ``ed_udp_ingest`` straight
+into the ring — syscalls amortized over ~64-datagram batches, no
+per-datagram Python — and held-back packets release on the 1 ms wheel,
+not the coarse reflect tick."""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from easydarwin_tpu import native
+from easydarwin_tpu.protocol import sdp
+from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.utils.client import RtspClient
+
+H264_SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=live\r\nt=0 0\r\n"
+            "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+            "a=control:trackID=1\r\n")
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core unavailable")
+
+
+def vid_pkt(seq, ts=0, nal_type=1, size=120):
+    return (struct.pack("!BBHII", 0x80, 96, seq & 0xFFFF, ts & 0xFFFFFFFF,
+                        0x77) + bytes([(3 << 5) | nal_type])
+            + bytes(size - 13))
+
+
+def test_ring_native_drain_matches_push_classification():
+    """Differential: draining bytes through recvmmsg produces the same
+    ring state (flags, seq/ts/ssrc, keyframe bookmarks) as push_rtp."""
+    sd = sdp.parse(H264_SDP)
+    st_a = RelayStream(sd.streams[0], StreamSettings())
+    st_b = RelayStream(sd.streams[0], StreamSettings())
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.setblocking(False)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    pkts = [vid_pkt(100 + i, 3000 * i, nal_type=5 if i % 7 == 0 else 1)
+            for i in range(150)]
+    for p in pkts:
+        tx.sendto(p, rx.getsockname())
+        st_b.push_rtp(p, 1000)
+    n = st_a.drain_rtp_native(rx.fileno(), 1000)
+    assert n == len(pkts)
+    ra, rb = st_a.rtp_ring, st_b.rtp_ring
+    assert ra.head == rb.head
+    import numpy as np
+    np.testing.assert_array_equal(ra.flags[:n], rb.flags[:n])
+    np.testing.assert_array_equal(ra.seq[:n], rb.seq[:n])
+    np.testing.assert_array_equal(ra.timestamp[:n], rb.timestamp[:n])
+    np.testing.assert_array_equal(ra.length[:n], rb.length[:n])
+    for i in range(n):
+        assert ra.get(i) == rb.get(i)
+    assert st_a.keyframe_id == st_b.keyframe_id
+    assert st_a.stats.keyframes == st_b.stats.keyframes
+    assert st_a._rr_max_seq == st_b._rr_max_seq
+    # amortization: one drain call admitted the whole burst
+    assert st_a.native_ingest_batches == 1
+    assert st_a.native_ingest_pkts == len(pkts)
+    tx.close()
+    rx.close()
+
+
+@pytest.mark.asyncio
+async def test_udp_push_uses_native_drain_e2e():
+    """A real UDP pusher's datagrams reach players through the batch
+    drain: syscalls amortized (pkts >> drain calls), relay bit-exact."""
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, bucket_delay_ms=0,
+                       access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/ni"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, H264_SDP, tcp=False)
+        srv_rtp = pusher.push_transports[0].server_port[0]
+
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        await player.play_start(uri)            # interleaved player
+
+        tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        n = 200
+        pkts = [vid_pkt(500 + i, 3000 * i, nal_type=5 if i == 0 else 1)
+                for i in range(n)]
+        # blast the burst without yielding: the single readiness callback
+        # must drain it in recvmmsg batches, not packet-by-packet
+        for p in pkts:
+            tx.sendto(p, ("127.0.0.1", srv_rtp))
+        got = []
+        for _ in range(n):
+            got.append(await player.recv_interleaved(0, timeout=5.0))
+        for g, p in zip(got[:n], pkts):
+            assert g[12:] == p[12:]             # payload bit-exact
+
+        st = app.registry.find("/live/ni").streams[1]
+        assert st.native_ingest_pkts >= n
+        # the amortization claim: far fewer drain calls than packets
+        assert st.native_ingest_pkts / max(st.native_ingest_batches, 1) >= 32
+        tx.close()
+        await player.close()
+        await pusher.close()
+    finally:
+        await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_wheel_releases_bucket_delayed_packets_before_tick():
+    """With a 500 ms reflect tick, a second-bucket output's stagger (60 ms)
+    must still release on time — the 1 ms wheel schedules the deadline
+    (without it the packet waits for the next full tick)."""
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=500, bucket_delay_ms=60,
+                       bucket_size=1, access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/wheel"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, H264_SDP)
+
+        players = []
+        for _ in range(2):                      # bucket 0 and bucket 1
+            c = RtspClient()
+            await c.connect("127.0.0.1", app.rtsp.port)
+            await c.play_start(uri)
+            players.append(c)
+
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        pusher.push_packet(0, vid_pkt(1, 0, nal_type=5))
+        await players[0].recv_interleaved(0, timeout=2.0)
+        await players[1].recv_interleaved(0, timeout=2.0)
+        elapsed = loop.time() - t0
+        # bucket 1's release rides the wheel: well inside the 500 ms tick
+        assert elapsed < 0.4, elapsed
+        for c in players:
+            await c.close()
+        await pusher.close()
+    finally:
+        await app.stop()
