@@ -389,7 +389,10 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
     if (n < 0) {
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-      return -errno;
+      // hard error after earlier successful batches: those datagrams are
+      // already consumed from the socket — report them so the caller
+      // commits the ring head instead of silently losing them
+      return total > 0 ? total : -errno;
     }
     if (n == 0) break;
     for (int i = 0; i < n; ++i) {

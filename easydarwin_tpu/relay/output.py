@@ -74,6 +74,12 @@ class RelayOutput:
         """RTCP RR feedback → quality level (FlowControl role input)."""
         return self.thinning.controller.on_receiver_report(fraction_lost)
 
+    def on_nadu(self, playout_delay_ms: int, free_buffer_64b: int) -> int:
+        """3GPP NADU buffer feedback → quality level (the reference parses
+        NADU but never adapts; ``RTCPAPPNADUPacket.cpp``)."""
+        return self.thinning.controller.on_nadu(playout_delay_ms,
+                                                free_buffer_64b)
+
     # -- transport ---------------------------------------------------------
     def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
         raise NotImplementedError

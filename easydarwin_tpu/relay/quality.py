@@ -36,6 +36,15 @@ NUM_LOSSES_TO_THIN = 3      # ...consecutive reports above SLOW → thin
 LOSS_THICK_BELOW = 0.03     # reports below this...
 NUM_CLEAN_TO_THICK = 6      # ...this many times → thicken one level
 
+# 3GPP NADU (TS 26.234) buffer-state thresholds.  The reference parses
+# NADU (RTPStream::ProcessNADUPacket) but never feeds it to flow control;
+# here the receiver's buffer state drives the same hysteresis as loss:
+NADU_DELAY_UNKNOWN = 0xFFFF
+NADU_UNDERRUN_NOW_MS = 40    # playout delay below this → thin immediately
+NADU_DELAY_LOW_MS = 150      # below this repeatedly → thin (underrun risk)
+NADU_DELAY_COMFY_MS = 1000   # above this (with free space) → clean report
+NADU_FREE_LOW_64B = 24       # < 1.5 KB free receiver buffer → back off
+
 
 @dataclass
 class QualityController:
@@ -65,6 +74,36 @@ class QualityController:
                 self._clean_reports = 0
         else:
             self._lossy_reports = self._clean_reports = 0
+        return self.level
+
+    def on_nadu(self, playout_delay_ms: int, free_buffer_64b: int) -> int:
+        """Feed one 3GPP NADU block's buffer state; returns the new level.
+
+        A receiver about to underrun (tiny playout delay) or to overflow
+        (no free buffer space) gets the lossy-report treatment — one
+        extreme report thins immediately, sustained low buffer thins via
+        the same hysteresis counters as loss; a deep comfortable buffer
+        counts as a clean report toward thickening.  (Delay 0xFFFF means
+        "not known" and contributes nothing.)"""
+        delay_known = playout_delay_ms != NADU_DELAY_UNKNOWN
+        if (delay_known and playout_delay_ms <= NADU_UNDERRUN_NOW_MS) \
+                or free_buffer_64b == 0:
+            self._bump(+1)
+            self._lossy_reports = self._clean_reports = 0
+            return self.level
+        if (delay_known and playout_delay_ms < NADU_DELAY_LOW_MS) \
+                or free_buffer_64b < NADU_FREE_LOW_64B:
+            self._lossy_reports += 1
+            self._clean_reports = 0
+            if self._lossy_reports >= NUM_LOSSES_TO_THIN:
+                self._bump(+1)
+                self._lossy_reports = 0
+        elif delay_known and playout_delay_ms >= NADU_DELAY_COMFY_MS:
+            self._clean_reports += 1
+            self._lossy_reports = 0
+            if self._clean_reports >= NUM_CLEAN_TO_THICK:
+                self._bump(-1)
+                self._clean_reports = 0
         return self.level
 
     def _bump(self, d: int) -> None:

@@ -354,17 +354,22 @@ class RelayStream:
             self.upstream_rtcp_owner = None
         return True
 
-    def next_deadline_ms(self, now_ms: int) -> int:
+    def next_deadline_ms(self, now_ms: int, *, allow_due: bool = False
+                         ) -> int:
         """ms until this stream next needs a pump pass without new ingest:
-        the earliest FUTURE bucket-delay release among held-back packets,
-        or the earliest future reliable-UDP RTO.  -1 = nothing scheduled.
-        Feeds the 1 ms timer wheel that paces the pump (vs the
-        reference's 10 ms scheduler floor, ``Task.cpp:334``).
+        the earliest bucket-delay release among held-back packets, or the
+        earliest future reliable-UDP RTO.  -1 = nothing scheduled.  Feeds
+        the 1 ms timer wheel that paces the pump (vs the reference's
+        10 ms scheduler floor, ``Task.cpp:334``).
 
-        Already-due work is never reported: a packet that is eligible but
-        unsent is WOULD_BLOCK-stalled, and a time-based wake cannot make a
-        blocked socket writable — re-arming a 0 ms timer would spin the
-        pump at ~1 kHz until the client drains."""
+        ``allow_due`` controls already-due bucket releases: a caller that
+        knows the last pass did NOT stall may arm them at 1 ms (the
+        release matured mid-pass and the next pass will send it); for a
+        stalled stream they are suppressed — a time wake cannot make a
+        blocked socket writable, and re-arming 0/1 ms timers would spin
+        the pump until the client drains.  Future RTOs are always
+        reported; due RTOs never are (the tick that just ran handled
+        them)."""
         best = -1
         ring = self.rtp_ring
         delay = self.settings.bucket_delay_ms
@@ -378,7 +383,11 @@ class RelayStream:
                 if bm < ring.tail:
                     bm = ring.tail
                 d = int(ring.arrival[ring.slot(bm)]) + b_idx * delay - now_ms
-                if d > 0 and (best < 0 or d < best):
+                if d <= 0:
+                    if not allow_due:
+                        continue
+                    d = 1
+                if best < 0 or d < best:
                     best = d
         for out in self.tickable_outputs:
             d = out.resender.next_deadline_ms(now_ms)

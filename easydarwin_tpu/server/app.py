@@ -181,6 +181,7 @@ class StreamingServer:
                 # per-stream guard: one bad output (broken socket, buggy
                 # transcoder tap) must never halt fan-out for the rest
                 try:
+                    pre_stalls = stream.stats.stalls
                     if (use_tpu and stream.num_outputs
                             >= self.config.tpu_min_outputs):
                         sent += self._engine_for(stream).step(stream, t)
@@ -190,6 +191,12 @@ class StreamingServer:
                         # reliable-UDP retransmit sweep (RTO-expired
                         # packets; RTPPacketResender resend-on-interval)
                         sent += out.tick(t)
+                    # wheel hint: a due-but-held bucket release on a
+                    # NON-stalled stream just matured mid-pass and may be
+                    # armed immediately; a stalled stream must not be (a
+                    # time wake cannot unblock a full socket)
+                    stream._last_pass_stalled = \
+                        stream.stats.stalls > pre_stalls
                 except Exception as e:
                     if self.error_log:
                         self.error_log.warning(
@@ -211,9 +218,12 @@ class StreamingServer:
             return None
 
     def _schedule_stream_deadlines(self, wheel, t: int) -> None:
+        """``t`` must be the time the wheel was last advanced to, so
+        relative deadlines land on the right tick."""
         for sess in self.registry.sessions.values():
             for stream in sess.streams.values():
-                d = stream.next_deadline_ms(t)
+                allow_due = not getattr(stream, "_last_pass_stalled", False)
+                d = stream.next_deadline_ms(t, allow_due=allow_due)
                 key = id(stream)
                 cur = self._wheel_sched.get(key)
                 if d < 0:
@@ -241,12 +251,14 @@ class StreamingServer:
             except asyncio.TimeoutError:
                 pass
             self._pump_event.clear()
-            if wheel is not None:
-                for key in wheel.advance(now_ms()):
-                    self._wheel_sched.pop(key, None)
             self._reflect_all()
             if wheel is not None:
-                self._schedule_stream_deadlines(wheel, now_ms())
+                # advance and schedule against the SAME clock sample, or
+                # timers fire early by the reflect-pass duration
+                t = now_ms()
+                for key in wheel.advance(t):
+                    self._wheel_sched.pop(key, None)
+                self._schedule_stream_deadlines(wheel, t)
             now = time.monotonic()
             if now - last_prune >= 1.0:
                 last_prune = now

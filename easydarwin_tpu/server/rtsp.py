@@ -590,7 +590,17 @@ class RtspConnection:
         socket: one call drains the whole pending batch into the ring."""
         if self.relay is None:
             return
-        n = self.relay.drain_native(track_id, fd)
+        try:
+            n = self.relay.drain_native(track_id, fd)
+        except OSError:
+            # hard recv error (or a close race on the fd): stop the
+            # readiness callback so a permanently-readable dead socket
+            # cannot spin the loop; the timeout sweep reaps the track
+            try:
+                asyncio.get_event_loop().remove_reader(fd)
+            except (OSError, ValueError):
+                pass
+            return
         if n:
             self.last_activity = time.monotonic()
             self.server.stats["packets_in"] += n
@@ -823,6 +833,14 @@ class RtspServer:
                     out = outputs.get(rb.ssrc)
                     if out is not None:
                         out.on_receiver_report(rb.fraction_lost / 256.0)
+            elif isinstance(p, rtcp_mod.Nadu):
+                # 3GPP NADU buffer state → per-output rate adaptation;
+                # each block names the media sender SSRC it reports on
+                for blk in p.blocks:
+                    out = outputs.get(blk.ssrc)
+                    if out is not None:
+                        out.on_nadu(blk.playout_delay_ms,
+                                    blk.free_buffer_64b)
             elif isinstance(p, rtcp_mod.App):
                 # RTCPAckPacket → RTPPacketResender::AckPacket path.
                 # Route: exact track by RTCP source addr, else by the
