@@ -1,0 +1,15 @@
+"""Host-side codec tier: bitstream entropy work the device cannot do.
+
+The MJPEG ladder split (host entropy ⇄ device transform math,
+``models/mjpeg_ladder.py``) applied to H.264: CAVLC baseline intra
+parse/re-encode on the host, integer requantization batched on the
+device (``ops.transform.h264_requant``).  CABAC and inter prediction are
+out of scope (SURVEY §7 step 8 scope note)."""
+
+from .h264_bits import BitReader, BitWriter, rbsp_to_nal, nal_to_rbsp
+from .h264_transform import (forward_transform_quant, dequant_inverse,
+                             requant_levels_scalar)
+
+__all__ = ["BitReader", "BitWriter", "rbsp_to_nal", "nal_to_rbsp",
+           "forward_transform_quant", "dequant_inverse",
+           "requant_levels_scalar"]

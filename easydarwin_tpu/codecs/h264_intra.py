@@ -1,0 +1,402 @@
+"""Minimal H.264 baseline INTRA codec (CAVLC, I_4x4, 4:2:0-signalled).
+
+Purpose: (a) generate REAL CAVLC-coded H.264 for the HLS transcode tests
+and benches (the image ships no ffmpeg — SURVEY §4 note on building the
+test pyramid from scratch), and (b) provide the slice/macroblock walk the
+transform-domain requant rung (``h264_requant``) shares.
+
+Scope (documented, test-enforced): I slices, I_4x4 macroblocks with DC
+(mode 2) luma prediction, luma residuals only (chroma CBP 0 — chroma
+rides DC prediction, so sources with flat chroma 128 are lossless in
+chroma).  CABAC, inter prediction and I_16x16 are out of scope; the
+requant rung passes streams it cannot parse through unchanged and says
+so in its stats.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import h264_cavlc as cavlc
+from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
+from .h264_transform import (ZIGZAG4, dequant_inverse,
+                             forward_transform_quant)
+
+#: Table 9-4 codeNum → coded_block_pattern for Intra_4x4 (ue-mapped CBP).
+CBP_INTRA_FROM_CODE = [
+    47, 31, 15, 0, 23, 27, 29, 30, 7, 11, 13, 14, 39, 43, 45, 46,
+    16, 3, 5, 10, 12, 19, 21, 26, 28, 35, 37, 42, 44, 1, 2, 4,
+    8, 17, 18, 20, 24, 6, 9, 22, 25, 32, 33, 34, 36, 40, 38, 41]
+CBP_INTRA_TO_CODE = {cbp: i for i, cbp in enumerate(CBP_INTRA_FROM_CODE)}
+
+#: luma4x4BlkIdx → (x4, y4) inside the macroblock (spec 6.4.3 scan)
+BLK_XY = [(2 * ((i >> 2) & 1) + (i & 1), 2 * ((i >> 3) & 1)
+           + ((i >> 1) & 1)) for i in range(16)]
+
+
+@dataclass
+class Sps:
+    width_mbs: int
+    height_mbs: int
+    sps_id: int = 0
+    log2_max_frame_num: int = 4
+
+    def build(self) -> bytes:
+        bw = BitWriter()
+        bw.write_bits(66, 8)            # profile_idc: baseline
+        bw.write_bits(0xC0, 8)          # constraint_set0/1
+        bw.write_bits(30, 8)            # level_idc 3.0
+        bw.ue(self.sps_id)
+        bw.ue(self.log2_max_frame_num - 4)
+        bw.ue(2)                        # pic_order_cnt_type
+        bw.ue(1)                        # max_num_ref_frames
+        bw.write_bit(0)                 # gaps_in_frame_num
+        bw.ue(self.width_mbs - 1)
+        bw.ue(self.height_mbs - 1)
+        bw.write_bit(1)                 # frame_mbs_only
+        bw.write_bit(1)                 # direct_8x8_inference
+        bw.write_bit(0)                 # frame_cropping
+        bw.write_bit(0)                 # vui_parameters_present
+        bw.rbsp_trailing()
+        return b"\x67" + rbsp_to_nal(bw.to_bytes())
+
+    @classmethod
+    def parse(cls, nal: bytes) -> "Sps":
+        br = BitReader(nal_to_rbsp(nal[1:]))
+        profile = br.read_bits(8)
+        if profile not in (66, 77, 88, 100):
+            raise ValueError(f"unsupported profile {profile}")
+        br.read_bits(8)                 # constraint flags
+        br.read_bits(8)                 # level
+        sps_id = br.ue()
+        if profile == 100:
+            raise ValueError("high profile unsupported")
+        log2_mfn = br.ue() + 4
+        poc_type = br.ue()
+        if poc_type == 0:
+            br.ue()
+        elif poc_type == 1:
+            raise ValueError("poc_type 1 unsupported")
+        br.ue()                         # max_num_ref_frames
+        br.read_bit()
+        w = br.ue() + 1
+        h = br.ue() + 1
+        fmo = br.read_bit()             # frame_mbs_only
+        if not fmo:
+            raise ValueError("interlace unsupported")
+        return cls(w, h, sps_id, log2_mfn)
+
+
+@dataclass
+class Pps:
+    pps_id: int = 0
+    sps_id: int = 0
+    pic_init_qp: int = 26
+    deblocking_control: bool = True
+
+    def build(self) -> bytes:
+        bw = BitWriter()
+        bw.ue(self.pps_id)
+        bw.ue(self.sps_id)
+        bw.write_bit(0)                 # entropy_coding_mode: CAVLC
+        bw.write_bit(0)                 # bottom_field_pic_order
+        bw.ue(0)                        # num_slice_groups_minus1
+        bw.ue(0)                        # num_ref_idx_l0
+        bw.ue(0)                        # num_ref_idx_l1
+        bw.write_bit(0)                 # weighted_pred
+        bw.write_bits(0, 2)             # weighted_bipred_idc
+        bw.se(self.pic_init_qp - 26)
+        bw.se(0)                        # pic_init_qs
+        bw.se(0)                        # chroma_qp_index_offset
+        bw.write_bit(1 if self.deblocking_control else 0)
+        bw.write_bit(0)                 # constrained_intra_pred
+        bw.write_bit(0)                 # redundant_pic_cnt_present
+        bw.rbsp_trailing()
+        return b"\x68" + rbsp_to_nal(bw.to_bytes())
+
+    @classmethod
+    def parse(cls, nal: bytes) -> "Pps":
+        br = BitReader(nal_to_rbsp(nal[1:]))
+        pps_id = br.ue()
+        sps_id = br.ue()
+        if br.read_bit():
+            raise ValueError("CABAC unsupported (CAVLC-baseline scope)")
+        br.read_bit()
+        if br.ue() != 0:
+            raise ValueError("slice groups unsupported")
+        br.ue()
+        br.ue()
+        br.read_bit()
+        br.read_bits(2)
+        qp = br.se() + 26
+        br.se()
+        br.se()
+        deblock = bool(br.read_bit())
+        return cls(pps_id, sps_id, qp, deblock)
+
+
+@dataclass
+class MacroblockI4x4:
+    """Parsed I_4x4 macroblock: everything needed to re-encode."""
+
+    pred_modes: list[tuple[int, int]]   # (use_predicted, rem_mode) × 16
+    chroma_mode: int
+    cbp: int                            # luma CBP only (chroma bits 0)
+    qp: int                             # ABSOLUTE QPY of this MB (spec
+    levels: np.ndarray                  # 7.4.5: mb_qp_delta accumulates
+                                        # across MBs; the writer re-derives
+                                        # deltas) · [16, 16] zigzag levels
+
+
+class SliceCodec:
+    """Shared slice walk: parse ⇄ serialize I slices of I_4x4 MBs."""
+
+    def __init__(self, sps: Sps, pps: Pps):
+        self.sps = sps
+        self.pps = pps
+
+    # -- slice header ------------------------------------------------------
+    def parse_slice_header(self, br: BitReader, nal_type: int) -> int:
+        """Returns SliceQPY; leaves ``br`` at the first MB."""
+        first_mb = br.ue()
+        if first_mb != 0:
+            raise ValueError("multi-slice pictures unsupported")
+        slice_type = br.ue()
+        if slice_type % 5 != 2:
+            raise ValueError(f"non-I slice {slice_type} (intra-only scope)")
+        br.ue()                          # pps id
+        br.read_bits(self.sps.log2_max_frame_num)    # frame_num
+        if nal_type == 5:
+            br.ue()                      # idr_pic_id
+        qp = self.pps.pic_init_qp + br.se()          # + slice_qp_delta
+        if self.pps.deblocking_control:
+            idc = br.ue()
+            if idc != 1:
+                br.se()
+                br.se()
+        return qp
+
+    def write_slice_header(self, bw: BitWriter, qp: int, *,
+                           frame_num: int = 0, idr_pic_id: int = 0) -> None:
+        bw.ue(0)                         # first_mb_in_slice
+        bw.ue(7)                         # slice_type: I (all slices I)
+        bw.ue(self.pps.pps_id)
+        bw.write_bits(frame_num, self.sps.log2_max_frame_num)
+        bw.ue(idr_pic_id)                # IDR only (we always emit IDR)
+        bw.se(qp - self.pps.pic_init_qp)
+        if self.pps.deblocking_control:
+            bw.ue(1)                     # disable deblocking: recon == ours
+
+    # -- macroblock layer --------------------------------------------------
+    def parse_mbs(self, br: BitReader,
+                  slice_qp: int) -> list[MacroblockI4x4]:
+        n_mbs = self.sps.width_mbs * self.sps.height_mbs
+        w4 = self.sps.width_mbs * 4
+        h4 = self.sps.height_mbs * 4
+        # per-4x4-block total_coeffs for nC context, frame geometry
+        totals = np.full((h4, w4), -1, dtype=np.int32)
+        mbs = []
+        cur_qp = slice_qp
+        for mb_idx in range(n_mbs):
+            mb_type = br.ue()
+            if mb_type != 0:
+                raise ValueError(
+                    f"mb_type {mb_type} unsupported (I_4x4-only scope)")
+            modes = []
+            for _ in range(16):
+                flag = br.read_bit()
+                rem = 0 if flag else br.read_bits(3)
+                modes.append((flag, rem))
+            chroma_mode = br.ue()
+            cbp = CBP_INTRA_FROM_CODE[br.ue()]
+            if cbp >> 4:
+                raise ValueError("chroma residuals unsupported")
+            if cbp:
+                cur_qp += br.se()       # mb_qp_delta ACCUMULATES (7.4.5)
+                if not 0 <= cur_qp <= 51:
+                    raise ValueError("QPY out of range")
+            levels = np.zeros((16, 16), dtype=np.int64)
+            self._residuals(br, mb_idx, cbp, levels, totals, decode=True)
+            mbs.append(MacroblockI4x4(modes, chroma_mode, cbp, cur_qp,
+                                      levels))
+        return mbs
+
+    def write_mbs(self, bw: BitWriter, mbs: list[MacroblockI4x4],
+                  slice_qp: int) -> None:
+        w4 = self.sps.width_mbs * 4
+        h4 = self.sps.height_mbs * 4
+        totals = np.full((h4, w4), -1, dtype=np.int32)
+        prev_qp = slice_qp               # deltas are vs the PREVIOUS MB's
+        for mb_idx, mb in enumerate(mbs):  # QP (7.4.5), not the slice QP
+            bw.ue(0)                     # mb_type I_4x4
+            for flag, rem in mb.pred_modes:
+                bw.write_bit(flag)
+                if not flag:
+                    bw.write_bits(rem, 3)
+            bw.ue(mb.chroma_mode)
+            bw.ue(CBP_INTRA_TO_CODE[mb.cbp])
+            if mb.cbp:
+                delta = mb.qp - prev_qp
+                if not -26 <= delta <= 25:
+                    raise ValueError("mb_qp_delta out of range")
+                bw.se(delta)
+                prev_qp = mb.qp
+            # cbp == 0: no qp_delta syntax — the MB has no residual so its
+            # QP is irrelevant; prev_qp carries to the next coded MB
+            self._residuals(bw, mb_idx, mb.cbp, mb.levels, totals,
+                            decode=False)
+
+    def _residuals(self, bio, mb_idx: int, cbp: int, levels: np.ndarray,
+                   totals: np.ndarray, *, decode: bool) -> None:
+        """Walk the 16 luma blocks in spec order, maintaining the nC
+        context grid; decode into ``levels`` or encode from it."""
+        mb_x = (mb_idx % self.sps.width_mbs) * 4
+        mb_y = (mb_idx // self.sps.width_mbs) * 4
+        for blk in range(16):
+            x4, y4 = BLK_XY[blk]
+            gx, gy = mb_x + x4, mb_y + y4
+            if not (cbp >> (blk >> 2)) & 1:
+                totals[gy, gx] = 0
+                levels[blk] = 0
+                continue
+            nA = totals[gy, gx - 1] if gx > 0 else -1
+            nB = totals[gy - 1, gx] if gy > 0 else -1
+            if nA >= 0 and nB >= 0:
+                nC = (nA + nB + 1) >> 1
+            elif nA >= 0:
+                nC = int(nA)
+            elif nB >= 0:
+                nC = int(nB)
+            else:
+                nC = 0
+            if decode:
+                lv = cavlc.decode_residual(bio, nC)
+                levels[blk] = lv
+                totals[gy, gx] = sum(1 for v in lv if v)
+            else:
+                lv = [int(v) for v in levels[blk]]
+                cavlc.encode_residual(bio, lv, nC)
+                totals[gy, gx] = sum(1 for v in lv if v)
+
+
+# ----------------------------------------------------------------- encoder
+
+def _dc_pred(recon: np.ndarray, gx: int, gy: int) -> int:
+    """4×4 DC prediction from reconstructed neighbors (mode 2)."""
+    x0, y0 = gx * 4, gy * 4
+    left = recon[y0:y0 + 4, x0 - 1] if x0 > 0 else None
+    top = recon[y0 - 1, x0:x0 + 4] if y0 > 0 else None
+    if left is not None and top is not None:
+        return int((int(left.sum()) + int(top.sum()) + 4) >> 3)
+    if left is not None:
+        return int((int(left.sum()) + 2) >> 2)
+    if top is not None:
+        return int((int(top.sum()) + 2) >> 2)
+    return 128
+
+
+def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
+                  idr_pic_id: int = 0,
+                  sps: Sps | None = None, pps: Pps | None = None,
+                  include_ps: bool = True) -> list[bytes]:
+    """uint8 [H, W] luma (H, W multiples of 16) → NAL payloads
+    ([SPS, PPS,] IDR slice), DC-predicted I_4x4 with a real
+    reconstruction loop (prediction always from reconstructed samples,
+    as a conformant decoder will see them)."""
+    h, w = luma.shape
+    if h % 16 or w % 16:
+        raise ValueError("dimensions must be multiples of 16")
+    sps = sps or Sps(w // 16, h // 16)
+    pps = pps or Pps(pic_init_qp=qp)
+    codec = SliceCodec(sps, pps)
+    recon = np.zeros((h, w), dtype=np.int64)
+    zz = ZIGZAG4
+    mbs: list[MacroblockI4x4] = []
+    for mb_idx in range(sps.width_mbs * sps.height_mbs):
+        mb_x = (mb_idx % sps.width_mbs) * 4
+        mb_y = (mb_idx // sps.width_mbs) * 4
+        levels = np.zeros((16, 16), dtype=np.int64)
+        nz_blocks = np.zeros(16, dtype=bool)
+        for blk in range(16):
+            x4, y4 = BLK_XY[blk]
+            gx, gy = mb_x + x4, mb_y + y4
+            pred = _dc_pred(recon, gx, gy)
+            src = luma[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4].astype(np.int64)
+            res = src - pred
+            lv_raster = forward_transform_quant(res, qp)
+            levels[blk] = lv_raster[zz]
+            nz_blocks[blk] = bool(np.any(lv_raster))
+            rec_res = dequant_inverse(lv_raster, qp)
+            recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
+                pred + rec_res, 0, 255)
+        cbp = 0
+        for g in range(4):
+            if nz_blocks[4 * g:4 * g + 4].any():
+                cbp |= 1 << g
+        # CBP-cleared blocks carry no residual: the decoder reconstructs
+        # them as pure prediction, so mirror that here
+        for blk in range(16):
+            if not (cbp >> (blk >> 2)) & 1 and nz_blocks[blk]:
+                levels[blk] = 0
+        mbs.append(MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, levels))
+    bw = BitWriter()
+    codec.write_slice_header(bw, qp, frame_num=frame_num,
+                             idr_pic_id=idr_pic_id)
+    codec.write_mbs(bw, mbs, qp)
+    bw.rbsp_trailing()
+    slice_nal = bytes([0x65]) + rbsp_to_nal(bw.to_bytes())
+    if include_ps:
+        return [sps.build(), pps.build(), slice_nal]
+    return [slice_nal]
+
+
+# ----------------------------------------------------------------- decoder
+
+def decode_iframe(nals: list[bytes]) -> np.ndarray:
+    """NAL payloads → uint8 [H, W] luma (DC-mode I_4x4 scope)."""
+    sps = pps = None
+    slice_nal = None
+    for nal in nals:
+        t = nal[0] & 0x1F
+        if t == 7:
+            sps = Sps.parse(nal)
+        elif t == 8:
+            pps = Pps.parse(nal)
+        elif t in (1, 5):
+            slice_nal = nal
+    if sps is None or pps is None or slice_nal is None:
+        raise ValueError("need SPS+PPS+slice")
+    codec = SliceCodec(sps, pps)
+    br = BitReader(nal_to_rbsp(slice_nal[1:]))
+    qp = codec.parse_slice_header(br, slice_nal[0] & 0x1F)
+    mbs = codec.parse_mbs(br, qp)
+    h, w = sps.height_mbs * 16, sps.width_mbs * 16
+    recon = np.zeros((h, w), dtype=np.int64)
+    inv_zz = np.argsort(ZIGZAG4)
+    for mb_idx, mb in enumerate(mbs):
+        mb_x = (mb_idx % sps.width_mbs) * 4
+        mb_y = (mb_idx // sps.width_mbs) * 4
+        cur_qp = mb.qp
+        for blk in range(16):
+            flag, _rem = mb.pred_modes[blk]
+            if not flag:
+                # an explicit rem mode can never be DC when every context
+                # mode is DC (rem skips the predicted mode)
+                raise ValueError("non-DC intra mode out of scope")
+            x4, y4 = BLK_XY[blk]
+            gx, gy = mb_x + x4, mb_y + y4
+            pred = _dc_pred(recon, gx, gy)
+            lv = mb.levels[blk][inv_zz]
+            res = dequant_inverse(lv, cur_qp)
+            recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
+                pred + res, 0, 255)
+    return recon.astype(np.uint8)
+
+
+def psnr(a: np.ndarray, b: np.ndarray) -> float:
+    mse = float(np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2))
+    if mse == 0:
+        return 99.0
+    return 10.0 * np.log10(255.0 * 255.0 / mse)

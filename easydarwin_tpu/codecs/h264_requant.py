@@ -1,0 +1,137 @@
+"""Transform-domain H.264 requantization: the HLS bitrate rung's core.
+
+Open-loop CAVLC transcoding (the classic transform-domain design): parse
+every I_4x4 macroblock's residual levels, requantize them at a higher QP
+— batched on the device (``ops.transform.h264_requant``) or through the
+scalar oracle — and re-encode the slice with the new QP and recomputed
+CBP/nC contexts.  SPS/PPS pass through untouched (QP lives in the slice
+header).  Prediction drift is accepted and resets at every IDR, which in
+the all-intra camera configs this ladder targets means every frame.
+
+Streams outside the supported profile (CABAC, inter slices, I_16x16,
+chroma residuals) PASS THROUGH unchanged and are counted — the rung
+never corrupts what it cannot parse."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
+from .h264_intra import Pps, SliceCodec, Sps
+from .h264_transform import requant_levels_scalar
+
+
+@dataclass
+class RequantStats:
+    slices_requantized: int = 0
+    slices_passed_through: int = 0
+    blocks: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+
+
+def _scalar_batch(levels: np.ndarray, qp_in: np.ndarray,
+                  qp_out: np.ndarray) -> np.ndarray:
+    out = np.empty_like(levels)
+    for i in range(levels.shape[0]):
+        out[i] = requant_levels_scalar(levels[i], int(qp_in[i]),
+                                       int(qp_out[i]))
+    return out
+
+
+def device_batch(levels: np.ndarray, qp_in: np.ndarray,
+                 qp_out: np.ndarray) -> np.ndarray:
+    """Batch requant on the accelerator (bit-exact vs the scalar path)."""
+    import numpy as _np
+
+    from ..ops.transform import h264_requant
+    return _np.asarray(h264_requant(levels.astype(_np.int32),
+                                    qp_in.astype(_np.int32),
+                                    qp_out.astype(_np.int32))
+                       ).astype(_np.int64)
+
+
+class SliceRequantizer:
+    """Per-stream requantizer: latches SPS/PPS from the NAL flow and
+    rewrites coded slices ``delta_qp`` steps coarser."""
+
+    def __init__(self, delta_qp: int, *, requant_fn=None):
+        if delta_qp < 6 or delta_qp % 6:
+            # +6k steps are EXACT level shifts (table periodicity); other
+            # deltas would need transform-normalization terms
+            raise ValueError("delta_qp must be a positive multiple of 6")
+        self.delta_qp = delta_qp
+        self.requant_fn = requant_fn or _scalar_batch
+        self.sps: Sps | None = None
+        self.pps: Pps | None = None
+        self.stats = RequantStats()
+
+    # -- per-NAL entry -----------------------------------------------------
+    def transform_nal(self, nal: bytes) -> bytes:
+        t = nal[0] & 0x1F
+        if t == 7:
+            try:
+                self.sps = Sps.parse(nal)
+            except (ValueError, EOFError, IndexError):
+                self.sps = None
+            return nal
+        if t == 8:
+            try:
+                self.pps = Pps.parse(nal)
+            except (ValueError, EOFError, IndexError):
+                self.pps = None
+            return nal
+        if t not in (1, 5) or self.sps is None or self.pps is None:
+            return nal
+        self.stats.bytes_in += len(nal)
+        try:
+            out = self._requant_slice(nal)
+            self.stats.slices_requantized += 1
+        except (ValueError, EOFError, KeyError, IndexError):
+            out = nal
+            self.stats.slices_passed_through += 1
+        self.stats.bytes_out += len(out)
+        return out
+
+    def _requant_slice(self, nal: bytes) -> bytes:
+        codec = SliceCodec(self.sps, self.pps)
+        br = BitReader(nal_to_rbsp(nal[1:]))
+        qp_in_base = codec.parse_slice_header(br, nal[0] & 0x1F)
+        mbs = codec.parse_mbs(br, qp_in_base)
+        qp_out_base = qp_in_base + self.delta_qp
+        # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5):
+        # the ceiling check covers the true per-MB maxima
+        if max((mb.qp for mb in mbs), default=qp_in_base) \
+                + self.delta_qp > 51:
+            raise ValueError("qp already at ladder ceiling")
+
+        # gather every block with its per-MB source/target QP; the +6k
+        # step is uniform so every MB shifts by the same k
+        all_levels = []
+        qps = []
+        for mb in mbs:
+            all_levels.append(mb.levels)          # scan order is fine:
+            qps.extend([mb.qp] * 16)              # the op is elementwise
+        batch = np.concatenate(all_levels, axis=0)          # [16·n_mbs, 16]
+        qps = np.asarray(qps)
+        self.stats.blocks += batch.shape[0]
+        requanted = self.requant_fn(batch, qps, qps + self.delta_qp)
+
+        # write back + recompute CBP and the shifted absolute QP per MB;
+        # the writer re-derives deltas vs the previous CODED MB, so a
+        # cleared-CBP MB's QP correctly stops influencing the chain
+        for i, mb in enumerate(mbs):
+            mb.levels = requanted[16 * i:16 * i + 16]
+            cbp = 0
+            for g in range(4):
+                if np.any(mb.levels[4 * g:4 * g + 4]):
+                    cbp |= 1 << g
+            mb.cbp = cbp
+            mb.qp = mb.qp + self.delta_qp
+        bw = BitWriter()
+        codec.write_slice_header(bw, qp_out_base)
+        codec.write_mbs(bw, mbs, qp_out_base)
+        bw.rbsp_trailing()
+        return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes())

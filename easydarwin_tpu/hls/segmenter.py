@@ -218,16 +218,21 @@ class _HlsEntry:
         self.renditions: dict[str, HlsOutput] = {}
 
 
-#: default temporal ladder for master.m3u8 (frame-granular thinning —
-#: H.264 rungs with NO re-encode: level 1 halves the frame rate, level 2
-#: keeps GOP heads only; level 3 mutes video entirely so it is not a
-#: valid rendition).  Matches the reference's own thinning behavior
-#: (RTPStream.h:144-174): streams whose dropped frames are referenced
-#: show artifacts, exactly as the reference's thinning does.
+#: default ladder for master.m3u8: temporal rungs only (frame-granular
+#: thinning, NO re-encode: level 1 halves the frame rate, level 2 keeps
+#: GOP heads only — matching the reference's own thinning behavior,
+#: RTPStream.h:144-174).  The transform-domain REQUANT rung "qN" (same
+#: frame rate, truly lower bitrate — hls/requant.py) is OPT-IN via
+#: starthls rungs=q6 or an explicit /q6/ URL: its host-side CAVLC recode
+#: costs ~0.5 ms per macroblock, so auto-advertising it on every
+#: master.m3u8 GET would stall large pictures and publish a bogus
+#: variant for out-of-scope (CABAC/inter) sources.
 DEFAULT_RUNGS = (1, 2)
 MAX_RUNG_LEVEL = 2
+MAX_REQUANT_DELTA = 18
 #: BANDWIDTH fallbacks per rendition before any segment is observed
-_NOMINAL_BW = {"": 2_000_000, "r1": 1_200_000, "r2": 400_000}
+_NOMINAL_BW = {"": 2_000_000, "r1": 1_200_000, "r2": 400_000,
+               "q6": 1_000_000, "q12": 500_000}
 
 
 class HlsService:
@@ -242,19 +247,31 @@ class HlsService:
     entropy re-coding is a serial-decoder problem with no TPU win)."""
 
     def __init__(self, registry, *, target_duration: float = 2.0,
-                 window: int = 6):
+                 window: int = 6, requant_on_device: bool = False):
         self.registry = registry
         self.target_duration = target_duration
         self.window = window
+        #: device-batch the q-rung requant (bit-exact either way).  OFF by
+        #: default on the live path: first-touch JAX init (slow compile,
+        #: or a wedged tunneled lease) must never stall the rendition
+        #: worker; the server enables it when its TPU fan-out is on.
+        self.requant_on_device = requant_on_device
         self.outputs: dict[str, _HlsEntry] = {}
 
     def _rendition(self, entry: _HlsEntry, name: str) -> HlsOutput:
         out = entry.renditions.get(name)
         if out is None:
-            out = HlsOutput(target_duration=self.target_duration,
-                            window=self.window)
-            if name:
-                out.thinning.controller.level = int(name[1:])
+            if name.startswith("q"):
+                from .requant import RequantHlsOutput
+                out = RequantHlsOutput(int(name[1:]),
+                                       use_device=self.requant_on_device,
+                                       target_duration=self.target_duration,
+                                       window=self.window)
+            else:
+                out = HlsOutput(target_duration=self.target_duration,
+                                window=self.window)
+                if name:
+                    out.thinning.controller.level = int(name[1:])
             entry.renditions[name] = out
             entry.sess.add_output(entry.track_id, out)
         return out
@@ -282,9 +299,21 @@ class HlsService:
         levels raise ValueError rather than advertising a dead variant."""
         from ..protocol.sdp import _norm
         key = _norm(path)
-        levels = [int(r) for r in rungs]
-        if any(not 1 <= r <= MAX_RUNG_LEVEL for r in levels):
-            raise ValueError(f"rung levels must be 1..{MAX_RUNG_LEVEL}")
+        names = []
+        for r in rungs:
+            if isinstance(r, str) and r.startswith("q"):
+                delta = int(r[1:])
+                if not (6 <= delta <= MAX_REQUANT_DELTA and delta % 6 == 0):
+                    raise ValueError(
+                        f"requant rungs must be q6..q{MAX_REQUANT_DELTA} "
+                        "in steps of 6")
+                names.append(f"q{delta}")
+            else:
+                level = int(r)
+                if not 1 <= level <= MAX_RUNG_LEVEL:
+                    raise ValueError(
+                        f"rung levels must be 1..{MAX_RUNG_LEVEL}")
+                names.append(f"r{level}")
         entry = self._fresh_entry(key)
         if entry is None:
             sess = self.registry.find(key)
@@ -296,8 +325,8 @@ class HlsService:
                 raise ValueError("no video track")
             entry = self.outputs[key] = _HlsEntry(sess, vids[0])
         out = self._rendition(entry, "") if include_source else None
-        for level in levels:
-            self._rendition(entry, f"r{level}")
+        for name in names:
+            self._rendition(entry, name)
         return out
 
     def stop(self, path: str) -> None:
@@ -316,14 +345,23 @@ class HlsService:
         return len(dead)
 
     def list_streams(self) -> list[dict]:
-        return [{
-            "path": key,
-            "renditions": [{
+        def info(name, out):
+            d = {
                 "name": name or "source",
                 "uri": (f"{name}/index.m3u8" if name else "index.m3u8"),
                 "segments": len(out.segments),
                 "bandwidth": out.observed_bandwidth(),
-            } for name, out in sorted(entry.renditions.items())],
+            }
+            rq = getattr(out, "requant", None)
+            if rq is not None:          # requant rung: surface honesty
+                d["requantized_slices"] = rq.stats.slices_requantized
+                d["passed_through_slices"] = rq.stats.slices_passed_through
+                d["shed_units"] = out.shed
+            return d
+        return [{
+            "path": key,
+            "renditions": [info(n, o)
+                           for n, o in sorted(entry.renditions.items())],
         } for key, entry in self.outputs.items()]
 
     def master_playlist(self, entry: _HlsEntry) -> str:
@@ -348,8 +386,14 @@ class HlsService:
         stream_path, fname = rest.rsplit("/", 1)
         rendition = ""
         parts = stream_path.rsplit("/", 1)
-        if (len(parts) == 2 and len(parts[1]) == 2
-                and parts[1][0] == "r" and parts[1][1].isdigit()):
+        from ..protocol.sdp import _norm as _n
+        if (len(parts) == 2 and len(parts[1]) >= 2
+                and parts[1][0] in "rq" and parts[1][1:].isdigit()
+                # a stream genuinely PUBLISHED at .../r2 or .../q6 keeps
+                # its full path; the suffix is a rendition only when no
+                # such session exists
+                and self.registry.find(_n("/" + stream_path.strip("/")))
+                is None):
             stream_path, rendition = parts
         from ..protocol.sdp import _norm
         key = _norm("/" + stream_path.strip("/"))
@@ -361,8 +405,9 @@ class HlsService:
             elif rendition and (self._fresh_entry(key) is None
                                 or rendition not in
                                 self.outputs[key].renditions):
-                self.start(key, (int(rendition[1:]),),
-                           include_source=False)
+                rung = rendition if rendition[0] == "q" \
+                    else int(rendition[1:])
+                self.start(key, (rung,), include_source=False)
             elif self._fresh_entry(key) is None:
                 self.start(key)
         except (KeyError, ValueError):

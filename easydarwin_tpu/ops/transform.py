@@ -256,3 +256,30 @@ def requantize_downscale2x(quads: jnp.ndarray, qtable_in: jnp.ndarray,
                      jnp.asarray(downscale2x_operator()),
                      precision="highest")
     return jnp.round(out / qtable_out[None, :]).astype(jnp.int32)
+
+
+# ------------------------------------------------- H.264 4x4 requant (int32)
+
+@jax.jit
+def h264_requant(levels: jnp.ndarray, qp_in: jnp.ndarray,
+                 qp_out: jnp.ndarray) -> jnp.ndarray:
+    """H.264 4×4 transform-domain requant, BIT-EXACT against
+    ``codecs.h264_transform.requant_levels_scalar``: a +6k QP step is
+    exactly a rounded k-bit right shift of each level (Qstep doubles
+    every 6 QP with identical qp%6 multiplier rows):
+
+      l' = sign(l)·((|l| + 2^k/3) >> k),  k = (qp_out − qp_in) // 6.
+
+    levels: int32 [N, 16] block levels (any scan order — the op is
+    elementwise).  qp_in: [N] per-block source QP (per-MB qp_delta
+    support); qp_out: [N] or scalar target QP, qp_out ≡ qp_in (mod 6).
+    The entropy recode around this stays on the host
+    (``codecs.h264_requant``) — the same host⇄device split as the MJPEG
+    ladder.  The clip bound is the shared overflow contract
+    (``codecs.h264_transform.LEVEL_CLIP``)."""
+    from ..codecs.h264_transform import LEVEL_CLIP
+    lev = jnp.clip(levels.astype(jnp.int32), -LEVEL_CLIP, LEVEL_CLIP)
+    k = ((qp_out - qp_in.astype(jnp.int32)) // 6)[:, None]
+    f = (jnp.int32(1) << k) // 3
+    out = jnp.sign(lev) * ((jnp.abs(lev) + f) >> k)
+    return out.astype(jnp.int32)

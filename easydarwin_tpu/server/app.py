@@ -66,7 +66,8 @@ class StreamingServer:
         from ..hls import HlsService
         from .mp3 import Mp3Service
         self.recordings = RecordingManager()
-        self.hls = HlsService(self.registry)
+        self.hls = HlsService(self.registry,
+                              requant_on_device=self.config.tpu_fanout)
         from ..models.mjpeg_ladder import MjpegTranscodeService
         self.transcodes = MjpegTranscodeService(
             self.registry, on_frame=lambda _path: self._wake())

@@ -291,7 +291,8 @@ class RestApi:
         path = params.get("path", [""])[0]
         rungs_raw = params.get("rungs", [""])[0]
         try:
-            rungs = (tuple(int(r) for r in rungs_raw.split(",") if r)
+            rungs = (tuple(r if r.startswith("q") else int(r)
+                           for r in rungs_raw.split(",") if r)
                      if rungs_raw else DEFAULT_RUNGS)
             self.app.hls.start(path, rungs)
         except KeyError:
@@ -303,7 +304,8 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
             "Master": f"/hls{key}/master.m3u8",
             "Renditions": ["index.m3u8"]
-            + [f"r{int(r)}/index.m3u8" for r in rungs]})
+            + [(f"{r}/index.m3u8" if isinstance(r, str)
+                else f"r{int(r)}/index.m3u8") for r in rungs]})
 
     def _cmd_stophls(self, params: dict, body: bytes) -> tuple[int, str]:
         from ..protocol.sdp import _norm
