@@ -564,6 +564,12 @@ def main():
     import sys
 
     from easydarwin_tpu import native
+    if os.environ.get("EDTPU_BENCH_FORCE_CPU") == "1":
+        # child of a wedged-TPU fallback: pin the CPU backend before ANY
+        # jax.devices() probe (the axon sitecustomize would otherwise
+        # re-probe the wedged lease and hang this process too)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     ring, lens = build_load()
     raise_rmem_cap()
     socks, addrs = make_receivers()

@@ -42,6 +42,8 @@ class Sps:
     height_mbs: int
     sps_id: int = 0
     log2_max_frame_num: int = 4
+    poc_type: int = 2
+    log2_max_poc_lsb: int = 4           # meaningful for poc_type 0 only
 
     def build(self) -> bytes:
         bw = BitWriter()
@@ -50,7 +52,9 @@ class Sps:
         bw.write_bits(30, 8)            # level_idc 3.0
         bw.ue(self.sps_id)
         bw.ue(self.log2_max_frame_num - 4)
-        bw.ue(2)                        # pic_order_cnt_type
+        bw.ue(self.poc_type)
+        if self.poc_type == 0:
+            bw.ue(self.log2_max_poc_lsb - 4)
         bw.ue(1)                        # max_num_ref_frames
         bw.write_bit(0)                 # gaps_in_frame_num
         bw.ue(self.width_mbs - 1)
@@ -75,8 +79,9 @@ class Sps:
             raise ValueError("high profile unsupported")
         log2_mfn = br.ue() + 4
         poc_type = br.ue()
+        log2_poc = 4
         if poc_type == 0:
-            br.ue()
+            log2_poc = br.ue() + 4
         elif poc_type == 1:
             raise ValueError("poc_type 1 unsupported")
         br.ue()                         # max_num_ref_frames
@@ -86,7 +91,7 @@ class Sps:
         fmo = br.read_bit()             # frame_mbs_only
         if not fmo:
             raise ValueError("interlace unsupported")
-        return cls(w, h, sps_id, log2_mfn)
+        return cls(w, h, sps_id, log2_mfn, poc_type, log2_poc)
 
 
 @dataclass
@@ -95,6 +100,7 @@ class Pps:
     sps_id: int = 0
     pic_init_qp: int = 26
     deblocking_control: bool = True
+    bottom_field_poc: bool = False
 
     def build(self) -> bytes:
         bw = BitWriter()
@@ -123,7 +129,7 @@ class Pps:
         sps_id = br.ue()
         if br.read_bit():
             raise ValueError("CABAC unsupported (CAVLC-baseline scope)")
-        br.read_bit()
+        bottom_poc = bool(br.read_bit())
         if br.ue() != 0:
             raise ValueError("slice groups unsupported")
         br.ue()
@@ -134,7 +140,25 @@ class Pps:
         br.se()
         br.se()
         deblock = bool(br.read_bit())
-        return cls(pps_id, sps_id, qp, deblock)
+        return cls(pps_id, sps_id, qp, deblock, bottom_poc)
+
+
+@dataclass
+class SliceHeader:
+    """Round-trippable I-slice header fields (subset of 7.3.3)."""
+
+    nal_type: int = 5
+    nal_ref_idc: int = 3
+    slice_type: int = 7
+    frame_num: int = 0
+    idr_pic_id: int = 0
+    poc_lsb: int = 0
+    no_output_prior: int = 0
+    long_term_ref: int = 0
+    qp: int = 26
+    deblock_idc: int = 1
+    deblock_alpha: int = 0
+    deblock_beta: int = 0
 
 
 @dataclass
@@ -158,36 +182,68 @@ class SliceCodec:
         self.pps = pps
 
     # -- slice header ------------------------------------------------------
-    def parse_slice_header(self, br: BitReader, nal_type: int) -> int:
-        """Returns SliceQPY; leaves ``br`` at the first MB."""
+    def parse_slice_header(self, br: BitReader, nal_byte: int
+                           ) -> "SliceHeader":
+        """Parses the full I-slice header (H.264 7.3.3) so the requant
+        writer can ROUND-TRIP every field — frame_num, idr_pic_id, POC
+        lsb, dec_ref_pic_marking — not just the QP.  Leaves ``br`` at the
+        first MB."""
+        nal_type = nal_byte & 0x1F
+        nal_ref_idc = (nal_byte >> 5) & 3
+        h = SliceHeader(nal_type=nal_type, nal_ref_idc=nal_ref_idc)
         first_mb = br.ue()
         if first_mb != 0:
             raise ValueError("multi-slice pictures unsupported")
-        slice_type = br.ue()
-        if slice_type % 5 != 2:
-            raise ValueError(f"non-I slice {slice_type} (intra-only scope)")
-        br.ue()                          # pps id
-        br.read_bits(self.sps.log2_max_frame_num)    # frame_num
+        h.slice_type = br.ue()
+        if h.slice_type % 5 != 2:
+            raise ValueError(
+                f"non-I slice {h.slice_type} (intra-only scope)")
+        br.ue()                          # pps id (ours)
+        h.frame_num = br.read_bits(self.sps.log2_max_frame_num)
         if nal_type == 5:
-            br.ue()                      # idr_pic_id
-        qp = self.pps.pic_init_qp + br.se()          # + slice_qp_delta
+            h.idr_pic_id = br.ue()
+        if self.sps.poc_type == 0:
+            if self.pps.bottom_field_poc:
+                raise ValueError("bottom-field POC unsupported")
+            h.poc_lsb = br.read_bits(self.sps.log2_max_poc_lsb)
+        if nal_ref_idc != 0:             # dec_ref_pic_marking (7.3.3.3)
+            if nal_type == 5:
+                h.no_output_prior = br.read_bit()
+                h.long_term_ref = br.read_bit()
+            else:
+                if br.read_bit():        # adaptive marking: MMCO loop
+                    raise ValueError("adaptive ref marking unsupported")
+        h.qp = self.pps.pic_init_qp + br.se()        # + slice_qp_delta
         if self.pps.deblocking_control:
             idc = br.ue()
+            h.deblock_idc = idc
             if idc != 1:
-                br.se()
-                br.se()
-        return qp
+                h.deblock_alpha = br.se()
+                h.deblock_beta = br.se()
+        return h
 
-    def write_slice_header(self, bw: BitWriter, qp: int, *,
-                           frame_num: int = 0, idr_pic_id: int = 0) -> None:
+    def write_slice_header(self, bw: BitWriter, h: "SliceHeader",
+                           qp: int) -> None:
         bw.ue(0)                         # first_mb_in_slice
-        bw.ue(7)                         # slice_type: I (all slices I)
+        bw.ue(h.slice_type)
         bw.ue(self.pps.pps_id)
-        bw.write_bits(frame_num, self.sps.log2_max_frame_num)
-        bw.ue(idr_pic_id)                # IDR only (we always emit IDR)
+        bw.write_bits(h.frame_num, self.sps.log2_max_frame_num)
+        if h.nal_type == 5:
+            bw.ue(h.idr_pic_id)
+        if self.sps.poc_type == 0:
+            bw.write_bits(h.poc_lsb, self.sps.log2_max_poc_lsb)
+        if h.nal_ref_idc != 0:           # dec_ref_pic_marking
+            if h.nal_type == 5:
+                bw.write_bit(h.no_output_prior)
+                bw.write_bit(h.long_term_ref)
+            else:
+                bw.write_bit(0)          # sliding-window marking
         bw.se(qp - self.pps.pic_init_qp)
         if self.pps.deblocking_control:
-            bw.ue(1)                     # disable deblocking: recon == ours
+            bw.ue(h.deblock_idc)
+            if h.deblock_idc != 1:
+                bw.se(h.deblock_alpha)
+                bw.se(h.deblock_beta)
 
     # -- macroblock layer --------------------------------------------------
     def parse_mbs(self, br: BitReader,
@@ -342,8 +398,8 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
                 levels[blk] = 0
         mbs.append(MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, levels))
     bw = BitWriter()
-    codec.write_slice_header(bw, qp, frame_num=frame_num,
-                             idr_pic_id=idr_pic_id)
+    hdr = SliceHeader(frame_num=frame_num, idr_pic_id=idr_pic_id, qp=qp)
+    codec.write_slice_header(bw, hdr, qp)
     codec.write_mbs(bw, mbs, qp)
     bw.rbsp_trailing()
     slice_nal = bytes([0x65]) + rbsp_to_nal(bw.to_bytes())
@@ -370,7 +426,7 @@ def decode_iframe(nals: list[bytes]) -> np.ndarray:
         raise ValueError("need SPS+PPS+slice")
     codec = SliceCodec(sps, pps)
     br = BitReader(nal_to_rbsp(slice_nal[1:]))
-    qp = codec.parse_slice_header(br, slice_nal[0] & 0x1F)
+    qp = codec.parse_slice_header(br, slice_nal[0]).qp
     mbs = codec.parse_mbs(br, qp)
     h, w = sps.height_mbs * 16, sps.width_mbs * 16
     recon = np.zeros((h, w), dtype=np.int64)

@@ -98,7 +98,8 @@ class SliceRequantizer:
     def _requant_slice(self, nal: bytes) -> bytes:
         codec = SliceCodec(self.sps, self.pps)
         br = BitReader(nal_to_rbsp(nal[1:]))
-        qp_in_base = codec.parse_slice_header(br, nal[0] & 0x1F)
+        hdr = codec.parse_slice_header(br, nal[0])
+        qp_in_base = hdr.qp
         mbs = codec.parse_mbs(br, qp_in_base)
         qp_out_base = qp_in_base + self.delta_qp
         # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5):
@@ -131,7 +132,7 @@ class SliceRequantizer:
             mb.cbp = cbp
             mb.qp = mb.qp + self.delta_qp
         bw = BitWriter()
-        codec.write_slice_header(bw, qp_out_base)
+        codec.write_slice_header(bw, hdr, qp_out_base)
         codec.write_mbs(bw, mbs, qp_out_base)
         bw.rbsp_trailing()
         return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes())

@@ -230,3 +230,41 @@ def test_requant_passes_through_what_it_cannot_parse():
     junk = b"\x65" + bytes(range(40))
     assert rq2.transform_nal(junk) == junk
     assert rq2.stats.slices_passed_through == 1
+
+
+def test_slice_header_roundtrips_all_fields():
+    """dec_ref_pic_marking, POC lsb, frame_num, idr_pic_id must all
+    survive the requant rewrite (review r3: the first cut dropped the
+    2 marking bits and collapsed idr_pic_id to 0)."""
+    from easydarwin_tpu.codecs.h264_bits import BitReader, BitWriter
+    from easydarwin_tpu.codecs.h264_intra import (Pps, SliceCodec,
+                                                  SliceHeader, Sps)
+    sps = Sps(4, 4, poc_type=0, log2_max_poc_lsb=6)
+    pps = Pps(pic_init_qp=28)
+    codec = SliceCodec(sps, pps)
+    hdr = SliceHeader(frame_num=9, idr_pic_id=3, poc_lsb=44,
+                      no_output_prior=1, long_term_ref=0, qp=31)
+    bw = BitWriter()
+    codec.write_slice_header(bw, hdr, 31)
+    bw.rbsp_trailing()
+    back = codec.parse_slice_header(BitReader(bw.to_bytes()), 0x65)
+    for f in ("frame_num", "idr_pic_id", "poc_lsb", "no_output_prior",
+              "long_term_ref", "qp"):
+        assert getattr(back, f) == getattr(hdr, f), f
+
+
+def test_requant_preserves_idr_pic_id_distinctness():
+    """Consecutive IDRs keep their distinct idr_pic_id through requant."""
+    img = _img(64)
+    ids = []
+    rq = SliceRequantizer(6)
+    for f in range(2):
+        nals = encode_iframe(img, 24, idr_pic_id=f)
+        out = [rq.transform_nal(n) for n in nals]
+        from easydarwin_tpu.codecs.h264_bits import BitReader, nal_to_rbsp
+        from easydarwin_tpu.codecs.h264_intra import Pps, SliceCodec, Sps
+        codec = SliceCodec(Sps.parse(out[0]), Pps.parse(out[1]))
+        hdr = codec.parse_slice_header(
+            BitReader(nal_to_rbsp(out[2][1:])), out[2][0])
+        ids.append(hdr.idr_pic_id)
+    assert ids == [0, 1]
