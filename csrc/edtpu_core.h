@@ -149,6 +149,23 @@ int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds);
 int64_t ed_udp_drain_ex(const int32_t *fds, int32_t n_fds,
                         int64_t *out_bytes);
 
+/* -------------------------------------------------------- H.264 requant */
+
+/* Native CAVLC slice requantizer (the HLS q-rung hot path) — decodes a
+ * baseline-intra I_4x4 slice, shifts every residual level by
+ * delta_qp/6 bits (exact +6k QP requant), re-encodes with recomputed
+ * CBP/nC contexts and QP chain.  Bit-exact vs the Python oracle
+ * (codecs/h264_requant.py); tables generated from the Python source
+ * (gen_h264_tables.py).  Returns the output NAL length written to out,
+ * or negative: -1 unsupported feature (caller passes through), -2
+ * malformed bitstream, -3 out buffer too small. */
+int32_t ed_h264_requant_slice(
+    const uint8_t *nal, int32_t nal_len, uint8_t *out, int32_t out_cap,
+    int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
+    int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
+    int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
+    int32_t delta_qp);
+
 /* ------------------------------------------------------------- timer wheel */
 
 /* Hashed timer wheel, 1 ms ticks (vs the reference's 10 ms scheduler floor,

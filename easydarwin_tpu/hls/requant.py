@@ -41,7 +41,13 @@ def _get_worker() -> ThreadPoolExecutor:
 class RequantHlsOutput(HlsOutput):
     def __init__(self, delta_qp: int, *, use_device: bool = True, **kw):
         super().__init__(**kw)
-        fn = device_batch if use_device else None
+        from .. import native as native_mod
+        if native_mod.available():
+            # the native CAVLC walk (~100x the Python path) is the
+            # production engine; it embeds the same exact level shift
+            fn = None
+        else:
+            fn = device_batch if use_device else None
         self.requant = SliceRequantizer(delta_qp, requant_fn=fn)
         self.delta_qp = delta_qp
         self._ps_fed: tuple[bytes | None, bytes | None] = (None, None)

@@ -104,6 +104,12 @@ def _load():
             u32p, u32p, u32p, ctypes.c_int32,
             ctypes.POINTER(SendOp), ctypes.c_int32,
             u8p, ctypes.c_int32, i32p]
+        lib.ed_h264_requant_slice.restype = ctypes.c_int32
+        lib.ed_h264_requant_slice.argtypes = [
+            u8p, ctypes.c_int32, u8p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32]
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
@@ -236,6 +242,35 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         ring_data.shape[0], ring_data.shape[1],
         _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
         dests, len(dests), ops, n_ops, int(use_gso))
+
+
+def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
+                       log2_max_frame_num: int, poc_type: int,
+                       log2_max_poc_lsb: int, pic_init_qp: int,
+                       pps_id: int, deblocking_control: bool,
+                       bottom_field_poc: bool,
+                       delta_qp: int) -> bytes | None:
+    """Native CAVLC slice requant; None = unsupported/malformed (caller
+    passes the slice through or falls back to the Python path)."""
+    lib = _load()
+    assert lib is not None
+    src = np.frombuffer(nal, dtype=np.uint8)
+    cap = len(nal) * 2 + 256
+    out = np.zeros(cap, dtype=np.uint8)
+    n = lib.ed_h264_requant_slice(
+        _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
+        log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
+        pps_id, 1 if deblocking_control else 0,
+        1 if bottom_field_poc else 0, delta_qp)
+    if n == -3:                      # tiny chance: expansion past 2x
+        cap = len(nal) * 4 + 4096
+        out = np.zeros(cap, dtype=np.uint8)
+        n = lib.ed_h264_requant_slice(
+            _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
+            log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
+            pps_id, 1 if deblocking_control else 0,
+            1 if bottom_field_poc else 0, delta_qp)
+    return out[:n].tobytes() if n > 0 else None
 
 
 def last_send_errno() -> int:

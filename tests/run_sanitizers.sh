@@ -17,12 +17,18 @@ run_one() {
     make -s -C csrc "$san"
     rt=$(g++ -print-file-name="lib${san}.so")
     [ -f "$rt" ] || { echo "lib${san}.so runtime not found, skipping"; return 0; }
-    echo "== ${san}: pytest tests/test_native_core.py =="
+    echo "== ${san}: pytest native suites =="
+    # -k native: the jax-backed tests abort under the preloaded sanitizer
+    # runtime (jaxlib allocator noise, not our code); the native CAVLC
+    # differential + garbage fuzz run jax-free
     env EDTPU_CORE_SO="$PWD/$so" LD_PRELOAD="$rt" \
         ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
         TSAN_OPTIONS=halt_on_error=1 \
         JAX_PLATFORMS=cpu \
-        python -m pytest tests/test_native_core.py -q -p no:cacheprovider
+        python -m pytest tests/test_native_core.py \
+        "tests/test_h264_codec.py::test_native_requant_matches_python_byte_for_byte" \
+        "tests/test_h264_codec.py::test_native_requant_rejects_garbage_cleanly" \
+        -q -p no:cacheprovider
 }
 
 case "$MODE" in
