@@ -1,0 +1,215 @@
+"""Integration soak: one server, many concurrent features, N seconds.
+
+Exercises simultaneously: TCP-interleaved push + UDP push (native
+recvmmsg ingest), interleaved players, UDP players on the shared egress
+(one with reliable-UDP, one sending NADU feedback), an HLS viewer
+pulling the temporal + requant renditions, and REST polling — then
+checks: no error-log growth, all players progressing, requant stats
+advancing, zero engine send errors.
+
+Usage: python tools/soak.py [seconds]   (default 120)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from easydarwin_tpu.codecs.h264_intra import encode_iframe  # noqa: E402
+from easydarwin_tpu.protocol import nalu  # noqa: E402
+from easydarwin_tpu.relay.reliable import build_ack  # noqa: E402
+from easydarwin_tpu.server import ServerConfig, StreamingServer  # noqa: E402
+from easydarwin_tpu.utils.client import RtspClient  # noqa: E402
+
+SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=soak\r\nt=0 0\r\n"
+       "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+       "a=control:trackID=1\r\n")
+
+
+def synth_frame(f: int, n: int = 64) -> np.ndarray:
+    x = np.arange(n)[None, :].repeat(n, 0).astype(np.float64)
+    y = np.arange(n)[:, None].repeat(n, 1).astype(np.float64)
+    return (128 + 50 * np.sin(x / 9.0 + f / 3) + 40 * np.cos(y / 7.0 - f / 5)
+            ).clip(0, 255).astype(np.uint8)
+
+
+async def soak(seconds: float) -> int:
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=10, bucket_delay_ms=10,
+                       access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    failures: list[str] = []
+    try:
+        base = f"rtsp://127.0.0.1:{app.rtsp.port}"
+        rest = f"http://127.0.0.1:{app.rest.port}"
+
+        # --- pusher A: TCP interleaved, REAL coded frames (feeds HLS q6)
+        push_a = RtspClient()
+        await push_a.connect("127.0.0.1", app.rtsp.port)
+        await push_a.push_start(f"{base}/live/a", SDP)
+        # --- pusher B: UDP (native recvmmsg ingest)
+        push_b = RtspClient()
+        await push_b.connect("127.0.0.1", app.rtsp.port)
+        await push_b.push_start(f"{base}/live/b", SDP, tcp=False)
+        b_rtp = push_b.push_transports[0].server_port[0]
+        b_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+        # --- players
+        tcp_player = RtspClient()
+        await tcp_player.connect("127.0.0.1", app.rtsp.port)
+        await tcp_player.play_start(f"{base}/live/a")
+
+        udp_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        udp_rtp.bind(("127.0.0.1", 0))
+        udp_rtp.setblocking(False)
+        udp_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        udp_rtcp.bind(("127.0.0.1", 0))
+        udp_rtcp.setblocking(False)
+        rel_player = RtspClient()
+        await rel_player.connect("127.0.0.1", app.rtsp.port)
+        await rel_player.play_start(
+            f"{base}/live/b", tcp=False,
+            client_ports=[(udp_rtp.getsockname()[1],
+                           udp_rtcp.getsockname()[1])],
+            setup_headers={"x-retransmit": "our-retransmit;window=128"})
+        egress = app.rtsp.shared_egress
+        rel_out = next(cn for cn in app.rtsp.connections
+                       if cn.player_tracks and cn is not None
+                       and any(hasattr(pt.output, "resender")
+                               for pt in cn.player_tracks.values())
+                       ).player_tracks[1].output
+
+        # --- HLS with the requant rung (REST calls must not block the
+        # loop the server itself runs on)
+        def _get(path):
+            with urllib.request.urlopen(rest + path, timeout=5) as r:
+                return r.status, r.read()
+
+        async def rest_get(path):
+            return await asyncio.to_thread(_get, path)
+
+        await rest_get("/api/v1/starthls?path=/live/a&rungs=1,q6")
+
+        t0 = time.time()
+        f = 0
+        seq_a = seq_b = 0
+        tcp_rx = [0]
+        udp_rx = [0]
+
+        async def tcp_drain():
+            while time.time() - t0 < seconds:
+                try:
+                    await tcp_player.recv_interleaved(0, timeout=1.0)
+                    tcp_rx[0] += 1
+                except asyncio.TimeoutError:
+                    pass
+
+        drain_task = asyncio.ensure_future(tcp_drain())
+        last_seen_out_seq = None
+        while time.time() - t0 < seconds:
+            img = synth_frame(f)
+            ts = int(f * 3000)
+            for nal in encode_iframe(img, 24):
+                for p in nalu.packetize_h264(
+                        nal, seq=seq_a, timestamp=ts, ssrc=1,
+                        marker_on_last=(nal[0] & 0x1F == 5)):
+                    seq_a += 1
+                    push_a.push_packet(0, p)
+            # pusher B: synthetic 1-packet IDR frames over UDP
+            pkt = (struct.pack("!BBHII", 0x80, 96, seq_b & 0xFFFF, ts, 0xB)
+                   + bytes([0x65]) + bytes(120))
+            seq_b += 1
+            b_sock.sendto(pkt, ("127.0.0.1", b_rtp))
+            # drain UDP player + ack its packets (reliable window)
+            acked = 0
+            while True:
+                try:
+                    d = udp_rtp.recv(65536)
+                except BlockingIOError:
+                    break
+                if len(d) >= 12 and d[1] & 0x7F == 96:
+                    udp_rx[0] += 1
+                    last_seen_out_seq = struct.unpack("!H", d[2:4])[0]
+                    acked += 1
+            if last_seen_out_seq is not None and acked:
+                udp_rtcp.sendto(
+                    build_ack(rel_out.rewrite.ssrc, last_seen_out_seq,
+                              0xFFFFFFFF),
+                    ("127.0.0.1", egress.rtcp_port))
+            if f % 30 == 10:           # periodic NADU (comfortable buffer)
+                from easydarwin_tpu.protocol.rtcp import Nadu, NaduBlock
+                udp_rtcp.sendto(Nadu(9, [NaduBlock(
+                    rel_out.rewrite.ssrc, playout_delay_ms=2000,
+                    free_buffer_64b=500)]).to_bytes(),
+                    ("127.0.0.1", egress.rtcp_port))
+            if f % 60 == 20:           # REST polling
+                st, _ = await rest_get("/api/v1/getserverinfo")
+                assert st == 200
+                st, _ = await rest_get("/api/v1/gethlsstreams")
+                assert st == 200
+            f += 1
+            await asyncio.sleep(0.03)
+        await drain_task
+
+        # --- checks
+        st, body = await rest_get("/hls/live/a/q6/index.m3u8")
+        if b"#EXTINF" not in body:
+            failures.append("q6 rendition produced no segments")
+        entry = app.hls.outputs.get("/live/a")
+        q6 = entry.renditions.get("q6") if entry else None
+        if q6 is None or q6.requant.stats.slices_requantized < 10:
+            failures.append(f"requant stats too low: "
+                            f"{q6 and q6.requant.stats}")
+        if q6 is not None and q6.requant.stats.native_slices == 0:
+            failures.append("native requant engine unused")
+        if tcp_rx[0] < f * 0.5:
+            failures.append(f"tcp player starved: {tcp_rx[0]}/{f}")
+        if udp_rx[0] < f * 0.5:
+            failures.append(f"udp player starved: {udp_rx[0]}/{f}")
+        if rel_out.resender.in_flight > 200:
+            failures.append(
+                f"reliable window never drains: {rel_out.resender.in_flight}")
+        for eng in app._engines.values():
+            if eng.send_errors:
+                failures.append(f"engine send errors: {eng.send_errors}")
+        stats = {
+            "frames": f,
+            "tcp_rx": tcp_rx[0],
+            "udp_rx": udp_rx[0],
+            "reliable_in_flight": rel_out.resender.in_flight,
+            "reliable_acks": rel_out.tracker.acks,
+            "retransmits": rel_out.resender.resent,
+            "requant": str(q6.requant.stats) if q6 else None,
+            "hls_shed": q6.shed if q6 else None,
+            "rtcp_in": egress.rtcp_in,
+            "native_ingest": {
+                s.native_ingest_pkts and "ok" or 0: s.native_ingest_pkts
+                for sess in app.registry.sessions.values()
+                for s in sess.streams.values()},
+        }
+        print("SOAK", "FAIL" if failures else "OK", stats)
+        for msg in failures:
+            print("  -", msg)
+        await tcp_player.close()
+        await rel_player.close()
+        await push_a.close()
+        await push_b.close()
+        for s in (b_sock, udp_rtp, udp_rtcp):
+            s.close()
+    finally:
+        await app.stop()
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    secs = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+    raise SystemExit(asyncio.run(soak(secs)))
