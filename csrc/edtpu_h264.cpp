@@ -260,8 +260,9 @@ void write_run_before(BitWriter &bw, int zeros_left, int run) {
   bw.bits(e & 0xFFFFFF, e >> 24);
 }
 
-// decode one residual block → levels[16] in zigzag order
-bool decode_residual(BitReader &br, int nC, int16_t *levels) {
+// decode one residual block → levels[maxc] in zigzag order (maxc = 16
+// for luma4x4 / I_16x16 DC, 15 for I_16x16 AC)
+bool decode_residual_n(BitReader &br, int nC, int16_t *levels, int maxc) {
   std::memset(levels, 0, 16 * sizeof(int16_t));
   int total, t1s;
   if (!read_coeff_token(br, nC, &total, &t1s)) return false;
@@ -298,13 +299,14 @@ bool decode_residual(BitReader &br, int nC, int16_t *levels) {
     int32_t a = lv < 0 ? -lv : lv;
     if (a > (3 << (suffix_len - 1)) && suffix_len < 6) ++suffix_len;
   }
+  if (total > maxc) return false;
   int total_zeros = 0;
-  if (total < 16 && !read_total_zeros(br, total, &total_zeros))
+  if (total < maxc && !read_total_zeros(br, total, &total_zeros))
     return false;
   int zeros_left = total_zeros;
   int pos = total + total_zeros - 1;
   for (int i = 0; i < nvals; ++i) {
-    if (pos < 0 || pos > 15) return false;
+    if (pos < 0 || pos >= maxc) return false;
     int32_t v = vals[i];
     if (v > kLevelClip) v = kLevelClip;
     if (v < -kLevelClip) v = -kLevelClip;
@@ -319,11 +321,12 @@ bool decode_residual(BitReader &br, int nC, int16_t *levels) {
   return true;
 }
 
-bool encode_residual(BitWriter &bw, const int16_t *levels, int nC) {
+bool encode_residual_n(BitWriter &bw, const int16_t *levels, int nC,
+                       int maxc) {
   int idxs[16];
   int32_t nzv[16];
   int total = 0;
-  for (int i = 0; i < 16; ++i)
+  for (int i = 0; i < maxc; ++i)
     if (levels[i]) {
       idxs[total] = i;
       nzv[total] = levels[i];
@@ -389,7 +392,7 @@ bool encode_residual(BitWriter &bw, const int16_t *levels, int nC) {
   }
   int highest = idxs[total - 1];
   int total_zeros = highest + 1 - total;
-  if (total < 16) {
+  if (total < maxc) {
     uint32_t e = kTotalZeros[total - 1][total_zeros];
     if (!e) return false;
     bw.bits(e & 0xFFFFFF, e >> 24);
@@ -403,6 +406,20 @@ bool encode_residual(BitWriter &bw, const int16_t *levels, int nC) {
     }
   }
   return true;
+}
+
+inline bool decode_residual(BitReader &br, int nC, int16_t *levels) {
+  return decode_residual_n(br, nC, levels, 16);
+}
+inline bool decode_residual15(BitReader &br, int nC, int16_t *levels) {
+  return decode_residual_n(br, nC, levels, 15);
+}
+inline bool encode_residual(BitWriter &bw, const int16_t *levels, int nC) {
+  return encode_residual_n(bw, levels, nC, 16);
+}
+inline bool encode_residual15(BitWriter &bw, const int16_t *levels,
+                              int nC) {
+  return encode_residual_n(bw, levels, nC, 15);
 }
 
 // --------------------------------------------------------------- NAL/EPB
@@ -507,18 +524,85 @@ extern "C" int32_t ed_h264_requant_slice(
   // (mirrors parse_mbs + write_mbs with the requant between).
   int n_mbs = width_mbs * height_mbs;
   int w4 = width_mbs * 4, h4 = height_mbs * 4;
-  std::vector<int16_t> all_levels(static_cast<size_t>(n_mbs) * 16 * 16);
+  // 17 level rows per MB: row 0 = I_16x16 DC, rows 1..16 = 4x4 blocks
+  // (16 coeffs for I_4x4 luma, 15 for I_16x16 AC)
+  std::vector<int16_t> all_levels(static_cast<size_t>(n_mbs) * 17 * 16);
   std::vector<int32_t> mb_qp(n_mbs), mb_cbp(n_mbs);
+  std::vector<uint8_t> mb_is16(n_mbs), mb_pred16(n_mbs);
   std::vector<uint8_t> mb_modes(static_cast<size_t>(n_mbs) * 16 * 2);
   std::vector<uint32_t> mb_chroma(n_mbs);
   std::vector<int16_t> totals(static_cast<size_t>(h4) * w4, -1);
+
+  auto nc_at = [&](int gx, int gy) -> int {
+    int nA = gx > 0 ? totals[static_cast<size_t>(gy) * w4 + gx - 1] : -1;
+    int nB = gy > 0 ? totals[static_cast<size_t>(gy - 1) * w4 + gx] : -1;
+    if (nA >= 0 && nB >= 0) return (nA + nB + 1) >> 1;
+    if (nA >= 0) return nA;
+    if (nB >= 0) return nB;
+    return 0;
+  };
+  auto shift_row = [&](int16_t *lv, int n, int kk, int dz) {
+    bool any = false;
+    for (int i = 0; i < n; ++i) {
+      int32_t v = lv[i];
+      int32_t a = v < 0 ? -v : v;
+      if (a > kLevelClip) a = kLevelClip;
+      a = (a + dz) >> kk;
+      lv[i] = static_cast<int16_t>(v < 0 ? -a : a);
+      any |= lv[i] != 0;
+    }
+    return any;
+  };
 
   int k = delta_qp / 6;
   int deadzone = (1 << k) / 3;
   int32_t cur_qp = h.qp;
   int32_t max_qp = h.qp;
   for (int mb = 0; mb < n_mbs; ++mb) {
-    if (br.ue() != 0) return kErrUnsupported;      // mb_type I_4x4 only
+    uint32_t mb_type = br.ue();
+    if (!br.ok) return kErrBitstream;
+    if (mb_type >= 1 && mb_type <= 24) {
+      // ---- I_16x16: DC block + (CBP 15) sixteen 15-coeff AC blocks
+      int pred = static_cast<int>(mb_type - 1) % 4;
+      int chroma_cbp = (static_cast<int>(mb_type - 1) / 4) % 3;
+      bool luma15 = mb_type >= 13;
+      if (chroma_cbp) return kErrUnsupported;
+      mb_is16[mb] = 1;
+      mb_pred16[mb] = static_cast<uint8_t>(pred);
+      mb_chroma[mb] = br.ue();
+      cur_qp += br.se();                 // always coded for I_16x16
+      if (cur_qp < 12 || cur_qp > 51) return kErrUnsupported;
+      mb_qp[mb] = cur_qp;
+      if (cur_qp > max_qp) max_qp = cur_qp;
+      int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
+      int16_t *dc = &all_levels[static_cast<size_t>(mb) * 17 * 16];
+      if (!decode_residual(br, nc_at(mb_x, mb_y), dc))
+        return kErrBitstream;
+      shift_row(dc, 16, k, deadzone);
+      bool any_ac = false;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mb_x + x4, gy = mb_y + y4;
+        int16_t *lv =
+            &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
+        if (!luma15) {
+          totals[static_cast<size_t>(gy) * w4 + gx] = 0;
+          std::memset(lv, 0, 16 * sizeof(int16_t));
+          continue;
+        }
+        int nC = nc_at(gx, gy);
+        if (!decode_residual15(br, nC, lv)) return kErrBitstream;
+        int tot = 0;
+        for (int i = 0; i < 15; ++i) tot += lv[i] != 0;
+        totals[static_cast<size_t>(gy) * w4 + gx] =
+            static_cast<int16_t>(tot);
+        any_ac |= shift_row(lv, 15, k, deadzone);
+      }
+      mb_cbp[mb] = any_ac ? 15 : 0;      // luma CBP after requant
+      continue;
+    }
+    if (mb_type != 0) return kErrUnsupported;      // inter etc.
     for (int b = 0; b < 16; ++b) {
       int flag = br.bit();
       mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2] =
@@ -543,21 +627,14 @@ extern "C" int32_t ed_h264_requant_slice(
       int x4, y4;
       blk_xy(b, &x4, &y4);
       int gx = mb_x + x4, gy = mb_y + y4;
-      int16_t *lv = &all_levels[(static_cast<size_t>(mb) * 16 + b) * 16];
+      int16_t *lv =
+          &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
       if (!((cbp >> (b >> 2)) & 1)) {
         totals[static_cast<size_t>(gy) * w4 + gx] = 0;
         std::memset(lv, 0, 16 * sizeof(int16_t));
         continue;
       }
-      int nA = gx > 0 ? totals[static_cast<size_t>(gy) * w4 + gx - 1] : -1;
-      int nB = gy > 0 ? totals[static_cast<size_t>(gy - 1) * w4 + gx] : -1;
-      int nC = 0;
-      if (nA >= 0 && nB >= 0)
-        nC = (nA + nB + 1) >> 1;
-      else if (nA >= 0)
-        nC = nA;
-      else if (nB >= 0)
-        nC = nB;
+      int nC = nc_at(gx, gy);
       if (!decode_residual(br, nC, lv)) return kErrBitstream;
       int tot = 0;
       for (int i = 0; i < 16; ++i) tot += lv[i] != 0;
@@ -565,14 +642,7 @@ extern "C" int32_t ed_h264_requant_slice(
           static_cast<int16_t>(tot);
       // requant: the +6k shift with the intra deadzone (bit-exact with
       // requant_levels_scalar / ops.transform.h264_requant)
-      for (int i = 0; i < 16; ++i) {
-        int32_t v = lv[i];
-        int32_t a = v < 0 ? -v : v;
-        if (a > kLevelClip) a = kLevelClip;
-        a = (a + deadzone) >> k;
-        lv[i] = static_cast<int16_t>(v < 0 ? -a : a);
-        if (lv[i]) out_cbp |= 1 << (b >> 2);
-      }
+      if (shift_row(lv, 16, k, deadzone)) out_cbp |= 1 << (b >> 2);
     }
     mb_cbp[mb] = out_cbp;
   }
@@ -608,6 +678,37 @@ extern "C" int32_t ed_h264_requant_slice(
   std::fill(totals.begin(), totals.end(), static_cast<int16_t>(-1));
   int32_t prev_qp = qp_out_base;
   for (int mb = 0; mb < n_mbs; ++mb) {
+    int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
+    if (mb_is16[mb]) {
+      bool luma15 = mb_cbp[mb] == 15;
+      bw.ue(1 + mb_pred16[mb] + (luma15 ? 12 : 0));
+      bw.ue(mb_chroma[mb]);
+      int32_t qp_out_mb = mb_qp[mb] + delta_qp;
+      int32_t delta = qp_out_mb - prev_qp;
+      if (delta < -26 || delta > 25) return kErrUnsupported;
+      bw.se(delta);                    // always coded for I_16x16
+      prev_qp = qp_out_mb;
+      const int16_t *dc = &all_levels[static_cast<size_t>(mb) * 17 * 16];
+      if (!encode_residual(bw, dc, nc_at(mb_x, mb_y))) return kErrBitstream;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mb_x + x4, gy = mb_y + y4;
+        const int16_t *lv =
+            &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
+        if (!luma15) {
+          totals[static_cast<size_t>(gy) * w4 + gx] = 0;
+          continue;
+        }
+        if (!encode_residual15(bw, lv, nc_at(gx, gy)))
+          return kErrBitstream;
+        int tot = 0;
+        for (int i = 0; i < 15; ++i) tot += lv[i] != 0;
+        totals[static_cast<size_t>(gy) * w4 + gx] =
+            static_cast<int16_t>(tot);
+      }
+      continue;
+    }
     bw.ue(0);                                      // mb_type I_4x4
     for (int b = 0; b < 16; ++b) {
       int flag = mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2];
@@ -625,27 +726,17 @@ extern "C" int32_t ed_h264_requant_slice(
       bw.se(delta);
       prev_qp = qp_out_mb;
     }
-    int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
     for (int b = 0; b < 16; ++b) {
       int x4, y4;
       blk_xy(b, &x4, &y4);
       int gx = mb_x + x4, gy = mb_y + y4;
       const int16_t *lv =
-          &all_levels[(static_cast<size_t>(mb) * 16 + b) * 16];
+          &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
       if (!((cbp >> (b >> 2)) & 1)) {
         totals[static_cast<size_t>(gy) * w4 + gx] = 0;
         continue;
       }
-      int nA = gx > 0 ? totals[static_cast<size_t>(gy) * w4 + gx - 1] : -1;
-      int nB = gy > 0 ? totals[static_cast<size_t>(gy - 1) * w4 + gx] : -1;
-      int nC = 0;
-      if (nA >= 0 && nB >= 0)
-        nC = (nA + nB + 1) >> 1;
-      else if (nA >= 0)
-        nC = nA;
-      else if (nB >= 0)
-        nC = nB;
-      if (!encode_residual(bw, lv, nC)) return kErrBitstream;
+      if (!encode_residual(bw, lv, nc_at(gx, gy))) return kErrBitstream;
       int tot = 0;
       for (int i = 0; i < 16; ++i) tot += lv[i] != 0;
       totals[static_cast<size_t>(gy) * w4 + gx] =

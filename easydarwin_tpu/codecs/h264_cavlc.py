@@ -293,6 +293,8 @@ def decode_residual(br: BitReader, nC: int, max_coeff: int = 16
                     ) -> list[int]:
     """One CAVLC residual block → levels in ZIGZAG order [max_coeff]."""
     total, t1s = read_coeff_token(br, nC)
+    if total > max_coeff:
+        raise ValueError("TotalCoeff exceeds block size")
     levels = [0] * max_coeff
     if total == 0:
         return levels

@@ -174,8 +174,26 @@ class MacroblockI4x4:
                                         # deltas) · [16, 16] zigzag levels
 
 
+@dataclass
+class MacroblockI16x16:
+    """Parsed I_16x16 macroblock (mb_type 1..24): DC Hadamard block +
+    optional 15-coeff AC blocks.  Chroma CBP must be 0 (scope)."""
+
+    pred_mode: int                      # intra16x16 pred mode 0..3
+    chroma_mode: int
+    luma_cbp15: bool                    # True = AC blocks coded (CBP 15)
+    qp: int
+    dc_levels: np.ndarray               # [16] zigzag DC levels
+    ac_levels: np.ndarray               # [16, 15] zigzag AC levels
+
+    @property
+    def mb_type(self) -> int:
+        return 1 + self.pred_mode + (12 if self.luma_cbp15 else 0)
+
+
 class SliceCodec:
-    """Shared slice walk: parse ⇄ serialize I slices of I_4x4 MBs."""
+    """Shared slice walk: parse ⇄ serialize I slices of I_4x4 and
+    I_16x16 macroblocks."""
 
     def __init__(self, sps: Sps, pps: Pps):
         self.sps = sps
@@ -246,8 +264,8 @@ class SliceCodec:
                 bw.se(h.deblock_beta)
 
     # -- macroblock layer --------------------------------------------------
-    def parse_mbs(self, br: BitReader,
-                  slice_qp: int) -> list[MacroblockI4x4]:
+    def parse_mbs(self, br: BitReader, slice_qp: int
+                  ) -> "list[MacroblockI4x4 | MacroblockI16x16]":
         n_mbs = self.sps.width_mbs * self.sps.height_mbs
         w4 = self.sps.width_mbs * 4
         h4 = self.sps.height_mbs * 4
@@ -257,35 +275,66 @@ class SliceCodec:
         cur_qp = slice_qp
         for mb_idx in range(n_mbs):
             mb_type = br.ue()
-            if mb_type != 0:
+            if mb_type == 0:
+                modes = []
+                for _ in range(16):
+                    flag = br.read_bit()
+                    rem = 0 if flag else br.read_bits(3)
+                    modes.append((flag, rem))
+                chroma_mode = br.ue()
+                cbp = CBP_INTRA_FROM_CODE[br.ue()]
+                if cbp >> 4:
+                    raise ValueError("chroma residuals unsupported")
+                if cbp:
+                    cur_qp += br.se()   # mb_qp_delta ACCUMULATES (7.4.5)
+                    if not 0 <= cur_qp <= 51:
+                        raise ValueError("QPY out of range")
+                levels = np.zeros((16, 16), dtype=np.int64)
+                self._residuals(br, mb_idx, cbp, levels, totals,
+                                decode=True)
+                mbs.append(MacroblockI4x4(modes, chroma_mode, cbp, cur_qp,
+                                          levels))
+            elif 1 <= mb_type <= 24:
+                pred = (mb_type - 1) % 4
+                chroma_cbp = ((mb_type - 1) // 4) % 3
+                luma15 = mb_type >= 13
+                if chroma_cbp:
+                    raise ValueError("chroma residuals unsupported")
+                chroma_mode = br.ue()
+                cur_qp += br.se()       # always coded for I_16x16
+                if not 12 <= cur_qp <= 51:
+                    # <12: DC dequant uses a rounding form that breaks the
+                    # exact +6k shift argument — pass through
+                    raise ValueError("QPY out of I_16x16 requant range")
+                mb16 = MacroblockI16x16(
+                    pred, chroma_mode, luma15, cur_qp,
+                    np.zeros(16, dtype=np.int64),
+                    np.zeros((16, 15), dtype=np.int64))
+                self._residuals16(br, mb_idx, mb16, totals, decode=True)
+                mbs.append(mb16)
+            else:
                 raise ValueError(
-                    f"mb_type {mb_type} unsupported (I_4x4-only scope)")
-            modes = []
-            for _ in range(16):
-                flag = br.read_bit()
-                rem = 0 if flag else br.read_bits(3)
-                modes.append((flag, rem))
-            chroma_mode = br.ue()
-            cbp = CBP_INTRA_FROM_CODE[br.ue()]
-            if cbp >> 4:
-                raise ValueError("chroma residuals unsupported")
-            if cbp:
-                cur_qp += br.se()       # mb_qp_delta ACCUMULATES (7.4.5)
-                if not 0 <= cur_qp <= 51:
-                    raise ValueError("QPY out of range")
-            levels = np.zeros((16, 16), dtype=np.int64)
-            self._residuals(br, mb_idx, cbp, levels, totals, decode=True)
-            mbs.append(MacroblockI4x4(modes, chroma_mode, cbp, cur_qp,
-                                      levels))
+                    f"mb_type {mb_type} unsupported (intra-only scope)")
         return mbs
 
-    def write_mbs(self, bw: BitWriter, mbs: list[MacroblockI4x4],
+    def write_mbs(self, bw: BitWriter,
+                  mbs: "list[MacroblockI4x4 | MacroblockI16x16]",
                   slice_qp: int) -> None:
         w4 = self.sps.width_mbs * 4
         h4 = self.sps.height_mbs * 4
         totals = np.full((h4, w4), -1, dtype=np.int32)
         prev_qp = slice_qp               # deltas are vs the PREVIOUS MB's
         for mb_idx, mb in enumerate(mbs):  # QP (7.4.5), not the slice QP
+            if isinstance(mb, MacroblockI16x16):
+                bw.ue(mb.mb_type)
+                bw.ue(mb.chroma_mode)
+                delta = mb.qp - prev_qp
+                if not -26 <= delta <= 25:
+                    raise ValueError("mb_qp_delta out of range")
+                bw.se(delta)             # always coded for I_16x16
+                prev_qp = mb.qp
+                self._residuals16(bw, mb_idx, mb, totals, decode=False)
+                continue
             bw.ue(0)                     # mb_type I_4x4
             for flag, rem in mb.pred_modes:
                 bw.write_bit(flag)
@@ -303,6 +352,49 @@ class SliceCodec:
             # QP is irrelevant; prev_qp carries to the next coded MB
             self._residuals(bw, mb_idx, mb.cbp, mb.levels, totals,
                             decode=False)
+
+    def _nc_at(self, totals: np.ndarray, gx: int, gy: int) -> int:
+        w4 = totals.shape[1]
+        nA = totals[gy, gx - 1] if gx > 0 else -1
+        nB = totals[gy - 1, gx] if gy > 0 else -1
+        if nA >= 0 and nB >= 0:
+            return int(nA + nB + 1) >> 1
+        if nA >= 0:
+            return int(nA)
+        if nB >= 0:
+            return int(nB)
+        return 0
+
+    def _residuals16(self, bio, mb_idx: int, mb: "MacroblockI16x16",
+                     totals: np.ndarray, *, decode: bool) -> None:
+        """I_16x16 residual walk: one 16-coeff DC block (nC from the
+        luma4x4BlkIdx-0 neighbors), then — when luma CBP is 15 — sixteen
+        15-coeff AC blocks.  Per-4x4 context totals store the AC
+        TotalCoeff (DC excluded), matching 9.2.1's nN derivation."""
+        mb_x = (mb_idx % self.sps.width_mbs) * 4
+        mb_y = (mb_idx // self.sps.width_mbs) * 4
+        nC = self._nc_at(totals, mb_x, mb_y)
+        if decode:
+            mb.dc_levels[:] = cavlc.decode_residual(bio, nC, 16)
+        else:
+            cavlc.encode_residual(bio, [int(v) for v in mb.dc_levels], nC,
+                                  16)
+        for blk in range(16):
+            x4, y4 = BLK_XY[blk]
+            gx, gy = mb_x + x4, mb_y + y4
+            if not mb.luma_cbp15:
+                totals[gy, gx] = 0
+                if decode:
+                    mb.ac_levels[blk] = 0
+                continue
+            nC = self._nc_at(totals, gx, gy)
+            if decode:
+                mb.ac_levels[blk] = cavlc.decode_residual(bio, nC, 15)
+                totals[gy, gx] = int(np.count_nonzero(mb.ac_levels[blk]))
+            else:
+                cavlc.encode_residual(
+                    bio, [int(v) for v in mb.ac_levels[blk]], nC, 15)
+                totals[gy, gx] = int(np.count_nonzero(mb.ac_levels[blk]))
 
     def _residuals(self, bio, mb_idx: int, cbp: int, levels: np.ndarray,
                    totals: np.ndarray, *, decode: bool) -> None:
@@ -432,6 +524,8 @@ def decode_iframe(nals: list[bytes]) -> np.ndarray:
     recon = np.zeros((h, w), dtype=np.int64)
     inv_zz = np.argsort(ZIGZAG4)
     for mb_idx, mb in enumerate(mbs):
+        if isinstance(mb, MacroblockI16x16):
+            raise ValueError("decoder scope is I_4x4 only")
         mb_x = (mb_idx % sps.width_mbs) * 4
         mb_y = (mb_idx // sps.width_mbs) * 4
         cur_qp = mb.qp

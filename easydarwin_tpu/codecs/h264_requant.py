@@ -8,9 +8,12 @@ CBP/nC contexts.  SPS/PPS pass through untouched (QP lives in the slice
 header).  Prediction drift is accepted and resets at every IDR, which in
 the all-intra camera configs this ladder targets means every frame.
 
-Streams outside the supported profile (CABAC, inter slices, I_16x16,
-chroma residuals) PASS THROUGH unchanged and are counted — the rung
-never corrupts what it cannot parse."""
+Scope: CAVLC baseline-intra slices of I_4x4 and I_16x16 macroblocks
+(luma residuals; I_16x16 DC Hadamard + AC blocks, QPY ≥ 12 where the
++6k shift is exact for the DC dequant too).  Streams outside that
+profile (CABAC, inter slices, chroma residuals, low-QP I_16x16) PASS
+THROUGH unchanged and are counted — the rung never corrupts what it
+cannot parse."""
 
 from __future__ import annotations
 
@@ -19,7 +22,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
-from .h264_intra import Pps, SliceCodec, Sps
+from .h264_intra import MacroblockI16x16, Pps, SliceCodec, Sps
 from .h264_transform import requant_levels_scalar
 
 
@@ -143,13 +146,28 @@ class SliceRequantizer:
             raise ValueError("qp already at ladder ceiling")
 
         # gather every block with its per-MB source/target QP; the +6k
-        # step is uniform so every MB shifts by the same k
+        # step is uniform so every MB shifts by the same k.  I_16x16 MBs
+        # contribute a DC row + 16 zero-padded 15-coeff AC rows (the op
+        # is elementwise, padding stays zero); a row map routes results
+        # back to the right structure
         all_levels = []
         qps = []
-        for mb in mbs:
-            all_levels.append(mb.levels)          # scan order is fine:
-            qps.extend([mb.qp] * 16)              # the op is elementwise
-        batch = np.concatenate(all_levels, axis=0)          # [16·n_mbs, 16]
+        row_map = []                   # (mb_index, kind, blk)
+        for i, mb in enumerate(mbs):
+            if isinstance(mb, MacroblockI16x16):
+                all_levels.append(mb.dc_levels[None, :])
+                row_map.append((i, "dc", 0))
+                qps.append(mb.qp)
+                ac = np.zeros((16, 16), dtype=np.int64)
+                ac[:, :15] = mb.ac_levels
+                all_levels.append(ac)
+                row_map.extend((i, "ac", b) for b in range(16))
+                qps.extend([mb.qp] * 16)
+            else:
+                all_levels.append(mb.levels)
+                row_map.extend((i, "l4", b) for b in range(16))
+                qps.extend([mb.qp] * 16)
+        batch = np.concatenate(all_levels, axis=0)
         qps = np.asarray(qps)
         self.stats.blocks += batch.shape[0]
         requanted = self.requant_fn(batch, qps, qps + self.delta_qp)
@@ -157,13 +175,23 @@ class SliceRequantizer:
         # write back + recompute CBP and the shifted absolute QP per MB;
         # the writer re-derives deltas vs the previous CODED MB, so a
         # cleared-CBP MB's QP correctly stops influencing the chain
-        for i, mb in enumerate(mbs):
-            mb.levels = requanted[16 * i:16 * i + 16]
-            cbp = 0
-            for g in range(4):
-                if np.any(mb.levels[4 * g:4 * g + 4]):
-                    cbp |= 1 << g
-            mb.cbp = cbp
+        for r, (i, kind, b) in enumerate(row_map):
+            mb = mbs[i]
+            if kind == "dc":
+                mb.dc_levels = requanted[r]
+            elif kind == "ac":
+                mb.ac_levels[b] = requanted[r, :15]
+            else:
+                mb.levels[b] = requanted[r]
+        for mb in mbs:
+            if isinstance(mb, MacroblockI16x16):
+                mb.luma_cbp15 = bool(np.any(mb.ac_levels))
+            else:
+                cbp = 0
+                for g in range(4):
+                    if np.any(mb.levels[4 * g:4 * g + 4]):
+                        cbp |= 1 << g
+                mb.cbp = cbp
             mb.qp = mb.qp + self.delta_qp
         bw = BitWriter()
         codec.write_slice_header(bw, hdr, qp_out_base)

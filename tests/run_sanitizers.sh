@@ -28,6 +28,7 @@ run_one() {
         python -m pytest tests/test_native_core.py \
         "tests/test_h264_codec.py::test_native_requant_matches_python_byte_for_byte" \
         "tests/test_h264_codec.py::test_native_requant_rejects_garbage_cleanly" \
+        "tests/test_h264_codec.py::test_i16x16_native_matches_python" \
         -q -p no:cacheprovider
 }
 

@@ -316,3 +316,108 @@ def test_native_requant_rejects_garbage_cleanly():
     rq.transform_nal(pps_nal)
     rq.transform_nal(bytes([0x65, 0xFF, 0xFF]))
     assert rq.stats.slices_passed_through == 1
+
+
+# ---------------------------------------------------------------- I_16x16
+
+def _mixed_slice(rng, sps, pps, qp, dense=False):
+    """Synthetic slice mixing I_16x16 and I_4x4 MBs (no pixel source —
+    the requant path needs only parse→shift→re-encode consistency)."""
+    from easydarwin_tpu.codecs.h264_bits import BitWriter, rbsp_to_nal
+    from easydarwin_tpu.codecs.h264_intra import (MacroblockI4x4,
+                                                  MacroblockI16x16,
+                                                  SliceCodec, SliceHeader)
+    codec = SliceCodec(sps, pps)
+    n = sps.width_mbs * sps.height_mbs
+    mbs = []
+    for i in range(n):
+        kind = i % 3
+        if kind == 0:                  # I_16x16 with ACs
+            dc = np.zeros(16, np.int64)
+            dc[:6] = rng.integers(-9, 9, 6)
+            ac = np.zeros((16, 15), np.int64)
+            ac[:, :6 if dense else 3] = rng.integers(
+                -8, 8, (16, 6 if dense else 3))
+            mbs.append(MacroblockI16x16(int(rng.integers(0, 4)), 0, True,
+                                        qp, dc, ac))
+        elif kind == 1:                # I_16x16 DC-only
+            dc = np.zeros(16, np.int64)
+            dc[:4] = rng.integers(-5, 5, 4)
+            mbs.append(MacroblockI16x16(int(rng.integers(0, 4)), 0, False,
+                                        qp, dc, np.zeros((16, 15),
+                                                         np.int64)))
+        else:                          # I_4x4
+            lv = np.zeros((16, 16), np.int64)
+            lv[:, :4] = rng.integers(-20, 20, (16, 4))
+            cbp = 0
+            for g in range(4):
+                if np.any(lv[4 * g:4 * g + 4]):
+                    cbp |= 1 << g
+            mbs.append(MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, lv))
+    bw = BitWriter()
+    codec.write_slice_header(bw, SliceHeader(qp=qp), qp)
+    codec.write_mbs(bw, mbs, qp)
+    bw.rbsp_trailing()
+    return bytes([0x65]) + rbsp_to_nal(bw.to_bytes()), mbs
+
+
+def test_i16x16_mixed_slice_requant_python():
+    from easydarwin_tpu.codecs.h264_bits import BitReader, nal_to_rbsp
+    from easydarwin_tpu.codecs.h264_intra import (MacroblockI16x16,
+                                                  SliceCodec)
+    rng = np.random.default_rng(7)
+    sps, pps = Sps(4, 3), Pps(pic_init_qp=26)
+    qp = 28
+    nal, mbs = _mixed_slice(rng, sps, pps, qp)
+    rq = SliceRequantizer(6, prefer_native=False)
+    rq.sps, rq.pps = sps, pps
+    out = rq.transform_nal(nal)
+    assert rq.stats.slices_requantized == 1
+    assert len(out) < len(nal)
+    codec = SliceCodec(sps, pps)
+    br = BitReader(nal_to_rbsp(out[1:]))
+    hdr = codec.parse_slice_header(br, 0x65)
+    assert hdr.qp == qp + 6
+    back = codec.parse_mbs(br, hdr.qp)
+    for a, b in zip(mbs, back):
+        if isinstance(a, MacroblockI16x16):
+            assert isinstance(b, MacroblockI16x16)
+            exp = requant_levels_scalar(a.dc_levels, qp, qp + 6)
+            np.testing.assert_array_equal(b.dc_levels, exp)
+            pad = np.zeros((16, 16), np.int64)
+            pad[:, :15] = a.ac_levels
+            exp_ac = requant_levels_scalar(pad, qp, qp + 6)[:, :15]
+            np.testing.assert_array_equal(b.ac_levels, exp_ac)
+            assert b.qp == qp + 6
+
+
+def test_i16x16_native_matches_python():
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    rng = np.random.default_rng(11)
+    for qp in (24, 30):
+        for dense in (False, True):
+            sps, pps = Sps(4, 3), Pps(pic_init_qp=26)
+            nal, _ = _mixed_slice(rng, sps, pps, qp, dense=dense)
+            py = SliceRequantizer(6, prefer_native=False)
+            nat = SliceRequantizer(6)
+            for rq in (py, nat):
+                rq.sps, rq.pps = sps, pps
+            out_py = py.transform_nal(nal)
+            out_nat = nat.transform_nal(nal)
+            assert out_py == out_nat, (qp, dense)
+            assert nat.stats.native_slices == 1
+
+
+def test_i16x16_low_qp_passes_through():
+    """qp < 12 breaks the exact-shift argument for the DC Hadamard
+    dequant: both engines must pass through, not approximate."""
+    rng = np.random.default_rng(3)
+    sps, pps = Sps(2, 2), Pps(pic_init_qp=26)
+    nal, _ = _mixed_slice(rng, sps, pps, 10)
+    for prefer in (False, True):
+        rq = SliceRequantizer(6, prefer_native=prefer)
+        rq.sps, rq.pps = sps, pps
+        assert rq.transform_nal(nal) == nal
+        assert rq.stats.slices_passed_through == 1
