@@ -371,12 +371,16 @@ int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
 int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
                       int64_t *ring_arrival, int32_t capacity,
                       int32_t slot_size, int64_t now_ms, int64_t *head,
-                      int32_t max_pkts) {
-  int32_t total = 0;
+                      int32_t max_pkts, int32_t *oversize_dropped) {
+  int32_t total = 0;      // datagrams ADMITTED into the ring
+  int32_t processed = 0;  // datagrams consumed from the socket — this is
+                          // what max_pkts bounds, so an oversize flood
+                          // (every datagram dropped) cannot extend one
+                          // drain call past the caller's work budget
   std::vector<mmsghdr> msgs(kRecvBatch);
   std::vector<iovec> iovs(kRecvBatch);
-  while (total < max_pkts) {
-    int want = std::min<int32_t>(kRecvBatch, max_pkts - total);
+  while (processed < max_pkts) {
+    int want = std::min<int32_t>(kRecvBatch, max_pkts - processed);
     for (int i = 0; i < want; ++i) {
       int64_t slot = (*head + i) % capacity;
       iovs[i].iov_base = ring_data + slot * slot_size;
@@ -395,21 +399,36 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
       return total > 0 ? total : -errno;
     }
     if (n == 0) break;
+    int wrote = 0;
     for (int i = 0; i < n; ++i) {
-      int64_t slot = (*head + i) % capacity;
+      int64_t src = (*head + i) % capacity;
+      // a kernel-truncated datagram (larger than the slot) is DROPPED,
+      // not admitted capped — a truncated slot would relay a corrupt
+      // packet to every consumer (mirrors PacketRing.push's oversize
+      // drop on the Python ingest path)
+      if (msgs[i].msg_hdr.msg_flags & MSG_TRUNC) {
+        if (oversize_dropped) ++*oversize_dropped;
+        continue;
+      }
       int32_t len = static_cast<int32_t>(msgs[i].msg_len);
-      if (len > slot_size) len = slot_size;  // kernel-truncated datagram
-      ring_len[slot] = len;
-      ring_arrival[slot] = now_ms;
+      int64_t dst = (*head + wrote) % capacity;
+      if (dst != src)                      // compact over dropped slots
+        std::memmove(ring_data + dst * slot_size,
+                     ring_data + src * slot_size,
+                     static_cast<size_t>(len));
+      ring_len[dst] = len;
+      ring_arrival[dst] = now_ms;
       // preserve the ring's zero-padded-slot invariant (a reused slot
       // would otherwise leak its previous occupant's bytes past len into
       // the device prefix staging)
       if (len < slot_size)
-        std::memset(ring_data + slot * slot_size + len, 0,
+        std::memset(ring_data + dst * slot_size + len, 0,
                     static_cast<size_t>(slot_size - len));
+      ++wrote;
     }
-    *head += n;
-    total += n;
+    *head += wrote;
+    total += wrote;
+    processed += n;
     if (n < want) break;
   }
   return total;

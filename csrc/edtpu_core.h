@@ -129,12 +129,15 @@ int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
 
 /* Drain up to max_pkts datagrams from fd (non-blocking, recvmmsg) directly
  * into ring slots starting at *head (mod capacity), writing lengths and
- * arrival_ms.  Returns datagrams read (0 if none), negative errno on error;
- * *head is advanced. */
+ * arrival_ms.  Returns datagrams ADMITTED (0 if none), negative errno on
+ * error; *head is advanced.  Kernel-truncated datagrams (larger than the
+ * slot) are dropped, compacted over, and counted into *oversize_dropped
+ * (nullable) — a truncated slot would relay a corrupt packet. */
 int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
                       int64_t *ring_arrival, int32_t capacity,
                       int32_t slot_size, int64_t now_ms,
-                      int64_t *head, int32_t max_pkts);
+                      int64_t *head, int32_t max_pkts,
+                      int32_t *oversize_dropped);
 
 /* Discard-drain every pending datagram on each fd (recvmmsg, MSG_DONTWAIT).
  * A cheap stand-in for N subscriber read loops: one syscall drains a batch,
@@ -152,8 +155,10 @@ int64_t ed_udp_drain_ex(const int32_t *fds, int32_t n_fds,
 /* -------------------------------------------------------- H.264 requant */
 
 /* Native CAVLC slice requantizer (the HLS q-rung hot path) — decodes a
- * baseline-intra I_4x4 slice, shifts every residual level by
- * delta_qp/6 bits (exact +6k QP requant), re-encodes with recomputed
+ * baseline-intra slice (I_4x4 + I_16x16, luma and 4:2:0 chroma
+ * residuals), requantizes every level delta_qp steps coarser (luma:
+ * exact +6k shift; chroma: Table 8-15 QPc mapping with identity /
+ * shift / integer-round-trip dispatch), re-encodes with recomputed
  * CBP/nC contexts and QP chain.  Bit-exact vs the Python oracle
  * (codecs/h264_requant.py); tables generated from the Python source
  * (gen_h264_tables.py).  Returns the output NAL length written to out,
@@ -164,7 +169,7 @@ int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp);
+    int32_t delta_qp, int32_t chroma_qp_offset);
 
 /* ------------------------------------------------------------- timer wheel */
 

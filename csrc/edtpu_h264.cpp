@@ -138,8 +138,34 @@ struct DecodeLuts {
   std::vector<uint32_t> ct[3];       // [1<<16]
   std::vector<uint16_t> tz[15];      // [1<<9]  len<<8 | total_zeros
   std::vector<uint16_t> rb[7];       // [1<<3]  len<<8 | run
+  std::vector<uint32_t> ctc;         // [1<<8]  chroma DC coeff_token
+  std::vector<uint16_t> tzc[3];      // [1<<3]  chroma DC total_zeros
 
   DecodeLuts() {
+    ctc.assign(1 << 8, 0);
+    for (int tc = 0; tc <= 4; ++tc)
+      for (int t1 = 0; t1 < 4; ++t1) {
+        uint32_t e = tc <= 4 ? kCoeffTokenCdc[tc][t1] : 0;
+        if (!e) continue;
+        int n = static_cast<int>(e >> 24);
+        uint32_t code = (e & 0xFFFFFF) << (8 - n);
+        uint32_t entry = (static_cast<uint32_t>(n) << 16) |
+                         (static_cast<uint32_t>(tc) << 8) |
+                         static_cast<uint32_t>(t1);
+        for (uint32_t i = 0; i < (1u << (8 - n)); ++i)
+          ctc[code + i] = entry;
+      }
+    for (int t = 0; t < 3; ++t) {
+      tzc[t].assign(1 << 3, 0);
+      for (int z = 0; z < 4; ++z) {
+        uint32_t e = kTotalZerosCdc[t][z];
+        if (!e) continue;
+        int n = static_cast<int>(e >> 24);
+        uint32_t code = (e & 0xFFFFFF) << (3 - n);
+        for (uint32_t i = 0; i < (1u << (3 - n)); ++i)
+          tzc[t][code + i] = static_cast<uint16_t>((n << 8) | z);
+      }
+    }
     for (int cls = 0; cls < 3; ++cls) {
       ct[cls].assign(1 << 16, 0);
       for (int tc = 0; tc <= 16; ++tc)
@@ -186,6 +212,14 @@ const DecodeLuts &luts() {
 }
 
 bool read_coeff_token(BitReader &br, int nC, int *total, int *t1s) {
+  if (nC < 0) {                        // chroma DC (4:2:0)
+    uint32_t entry = luts().ctc[br.peek(8)];
+    if (!entry) return false;
+    if (!br.advance(static_cast<int>(entry >> 16))) return false;
+    *total = static_cast<int>((entry >> 8) & 0xFF);
+    *t1s = static_cast<int>(entry & 0xFF);
+    return true;
+  }
   int cls = ct_class(nC);
   if (cls == 3) {
     uint32_t v = br.bits(6);
@@ -208,6 +242,12 @@ bool read_coeff_token(BitReader &br, int nC, int *total, int *t1s) {
 }
 
 bool write_coeff_token(BitWriter &bw, int nC, int total, int t1s) {
+  if (nC < 0) {
+    uint32_t e = total <= 4 ? kCoeffTokenCdc[total][t1s] : 0;
+    if (!e) return false;
+    bw.bits(e & 0xFFFFFF, e >> 24);
+    return true;
+  }
   int cls = ct_class(nC);
   if (cls == 3) {
     uint32_t v = total == 0 ? 0b000011
@@ -224,6 +264,14 @@ bool write_coeff_token(BitWriter &bw, int nC, int total, int t1s) {
 
 bool read_total_zeros(BitReader &br, int total, int *tz) {
   uint16_t entry = luts().tz[total - 1][br.peek(9)];
+  if (!entry) return false;
+  if (!br.advance(entry >> 8)) return false;
+  *tz = entry & 0xFF;
+  return true;
+}
+
+bool read_total_zeros_cdc(BitReader &br, int total, int *tz) {
+  uint16_t entry = luts().tzc[total - 1][br.peek(3)];
   if (!entry) return false;
   if (!br.advance(entry >> 8)) return false;
   *tz = entry & 0xFF;
@@ -301,8 +349,11 @@ bool decode_residual_n(BitReader &br, int nC, int16_t *levels, int maxc) {
   }
   if (total > maxc) return false;
   int total_zeros = 0;
-  if (total < maxc && !read_total_zeros(br, total, &total_zeros))
-    return false;
+  if (total < maxc) {
+    bool ok = maxc == 4 ? read_total_zeros_cdc(br, total, &total_zeros)
+                        : read_total_zeros(br, total, &total_zeros);
+    if (!ok) return false;
+  }
   int zeros_left = total_zeros;
   int pos = total + total_zeros - 1;
   for (int i = 0; i < nvals; ++i) {
@@ -393,7 +444,8 @@ bool encode_residual_n(BitWriter &bw, const int16_t *levels, int nC,
   int highest = idxs[total - 1];
   int total_zeros = highest + 1 - total;
   if (total < maxc) {
-    uint32_t e = kTotalZeros[total - 1][total_zeros];
+    uint32_t e = maxc == 4 ? kTotalZerosCdc[total - 1][total_zeros]
+                           : kTotalZeros[total - 1][total_zeros];
     if (!e) return false;
     bw.bits(e & 0xFFFFFF, e >> 24);
   }
@@ -458,6 +510,135 @@ inline void blk_xy(int i, int *x, int *y) {
   *y = 2 * ((i >> 3) & 1) + ((i >> 1) & 1);
 }
 
+// ------------------------------------------------------- chroma requant
+// Mirrors codecs/h264_transform.requant_chroma_scalar BIT-EXACTLY (same
+// clips: the scalar module documents the overflow contract).  Per-MB
+// three-way dispatch: identity (Table 8-15 saturation), exact +6k level
+// shift, or the open-loop integer round trip (8.5.11 DC + 8.5.12 AC
+// dequant → inverse core transform → JM forward requant at qpc_out).
+
+constexpr int64_t kResClip = 4095;   // h264_transform.RES_CLIP
+constexpr int64_t kWClip = 131071;   // h264_transform.W_CLIP
+
+inline int64_t clip64(int64_t v, int64_t c) {
+  return v > c ? c : (v < -c ? -c : v);
+}
+
+inline int64_t dz_shift(int64_t v, int k, int64_t dz) {
+  int64_t a = (v < 0 ? -v : v) + dz;
+  a >>= k;
+  return v < 0 ? -a : a;
+}
+
+inline void hadamard2x2(const int64_t *c, int64_t *f) {
+  f[0] = c[0] + c[1] + c[2] + c[3];
+  f[1] = c[0] - c[1] + c[2] - c[3];
+  f[2] = c[0] + c[1] - c[2] - c[3];
+  f[3] = c[0] - c[1] - c[2] + c[3];
+}
+
+inline void inv_core4(int64_t *w) {     // rows then cols, in place
+  for (int r = 0; r < 4; ++r) {
+    int64_t a = w[4 * r], b = w[4 * r + 1], c = w[4 * r + 2],
+            d = w[4 * r + 3];
+    int64_t e0 = a + c, e1 = a - c, e2 = (b >> 1) - d, e3 = b + (d >> 1);
+    w[4 * r] = e0 + e3;
+    w[4 * r + 1] = e1 + e2;
+    w[4 * r + 2] = e1 - e2;
+    w[4 * r + 3] = e0 - e3;
+  }
+  for (int col = 0; col < 4; ++col) {
+    int64_t a = w[col], b = w[4 + col], c = w[8 + col], d = w[12 + col];
+    int64_t e0 = a + c, e1 = a - c, e2 = (b >> 1) - d, e3 = b + (d >> 1);
+    w[col] = e0 + e3;
+    w[4 + col] = e1 + e2;
+    w[8 + col] = e1 - e2;
+    w[12 + col] = e0 - e3;
+  }
+}
+
+inline void fwd_core4(int64_t *x) {     // exact integer Cf·X·Cfᵀ
+  for (int r = 0; r < 4; ++r) {
+    int64_t x0 = x[4 * r], x1 = x[4 * r + 1], x2 = x[4 * r + 2],
+            x3 = x[4 * r + 3];
+    int64_t t0 = x0 + x3, t1 = x1 + x2, t2 = x1 - x2, t3 = x0 - x3;
+    x[4 * r] = t0 + t1;
+    x[4 * r + 1] = 2 * t3 + t2;
+    x[4 * r + 2] = t0 - t1;
+    x[4 * r + 3] = t3 - 2 * t2;
+  }
+  for (int col = 0; col < 4; ++col) {
+    int64_t x0 = x[col], x1 = x[4 + col], x2 = x[8 + col],
+            x3 = x[12 + col];
+    int64_t t0 = x0 + x3, t1 = x1 + x2, t2 = x1 - x2, t3 = x0 - x3;
+    x[col] = t0 + t1;
+    x[4 + col] = 2 * t3 + t2;
+    x[8 + col] = t0 - t1;
+    x[12 + col] = t3 - 2 * t2;
+  }
+}
+
+// dc: 16-wide row (4 used, 2×2 raster); ac: 4 rows of 16 (15 used,
+// zigzag tails).  Rewrites both at qpc_out.
+//
+// Clip contract: decode_residual_n clamps every parsed level to
+// ±kLevelClip at store time, so the identity and shift arms below see
+// pre-clipped inputs — byte-identical to the Python oracle, which parses
+// unclipped and clamps inside requant_chroma_scalar instead.
+void chroma_requant_comp(int16_t *dc, int16_t *ac, int qpc_in,
+                         int qpc_out) {
+  int delta = qpc_out - qpc_in;
+  if (delta == 0) return;
+  if (delta % 6 == 0 && delta > 0) {
+    int k = delta / 6;
+    int64_t dz = (1 << k) / 3;
+    for (int i = 0; i < 4; ++i)
+      dc[i] = static_cast<int16_t>(dz_shift(dc[i], k, dz));
+    for (int b = 0; b < 4; ++b)
+      for (int i = 0; i < 15; ++i)
+        ac[16 * b + i] =
+            static_cast<int16_t>(dz_shift(ac[16 * b + i], k, dz));
+    return;
+  }
+  int mi = qpc_in % 6, si = qpc_in / 6;
+  int mo = qpc_out % 6, so = qpc_out / 6;
+  int64_t c[4], f2[4], dcc[4], w00[4];
+  for (int i = 0; i < 4; ++i) c[i] = clip64(dc[i], kLevelClip);
+  hadamard2x2(c, f2);
+  for (int i = 0; i < 4; ++i)
+    dcc[i] = (f2[i] * kVPos[mi][0] * (1LL << si)) >> 1;
+  int qbits = 15 + so;
+  int64_t off = (1LL << qbits) / 3;
+  for (int b = 0; b < 4; ++b) {
+    int64_t w[16] = {0};
+    for (int i = 0; i < 15; ++i) {
+      int pos = kZigzag4[1 + i];
+      w[pos] = clip64(ac[16 * b + i], kLevelClip) * kVPos[mi][pos] *
+               (1LL << si);
+    }
+    w[0] = dcc[b];
+    inv_core4(w);
+    for (int i = 0; i < 16; ++i) w[i] = clip64((w[i] + 32) >> 6, kResClip);
+    fwd_core4(w);
+    for (int i = 0; i < 16; ++i) w[i] = clip64(w[i], kWClip);
+    w00[b] = w[0];
+    for (int i = 0; i < 15; ++i) {
+      int pos = kZigzag4[1 + i];
+      int64_t a = w[pos] < 0 ? -w[pos] : w[pos];
+      int64_t q = (a * kMFPos[mo][pos] + off) >> qbits;
+      ac[16 * b + i] =
+          static_cast<int16_t>(clip64(w[pos] < 0 ? -q : q, kLevelClip));
+    }
+  }
+  hadamard2x2(w00, f2);
+  for (int i = 0; i < 4; ++i) {
+    int64_t v = clip64(f2[i], kWClip);
+    int64_t a = v < 0 ? -v : v;
+    int64_t q = (a * kMFPos[mo][0] + 2 * off) >> (qbits + 1);
+    dc[i] = static_cast<int16_t>(clip64(v < 0 ? -q : q, kLevelClip));
+  }
+}
+
 struct SliceHeader {
   int nal_type, nal_ref_idc, slice_type;
   uint32_t frame_num, idr_pic_id, poc_lsb;
@@ -474,7 +655,7 @@ extern "C" int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp) {
+    int32_t delta_qp, int32_t chroma_qp_offset) {
   if (nal_len < 2 || delta_qp < 6 || delta_qp % 6) return kErrUnsupported;
   uint8_t nal_byte = nal[0];
   int nal_type = nal_byte & 0x1F;
@@ -532,6 +713,13 @@ extern "C" int32_t ed_h264_requant_slice(
   std::vector<uint8_t> mb_modes(static_cast<size_t>(n_mbs) * 16 * 2);
   std::vector<uint32_t> mb_chroma(n_mbs);
   std::vector<int16_t> totals(static_cast<size_t>(h4) * w4, -1);
+  // chroma residual state: per-component DC rows (16-wide, 4 used),
+  // AC rows (4×16, 15 used), post-requant chroma CBP, nC context grids
+  int w2 = width_mbs * 2, h2 = height_mbs * 2;
+  std::vector<int16_t> cdc(static_cast<size_t>(n_mbs) * 2 * 16);
+  std::vector<int16_t> cac(static_cast<size_t>(n_mbs) * 2 * 4 * 16);
+  std::vector<uint8_t> mb_ccbp(n_mbs);
+  std::vector<int16_t> tot_c(static_cast<size_t>(2) * h2 * w2, -1);
 
   auto nc_at = [&](int gx, int gy) -> int {
     int nA = gx > 0 ? totals[static_cast<size_t>(gy) * w4 + gx - 1] : -1;
@@ -540,6 +728,78 @@ extern "C" int32_t ed_h264_requant_slice(
     if (nA >= 0) return nA;
     if (nB >= 0) return nB;
     return 0;
+  };
+  auto nc_at_c = [&](int comp, int gx, int gy) -> int {
+    const int16_t *g = &tot_c[static_cast<size_t>(comp) * h2 * w2];
+    int nA = gx > 0 ? g[static_cast<size_t>(gy) * w2 + gx - 1] : -1;
+    int nB = gy > 0 ? g[static_cast<size_t>(gy - 1) * w2 + gx] : -1;
+    if (nA >= 0 && nB >= 0) return (nA + nB + 1) >> 1;
+    if (nA >= 0) return nA;
+    if (nB >= 0) return nB;
+    return 0;
+  };
+  auto qpc_of = [&](int32_t qpy) -> int {
+    int q = qpy + chroma_qp_offset;
+    if (q < 0) q = 0;
+    if (q > 51) q = 51;
+    return kChromaQp[q];
+  };
+  // parse (decode=true) or emit (decode=false) one MB's chroma
+  // residuals in 7.3.5.3.3 order, requantizing right after parse; on
+  // the emit side tot_c carries the POST-requant TotalCoeff contexts.
+  BitWriter *cw = nullptr;           // set during the encode pass
+  auto chroma_mb = [&](void *bio, int mb, int ccbp, int32_t qpy,
+                       bool decode) -> bool {
+    int mbx2 = (mb % width_mbs) * 2, mby2 = (mb / width_mbs) * 2;
+    int16_t *dcrows = &cdc[static_cast<size_t>(mb) * 2 * 16];
+    int16_t *acrows = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
+    if (ccbp) {
+      for (int comp = 0; comp < 2; ++comp) {
+        if (decode) {
+          if (!decode_residual_n(*static_cast<BitReader *>(bio), -1,
+                                 dcrows + comp * 16, 4))
+            return false;
+        } else if (!encode_residual_n(*cw, dcrows + comp * 16, -1, 4)) {
+          return false;
+        }
+      }
+    }
+    for (int comp = 0; comp < 2; ++comp) {
+      int16_t *g = &tot_c[static_cast<size_t>(comp) * h2 * w2];
+      for (int b = 0; b < 4; ++b) {
+        int gx = mbx2 + (b & 1), gy = mby2 + (b >> 1);
+        int16_t *lv = acrows + (comp * 4 + b) * 16;
+        if (ccbp != 2) {
+          g[static_cast<size_t>(gy) * w2 + gx] = 0;
+          continue;
+        }
+        int nC = nc_at_c(comp, gx, gy);
+        if (decode) {
+          if (!decode_residual_n(*static_cast<BitReader *>(bio), nC, lv,
+                                 15))
+            return false;
+        } else if (!encode_residual_n(*cw, lv, nC, 15)) {
+          return false;
+        }
+        int tot = 0;
+        for (int i = 0; i < 15; ++i) tot += lv[i] != 0;
+        g[static_cast<size_t>(gy) * w2 + gx] = static_cast<int16_t>(tot);
+      }
+    }
+    if (decode) {
+      if (!ccbp) {                     // nothing parsed, nothing to shift
+        mb_ccbp[mb] = 0;
+        return true;
+      }
+      for (int comp = 0; comp < 2; ++comp)
+        chroma_requant_comp(dcrows + comp * 16, acrows + comp * 4 * 16,
+                            qpc_of(qpy), qpc_of(qpy + delta_qp));
+      bool any_ac = false, any_dc = false;
+      for (int i = 0; i < 2 * 16; ++i) any_dc |= dcrows[i] != 0;
+      for (int i = 0; i < 2 * 4 * 16; ++i) any_ac |= acrows[i] != 0;
+      mb_ccbp[mb] = any_ac ? 2 : (any_dc ? 1 : 0);
+    }
+    return true;
   };
   auto shift_row = [&](int16_t *lv, int n, int kk, int dz) {
     bool any = false;
@@ -566,7 +826,6 @@ extern "C" int32_t ed_h264_requant_slice(
       int pred = static_cast<int>(mb_type - 1) % 4;
       int chroma_cbp = (static_cast<int>(mb_type - 1) / 4) % 3;
       bool luma15 = mb_type >= 13;
-      if (chroma_cbp) return kErrUnsupported;
       mb_is16[mb] = 1;
       mb_pred16[mb] = static_cast<uint8_t>(pred);
       mb_chroma[mb] = br.ue();
@@ -600,6 +859,8 @@ extern "C" int32_t ed_h264_requant_slice(
         any_ac |= shift_row(lv, 15, k, deadzone);
       }
       mb_cbp[mb] = any_ac ? 15 : 0;      // luma CBP after requant
+      if (!chroma_mb(&br, mb, chroma_cbp, cur_qp, true))
+        return kErrBitstream;
       continue;
     }
     if (mb_type != 0) return kErrUnsupported;      // inter etc.
@@ -614,7 +875,6 @@ extern "C" int32_t ed_h264_requant_slice(
     uint32_t code = br.ue();
     if (code >= 48) return kErrBitstream;
     int cbp = kCbpIntraFromCode[code];
-    if (cbp >> 4) return kErrUnsupported;          // chroma residuals
     if (cbp) {
       cur_qp += br.se();                           // cumulative (7.4.5)
       if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
@@ -645,6 +905,8 @@ extern "C" int32_t ed_h264_requant_slice(
       if (shift_row(lv, 16, k, deadzone)) out_cbp |= 1 << (b >> 2);
     }
     mb_cbp[mb] = out_cbp;
+    if (!chroma_mb(&br, mb, cbp >> 4, cur_qp, true))
+      return kErrBitstream;
   }
   if (!br.ok) return kErrBitstream;
   if (max_qp + delta_qp > 51) return kErrUnsupported;  // ladder ceiling
@@ -676,12 +938,14 @@ extern "C" int32_t ed_h264_requant_slice(
   }
 
   std::fill(totals.begin(), totals.end(), static_cast<int16_t>(-1));
+  std::fill(tot_c.begin(), tot_c.end(), static_cast<int16_t>(-1));
+  cw = &bw;
   int32_t prev_qp = qp_out_base;
   for (int mb = 0; mb < n_mbs; ++mb) {
     int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
     if (mb_is16[mb]) {
       bool luma15 = mb_cbp[mb] == 15;
-      bw.ue(1 + mb_pred16[mb] + (luma15 ? 12 : 0));
+      bw.ue(1 + mb_pred16[mb] + 4 * mb_ccbp[mb] + (luma15 ? 12 : 0));
       bw.ue(mb_chroma[mb]);
       int32_t qp_out_mb = mb_qp[mb] + delta_qp;
       int32_t delta = qp_out_mb - prev_qp;
@@ -707,6 +971,8 @@ extern "C" int32_t ed_h264_requant_slice(
         totals[static_cast<size_t>(gy) * w4 + gx] =
             static_cast<int16_t>(tot);
       }
+      if (!chroma_mb(nullptr, mb, mb_ccbp[mb], 0, false))
+        return kErrBitstream;
       continue;
     }
     bw.ue(0);                                      // mb_type I_4x4
@@ -717,7 +983,7 @@ extern "C" int32_t ed_h264_requant_slice(
         bw.bits(mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1], 3);
     }
     bw.ue(mb_chroma[mb]);
-    int cbp = mb_cbp[mb];
+    int cbp = mb_cbp[mb] | (mb_ccbp[mb] << 4);
     bw.ue(kCbpIntraToCode[cbp]);
     int32_t qp_out_mb = mb_qp[mb] + delta_qp;
     if (cbp) {
@@ -742,6 +1008,8 @@ extern "C" int32_t ed_h264_requant_slice(
       totals[static_cast<size_t>(gy) * w4 + gx] =
           static_cast<int16_t>(tot);
     }
+    if (!chroma_mb(nullptr, mb, mb_ccbp[mb], 0, false))
+      return kErrBitstream;
   }
   bw.trailing();
 

@@ -5,9 +5,9 @@ requantizes the levels on the device, and re-encodes at the new QP.
 Tables are the spec's (ITU-T H.264 Tables 9-5, 9-7/9-8, 9-10); the test
 suite checks them for prefix-freeness and against the published worked
 example (Richardson, *H.264 and MPEG-4 Video Compression*, the classic
-TotalCoeff=5/T1s=3 block).  Chroma-DC tables are omitted: the transcode
-tier codes luma residuals only (chroma rides prediction, see
-``h264_intra``)."""
+TotalCoeff=5/T1s=3 block).  ``nC == -1`` selects the 4:2:0 chroma-DC
+column of Table 9-5 (with Table 9-9(a) total_zeros) so the transcode
+tier covers chroma residuals too."""
 
 from __future__ import annotations
 
@@ -116,12 +116,25 @@ _CT_NC4 = {   # 4 <= nC < 8
 }
 
 
+#: Table 9-5's nC == −1 column: chroma DC (4:2:0, maxNumCoeff 4).
+_CT_CDC = {
+    (0, 0): (2, 0b01),
+    (1, 0): (6, 0b000111), (1, 1): (1, 0b1),
+    (2, 0): (6, 0b000100), (2, 1): (6, 0b000110), (2, 2): (3, 0b001),
+    (3, 0): (6, 0b000011), (3, 1): (7, 0b0000011),
+    (3, 2): (7, 0b0000010), (3, 3): (6, 0b000101),
+    (4, 0): (6, 0b000010), (4, 1): (8, 0b00000011),
+    (4, 2): (8, 0b00000010), (4, 3): (7, 0b0000000),
+}
+
+
 def _invert(table):
     return {(n, v): key for key, (n, v) in table.items()}
 
 
 _CT_TABLES = (_CT_NC0, _CT_NC2, _CT_NC4)
 _CT_DECODE = tuple(_invert(t) for t in _CT_TABLES)
+_CT_CDC_DECODE = _invert(_CT_CDC)
 
 
 def _ct_class(nC: int) -> int:
@@ -135,6 +148,10 @@ def _ct_class(nC: int) -> int:
 
 
 def write_coeff_token(bw: BitWriter, nC: int, total: int, t1s: int) -> None:
+    if nC < 0:                          # chroma DC (4:2:0)
+        n, v = _CT_CDC[(total, t1s)]
+        bw.write_bits(v, n)
+        return
     cls = _ct_class(nC)
     if cls == 3:
         v = 0b000011 if total == 0 else (((total - 1) << 2) | t1s)
@@ -145,16 +162,21 @@ def write_coeff_token(bw: BitWriter, nC: int, total: int, t1s: int) -> None:
 
 
 def read_coeff_token(br: BitReader, nC: int) -> tuple[int, int]:
-    cls = _ct_class(nC)
-    if cls == 3:
-        v = br.read_bits(6)
-        if v == 0b000011:
-            return 0, 0
-        return (v >> 2) + 1, v & 3
-    table = _CT_DECODE[cls]
+    if nC < 0:
+        table = _CT_CDC_DECODE
+        max_bits = 8
+    else:
+        cls = _ct_class(nC)
+        if cls == 3:
+            v = br.read_bits(6)
+            if v == 0b000011:
+                return 0, 0
+            return (v >> 2) + 1, v & 3
+        table = _CT_DECODE[cls]
+        max_bits = 17
     n = 0
     v = 0
-    while n < 17:
+    while n < max_bits:
         v = (v << 1) | br.read_bit()
         n += 1
         hit = table.get((n, v))
@@ -218,14 +240,28 @@ _TZ = [
 ]
 _TZ_DECODE = [{(n, v): tz for tz, (n, v) in enumerate(row)} for row in _TZ]
 
+#: Table 9-9(a): total_zeros for chroma DC (4:2:0, maxNumCoeff 4);
+#: rows are TotalCoeff 1..3 (TotalCoeff 4 ⇒ no zeros, nothing coded).
+_TZ_CDC = [
+    [(1, 1), (2, 0b01), (3, 0b001), (3, 0b000)],
+    [(1, 1), (2, 0b01), (2, 0b00)],
+    [(1, 1), (1, 0b0)],
+]
+_TZ_CDC_DECODE = [{(n, v): tz for tz, (n, v) in enumerate(row)}
+                  for row in _TZ_CDC]
 
-def write_total_zeros(bw: BitWriter, total_coeff: int, tz: int) -> None:
-    n, v = _TZ[total_coeff - 1][tz]
+
+def write_total_zeros(bw: BitWriter, total_coeff: int, tz: int,
+                      max_coeff: int = 16) -> None:
+    row = (_TZ_CDC if max_coeff == 4 else _TZ)[total_coeff - 1]
+    n, v = row[tz]
     bw.write_bits(v, n)
 
 
-def read_total_zeros(br: BitReader, total_coeff: int) -> int:
-    table = _TZ_DECODE[total_coeff - 1]
+def read_total_zeros(br: BitReader, total_coeff: int,
+                     max_coeff: int = 16) -> int:
+    table = (_TZ_CDC_DECODE if max_coeff == 4
+             else _TZ_DECODE)[total_coeff - 1]
     n = 0
     v = 0
     while n < 10:
@@ -334,7 +370,7 @@ def decode_residual(br: BitReader, nC: int, max_coeff: int = 16
             suffix_len += 1
     total_zeros = 0
     if total < max_coeff:
-        total_zeros = read_total_zeros(br, total)
+        total_zeros = read_total_zeros(br, total, max_coeff)
     # place coefficients, highest scan position first
     zeros_left = total_zeros
     pos = total + total_zeros - 1
@@ -414,7 +450,7 @@ def encode_residual(bw: BitWriter, levels: list[int], nC: int,
     highest = nz[-1][0]
     total_zeros = highest + 1 - total
     if total < max_coeff:
-        write_total_zeros(bw, total, total_zeros)
+        write_total_zeros(bw, total, total_zeros, max_coeff)
     zeros_left = total_zeros
     for i in range(len(rev) - 1):
         pos = rev[i][0]

@@ -1,28 +1,30 @@
-"""Minimal H.264 baseline INTRA codec (CAVLC, I_4x4, 4:2:0-signalled).
+"""Minimal H.264 baseline INTRA codec (CAVLC, I_4x4, 4:2:0).
 
 Purpose: (a) generate REAL CAVLC-coded H.264 for the HLS transcode tests
 and benches (the image ships no ffmpeg — SURVEY §4 note on building the
 test pyramid from scratch), and (b) provide the slice/macroblock walk the
 transform-domain requant rung (``h264_requant``) shares.
 
-Scope (documented, test-enforced): I slices, I_4x4 macroblocks with DC
-(mode 2) luma prediction, luma residuals only (chroma CBP 0 — chroma
-rides DC prediction, so sources with flat chroma 128 are lossless in
-chroma).  CABAC, inter prediction and I_16x16 are out of scope; the
-requant rung passes streams it cannot parse through unchanged and says
-so in its stats.
+Scope (documented, test-enforced): I slices of I_4x4 and I_16x16
+macroblocks, DC-mode prediction, CAVLC — including full 4:2:0 chroma
+residuals (chroma DC 2×2 Hadamard + AC blocks, Table 9-5 nC=−1 coding,
+8.3.4.1 mode-0 chroma prediction).  CABAC and inter prediction are out
+of scope; the requant rung passes streams it cannot parse through
+unchanged and says so in its stats.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
 from . import h264_cavlc as cavlc
 from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
-from .h264_transform import (ZIGZAG4, dequant_inverse,
-                             forward_transform_quant)
+from .h264_transform import (_CF, ZIGZAG4, chroma_dc_dequant,
+                             chroma_dc_quant, chroma_qp, dequant_inverse,
+                             forward_transform_quant, inverse_core,
+                             v_position)
 
 #: Table 9-4 codeNum → coded_block_pattern for Intra_4x4 (ue-mapped CBP).
 CBP_INTRA_FROM_CODE = [
@@ -101,6 +103,7 @@ class Pps:
     pic_init_qp: int = 26
     deblocking_control: bool = True
     bottom_field_poc: bool = False
+    chroma_qp_offset: int = 0           # chroma_qp_index_offset (7.4.2.2)
 
     def build(self) -> bytes:
         bw = BitWriter()
@@ -115,7 +118,7 @@ class Pps:
         bw.write_bits(0, 2)             # weighted_bipred_idc
         bw.se(self.pic_init_qp - 26)
         bw.se(0)                        # pic_init_qs
-        bw.se(0)                        # chroma_qp_index_offset
+        bw.se(self.chroma_qp_offset)
         bw.write_bit(1 if self.deblocking_control else 0)
         bw.write_bit(0)                 # constrained_intra_pred
         bw.write_bit(0)                 # redundant_pic_cnt_present
@@ -138,9 +141,9 @@ class Pps:
         br.read_bits(2)
         qp = br.se() + 26
         br.se()
-        br.se()
+        chroma_off = br.se()
         deblock = bool(br.read_bit())
-        return cls(pps_id, sps_id, qp, deblock, bottom_poc)
+        return cls(pps_id, sps_id, qp, deblock, bottom_poc, chroma_off)
 
 
 @dataclass
@@ -161,23 +164,34 @@ class SliceHeader:
     deblock_beta: int = 0
 
 
+def _zero_chroma() -> tuple[np.ndarray, np.ndarray]:
+    return (np.zeros((2, 4), dtype=np.int64),
+            np.zeros((2, 4, 15), dtype=np.int64))
+
+
 @dataclass
 class MacroblockI4x4:
     """Parsed I_4x4 macroblock: everything needed to re-encode."""
 
     pred_modes: list[tuple[int, int]]   # (use_predicted, rem_mode) × 16
     chroma_mode: int
-    cbp: int                            # luma CBP only (chroma bits 0)
+    cbp: int                            # FULL 6-bit CBP (luma | chroma<<4)
     qp: int                             # ABSOLUTE QPY of this MB (spec
     levels: np.ndarray                  # 7.4.5: mb_qp_delta accumulates
                                         # across MBs; the writer re-derives
                                         # deltas) · [16, 16] zigzag levels
+    chroma_dc: np.ndarray = field(default_factory=lambda: _zero_chroma()[0])
+    chroma_ac: np.ndarray = field(default_factory=lambda: _zero_chroma()[1])
+
+    @property
+    def chroma_cbp(self) -> int:
+        return self.cbp >> 4
 
 
 @dataclass
 class MacroblockI16x16:
     """Parsed I_16x16 macroblock (mb_type 1..24): DC Hadamard block +
-    optional 15-coeff AC blocks.  Chroma CBP must be 0 (scope)."""
+    optional 15-coeff AC blocks, plus 4:2:0 chroma residuals."""
 
     pred_mode: int                      # intra16x16 pred mode 0..3
     chroma_mode: int
@@ -185,10 +199,14 @@ class MacroblockI16x16:
     qp: int
     dc_levels: np.ndarray               # [16] zigzag DC levels
     ac_levels: np.ndarray               # [16, 15] zigzag AC levels
+    chroma_cbp: int = 0                 # 0 none / 1 DC only / 2 DC+AC
+    chroma_dc: np.ndarray = field(default_factory=lambda: _zero_chroma()[0])
+    chroma_ac: np.ndarray = field(default_factory=lambda: _zero_chroma()[1])
 
     @property
     def mb_type(self) -> int:
-        return 1 + self.pred_mode + (12 if self.luma_cbp15 else 0)
+        return (1 + self.pred_mode + 4 * self.chroma_cbp
+                + (12 if self.luma_cbp15 else 0))
 
 
 class SliceCodec:
@@ -264,13 +282,21 @@ class SliceCodec:
                 bw.se(h.deblock_beta)
 
     # -- macroblock layer --------------------------------------------------
+    def _fresh_totals(self):
+        """(luma, chroma) nC context grids for one slice walk: per-4x4
+        TotalCoeff, −1 = unavailable.  Chroma grid is [2, h2, w2] (Cb,
+        Cr planes of 2×2 blocks per MB)."""
+        w4 = self.sps.width_mbs * 4
+        h4 = self.sps.height_mbs * 4
+        luma = np.full((h4, w4), -1, dtype=np.int32)
+        chroma = np.full((2, self.sps.height_mbs * 2,
+                          self.sps.width_mbs * 2), -1, dtype=np.int32)
+        return luma, chroma
+
     def parse_mbs(self, br: BitReader, slice_qp: int
                   ) -> "list[MacroblockI4x4 | MacroblockI16x16]":
         n_mbs = self.sps.width_mbs * self.sps.height_mbs
-        w4 = self.sps.width_mbs * 4
-        h4 = self.sps.height_mbs * 4
-        # per-4x4-block total_coeffs for nC context, frame geometry
-        totals = np.full((h4, w4), -1, dtype=np.int32)
+        totals, tot_c = self._fresh_totals()
         mbs = []
         cur_qp = slice_qp
         for mb_idx in range(n_mbs):
@@ -283,8 +309,6 @@ class SliceCodec:
                     modes.append((flag, rem))
                 chroma_mode = br.ue()
                 cbp = CBP_INTRA_FROM_CODE[br.ue()]
-                if cbp >> 4:
-                    raise ValueError("chroma residuals unsupported")
                 if cbp:
                     cur_qp += br.se()   # mb_qp_delta ACCUMULATES (7.4.5)
                     if not 0 <= cur_qp <= 51:
@@ -292,14 +316,16 @@ class SliceCodec:
                 levels = np.zeros((16, 16), dtype=np.int64)
                 self._residuals(br, mb_idx, cbp, levels, totals,
                                 decode=True)
-                mbs.append(MacroblockI4x4(modes, chroma_mode, cbp, cur_qp,
-                                          levels))
+                mb = MacroblockI4x4(modes, chroma_mode, cbp, cur_qp,
+                                    levels)
+                self._residuals_chroma(br, mb_idx, cbp >> 4,
+                                       mb.chroma_dc, mb.chroma_ac,
+                                       tot_c, decode=True)
+                mbs.append(mb)
             elif 1 <= mb_type <= 24:
                 pred = (mb_type - 1) % 4
                 chroma_cbp = ((mb_type - 1) // 4) % 3
                 luma15 = mb_type >= 13
-                if chroma_cbp:
-                    raise ValueError("chroma residuals unsupported")
                 chroma_mode = br.ue()
                 cur_qp += br.se()       # always coded for I_16x16
                 if not 12 <= cur_qp <= 51:
@@ -309,8 +335,11 @@ class SliceCodec:
                 mb16 = MacroblockI16x16(
                     pred, chroma_mode, luma15, cur_qp,
                     np.zeros(16, dtype=np.int64),
-                    np.zeros((16, 15), dtype=np.int64))
+                    np.zeros((16, 15), dtype=np.int64), chroma_cbp)
                 self._residuals16(br, mb_idx, mb16, totals, decode=True)
+                self._residuals_chroma(br, mb_idx, chroma_cbp,
+                                       mb16.chroma_dc, mb16.chroma_ac,
+                                       tot_c, decode=True)
                 mbs.append(mb16)
             else:
                 raise ValueError(
@@ -320,9 +349,7 @@ class SliceCodec:
     def write_mbs(self, bw: BitWriter,
                   mbs: "list[MacroblockI4x4 | MacroblockI16x16]",
                   slice_qp: int) -> None:
-        w4 = self.sps.width_mbs * 4
-        h4 = self.sps.height_mbs * 4
-        totals = np.full((h4, w4), -1, dtype=np.int32)
+        totals, tot_c = self._fresh_totals()
         prev_qp = slice_qp               # deltas are vs the PREVIOUS MB's
         for mb_idx, mb in enumerate(mbs):  # QP (7.4.5), not the slice QP
             if isinstance(mb, MacroblockI16x16):
@@ -334,6 +361,9 @@ class SliceCodec:
                 bw.se(delta)             # always coded for I_16x16
                 prev_qp = mb.qp
                 self._residuals16(bw, mb_idx, mb, totals, decode=False)
+                self._residuals_chroma(bw, mb_idx, mb.chroma_cbp,
+                                       mb.chroma_dc, mb.chroma_ac,
+                                       tot_c, decode=False)
                 continue
             bw.ue(0)                     # mb_type I_4x4
             for flag, rem in mb.pred_modes:
@@ -352,6 +382,9 @@ class SliceCodec:
             # QP is irrelevant; prev_qp carries to the next coded MB
             self._residuals(bw, mb_idx, mb.cbp, mb.levels, totals,
                             decode=False)
+            self._residuals_chroma(bw, mb_idx, mb.cbp >> 4,
+                                   mb.chroma_dc, mb.chroma_ac,
+                                   tot_c, decode=False)
 
     def _nc_at(self, totals: np.ndarray, gx: int, gy: int) -> int:
         w4 = totals.shape[1]
@@ -428,6 +461,51 @@ class SliceCodec:
                 cavlc.encode_residual(bio, lv, nC)
                 totals[gy, gx] = sum(1 for v in lv if v)
 
+    def _residuals_chroma(self, bio, mb_idx: int, chroma_cbp: int,
+                          cdc: np.ndarray, cac: np.ndarray,
+                          tot_c: np.ndarray, *, decode: bool) -> None:
+        """4:2:0 chroma residual walk (7.3.5.3.3 order): both components'
+        DC blocks first (nC = −1, 4 coeffs), then per component the four
+        AC blocks when chroma CBP is 2.  ``tot_c[comp]`` keeps each
+        chroma AC block's TotalCoeff for 9.2.1 neighbor contexts (an
+        uncoded block counts 0, off-picture is unavailable)."""
+        mb_x = (mb_idx % self.sps.width_mbs) * 2
+        mb_y = (mb_idx // self.sps.width_mbs) * 2
+        if chroma_cbp:
+            for comp in range(2):
+                if decode:
+                    cdc[comp] = cavlc.decode_residual(bio, -1, 4)
+                else:
+                    cavlc.encode_residual(
+                        bio, [int(v) for v in cdc[comp]], -1, 4)
+        elif decode:
+            cdc[:] = 0
+        for comp in range(2):
+            grid = tot_c[comp]
+            for blk in range(4):
+                gx, gy = mb_x + (blk & 1), mb_y + (blk >> 1)
+                if chroma_cbp != 2:
+                    grid[gy, gx] = 0
+                    if decode:
+                        cac[comp, blk] = 0
+                    continue
+                nA = grid[gy, gx - 1] if gx > 0 else -1
+                nB = grid[gy - 1, gx] if gy > 0 else -1
+                if nA >= 0 and nB >= 0:
+                    nC = int(nA + nB + 1) >> 1
+                elif nA >= 0:
+                    nC = int(nA)
+                elif nB >= 0:
+                    nC = int(nB)
+                else:
+                    nC = 0
+                if decode:
+                    cac[comp, blk] = cavlc.decode_residual(bio, nC, 15)
+                else:
+                    cavlc.encode_residual(
+                        bio, [int(v) for v in cac[comp, blk]], nC, 15)
+                grid[gy, gx] = int(np.count_nonzero(cac[comp, blk]))
+
 
 # ----------------------------------------------------------------- encoder
 
@@ -445,14 +523,89 @@ def _dc_pred(recon: np.ndarray, gx: int, gy: int) -> int:
     return 128
 
 
+def _chroma_dc_pred_mb(recon: np.ndarray, mbx: int, mby: int) -> np.ndarray:
+    """[8,8] mode-0 (DC) chroma prediction for one MB per 8.3.4.1: each
+    4×4 sub-block predicts from the MB-adjacent row above / column left
+    at its own offsets, with the corner blocks averaging both and the
+    off-diagonal blocks preferring top (x>0) or left (y>0)."""
+    x0, y0 = mbx * 8, mby * 8
+    pred = np.empty((8, 8), dtype=np.int64)
+    for by in range(2):
+        for bx in range(2):
+            top = (recon[y0 - 1, x0 + bx * 4:x0 + bx * 4 + 4]
+                   if mby > 0 else None)
+            left = (recon[y0 + by * 4:y0 + by * 4 + 4, x0 - 1]
+                    if mbx > 0 else None)
+            if (bx, by) == (1, 0):        # top-right block prefers top
+                one = top if top is not None else left
+                val = 128 if one is None else (int(one.sum()) + 2) >> 2
+            elif (bx, by) == (0, 1):      # bottom-left prefers left
+                one = left if left is not None else top
+                val = 128 if one is None else (int(one.sum()) + 2) >> 2
+            elif top is not None and left is not None:   # corners: both
+                val = (int(top.sum()) + int(left.sum()) + 4) >> 3
+            elif left is not None:
+                val = (int(left.sum()) + 2) >> 2
+            elif top is not None:
+                val = (int(top.sum()) + 2) >> 2
+            else:
+                val = 128
+            pred[by * 4:by * 4 + 4, bx * 4:bx * 4 + 4] = val
+    return pred
+
+
+def _encode_chroma_comp(plane: np.ndarray, recon: np.ndarray, mbx: int,
+                        mby: int, qpc: int
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """Quantize one MB's chroma component: ([4] DC levels, [4,15] AC)."""
+    pred = _chroma_dc_pred_mb(recon, mbx, mby)
+    x0, y0 = mbx * 8, mby * 8
+    res = plane[y0:y0 + 8, x0:x0 + 8].astype(np.int64) - pred
+    w00 = np.empty(4, dtype=np.int64)
+    ac = np.zeros((4, 15), dtype=np.int64)
+    for b in range(4):
+        bx, by = b & 1, b >> 1
+        blk = res[by * 4:by * 4 + 4, bx * 4:bx * 4 + 4]
+        w00[b] = (_CF @ blk @ _CF.T)[0, 0]
+        ac[b] = forward_transform_quant(blk, qpc)[ZIGZAG4[1:]]
+    return chroma_dc_quant(w00, qpc), ac
+
+
+def _recon_chroma_comp(recon: np.ndarray, mbx: int, mby: int,
+                       dc: np.ndarray, ac: np.ndarray, qpc: int) -> None:
+    """Reconstruct one MB's chroma component exactly as a decoder does
+    (8.5.11 DC chain + 8.5.12 AC dequant + inverse core transform)."""
+    pred = _chroma_dc_pred_mb(recon, mbx, mby)
+    if not (np.any(dc) or np.any(ac)):   # no residual: pure prediction
+        x0, y0 = mbx * 8, mby * 8
+        recon[y0:y0 + 8, x0:x0 + 8] = pred
+        return
+    dcc = chroma_dc_dequant(dc, qpc)
+    vq = v_position(qpc)
+    x0, y0 = mbx * 8, mby * 8
+    for b in range(4):
+        bx, by = b & 1, b >> 1
+        lev = np.zeros(16, dtype=np.int64)
+        lev[ZIGZAG4[1:]] = ac[b]
+        w = (lev * vq) << (qpc // 6)
+        w[0] = dcc[b]
+        res = inverse_core(w.reshape(4, 4))
+        recon[y0 + by * 4:y0 + by * 4 + 4,
+              x0 + bx * 4:x0 + bx * 4 + 4] = np.clip(
+            pred[by * 4:by * 4 + 4, bx * 4:bx * 4 + 4] + res, 0, 255)
+
+
 def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
-                  idr_pic_id: int = 0,
+                  idr_pic_id: int = 0, cb: np.ndarray | None = None,
+                  cr: np.ndarray | None = None,
                   sps: Sps | None = None, pps: Pps | None = None,
                   include_ps: bool = True) -> list[bytes]:
     """uint8 [H, W] luma (H, W multiples of 16) → NAL payloads
     ([SPS, PPS,] IDR slice), DC-predicted I_4x4 with a real
     reconstruction loop (prediction always from reconstructed samples,
-    as a conformant decoder will see them)."""
+    as a conformant decoder will see them).  Optional ``cb``/``cr``
+    [H/2, W/2] planes get real 4:2:0 chroma residuals (mode-0 predicted,
+    DC+AC coded); omitted planes keep chroma CBP 0."""
     h, w = luma.shape
     if h % 16 or w % 16:
         raise ValueError("dimensions must be multiples of 16")
@@ -460,6 +613,9 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
     pps = pps or Pps(pic_init_qp=qp)
     codec = SliceCodec(sps, pps)
     recon = np.zeros((h, w), dtype=np.int64)
+    do_chroma = cb is not None and cr is not None
+    qpc = chroma_qp(qp, pps.chroma_qp_offset)
+    recon_c = np.zeros((2, h // 2, w // 2), dtype=np.int64)
     zz = ZIGZAG4
     mbs: list[MacroblockI4x4] = []
     for mb_idx in range(sps.width_mbs * sps.height_mbs):
@@ -488,7 +644,22 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
         for blk in range(16):
             if not (cbp >> (blk >> 2)) & 1 and nz_blocks[blk]:
                 levels[blk] = 0
-        mbs.append(MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, levels))
+        mb = MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, levels)
+        if do_chroma:
+            mbx = mb_idx % sps.width_mbs
+            mby = mb_idx // sps.width_mbs
+            for comp, plane in enumerate((cb, cr)):
+                mb.chroma_dc[comp], mb.chroma_ac[comp] = \
+                    _encode_chroma_comp(plane, recon_c[comp], mbx, mby,
+                                        qpc)
+            ccbp = (2 if np.any(mb.chroma_ac) else
+                    1 if np.any(mb.chroma_dc) else 0)
+            mb.cbp = cbp | (ccbp << 4)
+            for comp in range(2):
+                _recon_chroma_comp(recon_c[comp], mbx, mby,
+                                   mb.chroma_dc[comp], mb.chroma_ac[comp],
+                                   qpc)
+        mbs.append(mb)
     bw = BitWriter()
     hdr = SliceHeader(frame_num=frame_num, idr_pic_id=idr_pic_id, qp=qp)
     codec.write_slice_header(bw, hdr, qp)
@@ -502,8 +673,10 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
 
 # ----------------------------------------------------------------- decoder
 
-def decode_iframe(nals: list[bytes]) -> np.ndarray:
-    """NAL payloads → uint8 [H, W] luma (DC-mode I_4x4 scope)."""
+def decode_iframe_yuv(nals: list[bytes]
+                      ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """NAL payloads → uint8 (Y [H,W], Cb, Cr [H/2,W/2]) planes
+    (DC-mode I_4x4 scope, full 4:2:0 chroma)."""
     sps = pps = None
     slice_nal = None
     for nal in nals:
@@ -522,6 +695,7 @@ def decode_iframe(nals: list[bytes]) -> np.ndarray:
     mbs = codec.parse_mbs(br, qp)
     h, w = sps.height_mbs * 16, sps.width_mbs * 16
     recon = np.zeros((h, w), dtype=np.int64)
+    recon_c = np.zeros((2, h // 2, w // 2), dtype=np.int64)
     inv_zz = np.argsort(ZIGZAG4)
     for mb_idx, mb in enumerate(mbs):
         if isinstance(mb, MacroblockI16x16):
@@ -542,7 +716,21 @@ def decode_iframe(nals: list[bytes]) -> np.ndarray:
             res = dequant_inverse(lv, cur_qp)
             recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
                 pred + res, 0, 255)
-    return recon.astype(np.uint8)
+        if mb.chroma_mode != 0:
+            raise ValueError("non-DC chroma mode out of scope")
+        qpc = chroma_qp(cur_qp, pps.chroma_qp_offset)
+        for comp in range(2):
+            _recon_chroma_comp(recon_c[comp], mb_idx % sps.width_mbs,
+                               mb_idx // sps.width_mbs,
+                               mb.chroma_dc[comp], mb.chroma_ac[comp],
+                               qpc)
+    return (recon.astype(np.uint8), recon_c[0].astype(np.uint8),
+            recon_c[1].astype(np.uint8))
+
+
+def decode_iframe(nals: list[bytes]) -> np.ndarray:
+    """NAL payloads → uint8 [H, W] luma (DC-mode I_4x4 scope)."""
+    return decode_iframe_yuv(nals)[0]
 
 
 def psnr(a: np.ndarray, b: np.ndarray) -> float:

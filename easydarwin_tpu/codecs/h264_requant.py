@@ -1,19 +1,22 @@
 """Transform-domain H.264 requantization: the HLS bitrate rung's core.
 
 Open-loop CAVLC transcoding (the classic transform-domain design): parse
-every I_4x4 macroblock's residual levels, requantize them at a higher QP
-— batched on the device (``ops.transform.h264_requant``) or through the
-scalar oracle — and re-encode the slice with the new QP and recomputed
-CBP/nC contexts.  SPS/PPS pass through untouched (QP lives in the slice
-header).  Prediction drift is accepted and resets at every IDR, which in
-the all-intra camera configs this ladder targets means every frame.
+every macroblock's residual levels, requantize them at a higher QP
+— batched on the device (``ops.transform.h264_requant`` /
+``h264_requant_chroma``) or through the scalar oracles — and re-encode
+the slice with the new QP and recomputed CBP/nC contexts.  SPS/PPS pass
+through untouched (QP lives in the slice header).  Prediction drift is
+accepted and resets at every IDR, which in the all-intra camera configs
+this ladder targets means every frame.
 
-Scope: CAVLC baseline-intra slices of I_4x4 and I_16x16 macroblocks
-(luma residuals; I_16x16 DC Hadamard + AC blocks, QPY ≥ 12 where the
-+6k shift is exact for the DC dequant too).  Streams outside that
-profile (CABAC, inter slices, chroma residuals, low-QP I_16x16) PASS
-THROUGH unchanged and are counted — the rung never corrupts what it
-cannot parse."""
+Scope: CAVLC baseline-intra slices of I_4x4 and I_16x16 macroblocks,
+luma AND 4:2:0 chroma residuals (luma steps by the exact +6k shift;
+chroma follows the Table 8-15 QPc mapping with a three-way
+identity / exact-shift / integer-round-trip dispatch — see
+``h264_transform.requant_chroma_scalar``).  I_16x16 needs QPY ≥ 12
+(the exact-shift DC dequant window).  Streams outside the profile
+(CABAC, inter slices, low-QP I_16x16) PASS THROUGH unchanged and are
+counted — the rung never corrupts what it cannot parse."""
 
 from __future__ import annotations
 
@@ -23,7 +26,8 @@ import numpy as np
 
 from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
 from .h264_intra import MacroblockI16x16, Pps, SliceCodec, Sps
-from .h264_transform import requant_levels_scalar
+from .h264_transform import (chroma_qp, requant_chroma_scalar,
+                             requant_levels_scalar)
 
 
 @dataclass
@@ -57,6 +61,31 @@ def device_batch(levels: np.ndarray, qp_in: np.ndarray,
                        ).astype(_np.int64)
 
 
+def _scalar_batch_chroma(dc: np.ndarray, ac: np.ndarray,
+                         qpc_in: np.ndarray, qpc_out: np.ndarray
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    out_dc = np.empty_like(dc)
+    out_ac = np.empty_like(ac)
+    for i in range(dc.shape[0]):
+        out_dc[i], out_ac[i] = requant_chroma_scalar(
+            dc[i], ac[i], int(qpc_in[i]), int(qpc_out[i]))
+    return out_dc, out_ac
+
+
+def device_batch_chroma(dc: np.ndarray, ac: np.ndarray,
+                        qpc_in: np.ndarray, qpc_out: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """Chroma batch requant on the accelerator (bit-exact vs scalar)."""
+    import numpy as _np
+
+    from ..ops.transform import h264_requant_chroma
+    d, a = h264_requant_chroma(dc.astype(_np.int32), ac.astype(_np.int32),
+                               qpc_in.astype(_np.int32),
+                               qpc_out.astype(_np.int32))
+    return (_np.asarray(d).astype(_np.int64),
+            _np.asarray(a).astype(_np.int64))
+
+
 class SliceRequantizer:
     """Per-stream requantizer: latches SPS/PPS from the NAL flow and
     rewrites coded slices ``delta_qp`` steps coarser.
@@ -70,7 +99,7 @@ class SliceRequantizer:
     path — that is how the differential tests and the TPU-batched
     variant run."""
 
-    def __init__(self, delta_qp: int, *, requant_fn=None,
+    def __init__(self, delta_qp: int, *, requant_fn=None, chroma_fn=None,
                  prefer_native: bool = True):
         if delta_qp < 6 or delta_qp % 6:
             # +6k steps are EXACT level shifts (table periodicity); other
@@ -78,7 +107,9 @@ class SliceRequantizer:
             raise ValueError("delta_qp must be a positive multiple of 6")
         self.delta_qp = delta_qp
         self.requant_fn = requant_fn or _scalar_batch
-        self._native = prefer_native and requant_fn is None
+        self.chroma_fn = chroma_fn or _scalar_batch_chroma
+        self._native = (prefer_native and requant_fn is None
+                        and chroma_fn is None)
         self.sps: Sps | None = None
         self.pps: Pps | None = None
         self.stats = RequantStats()
@@ -130,7 +161,8 @@ class SliceRequantizer:
             log2_max_poc_lsb=s.log2_max_poc_lsb,
             pic_init_qp=p.pic_init_qp, pps_id=p.pps_id,
             deblocking_control=p.deblocking_control,
-            bottom_field_poc=p.bottom_field_poc, delta_qp=self.delta_qp)
+            bottom_field_poc=p.bottom_field_poc, delta_qp=self.delta_qp,
+            chroma_qp_offset=p.chroma_qp_offset)
 
     def _requant_slice(self, nal: bytes) -> bytes:
         codec = SliceCodec(self.sps, self.pps)
@@ -183,15 +215,42 @@ class SliceRequantizer:
                 mb.ac_levels[b] = requanted[r, :15]
             else:
                 mb.levels[b] = requanted[r]
+
+        # chroma: per-MB QPc pairs (Table 8-15 over the shifted QPY)
+        # through the three-way identity/shift/round-trip requant, both
+        # components batched as independent rows
+        centries = [i for i, mb in enumerate(mbs) if mb.chroma_cbp]
+        if centries:
+            off = self.pps.chroma_qp_offset
+            cdc = np.stack([mbs[i].chroma_dc for i in centries])
+            cac = np.stack([mbs[i].chroma_ac for i in centries])
+            qin = np.array([chroma_qp(mbs[i].qp, off) for i in centries],
+                           dtype=np.int64)
+            qout = np.array(
+                [chroma_qp(mbs[i].qp + self.delta_qp, off)
+                 for i in centries], dtype=np.int64)
+            self.stats.blocks += 8 * len(centries)
+            d2, a2 = self.chroma_fn(
+                cdc.reshape(-1, 4), cac.reshape(-1, 4, 15),
+                np.repeat(qin, 2), np.repeat(qout, 2))
+            d2 = d2.reshape(-1, 2, 4)
+            a2 = a2.reshape(-1, 2, 4, 15)
+            for j, i in enumerate(centries):
+                mbs[i].chroma_dc = d2[j]
+                mbs[i].chroma_ac = a2[j]
+
         for mb in mbs:
+            ccbp = (2 if np.any(mb.chroma_ac) else
+                    1 if np.any(mb.chroma_dc) else 0)
             if isinstance(mb, MacroblockI16x16):
                 mb.luma_cbp15 = bool(np.any(mb.ac_levels))
+                mb.chroma_cbp = ccbp
             else:
                 cbp = 0
                 for g in range(4):
                     if np.any(mb.levels[4 * g:4 * g + 4]):
                         cbp |= 1 << g
-                mb.cbp = cbp
+                mb.cbp = cbp | (ccbp << 4)
             mb.qp = mb.qp + self.delta_qp
         bw = BitWriter()
         codec.write_slice_header(bw, hdr, qp_out_base)

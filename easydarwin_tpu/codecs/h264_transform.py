@@ -51,6 +51,30 @@ _CF = np.array([[1, 1, 1, 1],
 #: overflow-free (|l|·V·MF ≤ 2047·29·13107 < 2^31)
 LEVEL_CLIP = 2047
 
+#: Table 8-15: QPc as a function of qPI (identity below 30, then the
+#: compressing tail).  This non-linearity is WHY chroma needs a general
+#: requant: a luma +6k step maps to a chroma delta that is usually not
+#: a multiple of 6, so the exact-shift argument does not apply.
+CHROMA_QP = np.array(
+    list(range(30)) + [29, 30, 31, 32, 32, 33, 34, 34, 35, 35, 36, 36,
+                       37, 37, 37, 38, 38, 38, 39, 39, 39, 39],
+    dtype=np.int64)
+
+#: clips shared with the device / native chroma paths so int64 (numpy),
+#: int32 (XLA) and int32 (C++) stay bit-exact: residuals after the
+#: inverse transform clip to ±RES_CLIP (⇒ |W| ≤ 36·4095), forward
+#: coefficients to ±W_CLIP (131071·13107 + 2·2^23 < 2^31).  Real
+#: residuals are within ±255, so the clips never bind on real streams.
+RES_CLIP = 4095
+W_CLIP = 131071
+
+_H2 = np.array([[1, 1], [1, -1]], dtype=np.int64)
+
+
+def chroma_qp(qp_y: int, offset: int = 0) -> int:
+    """QPc for a macroblock: Table 8-15 over clip3(0, 51, QPY + offset)."""
+    return int(CHROMA_QP[int(np.clip(qp_y + offset, 0, 51))])
+
 
 def mf_position(qp: int) -> np.ndarray:
     """[16] per-position forward multiplier for ``qp``."""
@@ -76,12 +100,9 @@ def forward_transform_quant(residual: np.ndarray, qp: int) -> np.ndarray:
     return np.clip(lev.reshape(16), -LEVEL_CLIP, LEVEL_CLIP)
 
 
-def dequant_inverse(levels: np.ndarray, qp: int) -> np.ndarray:
-    """[16] levels (raster) → [4,4] int residual (spec 8.5.12 rounding)."""
-    lev = levels.astype(np.int64).reshape(4, 4)
-    w = lev * v_position(qp).reshape(4, 4)
-    w = w << (qp // 6)
-    # inverse core transform with >>6 rounding at the end
+def inverse_core(w: np.ndarray) -> np.ndarray:
+    """[4,4] dequantized coefficients → [4,4] residual (8.5.12's inverse
+    core transform with the final +32 >> 6)."""
     def ih(row):
         a, b, c, d = row
         e0 = a + c
@@ -93,6 +114,14 @@ def dequant_inverse(levels: np.ndarray, qp: int) -> np.ndarray:
     tmp = np.stack([ih(w[i]) for i in range(4)])
     cols = np.stack([ih(tmp[:, j]) for j in range(4)], axis=1)
     return ((cols + 32) >> 6).astype(np.int64)
+
+
+def dequant_inverse(levels: np.ndarray, qp: int) -> np.ndarray:
+    """[16] levels (raster) → [4,4] int residual (spec 8.5.12 rounding)."""
+    lev = levels.astype(np.int64).reshape(4, 4)
+    w = lev * v_position(qp).reshape(4, 4)
+    w = w << (qp // 6)
+    return inverse_core(w)
 
 
 def requant_levels_scalar(levels: np.ndarray, qp_in: int, qp_out: int
@@ -116,3 +145,79 @@ def requant_levels_scalar(levels: np.ndarray, qp_in: int, qp_out: int
     f = (1 << k) // 3
     out = np.sign(lev) * ((np.abs(lev) + f) >> k)
     return out.astype(np.int64)
+
+
+# ------------------------------------------------------------------- chroma
+
+def chroma_dc_dequant(dc_levels: np.ndarray, qpc: int) -> np.ndarray:
+    """[4] parsed 2×2 chroma DC levels (raster) → [4] dcC per 8.5.11:
+    dcC = ((H2·c·H2) · LevelScale(QPc%6,0,0)) << (QPc/6) >> 5 — the spec's
+    LevelScale carries a ×16, so in this module's V convention the net
+    shift is >> 1 (exact for every QPc, both forms being 2-adic)."""
+    c = np.clip(dc_levels.astype(np.int64), -LEVEL_CLIP,
+                LEVEL_CLIP).reshape(2, 2)
+    f = _H2 @ c @ _H2
+    return (((f * V[qpc % 6][0]) << (qpc // 6)) >> 1).reshape(4)
+
+
+def chroma_dc_quant(w00: np.ndarray, qpc: int) -> np.ndarray:
+    """[4] forward-transform DC coefficients (raster 2×2 of the MB
+    component's blocks) → [4] quantized chroma DC levels (JM forward:
+    2×2 Hadamard, then MF with doubled deadzone and qbits+1 shift)."""
+    f2 = _H2 @ np.clip(w00.astype(np.int64), -W_CLIP,
+                       W_CLIP).reshape(2, 2) @ _H2
+    f2 = np.clip(f2, -W_CLIP, W_CLIP)
+    qbits = 15 + qpc // 6
+    off = (1 << qbits) // 3
+    lev = np.sign(f2) * ((np.abs(f2) * MF[qpc % 6][0] + 2 * off)
+                         >> (qbits + 1))
+    return np.clip(lev, -LEVEL_CLIP, LEVEL_CLIP).reshape(4)
+
+
+def requant_chroma_scalar(dc: np.ndarray, ac: np.ndarray, qpc_in: int,
+                          qpc_out: int) -> tuple[np.ndarray, np.ndarray]:
+    """Chroma requant for ONE macroblock component, the scalar oracle for
+    ``ops.transform.h264_requant_chroma`` (bit-exact, same clips).
+
+    dc: [4] chroma DC levels (2×2 raster); ac: [4, 15] per-block zigzag
+    AC tails.  Three-way per-MB dispatch on delta = qpc_out − qpc_in:
+
+    * 0 — identity (Table 8-15 saturation; the levels still decode right
+      because QPc is unchanged).
+    * +6k — the same exact level shift as luma (the DC chain also scales
+      by exactly 2 per +6: same %6 row, one more left shift).
+    * otherwise — open-loop integer round trip, each block reconstructed
+      exactly as a decoder would (8.5.11 DC + 8.5.12 AC dequant, inverse
+      core transform) and re-encoded with the JM forward quantizer at
+      qpc_out.  Valid for ANY delta, which chroma needs (module note on
+      CHROMA_QP)."""
+    dc = np.clip(np.asarray(dc, dtype=np.int64), -LEVEL_CLIP, LEVEL_CLIP)
+    ac = np.clip(np.asarray(ac, dtype=np.int64), -LEVEL_CLIP, LEVEL_CLIP)
+    delta = qpc_out - qpc_in
+    if delta < 0:
+        raise ValueError("chroma requant only steps down (qpc_out >= in)")
+    if delta == 0:
+        return dc.copy(), ac.copy()
+    if delta % 6 == 0:
+        k = delta // 6
+        f = (1 << k) // 3
+        sh = lambda x: np.sign(x) * ((np.abs(x) + f) >> k)  # noqa: E731
+        return sh(dc), sh(ac)
+    dcc = chroma_dc_dequant(dc, qpc_in)
+    vq = v_position(qpc_in)
+    mfq = mf_position(qpc_out)
+    qbits = 15 + qpc_out // 6
+    off = (1 << qbits) // 3
+    out_ac = np.empty_like(ac)
+    w00 = np.empty(4, dtype=np.int64)
+    for b in range(4):
+        lev = np.zeros(16, dtype=np.int64)
+        lev[ZIGZAG4[1:]] = ac[b]
+        w = (lev * vq) << (qpc_in // 6)
+        w[0] = dcc[b]
+        x = np.clip(inverse_core(w.reshape(4, 4)), -RES_CLIP, RES_CLIP)
+        big_w = np.clip(_CF @ x @ _CF.T, -W_CLIP, W_CLIP).reshape(16)
+        w00[b] = big_w[0]
+        q = np.sign(big_w) * ((np.abs(big_w) * mfq + off) >> qbits)
+        out_ac[b] = np.clip(q, -LEVEL_CLIP, LEVEL_CLIP)[ZIGZAG4[1:]]
+    return chroma_dc_quant(w00, qpc_out), out_ac

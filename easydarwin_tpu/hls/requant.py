@@ -9,17 +9,19 @@ batched on the device (``ops.transform.h264_requant``), differential-
 tested bit-exact against the scalar oracle.
 
 Honest scope notes (also in ``codecs.h264_requant``): CAVLC baseline
-intra slices only; anything else passes through unchanged and is
-counted, so the rendition degrades toward the source bitrate rather than
-corrupting.  Requant is open loop: drift is spatial-only and resets at
-every IDR — for all-intra camera streams, every frame."""
+intra slices only (I_4x4 + I_16x16, luma AND 4:2:0 chroma residuals);
+anything else passes through unchanged and is counted, so the rendition
+degrades toward the source bitrate rather than corrupting.  Requant is
+open loop: drift is spatial-only and resets at every IDR — for
+all-intra camera streams, every frame."""
 
 from __future__ import annotations
 
 import asyncio
 from concurrent.futures import ThreadPoolExecutor
 
-from ..codecs.h264_requant import SliceRequantizer, device_batch
+from ..codecs.h264_requant import (SliceRequantizer, device_batch,
+                                   device_batch_chroma)
 from ..vod.depacketize import AccessUnit
 from .segmenter import HlsOutput
 
@@ -45,10 +47,13 @@ class RequantHlsOutput(HlsOutput):
         if native_mod.available():
             # the native CAVLC walk (~100x the Python path) is the
             # production engine; it embeds the same exact level shift
-            fn = None
+            # and the chroma identity/shift/round-trip dispatch
+            fn = cfn = None
         else:
             fn = device_batch if use_device else None
-        self.requant = SliceRequantizer(delta_qp, requant_fn=fn)
+            cfn = device_batch_chroma if use_device else None
+        self.requant = SliceRequantizer(delta_qp, requant_fn=fn,
+                                        chroma_fn=cfn)
         self.delta_qp = delta_qp
         self._ps_fed: tuple[bytes | None, bytes | None] = (None, None)
         #: AUs dropped because the requant worker was too far behind —

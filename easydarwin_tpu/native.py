@@ -109,11 +109,12 @@ def _load():
             u8p, ctypes.c_int32, u8p, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32]
+            ctypes.c_int32, ctypes.c_int32]
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int64, i64p, ctypes.c_int32]
+            ctypes.c_int64, i64p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
         lib.ed_wheel_new.restype = ctypes.c_void_p
         lib.ed_wheel_new.argtypes = [ctypes.c_int64]
         lib.ed_wheel_free.argtypes = [ctypes.c_void_p]
@@ -248,8 +249,8 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
                        log2_max_frame_num: int, poc_type: int,
                        log2_max_poc_lsb: int, pic_init_qp: int,
                        pps_id: int, deblocking_control: bool,
-                       bottom_field_poc: bool,
-                       delta_qp: int) -> bytes | None:
+                       bottom_field_poc: bool, delta_qp: int,
+                       chroma_qp_offset: int = 0) -> bytes | None:
     """Native CAVLC slice requant; None = unsupported/malformed (caller
     passes the slice through or falls back to the Python path)."""
     lib = _load()
@@ -261,7 +262,7 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
         _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
         log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
         pps_id, 1 if deblocking_control else 0,
-        1 if bottom_field_poc else 0, delta_qp)
+        1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset)
     if n == -3:                      # tiny chance: expansion past 2x
         cap = len(nal) * 4 + 4096
         out = np.zeros(cap, dtype=np.uint8)
@@ -269,7 +270,7 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
             _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
             log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
             pps_id, 1 if deblocking_control else 0,
-            1 if bottom_field_poc else 0, delta_qp)
+            1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset)
     return out[:n].tobytes() if n > 0 else None
 
 
@@ -340,18 +341,19 @@ def fanout_render(ring_data: np.ndarray, ring_len: np.ndarray,
 
 def udp_ingest(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
                ring_arrival: np.ndarray, now_ms: int, head: int,
-               max_pkts: int = 256) -> tuple[int, int]:
-    """Returns (n_read, new_head)."""
+               max_pkts: int = 256) -> tuple[int, int, int]:
+    """Returns (n_admitted, new_head, oversize_dropped)."""
     lib = _load()
     assert lib is not None
     h = ctypes.c_int64(head)
+    drops = ctypes.c_int32(0)
     n = lib.ed_udp_ingest(
         fd, _u8(ring_data), _i32(ring_len), _i64(ring_arrival),
         ring_data.shape[0], ring_data.shape[1], now_ms,
-        ctypes.byref(h), max_pkts)
+        ctypes.byref(h), max_pkts, ctypes.byref(drops))
     if n < 0:
         raise OSError(-n, os.strerror(-n))
-    return n, h.value
+    return n, h.value, drops.value
 
 
 class TimerWheel:

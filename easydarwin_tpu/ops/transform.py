@@ -283,3 +283,100 @@ def h264_requant(levels: jnp.ndarray, qp_in: jnp.ndarray,
     f = (jnp.int32(1) << k) // 3
     out = jnp.sign(lev) * ((jnp.abs(lev) + f) >> k)
     return out.astype(jnp.int32)
+
+
+# --------------------------------------------- H.264 chroma requant (int32)
+
+def _h2x2(v: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise 2×2 Hadamard (H2·c·H2) of [..., 4] raster quads."""
+    a, b, c, d = v[..., 0], v[..., 1], v[..., 2], v[..., 3]
+    return jnp.stack([a + b + c + d, a - b + c - d,
+                      a + b - c - d, a - b - c + d], axis=-1)
+
+
+def _inv_core_1d(a, b, c, d):
+    e0, e1 = a + c, a - c
+    e2, e3 = (b >> 1) - d, b + (d >> 1)
+    return e0 + e3, e1 + e2, e1 - e2, e0 - e3
+
+
+def _fwd_core_1d(x0, x1, x2, x3):
+    t0, t1, t2, t3 = x0 + x3, x1 + x2, x1 - x2, x0 - x3
+    return t0 + t1, 2 * t3 + t2, t0 - t1, t3 - 2 * t2
+
+
+def _rows_cols(w: jnp.ndarray, fn) -> jnp.ndarray:
+    """Apply a 4-point butterfly over rows then columns of [..., 4, 4]."""
+    r = jnp.stack(fn(*(w[..., i] for i in range(4))), axis=-1)
+    return jnp.stack(fn(*(r[..., i, :] for i in range(4))), axis=-2)
+
+
+@jax.jit
+def h264_requant_chroma(dc: jnp.ndarray, ac: jnp.ndarray,
+                        qpc_in: jnp.ndarray, qpc_out: jnp.ndarray
+                        ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched chroma requant, BIT-EXACT against
+    ``codecs.h264_transform.requant_chroma_scalar`` (same clips, same
+    integer ops, int32 throughout — the scalar module documents why the
+    clips make int32 sufficient).
+
+    dc: int32 [N, 4] chroma DC levels (2×2 raster) per MB component;
+    ac: int32 [N, 4, 15] per-block zigzag AC tails; qpc_in/qpc_out: [N].
+    Per-row three-way dispatch (identity / exact +6k shift / open-loop
+    integer round trip) computed dense and selected — branchless, so one
+    trace serves every mix of Table 8-15 deltas."""
+    from ..codecs.h264_transform import (LEVEL_CLIP, MF, RES_CLIP, V,
+                                         W_CLIP, ZIGZAG4, _CLS)
+    n = dc.shape[0]
+    dc = jnp.clip(dc.astype(jnp.int32), -LEVEL_CLIP, LEVEL_CLIP)
+    ac = jnp.clip(ac.astype(jnp.int32), -LEVEL_CLIP, LEVEL_CLIP)
+    qi = qpc_in.astype(jnp.int32)
+    qo = qpc_out.astype(jnp.int32)
+    delta = qo - qi
+
+    # --- exact-shift arm (delta ≡ 0 mod 6; k=0 degenerates to identity)
+    k = jnp.maximum(delta // 6, 0)
+    f6 = (jnp.int32(1) << k) // 3
+
+    def shift(x, kk, ff):
+        return jnp.sign(x) * ((jnp.abs(x) + ff) >> kk)
+
+    dc_shift = shift(dc, k[:, None], f6[:, None])
+    ac_shift = shift(ac, k[:, None, None], f6[:, None, None])
+
+    # --- general arm: dequant (8.5.11 DC + 8.5.12 AC) → inverse core →
+    #     forward core → requant at qpc_out
+    vpos = jnp.asarray(np.stack([V[m][_CLS] for m in range(6)]),
+                       dtype=jnp.int32)                       # [6, 16]
+    mfpos = jnp.asarray(np.stack([MF[m][_CLS] for m in range(6)]),
+                        dtype=jnp.int32)
+    v0 = jnp.asarray(V[:, 0], dtype=jnp.int32)
+    mf0 = jnp.asarray(MF[:, 0], dtype=jnp.int32)
+    si, so = qi // 6, qo // 6
+    mi, mo = qi % 6, qo % 6
+
+    dcc = ((_h2x2(dc) * v0[mi][:, None]) << si[:, None]) >> 1
+    lev = jnp.zeros((n, 4, 16), jnp.int32)
+    lev = lev.at[:, :, jnp.asarray(ZIGZAG4[1:])].set(ac)
+    w = (lev * vpos[mi][:, None, :]) << si[:, None, None]
+    w = w.at[:, :, 0].set(dcc)
+    x = _rows_cols(w.reshape(n, 4, 4, 4), _inv_core_1d)
+    x = jnp.clip((x + 32) >> 6, -RES_CLIP, RES_CLIP)
+    big = jnp.clip(_rows_cols(x, _fwd_core_1d),
+                   -W_CLIP, W_CLIP).reshape(n, 4, 16)
+    qbits = 15 + so
+    off = (jnp.int32(1) << qbits) // 3
+    q = jnp.sign(big) * ((jnp.abs(big) * mfpos[mo][:, None, :]
+                          + off[:, None, None]) >> qbits[:, None, None])
+    q = jnp.clip(q, -LEVEL_CLIP, LEVEL_CLIP)
+    ac_gen = q[:, :, jnp.asarray(ZIGZAG4[1:])]
+    f2 = jnp.clip(_h2x2(jnp.clip(big[:, :, 0], -W_CLIP, W_CLIP)),
+                  -W_CLIP, W_CLIP)
+    dc_gen = jnp.sign(f2) * ((jnp.abs(f2) * mf0[mo][:, None]
+                              + 2 * off[:, None]) >> (qbits + 1)[:, None])
+    dc_gen = jnp.clip(dc_gen, -LEVEL_CLIP, LEVEL_CLIP)
+
+    use_shift = (delta % 6 == 0)
+    dc_out = jnp.where(use_shift[:, None], dc_shift, dc_gen)
+    ac_out = jnp.where(use_shift[:, None, None], ac_shift, ac_gen)
+    return dc_out.astype(jnp.int32), ac_out.astype(jnp.int32)

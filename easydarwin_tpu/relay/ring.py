@@ -64,6 +64,7 @@ class PacketRing:
         self.head = 0            # next id to assign
         self.tail = 0            # oldest live id
         self.total_dropped = 0
+        self.total_oversize = 0  # dropped: larger than the slot
 
     def __len__(self) -> int:
         return self.head - self.tail
@@ -106,9 +107,15 @@ class PacketRing:
     def push(self, packet: bytes, arrival_ms: int, *,
              is_rtcp: bool = False) -> int:
         """Admit one packet; classifies H.264 keyframe boundaries on
-        ingest. Returns the absolute id."""
+        ingest. Returns the absolute id, or -1 if the packet exceeds the
+        slot and is dropped — a truncated slot would relay a CORRUPT
+        packet to every consumer (the reference truncates silently via
+        recvfrom's fixed 2060-byte ReflectorPacket buffer,
+        ReflectorStream.h:127; dropping is the honest equivalent, and
+        conformant pushers FU-A-fragment far below the slot anyway)."""
         if len(packet) > self.slot_size:
-            packet = packet[:self.slot_size]
+            self.total_oversize += 1
+            return -1
         if len(self) >= self.capacity:
             self.tail += 1          # overwrite-oldest, like maxQSize trim
             self.total_dropped += 1
@@ -134,9 +141,10 @@ class PacketRing:
         # never drain more than one ring's worth in a single call so the
         # overwrite-oldest accounting below stays exact
         max_pkts = min(max_pkts, self.capacity)
-        n, new_head = native.udp_ingest(
+        n, new_head, oversize = native.udp_ingest(
             fd, self.data, self.length, self.arrival, now_ms, self.head,
             max_pkts)
+        self.total_oversize += oversize
         if n <= 0:
             return 0
         for pid in range(self.head, new_head):

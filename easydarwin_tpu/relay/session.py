@@ -122,6 +122,7 @@ class RelaySession:
                     "packets_out": s.stats.packets_out,
                     "keyframes": s.stats.keyframes,
                     "queue": len(s.rtp_ring),
+                    "oversize_dropped": s.rtp_ring.total_oversize,
                 } for tid, s in self.streams.items()
             },
         }

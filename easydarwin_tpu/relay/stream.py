@@ -146,7 +146,8 @@ class RelayStream:
             # stepping a copied stream state share the exact base
             self._wall_base = time.time() - now_ms / 1000.0
         pid = self.rtp_ring.push(packet, now_ms)
-        self._note_rtp_ingested(pid)
+        if pid >= 0:
+            self._note_rtp_ingested(pid)
         return pid
 
     def drain_rtp_native(self, fd: int, now_ms: int,

@@ -29,6 +29,7 @@ run_one() {
         "tests/test_h264_codec.py::test_native_requant_matches_python_byte_for_byte" \
         "tests/test_h264_codec.py::test_native_requant_rejects_garbage_cleanly" \
         "tests/test_h264_codec.py::test_i16x16_native_matches_python" \
+        "tests/test_h264_codec.py::test_chroma_mixed_slice_native_matches_python" \
         -q -p no:cacheprovider
 }
 

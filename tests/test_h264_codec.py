@@ -75,6 +75,9 @@ def test_cavlc_tables_prefix_free():
         check(row)
     for row in cavlc._RB:
         check(row)
+    check(cavlc._CT_CDC.values())
+    for row in cavlc._TZ_CDC:
+        check(row)
 
 
 def test_cbp_intra_mapping_is_permutation():
@@ -320,9 +323,11 @@ def test_native_requant_rejects_garbage_cleanly():
 
 # ---------------------------------------------------------------- I_16x16
 
-def _mixed_slice(rng, sps, pps, qp, dense=False):
+def _mixed_slice(rng, sps, pps, qp, dense=False, chroma=False):
     """Synthetic slice mixing I_16x16 and I_4x4 MBs (no pixel source —
-    the requant path needs only parse→shift→re-encode consistency)."""
+    the requant path needs only parse→shift→re-encode consistency).
+    ``chroma=True`` decorates MBs with a rotating chroma CBP (0/1/2) of
+    random DC and AC chroma residuals."""
     from easydarwin_tpu.codecs.h264_bits import BitWriter, rbsp_to_nal
     from easydarwin_tpu.codecs.h264_intra import (MacroblockI4x4,
                                                   MacroblockI16x16,
@@ -354,6 +359,19 @@ def _mixed_slice(rng, sps, pps, qp, dense=False):
                 if np.any(lv[4 * g:4 * g + 4]):
                     cbp |= 1 << g
             mbs.append(MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, lv))
+        if chroma:
+            mb = mbs[-1]
+            ccbp = i % 3               # rotate through 0/1/2
+            if ccbp >= 1:
+                mb.chroma_dc[:, :] = rng.integers(-30, 30, (2, 4))
+                mb.chroma_dc[0, 0] = max(int(mb.chroma_dc[0, 0]), 1)
+            if ccbp == 2:
+                mb.chroma_ac[:, :, :5] = rng.integers(-12, 12, (2, 4, 5))
+                mb.chroma_ac[0, 0, 0] = max(int(mb.chroma_ac[0, 0, 0]), 1)
+            if isinstance(mb, MacroblockI16x16):
+                mb.chroma_cbp = ccbp
+            else:
+                mb.cbp |= ccbp << 4
     bw = BitWriter()
     codec.write_slice_header(bw, SliceHeader(qp=qp), qp)
     codec.write_mbs(bw, mbs, qp)
@@ -421,3 +439,171 @@ def test_i16x16_low_qp_passes_through():
         rq.sps, rq.pps = sps, pps
         assert rq.transform_nal(nal) == nal
         assert rq.stats.slices_passed_through == 1
+
+
+# ----------------------------------------------------------------- chroma
+
+def test_chroma_qp_table_spot_values():
+    """Table 8-15 spot checks: identity below 30, compressing tail,
+    clip3 saturation via the PPS offset."""
+    from easydarwin_tpu.codecs.h264_transform import chroma_qp
+    assert chroma_qp(0) == 0 and chroma_qp(29) == 29
+    assert chroma_qp(30) == 29 and chroma_qp(33) == 32
+    assert chroma_qp(39) == 35 and chroma_qp(51) == 39
+    assert chroma_qp(45, 12) == 39 and chroma_qp(51, 12) == 39
+    assert chroma_qp(3, -10) == 0
+
+
+def test_chroma_dc_residual_bijection_fuzz():
+    rng = np.random.default_rng(5)
+    for _ in range(3000):
+        lv = [int(v) for v in rng.integers(-200, 200, 4)
+              * (rng.random(4) < 0.6)]
+        bw = BitWriter()
+        cavlc.encode_residual(bw, lv, -1, 4)
+        bw.rbsp_trailing()
+        out = cavlc.decode_residual(BitReader(bw.to_bytes()), -1, 4)
+        assert out == lv
+
+
+def test_chroma_encode_decode_roundtrip_psnr():
+    """Real 4:2:0 chroma residuals through the full encoder/decoder:
+    PSNR improves as QP drops, chroma tracks luma quality."""
+    from easydarwin_tpu.codecs.h264_intra import decode_iframe_yuv
+    rng = np.random.default_rng(2)
+    y = _img(64)
+    cb = (_img(32).astype(np.int64) - 30).clip(0, 255).astype(np.uint8)
+    cr = (255 - _img(32).astype(np.int64)).clip(0, 255).astype(np.uint8)
+    prev = 0.0
+    for qp in (38, 30, 22):
+        nals = encode_iframe(y, qp, cb=cb, cr=cr)
+        dy, dcb, dcr = decode_iframe_yuv(nals)
+        q = min(psnr(cb, dcb), psnr(cr, dcr))
+        assert q > prev
+        prev = q
+    assert prev > 38.0
+    assert psnr(y, dy) > 38.0
+
+
+def test_chroma_requant_scalar_vs_device_bit_exact():
+    from easydarwin_tpu.codecs.h264_transform import (chroma_qp,
+                                                      requant_chroma_scalar)
+    from easydarwin_tpu.ops.transform import h264_requant_chroma
+    rng = np.random.default_rng(9)
+    n = 256
+    dc = rng.integers(-400, 400, (n, 4)).astype(np.int32)
+    ac = (rng.integers(-90, 90, (n, 4, 15))
+          * (rng.random((n, 4, 15)) < 0.4)).astype(np.int32)
+    qpy = rng.integers(8, 46, n)
+    dqp = rng.choice([6, 12, 18], n)
+    qi = np.array([chroma_qp(int(q)) for q in qpy], dtype=np.int32)
+    qo = np.array([chroma_qp(int(q + d)) for q, d in zip(qpy, dqp)],
+                  dtype=np.int32)
+    qi[:16] = 39
+    qo[:16] = 39                      # saturation-identity rows
+    ddc, dac = h264_requant_chroma(dc, ac, qi, qo)
+    ddc, dac = np.asarray(ddc), np.asarray(dac)
+    for i in range(n):
+        sdc, sac = requant_chroma_scalar(dc[i], ac[i], int(qi[i]),
+                                         int(qo[i]))
+        np.testing.assert_array_equal(sdc, ddc[i])
+        np.testing.assert_array_equal(sac, dac[i])
+
+
+def test_chroma_requant_clip_contract_bit_exact():
+    """Hostile levels beyond LEVEL_CLIP: the documented clips keep the
+    int64 scalar and the int32 device paths identical."""
+    from easydarwin_tpu.codecs.h264_transform import requant_chroma_scalar
+    from easydarwin_tpu.ops.transform import h264_requant_chroma
+    rng = np.random.default_rng(13)
+    n = 64
+    dc = rng.integers(-6000, 6000, (n, 4)).astype(np.int32)
+    ac = rng.integers(-6000, 6000, (n, 4, 15)).astype(np.int32)
+    qi = np.full(n, 20, np.int32)
+    qo = np.full(n, 29, np.int32)     # general (non-6k) arm
+    ddc, dac = h264_requant_chroma(dc, ac, qi, qo)
+    for i in range(n):
+        sdc, sac = requant_chroma_scalar(dc[i], ac[i], 20, 29)
+        np.testing.assert_array_equal(sdc, np.asarray(ddc)[i])
+        np.testing.assert_array_equal(sac, np.asarray(dac)[i])
+
+
+def test_chroma_slice_requant_cuts_bitrate_and_decodes():
+    """End-to-end: a chroma-bearing slice requants smaller on every
+    engine (scalar, device, native), all three byte-identical, and the
+    result still decodes with sane chroma PSNR."""
+    from easydarwin_tpu import native
+    from easydarwin_tpu.codecs.h264_intra import decode_iframe_yuv
+    from easydarwin_tpu.codecs.h264_requant import device_batch_chroma
+    y = _img(64)
+    cb = (_img(32).astype(np.int64) - 30).clip(0, 255).astype(np.uint8)
+    cr = (255 - _img(32).astype(np.int64)).clip(0, 255).astype(np.uint8)
+    nals = encode_iframe(y, 24, cb=cb, cr=cr)
+    outs = {}
+    engines = {
+        "scalar": dict(prefer_native=False),
+        "device": dict(requant_fn=device_batch,
+                       chroma_fn=device_batch_chroma),
+    }
+    if native.available():
+        engines["native"] = {}
+    for name, kw in engines.items():
+        rq = SliceRequantizer(6, **kw)
+        outs[name] = [rq.transform_nal(n) for n in nals]
+        assert rq.stats.slices_requantized == 1, name
+        if name == "native":
+            assert rq.stats.native_slices == 1
+    first = next(iter(outs.values()))
+    for name, out in outs.items():
+        assert out == first, name
+        assert sum(map(len, out)) < sum(map(len, nals))
+    dy, dcb, dcr = decode_iframe_yuv(first)
+    assert psnr(cb, dcb) > 24.0 and psnr(cr, dcr) > 24.0
+
+
+def test_chroma_saturation_passes_levels_through():
+    """chroma_qp_offset pushing both QPc into the Table 8-15 clip region
+    ⇒ delta_c == 0 ⇒ chroma levels must survive requant UNCHANGED while
+    luma still steps down."""
+    from easydarwin_tpu.codecs.h264_bits import BitReader, nal_to_rbsp
+    from easydarwin_tpu.codecs.h264_intra import SliceCodec
+    rng = np.random.default_rng(21)
+    sps = Sps(3, 2)
+    pps = Pps(pic_init_qp=40, chroma_qp_offset=12)
+    nal, mbs = _mixed_slice(rng, sps, pps, 40, chroma=True)
+    for kw in (dict(prefer_native=False), {}):
+        rq = SliceRequantizer(6, **kw)
+        rq.sps, rq.pps = sps, pps
+        out = rq.transform_nal(nal)
+        codec = SliceCodec(sps, pps)
+        br = BitReader(nal_to_rbsp(out[1:]))
+        hdr = codec.parse_slice_header(br, 0x65)
+        assert hdr.qp == 46
+        back = codec.parse_mbs(br, hdr.qp)
+        for a, b in zip(mbs, back):
+            np.testing.assert_array_equal(a.chroma_dc, b.chroma_dc)
+            np.testing.assert_array_equal(a.chroma_ac, b.chroma_ac)
+
+
+def test_chroma_mixed_slice_native_matches_python():
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    rng = np.random.default_rng(17)
+    for qp, off in ((24, 0), (30, 2), (36, -4), (18, 0)):
+        sps = Sps(4, 3)
+        pps = Pps(pic_init_qp=26, chroma_qp_offset=off)
+        nal, _ = _mixed_slice(rng, sps, pps, qp, dense=True, chroma=True)
+        py = SliceRequantizer(6, prefer_native=False)
+        nat = SliceRequantizer(6)
+        for rq in (py, nat):
+            rq.sps, rq.pps = sps, pps
+        out_py = py.transform_nal(nal)
+        out_nat = nat.transform_nal(nal)
+        assert out_py == out_nat, (qp, off)
+        assert nat.stats.native_slices == 1
+
+
+def test_chroma_pps_offset_roundtrip():
+    p = Pps(pic_init_qp=30, chroma_qp_offset=-7)
+    assert Pps.parse(p.build()).chroma_qp_offset == -7

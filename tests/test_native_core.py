@@ -167,8 +167,9 @@ def test_udp_ingest_into_ring():
     data = np.zeros((8, 2060), dtype=np.uint8)
     lens = np.zeros(8, dtype=np.int32)
     arr = np.zeros(8, dtype=np.int64)
-    n, head = native.udp_ingest(rx.fileno(), data, lens, arr,
-                                now_ms=12345, head=6, max_pkts=32)
+    n, head, oversize = native.udp_ingest(rx.fileno(), data, lens, arr,
+                                          now_ms=12345, head=6, max_pkts=32)
+    assert oversize == 0
     assert n == 5 and head == 11
     for i, p in enumerate(sent):
         slot = (6 + i) % 8
@@ -176,7 +177,7 @@ def test_udp_ingest_into_ring():
         assert data[slot, :len(p)].tobytes() == p
         assert arr[slot] == 12345
     # drained: second call reads nothing
-    n2, head2 = native.udp_ingest(rx.fileno(), data, lens, arr,
+    n2, head2, _ = native.udp_ingest(rx.fileno(), data, lens, arr,
                                   now_ms=12346, head=head)
     assert n2 == 0 and head2 == head
     rx.close()

@@ -7,6 +7,7 @@ not the coarse reflect tick."""
 import asyncio
 import socket
 import struct
+import time
 
 import pytest
 
@@ -147,3 +148,59 @@ async def test_wheel_releases_bucket_delayed_packets_before_tick():
         await pusher.close()
     finally:
         await app.stop()
+
+
+def test_native_drain_drops_kernel_truncated_datagrams():
+    """An oversize datagram (> slot) must be DROPPED by the recvmmsg
+    drain — not admitted capped — and later datagrams in the same batch
+    must compact into its slot (mirrors PacketRing.push's oversize
+    drop)."""
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    from easydarwin_tpu.relay.ring import PacketRing
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.setblocking(False)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        ring = PacketRing(capacity=64)
+        keep1 = b"\x80\x60\x00\x01" + b"A" * 60
+        keep2 = b"\x80\x60\x00\x03" + b"C" * 60
+        for p in (keep1, b"\x80\x60\x00\x02" + b"B" * 3000, keep2):
+            tx.sendto(p, rx.getsockname())
+        time.sleep(0.05)
+        n = ring.native_drain(rx.fileno(), 123)
+        assert n == 2
+        assert ring.get(0) == keep1 and ring.get(1) == keep2
+    finally:
+        rx.close()
+        tx.close()
+
+
+def test_native_drain_oversize_flood_respects_budget():
+    """max_pkts bounds datagrams CONSUMED, not admitted: an oversize
+    flood must not extend one drain call past the caller's work budget
+    (it would stall the event loop for every stream)."""
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    from easydarwin_tpu.relay.ring import PacketRing
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.setblocking(False)
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        ring = PacketRing(capacity=64)
+        for i in range(20):
+            tx.sendto(b"\x80\x60" + bytes([0, i]) + b"B" * 3000,
+                      rx.getsockname())
+        time.sleep(0.05)
+        n = ring.native_drain(rx.fileno(), 1, max_pkts=8)
+        assert n == 0
+        assert ring.total_oversize == 8          # budget consumed, not more
+        n2 = ring.native_drain(rx.fileno(), 2, max_pkts=64)
+        assert n2 == 0 and ring.total_oversize == 20
+    finally:
+        rx.close()
+        tx.close()

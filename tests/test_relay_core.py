@@ -46,6 +46,17 @@ def test_ring_wraparound_and_drop_count():
     assert r.get(ids[-1]) == vid_pkt(9)
 
 
+def test_ring_drops_oversize_instead_of_truncating():
+    """A packet larger than the slot would relay CORRUPT bytes to every
+    consumer if truncated (the pre-fix behavior); it must be dropped and
+    counted, and the ring must stay intact."""
+    r = PacketRing(capacity=4)
+    assert r.push(b"\x80" * (r.slot_size + 1), 1000) == -1
+    assert r.total_oversize == 1 and len(r) == 0
+    pid = r.push(vid_pkt(1), 1001)
+    assert pid == 0 and r.get(pid) == vid_pkt(1)
+
+
 def test_basic_fanout_with_rewrite():
     st = mkstream()
     out = CollectingOutput(ssrc=0xAAAA, out_seq_start=100, out_ts_start=0)
