@@ -156,7 +156,8 @@ int64_t ed_udp_drain_ex(const int32_t *fds, int32_t n_fds,
 
 /* Native CAVLC slice requantizer (the HLS q-rung hot path) — decodes a
  * baseline-intra slice (I_4x4 + I_16x16, luma and 4:2:0 chroma
- * residuals), requantizes every level delta_qp steps coarser (luma:
+ * residuals, multi-slice pictures via first_mb_in_slice + the 7.3.4
+ * stop-bit walk), requantizes every level delta_qp steps coarser (luma:
  * exact +6k shift; chroma: Table 8-15 QPc mapping with identity /
  * shift / integer-round-trip dispatch), re-encodes with recomputed
  * CBP/nC contexts and QP chain.  Bit-exact vs the Python oracle
@@ -169,7 +170,7 @@ int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset);
+    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out);
 
 /* ------------------------------------------------------------- timer wheel */
 

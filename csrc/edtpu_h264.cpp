@@ -33,9 +33,22 @@ struct BitReader {
   int64_t nbits;
   int64_t pos = 0;
   bool ok = true;
+  int64_t stop_bit = -1;  // rbsp_stop_one_bit position (last set bit)
 
   BitReader(const uint8_t *data, int64_t nbytes)
-      : d(data), nbits(nbytes * 8) {}
+      : d(data), nbits(nbytes * 8) {
+    for (int64_t i = nbytes - 1; i >= 0; --i) {
+      uint8_t b = data[i];
+      if (b) {
+        int low = __builtin_ctz(b);
+        stop_bit = i * 8 + 7 - low;
+        break;
+      }
+    }
+  }
+
+  // 7.3.4 moreDataFlag for CAVLC: payload remains before the stop bit
+  bool more_rbsp_data() const { return pos < stop_bit; }
 
   int bit() {
     if (pos >= nbits) {
@@ -655,7 +668,7 @@ extern "C" int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset) {
+    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out) {
   if (nal_len < 2 || delta_qp < 6 || delta_qp % 6) return kErrUnsupported;
   uint8_t nal_byte = nal[0];
   int nal_type = nal_byte & 0x1F;
@@ -670,7 +683,7 @@ extern "C" int32_t ed_h264_requant_slice(
   SliceHeader h{};
   h.nal_type = nal_type;
   h.nal_ref_idc = nal_ref_idc;
-  if (br.ue() != 0) return kErrUnsupported;        // first_mb_in_slice
+  uint32_t first_mb = br.ue();                     // first_mb_in_slice
   h.slice_type = static_cast<int>(br.ue());
   if (h.slice_type % 5 != 2) return kErrUnsupported;
   br.ue();                                         // pps id
@@ -818,7 +831,13 @@ extern "C" int32_t ed_h264_requant_slice(
   int deadzone = (1 << k) / 3;
   int32_t cur_qp = h.qp;
   int32_t max_qp = h.qp;
-  for (int mb = 0; mb < n_mbs; ++mb) {
+  if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;
+  int end_mb = n_mbs;  // one past the slice's last MB (7.3.4 stop-bit)
+  for (int mb = static_cast<int>(first_mb); mb < n_mbs; ++mb) {
+    if (mb > static_cast<int>(first_mb) && !br.more_rbsp_data()) {
+      end_mb = mb;
+      break;
+    }
     uint32_t mb_type = br.ue();
     if (!br.ok) return kErrBitstream;
     if (mb_type >= 1 && mb_type <= 24) {
@@ -910,11 +929,12 @@ extern "C" int32_t ed_h264_requant_slice(
   }
   if (!br.ok) return kErrBitstream;
   if (max_qp + delta_qp > 51) return kErrUnsupported;  // ladder ceiling
+  if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
 
   // ---- re-encode
   BitWriter bw;
   int32_t qp_out_base = h.qp + delta_qp;
-  bw.ue(0);
+  bw.ue(first_mb);
   bw.ue(static_cast<uint32_t>(h.slice_type));
   bw.ue(static_cast<uint32_t>(pps_id));            // the latched PPS's id
   bw.bits(h.frame_num, log2_max_frame_num);
@@ -941,7 +961,7 @@ extern "C" int32_t ed_h264_requant_slice(
   std::fill(tot_c.begin(), tot_c.end(), static_cast<int16_t>(-1));
   cw = &bw;
   int32_t prev_qp = qp_out_base;
-  for (int mb = 0; mb < n_mbs; ++mb) {
+  for (int mb = static_cast<int>(first_mb); mb < end_mb; ++mb) {
     int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
     if (mb_is16[mb]) {
       bool luma15 = mb_cbp[mb] == 15;

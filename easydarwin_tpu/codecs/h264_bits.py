@@ -15,6 +15,7 @@ class BitReader:
     def __init__(self, data: bytes):
         self.data = data
         self.pos = 0                    # bit position
+        self._stop_bit: int | None = None   # cached rbsp_stop_one_bit pos
 
     @property
     def bits_left(self) -> int:
@@ -71,13 +72,17 @@ class BitReader:
 
     def more_rbsp_data(self) -> bool:
         """True while data before the rbsp_stop_one_bit remains (the stop
-        bit is the LAST set bit of the RBSP)."""
-        if self.bits_left <= 0:
-            return False
-        for p in range(len(self.data) * 8 - 1, self.pos - 1, -1):
-            if (self.data[p >> 3] >> (7 - (p & 7))) & 1:
-                return p > self.pos
-        return False
+        bit is the LAST set bit of the RBSP; its position is found once
+        and cached — the multi-slice MB walk queries this per MB)."""
+        if self._stop_bit is None:
+            self._stop_bit = -1
+            for i in range(len(self.data) - 1, -1, -1):
+                b = self.data[i]
+                if b:
+                    low = b & -b                     # lowest set bit
+                    self._stop_bit = i * 8 + 7 - low.bit_length() + 1
+                    break
+        return self.pos < self._stop_bit
 
 
 class BitWriter:

@@ -153,6 +153,7 @@ class SliceHeader:
     nal_type: int = 5
     nal_ref_idc: int = 3
     slice_type: int = 7
+    first_mb: int = 0                   # first_mb_in_slice (multi-slice)
     frame_num: int = 0
     idr_pic_id: int = 0
     poc_lsb: int = 0
@@ -227,9 +228,9 @@ class SliceCodec:
         nal_type = nal_byte & 0x1F
         nal_ref_idc = (nal_byte >> 5) & 3
         h = SliceHeader(nal_type=nal_type, nal_ref_idc=nal_ref_idc)
-        first_mb = br.ue()
-        if first_mb != 0:
-            raise ValueError("multi-slice pictures unsupported")
+        h.first_mb = br.ue()
+        if h.first_mb >= self.sps.width_mbs * self.sps.height_mbs:
+            raise ValueError("first_mb_in_slice beyond the picture")
         h.slice_type = br.ue()
         if h.slice_type % 5 != 2:
             raise ValueError(
@@ -260,7 +261,7 @@ class SliceCodec:
 
     def write_slice_header(self, bw: BitWriter, h: "SliceHeader",
                            qp: int) -> None:
-        bw.ue(0)                         # first_mb_in_slice
+        bw.ue(h.first_mb)                # first_mb_in_slice
         bw.ue(h.slice_type)
         bw.ue(self.pps.pps_id)
         bw.write_bits(h.frame_num, self.sps.log2_max_frame_num)
@@ -293,13 +294,19 @@ class SliceCodec:
                           self.sps.width_mbs * 2), -1, dtype=np.int32)
         return luma, chroma
 
-    def parse_mbs(self, br: BitReader, slice_qp: int
+    def parse_mbs(self, br: BitReader, slice_qp: int, first_mb: int = 0
                   ) -> "list[MacroblockI4x4 | MacroblockI16x16]":
+        """Walk the slice's MBs from ``first_mb`` until the RBSP stop bit
+        (7.3.4 moreDataFlag for CAVLC).  nC contexts start fresh — MBs of
+        other slices are unavailable neighbors (6.4.9), which the grids'
+        untouched −1 cells encode exactly."""
         n_mbs = self.sps.width_mbs * self.sps.height_mbs
         totals, tot_c = self._fresh_totals()
         mbs = []
         cur_qp = slice_qp
-        for mb_idx in range(n_mbs):
+        for mb_idx in range(first_mb, n_mbs):
+            if mbs and not br.more_rbsp_data():
+                break                   # end of this slice's MB data
             mb_type = br.ue()
             if mb_type == 0:
                 modes = []
@@ -348,10 +355,11 @@ class SliceCodec:
 
     def write_mbs(self, bw: BitWriter,
                   mbs: "list[MacroblockI4x4 | MacroblockI16x16]",
-                  slice_qp: int) -> None:
+                  slice_qp: int, first_mb: int = 0) -> None:
         totals, tot_c = self._fresh_totals()
         prev_qp = slice_qp               # deltas are vs the PREVIOUS MB's
-        for mb_idx, mb in enumerate(mbs):  # QP (7.4.5), not the slice QP
+        for mb_idx, mb in enumerate(mbs, start=first_mb):  # QP (7.4.5),
+            # not the slice QP
             if isinstance(mb, MacroblockI16x16):
                 bw.ue(mb.mb_type)
                 bw.ue(mb.chroma_mode)
@@ -509,11 +517,14 @@ class SliceCodec:
 
 # ----------------------------------------------------------------- encoder
 
-def _dc_pred(recon: np.ndarray, gx: int, gy: int) -> int:
-    """4×4 DC prediction from reconstructed neighbors (mode 2)."""
+def _dc_pred(recon: np.ndarray, gx: int, gy: int, gy_min: int = 0) -> int:
+    """4×4 DC prediction from reconstructed neighbors (mode 2).
+    ``gy_min`` is the slice's first 4×4-block row: neighbors above it
+    belong to another slice and are unavailable (6.4.9); slices split on
+    MB-row boundaries, so left neighbors are always same-slice."""
     x0, y0 = gx * 4, gy * 4
     left = recon[y0:y0 + 4, x0 - 1] if x0 > 0 else None
-    top = recon[y0 - 1, x0:x0 + 4] if y0 > 0 else None
+    top = recon[y0 - 1, x0:x0 + 4] if gy > gy_min else None
     if left is not None and top is not None:
         return int((int(left.sum()) + int(top.sum()) + 4) >> 3)
     if left is not None:
@@ -523,17 +534,19 @@ def _dc_pred(recon: np.ndarray, gx: int, gy: int) -> int:
     return 128
 
 
-def _chroma_dc_pred_mb(recon: np.ndarray, mbx: int, mby: int) -> np.ndarray:
+def _chroma_dc_pred_mb(recon: np.ndarray, mbx: int, mby: int,
+                       mby_min: int = 0) -> np.ndarray:
     """[8,8] mode-0 (DC) chroma prediction for one MB per 8.3.4.1: each
     4×4 sub-block predicts from the MB-adjacent row above / column left
     at its own offsets, with the corner blocks averaging both and the
-    off-diagonal blocks preferring top (x>0) or left (y>0)."""
+    off-diagonal blocks preferring top (x>0) or left (y>0).  ``mby_min``
+    is the slice's first MB row (rows above are another slice)."""
     x0, y0 = mbx * 8, mby * 8
     pred = np.empty((8, 8), dtype=np.int64)
     for by in range(2):
         for bx in range(2):
             top = (recon[y0 - 1, x0 + bx * 4:x0 + bx * 4 + 4]
-                   if mby > 0 else None)
+                   if mby > mby_min else None)
             left = (recon[y0 + by * 4:y0 + by * 4 + 4, x0 - 1]
                     if mbx > 0 else None)
             if (bx, by) == (1, 0):        # top-right block prefers top
@@ -555,10 +568,10 @@ def _chroma_dc_pred_mb(recon: np.ndarray, mbx: int, mby: int) -> np.ndarray:
 
 
 def _encode_chroma_comp(plane: np.ndarray, recon: np.ndarray, mbx: int,
-                        mby: int, qpc: int
+                        mby: int, qpc: int, mby_min: int = 0
                         ) -> tuple[np.ndarray, np.ndarray]:
     """Quantize one MB's chroma component: ([4] DC levels, [4,15] AC)."""
-    pred = _chroma_dc_pred_mb(recon, mbx, mby)
+    pred = _chroma_dc_pred_mb(recon, mbx, mby, mby_min)
     x0, y0 = mbx * 8, mby * 8
     res = plane[y0:y0 + 8, x0:x0 + 8].astype(np.int64) - pred
     w00 = np.empty(4, dtype=np.int64)
@@ -572,10 +585,11 @@ def _encode_chroma_comp(plane: np.ndarray, recon: np.ndarray, mbx: int,
 
 
 def _recon_chroma_comp(recon: np.ndarray, mbx: int, mby: int,
-                       dc: np.ndarray, ac: np.ndarray, qpc: int) -> None:
+                       dc: np.ndarray, ac: np.ndarray, qpc: int,
+                       mby_min: int = 0) -> None:
     """Reconstruct one MB's chroma component exactly as a decoder does
     (8.5.11 DC chain + 8.5.12 AC dequant + inverse core transform)."""
-    pred = _chroma_dc_pred_mb(recon, mbx, mby)
+    pred = _chroma_dc_pred_mb(recon, mbx, mby, mby_min)
     if not (np.any(dc) or np.any(ac)):   # no residual: pure prediction
         x0, y0 = mbx * 8, mby * 8
         recon[y0:y0 + 8, x0:x0 + 8] = pred
@@ -599,86 +613,99 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
                   idr_pic_id: int = 0, cb: np.ndarray | None = None,
                   cr: np.ndarray | None = None,
                   sps: Sps | None = None, pps: Pps | None = None,
-                  include_ps: bool = True) -> list[bytes]:
+                  include_ps: bool = True, slices: int = 1) -> list[bytes]:
     """uint8 [H, W] luma (H, W multiples of 16) → NAL payloads
-    ([SPS, PPS,] IDR slice), DC-predicted I_4x4 with a real
+    ([SPS, PPS,] IDR slice(s)), DC-predicted I_4x4 with a real
     reconstruction loop (prediction always from reconstructed samples,
     as a conformant decoder will see them).  Optional ``cb``/``cr``
     [H/2, W/2] planes get real 4:2:0 chroma residuals (mode-0 predicted,
-    DC+AC coded); omitted planes keep chroma CBP 0."""
+    DC+AC coded); omitted planes keep chroma CBP 0.  ``slices`` splits
+    the picture into that many MB-row-aligned slices (the low-latency
+    encoder shape), each with slice-scoped prediction and nC contexts."""
     h, w = luma.shape
     if h % 16 or w % 16:
         raise ValueError("dimensions must be multiples of 16")
     sps = sps or Sps(w // 16, h // 16)
     pps = pps or Pps(pic_init_qp=qp)
+    if not 1 <= slices <= sps.height_mbs:
+        raise ValueError("slices must be in 1..height_mbs")
     codec = SliceCodec(sps, pps)
     recon = np.zeros((h, w), dtype=np.int64)
     do_chroma = cb is not None and cr is not None
     qpc = chroma_qp(qp, pps.chroma_qp_offset)
     recon_c = np.zeros((2, h // 2, w // 2), dtype=np.int64)
     zz = ZIGZAG4
-    mbs: list[MacroblockI4x4] = []
-    for mb_idx in range(sps.width_mbs * sps.height_mbs):
-        mb_x = (mb_idx % sps.width_mbs) * 4
-        mb_y = (mb_idx // sps.width_mbs) * 4
-        levels = np.zeros((16, 16), dtype=np.int64)
-        nz_blocks = np.zeros(16, dtype=bool)
-        for blk in range(16):
-            x4, y4 = BLK_XY[blk]
-            gx, gy = mb_x + x4, mb_y + y4
-            pred = _dc_pred(recon, gx, gy)
-            src = luma[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4].astype(np.int64)
-            res = src - pred
-            lv_raster = forward_transform_quant(res, qp)
-            levels[blk] = lv_raster[zz]
-            nz_blocks[blk] = bool(np.any(lv_raster))
-            rec_res = dequant_inverse(lv_raster, qp)
-            recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
-                pred + rec_res, 0, 255)
-        cbp = 0
-        for g in range(4):
-            if nz_blocks[4 * g:4 * g + 4].any():
-                cbp |= 1 << g
-        # CBP-cleared blocks carry no residual: the decoder reconstructs
-        # them as pure prediction, so mirror that here
-        for blk in range(16):
-            if not (cbp >> (blk >> 2)) & 1 and nz_blocks[blk]:
-                levels[blk] = 0
-        mb = MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, levels)
-        if do_chroma:
-            mbx = mb_idx % sps.width_mbs
-            mby = mb_idx // sps.width_mbs
-            for comp, plane in enumerate((cb, cr)):
-                mb.chroma_dc[comp], mb.chroma_ac[comp] = \
-                    _encode_chroma_comp(plane, recon_c[comp], mbx, mby,
-                                        qpc)
-            ccbp = (2 if np.any(mb.chroma_ac) else
-                    1 if np.any(mb.chroma_dc) else 0)
-            mb.cbp = cbp | (ccbp << 4)
-            for comp in range(2):
-                _recon_chroma_comp(recon_c[comp], mbx, mby,
-                                   mb.chroma_dc[comp], mb.chroma_ac[comp],
-                                   qpc)
-        mbs.append(mb)
-    bw = BitWriter()
-    hdr = SliceHeader(frame_num=frame_num, idr_pic_id=idr_pic_id, qp=qp)
-    codec.write_slice_header(bw, hdr, qp)
-    codec.write_mbs(bw, mbs, qp)
-    bw.rbsp_trailing()
-    slice_nal = bytes([0x65]) + rbsp_to_nal(bw.to_bytes())
+    slice_rows = np.array_split(np.arange(sps.height_mbs), slices)
+    out_nals: list[bytes] = []
+    for rows in slice_rows:
+        first_row = int(rows[0])
+        first_mb = first_row * sps.width_mbs
+        gy_min = first_row * 4           # slice boundary for prediction
+        mbs: list[MacroblockI4x4] = []
+        for mb_idx in range(first_mb,
+                            (int(rows[-1]) + 1) * sps.width_mbs):
+            mb_x = (mb_idx % sps.width_mbs) * 4
+            mb_y = (mb_idx // sps.width_mbs) * 4
+            levels = np.zeros((16, 16), dtype=np.int64)
+            nz_blocks = np.zeros(16, dtype=bool)
+            for blk in range(16):
+                x4, y4 = BLK_XY[blk]
+                gx, gy = mb_x + x4, mb_y + y4
+                pred = _dc_pred(recon, gx, gy, gy_min)
+                src = luma[gy * 4:gy * 4 + 4,
+                           gx * 4:gx * 4 + 4].astype(np.int64)
+                res = src - pred
+                lv_raster = forward_transform_quant(res, qp)
+                levels[blk] = lv_raster[zz]
+                nz_blocks[blk] = bool(np.any(lv_raster))
+                rec_res = dequant_inverse(lv_raster, qp)
+                recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
+                    pred + rec_res, 0, 255)
+            cbp = 0
+            for g in range(4):
+                if nz_blocks[4 * g:4 * g + 4].any():
+                    cbp |= 1 << g
+            # CBP-cleared blocks carry no residual: the decoder
+            # reconstructs them as pure prediction, so mirror that here
+            for blk in range(16):
+                if not (cbp >> (blk >> 2)) & 1 and nz_blocks[blk]:
+                    levels[blk] = 0
+            mb = MacroblockI4x4([(1, 0)] * 16, 0, cbp, qp, levels)
+            if do_chroma:
+                mbx = mb_idx % sps.width_mbs
+                mby = mb_idx // sps.width_mbs
+                for comp, plane in enumerate((cb, cr)):
+                    mb.chroma_dc[comp], mb.chroma_ac[comp] = \
+                        _encode_chroma_comp(plane, recon_c[comp], mbx,
+                                            mby, qpc, first_row)
+                ccbp = (2 if np.any(mb.chroma_ac) else
+                        1 if np.any(mb.chroma_dc) else 0)
+                mb.cbp = cbp | (ccbp << 4)
+                for comp in range(2):
+                    _recon_chroma_comp(recon_c[comp], mbx, mby,
+                                       mb.chroma_dc[comp],
+                                       mb.chroma_ac[comp], qpc, first_row)
+            mbs.append(mb)
+        bw = BitWriter()
+        hdr = SliceHeader(frame_num=frame_num, idr_pic_id=idr_pic_id,
+                          qp=qp, first_mb=first_mb)
+        codec.write_slice_header(bw, hdr, qp)
+        codec.write_mbs(bw, mbs, qp, first_mb)
+        bw.rbsp_trailing()
+        out_nals.append(bytes([0x65]) + rbsp_to_nal(bw.to_bytes()))
     if include_ps:
-        return [sps.build(), pps.build(), slice_nal]
-    return [slice_nal]
+        return [sps.build(), pps.build()] + out_nals
+    return out_nals
 
 
 # ----------------------------------------------------------------- decoder
 
 def decode_iframe_yuv(nals: list[bytes]
                       ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """NAL payloads → uint8 (Y [H,W], Cb, Cr [H/2,W/2]) planes
-    (DC-mode I_4x4 scope, full 4:2:0 chroma)."""
+    """NAL payloads → uint8 (Y [H,W], Cb, Cr [H/2,W/2]) planes (DC-mode
+    I_4x4 scope, full 4:2:0 chroma, MB-row-aligned multi-slice)."""
     sps = pps = None
-    slice_nal = None
+    slice_nals = []
     for nal in nals:
         t = nal[0] & 0x1F
         if t == 7:
@@ -686,44 +713,48 @@ def decode_iframe_yuv(nals: list[bytes]
         elif t == 8:
             pps = Pps.parse(nal)
         elif t in (1, 5):
-            slice_nal = nal
-    if sps is None or pps is None or slice_nal is None:
+            slice_nals.append(nal)
+    if sps is None or pps is None or not slice_nals:
         raise ValueError("need SPS+PPS+slice")
     codec = SliceCodec(sps, pps)
-    br = BitReader(nal_to_rbsp(slice_nal[1:]))
-    qp = codec.parse_slice_header(br, slice_nal[0]).qp
-    mbs = codec.parse_mbs(br, qp)
     h, w = sps.height_mbs * 16, sps.width_mbs * 16
     recon = np.zeros((h, w), dtype=np.int64)
     recon_c = np.zeros((2, h // 2, w // 2), dtype=np.int64)
     inv_zz = np.argsort(ZIGZAG4)
-    for mb_idx, mb in enumerate(mbs):
-        if isinstance(mb, MacroblockI16x16):
-            raise ValueError("decoder scope is I_4x4 only")
-        mb_x = (mb_idx % sps.width_mbs) * 4
-        mb_y = (mb_idx // sps.width_mbs) * 4
-        cur_qp = mb.qp
-        for blk in range(16):
-            flag, _rem = mb.pred_modes[blk]
-            if not flag:
-                # an explicit rem mode can never be DC when every context
-                # mode is DC (rem skips the predicted mode)
-                raise ValueError("non-DC intra mode out of scope")
-            x4, y4 = BLK_XY[blk]
-            gx, gy = mb_x + x4, mb_y + y4
-            pred = _dc_pred(recon, gx, gy)
-            lv = mb.levels[blk][inv_zz]
-            res = dequant_inverse(lv, cur_qp)
-            recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
-                pred + res, 0, 255)
-        if mb.chroma_mode != 0:
-            raise ValueError("non-DC chroma mode out of scope")
-        qpc = chroma_qp(cur_qp, pps.chroma_qp_offset)
-        for comp in range(2):
-            _recon_chroma_comp(recon_c[comp], mb_idx % sps.width_mbs,
-                               mb_idx // sps.width_mbs,
-                               mb.chroma_dc[comp], mb.chroma_ac[comp],
-                               qpc)
+    for slice_nal in slice_nals:
+        br = BitReader(nal_to_rbsp(slice_nal[1:]))
+        hdr = codec.parse_slice_header(br, slice_nal[0])
+        if hdr.first_mb % sps.width_mbs:
+            raise ValueError("decoder scope is MB-row-aligned slices")
+        first_row = hdr.first_mb // sps.width_mbs
+        mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb)
+        for mb_idx, mb in enumerate(mbs, start=hdr.first_mb):
+            if isinstance(mb, MacroblockI16x16):
+                raise ValueError("decoder scope is I_4x4 only")
+            mb_x = (mb_idx % sps.width_mbs) * 4
+            mb_y = (mb_idx // sps.width_mbs) * 4
+            cur_qp = mb.qp
+            for blk in range(16):
+                flag, _rem = mb.pred_modes[blk]
+                if not flag:
+                    # an explicit rem mode can never be DC when every
+                    # context mode is DC (rem skips the predicted mode)
+                    raise ValueError("non-DC intra mode out of scope")
+                x4, y4 = BLK_XY[blk]
+                gx, gy = mb_x + x4, mb_y + y4
+                pred = _dc_pred(recon, gx, gy, first_row * 4)
+                lv = mb.levels[blk][inv_zz]
+                res = dequant_inverse(lv, cur_qp)
+                recon[gy * 4:gy * 4 + 4, gx * 4:gx * 4 + 4] = np.clip(
+                    pred + res, 0, 255)
+            if mb.chroma_mode != 0:
+                raise ValueError("non-DC chroma mode out of scope")
+            qpc = chroma_qp(cur_qp, pps.chroma_qp_offset)
+            for comp in range(2):
+                _recon_chroma_comp(recon_c[comp], mb_idx % sps.width_mbs,
+                                   mb_idx // sps.width_mbs,
+                                   mb.chroma_dc[comp], mb.chroma_ac[comp],
+                                   qpc, first_row)
     return (recon.astype(np.uint8), recon_c[0].astype(np.uint8),
             recon_c[1].astype(np.uint8))
 

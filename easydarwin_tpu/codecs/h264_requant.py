@@ -9,7 +9,9 @@ through untouched (QP lives in the slice header).  Prediction drift is
 accepted and resets at every IDR, which in the all-intra camera configs
 this ladder targets means every frame.
 
-Scope: CAVLC baseline-intra slices of I_4x4 and I_16x16 macroblocks,
+Scope: CAVLC baseline-intra slices of I_4x4 and I_16x16 macroblocks —
+including multi-slice pictures (each slice requants independently from
+its ``first_mb_in_slice``, nC contexts slice-scoped per 6.4.9) — with
 luma AND 4:2:0 chroma residuals (luma steps by the exact +6k shift;
 chroma follows the Table 8-15 QPc mapping with a three-way
 identity / exact-shift / integer-round-trip dispatch — see
@@ -134,12 +136,12 @@ class SliceRequantizer:
         self.stats.bytes_in += len(nal)
         out = None
         if self._native:
-            out = self._requant_native(nal)
-            if out is not None:
+            res = self._requant_native(nal)
+            if res is not None:
+                out, n_slice_mbs = res
                 self.stats.slices_requantized += 1
                 self.stats.native_slices += 1
-                self.stats.blocks += \
-                    self.sps.width_mbs * self.sps.height_mbs * 16
+                self.stats.blocks += n_slice_mbs * 16
         if out is None:
             try:
                 out = self._requant_slice(nal)
@@ -150,7 +152,7 @@ class SliceRequantizer:
         self.stats.bytes_out += len(out)
         return out
 
-    def _requant_native(self, nal: bytes) -> bytes | None:
+    def _requant_native(self, nal: bytes) -> "tuple[bytes, int] | None":
         from .. import native
         if not native.available():
             return None
@@ -169,7 +171,7 @@ class SliceRequantizer:
         br = BitReader(nal_to_rbsp(nal[1:]))
         hdr = codec.parse_slice_header(br, nal[0])
         qp_in_base = hdr.qp
-        mbs = codec.parse_mbs(br, qp_in_base)
+        mbs = codec.parse_mbs(br, qp_in_base, hdr.first_mb)
         qp_out_base = qp_in_base + self.delta_qp
         # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5):
         # the ceiling check covers the true per-MB maxima
@@ -254,6 +256,6 @@ class SliceRequantizer:
             mb.qp = mb.qp + self.delta_qp
         bw = BitWriter()
         codec.write_slice_header(bw, hdr, qp_out_base)
-        codec.write_mbs(bw, mbs, qp_out_base)
+        codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb)
         bw.rbsp_trailing()
         return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes())

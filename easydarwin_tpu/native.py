@@ -109,7 +109,8 @@ def _load():
             u8p, ctypes.c_int32, u8p, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32]
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
@@ -250,19 +251,23 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
                        log2_max_poc_lsb: int, pic_init_qp: int,
                        pps_id: int, deblocking_control: bool,
                        bottom_field_poc: bool, delta_qp: int,
-                       chroma_qp_offset: int = 0) -> bytes | None:
-    """Native CAVLC slice requant; None = unsupported/malformed (caller
-    passes the slice through or falls back to the Python path)."""
+                       chroma_qp_offset: int = 0
+                       ) -> tuple[bytes, int] | None:
+    """Native CAVLC slice requant → (nal, mbs_in_slice); None =
+    unsupported/malformed (caller passes the slice through or falls back
+    to the Python path)."""
     lib = _load()
     assert lib is not None
     src = np.frombuffer(nal, dtype=np.uint8)
     cap = len(nal) * 2 + 256
     out = np.zeros(cap, dtype=np.uint8)
+    mbs = ctypes.c_int32(0)
     n = lib.ed_h264_requant_slice(
         _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
         log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
         pps_id, 1 if deblocking_control else 0,
-        1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset)
+        1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset,
+        ctypes.byref(mbs))
     if n == -3:                      # tiny chance: expansion past 2x
         cap = len(nal) * 4 + 4096
         out = np.zeros(cap, dtype=np.uint8)
@@ -270,8 +275,9 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
             _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
             log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
             pps_id, 1 if deblocking_control else 0,
-            1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset)
-    return out[:n].tobytes() if n > 0 else None
+            1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset,
+            ctypes.byref(mbs))
+    return (out[:n].tobytes(), mbs.value) if n > 0 else None
 
 
 def last_send_errno() -> int:

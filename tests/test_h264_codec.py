@@ -607,3 +607,66 @@ def test_chroma_mixed_slice_native_matches_python():
 def test_chroma_pps_offset_roundtrip():
     p = Pps(pic_init_qp=30, chroma_qp_offset=-7)
     assert Pps.parse(p.build()).chroma_qp_offset == -7
+
+
+# ------------------------------------------------------------- multi-slice
+
+def test_multislice_encode_decode_roundtrip():
+    """MB-row-aligned multi-slice pictures (the low-latency encoder
+    shape): per-slice prediction and nC contexts, same quality as
+    single-slice."""
+    from easydarwin_tpu.codecs.h264_intra import decode_iframe_yuv
+    img = _img(96)
+    cbp = (_img(48).astype(np.int64) - 20).clip(0, 255).astype(np.uint8)
+    crp = (255 - _img(48).astype(np.int64)).clip(0, 255).astype(np.uint8)
+    for ns in (2, 3, 6):
+        nals = encode_iframe(img, 26, cb=cbp, cr=crp, slices=ns)
+        assert len(nals) == 2 + ns
+        dy, dcb, dcr = decode_iframe_yuv(nals)
+        assert psnr(img, dy) > 33 and psnr(cbp, dcb) > 33
+        assert psnr(crp, dcr) > 33
+
+
+def test_multislice_requant_all_engines_identical():
+    """Every slice of a multi-slice picture requants (none pass
+    through), Python and native produce identical bytes, and the result
+    still decodes."""
+    from easydarwin_tpu import native
+    from easydarwin_tpu.codecs.h264_intra import decode_iframe_yuv
+    img = _img(96)
+    cbp = (_img(48).astype(np.int64) - 20).clip(0, 255).astype(np.uint8)
+    nals = encode_iframe(img, 24, cb=cbp, cr=cbp, slices=3)
+    py = SliceRequantizer(6, prefer_native=False)
+    out_py = [py.transform_nal(n) for n in nals]
+    assert py.stats.slices_requantized == 3
+    assert py.stats.slices_passed_through == 0
+    assert sum(map(len, out_py)) < sum(map(len, nals))
+    if native.available():
+        nat = SliceRequantizer(6)
+        out_nat = [nat.transform_nal(n) for n in nals]
+        assert out_nat == out_py
+        assert nat.stats.native_slices == 3
+    dy, dcb, _ = decode_iframe_yuv(out_py)
+    assert psnr(img, dy) > 20 and psnr(cbp, dcb) > 22
+
+
+def test_multislice_nc_contexts_are_slice_scoped():
+    """A slice's first MB row must treat the row above as UNAVAILABLE
+    (6.4.9) — re-encoding slice 2 standalone must produce identical
+    bytes whether or not slice 1 was processed first (no cross-slice
+    context leak in either engine)."""
+    from easydarwin_tpu import native
+    img = _img(96)
+    nals = encode_iframe(img, 24, slices=2)
+    s2 = nals[3]
+    for kw in (dict(prefer_native=False), {}):
+        if kw == {} and not native.available():
+            continue
+        a = SliceRequantizer(6, **kw)
+        for n in nals:                        # slices 1 then 2
+            last = a.transform_nal(n)
+        b = SliceRequantizer(6, **kw)
+        b.transform_nal(nals[0])
+        b.transform_nal(nals[1])
+        only2 = b.transform_nal(s2)           # slice 2 alone
+        assert last == only2

@@ -419,3 +419,64 @@ def test_requant_rendition_real_coded_frames():
         assert psnr(img, decode_iframe(out_nals)) > 20
     master = svc.master_playlist(svc.outputs["/camq"])
     assert "q6/index.m3u8" in master
+
+
+def test_requant_rendition_chroma_frames_through_relay():
+    """Chroma-bearing frames (the shape real cameras push) through the
+    relay → q6 rendition: every slice requants (none pass through), the
+    rendition shrinks materially, and chroma still decodes."""
+    import numpy as np
+
+    from easydarwin_tpu.codecs.h264_intra import (decode_iframe_yuv,
+                                                  encode_iframe, psnr)
+    from easydarwin_tpu.hls.segmenter import HlsService
+    from easydarwin_tpu.relay.session import SessionRegistry
+
+    VIDEO = ("v=0\r\nm=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+             "a=control:trackID=1\r\n")
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/camc", VIDEO)
+    for st in sess.streams.values():
+        st.settings.bucket_delay_ms = 0
+    svc = HlsService(reg, target_duration=0.2)
+    svc.start("/camc", ("q6",))
+    src_out = svc.outputs["/camc"].renditions[""]
+    q6_out = svc.outputs["/camc"].renditions["q6"]
+
+    n = 64
+    x = np.arange(n)[None, :].repeat(n, 0).astype(np.float64)
+    y = np.arange(n)[:, None].repeat(n, 1).astype(np.float64)
+
+    def pl(f, m, base):
+        return (base + 45 * np.sin(x[:m, :m] / 8.0 + f / 3)
+                + 35 * np.cos(y[:m, :m] / 6.0)).clip(0, 255).astype(np.uint8)
+
+    seq = 0
+    planes = []
+    for f in range(10):
+        yp, cbp, crp = pl(f, 64, 128), pl(f + 5, 32, 100), pl(f + 9, 32, 150)
+        planes.append((yp, cbp, crp))
+        ts = int(f * 90000 / 30)
+        for nal in encode_iframe(yp, 24, cb=cbp, cr=crp, idr_pic_id=f % 2):
+            for p in nalu.packetize_h264(nal, seq=seq, timestamp=ts, ssrc=1,
+                                         marker_on_last=(nal[0] & 0x1F == 5)):
+                seq += 1
+                sess.push(1, p, t_ms=1000 + f * 33)
+        for st in sess.streams.values():
+            st.reflect(1000 + f * 33)
+
+    assert src_out.segments and q6_out.segments
+    src_bytes = sum(len(s.data) for s in src_out.segments)
+    q6_bytes = sum(len(s.data) for s in q6_out.segments)
+    assert q6_bytes < 0.75 * src_bytes, (q6_bytes, src_bytes)
+    assert q6_out.requant.stats.slices_requantized >= 8
+    assert q6_out.requant.stats.slices_passed_through == 0
+
+    # standalone decode check with chroma PSNR
+    from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
+    yp, cbp, crp = planes[0]
+    rq = SliceRequantizer(6)
+    out_nals = [rq.transform_nal(nn)
+                for nn in encode_iframe(yp, 24, cb=cbp, cr=crp)]
+    dy, dcb, dcr = decode_iframe_yuv(out_nals)
+    assert psnr(yp, dy) > 20 and psnr(cbp, dcb) > 22 and psnr(crp, dcr) > 22

@@ -118,7 +118,9 @@ async def soak(seconds: float) -> int:
         while time.time() - t0 < seconds:
             img = synth_frame(f)
             ts = int(f * 3000)
-            for nal in encode_iframe(img, 24):
+            # chroma planes soak the q-rung's chroma requant path too
+            for nal in encode_iframe(img, 24, cb=synth_frame(f + 7, 32),
+                                     cr=synth_frame(f + 13, 32)):
                 for p in nalu.packetize_h264(
                         nal, seq=seq_a, timestamp=ts, ssrc=1,
                         marker_on_last=(nal[0] & 0x1F == 5)):
