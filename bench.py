@@ -544,6 +544,48 @@ def cpu_reference_rate(ring, lens, addrs, *, seconds=2.0) -> float:
     return units / (time.perf_counter() - t0)
 
 
+def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
+    """Native q-rung throughput on a REAL chroma-bearing CAVLC slice:
+    macroblocks/s through ``ed_h264_requant_slice``, and the implied
+    number of concurrent 1080p30 bitrate renditions that throughput
+    sustains (1080p = 8160 MBs/frame).  The slice is encoded once by the
+    Python reference encoder (4:2:0, qp 24) and requanted repeatedly —
+    the production path for every HLS q-rung frame."""
+    from easydarwin_tpu.codecs.h264_intra import encode_iframe
+    from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
+    from easydarwin_tpu.utils.synth import synth_luma
+
+    n = 192                                   # 12x12 MBs = 144 MBs/frame
+    img = synth_luma(n)
+    nals = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2])
+    rq = SliceRequantizer(6)
+    for nal in nals[:2]:
+        rq.transform_nal(nal)
+    slice_nal = nals[2]
+    mbs_per_slice = (n // 16) ** 2
+    # warm up + verify the native path engages
+    rq.transform_nal(slice_nal)
+    if rq.stats.native_slices != 1:
+        return {"h264_requant_note": "native path unavailable"}
+    t0 = time.perf_counter()
+    done = 0
+    while time.perf_counter() - t0 < seconds:
+        rq.transform_nal(slice_nal)
+        done += 1
+    dt = time.perf_counter() - t0
+    mbs_s = done * mbs_per_slice / dt
+    return {
+        "h264_requant_mbs_per_sec": round(mbs_s, 0),
+        "h264_requant_1080p30_renditions": round(mbs_s / (8160 * 30), 1),
+        "h264_requant_method": (
+            "real 192x192 4:2:0 CAVLC slice (chroma DC+AC coded) through "
+            "the native requant walk, back-to-back on one core; 1080p30 "
+            "renditions = mbs_per_sec / (8160 MBs * 30 fps).  The HLS "
+            "worker sheds AUs when a rendition exceeds the budget, so an "
+            "over-budget rung degrades in frame rate, never in latency."),
+    }
+
+
 def run_with_timeout(fn, args, timeout_s, **kw):
     box = {}
 
@@ -630,6 +672,12 @@ def main():
         pump_rate = srv_p50 = srv_p99 = 0.0
         eng_extra = {"engine_error": lat_box.get("error", "unavailable")}
 
+    rq_box = run_with_timeout(h264_requant_throughput, (), 30.0) \
+        if have_native else {}
+    rq_extra = rq_box.get("result",
+                          {"h264_requant_note":
+                           rq_box.get("error", "unavailable")})
+
     time.sleep(0.2)
     drain.stop_flag = True
     received = drain.count
@@ -694,6 +742,7 @@ def main():
                 "Loopback UDP GSO/GRO stands in for NIC UDP offload. "
                 "p50/p99_added_ms: see latency_method."),
             **eng_extra,
+            **rq_extra,
             **info,
         },
     }))

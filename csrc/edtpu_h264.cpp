@@ -60,19 +60,42 @@ struct BitReader {
     return b;
   }
 
-  uint32_t bits(int n) {
-    uint32_t v = 0;
-    for (int i = 0; i < n; ++i) v = (v << 1) | bit();
-    return v;
+  // up to 25 bits starting at pos, zero-padded past the end: one
+  // unaligned 64-bit load + bswap on the common path (the VLC walk is
+  // bit-I/O bound — this is the q-rung's hottest primitive)
+  uint32_t peek(int n) const {
+    int64_t byte = pos >> 3;
+    int off = static_cast<int>(pos & 7);
+    int64_t nbytes = (nbits + 7) >> 3;
+    uint64_t w;
+#if __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+    if (byte + 8 <= nbytes) {
+      std::memcpy(&w, d + byte, 8);
+      w = __builtin_bswap64(w);
+      return static_cast<uint32_t>((w >> (64 - off - n)) &
+                                   ((1u << n) - 1));
+    }
+#endif
+    w = 0;
+    for (int i = 0; i < 5; ++i)
+      w = (w << 8) | (byte + i < nbytes ? d[byte + i] : 0);
+    return static_cast<uint32_t>((w >> (40 - off - n)) &
+                                 ((1u << n) - 1));
   }
 
-  uint32_t peek(int n) const {
-    uint32_t v = 0;
-    for (int i = 0; i < n; ++i) {
-      int64_t p = pos + i;
-      int b = p < nbits ? (d[p >> 3] >> (7 - (p & 7))) & 1 : 0;
-      v = (v << 1) | static_cast<uint32_t>(b);
+  uint32_t bits(int n) {
+    if (n == 0) return 0;
+    if (n <= 25) {
+      uint32_t v = peek(n);
+      if (pos + n > nbits) {
+        ok = false;
+        return 0;
+      }
+      pos += n;
+      return v;
     }
+    uint32_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 1) | bit();
     return v;
   }
 
@@ -85,7 +108,21 @@ struct BitReader {
     return true;
   }
 
+  // zero-run before the next stop 1 within a 25-bit window, WITHOUT
+  // consuming; -1 = run extends past the window (callers take the
+  // per-bit slow path).  Shared by ue() and the level_prefix reader.
+  int zrun25() const {
+    uint32_t w = peek(25);
+    return w ? __builtin_clz(w) - 7 : -1;
+  }
+
   uint32_t ue() {
+    int lz = zrun25();
+    if (lz >= 0 && 2 * lz + 1 <= 25) {
+      uint32_t w = peek(2 * lz + 1);
+      if (!advance(2 * lz + 1)) return 0;
+      return w - 1;
+    }
     int zeros = 0;
     while (bit() == 0) {
       if (++zeros > 31 || !ok) {
@@ -117,8 +154,19 @@ struct BitWriter {
     }
   }
 
+  // append n bits in one accumulator pass (≤ 7 pending + 32 new = 39
+  // bits max); the per-bit loop was the encode side's hot spot
   void bits(uint32_t v, int n) {
-    for (int i = n - 1; i >= 0; --i) bit((v >> i) & 1);
+    if (n <= 0) return;
+    uint64_t acc = (static_cast<uint64_t>(cur) << n) |
+                   (n < 32 ? (v & ((1u << n) - 1)) : v);
+    int total = nbits + n;
+    while (total >= 8) {
+      out.push_back(static_cast<uint8_t>(acc >> (total - 8)));
+      total -= 8;
+    }
+    cur = static_cast<uint32_t>(acc & ((1u << total) - 1));
+    nbits = total;
   }
 
   void ue(uint32_t v) {
@@ -333,9 +381,14 @@ bool decode_residual_n(BitReader &br, int nC, int16_t *levels, int maxc) {
   for (int i = 0; i < t1s; ++i) vals[nvals++] = br.bit() ? -1 : 1;
   int suffix_len = (total > 10 && t1s < 3) ? 1 : 0;
   for (int i = 0; i < total - t1s; ++i) {
-    int prefix = 0;
-    while (br.bit() == 0) {
-      if (++prefix > 32 || !br.ok) return false;
+    int prefix = br.zrun25();
+    if (prefix >= 0) {
+      if (!br.advance(prefix + 1)) return false;
+    } else {
+      prefix = 0;
+      while (br.bit() == 0) {
+        if (++prefix > 32 || !br.ok) return false;
+      }
     }
     int64_t level_code;
     if (prefix <= 14) {

@@ -1,0 +1,18 @@
+"""Shared synthetic test pattern for benches, soak and codec tests.
+
+One definition so the bench probe, the integration soak and the codec
+test suite all exercise the SAME content (a change to the pattern's
+coefficient ranges must not silently diverge between them)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synth_luma(n: int = 96, f: float = 0.0) -> np.ndarray:
+    """uint8 [n, n] plane of drifting sinusoids; ``f`` animates (frame
+    index) for soak-style moving content, 0 gives the static pattern."""
+    x = np.arange(n)[None, :].repeat(n, 0).astype(np.float64)
+    y = np.arange(n)[:, None].repeat(n, 1).astype(np.float64)
+    return (128 + 50 * np.sin(x / 9.0 + f / 3) + 40 * np.cos(y / 7.0 - f / 5)
+            + 20 * np.sin((x + y) / 5.0)).clip(0, 255).astype(np.uint8)

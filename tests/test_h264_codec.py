@@ -24,10 +24,8 @@ from easydarwin_tpu.codecs.h264_transform import (LEVEL_CLIP,
 
 
 def _img(n=96):
-    x = np.arange(n)[None, :].repeat(n, 0).astype(np.float64)
-    y = np.arange(n)[:, None].repeat(n, 1).astype(np.float64)
-    return (128 + 50 * np.sin(x / 9.0) + 40 * np.cos(y / 7.0)
-            + 20 * np.sin((x + y) / 5.0)).clip(0, 255).astype(np.uint8)
+    from easydarwin_tpu.utils.synth import synth_luma
+    return synth_luma(n)
 
 
 # ------------------------------------------------------------ bits / tables
@@ -670,3 +668,31 @@ def test_multislice_nc_contexts_are_slice_scoped():
         b.transform_nal(nals[1])
         only2 = b.transform_nal(s2)           # slice 2 alone
         assert last == only2
+
+
+def test_bitflip_fuzz_engines_agree():
+    """Random bit flips in valid chroma multi-slice NALs: neither engine
+    may crash, and both must produce IDENTICAL bytes — same requant
+    result when the mutation still parses, same passthrough when it
+    does not (no engine-dependent corruption on hostile input)."""
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    rng = np.random.default_rng(0)
+    img = _img(96)
+    cbp = img[::2, ::2]
+    nals = encode_iframe(img, 24, cb=cbp, cr=cbp, slices=2)
+    sps_n, pps_n = nals[0], nals[1]
+    slices = nals[2:]
+    for trial in range(200):
+        s = bytearray(slices[trial % 2])
+        for _ in range(int(rng.integers(1, 4))):
+            i = int(rng.integers(1, len(s)))
+            s[i] ^= 1 << int(rng.integers(0, 8))
+        mut = bytes(s)
+        py = SliceRequantizer(6, prefer_native=False)
+        nat = SliceRequantizer(6)
+        for rq in (py, nat):
+            rq.transform_nal(sps_n)
+            rq.transform_nal(pps_n)
+        assert py.transform_nal(mut) == nat.transform_nal(mut), trial

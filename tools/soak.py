@@ -35,10 +35,8 @@ SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=soak\r\nt=0 0\r\n"
 
 
 def synth_frame(f: int, n: int = 64) -> np.ndarray:
-    x = np.arange(n)[None, :].repeat(n, 0).astype(np.float64)
-    y = np.arange(n)[:, None].repeat(n, 1).astype(np.float64)
-    return (128 + 50 * np.sin(x / 9.0 + f / 3) + 40 * np.cos(y / 7.0 - f / 5)
-            ).clip(0, 255).astype(np.uint8)
+    from easydarwin_tpu.utils.synth import synth_luma
+    return synth_luma(n, f)
 
 
 async def soak(seconds: float) -> int:
