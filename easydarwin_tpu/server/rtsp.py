@@ -807,7 +807,15 @@ class RtspServer:
                        addr=None) -> None:
         """Receiver reports from players → per-output quality adaptation
         (the QTSS_RTCPProcess_Role → FlowControlModule pipeline), and
-        'qtak' acks → the reliable-UDP resend window."""
+        'qtak' acks → the reliable-UDP resend window.
+
+        Valid RTCP from a player proves the session is alive: refresh its
+        idle clock, or the sweep kills an actively-watching UDP player at
+        rtsp_timeout (its RTSP TCP connection is legitimately silent
+        during playback).  Refresh only AFTER a successful parse so
+        garbage/spoofed datagrams reaching the RTCP port cannot keep a
+        dead session allocated forever.  Reference: ``RTPStream::
+        ProcessIncomingRTCPPacket`` → ``RefreshTimeout`` via RTCPTask."""
         from ..protocol import rtcp as rtcp_mod
         self.stats.setdefault("rtcp_in", 0)
         self.stats["rtcp_in"] += 1
@@ -815,6 +823,7 @@ class RtspServer:
             pkts = rtcp_mod.parse_compound(data)
         except rtcp_mod.RtcpError:
             return
+        conn.last_activity = time.monotonic()
         outputs = {pt.output.rewrite.ssrc: pt.output
                    for pt in conn.player_tracks.values()}
         # the RTCP source address names the track (each SETUP registers its

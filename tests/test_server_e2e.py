@@ -405,3 +405,71 @@ async def test_glass_to_glass_latency_under_budget(cfg):
         await pusher.close()
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_rtcp_refreshes_udp_player_timeout():
+    """A UDP player's RTSP TCP connection is legitimately silent during
+    playback; its RTCP (RRs/acks) must refresh the idle clock or the
+    sweep kills an actively-watching player at rtsp_timeout (found by
+    the 300 s soak; reference: RTPStream::ProcessIncomingRTCPPacket →
+    RefreshTimeout).  A player sending NO RTCP must still be swept."""
+    import struct as _struct
+    import time as _time
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, reflect_interval_ms=5,
+                       bind_ip="127.0.0.1", rtsp_timeout_sec=1)
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/camto"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(1, 0, nal_type=5))
+
+        loop = asyncio.get_running_loop()
+
+        async def make_player():
+            class Sink(asyncio.DatagramProtocol):
+                def datagram_received(self, data, addr):
+                    pass
+            rtp_t, _ = await loop.create_datagram_endpoint(
+                Sink, local_addr=("127.0.0.1", 0))
+            rtcp_t, _ = await loop.create_datagram_endpoint(
+                Sink, local_addr=("127.0.0.1", 0))
+            pl = RtspClient()
+            await pl.connect("127.0.0.1", app.rtsp.port)
+            await pl.play_start(uri, tcp=False, client_ports=[
+                (rtp_t.get_extra_info("sockname")[1],
+                 rtcp_t.get_extra_info("sockname")[1])])
+            return pl, rtp_t, rtcp_t
+
+        alive, a_rtp, a_rtcp = await make_player()
+        dead, d_rtp, d_rtcp = await make_player()
+        try:
+            assert len(app.rtsp.connections) == 3    # pusher + 2 players
+
+            srv_rtcp = alive.transports[0].server_port[1]
+            rr = _struct.pack("!BBH I", 0x80, 201, 1, 0xCAFE)  # empty RR
+            t0 = _time.monotonic()
+            seq = 2
+            while _time.monotonic() - t0 < 3.2:
+                a_rtcp.sendto(rr, ("127.0.0.1", srv_rtcp))
+                pusher.push_packet(0, vid_pkt(seq, seq * 3000))
+                seq += 1
+                app.rtsp.sweep_timeouts()
+                await asyncio.sleep(0.25)
+            await asyncio.sleep(0.1)
+            conns = list(app.rtsp.connections)
+            # the silent player died; the RTCP-sending one survived 3x
+            # the timeout while its TCP connection stayed idle
+            assert any(c.player_tracks for c in conns), "alive swept"
+            assert len(conns) == 2, [c.is_pusher for c in conns]
+        finally:
+            for tr in (a_rtp, a_rtcp, d_rtp, d_rtcp):
+                tr.close()
+            await alive.close()
+            await dead.close()
+            await pusher.close()
+    finally:
+        await app.stop()
