@@ -812,9 +812,11 @@ class RtspServer:
         Valid RTCP from a player proves the session is alive: refresh its
         idle clock, or the sweep kills an actively-watching UDP player at
         rtsp_timeout (its RTSP TCP connection is legitimately silent
-        during playback).  Refresh only AFTER a successful parse so
-        garbage/spoofed datagrams reaching the RTCP port cannot keep a
-        dead session allocated forever.  Reference: ``RTPStream::
+        during playback).  The refresh requires PROOF of ownership — the
+        datagram's source is a registered track's RTCP address, or the
+        compound references an SSRC this connection's outputs own — so a
+        forged-but-parseable empty RR cannot keep a dead session
+        allocated forever.  Reference: ``RTPStream::
         ProcessIncomingRTCPPacket`` → ``RefreshTimeout`` via RTCPTask."""
         from ..protocol import rtcp as rtcp_mod
         self.stats.setdefault("rtcp_in", 0)
@@ -823,7 +825,6 @@ class RtspServer:
             pkts = rtcp_mod.parse_compound(data)
         except rtcp_mod.RtcpError:
             return
-        conn.last_activity = time.monotonic()
         outputs = {pt.output.rewrite.ssrc: pt.output
                    for pt in conn.player_tracks.values()}
         # the RTCP source address names the track (each SETUP registers its
@@ -836,11 +837,13 @@ class RtspServer:
                 if getattr(pt.output, "rtcp_addr", None) == tuple(addr):
                     addr_out = pt.output
                     break
+        proven = addr_out is not None
         for p in pkts:
             if isinstance(p, rtcp_mod.ReceiverReport):
                 for rb in p.reports:
                     out = outputs.get(rb.ssrc)
                     if out is not None:
+                        proven = True
                         out.on_receiver_report(rb.fraction_lost / 256.0)
             elif isinstance(p, rtcp_mod.Nadu):
                 # 3GPP NADU buffer state → per-output rate adaptation;
@@ -848,6 +851,7 @@ class RtspServer:
                 for blk in p.blocks:
                     out = outputs.get(blk.ssrc)
                     if out is not None:
+                        proven = True
                         out.on_nadu(blk.playout_delay_ms,
                                     blk.free_buffer_64b)
             elif isinstance(p, rtcp_mod.App):
@@ -867,7 +871,10 @@ class RtspServer:
                 for out in targets:
                     ack_fn = getattr(out, "on_rtcp_app", None)
                     if ack_fn is not None:
+                        proven = True
                         ack_fn(p)
+        if proven:
+            conn.last_activity = time.monotonic()
 
     def wake_pump(self) -> None:
         if self._on_pump_wake is not None:

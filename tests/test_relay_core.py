@@ -221,3 +221,28 @@ def test_stats_shape():
     st = sess.stats()
     assert st["outputs"] == 0
     assert st["streams"][1]["media"] == "video"
+
+
+def test_seq_wraparound_relay_continuity():
+    """A pusher crossing RTP seq 65535→0 (reached ~24 min into any real
+    stream): rewritten output seqs stay contiguous mod 2^16, and the RFC
+    3550 A.3 reception accounting records exactly one cycle with zero
+    inferred loss."""
+    st = mkstream(bucket_delay_ms=0)
+    out = CollectingOutput(ssrc=7)
+    st.add_output(out)
+    t = 1000
+    seqs = list(range(65520, 65536)) + list(range(0, 16))
+    for i, seq in enumerate(seqs):
+        st.push_rtp(vid_pkt(seq, ts=i * 3000,
+                            nal_type=5 if i == 0 else 1), t + i)
+    st.reflect(t + len(seqs))
+    got = [rtp.RtpPacket.parse(p).seq for p in out.rtp_packets]
+    assert len(got) == len(seqs)
+    for a, b in zip(got, got[1:]):
+        assert (b - a) & 0xFFFF == 1, (a, b)
+    assert st._rr_cycles == 1
+    # A.3 extended-seq balance: expected == received ⇒ zero loss inferred
+    ext_max = (st._rr_cycles << 16) + st._rr_max_seq
+    expected = ext_max - st._rr_base_seq + 1
+    assert expected == st._rr_received == len(seqs)

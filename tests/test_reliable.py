@@ -134,3 +134,27 @@ def test_on_rtcp_app_acks_by_output_seq():
         rtcp.parse_compound(build_ack(9, 40, 0x80000000))[0])
     assert acked == 2                             # seq 40 + mask bit 0 (41)
     assert rel.resender.in_flight == 1
+
+
+def test_resend_window_and_acks_across_seq_wrap():
+    """Window ops keyed mod 2^16: an ack whose mask spans 65535→0 must
+    pop every pending packet (one qtak covering the wrap)."""
+    from easydarwin_tpu.relay.reliable import (BandwidthTracker,
+                                               PacketResender, build_ack,
+                                               parse_ack)
+    from easydarwin_tpu.protocol.rtcp import parse_compound
+
+    tr = BandwidthTracker()
+    rs = PacketResender(tr)
+    seqs = [65534, 65535, 0, 1]
+    for s in seqs:
+        rs.add(s, b"x" * 100, now_ms=1000)
+    assert tr.bytes_in_flight == 400
+    # one ack: first=65534, mask bits for 65535, 0, 1
+    ack = build_ack(0xAB, 65534, extra_mask=0b111 << 29)
+    app = parse_compound(ack)[0]
+    got = parse_ack(app)
+    assert got == seqs
+    for s in got:
+        assert rs.ack(s, now_ms=1050)
+    assert not rs.pending and tr.bytes_in_flight == 0

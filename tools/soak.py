@@ -97,6 +97,15 @@ async def soak(seconds: float) -> int:
 
         await rest_get("/api/v1/starthls?path=/live/a&rungs=1,q6")
 
+        # pre-encode one GOP-ish cycle BEFORE the clock starts and before
+        # the drain task runs (pure-Python encode per frame would
+        # monopolize the shared event loop and starve the player tasks —
+        # the soak measures the SERVER, not the harness's encoder)
+        cycle = [encode_iframe(synth_frame(i), 24,
+                               cb=synth_frame(i + 7, 32),
+                               cr=synth_frame(i + 13, 32))
+                 for i in range(16)]
+
         t0 = time.time()
         f = 0
         seq_a = seq_b = 0
@@ -104,21 +113,28 @@ async def soak(seconds: float) -> int:
         udp_rx = [0]
 
         async def tcp_drain():
+            # greedy: consume every buffered packet per wake — a
+            # one-packet-per-wake drain starves behind the push loop and
+            # makes the SERVER's (correct) slow-consumer aging look like
+            # a server failure
             while time.time() - t0 < seconds:
                 try:
-                    await tcp_player.recv_interleaved(0, timeout=1.0)
+                    await tcp_player.recv_interleaved(0, timeout=0.25)
                     tcp_rx[0] += 1
                 except asyncio.TimeoutError:
-                    pass
+                    continue
+                for _ in range(64):
+                    try:
+                        await tcp_player.recv_interleaved(0, timeout=0.002)
+                        tcp_rx[0] += 1
+                    except asyncio.TimeoutError:
+                        break
 
         drain_task = asyncio.ensure_future(tcp_drain())
         last_seen_out_seq = None
         while time.time() - t0 < seconds:
-            img = synth_frame(f)
             ts = int(f * 3000)
-            # chroma planes soak the q-rung's chroma requant path too
-            for nal in encode_iframe(img, 24, cb=synth_frame(f + 7, 32),
-                                     cr=synth_frame(f + 13, 32)):
+            for nal in cycle[f % 16]:
                 for p in nalu.packetize_h264(
                         nal, seq=seq_a, timestamp=ts, ssrc=1,
                         marker_on_last=(nal[0] & 0x1F == 5)):
@@ -145,6 +161,19 @@ async def soak(seconds: float) -> int:
                     build_ack(rel_out.rewrite.ssrc, last_seen_out_seq,
                               0xFFFFFFFF),
                     ("127.0.0.1", egress.rtcp_port))
+            if f % 150 == 5:
+                # conformant interleaved player: periodic RR on the RTCP
+                # channel (a silent client is CORRECTLY reaped at
+                # rtsp_timeout — found by the 26-minute soak)
+                tcp_out = next(iter(
+                    next(cn for cn in app.rtsp.connections
+                         if cn.player_tracks
+                         and not hasattr(
+                             cn.player_tracks[1].output, "resender")
+                         ).player_tracks.values())).output
+                rr = struct.pack("!BBHIIIIIII", 0x81, 201, 7, 0x7A7A,
+                                 tcp_out.rewrite.ssrc, 0, 0, 0, 0, 0)
+                tcp_player.send_interleaved(1, rr)
             if f % 30 == 10:           # periodic NADU (comfortable buffer)
                 from easydarwin_tpu.protocol.rtcp import Nadu, NaduBlock
                 udp_rtcp.sendto(Nadu(9, [NaduBlock(
