@@ -170,7 +170,8 @@ int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out);
+    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t *blocks_out);
 
 /* ------------------------------------------------------------- timer wheel */
 

@@ -721,7 +721,8 @@ extern "C" int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out) {
+    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t *blocks_out) {
   if (nal_len < 2 || delta_qp < 6 || delta_qp % 6) return kErrUnsupported;
   uint8_t nal_byte = nal[0];
   int nal_type = nal_byte & 0x1F;
@@ -882,6 +883,10 @@ extern "C" int32_t ed_h264_requant_slice(
 
   int k = delta_qp / 6;
   int deadzone = (1 << k) / 3;
+  // engine-independent stats.blocks: the Python path batches 17 level
+  // rows per I_16x16 MB (DC + 16 zero-padded AC), 16 per I_4x4, plus 8
+  // chroma rows per chroma-bearing MB — count identically here
+  int64_t blk_count = 0;
   int32_t cur_qp = h.qp;
   int32_t max_qp = h.qp;
   if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;
@@ -931,6 +936,7 @@ extern "C" int32_t ed_h264_requant_slice(
         any_ac |= shift_row(lv, 15, k, deadzone);
       }
       mb_cbp[mb] = any_ac ? 15 : 0;      // luma CBP after requant
+      blk_count += 17 + (chroma_cbp ? 8 : 0);
       if (!chroma_mb(&br, mb, chroma_cbp, cur_qp, true))
         return kErrBitstream;
       continue;
@@ -977,12 +983,16 @@ extern "C" int32_t ed_h264_requant_slice(
       if (shift_row(lv, 16, k, deadzone)) out_cbp |= 1 << (b >> 2);
     }
     mb_cbp[mb] = out_cbp;
+    blk_count += 16 + ((cbp >> 4) ? 8 : 0);
     if (!chroma_mb(&br, mb, cbp >> 4, cur_qp, true))
       return kErrBitstream;
   }
   if (!br.ok) return kErrBitstream;
   if (max_qp + delta_qp > 51) return kErrUnsupported;  // ladder ceiling
   if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
+  if (blocks_out)
+    *blocks_out = static_cast<int32_t>(
+        blk_count > INT32_MAX ? INT32_MAX : blk_count);
 
   // ---- re-encode
   BitWriter bw;

@@ -138,10 +138,10 @@ class SliceRequantizer:
         if self._native:
             res = self._requant_native(nal)
             if res is not None:
-                out, n_slice_mbs = res
+                out, _n_slice_mbs, n_blocks = res
                 self.stats.slices_requantized += 1
                 self.stats.native_slices += 1
-                self.stats.blocks += n_slice_mbs * 16
+                self.stats.blocks += n_blocks
         if out is None:
             try:
                 out = self._requant_slice(nal)
@@ -152,7 +152,8 @@ class SliceRequantizer:
         self.stats.bytes_out += len(out)
         return out
 
-    def _requant_native(self, nal: bytes) -> "tuple[bytes, int] | None":
+    def _requant_native(
+            self, nal: bytes) -> "tuple[bytes, int, int] | None":
         from .. import native
         if not native.available():
             return None

@@ -110,6 +110,7 @@ def _load():
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32)]
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
@@ -252,22 +253,26 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
                        pps_id: int, deblocking_control: bool,
                        bottom_field_poc: bool, delta_qp: int,
                        chroma_qp_offset: int = 0
-                       ) -> tuple[bytes, int] | None:
-    """Native CAVLC slice requant → (nal, mbs_in_slice); None =
-    unsupported/malformed (caller passes the slice through or falls back
-    to the Python path)."""
+                       ) -> tuple[bytes, int, int] | None:
+    """Native CAVLC slice requant → (nal, mbs_in_slice, level_blocks);
+    level_blocks counts exactly what the Python path batches (17 rows
+    per I_16x16 MB, 16 per I_4x4, +8 chroma rows per chroma-bearing MB)
+    so RequantStats.blocks is engine-independent.  None = unsupported/
+    malformed (caller passes the slice through or falls back to the
+    Python path)."""
     lib = _load()
     assert lib is not None
     src = np.frombuffer(nal, dtype=np.uint8)
     cap = len(nal) * 2 + 256
     out = np.zeros(cap, dtype=np.uint8)
     mbs = ctypes.c_int32(0)
+    blocks = ctypes.c_int32(0)
     n = lib.ed_h264_requant_slice(
         _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
         log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
         pps_id, 1 if deblocking_control else 0,
         1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset,
-        ctypes.byref(mbs))
+        ctypes.byref(mbs), ctypes.byref(blocks))
     if n == -3:                      # tiny chance: expansion past 2x
         cap = len(nal) * 4 + 4096
         out = np.zeros(cap, dtype=np.uint8)
@@ -276,8 +281,8 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
             log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
             pps_id, 1 if deblocking_control else 0,
             1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset,
-            ctypes.byref(mbs))
-    return (out[:n].tobytes(), mbs.value) if n > 0 else None
+            ctypes.byref(mbs), ctypes.byref(blocks))
+    return (out[:n].tobytes(), mbs.value, blocks.value) if n > 0 else None
 
 
 def last_send_errno() -> int:

@@ -859,6 +859,7 @@ class RtspServer:
                 # Route: exact track by RTCP source addr, else by the
                 # App's SSRC, else (single reliable track only) fall back
                 # to it — never broadcast across colliding seq spaces
+                routed = addr_out is not None or p.ssrc in outputs
                 if addr_out is not None:
                     targets = [addr_out]
                 elif p.ssrc in outputs:
@@ -871,8 +872,16 @@ class RtspServer:
                 for out in targets:
                     ack_fn = getattr(out, "on_rtcp_app", None)
                     if ack_fn is not None:
-                        proven = True
-                        ack_fn(p)
+                        matched = ack_fn(p)
+                        # Ownership proof: a source-addr/SSRC-routed
+                        # track, or — in the single-track fallback,
+                        # where neither matched — an ack seq that
+                        # actually popped a packet from the resend
+                        # window.  A forged-but-parseable App with an
+                        # arbitrary SSRC proves nothing and must not
+                        # refresh the idle clock.
+                        if routed or matched:
+                            proven = True
         if proven:
             conn.last_activity = time.monotonic()
 

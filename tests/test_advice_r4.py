@@ -1,0 +1,97 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md):
+
+* the single-reliable-track APP/qtak fallback must NOT count as ownership
+  proof (and refresh the idle clock) unless the ack actually pops a packet
+  from the resend window — a forged-but-parseable APP with an arbitrary
+  SSRC kept dead sessions allocated forever (medium)
+* RequantStats.blocks must be engine-independent: the native walk now
+  returns the same level-row count the Python path batches (low)
+"""
+
+import types
+
+import pytest
+
+from easydarwin_tpu.protocol import rtcp, rtp
+from easydarwin_tpu.relay.output import CollectingOutput, WriteResult
+from easydarwin_tpu.relay.reliable import ReliableUdpOutput, build_ack
+from easydarwin_tpu.server.rtsp import RtspServer
+
+
+def _mk_conn_with_reliable(ssrc=0x42, rtcp_addr=("10.0.0.1", 5001)):
+    inner = CollectingOutput(ssrc=ssrc, out_seq_start=100)
+    inner.rtcp_addr = rtcp_addr
+    rel = ReliableUdpOutput(inner, clock=lambda: 1000)
+    pt = types.SimpleNamespace(output=rel)
+    conn = types.SimpleNamespace(player_tracks={1: pt}, last_activity=0.0)
+    return conn, rel
+
+
+def _dispatch(conn, data, addr):
+    srv = types.SimpleNamespace(stats={})
+    RtspServer.on_client_rtcp(srv, conn, data, addr)
+
+
+def test_forged_app_fallback_does_not_refresh_idle_clock():
+    """Unknown source addr + unowned SSRC + ack seq that misses the
+    resend window: the single-track fallback may try the ack, but it is
+    NOT ownership proof — last_activity stays put (ADVICE r3 medium)."""
+    conn, rel = _mk_conn_with_reliable()
+    assert rel.send_bytes(
+        rtp.RtpPacket(payload_type=96, seq=700, timestamp=0, ssrc=0x42,
+                      payload=bytes(40)).to_bytes(),
+        is_rtcp=False) is WriteResult.OK
+    forged = build_ack(0xDEAD, first_seq=9999)     # not in-window
+    _dispatch(conn, forged, addr=("6.6.6.6", 9999))
+    assert conn.last_activity == 0.0
+    assert rel.resender.in_flight == 1             # nothing popped
+
+
+def test_inwindow_ack_via_fallback_refreshes_idle_clock():
+    """A NAT'd client whose RTCP source addr matches nothing and whose
+    App SSRC is unowned still proves liveness when its ack pops a real
+    in-flight packet from the lone reliable track's window."""
+    conn, rel = _mk_conn_with_reliable()
+    wire = rtp.RtpPacket(payload_type=96, seq=700, timestamp=0, ssrc=0x42,
+                         payload=bytes(40)).to_bytes()
+    assert rel.send_bytes(wire, is_rtcp=False) is WriteResult.OK
+    seq = rtp.peek_seq(wire)
+    ack = build_ack(0xDEAD, first_seq=seq)         # unowned SSRC, real seq
+    _dispatch(conn, ack, addr=("6.6.6.6", 9999))
+    assert conn.last_activity > 0.0
+    assert rel.resender.in_flight == 0
+
+
+def test_owned_ssrc_app_still_refreshes():
+    conn, rel = _mk_conn_with_reliable(ssrc=0x42)
+    ack = build_ack(0x42, first_seq=1)             # owned SSRC, empty window
+    _dispatch(conn, ack, addr=("6.6.6.6", 9999))
+    assert conn.last_activity > 0.0
+
+
+def test_requant_blocks_engine_independent():
+    """Same stream through the native and the Python engines must report
+    the same stats.blocks (ADVICE r3 low)."""
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    from easydarwin_tpu.codecs.h264_intra import encode_iframe
+    from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
+    from easydarwin_tpu.utils.synth import synth_luma
+
+    img = synth_luma(96)
+    nals = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2])
+
+    counts = {}
+    outs = {}
+    for engine, prefer in (("native", True), ("python", False)):
+        rq = SliceRequantizer(6, prefer_native=prefer)
+        out = [rq.transform_nal(n) for n in nals]
+        counts[engine] = rq.stats.blocks
+        outs[engine] = out
+        if prefer:
+            assert rq.stats.native_slices > 0
+        else:
+            assert rq.stats.native_slices == 0
+    assert counts["native"] == counts["python"] > 0
+    assert outs["native"] == outs["python"]        # still bit-exact
