@@ -574,15 +574,52 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
         done += 1
     dt = time.perf_counter() - t0
     mbs_s = done * mbs_per_slice / dt
+
+    # the production harness (hls/requant.py): one shared pool, the
+    # native walk releases the GIL — measure the AGGREGATE rate with
+    # every core fed, which is what a multi-rung ladder gets
+    from easydarwin_tpu.hls.requant import pool_workers
+    workers = pool_workers()
+    agg_mbs_s = mbs_s
+    if workers > 1:
+        import threading
+        counts = [0] * workers
+        stop = [False]
+
+        def grind(i):
+            r = SliceRequantizer(6)
+            for nal in nals[:2]:
+                r.transform_nal(nal)
+            while not stop[0]:
+                r.transform_nal(slice_nal)
+                counts[i] += 1
+
+        ts = [threading.Thread(target=grind, args=(i,))
+              for i in range(workers)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        stop[0] = True
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        agg_mbs_s = sum(counts) * mbs_per_slice / dt
     return {
         "h264_requant_mbs_per_sec": round(mbs_s, 0),
-        "h264_requant_1080p30_renditions": round(mbs_s / (8160 * 30), 1),
+        "h264_requant_workers": workers,
+        "h264_requant_parallel_mbs_per_sec": round(agg_mbs_s, 0),
+        "h264_requant_1080p30_renditions":
+            round(agg_mbs_s / (8160 * 30), 1),
         "h264_requant_method": (
             "real 192x192 4:2:0 CAVLC slice (chroma DC+AC coded) through "
-            "the native requant walk, back-to-back on one core; 1080p30 "
-            "renditions = mbs_per_sec / (8160 MBs * 30 fps).  The HLS "
-            "worker sheds AUs when a rendition exceeds the budget, so an "
-            "over-budget rung degrades in frame rate, never in latency."),
+            "the native requant walk: mbs_per_sec = back-to-back on one "
+            "core; parallel_mbs_per_sec = aggregate across "
+            "pool_workers() GIL-released threads (the hls/requant.py "
+            "pool shape).  1080p30 renditions = parallel rate / "
+            "(8160 MBs * 30 fps).  The HLS pipeline sheds AUs when the "
+            "pool is saturated, so an over-budget ladder degrades in "
+            "frame rate, never in latency."),
     }
 
 
@@ -685,7 +722,7 @@ def main():
         s.close()
 
     value = tpu_rate if tpu_rate > 0 else c_rate
-    print(json.dumps({
+    details = {
         "metric": "relay_packets_to_wire_per_sec",
         "value": round(value, 1),
         "unit": "packets/s",
@@ -745,7 +782,34 @@ def main():
             **rq_extra,
             **info,
         },
-    }))
+    }
+    # The driver captures only a bounded TAIL of stdout and must parse a
+    # single JSON line from it (BENCH_r03 broke that with a >4 KB line:
+    # the captured tail started mid-JSON, parsed: null).  Contract: full
+    # prose/method detail goes to bench_details.json; stdout gets ONE
+    # compact line with the headline numbers only.
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_details.json"), "w") as f:
+        json.dump(details, f, indent=1)
+    ex = details["extra"]
+    compact_extra = {
+        k: ex[k] for k in (
+            "cpu_c_baseline_rate", "server_engine_rate", "p50_added_ms",
+            "p99_added_ms", "vs_baseline_server_cost", "real_flows",
+            "delivery_loss_pct", "h264_requant_mbs_per_sec",
+            "h264_requant_parallel_mbs_per_sec",
+            "h264_requant_1080p30_renditions", "h264_requant_workers",
+            "device", "device_fallback_cpu",
+            "sustainable_1080p30_subscribers_per_source")
+        if k in ex}
+    compact_extra["details_file"] = "bench_details.json"
+    print(json.dumps({
+        "metric": details["metric"],
+        "value": details["value"],
+        "unit": details["unit"],
+        "vs_baseline": details["vs_baseline"],
+        "extra": compact_extra,
+    }, separators=(",", ":")))
 
 
 if __name__ == "__main__":

@@ -41,6 +41,17 @@ class RequantStats:
     bytes_in: int = 0
     bytes_out: int = 0
 
+    def merge(self, d: "RequantStats") -> None:
+        """Fold a worker's per-AU delta in (pool path: workers requant
+        against snapshot parameter sets and never touch shared stats;
+        the owner thread merges at emit time)."""
+        self.slices_requantized += d.slices_requantized
+        self.slices_passed_through += d.slices_passed_through
+        self.native_slices += d.native_slices
+        self.blocks += d.blocks
+        self.bytes_in += d.bytes_in
+        self.bytes_out += d.bytes_out
+
 
 def _scalar_batch(levels: np.ndarray, qp_in: np.ndarray,
                   qp_out: np.ndarray) -> np.ndarray:
@@ -131,33 +142,46 @@ class SliceRequantizer:
             except (ValueError, EOFError, IndexError):
                 self.pps = None
             return nal
-        if t not in (1, 5) or self.sps is None or self.pps is None:
-            return nal
-        self.stats.bytes_in += len(nal)
-        out = None
-        if self._native:
-            res = self._requant_native(nal)
-            if res is not None:
-                out, _n_slice_mbs, n_blocks = res
-                self.stats.slices_requantized += 1
-                self.stats.native_slices += 1
-                self.stats.blocks += n_blocks
-        if out is None:
-            try:
-                out = self._requant_slice(nal)
-                self.stats.slices_requantized += 1
-            except (ValueError, EOFError, KeyError, IndexError):
-                out = nal
-                self.stats.slices_passed_through += 1
-        self.stats.bytes_out += len(out)
+        out, delta = self.requant_with(nal, self.sps, self.pps)
+        self.stats.merge(delta)
         return out
 
-    def _requant_native(
-            self, nal: bytes) -> "tuple[bytes, int, int] | None":
+    def requant_with(self, nal: bytes, sps: Sps | None, pps: Pps | None
+                     ) -> tuple[bytes, RequantStats]:
+        """Requant one slice NAL against EXPLICIT parameter sets,
+        returning the output and a stats delta — no instance state is
+        read or written, so pool workers can run AUs from the same
+        stream concurrently (each AU snapshot-captures the sets it was
+        coded against at enqueue time)."""
+        delta = RequantStats()
+        t = nal[0] & 0x1F
+        if t not in (1, 5) or sps is None or pps is None:
+            return nal, delta
+        delta.bytes_in += len(nal)
+        out = None
+        if self._native:
+            res = self._requant_native(nal, sps, pps)
+            if res is not None:
+                out, _n_slice_mbs, n_blocks = res
+                delta.slices_requantized += 1
+                delta.native_slices += 1
+                delta.blocks += n_blocks
+        if out is None:
+            try:
+                out, n_blocks = self._requant_slice(nal, sps, pps)
+                delta.slices_requantized += 1
+                delta.blocks += n_blocks
+            except (ValueError, EOFError, KeyError, IndexError):
+                out = nal
+                delta.slices_passed_through += 1
+        delta.bytes_out += len(out)
+        return out, delta
+
+    def _requant_native(self, nal: bytes, s: Sps, p: Pps
+                        ) -> "tuple[bytes, int, int] | None":
         from .. import native
         if not native.available():
             return None
-        s, p = self.sps, self.pps
         return native.h264_requant_slice(
             nal, width_mbs=s.width_mbs, height_mbs=s.height_mbs,
             log2_max_frame_num=s.log2_max_frame_num, poc_type=s.poc_type,
@@ -167,8 +191,10 @@ class SliceRequantizer:
             bottom_field_poc=p.bottom_field_poc, delta_qp=self.delta_qp,
             chroma_qp_offset=p.chroma_qp_offset)
 
-    def _requant_slice(self, nal: bytes) -> bytes:
-        codec = SliceCodec(self.sps, self.pps)
+    def _requant_slice(self, nal: bytes, sps: Sps, pps: Pps
+                       ) -> tuple[bytes, int]:
+        n_blocks = 0
+        codec = SliceCodec(sps, pps)
         br = BitReader(nal_to_rbsp(nal[1:]))
         hdr = codec.parse_slice_header(br, nal[0])
         qp_in_base = hdr.qp
@@ -204,7 +230,7 @@ class SliceRequantizer:
                 qps.extend([mb.qp] * 16)
         batch = np.concatenate(all_levels, axis=0)
         qps = np.asarray(qps)
-        self.stats.blocks += batch.shape[0]
+        n_blocks += batch.shape[0]
         requanted = self.requant_fn(batch, qps, qps + self.delta_qp)
 
         # write back + recompute CBP and the shifted absolute QP per MB;
@@ -224,7 +250,7 @@ class SliceRequantizer:
         # components batched as independent rows
         centries = [i for i, mb in enumerate(mbs) if mb.chroma_cbp]
         if centries:
-            off = self.pps.chroma_qp_offset
+            off = pps.chroma_qp_offset
             cdc = np.stack([mbs[i].chroma_dc for i in centries])
             cac = np.stack([mbs[i].chroma_ac for i in centries])
             qin = np.array([chroma_qp(mbs[i].qp, off) for i in centries],
@@ -232,7 +258,7 @@ class SliceRequantizer:
             qout = np.array(
                 [chroma_qp(mbs[i].qp + self.delta_qp, off)
                  for i in centries], dtype=np.int64)
-            self.stats.blocks += 8 * len(centries)
+            n_blocks += 8 * len(centries)
             d2, a2 = self.chroma_fn(
                 cdc.reshape(-1, 4), cac.reshape(-1, 4, 15),
                 np.repeat(qin, 2), np.repeat(qout, 2))
@@ -259,4 +285,4 @@ class SliceRequantizer:
         codec.write_slice_header(bw, hdr, qp_out_base)
         codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb)
         bw.rbsp_trailing()
-        return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes())
+        return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes()), n_blocks

@@ -8,6 +8,17 @@ ladder: CAVLC entropy recode on the host, the per-level integer requant
 batched on the device (``ops.transform.h264_requant``), differential-
 tested bit-exact against the scalar oracle.
 
+Parallel harness (VERDICT r3 item 1): ALL requant renditions share one
+``ThreadPoolExecutor`` sized to the host's cores — the native CAVLC walk
+is a ctypes call, so the GIL is released for its whole duration and
+pictures genuinely run in parallel.  Order is preserved per rendition
+without serializing it: consecutive AUs of the same rung pipeline
+through different workers (each against snapshot parameter sets) and a
+reorder buffer emits them in submission order — so ONE 1080p30 rung
+scales across cores, not just many rungs across cores.  The reference
+analogue is the short/blocking task-thread split
+(``Task.cpp:120-146``); here the "blocking pool" is per-picture jobs.
+
 Honest scope notes (also in ``codecs.h264_requant``): CAVLC baseline
 intra slices only (I_4x4 + I_16x16, luma AND 4:2:0 chroma residuals);
 anything else passes through unchanged and is counted, so the rendition
@@ -18,6 +29,7 @@ all-intra camera streams, every frame."""
 from __future__ import annotations
 
 import asyncio
+import os
 from concurrent.futures import ThreadPoolExecutor
 
 from ..codecs.h264_requant import (SliceRequantizer, device_batch,
@@ -25,19 +37,26 @@ from ..codecs.h264_requant import (SliceRequantizer, device_batch,
 from ..vod.depacketize import AccessUnit
 from .segmenter import HlsOutput
 
-#: one shared worker for ALL requant renditions: the host-side CAVLC
-#: recode is pure Python (~0.5 ms per macroblock) and must never run on
-#: the event loop — a single FIFO worker also preserves per-stream AU
-#: order without locks
-_worker: ThreadPoolExecutor | None = None
+#: one shared pool for ALL requant renditions, sized to the cores the
+#: process may use: the native walk releases the GIL (ctypes), so jobs
+#: from one OR many renditions run truly concurrently; the pure-Python
+#: fallback path still benefits from staying off the event loop
+_pool: ThreadPoolExecutor | None = None
 
 
-def _get_worker() -> ThreadPoolExecutor:
-    global _worker
-    if _worker is None:
-        _worker = ThreadPoolExecutor(max_workers=1,
-                                     thread_name_prefix="hls-requant")
-    return _worker
+def pool_workers() -> int:
+    try:
+        return max(1, len(os.sched_getaffinity(0)))
+    except (AttributeError, OSError):
+        return max(1, os.cpu_count() or 1)
+
+
+def _get_pool() -> ThreadPoolExecutor:
+    global _pool
+    if _pool is None:
+        _pool = ThreadPoolExecutor(max_workers=pool_workers(),
+                                   thread_name_prefix="hls-requant")
+    return _pool
 
 
 class RequantHlsOutput(HlsOutput):
@@ -56,11 +75,17 @@ class RequantHlsOutput(HlsOutput):
                                         chroma_fn=cfn)
         self.delta_qp = delta_qp
         self._ps_fed: tuple[bytes | None, bytes | None] = (None, None)
-        #: AUs dropped because the requant worker was too far behind —
-        #: real-time-ness depends on picture size (pure-Python CAVLC);
-        #: shedding keeps the rendition live instead of ever-later
+        #: AUs dropped because the pipeline was too far behind — shedding
+        #: keeps the rendition live instead of ever-later.  Depth 2x the
+        #: pool keeps every core fed while bounding added latency to
+        #: ~2 pictures' work
         self.shed = 0
-        self._inflight = 0
+        self._max_pending = max(4, 2 * pool_workers())
+        # per-rendition reorder buffer: workers complete out of order,
+        # fMP4 fragments must not
+        self._next_submit = 0
+        self._next_emit = 0
+        self._ready: dict[int, AccessUnit] = {}
 
     def _transform(self, au: AccessUnit,
                    ps: tuple[bytes | None, bytes | None]) -> AccessUnit:
@@ -87,22 +112,56 @@ class RequantHlsOutput(HlsOutput):
             # synchronous caller (tests, offline tools): transform inline
             super()._on_unit(self._transform(au, ps))
             return
-        if self._inflight >= 8:
+        # gate on SUBMITTED-minus-EMITTED, not worker completions: a
+        # straggler AU must stall admission too, or fast successors pile
+        # up unboundedly in the reorder buffer behind it (added latency
+        # then grows with the straggler, breaking the "degrade in frame
+        # rate, never in latency" contract)
+        if self.pending >= self._max_pending:
             self.shed += 1                 # backlogged: shed, stay live
             return
-        self._inflight += 1
+        # latch the sets on the loop thread and snapshot the PARSED
+        # objects for the worker (requant_with is stateless)
+        if ps != self._ps_fed:
+            self._ps_fed = ps
+            for n in ps:
+                if n:
+                    self.requant.transform_nal(n)
+        sps, pps = self.requant.sps, self.requant.pps
+        seq = self._next_submit
+        self._next_submit += 1
 
         def work():
             try:
-                out = self._transform(au, ps)
+                deltas = []
+                nals = []
+                for n in au.nals:
+                    out, d = self.requant.requant_with(n, sps, pps)
+                    nals.append(out)
+                    deltas.append(d)
+                out_au = AccessUnit(au.timestamp, nals)
             except Exception:
-                # never let a worker error strand _inflight (that would
-                # shed every future AU forever); pass the unit through
-                out = au
-            loop.call_soon_threadsafe(self._emit, out)
+                # never let a worker error strand the reorder slot (that
+                # would shed every future AU forever); pass the unit
+                # through — and none of its stats: partially-counted
+                # work whose output was discarded must not drift
+                # bytes_out away from emitted bytes
+                out_au = au
+                deltas = []
+            loop.call_soon_threadsafe(self._emit, seq, out_au, deltas)
 
-        _get_worker().submit(work)
+        _get_pool().submit(work)
 
-    def _emit(self, au: AccessUnit) -> None:
-        self._inflight -= 1
-        super()._on_unit(au)
+    @property
+    def pending(self) -> int:
+        """Submitted-but-not-yet-emitted AUs (in workers OR waiting in
+        the reorder buffer) — the admission gate and test barrier."""
+        return self._next_submit - self._next_emit
+
+    def _emit(self, seq: int, au: AccessUnit, deltas) -> None:
+        for d in deltas:
+            self.requant.stats.merge(d)
+        self._ready[seq] = au
+        while self._next_emit in self._ready:
+            super()._on_unit(self._ready.pop(self._next_emit))
+            self._next_emit += 1
