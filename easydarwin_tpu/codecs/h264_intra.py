@@ -46,11 +46,14 @@ class Sps:
     log2_max_frame_num: int = 4
     poc_type: int = 2
     log2_max_poc_lsb: int = 4           # meaningful for poc_type 0 only
+    profile_idc: int = 66               # 66 CAVLC baseline / 77 CABAC:
+                                        # A.2.1 forbids CABAC in baseline
 
     def build(self) -> bytes:
         bw = BitWriter()
-        bw.write_bits(66, 8)            # profile_idc: baseline
-        bw.write_bits(0xC0, 8)          # constraint_set0/1
+        bw.write_bits(self.profile_idc, 8)
+        # baseline asserts constraint_set0/1; Main asserts set1 only
+        bw.write_bits(0xC0 if self.profile_idc == 66 else 0x40, 8)
         bw.write_bits(30, 8)            # level_idc 3.0
         bw.ue(self.sps_id)
         bw.ue(self.log2_max_frame_num - 4)
@@ -78,7 +81,17 @@ class Sps:
         br.read_bits(8)                 # level
         sps_id = br.ue()
         if profile == 100:
-            raise ValueError("high profile unsupported")
+            # High profile is in scope as long as it stays 4:2:0 8-bit
+            # with FLAT scaling (non-flat matrices change the requant
+            # math; reject → the rung passes the stream through)
+            if br.ue() != 1:
+                raise ValueError("chroma_format != 4:2:0")
+            if br.ue() != 0 or br.ue() != 0:
+                raise ValueError("bit depth > 8")
+            if br.read_bit():
+                raise ValueError("transform bypass unsupported")
+            if br.read_bit():
+                raise ValueError("scaling matrices unsupported")
         log2_mfn = br.ue() + 4
         poc_type = br.ue()
         log2_poc = 4
@@ -104,12 +117,13 @@ class Pps:
     deblocking_control: bool = True
     bottom_field_poc: bool = False
     chroma_qp_offset: int = 0           # chroma_qp_index_offset (7.4.2.2)
+    entropy_cabac: bool = False         # entropy_coding_mode_flag
 
     def build(self) -> bytes:
         bw = BitWriter()
         bw.ue(self.pps_id)
         bw.ue(self.sps_id)
-        bw.write_bit(0)                 # entropy_coding_mode: CAVLC
+        bw.write_bit(1 if self.entropy_cabac else 0)
         bw.write_bit(0)                 # bottom_field_pic_order
         bw.ue(0)                        # num_slice_groups_minus1
         bw.ue(0)                        # num_ref_idx_l0
@@ -130,8 +144,7 @@ class Pps:
         br = BitReader(nal_to_rbsp(nal[1:]))
         pps_id = br.ue()
         sps_id = br.ue()
-        if br.read_bit():
-            raise ValueError("CABAC unsupported (CAVLC-baseline scope)")
+        cabac = bool(br.read_bit())     # entropy_coding_mode_flag
         bottom_poc = bool(br.read_bit())
         if br.ue() != 0:
             raise ValueError("slice groups unsupported")
@@ -143,7 +156,18 @@ class Pps:
         br.se()
         chroma_off = br.se()
         deblock = bool(br.read_bit())
-        return cls(pps_id, sps_id, qp, deblock, bottom_poc, chroma_off)
+        br.read_bit()                   # constrained_intra_pred
+        br.read_bit()                   # redundant_pic_cnt_present
+        if br.more_rbsp_data():         # High-profile PPS extension
+            if br.read_bit():
+                raise ValueError("8x8 transform unsupported")
+            if br.read_bit():
+                raise ValueError("scaling matrices unsupported")
+            if br.se() != chroma_off:   # second_chroma_qp_index_offset:
+                # the requant maps both components through ONE offset
+                raise ValueError("split Cb/Cr qp offsets unsupported")
+        return cls(pps_id, sps_id, qp, deblock, bottom_poc, chroma_off,
+                   cabac)
 
 
 @dataclass
@@ -613,7 +637,8 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
                   idr_pic_id: int = 0, cb: np.ndarray | None = None,
                   cr: np.ndarray | None = None,
                   sps: Sps | None = None, pps: Pps | None = None,
-                  include_ps: bool = True, slices: int = 1) -> list[bytes]:
+                  include_ps: bool = True, slices: int = 1,
+                  entropy: str = "cavlc") -> list[bytes]:
     """uint8 [H, W] luma (H, W multiples of 16) → NAL payloads
     ([SPS, PPS,] IDR slice(s)), DC-predicted I_4x4 with a real
     reconstruction loop (prediction always from reconstructed samples,
@@ -625,8 +650,9 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
     h, w = luma.shape
     if h % 16 or w % 16:
         raise ValueError("dimensions must be multiples of 16")
-    sps = sps or Sps(w // 16, h // 16)
-    pps = pps or Pps(pic_init_qp=qp)
+    sps = sps or Sps(w // 16, h // 16,
+                     profile_idc=77 if entropy == "cabac" else 66)
+    pps = pps or Pps(pic_init_qp=qp, entropy_cabac=(entropy == "cabac"))
     if not 1 <= slices <= sps.height_mbs:
         raise ValueError("slices must be in 1..height_mbs")
     codec = SliceCodec(sps, pps)
@@ -686,13 +712,18 @@ def encode_iframe(luma: np.ndarray, qp: int, *, frame_num: int = 0,
                                        mb.chroma_dc[comp],
                                        mb.chroma_ac[comp], qpc, first_row)
             mbs.append(mb)
-        bw = BitWriter()
         hdr = SliceHeader(frame_num=frame_num, idr_pic_id=idr_pic_id,
                           qp=qp, first_mb=first_mb)
-        codec.write_slice_header(bw, hdr, qp)
-        codec.write_mbs(bw, mbs, qp, first_mb)
-        bw.rbsp_trailing()
-        out_nals.append(bytes([0x65]) + rbsp_to_nal(bw.to_bytes()))
+        if pps.entropy_cabac:
+            from .h264_cabac import CabacSliceCodec
+            out_nals.append(CabacSliceCodec(sps, pps).write_slice(
+                hdr, first_mb, mbs, qp))
+        else:
+            bw = BitWriter()
+            codec.write_slice_header(bw, hdr, qp)
+            codec.write_mbs(bw, mbs, qp, first_mb)
+            bw.rbsp_trailing()
+            out_nals.append(bytes([0x65]) + rbsp_to_nal(bw.to_bytes()))
     if include_ps:
         return [sps.build(), pps.build()] + out_nals
     return out_nals
@@ -722,12 +753,17 @@ def decode_iframe_yuv(nals: list[bytes]
     recon_c = np.zeros((2, h // 2, w // 2), dtype=np.int64)
     inv_zz = np.argsort(ZIGZAG4)
     for slice_nal in slice_nals:
-        br = BitReader(nal_to_rbsp(slice_nal[1:]))
-        hdr = codec.parse_slice_header(br, slice_nal[0])
+        if pps.entropy_cabac:
+            from .h264_cabac import CabacSliceCodec
+            hdr, _first, mbs, _qps = CabacSliceCodec(
+                sps, pps).parse_slice(slice_nal)
+        else:
+            br = BitReader(nal_to_rbsp(slice_nal[1:]))
+            hdr = codec.parse_slice_header(br, slice_nal[0])
+            mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb)
         if hdr.first_mb % sps.width_mbs:
             raise ValueError("decoder scope is MB-row-aligned slices")
         first_row = hdr.first_mb // sps.width_mbs
-        mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb)
         for mb_idx, mb in enumerate(mbs, start=hdr.first_mb):
             if isinstance(mb, MacroblockI16x16):
                 raise ValueError("decoder scope is I_4x4 only")

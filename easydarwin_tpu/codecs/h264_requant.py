@@ -159,7 +159,10 @@ class SliceRequantizer:
             return nal, delta
         delta.bytes_in += len(nal)
         out = None
-        if self._native:
+        # the native walk is CAVLC-only so far: CABAC slice data must
+        # not be offered to it (its strict checks would reject, but
+        # guaranteeing the dispatch is cheaper than trusting them)
+        if self._native and not pps.entropy_cabac:
             res = self._requant_native(nal, sps, pps)
             if res is not None:
                 out, _n_slice_mbs, n_blocks = res
@@ -194,11 +197,18 @@ class SliceRequantizer:
     def _requant_slice(self, nal: bytes, sps: Sps, pps: Pps
                        ) -> tuple[bytes, int]:
         n_blocks = 0
-        codec = SliceCodec(sps, pps)
-        br = BitReader(nal_to_rbsp(nal[1:]))
-        hdr = codec.parse_slice_header(br, nal[0])
-        qp_in_base = hdr.qp
-        mbs = codec.parse_mbs(br, qp_in_base, hdr.first_mb)
+        cabac_codec = None
+        if pps.entropy_cabac:
+            from .h264_cabac import CabacSliceCodec
+            cabac_codec = CabacSliceCodec(sps, pps)
+            hdr, _first, mbs, _qps = cabac_codec.parse_slice(nal)
+            qp_in_base = hdr.qp
+        else:
+            codec = SliceCodec(sps, pps)
+            br = BitReader(nal_to_rbsp(nal[1:]))
+            hdr = codec.parse_slice_header(br, nal[0])
+            qp_in_base = hdr.qp
+            mbs = codec.parse_mbs(br, qp_in_base, hdr.first_mb)
         qp_out_base = qp_in_base + self.delta_qp
         # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5):
         # the ceiling check covers the true per-MB maxima
@@ -281,6 +291,9 @@ class SliceRequantizer:
                         cbp |= 1 << g
                 mb.cbp = cbp | (ccbp << 4)
             mb.qp = mb.qp + self.delta_qp
+        if cabac_codec is not None:
+            return cabac_codec.write_slice(hdr, hdr.first_mb, mbs,
+                                           qp_out_base), n_blocks
         bw = BitWriter()
         codec.write_slice_header(bw, hdr, qp_out_base)
         codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb)

@@ -1,0 +1,91 @@
+"""Independent H.264 decode oracle: the system libavcodec via ctypes.
+
+Used by the CABAC tests to prove SPEC compliance, not just in-tree
+self-consistency: a slice encoded by ``codecs.h264_cabac`` must decode
+bit-for-bit through libavcodec's own arithmetic engine — any context
+derivation or engine divergence corrupts its output immediately.
+
+Only stable ABI surface is touched: exported functions plus the first
+two AVFrame fields (``uint8_t *data[8]`` at offset 0, ``int
+linesize[8]`` at offset 64), unchanged across every lavc 5x release.
+"""
+
+import ctypes
+
+import numpy as np
+
+_AV_CODEC_ID_H264 = 27
+
+
+class LavcH264Decoder:
+    def __init__(self):
+        self.avc = ctypes.CDLL("libavcodec.so.59")
+        self.avu = ctypes.CDLL("libavutil.so.57")
+        for f, res, args in (
+                ("avcodec_find_decoder", ctypes.c_void_p, [ctypes.c_int]),
+                ("avcodec_alloc_context3", ctypes.c_void_p,
+                 [ctypes.c_void_p]),
+                ("avcodec_open2", ctypes.c_int,
+                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]),
+                ("av_packet_alloc", ctypes.c_void_p, []),
+                ("av_packet_from_data", ctypes.c_int,
+                 [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]),
+                ("av_packet_free", None, [ctypes.c_void_p]),
+                ("avcodec_send_packet", ctypes.c_int,
+                 [ctypes.c_void_p, ctypes.c_void_p]),
+                ("avcodec_receive_frame", ctypes.c_int,
+                 [ctypes.c_void_p, ctypes.c_void_p]),
+                ("avcodec_free_context", None, [ctypes.c_void_p])):
+            fn = getattr(self.avc, f)
+            fn.restype = res
+            fn.argtypes = args
+        for f, res, args in (
+                ("av_malloc", ctypes.c_void_p, [ctypes.c_size_t]),
+                ("av_frame_alloc", ctypes.c_void_p, []),
+                ("av_frame_free", None, [ctypes.c_void_p])):
+            fn = getattr(self.avu, f)
+            fn.restype = res
+            fn.argtypes = args
+        self.codec = self.avc.avcodec_find_decoder(_AV_CODEC_ID_H264)
+        if not self.codec:
+            raise RuntimeError("lavc has no H.264 decoder")
+        self.ctx = self.avc.avcodec_alloc_context3(self.codec)
+        if self.avc.avcodec_open2(self.ctx, self.codec, None) < 0:
+            raise RuntimeError("avcodec_open2 failed")
+
+    def decode(self, nals: list[bytes], width: int, height: int
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+        """Annex-B wrap + decode one access unit → (Y, Cb, Cr) uint8
+        planes, or None if lavc refused the stream."""
+        data = b"".join(b"\x00\x00\x00\x01" + n for n in nals)
+        buf = self.avu.av_malloc(len(data) + 64)
+        ctypes.memmove(buf, data, len(data))
+        pkt = self.avc.av_packet_alloc()
+        if self.avc.av_packet_from_data(pkt, buf, len(data)) < 0:
+            raise RuntimeError("av_packet_from_data failed")
+        rc = self.avc.avcodec_send_packet(self.ctx, pkt)
+        p = ctypes.c_void_p(pkt)
+        self.avc.av_packet_free(ctypes.byref(p))
+        if rc < 0:
+            return None
+        self.avc.avcodec_send_packet(self.ctx, None)     # flush
+        frame = self.avu.av_frame_alloc()
+        try:
+            if self.avc.avcodec_receive_frame(self.ctx, frame) < 0:
+                return None
+            datap = (ctypes.c_void_p * 8).from_address(frame)
+            lines = (ctypes.c_int * 8).from_address(frame + 64)
+            planes = []
+            for i, (w, h) in enumerate(((width, height),
+                                        (width // 2, height // 2),
+                                        (width // 2, height // 2))):
+                if not datap[i]:
+                    return None
+                ls = lines[i]
+                raw = ctypes.string_at(datap[i], ls * h)
+                planes.append(np.frombuffer(raw, dtype=np.uint8)
+                              .reshape(h, ls)[:, :w].copy())
+            return tuple(planes)
+        finally:
+            f = ctypes.c_void_p(frame)
+            self.avu.av_frame_free(ctypes.byref(f))

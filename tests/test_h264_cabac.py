@@ -1,0 +1,183 @@
+"""CABAC entropy layer: engine round-trips, spec compliance via the
+system libavcodec oracle, and the CABAC requant rung (VERDICT r3
+item 3).
+
+The oracle matters: in-tree encode⇄decode symmetry cannot catch a
+wrong context table or transition — both sides would share the bug.
+libavcodec's independent arithmetic engine decodes our slices
+bit-for-bit only if every context derivation matches the spec."""
+
+import random
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu.codecs.h264_cabac import (CabacDecoder, CabacEncoder,
+                                              CabacSliceCodec)
+from easydarwin_tpu.codecs.h264_intra import (Pps, Sps, decode_iframe_yuv,
+                                              encode_iframe, psnr)
+from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
+from easydarwin_tpu.utils.synth import synth_luma
+
+try:
+    from lavc_oracle import LavcH264Decoder
+    _HAVE_LAVC = True
+except (ImportError, OSError, RuntimeError):
+    _HAVE_LAVC = False
+
+
+def _img(n, seed=0):
+    rng = np.random.default_rng(seed)
+    base = synth_luma(n).astype(np.int64)
+    return np.clip(base + rng.integers(-8, 9, base.shape), 0, 255) \
+        .astype(np.uint8)
+
+
+def test_engine_roundtrip_fuzz():
+    """Random decisions/bypass/terminate through the raw engine: the
+    decoder must reproduce the encoder's bin sequence exactly."""
+    rng = random.Random(7)
+    for trial in range(20):
+        qp = rng.randrange(0, 52)
+        ops = []
+        for _ in range(rng.randrange(1, 400)):
+            kind = rng.choice(("d", "d", "d", "b"))
+            if kind == "d":
+                ops.append(("d", rng.randrange(0, 1024), rng.randrange(2)))
+            else:
+                ops.append(("b", None, rng.randrange(2)))
+        enc = CabacEncoder(qp)
+        for kind, ctx, b in ops:
+            if kind == "d":
+                enc.decision(ctx, b)
+            else:
+                enc.bypass(b)
+        enc.terminate(1)
+        acc, n, data = 0, 0, bytearray()
+        for b in enc.bits:
+            acc = (acc << 1) | b
+            n += 1
+            if n == 8:
+                data.append(acc)
+                acc = n = 0
+        if n:
+            data.append(acc << (8 - n))
+        dec = CabacDecoder(bytes(data), 0, qp)
+        for kind, ctx, b in ops:
+            got = dec.decision(ctx) if kind == "d" else dec.bypass()
+            assert got == b, (trial, kind, ctx)
+        assert dec.terminate() == 1
+
+
+def test_cabac_reconstruction_matches_cavlc():
+    """Same source through both entropy layers → identical pixels (the
+    entropy layer must be lossless over the shared MB model)."""
+    img = _img(64)
+    cav = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2])
+    cab = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                        entropy="cabac")
+    assert len(cab[2]) < len(cav[2])         # CABAC compresses tighter
+    for a, b in zip(decode_iframe_yuv(cav), decode_iframe_yuv(cab)):
+        assert np.array_equal(a, b)
+
+
+def test_cabac_slice_parse_write_identity():
+    """parse → write with unchanged MBs must be byte-identical (the
+    requant path's no-op case)."""
+    img = _img(64, seed=3)
+    nals = encode_iframe(img, 26, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                         entropy="cabac")
+    sps, pps = Sps.parse(nals[0]), Pps.parse(nals[1])
+    codec = CabacSliceCodec(sps, pps)
+    hdr, first, mbs, _ = codec.parse_slice(nals[2])
+    out = codec.write_slice(hdr, first, mbs, hdr.qp)
+    assert out == nals[2]
+
+
+@pytest.mark.skipif(not _HAVE_LAVC, reason="libavcodec unavailable")
+@pytest.mark.parametrize("qp,size,slices", [(24, 64, 1), (30, 96, 1),
+                                            (20, 64, 2), (28, 96, 3)])
+def test_lavc_decodes_our_cabac_bitstream(qp, size, slices):
+    img = _img(size, seed=qp)
+    nals = encode_iframe(img, qp, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                         entropy="cabac", slices=slices)
+    got = LavcH264Decoder().decode(nals, size, size)
+    assert got is not None, "lavc refused the stream"
+    mine = decode_iframe_yuv(nals)
+    for a, b in zip(got, mine):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.skipif(not _HAVE_LAVC, reason="libavcodec unavailable")
+def test_cabac_requant_rung_end_to_end():
+    """CABAC slice → +6 QP requant → smaller bytes, decodable by BOTH
+    decoders with identical output and sane PSNR."""
+    img = _img(96, seed=11)
+    nals = encode_iframe(img, 22, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                         entropy="cabac")
+    rq = SliceRequantizer(6, prefer_native=False)
+    out = [rq.transform_nal(n) for n in nals]
+    assert rq.stats.slices_requantized == 1
+    assert rq.stats.slices_passed_through == 0
+    assert rq.stats.bytes_out < 0.8 * rq.stats.bytes_in
+    got = LavcH264Decoder().decode(out, 96, 96)
+    assert got is not None, "lavc refused the requanted stream"
+    mine = decode_iframe_yuv(out)
+    for a, b in zip(got, mine):
+        assert np.array_equal(a, b)
+    # open-loop drift bound: +6 QP on noisy content costs ~17 dB vs
+    # the 42 dB source encode (spatial drift cascades through DC
+    # prediction and resets at the next IDR); the floor guards against
+    # catastrophic corruption, not against honest requant loss
+    assert psnr(img, got[0]) > 22.0
+
+
+@pytest.mark.skipif(not _HAVE_LAVC, reason="libavcodec unavailable")
+def test_cabac_requant_multislice_and_qp_chain():
+    """Multi-slice CABAC pictures requant per slice; +12 QP zeroes some
+    MBs entirely, exercising the delta-QP chain across uncoded MBs."""
+    img = _img(96, seed=5)
+    nals = encode_iframe(img, 30, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                         entropy="cabac", slices=3)
+    rq = SliceRequantizer(12, prefer_native=False)
+    out = [rq.transform_nal(n) for n in nals]
+    assert rq.stats.slices_requantized == 3
+    got = LavcH264Decoder().decode(out, 96, 96)
+    assert got is not None
+    mine = decode_iframe_yuv(out)
+    for a, b in zip(got, mine):
+        assert np.array_equal(a, b)
+
+
+def test_cabac_out_of_scope_passes_through():
+    """QP ceiling and truncated/corrupt CABAC data pass through
+    unchanged — the rung never corrupts what it cannot requant."""
+    img = _img(64)
+    nals = encode_iframe(img, 46, entropy="cabac")
+    rq = SliceRequantizer(12, prefer_native=False)   # 46+12 > 51
+    out = [rq.transform_nal(n) for n in nals]
+    assert out == nals
+    assert rq.stats.slices_passed_through == 1
+
+    nals = encode_iframe(img, 24, entropy="cabac")
+    rq = SliceRequantizer(6, prefer_native=False)
+    rq.transform_nal(nals[0])
+    rq.transform_nal(nals[1])
+    chopped = nals[2][: len(nals[2]) // 3]
+    assert rq.transform_nal(chopped) == chopped
+    assert rq.stats.slices_passed_through == 1
+
+
+def test_requant_blocks_match_between_entropy_layers():
+    """The same picture coded CAVLC and CABAC reports the same
+    stats.blocks through the rung (engine-independent accounting)."""
+    img = _img(64, seed=9)
+    counts = {}
+    for entropy in ("cavlc", "cabac"):
+        nals = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                             entropy=entropy)
+        rq = SliceRequantizer(6, prefer_native=False)
+        for n in nals:
+            rq.transform_nal(n)
+        counts[entropy] = rq.stats.blocks
+    assert counts["cavlc"] == counts["cabac"] > 0

@@ -54,6 +54,11 @@ async def soak(seconds: float) -> int:
         push_a = RtspClient()
         await push_a.connect("127.0.0.1", app.rtsp.port)
         await push_a.push_start(f"{base}/live/a", SDP)
+        # --- pusher C: TCP, REAL CABAC-coded frames (feeds its own q6
+        # rung: the CABAC requant path must run, not pass through)
+        push_c = RtspClient()
+        await push_c.connect("127.0.0.1", app.rtsp.port)
+        await push_c.push_start(f"{base}/live/c", SDP)
         # --- pusher B: UDP (native recvmmsg ingest)
         push_b = RtspClient()
         await push_b.connect("127.0.0.1", app.rtsp.port)
@@ -96,6 +101,7 @@ async def soak(seconds: float) -> int:
             return await asyncio.to_thread(_get, path)
 
         await rest_get("/api/v1/starthls?path=/live/a&rungs=1,q6")
+        await rest_get("/api/v1/starthls?path=/live/c&rungs=q6")
 
         # pre-encode one GOP-ish cycle BEFORE the clock starts and before
         # the drain task runs (pure-Python encode per frame would
@@ -105,6 +111,10 @@ async def soak(seconds: float) -> int:
                                cb=synth_frame(i + 7, 32),
                                cr=synth_frame(i + 13, 32))
                  for i in range(16)]
+        cycle_cabac = [encode_iframe(synth_frame(i, 48), 24,
+                                     entropy="cabac")
+                       for i in range(8)]
+        seq_c = 0
 
         t0 = time.time()
         f = 0
@@ -145,6 +155,15 @@ async def soak(seconds: float) -> int:
                    + bytes([0x65]) + bytes(120))
             seq_b += 1
             b_sock.sendto(pkt, ("127.0.0.1", b_rtp))
+            if f % 4 == 2:     # ~8 fps CABAC: the Python entropy layer
+                               # is the engine until the native mirror
+                ts_c = int(f * 3000)
+                for nal in cycle_cabac[(f // 4) % 8]:
+                    for p in nalu.packetize_h264(
+                            nal, seq=seq_c, timestamp=ts_c, ssrc=3,
+                            marker_on_last=(nal[0] & 0x1F == 5)):
+                        seq_c += 1
+                        push_c.push_packet(0, p)
             # drain UDP player + ack its packets (reliable window)
             acked = 0
             while True:
@@ -200,6 +219,15 @@ async def soak(seconds: float) -> int:
                             f"{q6 and q6.requant.stats}")
         if q6 is not None and q6.requant.stats.native_slices == 0:
             failures.append("native requant engine unused")
+        entry_c = app.hls.outputs.get("/live/c")
+        q6c = entry_c.renditions.get("q6") if entry_c else None
+        if q6c is None or q6c.requant.stats.slices_requantized < 5:
+            failures.append(f"CABAC requant stats too low: "
+                            f"{q6c and q6c.requant.stats}")
+        if q6c is not None and q6c.requant.stats.slices_passed_through:
+            failures.append(
+                f"CABAC slices passed through unrequanted: "
+                f"{q6c.requant.stats}")
         if tcp_rx[0] < f * 0.5:
             failures.append(f"tcp player starved: {tcp_rx[0]}/{f}")
         if udp_rx[0] < f * 0.5:
@@ -212,6 +240,8 @@ async def soak(seconds: float) -> int:
                 failures.append(f"engine send errors: {eng.send_errors}")
         stats = {
             "frames": f,
+            "cabac_requant": str(q6c and q6c.requant.stats),
+            "cabac_shed": q6c.shed if q6c else None,
             "tcp_rx": tcp_rx[0],
             "udp_rx": udp_rx[0],
             "reliable_in_flight": rel_out.resender.in_flight,
@@ -231,6 +261,7 @@ async def soak(seconds: float) -> int:
         await tcp_player.close()
         await rel_player.close()
         await push_a.close()
+        await push_c.close()
         await push_b.close()
         for s in (b_sock, udp_rtp, udp_rtcp):
             s.close()
