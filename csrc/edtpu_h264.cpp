@@ -724,6 +724,78 @@ struct SliceHeader {
   int32_t deblock_alpha, deblock_beta;
 };
 
+// shared I-slice header parse (mirrors SliceCodec.parse_slice_header);
+// 0 on success, kErr* otherwise
+int parse_islice_header(BitReader &br, int nal_type, int nal_ref_idc,
+                        int32_t log2_max_frame_num, int32_t poc_type,
+                        int32_t log2_max_poc_lsb, int32_t pic_init_qp,
+                        int32_t deblocking_control,
+                        int32_t bottom_field_poc, SliceHeader *h,
+                        uint32_t *first_mb) {
+  h->nal_type = nal_type;
+  h->nal_ref_idc = nal_ref_idc;
+  *first_mb = br.ue();                             // first_mb_in_slice
+  h->slice_type = static_cast<int>(br.ue());
+  if (h->slice_type % 5 != 2) return kErrUnsupported;
+  br.ue();                                         // pps id
+  h->frame_num = br.bits(log2_max_frame_num);
+  if (nal_type == 5) h->idr_pic_id = br.ue();
+  if (poc_type == 0) {
+    if (bottom_field_poc) return kErrUnsupported;
+    h->poc_lsb = br.bits(log2_max_poc_lsb);
+  } else if (poc_type == 1) {
+    return kErrUnsupported;
+  }
+  if (nal_ref_idc != 0) {
+    if (nal_type == 5) {
+      h->no_output_prior = br.bit();
+      h->long_term_ref = br.bit();
+    } else if (br.bit()) {
+      return kErrUnsupported;                      // adaptive marking
+    }
+  }
+  h->qp = pic_init_qp + br.se();
+  if (deblocking_control) {
+    h->deblock_idc = br.ue();
+    if (h->deblock_idc != 1) {
+      h->deblock_alpha = br.se();
+      h->deblock_beta = br.se();
+    }
+  }
+  if (!br.ok || h->qp < 0 || h->qp > 51) return kErrBitstream;
+  return 0;
+}
+
+void write_islice_header(BitWriter &bw, const SliceHeader &h,
+                         uint32_t first_mb, int32_t pps_id,
+                         int32_t qp_out_base, int32_t log2_max_frame_num,
+                         int32_t poc_type, int32_t log2_max_poc_lsb,
+                         int32_t pic_init_qp,
+                         int32_t deblocking_control) {
+  bw.ue(first_mb);
+  bw.ue(static_cast<uint32_t>(h.slice_type));
+  bw.ue(static_cast<uint32_t>(pps_id));            // the latched PPS's id
+  bw.bits(h.frame_num, log2_max_frame_num);
+  if (h.nal_type == 5) bw.ue(h.idr_pic_id);
+  if (poc_type == 0) bw.bits(h.poc_lsb, log2_max_poc_lsb);
+  if (h.nal_ref_idc != 0) {
+    if (h.nal_type == 5) {
+      bw.bit(h.no_output_prior);
+      bw.bit(h.long_term_ref);
+    } else {
+      bw.bit(0);
+    }
+  }
+  bw.se(qp_out_base - pic_init_qp);
+  if (deblocking_control) {
+    bw.ue(h.deblock_idc);
+    if (h.deblock_idc != 1) {
+      bw.se(h.deblock_alpha);
+      bw.se(h.deblock_beta);
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" int32_t ed_h264_requant_slice(
@@ -745,37 +817,13 @@ extern "C" int32_t ed_h264_requant_slice(
 
   // ---- slice header (mirrors SliceCodec.parse_slice_header)
   SliceHeader h{};
-  h.nal_type = nal_type;
-  h.nal_ref_idc = nal_ref_idc;
-  uint32_t first_mb = br.ue();                     // first_mb_in_slice
-  h.slice_type = static_cast<int>(br.ue());
-  if (h.slice_type % 5 != 2) return kErrUnsupported;
-  br.ue();                                         // pps id
-  h.frame_num = br.bits(log2_max_frame_num);
-  if (nal_type == 5) h.idr_pic_id = br.ue();
-  if (poc_type == 0) {
-    if (bottom_field_poc) return kErrUnsupported;
-    h.poc_lsb = br.bits(log2_max_poc_lsb);
-  } else if (poc_type == 1) {
-    return kErrUnsupported;
-  }
-  if (nal_ref_idc != 0) {
-    if (nal_type == 5) {
-      h.no_output_prior = br.bit();
-      h.long_term_ref = br.bit();
-    } else if (br.bit()) {
-      return kErrUnsupported;                      // adaptive marking
-    }
-  }
-  h.qp = pic_init_qp + br.se();
-  if (deblocking_control) {
-    h.deblock_idc = br.ue();
-    if (h.deblock_idc != 1) {
-      h.deblock_alpha = br.se();
-      h.deblock_beta = br.se();
-    }
-  }
-  if (!br.ok || h.qp < 0 || h.qp > 51) return kErrBitstream;
+  uint32_t first_mb = 0;
+  int hrc = parse_islice_header(br, nal_type, nal_ref_idc,
+                                log2_max_frame_num, poc_type,
+                                log2_max_poc_lsb, pic_init_qp,
+                                deblocking_control, bottom_field_poc, &h,
+                                &first_mb);
+  if (hrc) return hrc;
 
   // ---- macroblock walk: decode, shift, re-encode in one pass.
   // nC contexts depend on the NEW totals, so decode everything first
@@ -1004,28 +1052,9 @@ extern "C" int32_t ed_h264_requant_slice(
   // ---- re-encode
   BitWriter bw;
   int32_t qp_out_base = h.qp + delta_qp;
-  bw.ue(first_mb);
-  bw.ue(static_cast<uint32_t>(h.slice_type));
-  bw.ue(static_cast<uint32_t>(pps_id));            // the latched PPS's id
-  bw.bits(h.frame_num, log2_max_frame_num);
-  if (nal_type == 5) bw.ue(h.idr_pic_id);
-  if (poc_type == 0) bw.bits(h.poc_lsb, log2_max_poc_lsb);
-  if (nal_ref_idc != 0) {
-    if (nal_type == 5) {
-      bw.bit(h.no_output_prior);
-      bw.bit(h.long_term_ref);
-    } else {
-      bw.bit(0);
-    }
-  }
-  bw.se(qp_out_base - pic_init_qp);
-  if (deblocking_control) {
-    bw.ue(h.deblock_idc);
-    if (h.deblock_idc != 1) {
-      bw.se(h.deblock_alpha);
-      bw.se(h.deblock_beta);
-    }
-  }
+  write_islice_header(bw, h, first_mb, pps_id, qp_out_base,
+                      log2_max_frame_num, poc_type, log2_max_poc_lsb,
+                      pic_init_qp, deblocking_control);
 
   std::fill(totals.begin(), totals.end(), static_cast<int16_t>(-1));
   std::fill(tot_c.begin(), tot_c.end(), static_cast<int16_t>(-1));
@@ -1101,6 +1130,832 @@ extern "C" int32_t ed_h264_requant_slice(
       return kErrBitstream;
   }
   bw.trailing();
+
+  std::vector<uint8_t> wire;
+  insert_epb(bw.out, wire);
+  if (static_cast<int64_t>(wire.size()) + 1 > out_cap) return kErrOverflow;
+  out[0] = nal_byte;
+  std::memcpy(out + 1, wire.data(), wire.size());
+  return static_cast<int32_t>(wire.size()) + 1;
+}
+
+// ===================================================================
+// CABAC requant (mirrors codecs/h264_cabac.py BIT-EXACTLY; spec
+// 9.3.3.2 / 9.3.4 engines, I-slice syntax, ctxBlockCat 0-4).  Tables
+// come from h264_tables.h, generated from the Python source of truth.
+// ===================================================================
+
+namespace {
+
+constexpr int kSigBase[5] = {105, 120, 134, 149, 152};
+constexpr int kLastBase[5] = {166, 181, 195, 210, 213};
+constexpr int kAbsBase[5] = {227, 237, 247, 257, 266};
+
+inline void cabac_init_states(uint8_t *state, int qp) {
+  qp = qp < 0 ? 0 : (qp > 51 ? 51 : qp);
+  for (int i = 0; i < 1024; ++i) {
+    int pre = ((kCabacCtxInitI[i][0] * qp) >> 4) + kCabacCtxInitI[i][1];
+    pre = pre < 1 ? 1 : (pre > 126 ? 126 : pre);
+    state[i] = pre <= 63 ? static_cast<uint8_t>((63 - pre) << 1)
+                         : static_cast<uint8_t>(((pre - 64) << 1) | 1);
+  }
+}
+
+struct CabacDec {
+  const uint8_t *d = nullptr;
+  int64_t nbits = 0, pos = 0;
+  int overrun = 0;
+  bool ok = true;
+  uint32_t range = 510, offset = 0;
+  uint8_t state[1024];
+
+  int bit() {
+    if (pos >= nbits) {
+      if (++overrun > 64) ok = false;   // far past slice end: corrupt
+      return 0;
+    }
+    int b = (d[pos >> 3] >> (7 - (pos & 7))) & 1;
+    ++pos;
+    return b;
+  }
+
+  int init(const uint8_t *data, int64_t nb, int64_t bitpos, int qp) {
+    d = data;
+    nbits = nb;
+    pos = (bitpos + 7) & ~static_cast<int64_t>(7);
+    cabac_init_states(state, qp);
+    for (int i = 0; i < 9; ++i) offset = (offset << 1) | bit();
+    return offset >= 510 ? kErrBitstream : 0;
+  }
+
+  int decision(int ctx) {
+    uint8_t s = state[ctx];
+    int p = s >> 1, mps = s & 1;
+    uint32_t lps = kCabacRangeLps[p][(range >> 6) & 3];
+    range -= lps;
+    int binv;
+    if (offset >= range) {
+      binv = mps ^ 1;
+      offset -= range;
+      range = lps;
+      if (p == 0) mps ^= 1;
+      state[ctx] = static_cast<uint8_t>((kCabacTransLps[p] << 1) | mps);
+    } else {
+      binv = mps;
+      state[ctx] = static_cast<uint8_t>((kCabacTransMps[p] << 1) | mps);
+    }
+    while (range < 256) {
+      range <<= 1;
+      offset = (offset << 1) | bit();
+    }
+    return binv;
+  }
+
+  int bypass() {
+    offset = (offset << 1) | bit();
+    if (offset >= range) {
+      offset -= range;
+      return 1;
+    }
+    return 0;
+  }
+
+  int terminate() {
+    range -= 2;
+    if (offset >= range) return 1;
+    while (range < 256) {
+      range <<= 1;
+      offset = (offset << 1) | bit();
+    }
+    return 0;
+  }
+};
+
+struct CabacEnc {
+  uint32_t low = 0, range = 510;
+  bool first = true;
+  int64_t outstanding = 0;
+  std::vector<uint8_t> bytes;
+  uint32_t cur = 0;
+  int ncur = 0;
+  uint8_t state[1024];
+
+  void emit(int b) {
+    cur = (cur << 1) | (b & 1);
+    if (++ncur == 8) {
+      bytes.push_back(static_cast<uint8_t>(cur));
+      cur = 0;
+      ncur = 0;
+    }
+  }
+
+  void put(int b) {
+    if (first)
+      first = false;                    // 9.3.4.1: leading bit dropped
+    else
+      emit(b);
+    while (outstanding) {
+      emit(1 - b);
+      --outstanding;
+    }
+  }
+
+  void renorm() {
+    while (range < 256) {
+      if (low >= 512) {
+        put(1);
+        low -= 512;
+      } else if (low < 256) {
+        put(0);
+      } else {
+        ++outstanding;
+        low -= 256;
+      }
+      low <<= 1;
+      range <<= 1;
+    }
+  }
+
+  void decision(int ctx, int binv) {
+    uint8_t s = state[ctx];
+    int p = s >> 1, mps = s & 1;
+    uint32_t lps = kCabacRangeLps[p][(range >> 6) & 3];
+    range -= lps;
+    if (binv != mps) {
+      low += range;
+      range = lps;
+      if (p == 0) mps ^= 1;
+      state[ctx] = static_cast<uint8_t>((kCabacTransLps[p] << 1) | mps);
+    } else {
+      state[ctx] = static_cast<uint8_t>((kCabacTransMps[p] << 1) | mps);
+    }
+    renorm();
+  }
+
+  void bypass(int binv) {
+    low <<= 1;
+    if (binv) low += range;
+    if (low >= 1024) {
+      put(1);
+      low -= 1024;
+    } else if (low < 512) {
+      put(0);
+    } else {
+      ++outstanding;
+      low -= 512;
+    }
+  }
+
+  void terminate(int binv) {
+    range -= 2;
+    if (binv) {
+      low += range;
+      range = 2;
+      renorm();
+      // EncodeFlush: final written bit doubles as rbsp_stop_one_bit
+      put((low >> 9) & 1);
+      emit((low >> 8) & 1);
+      emit(1);
+      while (ncur) emit(0);             // rbsp_alignment_zero_bit
+    } else {
+      renorm();
+    }
+  }
+};
+
+// per-slice neighbor grids for ctxIdxInc derivation (slice-scoped:
+// out-of-slice → unavailable; intra cbf default 1 — the same rule the
+// Python layer learned from the libavcodec differential)
+struct CabacNb {
+  int w, h;
+  std::vector<uint8_t> seen, i4x4;
+  std::vector<int32_t> cmode, cbpl, cbpc;
+  std::vector<int8_t> dccbf, lcbf, ccbf, cdccbf;
+  bool last_dqp_nz = false;
+
+  CabacNb(int width_mbs, int height_mbs) : w(width_mbs), h(height_mbs) {
+    int n = w * h;
+    seen.assign(n, 0);
+    i4x4.assign(n, 0);
+    cmode.assign(n, 0);
+    cbpl.assign(n, 0);
+    cbpc.assign(n, 0);
+    dccbf.assign(n, 0);
+    lcbf.assign(static_cast<size_t>(4 * h) * 4 * w, -1);
+    ccbf.assign(static_cast<size_t>(2) * 2 * h * 2 * w, -1);
+    cdccbf.assign(static_cast<size_t>(2) * n, 0);
+  }
+
+  int mbok(int mb, int dx, int dy) const {
+    int x = mb % w + dx, y = mb / w + dy;
+    if (x < 0 || y < 0 || x >= w || y >= h) return -1;
+    int n = y * w + x;
+    return seen[n] ? n : -1;
+  }
+
+  int mb_type_inc(int mb) const {
+    int inc = 0;
+    int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
+    if (a >= 0 && !i4x4[a]) ++inc;
+    if (b >= 0 && !i4x4[b]) ++inc;
+    return inc;
+  }
+
+  int chroma_pred_inc(int mb) const {
+    int inc = 0;
+    int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
+    if (a >= 0 && cmode[a] != 0) inc += 1;
+    if (b >= 0 && cmode[b] != 0) inc += 2;
+    return inc;
+  }
+
+  int cbp_luma_inc(int mb, int b8, int cur_bits) const {
+    int x8 = b8 & 1, y8 = b8 >> 1;
+    int a, b;
+    if (x8 == 1) {
+      a = ((cur_bits >> (b8 - 1)) & 1) ? 0 : 1;
+    } else {
+      int n = mbok(mb, -1, 0);
+      a = n >= 0 ? (((cbpl[n] >> (b8 + 1)) & 1) ? 0 : 1) : 0;
+    }
+    if (y8 == 1) {
+      b = ((cur_bits >> (b8 - 2)) & 1) ? 0 : 1;
+    } else {
+      int n = mbok(mb, 0, -1);
+      b = n >= 0 ? (((cbpl[n] >> (b8 + 2)) & 1) ? 0 : 1) : 0;
+    }
+    return a + 2 * b;
+  }
+
+  int cbp_chroma_inc(int mb, int binidx) const {
+    int inc = 0;
+    int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
+    if (a >= 0 && (binidx == 0 ? cbpc[a] != 0 : cbpc[a] == 2)) inc += 1;
+    if (b >= 0 && (binidx == 0 ? cbpc[b] != 0 : cbpc[b] == 2)) inc += 2;
+    return inc;
+  }
+
+  int cbf_at(const int8_t *g, int y, int x, int H, int W) const {
+    if (x < 0 || y < 0 || x >= W || y >= H) return 1;
+    int8_t v = g[static_cast<size_t>(y) * W + x];
+    return v < 0 ? 1 : v;
+  }
+
+  int luma_cbf_inc(int gx, int gy) const {
+    return cbf_at(lcbf.data(), gy, gx - 1, 4 * h, 4 * w) +
+           2 * cbf_at(lcbf.data(), gy - 1, gx, 4 * h, 4 * w);
+  }
+
+  int chroma_cbf_inc(int comp, int gx, int gy) const {
+    const int8_t *g = ccbf.data() + static_cast<size_t>(comp) * 2 * h * 2 * w;
+    return cbf_at(g, gy, gx - 1, 2 * h, 2 * w) +
+           2 * cbf_at(g, gy - 1, gx, 2 * h, 2 * w);
+  }
+
+  int dc_cbf_inc(int mb) const {
+    int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
+    return (a < 0 ? 1 : dccbf[a]) + 2 * (b < 0 ? 1 : dccbf[b]);
+  }
+
+  int cdc_inc(int comp, int mb) const {
+    int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
+    int va = a < 0 ? 1 : cdccbf[static_cast<size_t>(comp) * w * h + a];
+    int vb = b < 0 ? 1 : cdccbf[static_cast<size_t>(comp) * w * h + b];
+    return va + 2 * vb;
+  }
+
+  void set_lcbf(int gx, int gy, int v) {
+    lcbf[static_cast<size_t>(gy) * 4 * w + gx] = static_cast<int8_t>(v);
+  }
+  void set_ccbf(int comp, int gx, int gy, int v) {
+    ccbf[static_cast<size_t>(comp) * 2 * h * 2 * w +
+         static_cast<size_t>(gy) * 2 * w + gx] = static_cast<int8_t>(v);
+  }
+  void set_cdc(int comp, int mb, int v) {
+    cdccbf[static_cast<size_t>(comp) * w * h + mb] =
+        static_cast<int8_t>(v);
+  }
+};
+
+// residual_block_cabac decode (cbf already consumed); levels clamped to
+// ±kLevelClip at parse time per the repo clip contract
+bool cabac_residual_dec(CabacDec &dc, int cat, int16_t *row, int maxc) {
+  int sigpos[16];
+  int nsig = 0;
+  bool broke = false;
+  for (int i = 0; i < maxc - 1; ++i) {
+    if (dc.decision(kSigBase[cat] + i)) {
+      sigpos[nsig++] = i;
+      if (dc.decision(kLastBase[cat] + i)) {
+        broke = true;
+        break;
+      }
+    }
+  }
+  if (!broke) sigpos[nsig++] = maxc - 1;
+  int n_eq1 = 0, n_gt1 = 0;
+  for (int j = nsig - 1; j >= 0; --j) {
+    int ctx0 = kAbsBase[cat] + (n_gt1 ? 0 : (n_eq1 + 1 > 4 ? 4 : n_eq1 + 1));
+    int64_t mag = 0;
+    if (dc.decision(ctx0)) {
+      mag = 1;
+      int ctxn = kAbsBase[cat] + 5 + (n_gt1 > 4 ? 4 : n_gt1);
+      while (mag < 14 && dc.decision(ctxn)) ++mag;
+      if (mag == 14) {                  // UEG0 bypass suffix
+        int k = 0;
+        while (dc.bypass()) {
+          if (++k > 31) return false;
+        }
+        int64_t add = 0;
+        for (int t = 0; t < k; ++t) add = (add << 1) | dc.bypass();
+        mag += (1LL << k) - 1 + add;
+      }
+    }
+    int64_t level = mag + 1;
+    if (dc.bypass()) level = -level;
+    if (level > kLevelClip) level = kLevelClip;
+    if (level < -kLevelClip) level = -kLevelClip;
+    row[sigpos[j]] = static_cast<int16_t>(level);
+    if (mag == 0)
+      ++n_eq1;
+    else
+      ++n_gt1;
+  }
+  return dc.ok;
+}
+
+void cabac_residual_enc(CabacEnc &en, int cat, const int16_t *row,
+                        int maxc) {
+  int sigpos[16];
+  int nsig = 0;
+  for (int i = 0; i < maxc; ++i)
+    if (row[i]) sigpos[nsig++] = i;
+  int last = sigpos[nsig - 1];
+  for (int i = 0; i < maxc - 1 && i <= last; ++i) {
+    int sig = row[i] ? 1 : 0;
+    en.decision(kSigBase[cat] + i, sig);
+    if (sig) en.decision(kLastBase[cat] + i, i == last ? 1 : 0);
+  }
+  int n_eq1 = 0, n_gt1 = 0;
+  for (int j = nsig - 1; j >= 0; --j) {
+    int level = row[sigpos[j]];
+    int mag = (level < 0 ? -level : level) - 1;
+    int ctx0 = kAbsBase[cat] + (n_gt1 ? 0 : (n_eq1 + 1 > 4 ? 4 : n_eq1 + 1));
+    if (mag == 0) {
+      en.decision(ctx0, 0);
+    } else {
+      en.decision(ctx0, 1);
+      int ctxn = kAbsBase[cat] + 5 + (n_gt1 > 4 ? 4 : n_gt1);
+      int pre = mag < 14 ? mag : 14;
+      for (int t = 0; t < pre - 1; ++t) en.decision(ctxn, 1);
+      if (mag < 14) {
+        en.decision(ctxn, 0);
+      } else {                          // UEG0 bypass suffix
+        int rem = mag - 14;
+        int k = 0;
+        while ((rem + 1) >> (k + 1)) ++k;
+        for (int t = 0; t < k; ++t) en.bypass(1);
+        en.bypass(0);
+        int suffix = rem + 1 - (1 << k);
+        for (int t = k - 1; t >= 0; --t) en.bypass((suffix >> t) & 1);
+      }
+    }
+    en.bypass(level < 0 ? 1 : 0);
+    if (mag == 0)
+      ++n_eq1;
+    else
+      ++n_gt1;
+  }
+}
+
+}  // namespace
+
+/* Native CABAC I-slice requant — same contract as the CAVLC entry. */
+extern "C" int32_t ed_h264_requant_slice_cabac(
+    const uint8_t *nal, int32_t nal_len, uint8_t *out, int32_t out_cap,
+    int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
+    int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
+    int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
+    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t *blocks_out) {
+  if (nal_len < 2 || delta_qp < 6 || delta_qp % 6) return kErrUnsupported;
+  uint8_t nal_byte = nal[0];
+  int nal_type = nal_byte & 0x1F;
+  int nal_ref_idc = (nal_byte >> 5) & 3;
+  if (nal_type != 1 && nal_type != 5) return kErrUnsupported;
+
+  std::vector<uint8_t> rbsp;
+  strip_epb(nal + 1, nal_len - 1, rbsp);
+  BitReader br(rbsp.data(), static_cast<int64_t>(rbsp.size()));
+  SliceHeader h{};
+  uint32_t first_mb = 0;
+  int hrc = parse_islice_header(br, nal_type, nal_ref_idc,
+                                log2_max_frame_num, poc_type,
+                                log2_max_poc_lsb, pic_init_qp,
+                                deblocking_control, bottom_field_poc, &h,
+                                &first_mb);
+  if (hrc) return hrc;
+
+  int n_mbs = width_mbs * height_mbs;
+  if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;
+
+  CabacDec dec;
+  if (dec.init(rbsp.data(), static_cast<int64_t>(rbsp.size()) * 8, br.pos,
+               h.qp))
+    return kErrBitstream;
+
+  // ---- per-MB storage (CAVLC layout: row 0 = I_16x16 DC, 1+b = blocks)
+  std::vector<int16_t> all_levels(static_cast<size_t>(n_mbs) * 17 * 16);
+  std::vector<int32_t> mb_qp(n_mbs);
+  std::vector<uint8_t> mb_is16(n_mbs), mb_pred16(n_mbs);
+  std::vector<uint8_t> mb_modes(static_cast<size_t>(n_mbs) * 16 * 2);
+  std::vector<uint32_t> mb_chroma(n_mbs);
+  std::vector<uint8_t> mb_ccbp_in(n_mbs);
+  std::vector<int16_t> cdc(static_cast<size_t>(n_mbs) * 2 * 16);
+  std::vector<int16_t> cac(static_cast<size_t>(n_mbs) * 2 * 4 * 16);
+
+  // one authoritative copy of the per-MB dqp / chroma-pred-mode syntax
+  // (the Python mirror keeps these in _parse_dqp/_write_dqp/
+  // _parse_chroma_mode/_write_chroma_mode); qp-range policy stays at
+  // the call sites
+  auto read_dqp = [](CabacDec &dc, CabacNb &grids, int32_t *delta) {
+    int val = 0;
+    int ctx = 60 + (grids.last_dqp_nz ? 1 : 0);
+    while (dc.decision(ctx)) {
+      if (++val > 104) return false;
+      ctx = val == 1 ? 62 : 63;
+    }
+    grids.last_dqp_nz = val != 0;
+    *delta = (val & 1) ? (val + 1) / 2 : -(val / 2);
+    return true;
+  };
+  auto emit_dqp = [](CabacEnc &en, CabacNb &grids, int32_t delta) {
+    if (delta < -26 || delta > 25) return false;   // 7.4.5 bound
+    int val = delta > 0 ? 2 * delta - 1 : -2 * delta;
+    int ctx = 60 + (grids.last_dqp_nz ? 1 : 0);
+    for (int i = 0; i < val; ++i) {
+      en.decision(ctx, 1);
+      ctx = i == 0 ? 62 : 63;
+    }
+    en.decision(ctx, 0);
+    grids.last_dqp_nz = delta != 0;
+    return true;
+  };
+  auto read_cmode = [](CabacDec &dc, CabacNb &grids, int mbi) {
+    int cm;
+    if (!dc.decision(64 + grids.chroma_pred_inc(mbi)))
+      cm = 0;
+    else if (!dc.decision(67))
+      cm = 1;
+    else
+      cm = dc.decision(67) ? 3 : 2;
+    grids.cmode[mbi] = cm;
+    return cm;
+  };
+  auto emit_cmode = [](CabacEnc &en, CabacNb &grids, int mbi, int cm) {
+    en.decision(64 + grids.chroma_pred_inc(mbi), cm == 0 ? 0 : 1);
+    if (cm > 0) {
+      en.decision(67, cm == 1 ? 0 : 1);
+      if (cm > 1) en.decision(67, cm == 2 ? 0 : 1);
+    }
+    grids.cmode[mbi] = cm;
+  };
+
+  int k = delta_qp / 6;
+  int deadzone = (1 << k) / 3;
+  auto qpc_of = [&](int32_t qpy) -> int {
+    int q = qpy + chroma_qp_offset;
+    q = q < 0 ? 0 : (q > 51 ? 51 : q);
+    return kChromaQp[q];
+  };
+
+  // ---- decode pass
+  CabacNb nb(width_mbs, height_mbs);
+  int32_t cur_qp = h.qp;
+  int32_t max_qp = h.qp;
+  int end_mb = static_cast<int>(first_mb);
+  int64_t blk_count = 0;
+  for (int mb = static_cast<int>(first_mb);; ++mb) {
+    if (mb >= n_mbs) return kErrBitstream;         // overran the picture
+    int mbx4 = (mb % width_mbs) * 4, mby4 = (mb / width_mbs) * 4;
+    int cx2 = (mb % width_mbs) * 2, cy2 = (mb / width_mbs) * 2;
+    int16_t *rows = &all_levels[static_cast<size_t>(mb) * 17 * 16];
+    int16_t *cd = &cdc[static_cast<size_t>(mb) * 2 * 16];
+    int16_t *ca = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
+    int chroma_cbp;
+    if (dec.decision(3 + nb.mb_type_inc(mb)) == 0) {
+      // ---------------- I_4x4
+      mb_is16[mb] = 0;
+      for (int b = 0; b < 16; ++b) {
+        int flag = dec.decision(68);
+        int rem = 0;
+        if (!flag)
+          rem = dec.decision(69) | (dec.decision(69) << 1) |
+                (dec.decision(69) << 2);
+        mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2] =
+            static_cast<uint8_t>(flag);
+        mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1] =
+            static_cast<uint8_t>(rem);
+      }
+      nb.seen[mb] = 1;
+      nb.i4x4[mb] = 1;
+      mb_chroma[mb] = static_cast<uint32_t>(read_cmode(dec, nb, mb));
+      int cbp = 0;
+      for (int b8 = 0; b8 < 4; ++b8)
+        if (dec.decision(73 + nb.cbp_luma_inc(mb, b8, cbp)))
+          cbp |= 1 << b8;
+      chroma_cbp = 0;
+      if (dec.decision(77 + nb.cbp_chroma_inc(mb, 0)))
+        chroma_cbp = dec.decision(81 + nb.cbp_chroma_inc(mb, 1)) ? 2 : 1;
+      nb.cbpl[mb] = cbp;
+      nb.cbpc[mb] = chroma_cbp;
+      if (cbp || chroma_cbp) {
+        int32_t delta;
+        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
+        cur_qp += delta;
+        if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
+      } else {
+        nb.last_dqp_nz = false;
+      }
+      mb_qp[mb] = cur_qp;
+      if (cur_qp > max_qp) max_qp = cur_qp;
+      nb.dccbf[mb] = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        int16_t *lv = rows + (1 + b) * 16;
+        if ((cbp >> (b >> 2)) & 1) {
+          int cbf = dec.decision(85 + 8 + nb.luma_cbf_inc(gx, gy));
+          nb.set_lcbf(gx, gy, cbf);
+          if (cbf && !cabac_residual_dec(dec, 2, lv, 16))
+            return kErrBitstream;
+        } else {
+          nb.set_lcbf(gx, gy, 0);
+        }
+      }
+      blk_count += 16 + (chroma_cbp ? 8 : 0);
+    } else {
+      // ---------------- I_16x16
+      if (dec.terminate()) return kErrUnsupported;  // I_PCM
+      int luma15 = dec.decision(6);
+      chroma_cbp = 0;
+      if (dec.decision(7)) chroma_cbp = dec.decision(8) ? 2 : 1;
+      int pred = (dec.decision(9) << 1) | dec.decision(10);
+      mb_is16[mb] = 1;
+      mb_pred16[mb] = static_cast<uint8_t>(pred);
+      nb.seen[mb] = 1;
+      nb.i4x4[mb] = 0;
+      nb.cbpl[mb] = luma15 ? 15 : 0;
+      nb.cbpc[mb] = chroma_cbp;
+      mb_chroma[mb] = static_cast<uint32_t>(read_cmode(dec, nb, mb));
+      {
+        int32_t delta;
+        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
+        cur_qp += delta;
+        if (cur_qp < 12 || cur_qp > 51) return kErrUnsupported;
+      }
+      mb_qp[mb] = cur_qp;
+      if (cur_qp > max_qp) max_qp = cur_qp;
+      int cbf = dec.decision(85 + 0 + nb.dc_cbf_inc(mb));
+      nb.dccbf[mb] = static_cast<int8_t>(cbf);
+      if (cbf && !cabac_residual_dec(dec, 0, rows, 16))
+        return kErrBitstream;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        int16_t *lv = rows + (1 + b) * 16;
+        if (luma15) {
+          int c2 = dec.decision(85 + 4 + nb.luma_cbf_inc(gx, gy));
+          nb.set_lcbf(gx, gy, c2);
+          if (c2 && !cabac_residual_dec(dec, 1, lv, 15))
+            return kErrBitstream;
+        } else {
+          nb.set_lcbf(gx, gy, 0);
+        }
+      }
+      blk_count += 17 + (chroma_cbp ? 8 : 0);
+    }
+    // ---------------- chroma residuals (shared I_4x4 / I_16x16)
+    mb_ccbp_in[mb] = static_cast<uint8_t>(chroma_cbp);
+    if (chroma_cbp) {
+      for (int comp = 0; comp < 2; ++comp) {
+        int cbf = dec.decision(85 + 12 + nb.cdc_inc(comp, mb));
+        nb.set_cdc(comp, mb, cbf);
+        if (cbf && !cabac_residual_dec(dec, 3, cd + comp * 16, 4))
+          return kErrBitstream;
+      }
+    } else {
+      nb.set_cdc(0, mb, 0);
+      nb.set_cdc(1, mb, 0);
+    }
+    for (int comp = 0; comp < 2; ++comp)
+      for (int b = 0; b < 4; ++b) {
+        int gx = cx2 + (b & 1), gy = cy2 + (b >> 1);
+        if (chroma_cbp == 2) {
+          int cbf = dec.decision(85 + 16 + nb.chroma_cbf_inc(comp, gx, gy));
+          nb.set_ccbf(comp, gx, gy, cbf);
+          if (cbf &&
+              !cabac_residual_dec(dec, 4, ca + (comp * 4 + b) * 16, 15))
+            return kErrBitstream;
+        } else {
+          nb.set_ccbf(comp, gx, gy, 0);
+        }
+      }
+    if (!dec.ok) return kErrBitstream;
+    end_mb = mb + 1;
+    if (dec.terminate()) break;
+  }
+  if (max_qp + delta_qp > 51) return kErrUnsupported;  // ladder ceiling
+  if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
+  if (blocks_out)
+    *blocks_out = static_cast<int32_t>(
+        blk_count > INT32_MAX ? INT32_MAX : blk_count);
+
+  // ---- requant (+6k shift, chroma via Table 8-15 QPc dispatch) and
+  // output CBP recompute — identical math to the CAVLC entry
+  std::vector<int32_t> mb_cbp_out(n_mbs);
+  std::vector<uint8_t> mb_ccbp_out(n_mbs);
+  auto shift_row16 = [&](int16_t *lv, int n) {
+    bool any = false;
+    for (int i = 0; i < n; ++i) {
+      int32_t v = lv[i];
+      int32_t a = v < 0 ? -v : v;
+      if (a > kLevelClip) a = kLevelClip;
+      a = (a + deadzone) >> k;
+      lv[i] = static_cast<int16_t>(v < 0 ? -a : a);
+      any |= lv[i] != 0;
+    }
+    return any;
+  };
+  for (int mb = static_cast<int>(first_mb); mb < end_mb; ++mb) {
+    int16_t *rows = &all_levels[static_cast<size_t>(mb) * 17 * 16];
+    int16_t *cd = &cdc[static_cast<size_t>(mb) * 2 * 16];
+    int16_t *ca = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
+    if (mb_is16[mb]) {
+      shift_row16(rows, 16);                       // DC
+      bool any_ac = false;
+      for (int b = 0; b < 16; ++b)
+        any_ac |= shift_row16(rows + (1 + b) * 16, 15);
+      mb_cbp_out[mb] = any_ac ? 15 : 0;
+    } else {
+      int out_cbp = 0;
+      for (int b = 0; b < 16; ++b)
+        if (shift_row16(rows + (1 + b) * 16, 16)) out_cbp |= 1 << (b >> 2);
+      mb_cbp_out[mb] = out_cbp;
+    }
+    if (mb_ccbp_in[mb]) {
+      for (int comp = 0; comp < 2; ++comp)
+        chroma_requant_comp(cd + comp * 16, ca + comp * 4 * 16,
+                            qpc_of(mb_qp[mb]),
+                            qpc_of(mb_qp[mb] + delta_qp));
+      bool any_dc = false, any_ac = false;
+      for (int i = 0; i < 2 * 16; ++i) any_dc |= cd[i] != 0;
+      for (int i = 0; i < 2 * 4 * 16; ++i) any_ac |= ca[i] != 0;
+      mb_ccbp_out[mb] = any_ac ? 2 : (any_dc ? 1 : 0);
+    } else {
+      mb_ccbp_out[mb] = 0;
+    }
+  }
+
+  // ---- re-encode
+  BitWriter bw;
+  int32_t qp_out_base = h.qp + delta_qp;
+  write_islice_header(bw, h, first_mb, pps_id, qp_out_base,
+                      log2_max_frame_num, poc_type, log2_max_poc_lsb,
+                      pic_init_qp, deblocking_control);
+  while (bw.nbits) bw.bit(1);                      // cabac_alignment_one
+  CabacEnc enc;
+  cabac_init_states(enc.state, qp_out_base);
+  CabacNb wb(width_mbs, height_mbs);
+  int32_t prev_qp = qp_out_base;
+  for (int mb = static_cast<int>(first_mb); mb < end_mb; ++mb) {
+    int mbx4 = (mb % width_mbs) * 4, mby4 = (mb / width_mbs) * 4;
+    int cx2 = (mb % width_mbs) * 2, cy2 = (mb / width_mbs) * 2;
+    const int16_t *rows = &all_levels[static_cast<size_t>(mb) * 17 * 16];
+    const int16_t *cd = &cdc[static_cast<size_t>(mb) * 2 * 16];
+    const int16_t *ca = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
+    int32_t qp_out_mb = mb_qp[mb] + delta_qp;
+    int ccbp = mb_ccbp_out[mb];
+    if (!mb_is16[mb]) {
+      enc.decision(3 + wb.mb_type_inc(mb), 0);
+      wb.seen[mb] = 1;
+      wb.i4x4[mb] = 1;
+      for (int b = 0; b < 16; ++b) {
+        int flag = mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2];
+        int rem = mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1];
+        enc.decision(68, flag);
+        if (!flag) {
+          enc.decision(69, rem & 1);
+          enc.decision(69, (rem >> 1) & 1);
+          enc.decision(69, (rem >> 2) & 1);
+        }
+      }
+      emit_cmode(enc, wb, mb, static_cast<int>(mb_chroma[mb]));
+      int cbp = mb_cbp_out[mb];
+      int built = 0;
+      for (int b8 = 0; b8 < 4; ++b8) {
+        int bit = (cbp >> b8) & 1;
+        enc.decision(73 + wb.cbp_luma_inc(mb, b8, built), bit);
+        built |= bit << b8;
+      }
+      enc.decision(77 + wb.cbp_chroma_inc(mb, 0), ccbp ? 1 : 0);
+      if (ccbp) enc.decision(81 + wb.cbp_chroma_inc(mb, 1),
+                             ccbp == 2 ? 1 : 0);
+      wb.cbpl[mb] = cbp;
+      wb.cbpc[mb] = ccbp;
+      if (cbp || ccbp) {
+        if (!emit_dqp(enc, wb, qp_out_mb - prev_qp))
+          return kErrUnsupported;
+        prev_qp = qp_out_mb;
+      } else {
+        wb.last_dqp_nz = false;
+      }
+      wb.dccbf[mb] = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        const int16_t *lv = rows + (1 + b) * 16;
+        if ((cbp >> (b >> 2)) & 1) {
+          bool any = false;
+          for (int i = 0; i < 16; ++i) any |= lv[i] != 0;
+          enc.decision(85 + 8 + wb.luma_cbf_inc(gx, gy), any ? 1 : 0);
+          wb.set_lcbf(gx, gy, any ? 1 : 0);
+          if (any) cabac_residual_enc(enc, 2, lv, 16);
+        } else {
+          wb.set_lcbf(gx, gy, 0);
+        }
+      }
+    } else {
+      enc.decision(3 + wb.mb_type_inc(mb), 1);
+      wb.seen[mb] = 1;
+      wb.i4x4[mb] = 0;
+      enc.terminate(0);
+      int luma15 = mb_cbp_out[mb] == 15;
+      enc.decision(6, luma15);
+      enc.decision(7, ccbp ? 1 : 0);
+      if (ccbp) enc.decision(8, ccbp == 2 ? 1 : 0);
+      enc.decision(9, (mb_pred16[mb] >> 1) & 1);
+      enc.decision(10, mb_pred16[mb] & 1);
+      wb.cbpl[mb] = luma15 ? 15 : 0;
+      wb.cbpc[mb] = ccbp;
+      emit_cmode(enc, wb, mb, static_cast<int>(mb_chroma[mb]));
+      if (!emit_dqp(enc, wb, qp_out_mb - prev_qp)) return kErrUnsupported;
+      prev_qp = qp_out_mb;
+      bool any_dc = false;
+      for (int i = 0; i < 16; ++i) any_dc |= rows[i] != 0;
+      enc.decision(85 + 0 + wb.dc_cbf_inc(mb), any_dc ? 1 : 0);
+      wb.dccbf[mb] = any_dc ? 1 : 0;
+      if (any_dc) cabac_residual_enc(enc, 0, rows, 16);
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        const int16_t *lv = rows + (1 + b) * 16;
+        if (luma15) {
+          bool any = false;
+          for (int i = 0; i < 15; ++i) any |= lv[i] != 0;
+          enc.decision(85 + 4 + wb.luma_cbf_inc(gx, gy), any ? 1 : 0);
+          wb.set_lcbf(gx, gy, any ? 1 : 0);
+          if (any) cabac_residual_enc(enc, 1, lv, 15);
+        } else {
+          wb.set_lcbf(gx, gy, 0);
+        }
+      }
+    }
+    if (ccbp) {
+      for (int comp = 0; comp < 2; ++comp) {
+        const int16_t *d = cd + comp * 16;
+        bool any = d[0] || d[1] || d[2] || d[3];
+        enc.decision(85 + 12 + wb.cdc_inc(comp, mb), any ? 1 : 0);
+        wb.set_cdc(comp, mb, any ? 1 : 0);
+        if (any) cabac_residual_enc(enc, 3, d, 4);
+      }
+    } else {
+      wb.set_cdc(0, mb, 0);
+      wb.set_cdc(1, mb, 0);
+    }
+    for (int comp = 0; comp < 2; ++comp)
+      for (int b = 0; b < 4; ++b) {
+        int gx = cx2 + (b & 1), gy = cy2 + (b >> 1);
+        if (ccbp == 2) {
+          const int16_t *lv = ca + (comp * 4 + b) * 16;
+          bool any = false;
+          for (int i = 0; i < 15; ++i) any |= lv[i] != 0;
+          enc.decision(85 + 16 + wb.chroma_cbf_inc(comp, gx, gy),
+                       any ? 1 : 0);
+          wb.set_ccbf(comp, gx, gy, any ? 1 : 0);
+          if (any) cabac_residual_enc(enc, 4, lv, 15);
+        } else {
+          wb.set_ccbf(comp, gx, gy, 0);
+        }
+      }
+    enc.terminate(mb == end_mb - 1 ? 1 : 0);
+  }
+
+  for (uint8_t byte : enc.bytes) bw.bits(byte, 8);
 
   std::vector<uint8_t> wire;
   insert_epb(bw.out, wire);

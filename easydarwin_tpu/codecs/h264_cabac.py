@@ -381,8 +381,10 @@ class CabacSliceCodec:
         chroma_mode = self._parse_chroma_mode(dec, nb, mb)
         delta = self._parse_dqp(dec, nb)
         cur_qp += delta
-        if not 0 <= cur_qp <= 51:
-            raise ValueError("qp out of range")
+        if not 12 <= cur_qp <= 51:
+            # <12: DC dequant uses a rounding form that breaks the exact
+            # +6k shift argument — pass through (same rule as CAVLC)
+            raise ValueError("QPY out of I_16x16 requant range")
 
         dc = np.zeros(16, dtype=np.int64)
         cbf = dec.decision(_CBF_BASE + 0 + nb.dc_cbf_inc(mb))

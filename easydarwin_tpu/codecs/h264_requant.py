@@ -9,16 +9,18 @@ through untouched (QP lives in the slice header).  Prediction drift is
 accepted and resets at every IDR, which in the all-intra camera configs
 this ladder targets means every frame.
 
-Scope: CAVLC baseline-intra slices of I_4x4 and I_16x16 macroblocks —
-including multi-slice pictures (each slice requants independently from
-its ``first_mb_in_slice``, nC contexts slice-scoped per 6.4.9) — with
-luma AND 4:2:0 chroma residuals (luma steps by the exact +6k shift;
-chroma follows the Table 8-15 QPc mapping with a three-way
-identity / exact-shift / integer-round-trip dispatch — see
+Scope: intra slices (I_4x4 and I_16x16 macroblocks) in BOTH entropy
+layers — CAVLC and CABAC (``h264_cabac``, dispatched on the PPS's
+entropy_coding_mode_flag) — including multi-slice pictures (each slice
+requants independently from its ``first_mb_in_slice``, contexts
+slice-scoped) — with luma AND 4:2:0 chroma residuals (luma steps by the
+exact +6k shift; chroma follows the Table 8-15 QPc mapping with a
+three-way identity / exact-shift / integer-round-trip dispatch — see
 ``h264_transform.requant_chroma_scalar``).  I_16x16 needs QPY ≥ 12
 (the exact-shift DC dequant window).  Streams outside the profile
-(CABAC, inter slices, low-QP I_16x16) PASS THROUGH unchanged and are
-counted — the rung never corrupts what it cannot parse."""
+(inter slices, 8x8 transform, scaling matrices, low-QP I_16x16) PASS
+THROUGH unchanged and are counted — the rung never corrupts what it
+cannot parse."""
 
 from __future__ import annotations
 
@@ -159,10 +161,7 @@ class SliceRequantizer:
             return nal, delta
         delta.bytes_in += len(nal)
         out = None
-        # the native walk is CAVLC-only so far: CABAC slice data must
-        # not be offered to it (its strict checks would reject, but
-        # guaranteeing the dispatch is cheaper than trusting them)
-        if self._native and not pps.entropy_cabac:
+        if self._native:
             res = self._requant_native(nal, sps, pps)
             if res is not None:
                 out, _n_slice_mbs, n_blocks = res
@@ -192,7 +191,7 @@ class SliceRequantizer:
             pic_init_qp=p.pic_init_qp, pps_id=p.pps_id,
             deblocking_control=p.deblocking_control,
             bottom_field_poc=p.bottom_field_poc, delta_qp=self.delta_qp,
-            chroma_qp_offset=p.chroma_qp_offset)
+            chroma_qp_offset=p.chroma_qp_offset, cabac=p.entropy_cabac)
 
     def _requant_slice(self, nal: bytes, sps: Sps, pps: Pps
                        ) -> tuple[bytes, int]:

@@ -59,7 +59,7 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        if not hasattr(lib, "ed_last_send_errno"):   # newest symbol
+        if not hasattr(lib, "ed_h264_requant_slice_cabac"):  # newest symbol
             # stale prebuilt .so from an older source tree: rebuild in place
             # (make relinks to a fresh inode, so a second dlopen maps the
             # new library; the old one is never deleted, in case no
@@ -70,7 +70,7 @@ def _load():
                 lib = ctypes.CDLL(_SO)
             except OSError:
                 return None
-            if not hasattr(lib, "ed_last_send_errno"):
+            if not hasattr(lib, "ed_h264_requant_slice_cabac"):
                 return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -104,14 +104,18 @@ def _load():
             u32p, u32p, u32p, ctypes.c_int32,
             ctypes.POINTER(SendOp), ctypes.c_int32,
             u8p, ctypes.c_int32, i32p]
-        lib.ed_h264_requant_slice.restype = ctypes.c_int32
-        lib.ed_h264_requant_slice.argtypes = [
-            u8p, ctypes.c_int32, u8p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32)]
+        for fname in ("ed_h264_requant_slice",
+                      "ed_h264_requant_slice_cabac"):
+            fn = getattr(lib, fname)
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [
+                u8p, ctypes.c_int32, u8p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32)]
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
@@ -252,9 +256,12 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
                        log2_max_poc_lsb: int, pic_init_qp: int,
                        pps_id: int, deblocking_control: bool,
                        bottom_field_poc: bool, delta_qp: int,
-                       chroma_qp_offset: int = 0
+                       chroma_qp_offset: int = 0,
+                       cabac: bool = False
                        ) -> tuple[bytes, int, int] | None:
-    """Native CAVLC slice requant → (nal, mbs_in_slice, level_blocks);
+    """Native slice requant — CAVLC, or the CABAC walk when
+    ``cabac=True`` (the caller passes the PPS's entropy flag) →
+    (nal, mbs_in_slice, level_blocks);
     level_blocks counts exactly what the Python path batches (17 rows
     per I_16x16 MB, 16 per I_4x4, +8 chroma rows per chroma-bearing MB)
     so RequantStats.blocks is engine-independent.  None = unsupported/
@@ -262,12 +269,14 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
     Python path)."""
     lib = _load()
     assert lib is not None
+    entry = (lib.ed_h264_requant_slice_cabac if cabac
+             else lib.ed_h264_requant_slice)
     src = np.frombuffer(nal, dtype=np.uint8)
     cap = len(nal) * 2 + 256
     out = np.zeros(cap, dtype=np.uint8)
     mbs = ctypes.c_int32(0)
     blocks = ctypes.c_int32(0)
-    n = lib.ed_h264_requant_slice(
+    n = entry(
         _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
         log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
         pps_id, 1 if deblocking_control else 0,
@@ -276,7 +285,7 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
     if n == -3:                      # tiny chance: expansion past 2x
         cap = len(nal) * 4 + 4096
         out = np.zeros(cap, dtype=np.uint8)
-        n = lib.ed_h264_requant_slice(
+        n = entry(
             _u8(src), len(nal), _u8(out), cap, width_mbs, height_mbs,
             log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
             pps_id, 1 if deblocking_control else 0,

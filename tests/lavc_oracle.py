@@ -50,6 +50,15 @@ class LavcH264Decoder:
         if not self.codec:
             raise RuntimeError("lavc has no H.264 decoder")
         self.ctx = self.avc.avcodec_alloc_context3(self.codec)
+        # strict mode: any bitstream error fails the decode instead of
+        # being concealed — the oracle must never paper over a desync
+        self.avu.av_opt_set.restype = ctypes.c_int
+        self.avu.av_opt_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_char_p, ctypes.c_int]
+        if self.avu.av_opt_set(self.ctx, b"err_detect", b"explode",
+                               1) < 0:
+            raise RuntimeError("err_detect=explode not accepted — the "
+                               "oracle would silently conceal desyncs")
         if self.avc.avcodec_open2(self.ctx, self.codec, None) < 0:
             raise RuntimeError("avcodec_open2 failed")
 

@@ -181,3 +181,97 @@ def test_requant_blocks_match_between_entropy_layers():
             rq.transform_nal(n)
         counts[entropy] = rq.stats.blocks
     assert counts["cavlc"] == counts["cabac"] > 0
+
+
+def test_native_cabac_differential():
+    """The native CABAC walk (csrc ed_h264_requant_slice_cabac) must be
+    byte-identical to the Python oracle across sizes, QPs, rung depths,
+    slice counts and chroma presence — same bar the CAVLC walk holds."""
+    from easydarwin_tpu import native
+    if not native.available():
+        pytest.skip("native core unavailable")
+    rng = np.random.default_rng(17)
+    for trial, (size, qp, dq, slices, chroma) in enumerate(
+            [(64, 24, 6, 1, True), (96, 30, 6, 1, True),
+             (64, 20, 12, 2, True), (96, 28, 6, 3, False),
+             (64, 36, 6, 1, True), (96, 24, 18, 1, True),
+             (64, 14, 6, 1, True)]):
+        base = synth_luma(size, trial).astype(np.int64)
+        img = np.clip(base + rng.integers(-9, 10, base.shape), 0, 255) \
+            .astype(np.uint8)
+        kw = dict(entropy="cabac", slices=slices)
+        if chroma:
+            kw.update(cb=img[::2, ::2], cr=img[1::2, 1::2])
+        nals = encode_iframe(img, qp, **kw)
+        rq_py = SliceRequantizer(dq, prefer_native=False)
+        rq_nat = SliceRequantizer(dq)
+        out_py = [rq_py.transform_nal(n) for n in nals]
+        out_nat = [rq_nat.transform_nal(n) for n in nals]
+        assert out_py == out_nat, (trial, size, qp, dq, slices)
+        assert rq_nat.stats.native_slices == rq_py.stats.slices_requantized
+        assert rq_nat.stats.blocks == rq_py.stats.blocks
+        assert rq_nat.stats.slices_passed_through \
+            == rq_py.stats.slices_passed_through
+
+
+@pytest.mark.skipif(not _HAVE_LAVC, reason="libavcodec unavailable")
+def test_native_cabac_output_decodes_in_lavc():
+    img = _img(96, seed=21)
+    nals = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                         entropy="cabac")
+    rq = SliceRequantizer(6)
+    out = [rq.transform_nal(n) for n in nals]
+    if rq.stats.native_slices == 0:
+        pytest.skip("native core unavailable")
+    got = LavcH264Decoder().decode(out, 96, 96)
+    assert got is not None
+    mine = decode_iframe_yuv(out)
+    for a, b in zip(got, mine):
+        assert np.array_equal(a, b)
+
+
+def test_cabac_i16_mixed_slice_differential_and_lavc():
+    """Mixed I_16x16 + I_4x4 CABAC slices (encode_iframe never emits
+    I_16x16, so this is the only coverage of that decode/encode path):
+    native ⇄ Python byte-equal, and libavcodec in strict err_detect=
+    explode mode accepts both the input and the requanted stream."""
+    from test_h264_codec import _mixed_slice
+
+    from easydarwin_tpu import native
+    from easydarwin_tpu.codecs.h264_cabac import CabacSliceCodec
+    from easydarwin_tpu.codecs.h264_intra import SliceHeader
+
+    rng = np.random.default_rng(23)
+    sps = Sps(4, 3, profile_idc=77)
+    pps = Pps(pic_init_qp=26, entropy_cabac=True)
+    qp = 28
+    # reuse the CAVLC helper's MB list, serialize through the CABAC codec
+    _nal_cavlc, mbs = _mixed_slice(rng, Sps(4, 3), Pps(pic_init_qp=26),
+                                   qp, chroma=True)
+    for mb in mbs:
+        if hasattr(mb, "pred_mode"):
+            # the helper randomizes I_16x16 pred modes; V/H/plane at
+            # picture edges reference unavailable samples, which the
+            # strict lavc oracle rightly rejects — DC is always legal
+            # (entropy coding is what this test exercises)
+            mb.pred_mode = 2
+    codec = CabacSliceCodec(sps, pps)
+    nal = codec.write_slice(SliceHeader(qp=qp), 0, mbs, qp)
+    hdr, first, back, _ = codec.parse_slice(nal)
+    assert len(back) == len(mbs)
+
+    rq_py = SliceRequantizer(6, prefer_native=False)
+    rq_py.sps, rq_py.pps = sps, pps
+    out_py = rq_py.transform_nal(nal)
+    assert rq_py.stats.slices_requantized == 1
+    if native.available():
+        rq_nat = SliceRequantizer(6)
+        rq_nat.sps, rq_nat.pps = sps, pps
+        out_nat = rq_nat.transform_nal(nal)
+        assert rq_nat.stats.native_slices == 1
+        assert out_nat == out_py
+        assert rq_nat.stats.blocks == rq_py.stats.blocks
+    if _HAVE_LAVC:
+        for stream in ([sps.build(), pps.build(), nal],
+                       [sps.build(), pps.build(), out_py]):
+            assert LavcH264Decoder().decode(stream, 64, 48) is not None

@@ -155,8 +155,7 @@ async def soak(seconds: float) -> int:
                    + bytes([0x65]) + bytes(120))
             seq_b += 1
             b_sock.sendto(pkt, ("127.0.0.1", b_rtp))
-            if f % 4 == 2:     # ~8 fps CABAC: the Python entropy layer
-                               # is the engine until the native mirror
+            if f % 4 == 2:     # ~8 fps CABAC through the native walk
                 ts_c = int(f * 3000)
                 for nal in cycle_cabac[(f // 4) % 8]:
                     for p in nalu.packetize_h264(
@@ -228,6 +227,8 @@ async def soak(seconds: float) -> int:
             failures.append(
                 f"CABAC slices passed through unrequanted: "
                 f"{q6c.requant.stats}")
+        if q6c is not None and q6c.requant.stats.native_slices == 0:
+            failures.append("native CABAC requant engine unused")
         if tcp_rx[0] < f * 0.5:
             failures.append(f"tcp player starved: {tcp_rx[0]}/{f}")
         if udp_rx[0] < f * 0.5:
