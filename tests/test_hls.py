@@ -537,3 +537,126 @@ async def test_requant_pipeline_parallel_in_order():
     assert (s_a.slices_requantized, s_a.blocks, s_a.bytes_out) \
         == (s_s.slices_requantized, s_s.blocks, s_s.bytes_out)
     assert s_a.slices_passed_through == 0
+
+
+def test_hls_av_fragments_with_audio_track():
+    """An A/V push (H.264 + RFC 3640 AAC) produces two-track CMAF: init
+    carries an mp4a/esds trak + second trex, every media segment muxes
+    a second traf (track 2) whose tfdt advances in lockstep with the
+    included sample durations, audio bytes follow video bytes in the
+    shared mdat, and the SAME audio rides the q6 requant rung unchanged
+    (VERDICT r3 item 4)."""
+    import numpy as np
+
+    from easydarwin_tpu.codecs.h264_intra import encode_iframe
+    from easydarwin_tpu.hls.segmenter import HlsService
+    from easydarwin_tpu.protocol.aac import packetize_aac_hbr
+    from easydarwin_tpu.relay.session import SessionRegistry
+
+    AV_SDP = ("v=0\r\nm=video 0 RTP/AVP 96\r\n"
+              "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n"
+              "m=audio 0 RTP/AVP 97\r\n"
+              "a=rtpmap:97 mpeg4-generic/48000/2\r\n"
+              "a=fmtp:97 streamtype=5; mode=AAC-hbr; config=1190; "
+              "sizeLength=13; indexLength=3; indexDeltaLength=3\r\n"
+              "a=control:trackID=2\r\n")
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/cam_av", AV_SDP)
+    for st in sess.streams.values():
+        st.settings.bucket_delay_ms = 0
+    svc = HlsService(reg, target_duration=0.2)
+    svc.start("/cam_av", ("q6",))
+    src = svc.outputs["/cam_av"].renditions[""]
+    q6 = svc.outputs["/cam_av"].renditions["q6"]
+    assert src.audio is not None and src.audio.sample_rate == 48000
+
+    n = 96
+    from easydarwin_tpu.utils.synth import synth_luma
+    vseq = aseq = 0
+    rng = np.random.default_rng(3)
+    for f in range(10):
+        img = synth_luma(n, f)
+        ts = int(f * 90000 / 30)
+        for nal in encode_iframe(img, 24, frame_num=0, idr_pic_id=f % 2):
+            for p in nalu.packetize_h264(nal, seq=vseq, timestamp=ts,
+                                         ssrc=1,
+                                         marker_on_last=(nal[0] & 0x1F
+                                                         == 5)):
+                vseq += 1
+                sess.push(1, p, t_ms=1000 + f * 33)
+        # ~1.5 AAC frames per video frame at 48 kHz / 30 fps
+        for j in range(2 if f % 2 else 1):
+            au = bytes(rng.integers(0, 256, 120, dtype=np.uint8))
+            sess.push(2, packetize_aac_hbr(
+                au, seq=aseq, timestamp=int(aseq * 1024) & 0xFFFFFFFF,
+                ssrc=2), t_ms=1000 + f * 33)
+            aseq += 1
+        for st in sess.streams.values():
+            st.reflect(1000 + f * 33)
+
+    for out in (src, q6):
+        assert out.init_segment is not None
+        assert b"mp4a" in out.init_segment
+        assert b"esds" in out.init_segment
+        assert out.init_segment.count(b"trex") == 2
+        assert out.segments and out.audio_samples_muxed > 0
+        assert "mp4a.40.2" in out.codec_string()
+
+        # walk each segment: two trafs, audio tfdt lockstep
+        expect_tfdt = None
+        for seg in out.segments:
+            d = seg.data
+            assert d.count(b"traf") == 2
+            # audio traf is the second: find both tfdt payloads
+            tfdts = []
+            truns = []
+            pos = 0
+            while True:
+                i = d.find(b"tfdt", pos)
+                if i < 0:
+                    break
+                tfdts.append(struct.unpack_from(">Q", d, i + 8)[0])
+                pos = i + 4
+            pos = 0
+            while True:
+                i = d.find(b"trun", pos)
+                if i < 0:
+                    break
+                cnt, off = struct.unpack_from(">Ii", d, i + 8)
+                rows = [struct.unpack_from(">III", d, i + 16 + 12 * r)
+                        for r in range(cnt)]
+                truns.append((cnt, off, rows))
+                pos = i + 4
+            assert len(tfdts) == 2 and len(truns) == 2
+            v_cnt, v_off, v_rows = truns[0]
+            a_cnt, a_off, a_rows = truns[1]
+            assert v_cnt > 0 and a_cnt > 0
+            # audio data directly follows video data in the mdat
+            assert a_off == v_off + sum(r[1] for r in v_rows)
+            if expect_tfdt is not None:
+                assert tfdts[1] == expect_tfdt
+            expect_tfdt = tfdts[1] + sum(r[0] for r in a_rows)
+            # mdat big enough for both tracks
+            mdat_at = d.find(b"mdat")
+            mdat_size = struct.unpack_from(">I", d, mdat_at - 4)[0] - 8
+            assert mdat_size == sum(r[1] for r in v_rows) \
+                + sum(r[1] for r in a_rows)
+
+    # the q6 rung carries the SAME audio bytes as the source rendition
+    def audio_bytes(out):
+        total = b""
+        for seg in out.segments:
+            d = seg.data
+            # second trun rows give sizes; audio bytes are the mdat tail
+            mdat_at = d.find(b"mdat")
+            pos = d.find(b"trun")
+            pos = d.find(b"trun", pos + 4)
+            cnt, _ = struct.unpack_from(">Ii", d, pos + 8)
+            asize = sum(struct.unpack_from(">III", d, pos + 16 + 12 * r)[1]
+                        for r in range(cnt))
+            total += d[len(d) - asize:]   # audio bytes are the mdat tail
+        return total
+
+    assert audio_bytes(src) == audio_bytes(q6)
+    master = svc.master_playlist(svc.outputs["/cam_av"])
+    assert "mp4a.40.2" in master
