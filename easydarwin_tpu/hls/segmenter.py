@@ -238,6 +238,18 @@ class HlsOutput(RelayOutput):
         sync it against yet)."""
         if self.audio is None or self._seg_start_ts is None:
             return
+        if self._audio_prev_ts is None and not self._audio_pending \
+                and self._audio_dts == 0:
+            # anchor the audio tfdt timeline to the video position NOW,
+            # mapped into the audio timescale: video tfdt carries raw
+            # source RTP timestamps (random origin per RFC 3550), so a
+            # zero-based audio track would present up to 2^32/90k sec
+            # away from it.  First-AU arrival jitter bounds the residual
+            # offset to ~a frame; an SR-correlated mapping can tighten
+            # it later.
+            ref = self._last_ts if self._last_ts is not None \
+                else self._seg_start_ts
+            self._audio_dts = ref * self.audio.sample_rate // VIDEO_CLOCK
         self._audio_pending.append((data, ts))
         # bounded like every other buffer here: cuts are video-driven,
         # so a stalled video track must shed audio, not hoard it

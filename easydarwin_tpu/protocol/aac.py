@@ -131,7 +131,13 @@ class AacDepacketizer:
             return []
         hdr_bits_total = (p[0] << 8) | p[1]
         hdr_bits = cfg.sizelength + cfg.indexlength
-        n_aus = max(1, hdr_bits_total // max(hdr_bits, 1))
+        if hdr_bits_total < hdr_bits:
+            # a zero/short AU-headers-length would make us parse media
+            # bytes as a header — and a garbage size can wedge the
+            # fragment state into eating subsequent valid AUs
+            self.errors += 1
+            return []
+        n_aus = hdr_bits_total // hdr_bits
         hdr_bytes = (hdr_bits_total + 7) // 8
         if len(p) < 2 + hdr_bytes:
             self.errors += 1

@@ -60,6 +60,12 @@ class RelayOutput:
         #: None = plain RTP).  Wrapping covers both the scalar write_rtp
         #: path and the TPU engine's send_rewritten path.
         self.meta_field_ids: dict[str, int] | None = None
+        #: per-packet context for the ft/pn meta fields — the VOD pacer
+        #: sets them from its sample tables before each send (the live
+        #: relay has no packetizer context; those grants stay tt/sq/md)
+        self.meta_frame_type: int | None = None
+        self.meta_packet_number: int | None = None
+        self.meta_packet_position: int | None = None
         self.packets_sent = 0
         self.bytes_sent = 0
         #: RTP payload octets only (no 12-byte header, no meta-info wrap) —
@@ -108,7 +114,11 @@ class RelayOutput:
         return rtp_meta.build_packet(
             header, media=payload, field_ids=ids,
             transmit_time=int(time.time() * 1000) if "tt" in ids else None,
-            seq=rtp.peek_seq(header) if "sq" in ids else None)
+            seq=rtp.peek_seq(header) if "sq" in ids else None,
+            frame_type=self.meta_frame_type if "ft" in ids else None,
+            packet_number=self.meta_packet_number if "pn" in ids else None,
+            packet_position=self.meta_packet_position
+            if "pp" in ids else None)
 
     # -- relay-facing API --------------------------------------------------
     def write_rtp(self, packet: bytes) -> WriteResult:

@@ -16,12 +16,69 @@ from __future__ import annotations
 
 import io
 import struct
+import mmap
+import os
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
 _CONTAINERS = {b"moov", b"trak", b"mdia", b"minf", b"stbl", b"edts",
                b"udta", b"dinf", b"tref"}
+
+
+_SHARED_LOCK = threading.Lock()
+_DETACHED = object()                   # replaced-on-disk, still-referenced
+_SHARED: "dict[str, Mp4File]" = {}     # path -> parsed instance (refs>=0)
+_SHARED_IDLE_KEEP = 8                  # parsed files kept warm at 0 refs
+
+
+def open_shared(path: str) -> "Mp4File":
+    """Refcounted shared instance per (path, mtime, size): concurrent
+    players of one file share the parse and the mapping; a replaced
+    file (changed stat) gets a fresh instance while old readers keep
+    their old mapping until release."""
+    st = os.stat(path)
+    key = (st.st_size, st.st_mtime_ns)
+    with _SHARED_LOCK:
+        f = _SHARED.get(path)
+        if f is not None and f.stat_key == key:
+            f._refs += 1
+            return f
+    fresh = Mp4File(path)              # parse outside the lock
+    fresh._shared_key = path
+    with _SHARED_LOCK:
+        cur = _SHARED.get(path)
+        if cur is not None and cur.stat_key == key:
+            cur._refs += 1             # raced: adopt the winner
+            fresh._shared_key = None
+            fresh._close_now()
+            return cur
+        if cur is not None and cur._refs == 0:
+            cur._shared_key = None
+            cur._close_now()           # stale, unreferenced: evict now
+        elif cur is not None:
+            # stale but in use: detach from the by-path table, but KEEP
+            # refcounted closing (a bare _shared_key=None would make the
+            # FIRST holder's close() unmap under the others' reads)
+            cur._shared_key = _DETACHED
+        _SHARED[path] = fresh
+        fresh._refs = 1
+        return fresh
+
+
+def _release_shared(f: "Mp4File") -> None:
+    with _SHARED_LOCK:
+        f._refs -= 1
+        if f._refs > 0:
+            return
+        # keep a few warm for reopen bursts; evict beyond the cap
+        idle = [p for p, v in _SHARED.items() if v._refs == 0]
+        while len(idle) > _SHARED_IDLE_KEEP:
+            victim = idle.pop(0)
+            v = _SHARED.pop(victim)
+            v._shared_key = None
+            v._close_now()
 
 
 class Mp4Error(ValueError):
@@ -133,29 +190,70 @@ class Track:
 
 
 class Mp4File:
+    """Parsed movie + mmap-backed sample reader.
+
+    The reference keeps an FD cache because hundreds of concurrent VOD
+    readers hammer buffered file IO (``OSFileSource.cpp:634``); here the
+    sample data path is a shared read-only ``mmap`` instead — sample
+    reads are stateless slices (no per-reader seek cursor, no per-reader
+    buffer), and the parse-time file object is closed right after
+    mapping, so N concurrent players of one file cost ONE parse, ONE
+    mapping and ONE descriptor (the mapping's own dup).
+    ``open_shared``/``close`` refcount one parsed instance per
+    (path, mtime, size) — the FD-cache role, modernized."""
+
     def __init__(self, path: str):
         self.path = path
+        self._refs = 0                 # managed by open_shared/close
+        self._shared_key = None
         self._f = open(path, "rb")
-        self._f.seek(0, 2)
-        size = self._f.tell()
-        self.boxes = _scan(self._f, 0, size)
-        moov = next((b for b in self.boxes if b.kind == b"moov"), None)
-        if moov is None:
-            raise Mp4Error("no moov box")
-        self.timescale, self.duration = self._parse_mvhd(moov)
-        self.tracks: list[Track] = []
-        for trak in moov.find_all(b"trak"):
-            t = self._parse_trak(trak)
-            if t is not None:
-                self.tracks.append(t)
+        try:
+            st = os.fstat(self._f.fileno())
+            self.stat_key = (st.st_size, st.st_mtime_ns)
+            size = st.st_size
+            if size == 0:
+                raise Mp4Error("empty file")
+            self._mm = mmap.mmap(self._f.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+            self.boxes = _scan(self._f, 0, size)
+            moov = next((b for b in self.boxes if b.kind == b"moov"),
+                        None)
+            if moov is None:
+                raise Mp4Error("no moov box")
+            self.timescale, self.duration = self._parse_mvhd(moov)
+            self.tracks: list[Track] = []
+            for trak in moov.find_all(b"trak"):
+                t = self._parse_trak(trak)
+                if t is not None:
+                    self.tracks.append(t)
+        finally:
+            self._f.close()            # the mapping keeps the pages alive
+            self._f = None
 
     def close(self):
-        self._f.close()
+        if self._shared_key is _DETACHED:
+            with _SHARED_LOCK:
+                self._refs -= 1
+                if self._refs > 0:
+                    return
+            self._close_now()          # genuinely the last holder
+            return
+        if self._shared_key is not None:
+            _release_shared(self)
+            return
+        self._close_now()
+
+    def _close_now(self):
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
 
     # -- readers -----------------------------------------------------------
     def _read_at(self, off: int, n: int) -> bytes:
-        self._f.seek(off)
-        return self._f.read(n)
+        if self._f is not None:        # during parse
+            self._f.seek(off)
+            return self._f.read(n)
+        return bytes(self._mm[off:off + n])
 
     def _full(self, box: Box) -> bytes:
         off, n = box.body

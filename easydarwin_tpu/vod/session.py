@@ -15,6 +15,8 @@ import os
 import time
 
 from ..protocol import rtp
+from ..protocol.rtp_meta import FRAME_KEY, FRAME_P
+from ..relay.quality import PacketFlags
 from ..relay.output import RelayOutput, WriteResult
 from .mp4 import Mp4Error, Mp4File
 from .packetizer import AacPacketizer, H264Packetizer, sdp_for_file
@@ -38,6 +40,11 @@ class FileSession:
         self._pending: dict[int, list[bytes]] = {}
         self._task: asyncio.Task | None = None
         self.packets_sent = 0
+        #: frames shed by quality adaptation (RTPStream thinning on the
+        #: VOD path: RR loss / NADU feedback raises the output's level,
+        #: the pacer consults it per sample — graceful frame-drop
+        #: instead of tail-drop, VERDICT r3 item 6)
+        self.frames_thinned = 0
         self.done = False
         track_no = 0
         v = file.video_track()
@@ -127,6 +134,12 @@ class FileSession:
     async def run(self) -> None:
         t0 = time.monotonic() - self.start_npt / self.speed
         self._pending_npt: dict[int, float] = {}
+        #: x-RTP-Meta-Info context: per-track running packet number and
+        #: the current sample's (frame type, file position) — the
+        #: packetizer context DSS fills ft/pn/pp from (RTPMetaInfoLib;
+        #: VERDICT r3 item 9)
+        self._meta_pn: dict[int, int] = {}
+        self._pending_meta: dict[int, tuple[int | None, int]] = {}
         #: per track: (rtp_ts of newest sent packet, wall time it was sent)
         self._sr_ref: dict[int, tuple[int, float]] = {}
         self._last_sr: dict[int, float] = {}
@@ -150,7 +163,22 @@ class FileSession:
             if not self._pending[tid]:
                 tr = self._track_of(tid)
                 cur = self._cursors[tid]
+                out0 = self.outputs[tid]
+                if tr.info.handler == "vide" \
+                        and not out0.thinning.passthrough():
+                    flags = (PacketFlags.VIDEO | PacketFlags.FRAME_FIRST
+                             | (PacketFlags.KEYFRAME_FIRST
+                                if bool(tr.sync[cur]) else 0))
+                    if not out0.thinning.admit(flags):
+                        self._cursors[tid] = cur + 1
+                        self.frames_thinned += 1
+                        continue
                 data = self.file.read_sample(tr, cur)
+                if tr.info.handler == "vide":
+                    ftype = FRAME_KEY if bool(tr.sync[cur]) else FRAME_P
+                else:
+                    ftype = None
+                self._pending_meta[tid] = (ftype, int(tr.offsets[cur]))
                 pkts = self._packetizers[tid].packetize_sample(data, cur)
                 if self.ts_scale != 1.0:
                     pkts = [rtp.rewrite_header(
@@ -164,7 +192,14 @@ class FileSession:
             q = self._pending[tid]
             last_sent = None
             while q:
-                res = out.send_bytes(q[0], is_rtcp=False)
+                wire = q[0]
+                if out.meta_field_ids is not None:
+                    ftype, fpos = self._pending_meta.get(tid, (None, 0))
+                    out.meta_frame_type = ftype
+                    out.meta_packet_position = fpos
+                    out.meta_packet_number = self._meta_pn.get(tid, 0)
+                    wire = out._wrap_meta(wire[:12], wire[12:])
+                res = out.send_bytes(wire, is_rtcp=False)
                 if res is WriteResult.WOULD_BLOCK:
                     await asyncio.sleep(0.02)      # bookmark: retry same pkt
                     break
@@ -172,6 +207,7 @@ class FileSession:
                 if res is WriteResult.OK:
                     out.packets_sent += 1
                     self.packets_sent += 1
+                    self._meta_pn[tid] = self._meta_pn.get(tid, 0) + 1
                     last_sent = pkt
                     self._sr_pkts[tid] = self._sr_pkts.get(tid, 0) + 1
                     self._sr_octets[tid] = (self._sr_octets.get(tid, 0)
@@ -220,7 +256,8 @@ class VodService:
         if fp is None:
             return None
         try:
-            return Mp4File(fp)
+            from .mp4 import open_shared
+            return open_shared(fp)
         except (Mp4Error, OSError):
             return None
 

@@ -660,3 +660,62 @@ def test_hls_av_fragments_with_audio_track():
     assert audio_bytes(src) == audio_bytes(q6)
     master = svc.master_playlist(svc.outputs["/cam_av"])
     assert "mp4a.40.2" in master
+
+
+def test_hls_av_timeline_alignment_nonzero_origins():
+    """Real sources start RTP timestamps at random origins (RFC 3550);
+    the audio tfdt must anchor to the video position mapped into the
+    audio timescale, or players present the tracks hours apart."""
+    import numpy as np
+
+    from easydarwin_tpu.codecs.h264_intra import encode_iframe
+    from easydarwin_tpu.hls.segmenter import HlsService
+    from easydarwin_tpu.protocol.aac import packetize_aac_hbr
+    from easydarwin_tpu.relay.session import SessionRegistry
+    from easydarwin_tpu.utils.synth import synth_luma
+
+    AV_SDP = ("v=0\r\nm=video 0 RTP/AVP 96\r\n"
+              "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n"
+              "m=audio 0 RTP/AVP 97\r\n"
+              "a=rtpmap:97 mpeg4-generic/48000/2\r\n"
+              "a=fmtp:97 mode=AAC-hbr; config=1190; sizeLength=13; "
+              "indexLength=3; indexDeltaLength=3\r\n"
+              "a=control:trackID=2\r\n")
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/xorig", AV_SDP)
+    for st in sess.streams.values():
+        st.settings.bucket_delay_ms = 0
+    svc = HlsService(reg, target_duration=0.2)
+    svc.start("/xorig", ())
+    R_V, R_A = 1234567890, 987654321
+    vseq = aseq = 0
+    for f in range(8):
+        img = synth_luma(64, f)
+        ts = (R_V + int(f * 90000 / 30)) & 0xFFFFFFFF
+        for nal in encode_iframe(img, 24):
+            for p in nalu.packetize_h264(nal, seq=vseq, timestamp=ts,
+                                         ssrc=1,
+                                         marker_on_last=(nal[0] & 0x1F
+                                                         == 5)):
+                vseq += 1
+                sess.push(1, p, t_ms=1000 + f * 33)
+        sess.push(2, packetize_aac_hbr(
+            b"\xaa" * 80, seq=aseq,
+            timestamp=(R_A + aseq * 1024) & 0xFFFFFFFF, ssrc=2),
+            t_ms=1000 + f * 33)
+        aseq += 1
+        for st in sess.streams.values():
+            st.reflect(1000 + f * 33)
+    out = svc.outputs["/xorig"].renditions[""]
+    assert out.segments
+    d = out.segments[0].data
+    tfdts = []
+    pos = 0
+    while True:
+        i = d.find(b"tfdt", pos)
+        if i < 0:
+            break
+        tfdts.append(struct.unpack_from(">Q", d, i + 8)[0])
+        pos = i + 4
+    assert len(tfdts) == 2
+    assert abs(tfdts[0] / 90000 - tfdts[1] / 48000) < 0.5

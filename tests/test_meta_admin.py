@@ -202,3 +202,80 @@ def test_meta_wrap_covers_socket_send_rewritten_paths():
     assert info is not None and info.media == tail
     assert info.seq == 0x1234           # seq of the packet as sent
     assert rtp_meta.strip_to_rtp(pkt, ids) == header + tail
+
+
+async def test_vod_meta_info_ft_pn_pp(tmp_path):
+    """VOD fills the full DSS meta-info field set from its sample tables
+    (VERDICT r3 item 9): ft = KEY on sync samples / P otherwise, pn a
+    per-track running packet number, pp the sample's file position —
+    granted on a VOD SETUP and verified on the wire format."""
+    import asyncio
+
+    from test_vod import write_fixture
+
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.vod.mp4 import open_shared
+    from easydarwin_tpu.vod.session import FileSession
+
+    path = write_fixture(str(tmp_path / "m.mp4"), n_frames=12,
+                         with_audio=False)
+    f = open_shared(path)
+    out = CollectingOutput(ssrc=7, out_seq_start=0)
+    ids = {"tt": 0, "ft": 1, "pn": 2, "sq": 3, "pp": 4,
+           "md": rtp_meta.UNCOMPRESSED}
+    out.meta_field_ids = ids
+    sess = FileSession(f, {1: out}, speed=100.0)
+    sess.start()
+    for _ in range(200):
+        if sess.done:
+            break
+        await asyncio.sleep(0.02)
+    assert sess.done and out.rtp_packets
+    tr = f.video_track()
+    seen_key = seen_p = False
+    last_pn = -1
+    offsets = {int(o) for o in tr.offsets}
+    for raw in out.rtp_packets:
+        info = rtp_meta.parse_packet(raw, ids)
+        assert info is not None and info.media
+        assert info.frame_type in (rtp_meta.FRAME_KEY, rtp_meta.FRAME_P)
+        seen_key |= info.frame_type == rtp_meta.FRAME_KEY
+        seen_p |= info.frame_type == rtp_meta.FRAME_P
+        assert info.packet_number == last_pn + 1      # running number
+        last_pn = info.packet_number
+        assert info.packet_position in offsets        # sample file pos
+        assert info.seq is not None and info.transmit_time is not None
+    assert seen_key and seen_p
+    f.close()
+
+
+async def test_vod_setup_grants_ft_pn(tmp_path):
+    """The VOD SETUP answers an x-RTP-Meta-Info request with ft/pn/pp
+    granted (the live relay grants only tt/sq/md)."""
+    from test_vod import write_fixture
+
+    from easydarwin_tpu.server.app import StreamingServer
+    from easydarwin_tpu.server.config import ServerConfig
+    from easydarwin_tpu.utils.client import RtspClient
+
+    write_fixture(str(tmp_path / "clip.mp4"))
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       movie_folder=str(tmp_path))
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        cl = RtspClient()
+        await cl.connect("127.0.0.1", app.rtsp.port)
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/clip.mp4"
+        r = await cl.request("DESCRIBE", uri, {"accept": "application/sdp"})
+        assert r.status == 200
+        r = await cl.request("SETUP", f"{uri}/trackID=1", {
+            "transport": "RTP/AVP/TCP;unicast;interleaved=0-1",
+            "x-RTP-Meta-Info": "tt;ft;pn;pp;sq;md"})
+        assert r.status == 200
+        granted = rtp_meta.parse_header(
+            r.headers.get("x-rtp-meta-info", ""))
+        assert set(granted) == {"tt", "ft", "pn", "pp", "sq", "md"}
+        await cl.close()
+    finally:
+        await app.stop()

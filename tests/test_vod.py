@@ -259,3 +259,81 @@ async def test_vod_negative_scale_ignored(tmp_path):
         await c.close()
     finally:
         await app.stop()
+
+
+def test_shared_source_32_players_bounded_fds(fixture_mp4):
+    """32 concurrent players of ONE file share a single parsed instance
+    and a single mapping, with NO held file descriptors (the mapping
+    outlives its fd) — the OSFileSource FD-cache role (VERDICT r3
+    item 6), modernized."""
+    from easydarwin_tpu.vod.mp4 import open_shared
+
+    def open_fds_on(path):
+        fd_dir = "/proc/self/fd"
+        n = 0
+        for fd in os.listdir(fd_dir):
+            try:
+                if os.readlink(f"{fd_dir}/{fd}") == path:
+                    n += 1
+            except OSError:
+                pass
+        return n
+
+    files = [open_shared(fixture_mp4) for _ in range(32)]
+    assert len({id(f) for f in files}) == 1       # one parse, one mapping
+    # CPython's mmap dups the fd internally: 32 players cost exactly ONE
+    # descriptor (the mapping's), not 32 buffered files
+    assert open_fds_on(fixture_mp4) == 1
+    tr = files[0].video_track()
+    datas = {files[i].read_sample(tr, 0) for i in range(32)}
+    assert len(datas) == 1
+    for f in files:
+        f.close()
+    # still warm (kept for reopen bursts) and reusable
+    again = open_shared(fixture_mp4)
+    assert again is files[0]
+    again.close()
+    # a REPLACED file (stat change) gets a fresh parse
+    os.utime(fixture_mp4, ns=(1, 1))
+    fresh = open_shared(fixture_mp4)
+    assert fresh is not files[0]
+    fresh.close()
+
+
+async def test_vod_thinning_frame_drop_not_tail_drop(fixture_mp4):
+    """A congested VOD client gets frame-granular shedding: RR loss
+    raises the output's quality level, the pacer consults thinning per
+    sample — non-sync video frames drop, sync frames and audio flow
+    (RTPStream.h:144-174 semantics on the VOD path)."""
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.vod.mp4 import open_shared
+    from easydarwin_tpu.vod.session import FileSession
+
+    f = open_shared(fixture_mp4)
+    v_out = CollectingOutput(ssrc=1, out_seq_start=0)
+    a_out = CollectingOutput(ssrc=2, out_seq_start=0)
+    # sustained loss reports raise the level (the live feedback path)…
+    for _ in range(8):
+        v_out.on_receiver_report(0.4)
+    assert v_out.thinning.controller.level >= 1
+    # …then pin keyframes-only for a deterministic assertion
+    v_out.thinning.controller.level = 2
+    sess = FileSession(f, {1: v_out, 2: a_out}, speed=100.0)
+    sess.start()
+    for _ in range(200):
+        if sess.done:
+            break
+        await asyncio.sleep(0.02)
+    assert sess.done
+    assert sess.frames_thinned > 0
+    # every delivered video packet belongs to an IDR sample (fixture
+    # IDRs are 201 bytes + FU overhead vs 81-byte P frames)
+    assert v_out.rtp_packets, "keyframes must still flow"
+    for p in v_out.rtp_packets:
+        t = p[12] & 0x1F
+        if t == 28:                         # FU-A: inner type
+            t = p[13] & 0x1F
+        assert t in (5, 7, 8), f"non-IDR slice leaked (nal {t})"
+    # audio unaffected: all 30 samples arrive
+    assert len(a_out.rtp_packets) == 30
+    f.close()
