@@ -131,10 +131,11 @@ class AacDepacketizer:
             return []
         hdr_bits_total = (p[0] << 8) | p[1]
         hdr_bits = cfg.sizelength + cfg.indexlength
-        if hdr_bits_total < hdr_bits:
-            # a zero/short AU-headers-length would make us parse media
-            # bytes as a header — and a garbage size can wedge the
-            # fragment state into eating subsequent valid AUs
+        if hdr_bits <= 0 or hdr_bits_total < hdr_bits:
+            # a zero/short AU-headers-length (or a malicious fmtp with
+            # sizelength=0) would make us parse media bytes as a header
+            # — and a garbage size can wedge the fragment state into
+            # eating subsequent valid AUs
             self.errors += 1
             return []
         n_aus = hdr_bits_total // hdr_bits

@@ -60,12 +60,6 @@ class RelayOutput:
         #: None = plain RTP).  Wrapping covers both the scalar write_rtp
         #: path and the TPU engine's send_rewritten path.
         self.meta_field_ids: dict[str, int] | None = None
-        #: per-packet context for the ft/pn meta fields — the VOD pacer
-        #: sets them from its sample tables before each send (the live
-        #: relay has no packetizer context; those grants stay tt/sq/md)
-        self.meta_frame_type: int | None = None
-        self.meta_packet_number: int | None = None
-        self.meta_packet_position: int | None = None
         self.packets_sent = 0
         self.bytes_sent = 0
         #: RTP payload octets only (no 12-byte header, no meta-info wrap) —
@@ -95,11 +89,14 @@ class RelayOutput:
         from offset 12.  Default concatenates; socket-backed outputs override
         with vectored I/O so the shared payload is never copied."""
         if self.meta_field_ids is not None:
-            return self.send_bytes(self._wrap_meta(header, tail),
+            return self.send_bytes(self.wrap_meta(header, tail),
                                    is_rtcp=False)
         return self.send_bytes(header + tail, is_rtcp=False)
 
-    def _wrap_meta(self, header: bytes, payload: bytes) -> bytes:
+    def wrap_meta(self, header: bytes, payload: bytes, *,
+                  frame_type: int | None = None,
+                  packet_number: int | None = None,
+                  packet_position: int | None = None) -> bytes:
         """RTP → x-RTP-Meta-Info packet with the negotiated fields
         (reference: RTPStream's meta-info send path, RTPMetaInfoLib).
 
@@ -115,10 +112,9 @@ class RelayOutput:
             header, media=payload, field_ids=ids,
             transmit_time=int(time.time() * 1000) if "tt" in ids else None,
             seq=rtp.peek_seq(header) if "sq" in ids else None,
-            frame_type=self.meta_frame_type if "ft" in ids else None,
-            packet_number=self.meta_packet_number if "pn" in ids else None,
-            packet_position=self.meta_packet_position
-            if "pp" in ids else None)
+            frame_type=frame_type if "ft" in ids else None,
+            packet_number=packet_number if "pn" in ids else None,
+            packet_position=packet_position if "pp" in ids else None)
 
     # -- relay-facing API --------------------------------------------------
     def write_rtp(self, packet: bytes) -> WriteResult:
@@ -134,7 +130,7 @@ class RelayOutput:
             timestamp=rw.map_ts(rtp.peek_timestamp(packet)),
             ssrc=rw.ssrc)
         if self.meta_field_ids is not None:
-            out = self._wrap_meta(out[:12], out[12:])
+            out = self.wrap_meta(out[:12], out[12:])
         res = self.send_bytes(out, is_rtcp=False)
         if res is WriteResult.OK:
             self.packets_sent += 1

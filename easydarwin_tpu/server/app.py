@@ -328,6 +328,18 @@ class StreamingServer:
         if path.lower().endswith(".mp3"):
             await self.mp3.stream(conn.writer, path, headers)
             return True
+        if path.lower().endswith(".m3u"):
+            # directory scan + per-file ID3 probes are blocking IO —
+            # keep them off the shared event loop
+            pl = await asyncio.to_thread(self.mp3.playlist, path)
+            if pl is not None:
+                body = pl.encode()
+                conn.writer.write(
+                    b"HTTP/1.0 200 OK\r\n"
+                    b"Content-Type: audio/x-mpegurl\r\n"
+                    b"Content-Length: " + str(len(body)).encode()
+                    + b"\r\n\r\n" + body)
+                return True
         if path in ("/", "/stats"):
             html = self.rest._webstats_html().encode()
             conn.writer.write(

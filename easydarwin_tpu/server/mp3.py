@@ -3,7 +3,11 @@
 Reference parity: ``QTSSMP3StreamingModule.cpp`` (2.9K LoC): HTTP GET of an
 .mp3 path on the RTSP port answers an icy (Shoutcast) stream — paced at the
 file's bitrate, with ``icy-metaint`` StreamTitle metadata blocks when the
-client sent ``Icy-MetaData: 1``.
+client sent ``Icy-MetaData: 1``.  StreamTitle comes from the file's ID3v2
+TIT2/TPE1 frames when present (``Artist - Title``, the module's
+ParseId3Tags role); a GET of a directory (or ``<dir>.m3u``) answers an
+``audio/x-mpegurl`` listing of its .mp3 files — the playlist-brokering
+half of the module.
 """
 
 from __future__ import annotations
@@ -17,6 +21,54 @@ _BITRATES = (0, 32, 40, 48, 56, 64, 80, 96, 112, 128, 160, 192, 224, 256,
 _SAMPLE_RATES = (44100, 48000, 32000, 0)
 
 META_INT = 8192
+
+
+def parse_id3_title(data: bytes) -> str | None:
+    """ID3v2.3/2.4 TIT2 (+TPE1) → ``Artist - Title`` (None = no tag).
+
+    Handles the common encodings (latin-1, utf-16 w/BOM, utf-8) and
+    syncsafe v2.4 frame sizes; anything malformed degrades to None and
+    the caller falls back to the filename."""
+    if len(data) < 10 or data[:3] != b"ID3":
+        return None
+    ver = data[3]
+    tag_size = ((data[6] & 0x7F) << 21) | ((data[7] & 0x7F) << 14) | \
+        ((data[8] & 0x7F) << 7) | (data[9] & 0x7F)
+    end = min(10 + tag_size, len(data))
+    pos = 10
+    fields: dict[str, str] = {}
+    while pos + 10 <= end:
+        fid = data[pos:pos + 4]
+        if not fid.strip(b"\x00"):
+            break
+        raw = data[pos + 4:pos + 8]
+        if ver >= 4:                     # v2.4: syncsafe frame size
+            fsize = ((raw[0] & 0x7F) << 21) | ((raw[1] & 0x7F) << 14) | \
+                ((raw[2] & 0x7F) << 7) | (raw[3] & 0x7F)
+        else:
+            fsize = int.from_bytes(raw, "big")
+        body = data[pos + 10:pos + 10 + fsize]
+        pos += 10 + fsize
+        if fid not in (b"TIT2", b"TPE1") or not body:
+            continue
+        enc, text = body[0], body[1:]
+        try:
+            if enc == 0:
+                val = text.decode("latin-1")
+            elif enc == 1:
+                val = text.decode("utf-16")
+            elif enc == 2:
+                val = text.decode("utf-16-be")
+            else:
+                val = text.decode("utf-8")
+        except UnicodeDecodeError:
+            continue
+        fields[fid.decode()] = val.rstrip("\x00").strip()
+    title = fields.get("TIT2")
+    if not title:
+        return None
+    artist = fields.get("TPE1")
+    return f"{artist} - {title}" if artist else title
 
 
 def parse_mp3_bitrate(data: bytes) -> int:
@@ -39,13 +91,43 @@ class Mp3Service:
         self.movie_folder = movie_folder
         self.streams_served = 0
 
+    def playlist(self, path: str) -> str | None:
+        """``/dir`` or ``/dir.m3u`` → an m3u listing of the directory's
+        .mp3 files (the module's playlist-brokering half); None = not a
+        listable directory."""
+        rel = path.lstrip("/")
+        if rel.lower().endswith(".m3u"):
+            rel = rel[:-4]
+        cand = os.path.normpath(os.path.join(self.movie_folder, rel))
+        root = os.path.normpath(self.movie_folder)
+        # separator-suffixed containment (relay/source.py precedent): a
+        # bare prefix check lets /media escape into /media_private
+        if cand != root and not cand.startswith(root + os.sep):
+            return None
+        if not os.path.isdir(cand):
+            return None
+        names = sorted(n for n in os.listdir(cand)
+                       if n.lower().endswith(".mp3"))
+        base = "/" + os.path.relpath(cand, root).replace(os.sep, "/")
+        if base == "/.":
+            base = ""
+        lines = ["#EXTM3U"]
+        for n in names:
+            with open(os.path.join(cand, n), "rb") as f:
+                title = parse_id3_title(f.read(128 * 1024)) \
+                    or os.path.splitext(n)[0]
+            lines.append(f"#EXTINF:-1,{title}")
+            lines.append(f"{base}/{n}")
+        return "\n".join(lines) + "\n"
+
     def resolve(self, path: str) -> str | None:
         if not path.lower().endswith(".mp3"):
             return None
         cand = os.path.normpath(
             os.path.join(self.movie_folder, path.lstrip("/")))
         root = os.path.normpath(self.movie_folder)
-        if not cand.startswith(root) or not os.path.isfile(cand):
+        if not cand.startswith(root + os.sep) \
+                or not os.path.isfile(cand):
             return None
         return cand
 
@@ -58,7 +140,10 @@ class Mp3Service:
             writer.write(b"HTTP/1.0 404 Not Found\r\n\r\n")
             return
         want_meta = headers.get("icy-metadata", "0").strip() == "1"
-        title = os.path.splitext(os.path.basename(fp))[0]
+        with open(fp, "rb") as probe:
+            head_bytes = probe.read(128 * 1024)
+        title = parse_id3_title(head_bytes) \
+            or os.path.splitext(os.path.basename(fp))[0]
         head = ["ICY 200 OK", "icy-name: easydarwin-tpu",
                 "Content-Type: audio/mpeg", "icy-pub: 0"]
         if want_meta:

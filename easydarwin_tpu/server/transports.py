@@ -51,7 +51,7 @@ class InterleavedOutput(RelayOutput):
 
     def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
         if self.meta_field_ids is not None:     # negotiated meta-info wrap
-            return self.send_bytes(self._wrap_meta(header, tail),
+            return self.send_bytes(self.wrap_meta(header, tail),
                                    is_rtcp=False)
         return self._send(self.rtp_channel, (header, tail))
 
@@ -80,7 +80,7 @@ class UdpOutput(RelayOutput):
 
     def send_rewritten(self, header: bytes, tail: bytes) -> WriteResult:
         if self.meta_field_ids is not None:     # negotiated meta-info wrap
-            return self.send_bytes(self._wrap_meta(header, tail),
+            return self.send_bytes(self.wrap_meta(header, tail),
                                    is_rtcp=False)
         if self.rtp_transport.is_closing():
             return WriteResult.ERROR

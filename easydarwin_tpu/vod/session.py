@@ -195,10 +195,10 @@ class FileSession:
                 wire = q[0]
                 if out.meta_field_ids is not None:
                     ftype, fpos = self._pending_meta.get(tid, (None, 0))
-                    out.meta_frame_type = ftype
-                    out.meta_packet_position = fpos
-                    out.meta_packet_number = self._meta_pn.get(tid, 0)
-                    wire = out._wrap_meta(wire[:12], wire[12:])
+                    wire = out.wrap_meta(
+                        wire[:12], wire[12:], frame_type=ftype,
+                        packet_number=self._meta_pn.get(tid, 0),
+                        packet_position=fpos)
                 res = out.send_bytes(wire, is_rtcp=False)
                 if res is WriteResult.WOULD_BLOCK:
                     await asyncio.sleep(0.02)      # bookmark: retry same pkt

@@ -1,0 +1,46 @@
+"""Two-process ``jax.distributed`` differential for the cluster mesh
+(VERDICT r3 item 7): the DCN path in ``parallel/distributed.py`` gets an
+EXECUTED proof, not just unit coverage — two local processes with 4
+virtual CPU devices each rendezvous through a real coordinator, build
+the host-major cluster mesh, and verify the sharded relay step
+bit-exact against the host oracle on every addressable shard."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.timeout(300)
+def test_two_process_cluster_mesh_bit_exact():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "_dist_worker.py")
+    # the axon sitecustomize imports jax at interpreter start, BEFORE
+    # the worker body runs — platform/device-count env must come from
+    # the parent or it arrives too late
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), coord], cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers hung: " +
+                    " / ".join(o or "" for o in outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER_OK {i}" in out, out
