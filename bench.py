@@ -802,6 +802,14 @@ def main():
             "real_flows": N_SUB,
             "extrapolated": False,
             "vs_baseline_server_cost": round(ratio_server_cost, 2),
+            "ratio_ceiling_note": (
+                "The headline ratio is LOOPBACK-KERNEL-DELIVERY bound, "
+                "not engine bound: raw egress with no device step in the "
+                "loop measures ~the same per-packet cost, and prototyped "
+                "variants (connected sockets: +1.7%; MSG_ZEROCOPY: parity "
+                "— 46-segment supers sit under MAX_SKB_FRAGS) do not move "
+                "it. Added-latency targets are met with wheel-deadline "
+                "wakeups (p99 well under the r2 37.4 ms)."),
             "server_cost_method": (
                 "Corroborating paired ratio with receiver queues "
                 "saturated for BOTH paths (GRO receivers, tiny buffers, "

@@ -109,6 +109,13 @@ class RestApi:
         params = parse_qs(url.query)
         if path == "/stats":
             return 200, self._webstats_html()
+        if path == "/admin":
+            if not self._authorized(headers, params):
+                return 401, "<h1>401</h1>"
+            if method == "POST" and body:
+                params = {**params, **parse_qs(body.decode("utf-8",
+                                                           "replace"))}
+            return self._admin_html(params, method)
         if path.startswith("/hls/") and self.app.hls is not None:
             served = self.app.hls.serve(url.path)
             if served is None:
@@ -343,6 +350,78 @@ class RestApi:
                                   body=payload)
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK,
                            body={"Path": path, "Value": payload})
+
+    def _admin_html(self, params: dict,
+                    method: str = "GET") -> tuple[int, str, str]:
+        """HTML front-end over the admin dictionary tree — the mongoose
+        web-admin role (``QTSSAdminModule.cpp:365`` served HTML over the
+        same get/set query API): navigable containers, leaf values, and
+        an inline set form for ``server/prefs/*``."""
+        import html as _html
+        from urllib.parse import quote
+
+        from . import admin
+        path = params.get("path", ["server/*"])[0]
+        msg = ""
+        if params.get("command", [""])[0].lower() == "set":
+            if method != "POST":
+                # a state-changing set must not ride an idempotent GET
+                # (link prefetchers, refresh, cross-site <img> CSRF)
+                msg = "<p class=err>set requires POST</p>"
+            else:
+                st, payload = admin.set_pref(self.app, path.rstrip("/*"),
+                                             params.get("value", [""])[0])
+                msg = ("<p class=ok>set ok</p>" if st == 200 else
+                       f"<p class=err>{_html.escape(str(payload))}</p>")
+            path = "server/prefs/*"
+        status, payload = admin.query(self.app, path)
+        crumbs = []
+        acc = []
+        for part in [p for p in path.strip("/").split("/") if p != "*"]:
+            acc.append(part)
+            href = quote("/".join(acc), safe="/") + "/*"
+            crumbs.append(f'<a href="/admin?path={quote(href, safe="/*")}"'
+                          f">{_html.escape(part)}</a>")
+        rows = []
+        if status != 200:
+            rows.append(f"<tr><td colspan=2 class=err>"
+                        f"{_html.escape(str(payload))}</td></tr>")
+        elif isinstance(payload, dict):
+            base = path.strip("/").rstrip("*").rstrip("/")
+            for k in sorted(payload):
+                v = payload[k]
+                if isinstance(v, dict) or v == "*container*":
+                    href = quote(f"{base}/{k}", safe="/") + "/*"
+                    rows.append(
+                        f'<tr><td><a href="/admin?path='
+                        f'{quote(href, safe="/*")}">'
+                        f"{_html.escape(str(k))}/</a></td><td></td></tr>")
+                else:
+                    cell = _html.escape(str(v))
+                    if base == "server/prefs":
+                        cell += (f'<form method=post action=/admin '
+                                 f'style="display:inline">'
+                                 f'<input type=hidden name=path value='
+                                 f'"server/prefs/{_html.escape(str(k))}">'
+                                 f'<input type=hidden name=command '
+                                 f'value=set>'
+                                 f'<input name=value size=12> '
+                                 f'<input type=submit value=set></form>')
+                    rows.append(f"<tr><td>{_html.escape(str(k))}</td>"
+                                f"<td>{cell}</td></tr>")
+        else:
+            rows.append(f"<tr><td>{_html.escape(path)}</td>"
+                        f"<td>{_html.escape(str(payload))}</td></tr>")
+        body = ("<!doctype html><html><head><title>easydarwin-tpu admin"
+                "</title><style>body{font-family:monospace;margin:2em}"
+                "table{border-collapse:collapse}td{border:1px solid #ccc;"
+                "padding:2px 8px}.err{color:#b00}.ok{color:#080}"
+                "</style></head><body>"
+                f"<h2><a href=\"/admin?path=server/*\">admin</a> "
+                f"{' / '.join(crumbs)}</h2>{msg}"
+                f"<table>{''.join(rows)}</table>"
+                "<p><a href=/stats>stats</a></p></body></html>")
+        return 200, body, "text/html"
 
     def _webstats_html(self) -> str:
         """HTML stats page (QTSSWebStatsModule.cpp:86-992 equivalent,

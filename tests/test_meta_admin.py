@@ -279,3 +279,59 @@ async def test_vod_setup_grants_ft_pn(tmp_path):
         await cl.close()
     finally:
         await app.stop()
+
+
+async def test_admin_html_ui():
+    """The web-admin role: /admin renders the dictionary tree as HTML
+    with navigable containers and a working pref set form (the mongoose
+    UI's get/set surface on the REST port)."""
+    import urllib.request
+
+    from easydarwin_tpu.server.app import StreamingServer
+    from easydarwin_tpu.server.config import ServerConfig
+
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0,
+                                       bind_ip="127.0.0.1"))
+    await app.start()
+    try:
+        import asyncio
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{app.rest.port}{path}",
+                    timeout=5) as r:
+                return r.status, r.read().decode()
+
+        st, body = await asyncio.to_thread(get, "/admin?path=server/*")
+        assert st == 200 and "prefs/" in body and "<table>" in body
+        st, body = await asyncio.to_thread(get,
+                                           "/admin?path=server/prefs/*")
+        assert "bucket_delay_ms" in body and "value=set" in body
+        # a GET set is refused (CSRF/idempotency), POST succeeds
+        st, body = await asyncio.to_thread(
+            get, "/admin?path=server/prefs/bucket_delay_ms"
+                 "&command=set&value=55")
+        assert "set requires POST" in body
+        assert app.config.bucket_delay_ms != 55
+
+        def post(path, data):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{app.rest.port}{path}",
+                data=data.encode(), method="POST")
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status, r.read().decode()
+
+        st, body = await asyncio.to_thread(
+            post, "/admin",
+            "path=server/prefs/bucket_delay_ms&command=set&value=55")
+        assert "set ok" in body
+        assert app.config.bucket_delay_ms == 55
+        # reflected-XSS probe: hostile path stays inert in the output
+        st, body = await asyncio.to_thread(
+            get, "/admin?path=server/x%22%3E%3Cscript%3Ealert(1)"
+                 "%3C/script%3E/*")
+        assert "<script>alert" not in body
+        st, body = await asyncio.to_thread(get, "/admin?path=nope/*")
+        assert "no such path" in body
+    finally:
+        await app.stop()
