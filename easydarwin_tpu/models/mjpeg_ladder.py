@@ -354,7 +354,12 @@ class MjpegTranscodeService:
         self.registry = registry
         self.on_frame = on_frame
         self.ladders: dict[str, MjpegLadderOutput] = {}
-        # one worker serializes all ladders' entropy coding off the loop
+        # a DEDICATED worker, deliberately not the hls/requant pool:
+        # a ladder's _drain is a long-lived loop of GIL-holding CPython
+        # entropy coding (hundreds of ms per frame, refilled faster than
+        # it drains on a live stream) — parked on the shared bounded
+        # pool it would permanently occupy a worker and starve the
+        # H.264 rungs, whose jobs are short and GIL-releasing
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="mjpeg-ladder")
 
