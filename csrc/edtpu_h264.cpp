@@ -552,17 +552,31 @@ inline bool encode_residual15(BitWriter &bw, const int16_t *levels,
 
 // --------------------------------------------------------------- NAL/EPB
 void strip_epb(const uint8_t *in, int64_t n, std::vector<uint8_t> &out) {
+  // memchr-accelerated: only zero bytes can begin an escape, so spans
+  // up to the next 0x00 bulk-copy; the stateful walk runs only around
+  // zeros (coded slice data is mostly nonzero — this was ~2% of the
+  // requant wall alone as a byte loop)
   out.clear();
   out.reserve(n);
   int zeros = 0;
-  for (int64_t i = 0; i < n; ++i) {
+  int64_t i = 0;
+  while (i < n) {
+    if (zeros == 0) {
+      const void *p = std::memchr(in + i, 0, static_cast<size_t>(n - i));
+      int64_t nz = p ? static_cast<const uint8_t *>(p) - in : n;
+      out.insert(out.end(), in + i, in + nz);
+      if (!p) return;
+      i = nz;
+    }
     uint8_t b = in[i];
     if (zeros >= 2 && b == 0x03 && i + 1 < n && in[i + 1] <= 0x03) {
       zeros = 0;
+      ++i;
       continue;
     }
     out.push_back(b);
     zeros = (b == 0) ? zeros + 1 : 0;
+    ++i;
   }
 }
 
@@ -570,13 +584,26 @@ void insert_epb(const std::vector<uint8_t> &in, std::vector<uint8_t> &out) {
   out.clear();
   out.reserve(in.size() + in.size() / 64 + 8);
   int zeros = 0;
-  for (uint8_t b : in) {
+  const uint8_t *d = in.data();
+  size_t n = in.size(), i = 0;
+  while (i < n) {
+    if (zeros == 0) {                  // escape needs two zeros first:
+      const void *p = std::memchr(d + i, 0, n - i);
+      size_t nz = p ? static_cast<size_t>(
+                          static_cast<const uint8_t *>(p) - d)
+                    : n;
+      out.insert(out.end(), d + i, d + nz);
+      if (!p) return;
+      i = nz;
+    }
+    uint8_t b = d[i];
     if (zeros >= 2 && b <= 0x03) {
       out.push_back(0x03);
       zeros = 0;
     }
     out.push_back(b);
     zeros = (b == 0) ? zeros + 1 : 0;
+    ++i;
   }
 }
 
