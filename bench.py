@@ -567,13 +567,17 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
     rq.transform_nal(slice_nal)
     if rq.stats.native_slices != 1:
         return {"h264_requant_note": "native path unavailable"}
+    # median per-slice time, not wall-average: this shared VM preempts
+    # the single core (the relay headline cancels that with paired
+    # ratios; here the analogous control is the median)
     t0 = time.perf_counter()
-    done = 0
+    times = []
     while time.perf_counter() - t0 < seconds:
+        c0 = time.perf_counter()
         rq.transform_nal(slice_nal)
-        done += 1
-    dt = time.perf_counter() - t0
-    mbs_s = done * mbs_per_slice / dt
+        times.append(time.perf_counter() - c0)
+    times.sort()
+    mbs_s = mbs_per_slice / times[len(times) // 2]
 
     # same slice content through the native CABAC walk (Main/High
     # profile camera streams take this path)
@@ -586,11 +590,13 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
     cabac_mbs_s = 0.0
     if rq_cb.stats.native_slices == 1:
         t0 = time.perf_counter()
-        done = 0
+        ct = []
         while time.perf_counter() - t0 < seconds / 2:
+            c0 = time.perf_counter()
             rq_cb.transform_nal(nals_cb[2])
-            done += 1
-        cabac_mbs_s = done * mbs_per_slice / (time.perf_counter() - t0)
+            ct.append(time.perf_counter() - c0)
+        ct.sort()
+        cabac_mbs_s = mbs_per_slice / ct[len(ct) // 2]
 
     # the production harness (hls/requant.py): one shared pool, the
     # native walk releases the GIL — measure the AGGREGATE rate with
@@ -628,11 +634,14 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
         "h264_requant_workers": workers,
         "h264_requant_parallel_mbs_per_sec": round(agg_mbs_s, 0),
         "h264_requant_1080p30_renditions":
-            round(agg_mbs_s / (8160 * 30), 1),
+            round(agg_mbs_s / (8160 * 30), 2),
         "h264_requant_method": (
-            "real 192x192 4:2:0 CAVLC slice (chroma DC+AC coded) through "
-            "the native requant walk: mbs_per_sec = back-to-back on one "
-            "core; parallel_mbs_per_sec = aggregate across "
+            "real 192x192 4:2:0 slices (chroma DC+AC coded) through the "
+            "native requant walks, CAVLC and CABAC: per-core rate = "
+            "mbs_per_slice / MEDIAN per-slice time (wall-average is "
+            "contaminated by this shared VM's preemption; the median is "
+            "the same control the relay headline's paired ratios "
+            "apply).  parallel_mbs_per_sec = aggregate across "
             "pool_workers() GIL-released threads (the hls/requant.py "
             "pool shape).  1080p30 renditions = parallel rate / "
             "(8160 MBs * 30 fps).  The HLS pipeline sheds AUs when the "

@@ -24,6 +24,17 @@ ROLE_HOOKS = ("initialize", "shutdown", "reread_prefs", "rtsp_filter",
               "session_closing", "incoming_rtp")
 
 
+def _module_attrs(module) -> dict:
+    """Module-added attributes (QTSS_AddStaticAttribute analogue) under
+    an ``attrs`` node; a module raising inside its own hook must not
+    take the whole tree down."""
+    try:
+        attrs = module.attributes()
+    except Exception as e:             # noqa: BLE001 — foreign plugin code
+        return {"attrs_error": str(e)}
+    return {"attrs": attrs} if attrs else {}
+
+
 def _roles_of(module) -> list[str]:
     """Roles a module registers for = hooks it overrides (the dispatch
     arrays in QTSServer::BuildModuleRoleArrays, rebuilt by reflection)."""
@@ -50,7 +61,8 @@ def build_tree(app) -> dict[str, Any]:
             "info": dict(app.server_info()),
             "prefs": cfg,
             "sessions": sessions,
-            "modules": {m.name: {"roles": _roles_of(m)}
+            "modules": {m.name: {"roles": _roles_of(m),
+                                 **_module_attrs(m)}
                         for m in getattr(app.modules, "modules", [])},
         },
     }

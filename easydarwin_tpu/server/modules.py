@@ -34,6 +34,14 @@ class Module:
 
     name = "module"
 
+    def attributes(self) -> dict:
+        """Module-added attributes for the admin dictionary tree — the
+        extensible half of the QTSS dictionary system
+        (``QTSS_AddStaticAttribute``; modules exposed live counters and
+        state through it, browseable under ``modules/<name>``).  Return
+        a flat or nested dict of JSON-able values."""
+        return {}
+
     def initialize(self, server) -> None:
         pass
 

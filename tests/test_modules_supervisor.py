@@ -213,3 +213,44 @@ def test_load_modules_ignores_imported_subclasses(tmp_path):
         "class Other(Module):\n    name = 'other'\n")
     mods = load_modules_from(str(tmp_path))
     assert sorted(m.name for m in mods) == ["mine", "other"]
+
+
+async def test_module_added_attributes_in_admin_tree():
+    """The extensible half of the QTSS dictionary system: a module's
+    attributes() surface under modules/<name>/attrs in the admin tree,
+    browseable and wildcard-listable; a crashing hook degrades to an
+    attrs_error leaf instead of breaking the tree."""
+    from easydarwin_tpu.server import admin
+    from easydarwin_tpu.server.app import StreamingServer
+    from easydarwin_tpu.server.config import ServerConfig
+    from easydarwin_tpu.server.modules import Module
+
+    class Counting(Module):
+        name = "counting"
+
+        def __init__(self):
+            self.hits = 7
+
+        def attributes(self):
+            return {"hits": self.hits, "nested": {"deep": "v"}}
+
+    class Broken(Module):
+        name = "broken"
+
+        def attributes(self):
+            raise RuntimeError("boom")
+
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0,
+                                       bind_ip="127.0.0.1"))
+    await app.start()
+    try:
+        app.modules.register(Counting())
+        app.modules.register(Broken())
+        st, val = admin.query(app, "server/modules/counting/attrs/hits")
+        assert (st, val) == (200, 7)
+        st, val = admin.query(app, "server/modules/counting/attrs/*")
+        assert st == 200 and set(val) == {"hits", "nested"}
+        st, val = admin.query(app, "server/modules/broken/*")
+        assert st == 200 and "boom" in str(val.get("attrs_error"))
+    finally:
+        await app.stop()
