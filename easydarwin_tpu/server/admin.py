@@ -30,9 +30,12 @@ def _module_attrs(module) -> dict:
     take the whole tree down."""
     try:
         attrs = module.attributes()
-    except Exception as e:             # noqa: BLE001 — foreign plugin code
-        return {"attrs_error": str(e)}
-    return {"attrs": attrs} if attrs else {}
+        if attrs:
+            import json
+            json.dumps(attrs)          # a non-serializable value would
+    except Exception as e:             # break every tree query that
+        return {"attrs_error": str(e)}  # includes this node, not just
+    return {"attrs": attrs} if attrs else {}  # the module's own path
 
 
 def _roles_of(module) -> list[str]:
