@@ -33,6 +33,13 @@ CBP_INTRA_FROM_CODE = [
     8, 17, 18, 20, 24, 6, 9, 22, 25, 32, 33, 34, 36, 40, 38, 41]
 CBP_INTRA_TO_CODE = {cbp: i for i, cbp in enumerate(CBP_INTRA_FROM_CODE)}
 
+#: profile_idc values whose SPS carries the chroma_format / bit-depth /
+#: scaling-matrix fields (7.3.2.1.1's "if( profile_idc == 100 || ... )"
+#: list): High, High 10, High 4:2:2, High 4:4:4 Predictive, CAVLC 4:4:4,
+#: Scalable (83/86), Multiview (118/128/138), and the MFC/stereo codes.
+_HIGH_FAMILY = frozenset(
+    (100, 110, 122, 244, 44, 83, 86, 118, 128, 138, 139, 134, 135))
+
 #: luma4x4BlkIdx → (x4, y4) inside the macroblock (spec 6.4.3 scan)
 BLK_XY = [(2 * ((i >> 2) & 1) + (i & 1), 2 * ((i >> 3) & 1)
            + ((i >> 1) & 1)) for i in range(16)]
@@ -75,15 +82,20 @@ class Sps:
     def parse(cls, nal: bytes) -> "Sps":
         br = BitReader(nal_to_rbsp(nal[1:]))
         profile = br.read_bits(8)
-        if profile not in (66, 77, 88, 100):
+        # 7.3.2.1.1: every profile in _HIGH_FAMILY carries the
+        # chroma_format/bit_depth/scaling fields after sps_id — not just
+        # 100.  Gating on the full set keeps e.g. a High-10 SPS from
+        # being silently misparsed (its chroma_format read as
+        # log2_max_frame_num) instead of cleanly rejected.
+        if profile not in (66, 77, 88) and profile not in _HIGH_FAMILY:
             raise ValueError(f"unsupported profile {profile}")
         br.read_bits(8)                 # constraint flags
         br.read_bits(8)                 # level
         sps_id = br.ue()
-        if profile == 100:
-            # High profile is in scope as long as it stays 4:2:0 8-bit
-            # with FLAT scaling (non-flat matrices change the requant
-            # math; reject → the rung passes the stream through)
+        if profile in _HIGH_FAMILY:
+            # the High family is in scope as long as it stays 4:2:0
+            # 8-bit with FLAT scaling (non-flat matrices change the
+            # requant math; reject → the rung passes the stream through)
             if br.ue() != 1:
                 raise ValueError("chroma_format != 4:2:0")
             if br.ue() != 0 or br.ue() != 0:

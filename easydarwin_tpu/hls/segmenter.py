@@ -44,7 +44,7 @@ def _esds(cfg: AacConfig) -> bytes:
 def _audio_trak(cfg: AacConfig) -> bytes:
     esds = _esds(cfg)
     entry = struct.pack(">I4s", 36 + len(esds), b"mp4a") + bytes(6) + \
-        struct.pack(">H", 2) + bytes(8) + \
+        struct.pack(">H", 1) + bytes(8) + \
         struct.pack(">HHI", cfg.channels, 16, 0) + \
         struct.pack(">I", cfg.sample_rate << 16) + esds
     stsd = full_box(b"stsd", 0, 0, struct.pack(">I", 1), entry)

@@ -29,6 +29,10 @@ class RestApi:
         self.config = config
         self.app = app                      # StreamingServer
         self.tokens: set[str] = set()
+        # per-process CSRF token for the /admin HTML set form: a
+        # cross-site POST rides cached Basic credentials but cannot READ
+        # the admin page to learn this value (same-origin policy)
+        self._admin_csrf = secrets.token_urlsafe(16)
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
         self.started_at = time.time()
@@ -115,7 +119,7 @@ class RestApi:
             if method == "POST" and body:
                 params = {**params, **parse_qs(body.decode("utf-8",
                                                            "replace"))}
-            return self._admin_html(params, method)
+            return self._admin_html(params, method, headers)
         if path.startswith("/hls/") and self.app.hls is not None:
             served = self.app.hls.serve(url.path)
             if served is None:
@@ -125,15 +129,41 @@ class RestApi:
         if not path.startswith("/api/v1/"):
             return 404, json.dumps({"error": "not found"})
         cmd = path[len("/api/v1/"):]
+        if "x-token" in headers and "token" not in params:
+            params["token"] = [headers["x-token"]]
         if cmd == "login":
             return self._login(params, headers)
         if not self._authorized(headers, params):
             return 401, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_UNAUTHORIZED)
+        if self.config.auth_enabled and self._mutates(cmd, params) \
+                and headers.get("x-token") not in self.tokens:
+            # CSRF altitude guard on the STATE CHANGE itself, not just
+            # the HTML form: cached Basic creds (or a leaked query-string
+            # token) ride any cross-site GET/POST, but a custom header
+            # cannot cross origins without a CORS preflight this server
+            # never grants.  Mutating commands therefore demand a login
+            # token sent via the X-Token HEADER.
+            return 403, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_UNAUTHORIZED,
+                               body={"Detail":
+                                     "mutating API calls need the X-Token "
+                                     "header (see /api/v1/login)"})
         fn = getattr(self, f"_cmd_{cmd}", None)
         if fn is None:
             return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
         return await fn(params, body) if asyncio.iscoroutinefunction(fn) \
             else fn(params, body)
+
+    #: API commands that change server state (everything not a pure read)
+    _MUTATING = frozenset((
+        "setbaseconfig", "restart", "startrecord", "stoprecord",
+        "startpullrelay", "stoppullrelay", "starttranscode",
+        "stoptranscode", "starthls", "stophls", "logout"))
+
+    def _mutates(self, cmd: str, params: dict) -> bool:
+        if cmd in self._MUTATING:
+            return True
+        return (cmd == "admin"
+                and params.get("command", ["get"])[0].lower() == "set")
 
     def _login(self, params: dict, headers: dict) -> tuple[int, str]:
         user = params.get("username", [""])[0]
@@ -148,6 +178,9 @@ class RestApi:
                            body={"Token": token})
 
     def _cmd_logout(self, params: dict, body: bytes) -> tuple[int, str]:
+        # route() folds an X-Token header into params["token"], so a
+        # header-only logout (the convention the mutation guard demands)
+        # revokes that token rather than silently discarding nothing
         token = params.get("token", [""])[0]
         self.tokens.discard(token)
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK)
@@ -351,8 +384,8 @@ class RestApi:
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK,
                            body={"Path": path, "Value": payload})
 
-    def _admin_html(self, params: dict,
-                    method: str = "GET") -> tuple[int, str, str]:
+    def _admin_html(self, params: dict, method: str = "GET",
+                    headers: dict | None = None) -> tuple[int, str, str]:
         """HTML front-end over the admin dictionary tree — the mongoose
         web-admin role (``QTSSAdminModule.cpp:365`` served HTML over the
         same get/set query API): navigable containers, leaf values, and
@@ -368,6 +401,16 @@ class RestApi:
                 # a state-changing set must not ride an idempotent GET
                 # (link prefetchers, refresh, cross-site <img> CSRF)
                 msg = "<p class=err>set requires POST</p>"
+            elif (not secrets.compare_digest(
+                        params.get("csrf", [""])[0].encode("utf-8"),
+                        self._admin_csrf.encode("ascii"))
+                    and (headers or {}).get("x-token") not in self.tokens):
+                # bytes, not str: compare_digest raises on non-ASCII str
+                # input, and the csrf field is attacker-supplied
+                # cross-site form POSTs ride cached Basic creds; demand
+                # proof the caller read this page (embedded token) or
+                # holds an API token sent via a header a form can't set
+                msg = "<p class=err>set requires the page CSRF token</p>"
             else:
                 st, payload = admin.set_pref(self.app, path.rstrip("/*"),
                                              params.get("value", [""])[0])
@@ -405,6 +448,8 @@ class RestApi:
                                  f'"server/prefs/{_html.escape(str(k))}">'
                                  f'<input type=hidden name=command '
                                  f'value=set>'
+                                 f'<input type=hidden name=csrf value='
+                                 f'"{self._admin_csrf}">'
                                  f'<input name=value size=12> '
                                  f'<input type=submit value=set></form>')
                     rows.append(f"<tr><td>{_html.escape(str(k))}</td>"

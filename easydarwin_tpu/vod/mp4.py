@@ -67,20 +67,6 @@ def open_shared(path: str) -> "Mp4File":
         return fresh
 
 
-def _release_shared(f: "Mp4File") -> None:
-    with _SHARED_LOCK:
-        f._refs -= 1
-        if f._refs > 0:
-            return
-        # keep a few warm for reopen bursts; evict beyond the cap
-        idle = [p for p, v in _SHARED.items() if v._refs == 0]
-        while len(idle) > _SHARED_IDLE_KEEP:
-            victim = idle.pop(0)
-            v = _SHARED.pop(victim)
-            v._shared_key = None
-            v._close_now()
-
-
 class Mp4Error(ValueError):
     pass
 
@@ -231,16 +217,28 @@ class Mp4File:
             self._f = None
 
     def close(self):
-        if self._shared_key is _DETACHED:
-            with _SHARED_LOCK:
+        # branch on _shared_key ONLY under the lock: open_shared may be
+        # detaching this instance concurrently, and an unlocked read
+        # could route a detached (replaced-but-referenced) instance down
+        # the by-path release path, leaking its mapping forever
+        with _SHARED_LOCK:
+            key = self._shared_key
+            if key is not None:
                 self._refs -= 1
                 if self._refs > 0:
                     return
-            self._close_now()          # genuinely the last holder
-            return
-        if self._shared_key is not None:
-            _release_shared(self)
-            return
+                if key is not _DETACHED:
+                    # still the by-path entry: keep a few warm for
+                    # reopen bursts; evict beyond the cap
+                    idle = [p for p, v in _SHARED.items()
+                            if v._refs == 0]
+                    while len(idle) > _SHARED_IDLE_KEEP:
+                        victim = idle.pop(0)
+                        v = _SHARED.pop(victim)
+                        v._shared_key = None
+                        v._close_now()
+                    return
+                self._shared_key = None   # detached, last holder: unmap
         self._close_now()
 
     def _close_now(self):

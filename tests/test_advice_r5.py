@@ -1,0 +1,182 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md):
+
+* audio mp4a SampleEntry data_reference_index must be 1 (the trak's own
+  single dref entry), not 2 (medium) — asserted in test_hls.py against
+  a real A/V init segment
+* Sps.parse must gate the chroma_format/bit_depth/scaling fields on the
+  FULL High-profile family (7.3.2.1.1), not just 100 — a High-10 SPS
+  must be cleanly rejected, never silently misparsed (low)
+* Mp4File.close() must serialize with open_shared's detach under
+  _SHARED_LOCK so a replaced-but-referenced instance's mapping is
+  released by its true last holder (low)
+* /admin HTML set form POSTs must carry the page CSRF token (low) —
+  asserted end-to-end in test_meta_admin.py; the API-level altitude
+  guard (mutating commands need the X-Token HEADER when auth is on)
+  is covered here
+"""
+
+import os
+
+import pytest
+
+from easydarwin_tpu.codecs.h264_bits import BitWriter, rbsp_to_nal
+from easydarwin_tpu.codecs.h264_intra import Sps
+
+
+def _sps_nal_profile(profile_idc: int) -> bytes:
+    """A syntactically valid SPS for a High-family profile carrying the
+    chroma_format/bit_depth fields (High 10 shape: bit_depth 10)."""
+    bw = BitWriter()
+    bw.write_bits(profile_idc, 8)
+    bw.write_bits(0, 8)                 # constraint flags
+    bw.write_bits(30, 8)                # level
+    bw.ue(0)                            # sps_id
+    bw.ue(1)                            # chroma_format_idc 4:2:0
+    bw.ue(2)                            # bit_depth_luma_minus8 = 2 (10-bit)
+    bw.ue(2)                            # bit_depth_chroma_minus8
+    bw.write_bit(0)                     # transform bypass
+    bw.write_bit(0)                     # seq_scaling_matrix_present
+    bw.ue(0)                            # log2_max_frame_num_minus4
+    bw.ue(2)                            # poc_type
+    bw.ue(1)                            # max_num_ref_frames
+    bw.write_bit(0)                     # gaps_in_frame_num
+    bw.ue(7)                            # width_mbs - 1
+    bw.ue(7)                            # height_mbs - 1
+    bw.write_bit(1)                     # frame_mbs_only
+    bw.write_bit(1)                     # direct_8x8_inference
+    bw.write_bit(0)                     # frame_cropping
+    bw.write_bit(0)                     # vui
+    bw.rbsp_trailing()
+    return b"\x67" + rbsp_to_nal(bw.to_bytes())
+
+
+def test_high10_sps_cleanly_rejected_not_misparsed():
+    """profile 110 carries the High-family fields; the parser must read
+    them (and reject 10-bit), NOT misparse chroma_format as
+    log2_max_frame_num and return a garbage Sps."""
+    with pytest.raises(ValueError, match="bit depth"):
+        Sps.parse(_sps_nal_profile(110))
+
+
+def test_high_family_8bit_accepted_like_100():
+    """A profile-122 SPS that stays 4:2:0/8-bit parses exactly as a
+    profile-100 one does (the fields are read, constraints hold)."""
+    bw = BitWriter()
+    bw.write_bits(122, 8)
+    bw.write_bits(0, 8)
+    bw.write_bits(30, 8)
+    bw.ue(0)
+    bw.ue(1)                            # 4:2:0
+    bw.ue(0)                            # 8-bit luma
+    bw.ue(0)                            # 8-bit chroma
+    bw.write_bit(0)
+    bw.write_bit(0)
+    bw.ue(0)
+    bw.ue(2)
+    bw.ue(1)
+    bw.write_bit(0)
+    bw.ue(3)
+    bw.ue(1)
+    bw.write_bit(1)
+    bw.write_bit(1)
+    bw.write_bit(0)
+    bw.write_bit(0)
+    bw.rbsp_trailing()
+    s = Sps.parse(b"\x67" + rbsp_to_nal(bw.to_bytes()))
+    assert (s.width_mbs, s.height_mbs) == (4, 2)
+
+
+def test_unknown_profile_still_rejected():
+    with pytest.raises(ValueError, match="unsupported profile"):
+        Sps.parse(_sps_nal_profile(144))
+
+
+def test_detached_shared_mp4_unmaps_with_last_holder(tmp_path):
+    """Replace a shared MP4 on disk while two readers hold it: the
+    detached instance must be unmapped exactly when the LAST holder
+    closes (close() branches on _shared_key under _SHARED_LOCK)."""
+    from easydarwin_tpu.vod import mp4 as m
+    from easydarwin_tpu.vod.mp4_writer import Mp4Writer
+
+    path = str(tmp_path / "a.mp4")
+
+    from easydarwin_tpu.codecs.h264_intra import Pps
+    from easydarwin_tpu.codecs.h264_intra import Sps as _Sps
+
+    w = Mp4Writer(path)
+    ti = w.add_h264_track(_Sps(4, 3).build(), Pps().build(), 64, 48)
+    w.write_sample(ti, b"\x00\x00\x00\x04" + bytes(4), 3000)
+    w.close()
+    a = m.open_shared(path)
+    b = m.open_shared(path)
+    assert a is b and a._refs == 2
+    os.utime(path, ns=(1, 1))           # stat change → replacement
+    c = m.open_shared(path)
+    assert c is not a
+    assert a._shared_key is m._DETACHED
+    a.close()
+    assert a._mm is not None            # one holder left: mapping alive
+    b.close()
+    assert a._mm is None                # last holder out: unmapped
+    c.close()
+
+
+def test_mutating_api_needs_header_token_when_auth_on():
+    """With auth enabled, cached Basic creds must NOT suffice for a
+    state-changing API call (cross-site requests carry them for free);
+    the X-Token header — unsendable cross-origin without a CORS
+    preflight — is required.  Reads stay Basic-accessible."""
+    import asyncio
+    import base64
+    import json
+
+    from easydarwin_tpu.server.app import StreamingServer
+    from easydarwin_tpu.server.config import ServerConfig
+    from easydarwin_tpu.server.rest import RestApi
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, auth_enabled=True,
+                       rest_username="admin", rest_password="pw")
+    app = StreamingServer(cfg)
+    api = RestApi(cfg, app)
+    basic = {"authorization":
+             "Basic " + base64.b64encode(b"admin:pw").decode()}
+
+    async def go():
+        st, _ = await api.route(
+            "GET", "/api/v1/getserverinfo", basic, b"")
+        assert st == 200                 # reads ride Basic fine
+        st, _ = await api.route(
+            "POST", "/api/v1/setbaseconfig", basic,
+            b'{"Config":{"bucket_delay_ms":41}}')
+        assert st == 403 and cfg.bucket_delay_ms != 41
+        st, _ = await api.route(
+            "GET", "/api/v1/admin?path=server/prefs/bucket_delay_ms"
+            "&command=set&value=41", basic, b"")
+        assert st == 403 and cfg.bucket_delay_ms != 41
+        st, payload = await api.route("GET",
+                                      "/api/v1/login?username=admin"
+                                      "&password=pw", {}, b"")
+        tok = json.loads(payload)["EasyDarwin"]["Body"]["Token"]
+        st, _ = await api.route(
+            "POST", "/api/v1/setbaseconfig",
+            {**basic, "x-token": tok},
+            b'{"Config":{"bucket_delay_ms":41}}')
+        assert st == 200 and cfg.bucket_delay_ms == 41
+        # header-only logout must actually revoke the token
+        st, _ = await api.route("POST", "/api/v1/logout",
+                                {"x-token": tok}, b"")
+        assert st == 200 and tok not in api.tokens
+        st, _ = await api.route(
+            "POST", "/api/v1/setbaseconfig",
+            {**basic, "x-token": tok},
+            b'{"Config":{"bucket_delay_ms":42}}')
+        assert st == 403 and cfg.bucket_delay_ms == 41
+        # a non-ASCII csrf value is refused, not a TypeError that kills
+        # the connection task (compare_digest rejects non-ASCII str)
+        st, page, _ctype = api._admin_html(
+            {"command": ["set"], "csrf": ["é"],
+             "path": ["server/prefs/bucket_delay_ms"], "value": ["9"]},
+            "POST", {})
+        assert st == 200 and "CSRF" in page
+
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(go())

@@ -598,6 +598,11 @@ def test_hls_av_fragments_with_audio_track():
         assert out.init_segment is not None
         assert b"mp4a" in out.init_segment
         assert b"esds" in out.init_segment
+        # data_reference_index must point at the trak's OWN single dref
+        # entry (ISO 14496-12 8.5.2; a stale 2 made strict demuxers
+        # reject the audio track)
+        ase = out.init_segment[out.init_segment.index(b"mp4a"):]
+        assert ase[10:12] == b"\x00\x01"
         assert out.init_segment.count(b"trex") == 2
         assert out.segments and out.audio_samples_muxed > 0
         assert "mp4a.40.2" in out.codec_string()

@@ -1,6 +1,7 @@
 """x-RTP-Meta-Info, UA/query/date utils, admin dictionary-tree browse."""
 
 import json
+import re
 import struct
 
 from easydarwin_tpu.protocol import rtp_meta
@@ -321,9 +322,20 @@ async def test_admin_html_ui():
             with urllib.request.urlopen(req, timeout=5) as r:
                 return r.status, r.read().decode()
 
+        # a POST without the page's CSRF token is refused too (a
+        # cross-site form rides cached creds but can't read the page)
         st, body = await asyncio.to_thread(
             post, "/admin",
             "path=server/prefs/bucket_delay_ms&command=set&value=55")
+        assert "CSRF" in body
+        assert app.config.bucket_delay_ms != 55
+        st, page = await asyncio.to_thread(get, "/admin?path=server/prefs/*")
+        m = re.search(r'name=csrf value="([^"]+)"', page)
+        assert m, "set form must embed the CSRF token"
+        st, body = await asyncio.to_thread(
+            post, "/admin",
+            "path=server/prefs/bucket_delay_ms&command=set&value=55"
+            f"&csrf={m.group(1)}")
         assert "set ok" in body
         assert app.config.bucket_delay_ms == 55
         # reflected-XSS probe: hostile path stays inert in the output
