@@ -33,6 +33,20 @@ CBP_INTRA_FROM_CODE = [
     8, 17, 18, 20, 24, 6, 9, 22, 25, 32, 33, 34, 36, 40, 38, 41]
 CBP_INTRA_TO_CODE = {cbp: i for i, cbp in enumerate(CBP_INTRA_FROM_CODE)}
 
+#: Table 9-4 codeNum → coded_block_pattern, INTER column (cross-checked
+#: against libavcodec's ff_h264_golomb_to_inter_cbp rodata).
+CBP_INTER_FROM_CODE = [
+    0, 16, 1, 2, 4, 8, 32, 3, 5, 10, 12, 15, 47, 7, 11, 13,
+    14, 6, 9, 31, 35, 37, 42, 44, 33, 34, 36, 40, 39, 43, 45, 46,
+    17, 18, 20, 24, 19, 21, 26, 28, 23, 27, 29, 30, 22, 25, 38, 41]
+CBP_INTER_TO_CODE = {cbp: i for i, cbp in enumerate(CBP_INTER_FROM_CODE)}
+
+#: P macroblock partitioning (Table 7-13): mb_type → number of
+#: partitions whose ref_idx/mvd ride in mb_pred (P_8x8* handled apart).
+P_MB_PARTS = {0: 1, 1: 2, 2: 2}
+#: P sub_mb_type → number of sub-partition mvds (Table 7-17).
+P_SUB_PARTS = (1, 2, 2, 4)
+
 #: profile_idc values whose SPS carries the chroma_format / bit-depth /
 #: scaling-matrix fields (7.3.2.1.1's "if( profile_idc == 100 || ... )"
 #: list): High, High 10, High 4:2:2, High 4:4:4 Predictive, CAVLC 4:4:4,
@@ -130,6 +144,9 @@ class Pps:
     bottom_field_poc: bool = False
     chroma_qp_offset: int = 0           # chroma_qp_index_offset (7.4.2.2)
     entropy_cabac: bool = False         # entropy_coding_mode_flag
+    num_ref_l0_default: int = 0         # num_ref_idx_l0_default_active_minus1
+    num_ref_l1_default: int = 0
+    weighted_pred: bool = False         # P-slice explicit weighting
 
     def build(self) -> bytes:
         bw = BitWriter()
@@ -138,9 +155,9 @@ class Pps:
         bw.write_bit(1 if self.entropy_cabac else 0)
         bw.write_bit(0)                 # bottom_field_pic_order
         bw.ue(0)                        # num_slice_groups_minus1
-        bw.ue(0)                        # num_ref_idx_l0
-        bw.ue(0)                        # num_ref_idx_l1
-        bw.write_bit(0)                 # weighted_pred
+        bw.ue(self.num_ref_l0_default)
+        bw.ue(self.num_ref_l1_default)
+        bw.write_bit(1 if self.weighted_pred else 0)
         bw.write_bits(0, 2)             # weighted_bipred_idc
         bw.se(self.pic_init_qp - 26)
         bw.se(0)                        # pic_init_qs
@@ -160,16 +177,19 @@ class Pps:
         bottom_poc = bool(br.read_bit())
         if br.ue() != 0:
             raise ValueError("slice groups unsupported")
-        br.ue()
-        br.ue()
-        br.read_bit()
-        br.read_bits(2)
-        qp = br.se() + 26
+        nref0 = br.ue()                 # num_ref_idx_l*_default_active_minus1
+        nref1 = br.ue()
+        wpred = bool(br.read_bit())     # weighted_pred (P requant rejects
+        br.read_bits(2)                 # explicit weight tables at slice
+        qp = br.se() + 26               # parse — pass-through)
         br.se()
         chroma_off = br.se()
         deblock = bool(br.read_bit())
         br.read_bit()                   # constrained_intra_pred
-        br.read_bit()                   # redundant_pic_cnt_present
+        if br.read_bit():               # redundant_pic_cnt_present: the
+            # P slice header would carry redundant_pic_cnt — reject so
+            # the rung passes such streams through instead of misparsing
+            raise ValueError("redundant_pic_cnt unsupported")
         if br.more_rbsp_data():         # High-profile PPS extension
             if br.read_bit():
                 raise ValueError("8x8 transform unsupported")
@@ -179,12 +199,12 @@ class Pps:
                 # the requant maps both components through ONE offset
                 raise ValueError("split Cb/Cr qp offsets unsupported")
         return cls(pps_id, sps_id, qp, deblock, bottom_poc, chroma_off,
-                   cabac)
+                   cabac, nref0, nref1, wpred)
 
 
 @dataclass
 class SliceHeader:
-    """Round-trippable I-slice header fields (subset of 7.3.3)."""
+    """Round-trippable I/P-slice header fields (subset of 7.3.3)."""
 
     nal_type: int = 5
     nal_ref_idc: int = 3
@@ -199,6 +219,21 @@ class SliceHeader:
     deblock_idc: int = 1
     deblock_alpha: int = 0
     deblock_beta: int = 0
+    # -- P-slice fields (7.3.3 + 7.3.3.1/7.3.3.3), round-tripped raw --
+    num_ref_override: bool = False      # num_ref_idx_active_override_flag
+    num_ref_l0_minus1: int = 0          # valid when num_ref_override
+    ref_list_mod: "list[tuple[int, int]] | None" = None   # l0 (idc, val)
+    adaptive_marking: "list[tuple[int, tuple[int, ...]]] | None" = None
+    cabac_init_idc: int = 0
+
+    @property
+    def is_p(self) -> bool:
+        return self.slice_type % 5 == 0
+
+    def num_ref_l0(self, pps: "Pps") -> int:
+        """Active l0 reference count for this slice."""
+        return (self.num_ref_l0_minus1 if self.num_ref_override
+                else pps.num_ref_l0_default) + 1
 
 
 def _zero_chroma() -> tuple[np.ndarray, np.ndarray]:
@@ -246,6 +281,41 @@ class MacroblockI16x16:
                 + (12 if self.luma_cbp15 else 0))
 
 
+class MacroblockPSkip:
+    """P_Skip marker: occupies an MB position with no syntax of its own
+    (CAVLC folds runs of these into mb_skip_run; CABAC codes one
+    mb_skip_flag each).  The requant rung never touches skipped MBs."""
+
+    __slots__ = ()
+    qp = None                           # no QP chain participation
+    chroma_cbp = 0
+
+
+@dataclass
+class MacroblockInter:
+    """Parsed P macroblock (mb_type 0..4): motion syntax is carried
+    VERBATIM (the transform-domain rung never re-derives prediction),
+    residual levels are the requant surface.
+
+    ``refs``/``mvds`` are in exact bitstream order (mb_pred /
+    sub_mb_pred 7.3.5.1-2): all ref_idx_l0 first, then every mvd pair;
+    ``sub_types`` is None unless mb_type is P_8x8 / P_8x8ref0."""
+
+    mb_type: int                        # 0..4 (Table 7-13)
+    sub_types: "list[int] | None"       # 4 × sub_mb_type for P_8x8*
+    refs: list[int]                     # ref_idx_l0 per partition
+    mvds: "list[tuple[int, int]]"       # (mvd_x, mvd_y) per (sub)partition
+    cbp: int                            # FULL 6-bit CBP
+    qp: int                             # ABSOLUTE QPY (7.4.5 chain)
+    levels: np.ndarray                  # [16, 16] zigzag luma levels
+    chroma_dc: np.ndarray = field(default_factory=lambda: _zero_chroma()[0])
+    chroma_ac: np.ndarray = field(default_factory=lambda: _zero_chroma()[1])
+
+    @property
+    def chroma_cbp(self) -> int:
+        return self.cbp >> 4
+
+
 class SliceCodec:
     """Shared slice walk: parse ⇄ serialize I slices of I_4x4 and
     I_16x16 macroblocks."""
@@ -257,10 +327,10 @@ class SliceCodec:
     # -- slice header ------------------------------------------------------
     def parse_slice_header(self, br: BitReader, nal_byte: int
                            ) -> "SliceHeader":
-        """Parses the full I-slice header (H.264 7.3.3) so the requant
+        """Parses the full I/P-slice header (H.264 7.3.3) so the requant
         writer can ROUND-TRIP every field — frame_num, idr_pic_id, POC
-        lsb, dec_ref_pic_marking — not just the QP.  Leaves ``br`` at the
-        first MB."""
+        lsb, ref-list modifications, dec_ref_pic_marking — not just the
+        QP.  Leaves ``br`` at the first MB."""
         nal_type = nal_byte & 0x1F
         nal_ref_idc = (nal_byte >> 5) & 3
         h = SliceHeader(nal_type=nal_type, nal_ref_idc=nal_ref_idc)
@@ -268,9 +338,9 @@ class SliceCodec:
         if h.first_mb >= self.sps.width_mbs * self.sps.height_mbs:
             raise ValueError("first_mb_in_slice beyond the picture")
         h.slice_type = br.ue()
-        if h.slice_type % 5 != 2:
+        if h.slice_type % 5 not in (0, 2):
             raise ValueError(
-                f"non-I slice {h.slice_type} (intra-only scope)")
+                f"slice type {h.slice_type} unsupported (I/P scope)")
         br.ue()                          # pps id (ours)
         h.frame_num = br.read_bits(self.sps.log2_max_frame_num)
         if nal_type == 5:
@@ -279,13 +349,45 @@ class SliceCodec:
             if self.pps.bottom_field_poc:
                 raise ValueError("bottom-field POC unsupported")
             h.poc_lsb = br.read_bits(self.sps.log2_max_poc_lsb)
+        if h.is_p:
+            if self.pps.weighted_pred:
+                # explicit pred_weight_table in the header — out of the
+                # requant scope, pass the stream through
+                raise ValueError("weighted prediction unsupported")
+            h.num_ref_override = bool(br.read_bit())
+            if h.num_ref_override:
+                h.num_ref_l0_minus1 = br.ue()
+            if br.read_bit():            # ref_pic_list_modification_flag
+                h.ref_list_mod = []
+                while True:
+                    idc = br.ue()
+                    if idc == 3:
+                        break
+                    if idc > 3:
+                        raise ValueError("bad modification idc")
+                    h.ref_list_mod.append((idc, br.ue()))
         if nal_ref_idc != 0:             # dec_ref_pic_marking (7.3.3.3)
             if nal_type == 5:
                 h.no_output_prior = br.read_bit()
                 h.long_term_ref = br.read_bit()
-            else:
-                if br.read_bit():        # adaptive marking: MMCO loop
-                    raise ValueError("adaptive ref marking unsupported")
+            elif br.read_bit():          # adaptive marking: MMCO loop,
+                h.adaptive_marking = []  # round-tripped raw (7.4.3.3)
+                while True:
+                    op = br.ue()
+                    if op == 0:
+                        break
+                    if op in (1, 2, 4, 6):
+                        h.adaptive_marking.append((op, (br.ue(),)))
+                    elif op == 3:
+                        h.adaptive_marking.append((op, (br.ue(), br.ue())))
+                    elif op == 5:
+                        h.adaptive_marking.append((op, ()))
+                    else:
+                        raise ValueError("bad MMCO op")
+        if self.pps.entropy_cabac and h.is_p:
+            h.cabac_init_idc = br.ue()
+            if h.cabac_init_idc > 2:
+                raise ValueError("cabac_init_idc out of range")
         h.qp = self.pps.pic_init_qp + br.se()        # + slice_qp_delta
         if self.pps.deblocking_control:
             idc = br.ue()
@@ -305,12 +407,30 @@ class SliceCodec:
             bw.ue(h.idr_pic_id)
         if self.sps.poc_type == 0:
             bw.write_bits(h.poc_lsb, self.sps.log2_max_poc_lsb)
+        if h.is_p:
+            bw.write_bit(1 if h.num_ref_override else 0)
+            if h.num_ref_override:
+                bw.ue(h.num_ref_l0_minus1)
+            bw.write_bit(1 if h.ref_list_mod is not None else 0)
+            if h.ref_list_mod is not None:
+                for idc, val in h.ref_list_mod:
+                    bw.ue(idc)
+                    bw.ue(val)
+                bw.ue(3)
         if h.nal_ref_idc != 0:           # dec_ref_pic_marking
             if h.nal_type == 5:
                 bw.write_bit(h.no_output_prior)
                 bw.write_bit(h.long_term_ref)
             else:
-                bw.write_bit(0)          # sliding-window marking
+                bw.write_bit(1 if h.adaptive_marking is not None else 0)
+                if h.adaptive_marking is not None:
+                    for op, args in h.adaptive_marking:
+                        bw.ue(op)
+                        for a in args:
+                            bw.ue(a)
+                    bw.ue(0)
+        if self.pps.entropy_cabac and h.is_p:
+            bw.ue(h.cabac_init_idc)
         bw.se(qp - self.pps.pic_init_qp)
         if self.pps.deblocking_control:
             bw.ue(h.deblock_idc)
@@ -330,20 +450,138 @@ class SliceCodec:
                           self.sps.width_mbs * 2), -1, dtype=np.int32)
         return luma, chroma
 
-    def parse_mbs(self, br: BitReader, slice_qp: int, first_mb: int = 0
-                  ) -> "list[MacroblockI4x4 | MacroblockI16x16]":
+    def _mark_skip_nc(self, mb_idx: int, totals: np.ndarray,
+                      tot_c: np.ndarray) -> None:
+        """A P_Skip MB's blocks count TotalCoeff 0 in 9.2.1 neighbor
+        contexts (available, no residual)."""
+        mb_x = (mb_idx % self.sps.width_mbs) * 4
+        mb_y = (mb_idx // self.sps.width_mbs) * 4
+        totals[mb_y:mb_y + 4, mb_x:mb_x + 4] = 0
+        cx, cy = (mb_idx % self.sps.width_mbs) * 2, \
+            (mb_idx // self.sps.width_mbs) * 2
+        tot_c[:, cy:cy + 2, cx:cx + 2] = 0
+
+    def _read_ref(self, br: BitReader, n_ref: int) -> int:
+        if n_ref == 1:
+            return 0                    # not coded, inferred (7.4.5.1)
+        if n_ref == 2:
+            return 1 - br.read_bit()    # te(v) with cMax 1: inverted bit
+        return br.ue()
+
+    def _write_ref(self, bw: BitWriter, ref: int, n_ref: int) -> None:
+        if n_ref == 1:
+            return
+        if n_ref == 2:
+            bw.write_bit(1 - ref)
+        else:
+            bw.ue(ref)
+
+    def _parse_inter_mb(self, br: BitReader, mb_type: int, mb_idx: int,
+                        cur_qp: int, n_ref: int, totals: np.ndarray,
+                        tot_c: np.ndarray
+                        ) -> "tuple[MacroblockInter, int]":
+        """mb_pred/sub_mb_pred (7.3.5.1-2) for P types 0..4, then the
+        shared residual walk.  Motion syntax is carried verbatim."""
+        sub_types = None
+        refs: list[int] = []
+        mvds: list[tuple[int, int]] = []
+        if mb_type in (0, 1, 2):
+            nparts = P_MB_PARTS[mb_type]
+            for _ in range(nparts):
+                refs.append(self._read_ref(br, n_ref))
+            for _ in range(nparts):
+                mvds.append((br.se(), br.se()))
+        elif mb_type in (3, 4):
+            sub_types = [br.ue() for _ in range(4)]
+            if any(t > 3 for t in sub_types):
+                raise ValueError("bad P sub_mb_type")
+            if mb_type == 3:
+                for _ in range(4):
+                    refs.append(self._read_ref(br, n_ref))
+            for st in sub_types:        # P_8x8ref0: refs inferred 0
+                for _ in range(P_SUB_PARTS[st]):
+                    mvds.append((br.se(), br.se()))
+        else:
+            raise ValueError(f"P mb_type {mb_type} unsupported")
+        cbp = CBP_INTER_FROM_CODE[br.ue()]
+        if cbp:
+            cur_qp += br.se()           # mb_qp_delta accumulates (7.4.5)
+            if not 0 <= cur_qp <= 51:
+                raise ValueError("QPY out of range")
+        mb = MacroblockInter(mb_type, sub_types, refs, mvds, cbp, cur_qp,
+                             np.zeros((16, 16), dtype=np.int64))
+        self._residuals(br, mb_idx, cbp & 15, mb.levels, totals,
+                        decode=True)
+        self._residuals_chroma(br, mb_idx, cbp >> 4, mb.chroma_dc,
+                               mb.chroma_ac, tot_c, decode=True)
+        return mb, cur_qp
+
+    def _write_inter_mb(self, bw: BitWriter, mb: "MacroblockInter",
+                        mb_idx: int, prev_qp: int, n_ref: int,
+                        totals: np.ndarray, tot_c: np.ndarray) -> None:
+        bw.ue(mb.mb_type)
+        if mb.mb_type in (0, 1, 2):
+            for r in mb.refs:
+                self._write_ref(bw, r, n_ref)
+        else:
+            for st in mb.sub_types:
+                bw.ue(st)
+            if mb.mb_type == 3:
+                for r in mb.refs:
+                    self._write_ref(bw, r, n_ref)
+        for mx, my in mb.mvds:
+            bw.se(mx)
+            bw.se(my)
+        bw.ue(CBP_INTER_TO_CODE[mb.cbp])
+        if mb.cbp:
+            delta = mb.qp - prev_qp
+            if not -26 <= delta <= 25:
+                raise ValueError("mb_qp_delta out of range")
+            bw.se(delta)
+        self._residuals(bw, mb_idx, mb.cbp & 15, mb.levels, totals,
+                        decode=False)
+        self._residuals_chroma(bw, mb_idx, mb.cbp >> 4, mb.chroma_dc,
+                               mb.chroma_ac, tot_c, decode=False)
+
+    def parse_mbs(self, br: BitReader, slice_qp: int, first_mb: int = 0,
+                  hdr: "SliceHeader | None" = None) -> "list":
         """Walk the slice's MBs from ``first_mb`` until the RBSP stop bit
         (7.3.4 moreDataFlag for CAVLC).  nC contexts start fresh — MBs of
         other slices are unavailable neighbors (6.4.9), which the grids'
-        untouched −1 cells encode exactly."""
+        untouched −1 cells encode exactly.  With a P ``hdr``, each
+        iteration consumes the leading mb_skip_run and inter MB types;
+        intra mb_types arrive offset by 5 (Table 7-13)."""
         n_mbs = self.sps.width_mbs * self.sps.height_mbs
         totals, tot_c = self._fresh_totals()
         mbs = []
         cur_qp = slice_qp
-        for mb_idx in range(first_mb, n_mbs):
+        is_p = hdr is not None and hdr.is_p
+        n_ref = hdr.num_ref_l0(self.pps) if is_p else 1
+        mb_idx = first_mb
+        while mb_idx < n_mbs:
             if mbs and not br.more_rbsp_data():
                 break                   # end of this slice's MB data
+            if is_p:
+                run = br.ue()           # mb_skip_run
+                if mb_idx + run > n_mbs:
+                    raise ValueError("skip run overruns picture")
+                for _ in range(run):
+                    self._mark_skip_nc(mb_idx, totals, tot_c)
+                    mbs.append(MacroblockPSkip())
+                    mb_idx += 1
+                if not br.more_rbsp_data():
+                    break               # slice ends on a skip run
+                if mb_idx >= n_mbs:
+                    raise ValueError("MB data past picture end")
             mb_type = br.ue()
+            if is_p and mb_type < 5:
+                mb, cur_qp = self._parse_inter_mb(
+                    br, mb_type, mb_idx, cur_qp, n_ref, totals, tot_c)
+                mbs.append(mb)
+                mb_idx += 1
+                continue
+            if is_p:
+                mb_type -= 5            # intra types ride offset by 5
             if mb_type == 0:
                 modes = []
                 for _ in range(16):
@@ -386,18 +624,34 @@ class SliceCodec:
                 mbs.append(mb16)
             else:
                 raise ValueError(
-                    f"mb_type {mb_type} unsupported (intra-only scope)")
+                    f"mb_type {mb_type} unsupported (I/P scope)")
+            mb_idx += 1
         return mbs
 
-    def write_mbs(self, bw: BitWriter,
-                  mbs: "list[MacroblockI4x4 | MacroblockI16x16]",
-                  slice_qp: int, first_mb: int = 0) -> None:
+    def write_mbs(self, bw: BitWriter, mbs: "list", slice_qp: int,
+                  first_mb: int = 0,
+                  hdr: "SliceHeader | None" = None) -> None:
         totals, tot_c = self._fresh_totals()
         prev_qp = slice_qp               # deltas are vs the PREVIOUS MB's
-        for mb_idx, mb in enumerate(mbs, start=first_mb):  # QP (7.4.5),
-            # not the slice QP
+        is_p = hdr is not None and hdr.is_p  # QP (7.4.5), not slice QP
+        n_ref = hdr.num_ref_l0(self.pps) if is_p else 1
+        run = 0
+        for mb_idx, mb in enumerate(mbs, start=first_mb):
+            if isinstance(mb, MacroblockPSkip):
+                self._mark_skip_nc(mb_idx, totals, tot_c)
+                run += 1
+                continue
+            if is_p:
+                bw.ue(run)               # mb_skip_run before every coded
+                run = 0                  # MB of a P slice (7.3.4)
+            if isinstance(mb, MacroblockInter):
+                self._write_inter_mb(bw, mb, mb_idx, prev_qp, n_ref,
+                                     totals, tot_c)
+                if mb.cbp:
+                    prev_qp = mb.qp
+                continue
             if isinstance(mb, MacroblockI16x16):
-                bw.ue(mb.mb_type)
+                bw.ue(mb.mb_type + (5 if is_p else 0))
                 bw.ue(mb.chroma_mode)
                 delta = mb.qp - prev_qp
                 if not -26 <= delta <= 25:
@@ -409,7 +663,7 @@ class SliceCodec:
                                        mb.chroma_dc, mb.chroma_ac,
                                        tot_c, decode=False)
                 continue
-            bw.ue(0)                     # mb_type I_4x4
+            bw.ue(5 if is_p else 0)      # mb_type I_4x4
             for flag, rem in mb.pred_modes:
                 bw.write_bit(flag)
                 if not flag:
@@ -429,6 +683,8 @@ class SliceCodec:
             self._residuals_chroma(bw, mb_idx, mb.cbp >> 4,
                                    mb.chroma_dc, mb.chroma_ac,
                                    tot_c, decode=False)
+        if is_p and run:
+            bw.ue(run)                   # slice ends on a skip run
 
     def _nc_at(self, totals: np.ndarray, gx: int, gy: int) -> int:
         w4 = totals.shape[1]

@@ -29,7 +29,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
-from .h264_intra import MacroblockI16x16, Pps, SliceCodec, Sps
+from .h264_intra import (MacroblockI16x16, MacroblockPSkip, Pps,
+                         SliceCodec, Sps)
 from .h264_transform import (chroma_qp, requant_chroma_scalar,
                              requant_levels_scalar)
 
@@ -207,12 +208,14 @@ class SliceRequantizer:
             br = BitReader(nal_to_rbsp(nal[1:]))
             hdr = codec.parse_slice_header(br, nal[0])
             qp_in_base = hdr.qp
-            mbs = codec.parse_mbs(br, qp_in_base, hdr.first_mb)
+            mbs = codec.parse_mbs(br, qp_in_base, hdr.first_mb, hdr)
         qp_out_base = qp_in_base + self.delta_qp
         # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5):
-        # the ceiling check covers the true per-MB maxima
-        if max((mb.qp for mb in mbs), default=qp_in_base) \
-                + self.delta_qp > 51:
+        # the ceiling check covers the true per-MB maxima; P_Skip MBs
+        # carry no QP
+        if max((mb.qp for mb in mbs
+                if not isinstance(mb, MacroblockPSkip)),
+               default=qp_in_base) + self.delta_qp > 51:
             raise ValueError("qp already at ladder ceiling")
 
         # gather every block with its per-MB source/target QP; the +6k
@@ -224,6 +227,8 @@ class SliceRequantizer:
         qps = []
         row_map = []                   # (mb_index, kind, blk)
         for i, mb in enumerate(mbs):
+            if isinstance(mb, MacroblockPSkip):
+                continue               # no residual, nothing to shift
             if isinstance(mb, MacroblockI16x16):
                 all_levels.append(mb.dc_levels[None, :])
                 row_map.append((i, "dc", 0))
@@ -278,13 +283,15 @@ class SliceRequantizer:
                 mbs[i].chroma_ac = a2[j]
 
         for mb in mbs:
+            if isinstance(mb, MacroblockPSkip):
+                continue
             ccbp = (2 if np.any(mb.chroma_ac) else
                     1 if np.any(mb.chroma_dc) else 0)
             if isinstance(mb, MacroblockI16x16):
                 mb.luma_cbp15 = bool(np.any(mb.ac_levels))
                 mb.chroma_cbp = ccbp
-            else:
-                cbp = 0
+            else:                      # I_4x4 and inter share the CBP
+                cbp = 0                # recompute shape
                 for g in range(4):
                     if np.any(mb.levels[4 * g:4 * g + 4]):
                         cbp |= 1 << g
@@ -295,6 +302,6 @@ class SliceRequantizer:
                                            qp_out_base), n_blocks
         bw = BitWriter()
         codec.write_slice_header(bw, hdr, qp_out_base)
-        codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb)
+        codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb, hdr)
         bw.rbsp_trailing()
         return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes()), n_blocks

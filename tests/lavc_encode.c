@@ -1,0 +1,79 @@
+/* Test-only x264 encode shim: real IPPP H.264 streams for the requant
+ * tests, produced by an INDEPENDENT encoder (system libavcodec's libx264
+ * wrapper), so the P-slice parse/re-encode walk is proven against
+ * bitstreams our own encoder did not shape.  Built on demand by
+ * tests/lavc_encode.py (gcc -shared, links the distro's libavcodec dev
+ * symlinks); never part of the shipped package.
+ *
+ * Input: n_frames tightly packed YUV420P frames.  Output: one Annex-B
+ * elementary stream (SPS/PPS inline, no global header).  Returns bytes
+ * written, or a negative lavc error. */
+
+#include <libavcodec/avcodec.h>
+#include <libavutil/opt.h>
+#include <string.h>
+
+int lavc_x264_encode(const unsigned char *yuv, int width, int height,
+                     int n_frames, const char *profile,
+                     const char *x264_params,
+                     unsigned char *out, int out_cap) {
+    const AVCodec *codec = avcodec_find_encoder_by_name("libx264");
+    if (!codec) return -1;
+    AVCodecContext *ctx = avcodec_alloc_context3(codec);
+    if (!ctx) return -2;
+    ctx->width = width;
+    ctx->height = height;
+    ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+    ctx->time_base = (AVRational){1, 30};
+    ctx->framerate = (AVRational){30, 1};
+    ctx->thread_count = 1;
+    if (profile && profile[0])
+        av_opt_set(ctx->priv_data, "profile", profile, 0);
+    if (x264_params && x264_params[0])
+        av_opt_set(ctx->priv_data, "x264-params", x264_params, 0);
+    int rc = avcodec_open2(ctx, codec, NULL);
+    if (rc < 0) { avcodec_free_context(&ctx); return rc; }
+
+    AVFrame *frame = av_frame_alloc();
+    AVPacket *pkt = av_packet_alloc();
+    frame->format = AV_PIX_FMT_YUV420P;
+    frame->width = width;
+    frame->height = height;
+    rc = av_frame_get_buffer(frame, 0);
+    size_t luma = (size_t)width * height, chroma = luma / 4;
+    int total = 0;
+    for (int f = 0; rc >= 0 && f <= n_frames; f++) {
+        AVFrame *send = NULL;
+        if (f < n_frames) {
+            av_frame_make_writable(frame);
+            const unsigned char *src = yuv + (size_t)f * (luma + 2 * chroma);
+            for (int r = 0; r < height; r++)
+                memcpy(frame->data[0] + (size_t)r * frame->linesize[0],
+                       src + (size_t)r * width, width);
+            for (int c = 0; c < 2; c++) {
+                const unsigned char *p = src + luma + (size_t)c * chroma;
+                for (int r = 0; r < height / 2; r++)
+                    memcpy(frame->data[1 + c]
+                               + (size_t)r * frame->linesize[1 + c],
+                           p + (size_t)r * (width / 2), width / 2);
+            }
+            frame->pts = f;
+            send = frame;
+        }
+        rc = avcodec_send_frame(ctx, send);   /* NULL at the end: flush */
+        if (rc < 0) break;
+        for (;;) {
+            int r2 = avcodec_receive_packet(ctx, pkt);
+            if (r2 == AVERROR(EAGAIN) || r2 == AVERROR_EOF) break;
+            if (r2 < 0) { rc = r2; break; }
+            if (total + pkt->size > out_cap) { rc = -1000; break; }
+            memcpy(out + total, pkt->data, pkt->size);
+            total += pkt->size;
+            av_packet_unref(pkt);
+        }
+    }
+    av_packet_free(&pkt);
+    av_frame_free(&frame);
+    avcodec_free_context(&ctx);
+    return rc < 0 && rc != AVERROR_EOF ? rc : total;
+}

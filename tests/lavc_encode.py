@@ -1,0 +1,144 @@
+"""Real-world H.264 test streams from the system libx264 (via a small C
+shim built on demand against the distro's libavcodec headers).
+
+The P-slice requant walk must be proven against bitstreams an
+INDEPENDENT encoder shaped — x264 picks motion vectors, partitions,
+skip runs and reference structures our own intra-only encoder never
+emits.  ``encode_ippp`` returns the Annex-B NAL list plus helpers to
+split per access unit."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "lavc_encode_shim.so")   # NOT lavc_encode.so:
+# a C library named like the Python module shadows it on import
+_SRC = os.path.join(_DIR, "lavc_encode.c")
+_lib = None
+
+
+def available() -> bool:
+    try:
+        return _load() is not None
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        inc = "/usr/include/x86_64-linux-gnu"
+        subprocess.run(
+            ["gcc", "-O2", "-shared", "-fPIC", "-I", inc, "-o", _SO, _SRC,
+             "-lavcodec", "-lavutil"],
+            check=True, capture_output=True, timeout=120)
+    lib = ctypes.CDLL(_SO)
+    lib.lavc_x264_encode.restype = ctypes.c_int
+    lib.lavc_x264_encode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+#: x264 restricted to the requant rung's documented scope: no B slices,
+#: no explicit weighted prediction, 4x4 transform only, single thread
+#: (deterministic), no adaptive I refresh.  qp is CQP so every slice
+#: shares a predictable QP ceiling.
+def scope_params(qp: int = 28, *, cabac: bool, keyint: int = 30,
+                 slices: int = 1, ref: int = 1, extra: str = "") -> str:
+    p = (f"qp={qp}:cabac={int(cabac)}:bframes=0:weightp=0:8x8dct=0:"
+         f"keyint={keyint}:min-keyint={keyint}:scenecut=0:ref={ref}:"
+         f"slices={slices}:threads=1:sliced-threads=0:rc-lookahead=0:"
+         f"interlaced=0:nal-hrd=none:aud=0:repeat-headers=1")
+    return p + (":" + extra if extra else p[len(p):] or "")
+
+
+def moving_scene(width: int, height: int, n_frames: int,
+                 seed: int = 7) -> np.ndarray:
+    """Packed YUV420P frames with real structure and motion: a drifting
+    gradient, a moving textured square, and static noise — gives x264
+    genuine MVs, skips, and residuals in every frame."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 40, (height, width), dtype=np.uint8)
+    yy, xx = np.mgrid[0:height, 0:width]
+    tex = rng.integers(0, 255, (64, 64), dtype=np.uint8)
+    frames = []
+    for f in range(n_frames):
+        y = (base + ((xx + 2 * f) % 256) // 2 + yy // 4).astype(np.uint8)
+        px = (13 + 5 * f) % max(1, width - 64)
+        py = (11 + 3 * f) % max(1, height - 64)
+        y[py:py + 64, px:px + 64] = tex
+        u = np.full((height // 2, width // 2), 110, dtype=np.uint8)
+        v = ((xx[::2, ::2] + f) % 160 + 40).astype(np.uint8)
+        u[py // 2:py // 2 + 16, px // 2:px // 2 + 16] = 80
+        frames.append(np.concatenate(
+            [y.ravel(), u.ravel(), v.ravel()]))
+    return np.concatenate(frames)
+
+
+def encode_ippp(width: int = 192, height: int = 192, n_frames: int = 12,
+                qp: int = 28, *, cabac: bool = False, keyint: int = 30,
+                slices: int = 1, ref: int = 1, profile: str = "",
+                extra: str = "", yuv: np.ndarray | None = None
+                ) -> list[bytes]:
+    """Encode a synthetic moving scene as an IPPP elementary stream;
+    returns the Annex-B NAL payload list (start codes stripped)."""
+    lib = _load()
+    if yuv is None:
+        yuv = moving_scene(width, height, n_frames)
+    cap = len(yuv) + (1 << 20)
+    out = (ctypes.c_ubyte * cap)()
+    params = scope_params(qp, cabac=cabac, keyint=keyint, slices=slices,
+                          ref=ref, extra=extra)
+    n = lib.lavc_x264_encode(
+        np.ascontiguousarray(yuv).tobytes(), width, height, n_frames,
+        profile.encode(), params.encode(), out, cap)
+    if n <= 0:
+        raise RuntimeError(f"x264 encode failed: {n}")
+    return split_annexb(bytes(out[:n]))
+
+
+def split_annexb(data: bytes) -> list[bytes]:
+    """Annex-B buffer → NAL payloads (start codes stripped)."""
+    nals = []
+    i = data.find(b"\x00\x00\x01")
+    while i >= 0:
+        j = data.find(b"\x00\x00\x01", i + 3)
+        end = j if j >= 0 else len(data)
+        while end > i + 3 and data[end - 1] == 0:
+            end -= 1                    # trailing zero bytes of next SC
+        nals.append(data[i + 3:end])
+        i = j
+    return [n for n in nals if n]
+
+
+def split_aus(nals: list[bytes]) -> list[list[bytes]]:
+    """Group NALs into access units: every slice NAL with
+    first_mb_in_slice == 0 starts a new AU; parameter sets ride with
+    the following AU."""
+    aus: list[list[bytes]] = []
+    pending: list[bytes] = []
+    for nal in nals:
+        t = nal[0] & 0x1F
+        if t in (1, 5):
+            first_mb_zero = bool(nal[1] & 0x80)   # ue(v)==0 ⇔ first bit 1
+            if first_mb_zero or not aus:
+                aus.append(pending + [nal])
+                pending = []
+            else:
+                aus[-1].append(nal)
+        elif t in (7, 8):
+            pending.append(nal)
+        # drop SEI/AUD etc. for the requant tests
+    if pending and aus:
+        aus[-1].extend(pending)
+    return aus

@@ -98,3 +98,54 @@ class LavcH264Decoder:
         finally:
             f = ctypes.c_void_p(frame)
             self.avu.av_frame_free(ctypes.byref(f))
+
+
+class LavcH264StreamDecoder(LavcH264Decoder):
+    """Multi-AU variant for IPPP streams: feed every access unit, then
+    flush, collecting ALL frames — still err_detect=explode, so any
+    P-slice syntax desync fails the decode instead of being concealed."""
+
+    def decode_stream(self, aus: "list[list[bytes]]", width: int,
+                      height: int
+                      ) -> "list[tuple[np.ndarray, np.ndarray, np.ndarray]]":
+        frames = []
+
+        def _drain():
+            while True:
+                frame = self.avu.av_frame_alloc()
+                try:
+                    if self.avc.avcodec_receive_frame(self.ctx, frame) < 0:
+                        return
+                    datap = (ctypes.c_void_p * 8).from_address(frame)
+                    lines = (ctypes.c_int * 8).from_address(frame + 64)
+                    planes = []
+                    for i, (w, h) in enumerate(((width, height),
+                                                (width // 2, height // 2),
+                                                (width // 2, height // 2))):
+                        if not datap[i]:
+                            raise RuntimeError("missing plane")
+                        ls = lines[i]
+                        raw = ctypes.string_at(datap[i], ls * h)
+                        planes.append(np.frombuffer(raw, dtype=np.uint8)
+                                      .reshape(h, ls)[:, :w].copy())
+                    frames.append(tuple(planes))
+                finally:
+                    f = ctypes.c_void_p(frame)
+                    self.avu.av_frame_free(ctypes.byref(f))
+
+        for au in aus:
+            data = b"".join(b"\x00\x00\x00\x01" + n for n in au)
+            buf = self.avu.av_malloc(len(data) + 64)
+            ctypes.memmove(buf, data, len(data))
+            pkt = self.avc.av_packet_alloc()
+            if self.avc.av_packet_from_data(pkt, buf, len(data)) < 0:
+                raise RuntimeError("av_packet_from_data failed")
+            rc = self.avc.avcodec_send_packet(self.ctx, pkt)
+            p = ctypes.c_void_p(pkt)
+            self.avc.av_packet_free(ctypes.byref(p))
+            if rc < 0:
+                raise RuntimeError(f"lavc refused AU: {rc}")
+            _drain()
+        self.avc.avcodec_send_packet(self.ctx, None)
+        _drain()
+        return frames

@@ -1,0 +1,157 @@
+"""P-slice (inter) requant tests against INDEPENDENT bitstreams.
+
+Every stream here is encoded by the system libx264 (tests/lavc_encode.py
+shim) — motion vectors, partitions, skip runs, and reference structures
+our own intra-only encoder never produces — and every requant output is
+decoded through libavcodec with ``err_detect=explode``
+(tests/lavc_oracle.py), so a single P-syntax desync fails the test
+rather than being concealed.
+
+Reference anchor: the reference has no transcode at all; its deepest
+H.264 bitstream work is the keyframe classification in
+``QTSSReflectorModule/ReflectorStream.cpp:1403-1480``.  BASELINE
+config 5 ("H.264→H.264 bitrate ladder") is the scope this implements,
+now covering the IPPP GOPs real cameras emit."""
+
+import numpy as np
+import pytest
+
+import lavc_encode as le
+from easydarwin_tpu.codecs.h264_bits import (BitReader, BitWriter,
+                                             nal_to_rbsp, rbsp_to_nal)
+from easydarwin_tpu.codecs.h264_intra import (MacroblockInter,
+                                              MacroblockPSkip, Pps,
+                                              SliceCodec, Sps, psnr)
+from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
+
+pytestmark = pytest.mark.skipif(not le.available(),
+                                reason="x264 encode shim unavailable")
+
+W = H = 192
+
+
+def _ps(nals):
+    sps = Sps.parse(next(n for n in nals if n[0] & 0x1F == 7))
+    pps = Pps.parse(next(n for n in nals if n[0] & 0x1F == 8))
+    return sps, pps
+
+
+def _roundtrip_all(nals):
+    """Parse + re-serialize every slice unchanged; must be byte-exact
+    (CAVLC codes are canonical, so identical values ⇒ identical bits)."""
+    sps, pps = _ps(nals)
+    codec = SliceCodec(sps, pps)
+    n = 0
+    for nal in nals:
+        if nal[0] & 0x1F not in (1, 5):
+            continue
+        br = BitReader(nal_to_rbsp(nal[1:]))
+        hdr = codec.parse_slice_header(br, nal[0])
+        mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb, hdr)
+        bw = BitWriter()
+        codec.write_slice_header(bw, hdr, hdr.qp)
+        codec.write_mbs(bw, mbs, hdr.qp, hdr.first_mb, hdr)
+        bw.rbsp_trailing()
+        assert bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes()) == nal
+        n += 1
+    return n
+
+
+def test_p_slice_roundtrip_byte_exact():
+    nals = le.encode_ippp(W, H, 8, qp=28, cabac=False)
+    assert _roundtrip_all(nals) == 8
+
+
+def test_p_slice_roundtrip_multislice_and_multiref():
+    """2 slices per picture exercise slice-scoped contexts and non-zero
+    first_mb; ref=3 exercises coded ref_idx (te(v) beyond 1 bit)."""
+    nals = le.encode_ippp(W, H, 8, qp=30, cabac=False, slices=2, ref=3)
+    assert _roundtrip_all(nals) == 16
+
+
+def test_p_slice_roundtrip_static_scene_mostly_skip():
+    """A still scene makes P frames almost pure skip runs (including
+    slices that END on a skip run)."""
+    yuv = le.moving_scene(W, H, 1).reshape(1, -1)
+    still = np.repeat(yuv, 6, axis=0).ravel()
+    nals = le.encode_ippp(W, H, 6, qp=28, cabac=False, yuv=still)
+    sps, pps = _ps(nals)
+    codec = SliceCodec(sps, pps)
+    p_nal = [n for n in nals if n[0] & 0x1F == 1][0]
+    br = BitReader(nal_to_rbsp(p_nal[1:]))
+    hdr = codec.parse_slice_header(br, p_nal[0])
+    mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb, hdr)
+    assert sum(isinstance(m, MacroblockPSkip) for m in mbs) > len(mbs) // 2
+    assert _roundtrip_all(nals) == 6
+
+
+def test_ippp_requant_decodes_clean_and_sheds_bitrate():
+    """The flagship gap (VERDICT r4 #1): a real IPPP stream must flow
+    through the rung with P slices REQUANTED (zero pass-through), decode
+    bit-clean through the independent oracle, and actually shed rate."""
+    from lavc_oracle import LavcH264StreamDecoder
+
+    nals = le.encode_ippp(W, H, 10, qp=26, cabac=False)
+    rq = SliceRequantizer(6, prefer_native=False)
+    out = [rq.transform_nal(n) for n in nals]
+    assert rq.stats.slices_requantized == 10
+    assert rq.stats.slices_passed_through == 0
+    orig = LavcH264StreamDecoder().decode_stream(le.split_aus(nals), W, H)
+    requ = LavcH264StreamDecoder().decode_stream(le.split_aus(out), W, H)
+    assert len(orig) == len(requ) == 10
+    # rate must genuinely drop on the P frames, not only on the IDR
+    p_in = sum(len(n) for n in nals[4:])     # skip SPS/PPS/SEI/IDR
+    p_out = sum(len(n) for n in out[4:])
+    assert p_out < 0.8 * p_in
+    # open-loop drift is bounded: stays watchable across the GOP
+    for a, b in zip(orig, requ):
+        assert psnr(a[0], b[0]) > 20.0
+
+
+def test_p_requant_preserves_motion_and_skip_structure():
+    """Requant must never touch motion syntax: MV deltas, ref indices,
+    sub-types, and the skip map survive a +6 rung bit-for-bit."""
+    nals = le.encode_ippp(W, H, 6, qp=26, cabac=False)
+    sps, pps = _ps(nals)
+    codec = SliceCodec(sps, pps)
+
+    def motion_map(slice_nals):
+        out = []
+        for nal in slice_nals:
+            br = BitReader(nal_to_rbsp(nal[1:]))
+            hdr = codec.parse_slice_header(br, nal[0])
+            mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb, hdr)
+            for m in mbs:
+                if isinstance(m, MacroblockPSkip):
+                    out.append("skip")
+                elif isinstance(m, MacroblockInter):
+                    out.append((m.mb_type, tuple(m.refs),
+                                tuple(m.mvds),
+                                tuple(m.sub_types or ())))
+                else:
+                    out.append("intra")
+        return out
+
+    rq = SliceRequantizer(6, prefer_native=False)
+    out = [rq.transform_nal(n) for n in nals]
+    p_in = [n for n in nals if n[0] & 0x1F == 1]
+    p_out = [n for n in out if n[0] & 0x1F == 1]
+    assert motion_map(p_in) == motion_map(p_out)
+
+
+def test_weighted_pred_stream_passes_through():
+    """weightp=2 puts explicit weight tables in P headers — outside the
+    rung's scope, so the stream must pass through UNCHANGED, never be
+    half-parsed."""
+    nals = le.encode_ippp(W, H, 6, qp=26, cabac=False,
+                          extra="weightp=2")
+    pps = Pps.parse(next(n for n in nals if n[0] & 0x1F == 8))
+    if not pps.weighted_pred:
+        pytest.skip("x264 did not enable weighted_pred on this content")
+    rq = SliceRequantizer(6, prefer_native=False)
+    for n in nals:
+        t = n[0] & 0x1F
+        out = rq.transform_nal(n)
+        if t == 1:
+            assert out == n
+    assert rq.stats.slices_passed_through > 0
