@@ -1398,10 +1398,13 @@ struct CabacNb {
   }
 
   int chroma_pred_inc(int mb) const {
+    // 9.3.3.1.1.8: condTermFlagA + condTermFlagB — both neighbors add 1
+    // (not the A + 2B pattern of cbf/cbp; the A+2B form truncated real
+    // encoder streams at the first MB with two nonzero-mode neighbors)
     int inc = 0;
     int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
     if (a >= 0 && cmode[a] != 0) inc += 1;
-    if (b >= 0 && cmode[b] != 0) inc += 2;
+    if (b >= 0 && cmode[b] != 0) inc += 1;
     return inc;
   }
 

@@ -9,18 +9,21 @@ through untouched (QP lives in the slice header).  Prediction drift is
 accepted and resets at every IDR, which in the all-intra camera configs
 this ladder targets means every frame.
 
-Scope: intra slices (I_4x4 and I_16x16 macroblocks) in BOTH entropy
-layers — CAVLC and CABAC (``h264_cabac``, dispatched on the PPS's
-entropy_coding_mode_flag) — including multi-slice pictures (each slice
-requants independently from its ``first_mb_in_slice``, contexts
-slice-scoped) — with luma AND 4:2:0 chroma residuals (luma steps by the
-exact +6k shift; chroma follows the Table 8-15 QPc mapping with a
-three-way identity / exact-shift / integer-round-trip dispatch — see
-``h264_transform.requant_chroma_scalar``).  I_16x16 needs QPY ≥ 12
+Scope: I AND P slices in BOTH entropy layers — CAVLC and CABAC
+(``h264_cabac``, dispatched on the PPS's entropy_coding_mode_flag) —
+including multi-slice pictures (each slice requants independently from
+its ``first_mb_in_slice``, contexts slice-scoped) — with luma AND
+4:2:0 chroma residuals (luma steps by the exact +6k shift; chroma
+follows the Table 8-15 QPc mapping with a three-way identity /
+exact-shift / integer-round-trip dispatch — see
+``h264_transform.requant_chroma_scalar``).  P slices requant their
+residuals only: motion syntax (mb_type, sub-types, ref_idx, mvd) and
+the skip map ride through verbatim, so prediction is untouched and
+drift stays open-loop (resets at each IDR).  I_16x16 needs QPY ≥ 12
 (the exact-shift DC dequant window).  Streams outside the profile
-(inter slices, 8x8 transform, scaling matrices, low-QP I_16x16) PASS
-THROUGH unchanged and are counted — the rung never corrupts what it
-cannot parse."""
+(B slices, weighted prediction, 8x8 transform, scaling matrices,
+low-QP I_16x16) PASS THROUGH unchanged and are counted — the rung
+never corrupts what it cannot parse."""
 
 from __future__ import annotations
 

@@ -139,6 +139,70 @@ def test_p_requant_preserves_motion_and_skip_structure():
     assert motion_map(p_in) == motion_map(p_out)
 
 
+def _cabac_roundtrip(nals):
+    """CABAC re-encode must reproduce x264's bytes up to the final
+    flush byte (the terminate flush padding is encoder-specific; every
+    decodable bin must match, which the byte prefix proves)."""
+    from easydarwin_tpu.codecs.h264_cabac import CabacSliceCodec
+
+    sps, pps = _ps(nals)
+    codec = CabacSliceCodec(sps, pps)
+    n = 0
+    for nal in nals:
+        if nal[0] & 0x1F not in (1, 5):
+            continue
+        hdr, first, mbs, qps = codec.parse_slice(nal)
+        out = codec.write_slice(hdr, first, mbs, hdr.qp)
+        assert len(out) == len(nal) and out[:-1] == nal[:-1]
+        n += 1
+    return n
+
+
+def test_cabac_p_slice_roundtrip():
+    nals = le.encode_ippp(W, H, 8, qp=28, cabac=True)
+    assert _cabac_roundtrip(nals) == 8
+
+
+def test_cabac_p_slice_roundtrip_multislice_multiref():
+    nals = le.encode_ippp(W, H, 8, qp=30, cabac=True, slices=2, ref=3)
+    assert _cabac_roundtrip(nals) == 16
+
+
+def test_cabac_ippp_requant_decodes_clean():
+    """CABAC IPPP through the rung: zero pass-through, bit-clean decode
+    via the explode oracle, real bitrate drop on P frames."""
+    from lavc_oracle import LavcH264StreamDecoder
+
+    nals = le.encode_ippp(W, H, 10, qp=26, cabac=True)
+    rq = SliceRequantizer(6, prefer_native=False)
+    out = [rq.transform_nal(n) for n in nals]
+    assert rq.stats.slices_requantized == 10
+    assert rq.stats.slices_passed_through == 0
+    orig = LavcH264StreamDecoder().decode_stream(le.split_aus(nals), W, H)
+    requ = LavcH264StreamDecoder().decode_stream(le.split_aus(out), W, H)
+    assert len(orig) == len(requ) == 10
+    p_in = sum(len(n) for n in nals[4:])
+    p_out = sum(len(n) for n in out[4:])
+    assert p_out < 0.8 * p_in
+    for a, b in zip(orig, requ):
+        assert psnr(a[0], b[0]) > 18.0
+
+
+def test_cabac_x264_iframe_full_parse_regression():
+    """Chroma-pred ctxIdxInc regression (round-5 find): an x264 CABAC
+    I frame with nonzero chroma modes everywhere must parse to the FULL
+    MB count — the A+2B bug truncated the slice at the first MB whose
+    left and top neighbors both used nonzero chroma modes, leaving a
+    valid-looking but incomplete rewrite."""
+    from easydarwin_tpu.codecs.h264_cabac import CabacSliceCodec
+
+    nals = le.encode_ippp(W, H, 1, qp=26, cabac=True)
+    sps, pps = _ps(nals)
+    idr = next(n for n in nals if n[0] & 0x1F == 5)
+    hdr, first, mbs, qps = CabacSliceCodec(sps, pps).parse_slice(idr)
+    assert len(mbs) == sps.width_mbs * sps.height_mbs
+
+
 def test_weighted_pred_stream_passes_through():
     """weightp=2 puts explicit weight tables in P headers — outside the
     rung's scope, so the stream must pass through UNCHANGED, never be
