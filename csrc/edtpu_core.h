@@ -170,17 +170,19 @@ int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t delta_qp, int32_t chroma_qp_offset,
+    int32_t num_ref_l0_default, int32_t weighted_pred, int32_t *mbs_out,
     int32_t *blocks_out);
 
-/* CABAC I-slice variant of the requant walk (mirrors
- * codecs/h264_cabac.py bit-exactly; same contract/returns). */
+/* CABAC variant of the requant walk (mirrors codecs/h264_cabac.py
+ * bit-exactly; same contract/returns). */
 int32_t ed_h264_requant_slice_cabac(
     const uint8_t *nal, int32_t nal_len, uint8_t *out, int32_t out_cap,
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t delta_qp, int32_t chroma_qp_offset,
+    int32_t num_ref_l0_default, int32_t weighted_pred, int32_t *mbs_out,
     int32_t *blocks_out);
 
 /* ------------------------------------------------------------- timer wheel */

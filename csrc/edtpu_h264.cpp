@@ -758,21 +758,36 @@ struct SliceHeader {
   int32_t qp;
   uint32_t deblock_idc;
   int32_t deblock_alpha, deblock_beta;
+  // P-slice fields (7.3.3 + 7.3.3.1/7.3.3.3), round-tripped raw
+  bool is_p = false;
+  int num_ref_override = 0;
+  uint32_t num_ref_l0_minus1 = 0;
+  bool have_list_mod = false;
+  std::vector<uint32_t> list_mod;                // (idc, val) pairs
+  bool have_mmco = false;
+  std::vector<uint32_t> mmco;                    // op then its args
+  uint32_t cabac_init_idc = 0;
+  int n_ref = 1;                                 // active l0 count
 };
 
-// shared I-slice header parse (mirrors SliceCodec.parse_slice_header);
+// shared I/P slice header parse (mirrors SliceCodec.parse_slice_header);
 // 0 on success, kErr* otherwise
 int parse_islice_header(BitReader &br, int nal_type, int nal_ref_idc,
                         int32_t log2_max_frame_num, int32_t poc_type,
                         int32_t log2_max_poc_lsb, int32_t pic_init_qp,
                         int32_t deblocking_control,
                         int32_t bottom_field_poc, SliceHeader *h,
-                        uint32_t *first_mb) {
+                        uint32_t *first_mb, int32_t num_ref_l0_default = 0,
+                        int32_t weighted_pred = 0, int32_t cabac = 0) {
   h->nal_type = nal_type;
   h->nal_ref_idc = nal_ref_idc;
   *first_mb = br.ue();                             // first_mb_in_slice
   h->slice_type = static_cast<int>(br.ue());
-  if (h->slice_type % 5 != 2) return kErrUnsupported;
+  {
+    int st = h->slice_type % 5;
+    if (st != 2 && st != 0) return kErrUnsupported;
+    h->is_p = st == 0;
+  }
   br.ue();                                         // pps id
   h->frame_num = br.bits(log2_max_frame_num);
   if (nal_type == 5) h->idr_pic_id = br.ue();
@@ -782,13 +797,51 @@ int parse_islice_header(BitReader &br, int nal_type, int nal_ref_idc,
   } else if (poc_type == 1) {
     return kErrUnsupported;
   }
+  if (h->is_p) {
+    if (weighted_pred) return kErrUnsupported;     // explicit tables
+    h->num_ref_override = br.bit();
+    if (h->num_ref_override) h->num_ref_l0_minus1 = br.ue();
+    h->n_ref = 1 + static_cast<int>(
+                       h->num_ref_override
+                           ? h->num_ref_l0_minus1
+                           : static_cast<uint32_t>(num_ref_l0_default));
+    if (br.bit()) {                                // 7.3.3.1 list mod l0
+      h->have_list_mod = true;
+      for (;;) {
+        uint32_t idc = br.ue();
+        if (idc == 3) break;
+        if (idc > 3 || !br.ok) return kErrBitstream;
+        h->list_mod.push_back(idc);
+        h->list_mod.push_back(br.ue());
+        if (h->list_mod.size() > 128) return kErrBitstream;
+      }
+    }
+  }
   if (nal_ref_idc != 0) {
     if (nal_type == 5) {
       h->no_output_prior = br.bit();
       h->long_term_ref = br.bit();
-    } else if (br.bit()) {
-      return kErrUnsupported;                      // adaptive marking
+    } else if (br.bit()) {                         // MMCO loop (7.4.3.3)
+      h->have_mmco = true;
+      for (;;) {
+        uint32_t op = br.ue();
+        h->mmco.push_back(op);
+        if (op == 0) break;
+        if (op == 1 || op == 2 || op == 4 || op == 6) {
+          h->mmco.push_back(br.ue());
+        } else if (op == 3) {
+          h->mmco.push_back(br.ue());
+          h->mmco.push_back(br.ue());
+        } else if (op != 5) {
+          return kErrBitstream;
+        }
+        if (h->mmco.size() > 128 || !br.ok) return kErrBitstream;
+      }
     }
+  }
+  if (cabac && h->is_p) {
+    h->cabac_init_idc = br.ue();
+    if (h->cabac_init_idc > 2) return kErrBitstream;
   }
   h->qp = pic_init_qp + br.se();
   if (deblocking_control) {
@@ -806,22 +859,34 @@ void write_islice_header(BitWriter &bw, const SliceHeader &h,
                          uint32_t first_mb, int32_t pps_id,
                          int32_t qp_out_base, int32_t log2_max_frame_num,
                          int32_t poc_type, int32_t log2_max_poc_lsb,
-                         int32_t pic_init_qp,
-                         int32_t deblocking_control) {
+                         int32_t pic_init_qp, int32_t deblocking_control,
+                         int32_t cabac = 0) {
   bw.ue(first_mb);
   bw.ue(static_cast<uint32_t>(h.slice_type));
   bw.ue(static_cast<uint32_t>(pps_id));            // the latched PPS's id
   bw.bits(h.frame_num, log2_max_frame_num);
   if (h.nal_type == 5) bw.ue(h.idr_pic_id);
   if (poc_type == 0) bw.bits(h.poc_lsb, log2_max_poc_lsb);
+  if (h.is_p) {
+    bw.bit(h.num_ref_override);
+    if (h.num_ref_override) bw.ue(h.num_ref_l0_minus1);
+    bw.bit(h.have_list_mod ? 1 : 0);
+    if (h.have_list_mod) {
+      for (uint32_t v : h.list_mod) bw.ue(v);
+      bw.ue(3);
+    }
+  }
   if (h.nal_ref_idc != 0) {
     if (h.nal_type == 5) {
       bw.bit(h.no_output_prior);
       bw.bit(h.long_term_ref);
     } else {
-      bw.bit(0);
+      bw.bit(h.have_mmco ? 1 : 0);
+      if (h.have_mmco)
+        for (uint32_t v : h.mmco) bw.ue(v);
     }
   }
+  if (cabac && h.is_p) bw.ue(h.cabac_init_idc);
   bw.se(qp_out_base - pic_init_qp);
   if (deblocking_control) {
     bw.ue(h.deblock_idc);
@@ -839,8 +904,17 @@ extern "C" int32_t ed_h264_requant_slice(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t delta_qp, int32_t chroma_qp_offset,
+    int32_t num_ref_l0_default, int32_t weighted_pred, int32_t *mbs_out,
     int32_t *blocks_out) {
+  // FUSED single-pass walk (round-5): each MB is decoded, requantized
+  // and re-encoded before the next is touched — no slice-wide level
+  // store, no second walk.  Two small context grids (parse-side and
+  // write-side nC totals) replace the re-fill of one grid; everything
+  // the MB needs lives in ~1.5 KB of scratch that stays in L1.
+  // Covers I AND P slices (mirrors codecs/h264_requant.py byte for
+  // byte): P adds mb_skip_run copy-through, inter MB types 0-4 with
+  // motion syntax carried verbatim, and the Table 9-4 inter CBP map.
   if (nal_len < 2 || delta_qp < 6 || delta_qp % 6) return kErrUnsupported;
   uint8_t nal_byte = nal[0];
   int nal_type = nal_byte & 0x1F;
@@ -851,47 +925,38 @@ extern "C" int32_t ed_h264_requant_slice(
   strip_epb(nal + 1, nal_len - 1, rbsp);
   BitReader br(rbsp.data(), static_cast<int64_t>(rbsp.size()));
 
-  // ---- slice header (mirrors SliceCodec.parse_slice_header)
   SliceHeader h{};
   uint32_t first_mb = 0;
   int hrc = parse_islice_header(br, nal_type, nal_ref_idc,
                                 log2_max_frame_num, poc_type,
                                 log2_max_poc_lsb, pic_init_qp,
                                 deblocking_control, bottom_field_poc, &h,
-                                &first_mb);
+                                &first_mb, num_ref_l0_default,
+                                weighted_pred, 0);
   if (hrc) return hrc;
 
-  // ---- macroblock walk: decode, shift, re-encode in one pass.
-  // nC contexts depend on the NEW totals, so decode everything first
-  // (mirrors parse_mbs + write_mbs with the requant between).
   int n_mbs = width_mbs * height_mbs;
   int w4 = width_mbs * 4, h4 = height_mbs * 4;
-  // 17 level rows per MB: row 0 = I_16x16 DC, rows 1..16 = 4x4 blocks
-  // (16 coeffs for I_4x4 luma, 15 for I_16x16 AC)
-  std::vector<int16_t> all_levels(static_cast<size_t>(n_mbs) * 17 * 16);
-  std::vector<int32_t> mb_qp(n_mbs), mb_cbp(n_mbs);
-  std::vector<uint8_t> mb_is16(n_mbs), mb_pred16(n_mbs);
-  std::vector<uint8_t> mb_modes(static_cast<size_t>(n_mbs) * 16 * 2);
-  std::vector<uint32_t> mb_chroma(n_mbs);
-  std::vector<int16_t> totals(static_cast<size_t>(h4) * w4, -1);
-  // chroma residual state: per-component DC rows (16-wide, 4 used),
-  // AC rows (4×16, 15 used), post-requant chroma CBP, nC context grids
   int w2 = width_mbs * 2, h2 = height_mbs * 2;
-  std::vector<int16_t> cdc(static_cast<size_t>(n_mbs) * 2 * 16);
-  std::vector<int16_t> cac(static_cast<size_t>(n_mbs) * 2 * 4 * 16);
-  std::vector<uint8_t> mb_ccbp(n_mbs);
-  std::vector<int16_t> tot_c(static_cast<size_t>(2) * h2 * w2, -1);
+  if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;
+  // parse-side and write-side nC context grids (write contexts depend
+  // on POST-requant totals, so they are tracked separately)
+  std::vector<int16_t> tin(static_cast<size_t>(h4) * w4, -1);
+  std::vector<int16_t> tout(static_cast<size_t>(h4) * w4, -1);
+  std::vector<int16_t> cin(static_cast<size_t>(2) * h2 * w2, -1);
+  std::vector<int16_t> cout_(static_cast<size_t>(2) * h2 * w2, -1);
 
-  auto nc_at = [&](int gx, int gy) -> int {
-    int nA = gx > 0 ? totals[static_cast<size_t>(gy) * w4 + gx - 1] : -1;
-    int nB = gy > 0 ? totals[static_cast<size_t>(gy - 1) * w4 + gx] : -1;
+  auto nc_at = [&](const std::vector<int16_t> &g, int gx, int gy) -> int {
+    int nA = gx > 0 ? g[static_cast<size_t>(gy) * w4 + gx - 1] : -1;
+    int nB = gy > 0 ? g[static_cast<size_t>(gy - 1) * w4 + gx] : -1;
     if (nA >= 0 && nB >= 0) return (nA + nB + 1) >> 1;
     if (nA >= 0) return nA;
     if (nB >= 0) return nB;
     return 0;
   };
-  auto nc_at_c = [&](int comp, int gx, int gy) -> int {
-    const int16_t *g = &tot_c[static_cast<size_t>(comp) * h2 * w2];
+  auto nc_at_c = [&](const std::vector<int16_t> &g0, int comp, int gx,
+                     int gy) -> int {
+    const int16_t *g = &g0[static_cast<size_t>(comp) * h2 * w2];
     int nA = gx > 0 ? g[static_cast<size_t>(gy) * w2 + gx - 1] : -1;
     int nB = gy > 0 ? g[static_cast<size_t>(gy - 1) * w2 + gx] : -1;
     if (nA >= 0 && nB >= 0) return (nA + nB + 1) >> 1;
@@ -905,268 +970,410 @@ extern "C" int32_t ed_h264_requant_slice(
     if (q > 51) q = 51;
     return kChromaQp[q];
   };
-  // parse (decode=true) or emit (decode=false) one MB's chroma
-  // residuals in 7.3.5.3.3 order, requantizing right after parse; on
-  // the emit side tot_c carries the POST-requant TotalCoeff contexts.
-  BitWriter *cw = nullptr;           // set during the encode pass
-  auto chroma_mb = [&](void *bio, int mb, int ccbp, int32_t qpy,
-                       bool decode) -> bool {
-    int mbx2 = (mb % width_mbs) * 2, mby2 = (mb / width_mbs) * 2;
-    int16_t *dcrows = &cdc[static_cast<size_t>(mb) * 2 * 16];
-    int16_t *acrows = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
-    if (ccbp) {
-      for (int comp = 0; comp < 2; ++comp) {
-        if (decode) {
-          if (!decode_residual_n(*static_cast<BitReader *>(bio), -1,
-                                 dcrows + comp * 16, 4))
-            return false;
-        } else if (!encode_residual_n(*cw, dcrows + comp * 16, -1, 4)) {
-          return false;
-        }
-      }
-    }
-    for (int comp = 0; comp < 2; ++comp) {
-      int16_t *g = &tot_c[static_cast<size_t>(comp) * h2 * w2];
-      for (int b = 0; b < 4; ++b) {
-        int gx = mbx2 + (b & 1), gy = mby2 + (b >> 1);
-        int16_t *lv = acrows + (comp * 4 + b) * 16;
-        if (ccbp != 2) {
-          g[static_cast<size_t>(gy) * w2 + gx] = 0;
-          continue;
-        }
-        int nC = nc_at_c(comp, gx, gy);
-        int tot;
-        if (decode) {
-          if (!decode_residual_n(*static_cast<BitReader *>(bio), nC, lv,
-                                 15, &tot))
-            return false;
-        } else if (!encode_residual_n(*cw, lv, nC, 15, &tot)) {
-          return false;
-        }
-        g[static_cast<size_t>(gy) * w2 + gx] = static_cast<int16_t>(tot);
-      }
-    }
-    if (decode) {
-      if (!ccbp) {                     // nothing parsed, nothing to shift
-        mb_ccbp[mb] = 0;
-        return true;
-      }
-      for (int comp = 0; comp < 2; ++comp)
-        chroma_requant_comp(dcrows + comp * 16, acrows + comp * 4 * 16,
-                            qpc_of(qpy), qpc_of(qpy + delta_qp));
-      bool any_ac = false, any_dc = false;
-      for (int i = 0; i < 2 * 16; ++i) any_dc |= dcrows[i] != 0;
-      for (int i = 0; i < 2 * 4 * 16; ++i) any_ac |= acrows[i] != 0;
-      mb_ccbp[mb] = any_ac ? 2 : (any_dc ? 1 : 0);
-    }
-    return true;
-  };
-  auto shift_row = [&](int16_t *lv, int n, int kk, int dz) {
+
+  int k = delta_qp / 6;
+  int deadzone = (1 << k) / 3;
+  auto shift_row = [&](int16_t *lv, int n) {
     bool any = false;
     for (int i = 0; i < n; ++i) {
       int32_t v = lv[i];
       int32_t a = v < 0 ? -v : v;
       if (a > kLevelClip) a = kLevelClip;
-      a = (a + dz) >> kk;
+      a = (a + deadzone) >> k;
       lv[i] = static_cast<int16_t>(v < 0 ? -a : a);
       any |= lv[i] != 0;
     }
     return any;
   };
 
-  int k = delta_qp / 6;
-  int deadzone = (1 << k) / 3;
-  // engine-independent stats.blocks: the Python path batches 17 level
-  // rows per I_16x16 MB (DC + 16 zero-padded AC), 16 per I_4x4, plus 8
-  // chroma rows per chroma-bearing MB — count identically here
+  BitWriter bw;
+  int32_t qp_out_base = h.qp + delta_qp;
+  if (qp_out_base > 51) return kErrUnsupported;
+  write_islice_header(bw, h, first_mb, pps_id, qp_out_base,
+                      log2_max_frame_num, poc_type, log2_max_poc_lsb,
+                      pic_init_qp, deblocking_control, 0);
+
+  // ---- per-MB scratch (fits L1) ----
+  int16_t dc[16], lv[16][16];
+  int16_t cdcr[2][16], cacr[2][4][16];
+  uint8_t modes[16][2];
+  uint32_t sub_t[4];
+  int refs[4];
+  int32_t mvd[16][2];
+
+  // one MB's chroma: parse with parse-side contexts, requant, report
+  // the new chroma CBP; then emit with write-side contexts
+  auto parse_chroma = [&](int mb, int ccbp, int32_t qpy,
+                          int *new_ccbp) -> bool {
+    int mbx2 = (mb % width_mbs) * 2, mby2 = (mb / width_mbs) * 2;
+    if (ccbp) {
+      for (int comp = 0; comp < 2; ++comp)
+        if (!decode_residual_n(br, -1, cdcr[comp], 4)) return false;
+    } else {
+      std::memset(cdcr, 0, sizeof(cdcr));
+    }
+    for (int comp = 0; comp < 2; ++comp) {
+      int16_t *g = &cin[static_cast<size_t>(comp) * h2 * w2];
+      for (int b = 0; b < 4; ++b) {
+        int gx = mbx2 + (b & 1), gy = mby2 + (b >> 1);
+        if (ccbp != 2) {
+          g[static_cast<size_t>(gy) * w2 + gx] = 0;
+          std::memset(cacr[comp][b], 0, sizeof(cacr[comp][b]));
+          continue;
+        }
+        int nC = nc_at_c(cin, comp, gx, gy);
+        int tot;
+        if (!decode_residual_n(br, nC, cacr[comp][b], 15, &tot))
+          return false;
+        g[static_cast<size_t>(gy) * w2 + gx] = static_cast<int16_t>(tot);
+      }
+    }
+    if (!ccbp) {
+      *new_ccbp = 0;
+      return true;
+    }
+    for (int comp = 0; comp < 2; ++comp)
+      chroma_requant_comp(cdcr[comp], &cacr[comp][0][0], qpc_of(qpy),
+                          qpc_of(qpy + delta_qp));
+    bool any_ac = false, any_dc = false;
+    const int16_t *dflat = &cdcr[0][0];
+    const int16_t *aflat = &cacr[0][0][0];
+    for (int i = 0; i < 2 * 16; ++i) any_dc |= dflat[i] != 0;
+    for (int i = 0; i < 2 * 4 * 16; ++i) any_ac |= aflat[i] != 0;
+    *new_ccbp = any_ac ? 2 : (any_dc ? 1 : 0);
+    return true;
+  };
+  auto write_chroma = [&](int mb, int ccbp) -> bool {
+    int mbx2 = (mb % width_mbs) * 2, mby2 = (mb / width_mbs) * 2;
+    if (ccbp) {
+      for (int comp = 0; comp < 2; ++comp)
+        if (!encode_residual_n(bw, cdcr[comp], -1, 4)) return false;
+    }
+    for (int comp = 0; comp < 2; ++comp) {
+      int16_t *g = &cout_[static_cast<size_t>(comp) * h2 * w2];
+      for (int b = 0; b < 4; ++b) {
+        int gx = mbx2 + (b & 1), gy = mby2 + (b >> 1);
+        if (ccbp != 2) {
+          g[static_cast<size_t>(gy) * w2 + gx] = 0;
+          continue;
+        }
+        int nC = nc_at_c(cout_, comp, gx, gy);
+        int tot;
+        if (!encode_residual_n(bw, cacr[comp][b], nC, 15, &tot))
+          return false;
+        g[static_cast<size_t>(gy) * w2 + gx] = static_cast<int16_t>(tot);
+      }
+    }
+    return true;
+  };
+  auto zero_mb_cells = [&](int mb) {
+    int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
+    for (int r = 0; r < 4; ++r) {
+      std::memset(&tin[static_cast<size_t>(mb_y + r) * w4 + mb_x], 0,
+                  4 * sizeof(int16_t));
+      std::memset(&tout[static_cast<size_t>(mb_y + r) * w4 + mb_x], 0,
+                  4 * sizeof(int16_t));
+    }
+    int cx = (mb % width_mbs) * 2, cy = (mb / width_mbs) * 2;
+    for (int comp = 0; comp < 2; ++comp)
+      for (int r = 0; r < 2; ++r) {
+        cin[(static_cast<size_t>(comp) * h2 + cy + r) * w2 + cx] = 0;
+        cin[(static_cast<size_t>(comp) * h2 + cy + r) * w2 + cx + 1] = 0;
+        cout_[(static_cast<size_t>(comp) * h2 + cy + r) * w2 + cx] = 0;
+        cout_[(static_cast<size_t>(comp) * h2 + cy + r) * w2 + cx + 1] =
+            0;
+      }
+  };
+
   int64_t blk_count = 0;
   int32_t cur_qp = h.qp;
-  int32_t max_qp = h.qp;
-  if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;
-  int end_mb = n_mbs;  // one past the slice's last MB (7.3.4 stop-bit)
-  for (int mb = static_cast<int>(first_mb); mb < n_mbs; ++mb) {
-    if (mb > static_cast<int>(first_mb) && !br.more_rbsp_data()) {
+  int32_t prev_qp = qp_out_base;
+  int end_mb = n_mbs;
+  int mb = static_cast<int>(first_mb);
+  bool first_iter = true;
+  while (mb < n_mbs) {
+    if (!first_iter && !br.more_rbsp_data()) {
       end_mb = mb;
       break;
     }
-    uint32_t mb_type = br.ue();
+    if (h.is_p) {
+      uint32_t run = br.ue();                    // mb_skip_run
+      if (!br.ok || mb + static_cast<int64_t>(run) > n_mbs)
+        return kErrBitstream;
+      bw.ue(run);                                // skip map is verbatim
+      for (uint32_t s = 0; s < run; ++s) zero_mb_cells(mb++);
+      if (!br.more_rbsp_data()) {                // slice ends on a run
+        end_mb = mb;
+        first_iter = false;
+        break;
+      }
+      if (mb >= n_mbs) return kErrBitstream;
+    }
+    first_iter = false;
+    uint32_t raw_type = br.ue();
     if (!br.ok) return kErrBitstream;
+    int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
+
+    if (h.is_p && raw_type < 5) {
+      // ---------------- P inter MB: motion verbatim, residuals shift
+      int n_sub_mvds = 0;
+      int n_parts = 0;
+      bool has_refs = raw_type != 4 && h.n_ref > 1;
+      if (raw_type <= 2) {
+        n_parts = raw_type == 0 ? 1 : 2;
+        for (int p = 0; p < n_parts && has_refs; ++p) {
+          refs[p] = h.n_ref == 2 ? 1 - br.bit()
+                                 : static_cast<int>(br.ue());
+          if (refs[p] >= h.n_ref) return kErrBitstream;
+        }
+        for (int p = 0; p < n_parts; ++p) {
+          mvd[p][0] = br.se();
+          mvd[p][1] = br.se();
+        }
+        n_sub_mvds = n_parts;
+      } else {
+        for (int s = 0; s < 4; ++s) {
+          sub_t[s] = br.ue();
+          if (sub_t[s] > 3) return kErrBitstream;
+        }
+        for (int p = 0; p < 4 && has_refs; ++p) {
+          refs[p] = h.n_ref == 2 ? 1 - br.bit()
+                                 : static_cast<int>(br.ue());
+          if (refs[p] >= h.n_ref) return kErrBitstream;
+        }
+        static const int kSubParts[4] = {1, 2, 2, 4};
+        for (int s = 0; s < 4; ++s)
+          for (int p = 0; p < kSubParts[sub_t[s]]; ++p) {
+            mvd[n_sub_mvds][0] = br.se();
+            mvd[n_sub_mvds][1] = br.se();
+            ++n_sub_mvds;
+          }
+      }
+      uint32_t code = br.ue();
+      if (!br.ok || code >= 48) return kErrBitstream;
+      int cbp_in = kCbpInterFromCode[code];
+      if (cbp_in) {
+        cur_qp += br.se();                       // cumulative (7.4.5)
+        if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
+        if (cur_qp + delta_qp > 51) return kErrUnsupported;
+      }
+      int out_cbp = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mb_x + x4, gy = mb_y + y4;
+        if (!((cbp_in >> (b >> 2)) & 1)) {
+          tin[static_cast<size_t>(gy) * w4 + gx] = 0;
+          std::memset(lv[b], 0, sizeof(lv[b]));
+          continue;
+        }
+        int nC = nc_at(tin, gx, gy);
+        int tot;
+        if (!decode_residual(br, nC, lv[b], &tot)) return kErrBitstream;
+        tin[static_cast<size_t>(gy) * w4 + gx] =
+            static_cast<int16_t>(tot);
+        if (shift_row(lv[b], 16)) out_cbp |= 1 << (b >> 2);
+      }
+      int new_ccbp = 0;
+      blk_count += 16 + ((cbp_in >> 4) ? 8 : 0);
+      if (!parse_chroma(mb, cbp_in >> 4, cur_qp, &new_ccbp))
+        return kErrBitstream;
+      // ---- emit
+      bw.ue(raw_type);
+      if (raw_type <= 2) {
+        for (int p = 0; p < n_parts && has_refs; ++p) {
+          if (h.n_ref == 2)
+            bw.bit(1 - refs[p]);
+          else
+            bw.ue(static_cast<uint32_t>(refs[p]));
+        }
+        for (int p = 0; p < n_parts; ++p) {
+          bw.se(mvd[p][0]);
+          bw.se(mvd[p][1]);
+        }
+      } else {
+        for (int s = 0; s < 4; ++s) bw.ue(sub_t[s]);
+        for (int p = 0; p < 4 && has_refs; ++p) {
+          if (h.n_ref == 2)
+            bw.bit(1 - refs[p]);
+          else
+            bw.ue(static_cast<uint32_t>(refs[p]));
+        }
+        for (int p = 0; p < n_sub_mvds; ++p) {
+          bw.se(mvd[p][0]);
+          bw.se(mvd[p][1]);
+        }
+      }
+      int full_cbp = out_cbp | (new_ccbp << 4);
+      bw.ue(kCbpInterToCode[full_cbp]);
+      if (full_cbp) {
+        int32_t qp_out_mb = cur_qp + delta_qp;
+        int32_t d = qp_out_mb - prev_qp;
+        if (d < -26 || d > 25) return kErrUnsupported;
+        bw.se(d);
+        prev_qp = qp_out_mb;
+      }
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mb_x + x4, gy = mb_y + y4;
+        if (!((out_cbp >> (b >> 2)) & 1)) {
+          tout[static_cast<size_t>(gy) * w4 + gx] = 0;
+          continue;
+        }
+        int tot;
+        if (!encode_residual(bw, lv[b], nc_at(tout, gx, gy), &tot))
+          return kErrBitstream;
+        tout[static_cast<size_t>(gy) * w4 + gx] =
+            static_cast<int16_t>(tot);
+      }
+      if (!write_chroma(mb, new_ccbp)) return kErrBitstream;
+      ++mb;
+      continue;
+    }
+
+    uint32_t mb_type = h.is_p ? raw_type - 5 : raw_type;
     if (mb_type >= 1 && mb_type <= 24) {
-      // ---- I_16x16: DC block + (CBP 15) sixteen 15-coeff AC blocks
+      // ---------------- I_16x16
       int pred = static_cast<int>(mb_type - 1) % 4;
       int chroma_cbp = (static_cast<int>(mb_type - 1) / 4) % 3;
       bool luma15 = mb_type >= 13;
-      mb_is16[mb] = 1;
-      mb_pred16[mb] = static_cast<uint8_t>(pred);
-      mb_chroma[mb] = br.ue();
-      cur_qp += br.se();                 // always coded for I_16x16
+      uint32_t cmode = br.ue();
+      cur_qp += br.se();                         // always coded for I16
       if (cur_qp < 12 || cur_qp > 51) return kErrUnsupported;
-      mb_qp[mb] = cur_qp;
-      if (cur_qp > max_qp) max_qp = cur_qp;
-      int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
-      int16_t *dc = &all_levels[static_cast<size_t>(mb) * 17 * 16];
-      if (!decode_residual(br, nc_at(mb_x, mb_y), dc))
+      if (cur_qp + delta_qp > 51) return kErrUnsupported;
+      if (!decode_residual(br, nc_at(tin, mb_x, mb_y), dc))
         return kErrBitstream;
-      shift_row(dc, 16, k, deadzone);
+      shift_row(dc, 16);
       bool any_ac = false;
       for (int b = 0; b < 16; ++b) {
         int x4, y4;
         blk_xy(b, &x4, &y4);
         int gx = mb_x + x4, gy = mb_y + y4;
-        int16_t *lv =
-            &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
         if (!luma15) {
-          totals[static_cast<size_t>(gy) * w4 + gx] = 0;
-          std::memset(lv, 0, 16 * sizeof(int16_t));
+          tin[static_cast<size_t>(gy) * w4 + gx] = 0;
+          std::memset(lv[b], 0, sizeof(lv[b]));
           continue;
         }
-        int nC = nc_at(gx, gy);
+        int nC = nc_at(tin, gx, gy);
         int tot;
-        if (!decode_residual15(br, nC, lv, &tot)) return kErrBitstream;
-        totals[static_cast<size_t>(gy) * w4 + gx] =
+        if (!decode_residual15(br, nC, lv[b], &tot)) return kErrBitstream;
+        tin[static_cast<size_t>(gy) * w4 + gx] =
             static_cast<int16_t>(tot);
-        any_ac |= shift_row(lv, 15, k, deadzone);
+        any_ac |= shift_row(lv[b], 15);
       }
-      mb_cbp[mb] = any_ac ? 15 : 0;      // luma CBP after requant
+      int new_ccbp = 0;
       blk_count += 17 + (chroma_cbp ? 8 : 0);
-      if (!chroma_mb(&br, mb, chroma_cbp, cur_qp, true))
+      if (!parse_chroma(mb, chroma_cbp, cur_qp, &new_ccbp))
         return kErrBitstream;
+      // ---- emit
+      bool out15 = luma15 && any_ac;
+      bw.ue((h.is_p ? 5u : 0u) + 1 + pred + 4 * new_ccbp +
+            (out15 ? 12 : 0));
+      bw.ue(cmode);
+      int32_t qp_out_mb = cur_qp + delta_qp;
+      int32_t d = qp_out_mb - prev_qp;
+      if (d < -26 || d > 25) return kErrUnsupported;
+      bw.se(d);
+      prev_qp = qp_out_mb;
+      if (!encode_residual(bw, dc, nc_at(tout, mb_x, mb_y)))
+        return kErrBitstream;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mb_x + x4, gy = mb_y + y4;
+        if (!out15) {
+          tout[static_cast<size_t>(gy) * w4 + gx] = 0;
+          continue;
+        }
+        int tot;
+        if (!encode_residual15(bw, lv[b], nc_at(tout, gx, gy), &tot))
+          return kErrBitstream;
+        tout[static_cast<size_t>(gy) * w4 + gx] =
+            static_cast<int16_t>(tot);
+      }
+      if (!write_chroma(mb, new_ccbp)) return kErrBitstream;
+      ++mb;
       continue;
     }
-    if (mb_type != 0) return kErrUnsupported;      // inter etc.
+    if (mb_type != 0) return kErrUnsupported;    // I_PCM etc.
+    // ---------------- I_4x4
     for (int b = 0; b < 16; ++b) {
-      int flag = br.bit();
-      mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2] =
-          static_cast<uint8_t>(flag);
-      mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1] =
-          static_cast<uint8_t>(flag ? 0 : br.bits(3));
+      modes[b][0] = static_cast<uint8_t>(br.bit());
+      modes[b][1] =
+          static_cast<uint8_t>(modes[b][0] ? 0 : br.bits(3));
     }
-    mb_chroma[mb] = br.ue();
+    uint32_t cmode = br.ue();
     uint32_t code = br.ue();
-    if (code >= 48) return kErrBitstream;
-    int cbp = kCbpIntraFromCode[code];
-    if (cbp) {
-      cur_qp += br.se();                           // cumulative (7.4.5)
+    if (!br.ok || code >= 48) return kErrBitstream;
+    int cbp_in = kCbpIntraFromCode[code];
+    if (cbp_in) {
+      cur_qp += br.se();                         // cumulative (7.4.5)
       if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
+      if (cur_qp + delta_qp > 51) return kErrUnsupported;
     }
-    mb_qp[mb] = cur_qp;
-    if (cur_qp > max_qp) max_qp = cur_qp;
-    int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
     int out_cbp = 0;
     for (int b = 0; b < 16; ++b) {
       int x4, y4;
       blk_xy(b, &x4, &y4);
       int gx = mb_x + x4, gy = mb_y + y4;
-      int16_t *lv =
-          &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
-      if (!((cbp >> (b >> 2)) & 1)) {
-        totals[static_cast<size_t>(gy) * w4 + gx] = 0;
-        std::memset(lv, 0, 16 * sizeof(int16_t));
+      if (!((cbp_in >> (b >> 2)) & 1)) {
+        tin[static_cast<size_t>(gy) * w4 + gx] = 0;
+        std::memset(lv[b], 0, sizeof(lv[b]));
         continue;
       }
-      int nC = nc_at(gx, gy);
+      int nC = nc_at(tin, gx, gy);
       int tot;
-      if (!decode_residual(br, nC, lv, &tot)) return kErrBitstream;
-      totals[static_cast<size_t>(gy) * w4 + gx] =
-          static_cast<int16_t>(tot);
+      if (!decode_residual(br, nC, lv[b], &tot)) return kErrBitstream;
+      tin[static_cast<size_t>(gy) * w4 + gx] = static_cast<int16_t>(tot);
       // requant: the +6k shift with the intra deadzone (bit-exact with
       // requant_levels_scalar / ops.transform.h264_requant)
-      if (shift_row(lv, 16, k, deadzone)) out_cbp |= 1 << (b >> 2);
+      if (shift_row(lv[b], 16)) out_cbp |= 1 << (b >> 2);
     }
-    mb_cbp[mb] = out_cbp;
-    blk_count += 16 + ((cbp >> 4) ? 8 : 0);
-    if (!chroma_mb(&br, mb, cbp >> 4, cur_qp, true))
+    int new_ccbp = 0;
+    blk_count += 16 + ((cbp_in >> 4) ? 8 : 0);
+    if (!parse_chroma(mb, cbp_in >> 4, cur_qp, &new_ccbp))
       return kErrBitstream;
-  }
-  if (!br.ok) return kErrBitstream;
-  if (max_qp + delta_qp > 51) return kErrUnsupported;  // ladder ceiling
-  if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
-  if (blocks_out)
-    *blocks_out = static_cast<int32_t>(
-        blk_count > INT32_MAX ? INT32_MAX : blk_count);
-
-  // ---- re-encode
-  BitWriter bw;
-  int32_t qp_out_base = h.qp + delta_qp;
-  write_islice_header(bw, h, first_mb, pps_id, qp_out_base,
-                      log2_max_frame_num, poc_type, log2_max_poc_lsb,
-                      pic_init_qp, deblocking_control);
-
-  std::fill(totals.begin(), totals.end(), static_cast<int16_t>(-1));
-  std::fill(tot_c.begin(), tot_c.end(), static_cast<int16_t>(-1));
-  cw = &bw;
-  int32_t prev_qp = qp_out_base;
-  for (int mb = static_cast<int>(first_mb); mb < end_mb; ++mb) {
-    int mb_x = (mb % width_mbs) * 4, mb_y = (mb / width_mbs) * 4;
-    if (mb_is16[mb]) {
-      bool luma15 = mb_cbp[mb] == 15;
-      bw.ue(1 + mb_pred16[mb] + 4 * mb_ccbp[mb] + (luma15 ? 12 : 0));
-      bw.ue(mb_chroma[mb]);
-      int32_t qp_out_mb = mb_qp[mb] + delta_qp;
-      int32_t delta = qp_out_mb - prev_qp;
-      if (delta < -26 || delta > 25) return kErrUnsupported;
-      bw.se(delta);                    // always coded for I_16x16
-      prev_qp = qp_out_mb;
-      const int16_t *dc = &all_levels[static_cast<size_t>(mb) * 17 * 16];
-      if (!encode_residual(bw, dc, nc_at(mb_x, mb_y))) return kErrBitstream;
-      for (int b = 0; b < 16; ++b) {
-        int x4, y4;
-        blk_xy(b, &x4, &y4);
-        int gx = mb_x + x4, gy = mb_y + y4;
-        const int16_t *lv =
-            &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
-        if (!luma15) {
-          totals[static_cast<size_t>(gy) * w4 + gx] = 0;
-          continue;
-        }
-        int tot;
-        if (!encode_residual15(bw, lv, nc_at(gx, gy), &tot))
-          return kErrBitstream;
-        totals[static_cast<size_t>(gy) * w4 + gx] =
-            static_cast<int16_t>(tot);
-      }
-      if (!chroma_mb(nullptr, mb, mb_ccbp[mb], 0, false))
-        return kErrBitstream;
-      continue;
-    }
-    bw.ue(0);                                      // mb_type I_4x4
+    // ---- emit
+    bw.ue(h.is_p ? 5u : 0u);                     // mb_type I_4x4
     for (int b = 0; b < 16; ++b) {
-      int flag = mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2];
-      bw.bit(flag);
-      if (!flag)
-        bw.bits(mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1], 3);
+      bw.bit(modes[b][0]);
+      if (!modes[b][0]) bw.bits(modes[b][1], 3);
     }
-    bw.ue(mb_chroma[mb]);
-    int cbp = mb_cbp[mb] | (mb_ccbp[mb] << 4);
-    bw.ue(kCbpIntraToCode[cbp]);
-    int32_t qp_out_mb = mb_qp[mb] + delta_qp;
-    if (cbp) {
-      int32_t delta = qp_out_mb - prev_qp;
-      if (delta < -26 || delta > 25) return kErrUnsupported;
-      bw.se(delta);
+    bw.ue(cmode);
+    int full_cbp = out_cbp | (new_ccbp << 4);
+    bw.ue(kCbpIntraToCode[full_cbp]);
+    if (full_cbp) {
+      int32_t qp_out_mb = cur_qp + delta_qp;
+      int32_t d = qp_out_mb - prev_qp;
+      if (d < -26 || d > 25) return kErrUnsupported;
+      bw.se(d);
       prev_qp = qp_out_mb;
     }
     for (int b = 0; b < 16; ++b) {
       int x4, y4;
       blk_xy(b, &x4, &y4);
       int gx = mb_x + x4, gy = mb_y + y4;
-      const int16_t *lv =
-          &all_levels[(static_cast<size_t>(mb) * 17 + 1 + b) * 16];
-      if (!((cbp >> (b >> 2)) & 1)) {
-        totals[static_cast<size_t>(gy) * w4 + gx] = 0;
+      if (!((out_cbp >> (b >> 2)) & 1)) {
+        tout[static_cast<size_t>(gy) * w4 + gx] = 0;
         continue;
       }
       int tot;
-      if (!encode_residual(bw, lv, nc_at(gx, gy), &tot))
+      if (!encode_residual(bw, lv[b], nc_at(tout, gx, gy), &tot))
         return kErrBitstream;
-      totals[static_cast<size_t>(gy) * w4 + gx] =
-          static_cast<int16_t>(tot);
+      tout[static_cast<size_t>(gy) * w4 + gx] = static_cast<int16_t>(tot);
     }
-    if (!chroma_mb(nullptr, mb, mb_ccbp[mb], 0, false))
-      return kErrBitstream;
+    if (!write_chroma(mb, new_ccbp)) return kErrBitstream;
+    ++mb;
   }
-  bw.trailing();
+  if (!br.ok) return kErrBitstream;
+  if (mb >= n_mbs) end_mb = n_mbs;
+  if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
+  if (blocks_out)
+    *blocks_out = static_cast<int32_t>(
+        blk_count > INT32_MAX ? INT32_MAX : blk_count);
 
+  bw.trailing();
   std::vector<uint8_t> wire;
   insert_epb(bw.out, wire);
   if (static_cast<int64_t>(wire.size()) + 1 > out_cap) return kErrOverflow;
@@ -1174,6 +1381,7 @@ extern "C" int32_t ed_h264_requant_slice(
   std::memcpy(out + 1, wire.data(), wire.size());
   return static_cast<int32_t>(wire.size()) + 1;
 }
+
 
 // ===================================================================
 // CABAC requant (mirrors codecs/h264_cabac.py BIT-EXACTLY; spec
@@ -1575,7 +1783,8 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
     int32_t poc_type, int32_t log2_max_poc_lsb, int32_t pic_init_qp,
     int32_t pps_id, int32_t deblocking_control, int32_t bottom_field_poc,
-    int32_t delta_qp, int32_t chroma_qp_offset, int32_t *mbs_out,
+    int32_t delta_qp, int32_t chroma_qp_offset,
+    int32_t num_ref_l0_default, int32_t weighted_pred, int32_t *mbs_out,
     int32_t *blocks_out) {
   if (nal_len < 2 || delta_qp < 6 || delta_qp % 6) return kErrUnsupported;
   uint8_t nal_byte = nal[0];
@@ -1592,8 +1801,11 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
                                 log2_max_frame_num, poc_type,
                                 log2_max_poc_lsb, pic_init_qp,
                                 deblocking_control, bottom_field_poc, &h,
-                                &first_mb);
+                                &first_mb, num_ref_l0_default,
+                                weighted_pred, 1);
   if (hrc) return hrc;
+  if (h.is_p) return kErrUnsupported;  // native CABAC P: next milestone
+                                       // (Python oracle covers it)
 
   int n_mbs = width_mbs * height_mbs;
   if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;

@@ -195,7 +195,9 @@ class SliceRequantizer:
             pic_init_qp=p.pic_init_qp, pps_id=p.pps_id,
             deblocking_control=p.deblocking_control,
             bottom_field_poc=p.bottom_field_poc, delta_qp=self.delta_qp,
-            chroma_qp_offset=p.chroma_qp_offset, cabac=p.entropy_cabac)
+            chroma_qp_offset=p.chroma_qp_offset, cabac=p.entropy_cabac,
+            num_ref_l0_default=p.num_ref_l0_default,
+            weighted_pred=p.weighted_pred)
 
     def _requant_slice(self, nal: bytes, sps: Sps, pps: Pps
                        ) -> tuple[bytes, int]:
@@ -245,10 +247,15 @@ class SliceRequantizer:
                 all_levels.append(mb.levels)
                 row_map.extend((i, "l4", b) for b in range(16))
                 qps.extend([mb.qp] * 16)
-        batch = np.concatenate(all_levels, axis=0)
-        qps = np.asarray(qps)
-        n_blocks += batch.shape[0]
-        requanted = self.requant_fn(batch, qps, qps + self.delta_qp)
+        if all_levels:                 # an all-skip P slice has no rows;
+            # its header QP still shifts (deblocking strength follows
+            # the slice QP even for skipped MBs)
+            batch = np.concatenate(all_levels, axis=0)
+            qps = np.asarray(qps)
+            n_blocks += batch.shape[0]
+            requanted = self.requant_fn(batch, qps, qps + self.delta_qp)
+        else:
+            requanted = np.zeros((0, 16), dtype=np.int64)
 
         # write back + recompute CBP and the shifted absolute QP per MB;
         # the writer re-derives deltas vs the previous CODED MB, so a

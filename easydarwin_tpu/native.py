@@ -113,7 +113,7 @@ def _load():
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-                ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32)]
         lib.ed_udp_ingest.restype = ctypes.c_int32
@@ -257,7 +257,9 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
                        pps_id: int, deblocking_control: bool,
                        bottom_field_poc: bool, delta_qp: int,
                        chroma_qp_offset: int = 0,
-                       cabac: bool = False
+                       cabac: bool = False,
+                       num_ref_l0_default: int = 0,
+                       weighted_pred: bool = False
                        ) -> tuple[bytes, int, int] | None:
     """Native slice requant — CAVLC, or the CABAC walk when
     ``cabac=True`` (the caller passes the PPS's entropy flag) →
@@ -281,6 +283,7 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
         log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
         pps_id, 1 if deblocking_control else 0,
         1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset,
+        num_ref_l0_default, 1 if weighted_pred else 0,
         ctypes.byref(mbs), ctypes.byref(blocks))
     if n == -3:                      # tiny chance: expansion past 2x
         cap = len(nal) * 4 + 4096
@@ -290,6 +293,7 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
             log2_max_frame_num, poc_type, log2_max_poc_lsb, pic_init_qp,
             pps_id, 1 if deblocking_control else 0,
             1 if bottom_field_poc else 0, delta_qp, chroma_qp_offset,
+            num_ref_l0_default, 1 if weighted_pred else 0,
             ctypes.byref(mbs), ctypes.byref(blocks))
     return (out[:n].tobytes(), mbs.value, blocks.value) if n > 0 else None
 

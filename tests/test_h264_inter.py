@@ -203,6 +203,32 @@ def test_cabac_x264_iframe_full_parse_regression():
     assert len(mbs) == sps.width_mbs * sps.height_mbs
 
 
+def test_native_fused_walk_matches_python_on_ippp():
+    """The fused native CAVLC walk must stay BYTE-EXACT with the Python
+    oracle across real x264 IPPP content — P types 0-4, skip runs
+    (including all-skip slices), multi-slice, multi-ref."""
+    from easydarwin_tpu import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    for kw in (dict(), dict(slices=2, ref=3), dict(qp=30, slices=3)):
+        nals = le.encode_ippp(W, H, 10, cabac=False, **kw)
+        sps, pps = _ps(nals)
+        rq_py = SliceRequantizer(6, prefer_native=False)
+        rq_nat = SliceRequantizer(6)
+        n_native = 0
+        for n in nals:
+            if n[0] & 0x1F not in (1, 5):
+                continue
+            a, da = rq_py.requant_with(n, sps, pps)
+            b, db = rq_nat.requant_with(n, sps, pps)
+            assert a == b, f"native diverged ({kw})"
+            assert da.blocks == db.blocks
+            n_native += db.native_slices
+        n_slices = sum(1 for n in nals if n[0] & 0x1F in (1, 5))
+        assert n_native == n_slices       # every slice took the C walk
+
+
 def test_weighted_pred_stream_passes_through():
     """weightp=2 puts explicit weight tables in P headers — outside the
     rung's scope, so the stream must pass through UNCHANGED, never be
