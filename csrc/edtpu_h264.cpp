@@ -712,42 +712,97 @@ void chroma_requant_comp(int16_t *dc, int16_t *ac, int qpc_in,
     }
     return;
   }
+  // integer round-trip arm, all-int32: every intermediate fits — w ≤
+  // 2047·18·2^8 ≈ 9.4M, transform sums ≤ ~300K (clipped ±4095/±131071),
+  // and a·MF ≤ 131071·13107 ≈ 1.72e9 < 2^31 — which lets the 4x16-wide
+  // loops vectorize (this arm was ~23% of the CAVLC walk at QPc deltas
+  // off the +6k lattice, e.g. any rung crossing the Table 8-15 knee)
   int mi = qpc_in % 6, si = qpc_in / 6;
   int mo = qpc_out % 6, so = qpc_out / 6;
-  int64_t c[4], f2[4], dcc[4], w00[4];
-  for (int i = 0; i < 4; ++i) c[i] = clip64(dc[i], kLevelClip);
-  hadamard2x2(c, f2);
+  auto clip32 = [](int32_t v, int32_t c) {
+    return v < -c ? -c : (v > c ? c : v);
+  };
+  int32_t c[4], f2[4], dcc[4], w00[4];
+  for (int i = 0; i < 4; ++i) c[i] = clip32(dc[i], kLevelClip);
+  f2[0] = c[0] + c[1] + c[2] + c[3];
+  f2[1] = c[0] - c[1] + c[2] - c[3];
+  f2[2] = c[0] + c[1] - c[2] - c[3];
+  f2[3] = c[0] - c[1] - c[2] + c[3];
   for (int i = 0; i < 4; ++i)
-    dcc[i] = (f2[i] * kVPos[mi][0] * (1LL << si)) >> 1;
+    dcc[i] = (f2[i] * kVPos[mi][0] * (1 << si)) >> 1;
   int qbits = 15 + so;
-  int64_t off = (1LL << qbits) / 3;
+  int32_t off = (1 << qbits) / 3;
   for (int b = 0; b < 4; ++b) {
-    int64_t w[16] = {0};
+    int32_t w[16] = {0};
     for (int i = 0; i < 15; ++i) {
       int pos = kZigzag4[1 + i];
-      w[pos] = clip64(ac[16 * b + i], kLevelClip) * kVPos[mi][pos] *
-               (1LL << si);
+      w[pos] =
+          clip32(ac[16 * b + i], kLevelClip) * kVPos[mi][pos] * (1 << si);
     }
     w[0] = dcc[b];
-    inv_core4(w);
-    for (int i = 0; i < 16; ++i) w[i] = clip64((w[i] + 32) >> 6, kResClip);
-    fwd_core4(w);
-    for (int i = 0; i < 16; ++i) w[i] = clip64(w[i], kWClip);
+    // inverse core (8.5.12 butterflies), rows then columns
+    for (int r = 0; r < 4; ++r) {
+      int32_t *p = w + 4 * r;
+      int32_t e0 = p[0] + p[2], e1 = p[0] - p[2];
+      int32_t e2 = (p[1] >> 1) - p[3], e3 = p[1] + (p[3] >> 1);
+      p[0] = e0 + e3;
+      p[1] = e1 + e2;
+      p[2] = e1 - e2;
+      p[3] = e0 - e3;
+    }
+    for (int col = 0; col < 4; ++col) {
+      int32_t *p = w + col;
+      int32_t e0 = p[0] + p[8], e1 = p[0] - p[8];
+      int32_t e2 = (p[4] >> 1) - p[12], e3 = p[4] + (p[12] >> 1);
+      p[0] = e0 + e3;
+      p[4] = e1 + e2;
+      p[8] = e1 - e2;
+      p[12] = e0 - e3;
+    }
+    for (int i = 0; i < 16; ++i)
+      w[i] = clip32((w[i] + 32) >> 6, static_cast<int32_t>(kResClip));
+    // forward core (Cf·X·Cfᵀ), rows then columns
+    for (int r = 0; r < 4; ++r) {
+      int32_t *p = w + 4 * r;
+      int32_t s0 = p[0] + p[3], s1 = p[1] + p[2];
+      int32_t d0 = p[0] - p[3], d1 = p[1] - p[2];
+      p[0] = s0 + s1;
+      p[1] = 2 * d0 + d1;
+      p[2] = s0 - s1;
+      p[3] = d0 - 2 * d1;
+    }
+    for (int col = 0; col < 4; ++col) {
+      int32_t *p = w + col;
+      int32_t s0 = p[0] + p[12], s1 = p[4] + p[8];
+      int32_t d0 = p[0] - p[12], d1 = p[4] - p[8];
+      p[0] = s0 + s1;
+      p[4] = 2 * d0 + d1;
+      p[8] = s0 - s1;
+      p[12] = d0 - 2 * d1;
+    }
+    for (int i = 0; i < 16; ++i)
+      w[i] = clip32(w[i], static_cast<int32_t>(kWClip));
     w00[b] = w[0];
     for (int i = 0; i < 15; ++i) {
       int pos = kZigzag4[1 + i];
-      int64_t a = w[pos] < 0 ? -w[pos] : w[pos];
-      int64_t q = (a * kMFPos[mo][pos] + off) >> qbits;
+      int32_t a = w[pos] < 0 ? -w[pos] : w[pos];
+      int32_t q = static_cast<int32_t>(
+          (static_cast<int64_t>(a) * kMFPos[mo][pos] + off) >> qbits);
       ac[16 * b + i] =
-          static_cast<int16_t>(clip64(w[pos] < 0 ? -q : q, kLevelClip));
+          static_cast<int16_t>(clip32(w[pos] < 0 ? -q : q, kLevelClip));
     }
   }
-  hadamard2x2(w00, f2);
+  f2[0] = w00[0] + w00[1] + w00[2] + w00[3];
+  f2[1] = w00[0] - w00[1] + w00[2] - w00[3];
+  f2[2] = w00[0] + w00[1] - w00[2] - w00[3];
+  f2[3] = w00[0] - w00[1] - w00[2] + w00[3];
   for (int i = 0; i < 4; ++i) {
-    int64_t v = clip64(f2[i], kWClip);
-    int64_t a = v < 0 ? -v : v;
-    int64_t q = (a * kMFPos[mo][0] + 2 * off) >> (qbits + 1);
-    dc[i] = static_cast<int16_t>(clip64(v < 0 ? -q : q, kLevelClip));
+    int32_t v = clip32(f2[i], static_cast<int32_t>(kWClip));
+    int32_t a = v < 0 ? -v : v;
+    int32_t q = static_cast<int32_t>(
+        (static_cast<int64_t>(a) * kMFPos[mo][0] + 2 * off) >>
+        (qbits + 1));
+    dc[i] = static_cast<int16_t>(clip32(v < 0 ? -q : q, kLevelClip));
   }
 }
 
@@ -1395,10 +1450,26 @@ constexpr int kSigBase[5] = {105, 120, 134, 149, 152};
 constexpr int kLastBase[5] = {166, 181, 195, 210, 213};
 constexpr int kAbsBase[5] = {227, 237, 247, 257, 266};
 
-inline void cabac_init_states(uint8_t *state, int qp) {
+// merged 7-bit state transitions (state = pStateIdx<<1 | valMPS): one
+// table lookup replaces shift/mask/branch per bin
+struct StateTables {
+  uint8_t mps[128], lps[128];
+  StateTables() {
+    for (int s = 0; s < 128; ++s) {
+      int p = s >> 1, m = s & 1;
+      mps[s] = static_cast<uint8_t>((kCabacTransMps[p] << 1) | m);
+      int m2 = p == 0 ? m ^ 1 : m;
+      lps[s] = static_cast<uint8_t>((kCabacTransLps[p] << 1) | m2);
+    }
+  }
+};
+const StateTables kST;
+
+inline void cabac_init_states(uint8_t *state, int qp,
+                              const int8_t (*table)[2] = kCabacCtxInitI) {
   qp = qp < 0 ? 0 : (qp > 51 ? 51 : qp);
   for (int i = 0; i < 1024; ++i) {
-    int pre = ((kCabacCtxInitI[i][0] * qp) >> 4) + kCabacCtxInitI[i][1];
+    int pre = ((table[i][0] * qp) >> 4) + table[i][1];
     pre = pre < 1 ? 1 : (pre > 126 ? 126 : pre);
     state[i] = pre <= 63 ? static_cast<uint8_t>((63 - pre) << 1)
                          : static_cast<uint8_t>(((pre - 64) << 1) | 1);
@@ -1406,57 +1477,90 @@ inline void cabac_init_states(uint8_t *state, int qp) {
 }
 
 struct CabacDec {
+  // 9.3.3.2 arithmetic decoder over a 64-bit MSB-aligned bit window:
+  // renorm consumes its shift in ONE masked read (CLZ-derived) instead
+  // of a bounds-checked per-bit feed — the round-4 engine's dominant
+  // cost.  Reads past the RBSP still yield 0-bits with a bounded
+  // overrun before the stream is declared corrupt, matching the
+  // Python oracle's rule.
   const uint8_t *d = nullptr;
-  int64_t nbits = 0, pos = 0;
-  int overrun = 0;
+  int64_t nbits = 0;       // RBSP length in bits
+  int64_t bytepos = 0;     // next byte to load into the window
+  uint64_t win = 0;        // MSB-first lookahead
+  int winbits = 0;
   bool ok = true;
   uint32_t range = 510, offset = 0;
   uint8_t state[1024];
 
-  int bit() {
-    if (pos >= nbits) {
-      if (++overrun > 64) ok = false;   // far past slice end: corrupt
-      return 0;
+  void refill() {
+    int64_t avail = (nbits + 7) >> 3;
+    if (bytepos + 8 <= avail) {
+      // fast path: one unaligned big-endian load tops the window up
+      uint64_t v;
+      std::memcpy(&v, d + bytepos, 8);
+      win |= __builtin_bswap64(v) >> winbits;
+      bytepos += (63 - winbits) >> 3;
+      winbits |= 56;
+      return;
     }
-    int b = (d[pos >> 3] >> (7 - (pos & 7))) & 1;
-    ++pos;
-    return b;
+    while (winbits <= 56) {
+      uint64_t b = bytepos < avail ? d[bytepos] : 0;
+      win |= b << (56 - winbits);
+      ++bytepos;
+      winbits += 8;
+    }
+    // consumed position = bytepos*8 - winbits; past the RBSP by more
+    // than the Python oracle's 64-bit overrun allowance → corrupt
+    if ((bytepos << 3) - winbits > nbits + 64) ok = false;
   }
 
-  int init(const uint8_t *data, int64_t nb, int64_t bitpos, int qp) {
+  inline uint32_t take(int n) {
+    if (winbits < n) refill();
+    uint32_t v = static_cast<uint32_t>(win >> (64 - n));
+    win <<= n;
+    winbits -= n;
+    return v;
+  }
+
+  int init(const uint8_t *data, int64_t nb, int64_t bitpos, int qp,
+           const int8_t (*table)[2] = kCabacCtxInitI) {
     d = data;
     nbits = nb;
-    pos = (bitpos + 7) & ~static_cast<int64_t>(7);
-    cabac_init_states(state, qp);
-    for (int i = 0; i < 9; ++i) offset = (offset << 1) | bit();
+    int64_t pos = (bitpos + 7) & ~static_cast<int64_t>(7);
+    bytepos = pos >> 3;                  // byte-aligned slice data start
+    cabac_init_states(state, qp, table);
+    offset = take(9);
     return offset >= 510 ? kErrBitstream : 0;
   }
 
   int decision(int ctx) {
     uint8_t s = state[ctx];
-    int p = s >> 1, mps = s & 1;
-    uint32_t lps = kCabacRangeLps[p][(range >> 6) & 3];
+    uint32_t lps = kCabacRangeLps[s >> 1][(range >> 6) & 3];
     range -= lps;
     int binv;
     if (offset >= range) {
-      binv = mps ^ 1;
+      binv = (s & 1) ^ 1;
       offset -= range;
       range = lps;
-      if (p == 0) mps ^= 1;
-      state[ctx] = static_cast<uint8_t>((kCabacTransLps[p] << 1) | mps);
+      state[ctx] = kST.lps[s];
+      // LPS renorm: range ∈ [2, 240] → shift fully in one step
+      int sh = __builtin_clz(range) - 23;
+      range <<= sh;
+      offset = (offset << sh) | take(sh);
     } else {
-      binv = mps;
-      state[ctx] = static_cast<uint8_t>((kCabacTransMps[p] << 1) | mps);
-    }
-    while (range < 256) {
-      range <<= 1;
-      offset = (offset << 1) | bit();
+      binv = s & 1;
+      state[ctx] = kST.mps[s];
+      // MPS renorm: post-subtract range ≥ 128 → at most one shift
+      if (range < 256) {
+        range <<= 1;
+        offset = (offset << 1) | take(1);
+      }
     }
     return binv;
   }
 
   int bypass() {
-    offset = (offset << 1) | bit();
+    offset = (offset << 1) | take(1);
     if (offset >= range) {
       offset -= range;
       return 1;
@@ -1467,71 +1571,104 @@ struct CabacDec {
   int terminate() {
     range -= 2;
     if (offset >= range) return 1;
-    while (range < 256) {
+    if (range < 256) {                   // range ≥ 254 here: ≤ one shift
       range <<= 1;
-      offset = (offset << 1) | bit();
+      offset = (offset << 1) | take(1);
     }
     return 0;
   }
 };
 
 struct CabacEnc {
-  uint32_t low = 0, range = 510;
-  bool first = true;
-  int64_t outstanding = 0;
+  // 9.3.4 encoder over a WIDE low: renorm/bypass shift bits into the
+  // pending region above the 10-bit arithmetic window instead of
+  // classifying them one at a time (the spec's put/outstanding dance
+  // is just carry bookkeeping — here carries resolve arithmetically
+  // inside `low`, and bytes are extracted with 0xFF buffering).  The
+  // spec's dropped leading bit is the first pending bit, stripped at
+  // the first extraction.  Output is byte-exact with the Python
+  // oracle's literal 9.3.4 implementation (differential-tested).
+  uint64_t low = 0;
+  uint32_t range = 510;
+  int queue = 0;                        // pending bits above the window
+  int ffpend = 0;                       // buffered 0xFF bytes
+  bool primed = false;                  // leading bit not yet stripped
   std::vector<uint8_t> bytes;
-  uint32_t cur = 0;
-  int ncur = 0;
   uint8_t state[1024];
 
-  void emit(int b) {
-    cur = (cur << 1) | (b & 1);
-    if (++ncur == 8) {
-      bytes.push_back(static_cast<uint8_t>(cur));
-      cur = 0;
-      ncur = 0;
-    }
-  }
-
-  void put(int b) {
-    if (first)
-      first = false;                    // 9.3.4.1: leading bit dropped
-    else
-      emit(b);
-    while (outstanding) {
-      emit(1 - b);
-      --outstanding;
-    }
-  }
-
-  void renorm() {
-    while (range < 256) {
-      if (low >= 512) {
-        put(1);
-        low -= 512;
-      } else if (low < 256) {
-        put(0);
-      } else {
-        ++outstanding;
-        low -= 256;
+  inline void push_resolved(uint32_t out9) {
+    // out9 = carry bit + 8 payload bits
+    uint32_t carry = out9 >> 8;
+    uint32_t b = out9 & 0xFF;
+    if (carry) {
+      // ripple: buffered FFs roll to 00, the last flushed byte gains 1
+      // (it is never 0xFF — those are buffered).  With no flushed byte
+      // yet the carry lands on the spec's DROPPED leading bit (which
+      // was provably 0) and is discarded with it.
+      if (!bytes.empty())
+        bytes.back() = static_cast<uint8_t>(bytes.back() + 1);
+      while (ffpend) {
+        bytes.push_back(0x00);
+        --ffpend;
       }
-      low <<= 1;
-      range <<= 1;
     }
+    if (b == 0xFF) {
+      ++ffpend;
+    } else {
+      while (ffpend) {
+        bytes.push_back(0xFF);
+        --ffpend;
+      }
+      bytes.push_back(static_cast<uint8_t>(b));
+    }
+  }
+
+  inline void extract() {
+    if (!primed) {
+      // strip the spec's dropped leading bit: wait for 9 pending bits,
+      // resolve any carry INTO that bit, then discard it
+      if (queue < 9) return;
+      uint32_t out10 = static_cast<uint32_t>(low >> (queue + 1));
+      low &= (1ULL << (queue + 1)) - 1;
+      queue -= 9;
+      // out10 = dropped bit (possibly carried into) + 8 payload bits;
+      // a carry cannot pass beyond the dropped bit (it was 0 pre-carry)
+      bytes.push_back(static_cast<uint8_t>(out10 & 0xFF));
+      if ((out10 & 0xFF) == 0xFF) {     // re-buffer an FF first byte
+        bytes.pop_back();
+        ++ffpend;
+      }
+      primed = true;
+    }
+    while (queue >= 8) {
+      uint32_t out9 = static_cast<uint32_t>(low >> (queue + 2));
+      low &= (1ULL << (queue + 2)) - 1;
+      queue -= 8;
+      push_resolved(out9);
+    }
+  }
+
+  inline void renorm() {
+    if (range >= 256) return;
+    int sh = __builtin_clz(range) - 23;
+    range <<= sh;
+    low <<= sh;
+    queue += sh;
+    // keep queue + 11 bits within the 64-bit low: extract leaves
+    // queue < 8, and growth per bin is ≤ 7, so 32 is conservative
+    if (queue >= 32) extract();
   }
 
   void decision(int ctx, int binv) {
     uint8_t s = state[ctx];
-    int p = s >> 1, mps = s & 1;
-    uint32_t lps = kCabacRangeLps[p][(range >> 6) & 3];
+    uint32_t lps = kCabacRangeLps[s >> 1][(range >> 6) & 3];
     range -= lps;
-    if (binv != mps) {
+    if (static_cast<unsigned>(binv) != (s & 1u)) {
       low += range;
       range = lps;
-      if (p == 0) mps ^= 1;
-      state[ctx] = static_cast<uint8_t>((kCabacTransLps[p] << 1) | mps);
+      state[ctx] = kST.lps[s];
     } else {
-      state[ctx] = static_cast<uint8_t>((kCabacTransMps[p] << 1) | mps);
+      state[ctx] = kST.mps[s];
     }
     renorm();
   }
@@ -1539,14 +1676,25 @@ struct CabacEnc {
   void bypass(int binv) {
     low <<= 1;
     if (binv) low += range;
-    if (low >= 1024) {
-      put(1);
-      low -= 1024;
-    } else if (low < 512) {
-      put(0);
-    } else {
-      ++outstanding;
-      low -= 512;
+    ++queue;
+    if (queue >= 32) extract();
+  }
+
+  void finish_bytes() {
+    // called after the final terminate(1): everything is in `low`
+    extract();
+    while (queue > 0) {                 // ≤ 7 leftover pending bits
+      int take = queue >= 8 ? 8 : queue;
+      uint32_t out = static_cast<uint32_t>(
+                         (low >> (queue + 10 - take)) << (8 - take)) &
+                     0x1FF;
+      low &= (1ULL << (queue + 10 - take)) - 1;
+      queue -= take;
+      push_resolved(out);               // carry impossible here
+    }
+    while (ffpend) {
+      bytes.push_back(0xFF);
+      --ffpend;
     }
   }
 
@@ -1556,11 +1704,16 @@ struct CabacEnc {
       low += range;
       range = 2;
       renorm();
-      // EncodeFlush: final written bit doubles as rbsp_stop_one_bit
-      put((low >> 9) & 1);
-      emit((low >> 8) & 1);
-      emit(1);
-      while (ncur) emit(0);             // rbsp_alignment_zero_bit
+      // EncodeFlush: bit9, bit8 of the window, then the stop bit; park
+      // them as pending so extraction handles carries uniformly
+      low = ((low & ~0xFFULL) | 0x80) << 3;   // appends b9, b8, 1
+      queue += 3;
+      extract();
+      // rbsp_alignment_zero_bit: pad pending to a byte boundary
+      int pad = (8 - (queue & 7)) & 7;
+      low <<= pad;
+      queue += pad;
+      extract();
     } else {
       renorm();
     }
@@ -1568,19 +1721,22 @@ struct CabacEnc {
 };
 
 // per-slice neighbor grids for ctxIdxInc derivation (slice-scoped:
-// out-of-slice → unavailable; intra cbf default 1 — the same rule the
-// Python layer learned from the libavcodec differential)
+// out-of-slice → unavailable; cbf unavailable default is 1 for intra
+// MBs and 0 for inter — the rules the Python layer learned from the
+// libavcodec differential)
 struct CabacNb {
   int w, h;
-  std::vector<uint8_t> seen, i4x4;
+  std::vector<uint8_t> seen, i4x4, skip;
   std::vector<int32_t> cmode, cbpl, cbpc;
-  std::vector<int8_t> dccbf, lcbf, ccbf, cdccbf;
+  std::vector<int8_t> dccbf, lcbf, ccbf, cdccbf, refgt0;
+  std::vector<int32_t> absmvd;          // [2][4h][4w] per-4x4 |mvd|
   bool last_dqp_nz = false;
 
   CabacNb(int width_mbs, int height_mbs) : w(width_mbs), h(height_mbs) {
     int n = w * h;
     seen.assign(n, 0);
     i4x4.assign(n, 0);
+    skip.assign(n, 0);
     cmode.assign(n, 0);
     cbpl.assign(n, 0);
     cbpc.assign(n, 0);
@@ -1588,6 +1744,67 @@ struct CabacNb {
     lcbf.assign(static_cast<size_t>(4 * h) * 4 * w, -1);
     ccbf.assign(static_cast<size_t>(2) * 2 * h * 2 * w, -1);
     cdccbf.assign(static_cast<size_t>(2) * n, 0);
+    refgt0.assign(static_cast<size_t>(2 * h) * 2 * w, 0);
+    absmvd.assign(static_cast<size_t>(2) * 4 * h * 4 * w, 0);
+  }
+
+  // -- P-slice ctxIdxInc helpers (9.3.3.1.1.1 / .6 / .7) --
+  int skip_inc(int mb) const {
+    int inc = 0;
+    int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
+    if (a >= 0 && !skip[a]) ++inc;
+    if (b >= 0 && !skip[b]) ++inc;
+    return inc;
+  }
+  int ref_inc(int bx, int by) const {
+    int a = bx > 0 ? refgt0[static_cast<size_t>(by) * 2 * w + bx - 1] : 0;
+    int b = by > 0 ? refgt0[static_cast<size_t>(by - 1) * 2 * w + bx] : 0;
+    return a + 2 * b;
+  }
+  void set_refgt0(int bx, int by, int bw_, int bh_, int v) {
+    for (int y = 0; y < bh_; ++y)
+      for (int x = 0; x < bw_; ++x)
+        refgt0[static_cast<size_t>(by + y) * 2 * w + bx + x] =
+            static_cast<int8_t>(v);
+  }
+  int mvd_inc(int comp, int x4, int y4) const {
+    const int32_t *g = absmvd.data() +
+                       static_cast<size_t>(comp) * 4 * h * 4 * w;
+    int32_t a = x4 > 0 ? g[static_cast<size_t>(y4) * 4 * w + x4 - 1] : 0;
+    int32_t b = y4 > 0 ? g[static_cast<size_t>(y4 - 1) * 4 * w + x4] : 0;
+    int32_t s = a + b;
+    return (s > 2 ? 1 : 0) + (s > 32 ? 1 : 0);
+  }
+  void set_absmvd(int comp, int x4, int y4, int w4, int h4, int32_t v) {
+    int32_t *g = absmvd.data() + static_cast<size_t>(comp) * 4 * h * 4 * w;
+    for (int y = 0; y < h4; ++y)
+      for (int x = 0; x < w4; ++x)
+        g[static_cast<size_t>(y4 + y) * 4 * w + x4 + x] = v;
+  }
+  void mark_skip(int mb) {
+    int mbx4 = (mb % w) * 4, mby4 = (mb / w) * 4;
+    int cx = (mb % w) * 2, cy = (mb / w) * 2;
+    seen[mb] = 1;
+    skip[mb] = 1;
+    i4x4[mb] = 0;
+    cmode[mb] = 0;
+    cbpl[mb] = 0;
+    cbpc[mb] = 0;
+    dccbf[mb] = 0;
+    cdccbf[mb] = 0;
+    cdccbf[static_cast<size_t>(w) * h + mb] = 0;
+    for (int y = 0; y < 4; ++y)
+      for (int x = 0; x < 4; ++x)
+        lcbf[static_cast<size_t>(mby4 + y) * 4 * w + mbx4 + x] = 0;
+    for (int comp = 0; comp < 2; ++comp)
+      for (int y = 0; y < 2; ++y)
+        for (int x = 0; x < 2; ++x)
+          ccbf[static_cast<size_t>(comp) * 2 * h * 2 * w +
+               static_cast<size_t>(cy + y) * 2 * w + cx + x] = 0;
+    set_refgt0(cx, cy, 2, 2, 0);
+    set_absmvd(0, mbx4, mby4, 4, 4, 0);
+    set_absmvd(1, mbx4, mby4, 4, 4, 0);
+    last_dqp_nz = false;
   }
 
   int mbok(int mb, int dx, int dy) const {
@@ -1642,21 +1859,24 @@ struct CabacNb {
     return inc;
   }
 
-  int cbf_at(const int8_t *g, int y, int x, int H, int W) const {
-    if (x < 0 || y < 0 || x >= W || y >= H) return 1;
+  int cbf_at(const int8_t *g, int y, int x, int H, int W,
+             int dflt) const {
+    // unavailable/out-of-slice → 1 when the CURRENT MB is intra, 0
+    // when inter (9.3.3.1.1.9)
+    if (x < 0 || y < 0 || x >= W || y >= H) return dflt;
     int8_t v = g[static_cast<size_t>(y) * W + x];
-    return v < 0 ? 1 : v;
+    return v < 0 ? dflt : v;
   }
 
-  int luma_cbf_inc(int gx, int gy) const {
-    return cbf_at(lcbf.data(), gy, gx - 1, 4 * h, 4 * w) +
-           2 * cbf_at(lcbf.data(), gy - 1, gx, 4 * h, 4 * w);
+  int luma_cbf_inc(int gx, int gy, int intra = 1) const {
+    return cbf_at(lcbf.data(), gy, gx - 1, 4 * h, 4 * w, intra) +
+           2 * cbf_at(lcbf.data(), gy - 1, gx, 4 * h, 4 * w, intra);
   }
 
-  int chroma_cbf_inc(int comp, int gx, int gy) const {
+  int chroma_cbf_inc(int comp, int gx, int gy, int intra = 1) const {
     const int8_t *g = ccbf.data() + static_cast<size_t>(comp) * 2 * h * 2 * w;
-    return cbf_at(g, gy, gx - 1, 2 * h, 2 * w) +
-           2 * cbf_at(g, gy - 1, gx, 2 * h, 2 * w);
+    return cbf_at(g, gy, gx - 1, 2 * h, 2 * w, intra) +
+           2 * cbf_at(g, gy - 1, gx, 2 * h, 2 * w, intra);
   }
 
   int dc_cbf_inc(int mb) const {
@@ -1664,10 +1884,10 @@ struct CabacNb {
     return (a < 0 ? 1 : dccbf[a]) + 2 * (b < 0 ? 1 : dccbf[b]);
   }
 
-  int cdc_inc(int comp, int mb) const {
+  int cdc_inc(int comp, int mb, int intra = 1) const {
     int a = mbok(mb, -1, 0), b = mbok(mb, 0, -1);
-    int va = a < 0 ? 1 : cdccbf[static_cast<size_t>(comp) * w * h + a];
-    int vb = b < 0 ? 1 : cdccbf[static_cast<size_t>(comp) * w * h + b];
+    int va = a < 0 ? intra : cdccbf[static_cast<size_t>(comp) * w * h + a];
+    int vb = b < 0 ? intra : cdccbf[static_cast<size_t>(comp) * w * h + b];
     return va + 2 * vb;
   }
 
@@ -1777,7 +1997,15 @@ void cabac_residual_enc(CabacEnc &en, int cat, const int16_t *row,
 
 }  // namespace
 
-/* Native CABAC I-slice requant — same contract as the CAVLC entry. */
+/* Native CABAC requant, FUSED single pass with I + P slice coverage
+ * (mirrors codecs/h264_cabac.py BIT-EXACTLY): each MB is decoded,
+ * requantized and re-encoded before the next — decoder and encoder
+ * each keep their own neighbor grids (write-side contexts follow the
+ * POST-requant cbf/cbp), and the per-MB payload lives in L1 scratch.
+ * P slices add mb_skip_flag (ctx 11-13), P mb_type/sub_mb_type
+ * binarizations, ref_idx unary coding over a per-8x8 refIdx cache,
+ * UEG3 mvd with the |mvdA|+|mvdB| rule over a per-4x4 cache, and the
+ * cabac_init_idc inter init tables. */
 extern "C" int32_t ed_h264_requant_slice_cabac(
     const uint8_t *nal, int32_t nal_len, uint8_t *out, int32_t out_cap,
     int32_t width_mbs, int32_t height_mbs, int32_t log2_max_frame_num,
@@ -1804,31 +2032,30 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
                                 &first_mb, num_ref_l0_default,
                                 weighted_pred, 1);
   if (hrc) return hrc;
-  if (h.is_p) return kErrUnsupported;  // native CABAC P: next milestone
-                                       // (Python oracle covers it)
 
   int n_mbs = width_mbs * height_mbs;
   if (first_mb >= static_cast<uint32_t>(n_mbs)) return kErrBitstream;
+  const int8_t(*init_table)[2] =
+      h.is_p ? kCabacCtxInitP[h.cabac_init_idc] : kCabacCtxInitI;
 
   CabacDec dec;
   if (dec.init(rbsp.data(), static_cast<int64_t>(rbsp.size()) * 8, br.pos,
-               h.qp))
+               h.qp, init_table))
     return kErrBitstream;
 
-  // ---- per-MB storage (CAVLC layout: row 0 = I_16x16 DC, 1+b = blocks)
-  std::vector<int16_t> all_levels(static_cast<size_t>(n_mbs) * 17 * 16);
-  std::vector<int32_t> mb_qp(n_mbs);
-  std::vector<uint8_t> mb_is16(n_mbs), mb_pred16(n_mbs);
-  std::vector<uint8_t> mb_modes(static_cast<size_t>(n_mbs) * 16 * 2);
-  std::vector<uint32_t> mb_chroma(n_mbs);
-  std::vector<uint8_t> mb_ccbp_in(n_mbs);
-  std::vector<int16_t> cdc(static_cast<size_t>(n_mbs) * 2 * 16);
-  std::vector<int16_t> cac(static_cast<size_t>(n_mbs) * 2 * 4 * 16);
+  BitWriter bw;
+  int32_t qp_out_base = h.qp + delta_qp;
+  if (qp_out_base > 51) return kErrUnsupported;
+  write_islice_header(bw, h, first_mb, pps_id, qp_out_base,
+                      log2_max_frame_num, poc_type, log2_max_poc_lsb,
+                      pic_init_qp, deblocking_control, 1);
+  while (bw.nbits) bw.bit(1);                      // cabac_alignment_one
+  CabacEnc enc;
+  cabac_init_states(enc.state, qp_out_base, init_table);
 
-  // one authoritative copy of the per-MB dqp / chroma-pred-mode syntax
-  // (the Python mirror keeps these in _parse_dqp/_write_dqp/
-  // _parse_chroma_mode/_write_chroma_mode); qp-range policy stays at
-  // the call sites
+  CabacNb nb(width_mbs, height_mbs);               // parse-side contexts
+  CabacNb wb(width_mbs, height_mbs);               // write-side contexts
+
   auto read_dqp = [](CabacDec &dc, CabacNb &grids, int32_t *delta) {
     int val = 0;
     int ctx = 60 + (grids.last_dqp_nz ? 1 : 0);
@@ -1871,6 +2098,63 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
     }
     grids.cmode[mbi] = cm;
   };
+  // UEG3 mvd (9.3.2.3): TU prefix cMax 9 over base+{inc,3..6}, EG3
+  // bypass suffix, bypass sign
+  auto read_mvd = [](CabacDec &dc, int base, int inc, int32_t *v) {
+    if (!dc.decision(base + inc)) {
+      *v = 0;
+      return true;
+    }
+    int32_t mag = 1;
+    int ctxofs = 3;
+    while (mag < 9 && dc.decision(base + ctxofs)) {
+      ++mag;
+      if (ctxofs < 6) ++ctxofs;
+    }
+    if (mag == 9) {
+      int kk = 3;
+      while (dc.bypass()) {
+        mag += 1 << kk;
+        if (++kk > 24) return false;
+      }
+      while (kk) {
+        --kk;
+        mag += dc.bypass() << kk;
+      }
+    }
+    *v = dc.bypass() ? -mag : mag;
+    return true;
+  };
+  auto emit_mvd = [](CabacEnc &en, int base, int inc, int32_t v) {
+    int32_t mag = v < 0 ? -v : v;
+    if (mag == 0) {
+      en.decision(base + inc, 0);
+      return;
+    }
+    en.decision(base + inc, 1);
+    int ctxofs = 3;
+    int n = 1;
+    int pre = mag < 9 ? mag : 9;
+    while (n < pre) {
+      en.decision(base + ctxofs, 1);
+      if (ctxofs < 6) ++ctxofs;
+      ++n;
+    }
+    if (mag < 9) {
+      en.decision(base + ctxofs, 0);
+    } else {
+      int32_t rem = mag - 9;
+      int kk = 3;
+      while (rem >= (1 << kk)) {
+        en.bypass(1);
+        rem -= 1 << kk;
+        ++kk;
+      }
+      en.bypass(0);
+      for (int i = kk - 1; i >= 0; --i) en.bypass((rem >> i) & 1);
+    }
+    en.bypass(v < 0 ? 1 : 0);
+  };
 
   int k = delta_qp / 6;
   int deadzone = (1 << k) / 3;
@@ -1879,155 +2163,6 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
     q = q < 0 ? 0 : (q > 51 ? 51 : q);
     return kChromaQp[q];
   };
-
-  // ---- decode pass
-  CabacNb nb(width_mbs, height_mbs);
-  int32_t cur_qp = h.qp;
-  int32_t max_qp = h.qp;
-  int end_mb = static_cast<int>(first_mb);
-  int64_t blk_count = 0;
-  for (int mb = static_cast<int>(first_mb);; ++mb) {
-    if (mb >= n_mbs) return kErrBitstream;         // overran the picture
-    int mbx4 = (mb % width_mbs) * 4, mby4 = (mb / width_mbs) * 4;
-    int cx2 = (mb % width_mbs) * 2, cy2 = (mb / width_mbs) * 2;
-    int16_t *rows = &all_levels[static_cast<size_t>(mb) * 17 * 16];
-    int16_t *cd = &cdc[static_cast<size_t>(mb) * 2 * 16];
-    int16_t *ca = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
-    int chroma_cbp;
-    if (dec.decision(3 + nb.mb_type_inc(mb)) == 0) {
-      // ---------------- I_4x4
-      mb_is16[mb] = 0;
-      for (int b = 0; b < 16; ++b) {
-        int flag = dec.decision(68);
-        int rem = 0;
-        if (!flag)
-          rem = dec.decision(69) | (dec.decision(69) << 1) |
-                (dec.decision(69) << 2);
-        mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2] =
-            static_cast<uint8_t>(flag);
-        mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1] =
-            static_cast<uint8_t>(rem);
-      }
-      nb.seen[mb] = 1;
-      nb.i4x4[mb] = 1;
-      mb_chroma[mb] = static_cast<uint32_t>(read_cmode(dec, nb, mb));
-      int cbp = 0;
-      for (int b8 = 0; b8 < 4; ++b8)
-        if (dec.decision(73 + nb.cbp_luma_inc(mb, b8, cbp)))
-          cbp |= 1 << b8;
-      chroma_cbp = 0;
-      if (dec.decision(77 + nb.cbp_chroma_inc(mb, 0)))
-        chroma_cbp = dec.decision(81 + nb.cbp_chroma_inc(mb, 1)) ? 2 : 1;
-      nb.cbpl[mb] = cbp;
-      nb.cbpc[mb] = chroma_cbp;
-      if (cbp || chroma_cbp) {
-        int32_t delta;
-        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
-        cur_qp += delta;
-        if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
-      } else {
-        nb.last_dqp_nz = false;
-      }
-      mb_qp[mb] = cur_qp;
-      if (cur_qp > max_qp) max_qp = cur_qp;
-      nb.dccbf[mb] = 0;
-      for (int b = 0; b < 16; ++b) {
-        int x4, y4;
-        blk_xy(b, &x4, &y4);
-        int gx = mbx4 + x4, gy = mby4 + y4;
-        int16_t *lv = rows + (1 + b) * 16;
-        if ((cbp >> (b >> 2)) & 1) {
-          int cbf = dec.decision(85 + 8 + nb.luma_cbf_inc(gx, gy));
-          nb.set_lcbf(gx, gy, cbf);
-          if (cbf && !cabac_residual_dec(dec, 2, lv, 16))
-            return kErrBitstream;
-        } else {
-          nb.set_lcbf(gx, gy, 0);
-        }
-      }
-      blk_count += 16 + (chroma_cbp ? 8 : 0);
-    } else {
-      // ---------------- I_16x16
-      if (dec.terminate()) return kErrUnsupported;  // I_PCM
-      int luma15 = dec.decision(6);
-      chroma_cbp = 0;
-      if (dec.decision(7)) chroma_cbp = dec.decision(8) ? 2 : 1;
-      int pred = (dec.decision(9) << 1) | dec.decision(10);
-      mb_is16[mb] = 1;
-      mb_pred16[mb] = static_cast<uint8_t>(pred);
-      nb.seen[mb] = 1;
-      nb.i4x4[mb] = 0;
-      nb.cbpl[mb] = luma15 ? 15 : 0;
-      nb.cbpc[mb] = chroma_cbp;
-      mb_chroma[mb] = static_cast<uint32_t>(read_cmode(dec, nb, mb));
-      {
-        int32_t delta;
-        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
-        cur_qp += delta;
-        if (cur_qp < 12 || cur_qp > 51) return kErrUnsupported;
-      }
-      mb_qp[mb] = cur_qp;
-      if (cur_qp > max_qp) max_qp = cur_qp;
-      int cbf = dec.decision(85 + 0 + nb.dc_cbf_inc(mb));
-      nb.dccbf[mb] = static_cast<int8_t>(cbf);
-      if (cbf && !cabac_residual_dec(dec, 0, rows, 16))
-        return kErrBitstream;
-      for (int b = 0; b < 16; ++b) {
-        int x4, y4;
-        blk_xy(b, &x4, &y4);
-        int gx = mbx4 + x4, gy = mby4 + y4;
-        int16_t *lv = rows + (1 + b) * 16;
-        if (luma15) {
-          int c2 = dec.decision(85 + 4 + nb.luma_cbf_inc(gx, gy));
-          nb.set_lcbf(gx, gy, c2);
-          if (c2 && !cabac_residual_dec(dec, 1, lv, 15))
-            return kErrBitstream;
-        } else {
-          nb.set_lcbf(gx, gy, 0);
-        }
-      }
-      blk_count += 17 + (chroma_cbp ? 8 : 0);
-    }
-    // ---------------- chroma residuals (shared I_4x4 / I_16x16)
-    mb_ccbp_in[mb] = static_cast<uint8_t>(chroma_cbp);
-    if (chroma_cbp) {
-      for (int comp = 0; comp < 2; ++comp) {
-        int cbf = dec.decision(85 + 12 + nb.cdc_inc(comp, mb));
-        nb.set_cdc(comp, mb, cbf);
-        if (cbf && !cabac_residual_dec(dec, 3, cd + comp * 16, 4))
-          return kErrBitstream;
-      }
-    } else {
-      nb.set_cdc(0, mb, 0);
-      nb.set_cdc(1, mb, 0);
-    }
-    for (int comp = 0; comp < 2; ++comp)
-      for (int b = 0; b < 4; ++b) {
-        int gx = cx2 + (b & 1), gy = cy2 + (b >> 1);
-        if (chroma_cbp == 2) {
-          int cbf = dec.decision(85 + 16 + nb.chroma_cbf_inc(comp, gx, gy));
-          nb.set_ccbf(comp, gx, gy, cbf);
-          if (cbf &&
-              !cabac_residual_dec(dec, 4, ca + (comp * 4 + b) * 16, 15))
-            return kErrBitstream;
-        } else {
-          nb.set_ccbf(comp, gx, gy, 0);
-        }
-      }
-    if (!dec.ok) return kErrBitstream;
-    end_mb = mb + 1;
-    if (dec.terminate()) break;
-  }
-  if (max_qp + delta_qp > 51) return kErrUnsupported;  // ladder ceiling
-  if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
-  if (blocks_out)
-    *blocks_out = static_cast<int32_t>(
-        blk_count > INT32_MAX ? INT32_MAX : blk_count);
-
-  // ---- requant (+6k shift, chroma via Table 8-15 QPc dispatch) and
-  // output CBP recompute — identical math to the CAVLC entry
-  std::vector<int32_t> mb_cbp_out(n_mbs);
-  std::vector<uint8_t> mb_ccbp_out(n_mbs);
   auto shift_row16 = [&](int16_t *lv, int n) {
     bool any = false;
     for (int i = 0; i < n; ++i) {
@@ -2040,147 +2175,83 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
     }
     return any;
   };
-  for (int mb = static_cast<int>(first_mb); mb < end_mb; ++mb) {
-    int16_t *rows = &all_levels[static_cast<size_t>(mb) * 17 * 16];
-    int16_t *cd = &cdc[static_cast<size_t>(mb) * 2 * 16];
-    int16_t *ca = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
-    if (mb_is16[mb]) {
-      shift_row16(rows, 16);                       // DC
-      bool any_ac = false;
-      for (int b = 0; b < 16; ++b)
-        any_ac |= shift_row16(rows + (1 + b) * 16, 15);
-      mb_cbp_out[mb] = any_ac ? 15 : 0;
+
+  // ---- per-MB scratch ----
+  int16_t rows[17 * 16];                 // row 0 = I16 DC, 1+b = blocks
+  int16_t cd[2 * 16], ca[2 * 4 * 16];
+  uint8_t modes[16][2];
+  uint32_t sub_t[4];
+  int refs[4];
+  int32_t mvdbuf[16][2];
+  // P partition geometry: (x8, y8, w8, h8) per partition
+  struct P8 { int8_t x, y, pw, ph; };
+  static const P8 kParts16x16[1] = {{0, 0, 2, 2}};
+  static const P8 kParts16x8[2] = {{0, 0, 2, 1}, {0, 1, 2, 1}};
+  static const P8 kParts8x16[2] = {{0, 0, 1, 2}, {1, 0, 1, 2}};
+  static const P8 kParts8x8[4] = {
+      {0, 0, 1, 1}, {1, 0, 1, 1}, {0, 1, 1, 1}, {1, 1, 1, 1}};
+  // sub partition rects in 4x4 units relative to the 8x8
+  struct S4 { int8_t x, y, sw, sh; };
+  static const S4 kSub4[4][4] = {
+      {{0, 0, 2, 2}, {}, {}, {}},
+      {{0, 0, 2, 1}, {0, 1, 2, 1}, {}, {}},
+      {{0, 0, 1, 2}, {1, 0, 1, 2}, {}, {}},
+      {{0, 0, 1, 1}, {1, 0, 1, 1}, {0, 1, 1, 1}, {1, 1, 1, 1}}};
+  static const int kSubN[4] = {1, 2, 2, 4};
+
+  // fused chroma: decode with nb, requant, report new ccbp via
+  // *out_ccbp; then encode with wb (called twice, dec then enc phase
+  // merged here for locality)
+  auto chroma_fused = [&](int mb, int ccbp_in, int32_t qpy, int intra,
+                          int *ccbp_out) -> bool {
+    int cx2 = (mb % width_mbs) * 2, cy2 = (mb / width_mbs) * 2;
+    std::memset(cd, 0, sizeof(cd));
+    std::memset(ca, 0, sizeof(ca));
+    if (ccbp_in) {
+      for (int comp = 0; comp < 2; ++comp) {
+        int cbf = dec.decision(85 + 12 + nb.cdc_inc(comp, mb, intra));
+        nb.set_cdc(comp, mb, cbf);
+        if (cbf && !cabac_residual_dec(dec, 3, cd + comp * 16, 4))
+          return false;
+      }
     } else {
-      int out_cbp = 0;
-      for (int b = 0; b < 16; ++b)
-        if (shift_row16(rows + (1 + b) * 16, 16)) out_cbp |= 1 << (b >> 2);
-      mb_cbp_out[mb] = out_cbp;
+      nb.set_cdc(0, mb, 0);
+      nb.set_cdc(1, mb, 0);
     }
-    if (mb_ccbp_in[mb]) {
+    for (int comp = 0; comp < 2; ++comp)
+      for (int b = 0; b < 4; ++b) {
+        int gx = cx2 + (b & 1), gy = cy2 + (b >> 1);
+        if (ccbp_in == 2) {
+          int cbf = dec.decision(85 + 16 +
+                                 nb.chroma_cbf_inc(comp, gx, gy, intra));
+          nb.set_ccbf(comp, gx, gy, cbf);
+          if (cbf &&
+              !cabac_residual_dec(dec, 4, ca + (comp * 4 + b) * 16, 15))
+            return false;
+        } else {
+          nb.set_ccbf(comp, gx, gy, 0);
+        }
+      }
+    int ccbp = 0;
+    if (ccbp_in) {
       for (int comp = 0; comp < 2; ++comp)
         chroma_requant_comp(cd + comp * 16, ca + comp * 4 * 16,
-                            qpc_of(mb_qp[mb]),
-                            qpc_of(mb_qp[mb] + delta_qp));
+                            qpc_of(qpy), qpc_of(qpy + delta_qp));
       bool any_dc = false, any_ac = false;
       for (int i = 0; i < 2 * 16; ++i) any_dc |= cd[i] != 0;
       for (int i = 0; i < 2 * 4 * 16; ++i) any_ac |= ca[i] != 0;
-      mb_ccbp_out[mb] = any_ac ? 2 : (any_dc ? 1 : 0);
-    } else {
-      mb_ccbp_out[mb] = 0;
+      ccbp = any_ac ? 2 : (any_dc ? 1 : 0);
     }
-  }
-
-  // ---- re-encode
-  BitWriter bw;
-  int32_t qp_out_base = h.qp + delta_qp;
-  write_islice_header(bw, h, first_mb, pps_id, qp_out_base,
-                      log2_max_frame_num, poc_type, log2_max_poc_lsb,
-                      pic_init_qp, deblocking_control);
-  while (bw.nbits) bw.bit(1);                      // cabac_alignment_one
-  CabacEnc enc;
-  cabac_init_states(enc.state, qp_out_base);
-  CabacNb wb(width_mbs, height_mbs);
-  int32_t prev_qp = qp_out_base;
-  for (int mb = static_cast<int>(first_mb); mb < end_mb; ++mb) {
-    int mbx4 = (mb % width_mbs) * 4, mby4 = (mb / width_mbs) * 4;
+    *ccbp_out = ccbp;
+    return true;
+  };
+  auto chroma_emit = [&](int mb, int ccbp, int intra) {
     int cx2 = (mb % width_mbs) * 2, cy2 = (mb / width_mbs) * 2;
-    const int16_t *rows = &all_levels[static_cast<size_t>(mb) * 17 * 16];
-    const int16_t *cd = &cdc[static_cast<size_t>(mb) * 2 * 16];
-    const int16_t *ca = &cac[static_cast<size_t>(mb) * 2 * 4 * 16];
-    int32_t qp_out_mb = mb_qp[mb] + delta_qp;
-    int ccbp = mb_ccbp_out[mb];
-    if (!mb_is16[mb]) {
-      enc.decision(3 + wb.mb_type_inc(mb), 0);
-      wb.seen[mb] = 1;
-      wb.i4x4[mb] = 1;
-      for (int b = 0; b < 16; ++b) {
-        int flag = mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2];
-        int rem = mb_modes[(static_cast<size_t>(mb) * 16 + b) * 2 + 1];
-        enc.decision(68, flag);
-        if (!flag) {
-          enc.decision(69, rem & 1);
-          enc.decision(69, (rem >> 1) & 1);
-          enc.decision(69, (rem >> 2) & 1);
-        }
-      }
-      emit_cmode(enc, wb, mb, static_cast<int>(mb_chroma[mb]));
-      int cbp = mb_cbp_out[mb];
-      int built = 0;
-      for (int b8 = 0; b8 < 4; ++b8) {
-        int bit = (cbp >> b8) & 1;
-        enc.decision(73 + wb.cbp_luma_inc(mb, b8, built), bit);
-        built |= bit << b8;
-      }
-      enc.decision(77 + wb.cbp_chroma_inc(mb, 0), ccbp ? 1 : 0);
-      if (ccbp) enc.decision(81 + wb.cbp_chroma_inc(mb, 1),
-                             ccbp == 2 ? 1 : 0);
-      wb.cbpl[mb] = cbp;
-      wb.cbpc[mb] = ccbp;
-      if (cbp || ccbp) {
-        if (!emit_dqp(enc, wb, qp_out_mb - prev_qp))
-          return kErrUnsupported;
-        prev_qp = qp_out_mb;
-      } else {
-        wb.last_dqp_nz = false;
-      }
-      wb.dccbf[mb] = 0;
-      for (int b = 0; b < 16; ++b) {
-        int x4, y4;
-        blk_xy(b, &x4, &y4);
-        int gx = mbx4 + x4, gy = mby4 + y4;
-        const int16_t *lv = rows + (1 + b) * 16;
-        if ((cbp >> (b >> 2)) & 1) {
-          bool any = false;
-          for (int i = 0; i < 16; ++i) any |= lv[i] != 0;
-          enc.decision(85 + 8 + wb.luma_cbf_inc(gx, gy), any ? 1 : 0);
-          wb.set_lcbf(gx, gy, any ? 1 : 0);
-          if (any) cabac_residual_enc(enc, 2, lv, 16);
-        } else {
-          wb.set_lcbf(gx, gy, 0);
-        }
-      }
-    } else {
-      enc.decision(3 + wb.mb_type_inc(mb), 1);
-      wb.seen[mb] = 1;
-      wb.i4x4[mb] = 0;
-      enc.terminate(0);
-      int luma15 = mb_cbp_out[mb] == 15;
-      enc.decision(6, luma15);
-      enc.decision(7, ccbp ? 1 : 0);
-      if (ccbp) enc.decision(8, ccbp == 2 ? 1 : 0);
-      enc.decision(9, (mb_pred16[mb] >> 1) & 1);
-      enc.decision(10, mb_pred16[mb] & 1);
-      wb.cbpl[mb] = luma15 ? 15 : 0;
-      wb.cbpc[mb] = ccbp;
-      emit_cmode(enc, wb, mb, static_cast<int>(mb_chroma[mb]));
-      if (!emit_dqp(enc, wb, qp_out_mb - prev_qp)) return kErrUnsupported;
-      prev_qp = qp_out_mb;
-      bool any_dc = false;
-      for (int i = 0; i < 16; ++i) any_dc |= rows[i] != 0;
-      enc.decision(85 + 0 + wb.dc_cbf_inc(mb), any_dc ? 1 : 0);
-      wb.dccbf[mb] = any_dc ? 1 : 0;
-      if (any_dc) cabac_residual_enc(enc, 0, rows, 16);
-      for (int b = 0; b < 16; ++b) {
-        int x4, y4;
-        blk_xy(b, &x4, &y4);
-        int gx = mbx4 + x4, gy = mby4 + y4;
-        const int16_t *lv = rows + (1 + b) * 16;
-        if (luma15) {
-          bool any = false;
-          for (int i = 0; i < 15; ++i) any |= lv[i] != 0;
-          enc.decision(85 + 4 + wb.luma_cbf_inc(gx, gy), any ? 1 : 0);
-          wb.set_lcbf(gx, gy, any ? 1 : 0);
-          if (any) cabac_residual_enc(enc, 1, lv, 15);
-        } else {
-          wb.set_lcbf(gx, gy, 0);
-        }
-      }
-    }
     if (ccbp) {
       for (int comp = 0; comp < 2; ++comp) {
         const int16_t *d = cd + comp * 16;
         bool any = d[0] || d[1] || d[2] || d[3];
-        enc.decision(85 + 12 + wb.cdc_inc(comp, mb), any ? 1 : 0);
+        enc.decision(85 + 12 + wb.cdc_inc(comp, mb, intra), any ? 1 : 0);
         wb.set_cdc(comp, mb, any ? 1 : 0);
         if (any) cabac_residual_enc(enc, 3, d, 4);
       }
@@ -2195,7 +2266,7 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
           const int16_t *lv = ca + (comp * 4 + b) * 16;
           bool any = false;
           for (int i = 0; i < 15; ++i) any |= lv[i] != 0;
-          enc.decision(85 + 16 + wb.chroma_cbf_inc(comp, gx, gy),
+          enc.decision(85 + 16 + wb.chroma_cbf_inc(comp, gx, gy, intra),
                        any ? 1 : 0);
           wb.set_ccbf(comp, gx, gy, any ? 1 : 0);
           if (any) cabac_residual_enc(enc, 4, lv, 15);
@@ -2203,9 +2274,480 @@ extern "C" int32_t ed_h264_requant_slice_cabac(
           wb.set_ccbf(comp, gx, gy, 0);
         }
       }
-    enc.terminate(mb == end_mb - 1 ? 1 : 0);
-  }
+  };
 
+  int32_t cur_qp = h.qp;
+  int32_t prev_qp = qp_out_base;
+  int end_mb = static_cast<int>(first_mb);
+  int64_t blk_count = 0;
+  for (int mb = static_cast<int>(first_mb);; ++mb) {
+    if (mb >= n_mbs) return kErrBitstream;         // overran the picture
+    int mbx4 = (mb % width_mbs) * 4, mby4 = (mb / width_mbs) * 4;
+    int bx2 = (mb % width_mbs) * 2, by2 = (mb / width_mbs) * 2;
+
+    if (h.is_p) {
+      int skip = dec.decision(11 + nb.skip_inc(mb));
+      enc.decision(11 + wb.skip_inc(mb), skip);
+      if (skip) {
+        nb.mark_skip(mb);
+        wb.mark_skip(mb);
+        end_mb = mb + 1;
+        int done = dec.terminate();
+        enc.terminate(done);
+        if (done) break;
+        continue;
+      }
+    }
+
+    std::memset(rows, 0, sizeof(rows));
+    int is16 = 0, inter_type = -1;
+    if (h.is_p) {
+      if (dec.decision(14) == 0) {
+        if (dec.decision(15) == 0)
+          inter_type = 3 * dec.decision(16);
+        else
+          inter_type = 2 - dec.decision(17);
+      } else if (dec.decision(17) == 0) {
+        is16 = 0;
+      } else {
+        if (dec.terminate()) return kErrUnsupported;  // I_PCM
+        is16 = 1;
+      }
+    } else {
+      if (dec.decision(3 + nb.mb_type_inc(mb)) == 0) {
+        is16 = 0;
+      } else {
+        if (dec.terminate()) return kErrUnsupported;  // I_PCM
+        is16 = 1;
+      }
+    }
+
+    if (inter_type >= 0) {
+      // ---------------- P inter MB
+      nb.seen[mb] = 1;
+      nb.i4x4[mb] = 0;
+      nb.cmode[mb] = 0;
+      const P8 *parts;
+      int nparts;
+      if (inter_type == 0) {
+        parts = kParts16x16;
+        nparts = 1;
+      } else if (inter_type == 1) {
+        parts = kParts16x8;
+        nparts = 2;
+      } else if (inter_type == 2) {
+        parts = kParts8x16;
+        nparts = 2;
+      } else {
+        parts = kParts8x8;
+        nparts = 4;
+        for (int s = 0; s < 4; ++s) {            // sub_mb_type, ctx 21-23
+          if (dec.decision(21))
+            sub_t[s] = 0;
+          else if (!dec.decision(22))
+            sub_t[s] = 1;
+          else
+            sub_t[s] = dec.decision(23) ? 2 : 3;
+        }
+      }
+      for (int p = 0; p < nparts; ++p) {
+        int r = 0;
+        if (h.n_ref > 1) {
+          int ctx = 54 + nb.ref_inc(bx2 + parts[p].x, by2 + parts[p].y);
+          while (dec.decision(ctx)) {
+            if (++r > 31) return kErrBitstream;
+            ctx = r == 1 ? 58 : 59;
+          }
+          if (r >= h.n_ref) return kErrBitstream;
+        }
+        refs[p] = r;
+        nb.set_refgt0(bx2 + parts[p].x, by2 + parts[p].y, parts[p].pw,
+                      parts[p].ph, r > 0 ? 1 : 0);
+      }
+      int nmvd = 0;
+      auto dec_mvd_rect = [&](int x4, int y4, int w4, int h4) -> bool {
+        int32_t mx, my;
+        if (!read_mvd(dec, 40, nb.mvd_inc(0, x4, y4), &mx)) return false;
+        if (!read_mvd(dec, 47, nb.mvd_inc(1, x4, y4), &my)) return false;
+        nb.set_absmvd(0, x4, y4, w4, h4, mx < 0 ? -mx : mx);
+        nb.set_absmvd(1, x4, y4, w4, h4, my < 0 ? -my : my);
+        mvdbuf[nmvd][0] = mx;
+        mvdbuf[nmvd][1] = my;
+        ++nmvd;
+        return true;
+      };
+      if (inter_type == 3) {
+        for (int s = 0; s < 4; ++s) {
+          int ox = mbx4 + (s & 1) * 2, oy = mby4 + (s >> 1) * 2;
+          for (int q = 0; q < kSubN[sub_t[s]]; ++q) {
+            const S4 &r4 = kSub4[sub_t[s]][q];
+            if (!dec_mvd_rect(ox + r4.x, oy + r4.y, r4.sw, r4.sh))
+              return kErrBitstream;
+          }
+        }
+      } else {
+        for (int p = 0; p < nparts; ++p)
+          if (!dec_mvd_rect(mbx4 + parts[p].x * 2, mby4 + parts[p].y * 2,
+                            parts[p].pw * 2, parts[p].ph * 2))
+            return kErrBitstream;
+      }
+      int cbp = 0;
+      for (int b8 = 0; b8 < 4; ++b8)
+        if (dec.decision(73 + nb.cbp_luma_inc(mb, b8, cbp)))
+          cbp |= 1 << b8;
+      int chroma_cbp = 0;
+      if (dec.decision(77 + nb.cbp_chroma_inc(mb, 0)))
+        chroma_cbp = dec.decision(81 + nb.cbp_chroma_inc(mb, 1)) ? 2 : 1;
+      nb.cbpl[mb] = cbp;
+      nb.cbpc[mb] = chroma_cbp;
+      if (cbp || chroma_cbp) {
+        int32_t delta;
+        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
+        cur_qp += delta;
+        if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
+        if (cur_qp + delta_qp > 51) return kErrUnsupported;
+      } else {
+        nb.last_dqp_nz = false;
+      }
+      nb.dccbf[mb] = 0;
+      int out_cbp = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        int16_t *lv = rows + (1 + b) * 16;
+        if ((cbp >> (b >> 2)) & 1) {
+          int cbf = dec.decision(85 + 8 + nb.luma_cbf_inc(gx, gy, 0));
+          nb.set_lcbf(gx, gy, cbf);
+          if (cbf && !cabac_residual_dec(dec, 2, lv, 16))
+            return kErrBitstream;
+          if (shift_row16(lv, 16)) out_cbp |= 1 << (b >> 2);
+        } else {
+          nb.set_lcbf(gx, gy, 0);
+        }
+      }
+      blk_count += 16 + (chroma_cbp ? 8 : 0);
+      int ccbp = 0;
+      if (!chroma_fused(mb, chroma_cbp, cur_qp, 0, &ccbp))
+        return kErrBitstream;
+
+      // ---- emit
+      wb.seen[mb] = 1;
+      wb.i4x4[mb] = 0;
+      wb.cmode[mb] = 0;
+      enc.decision(14, 0);
+      if (inter_type == 0 || inter_type == 3) {
+        enc.decision(15, 0);
+        enc.decision(16, inter_type == 3 ? 1 : 0);
+      } else {
+        enc.decision(15, 1);
+        enc.decision(17, inter_type == 1 ? 1 : 0);
+      }
+      if (inter_type == 3)
+        for (int s = 0; s < 4; ++s) {
+          enc.decision(21, sub_t[s] == 0 ? 1 : 0);
+          if (sub_t[s] != 0) {
+            enc.decision(22, sub_t[s] == 1 ? 0 : 1);
+            if (sub_t[s] != 1)
+              enc.decision(23, sub_t[s] == 2 ? 1 : 0);
+          }
+        }
+      for (int p = 0; p < nparts; ++p) {
+        if (h.n_ref > 1) {
+          int ctx = 54 + wb.ref_inc(bx2 + parts[p].x, by2 + parts[p].y);
+          for (int i = 0; i < refs[p]; ++i) {
+            enc.decision(ctx, 1);
+            ctx = i == 0 ? 58 : 59;
+          }
+          enc.decision(ctx, 0);
+        }
+        wb.set_refgt0(bx2 + parts[p].x, by2 + parts[p].y, parts[p].pw,
+                      parts[p].ph, refs[p] > 0 ? 1 : 0);
+      }
+      {
+        int m = 0;
+        auto enc_mvd_rect = [&](int x4, int y4, int w4, int h4) {
+          int32_t mx = mvdbuf[m][0], my = mvdbuf[m][1];
+          emit_mvd(enc, 40, wb.mvd_inc(0, x4, y4), mx);
+          emit_mvd(enc, 47, wb.mvd_inc(1, x4, y4), my);
+          wb.set_absmvd(0, x4, y4, w4, h4, mx < 0 ? -mx : mx);
+          wb.set_absmvd(1, x4, y4, w4, h4, my < 0 ? -my : my);
+          ++m;
+        };
+        if (inter_type == 3) {
+          for (int s = 0; s < 4; ++s) {
+            int ox = mbx4 + (s & 1) * 2, oy = mby4 + (s >> 1) * 2;
+            for (int q = 0; q < kSubN[sub_t[s]]; ++q) {
+              const S4 &r4 = kSub4[sub_t[s]][q];
+              enc_mvd_rect(ox + r4.x, oy + r4.y, r4.sw, r4.sh);
+            }
+          }
+        } else {
+          for (int p = 0; p < nparts; ++p)
+            enc_mvd_rect(mbx4 + parts[p].x * 2, mby4 + parts[p].y * 2,
+                         parts[p].pw * 2, parts[p].ph * 2);
+        }
+      }
+      int built = 0;
+      for (int b8 = 0; b8 < 4; ++b8) {
+        int bit = (out_cbp >> b8) & 1;
+        enc.decision(73 + wb.cbp_luma_inc(mb, b8, built), bit);
+        built |= bit << b8;
+      }
+      enc.decision(77 + wb.cbp_chroma_inc(mb, 0), ccbp ? 1 : 0);
+      if (ccbp)
+        enc.decision(81 + wb.cbp_chroma_inc(mb, 1), ccbp == 2 ? 1 : 0);
+      wb.cbpl[mb] = out_cbp;
+      wb.cbpc[mb] = ccbp;
+      if (out_cbp || ccbp) {
+        int32_t qp_out_mb = cur_qp + delta_qp;
+        if (!emit_dqp(enc, wb, qp_out_mb - prev_qp))
+          return kErrUnsupported;
+        prev_qp = qp_out_mb;
+      } else {
+        wb.last_dqp_nz = false;
+      }
+      wb.dccbf[mb] = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        const int16_t *lv = rows + (1 + b) * 16;
+        if ((out_cbp >> (b >> 2)) & 1) {
+          bool any = false;
+          for (int i = 0; i < 16; ++i) any |= lv[i] != 0;
+          enc.decision(85 + 8 + wb.luma_cbf_inc(gx, gy, 0), any ? 1 : 0);
+          wb.set_lcbf(gx, gy, any ? 1 : 0);
+          if (any) cabac_residual_enc(enc, 2, lv, 16);
+        } else {
+          wb.set_lcbf(gx, gy, 0);
+        }
+      }
+      chroma_emit(mb, ccbp, 0);
+      if (!dec.ok) return kErrBitstream;
+      end_mb = mb + 1;
+      int done = dec.terminate();
+      enc.terminate(done);
+      if (done) break;
+      continue;
+    }
+
+    if (!is16) {
+      // ---------------- I_4x4
+      nb.seen[mb] = 1;
+      nb.i4x4[mb] = 1;
+      for (int b = 0; b < 16; ++b) {
+        int flag = dec.decision(68);
+        int rem = 0;
+        if (!flag)
+          rem = dec.decision(69) | (dec.decision(69) << 1) |
+                (dec.decision(69) << 2);
+        modes[b][0] = static_cast<uint8_t>(flag);
+        modes[b][1] = static_cast<uint8_t>(rem);
+      }
+      int cmode = read_cmode(dec, nb, mb);
+      int cbp = 0;
+      for (int b8 = 0; b8 < 4; ++b8)
+        if (dec.decision(73 + nb.cbp_luma_inc(mb, b8, cbp)))
+          cbp |= 1 << b8;
+      int chroma_cbp = 0;
+      if (dec.decision(77 + nb.cbp_chroma_inc(mb, 0)))
+        chroma_cbp = dec.decision(81 + nb.cbp_chroma_inc(mb, 1)) ? 2 : 1;
+      nb.cbpl[mb] = cbp;
+      nb.cbpc[mb] = chroma_cbp;
+      if (cbp || chroma_cbp) {
+        int32_t delta;
+        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
+        cur_qp += delta;
+        if (cur_qp < 0 || cur_qp > 51) return kErrBitstream;
+        if (cur_qp + delta_qp > 51) return kErrUnsupported;
+      } else {
+        nb.last_dqp_nz = false;
+      }
+      nb.dccbf[mb] = 0;
+      int out_cbp = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        int16_t *lv = rows + (1 + b) * 16;
+        if ((cbp >> (b >> 2)) & 1) {
+          int cbf = dec.decision(85 + 8 + nb.luma_cbf_inc(gx, gy));
+          nb.set_lcbf(gx, gy, cbf);
+          if (cbf && !cabac_residual_dec(dec, 2, lv, 16))
+            return kErrBitstream;
+          if (shift_row16(lv, 16)) out_cbp |= 1 << (b >> 2);
+        } else {
+          nb.set_lcbf(gx, gy, 0);
+        }
+      }
+      blk_count += 16 + (chroma_cbp ? 8 : 0);
+      int ccbp = 0;
+      if (!chroma_fused(mb, chroma_cbp, cur_qp, 1, &ccbp))
+        return kErrBitstream;
+
+      // ---- emit
+      wb.seen[mb] = 1;
+      wb.i4x4[mb] = 1;
+      if (h.is_p) {
+        enc.decision(14, 1);
+        enc.decision(17, 0);
+      } else {
+        enc.decision(3 + wb.mb_type_inc(mb), 0);
+      }
+      for (int b = 0; b < 16; ++b) {
+        enc.decision(68, modes[b][0]);
+        if (!modes[b][0]) {
+          enc.decision(69, modes[b][1] & 1);
+          enc.decision(69, (modes[b][1] >> 1) & 1);
+          enc.decision(69, (modes[b][1] >> 2) & 1);
+        }
+      }
+      emit_cmode(enc, wb, mb, cmode);
+      int built = 0;
+      for (int b8 = 0; b8 < 4; ++b8) {
+        int bit = (out_cbp >> b8) & 1;
+        enc.decision(73 + wb.cbp_luma_inc(mb, b8, built), bit);
+        built |= bit << b8;
+      }
+      enc.decision(77 + wb.cbp_chroma_inc(mb, 0), ccbp ? 1 : 0);
+      if (ccbp)
+        enc.decision(81 + wb.cbp_chroma_inc(mb, 1), ccbp == 2 ? 1 : 0);
+      wb.cbpl[mb] = out_cbp;
+      wb.cbpc[mb] = ccbp;
+      if (out_cbp || ccbp) {
+        int32_t qp_out_mb = cur_qp + delta_qp;
+        if (!emit_dqp(enc, wb, qp_out_mb - prev_qp))
+          return kErrUnsupported;
+        prev_qp = qp_out_mb;
+      } else {
+        wb.last_dqp_nz = false;
+      }
+      wb.dccbf[mb] = 0;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        const int16_t *lv = rows + (1 + b) * 16;
+        if ((out_cbp >> (b >> 2)) & 1) {
+          bool any = false;
+          for (int i = 0; i < 16; ++i) any |= lv[i] != 0;
+          enc.decision(85 + 8 + wb.luma_cbf_inc(gx, gy), any ? 1 : 0);
+          wb.set_lcbf(gx, gy, any ? 1 : 0);
+          if (any) cabac_residual_enc(enc, 2, lv, 16);
+        } else {
+          wb.set_lcbf(gx, gy, 0);
+        }
+      }
+      chroma_emit(mb, ccbp, 1);
+    } else {
+      // ---------------- I_16x16 (in I slices ctx 6-10; in P 18-20)
+      int c_luma15 = h.is_p ? 18 : 6;
+      int c_cb0 = h.is_p ? 19 : 7;
+      int c_cb1 = h.is_p ? 19 : 8;
+      int c_ph = h.is_p ? 20 : 9;
+      int c_pl = h.is_p ? 20 : 10;
+      int luma15 = dec.decision(c_luma15);
+      int chroma_cbp = 0;
+      if (dec.decision(c_cb0)) chroma_cbp = dec.decision(c_cb1) ? 2 : 1;
+      int pred = (dec.decision(c_ph) << 1) | dec.decision(c_pl);
+      nb.seen[mb] = 1;
+      nb.i4x4[mb] = 0;
+      nb.cbpl[mb] = luma15 ? 15 : 0;
+      nb.cbpc[mb] = chroma_cbp;
+      int cmode = read_cmode(dec, nb, mb);
+      {
+        int32_t delta;
+        if (!read_dqp(dec, nb, &delta)) return kErrBitstream;
+        cur_qp += delta;
+        if (cur_qp < 12 || cur_qp > 51) return kErrUnsupported;
+        if (cur_qp + delta_qp > 51) return kErrUnsupported;
+      }
+      int cbf = dec.decision(85 + 0 + nb.dc_cbf_inc(mb));
+      nb.dccbf[mb] = static_cast<int8_t>(cbf);
+      if (cbf && !cabac_residual_dec(dec, 0, rows, 16))
+        return kErrBitstream;
+      shift_row16(rows, 16);
+      bool any_ac = false;
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        int16_t *lv = rows + (1 + b) * 16;
+        if (luma15) {
+          int c2 = dec.decision(85 + 4 + nb.luma_cbf_inc(gx, gy));
+          nb.set_lcbf(gx, gy, c2);
+          if (c2 && !cabac_residual_dec(dec, 1, lv, 15))
+            return kErrBitstream;
+          any_ac |= shift_row16(lv, 15);
+        } else {
+          nb.set_lcbf(gx, gy, 0);
+        }
+      }
+      blk_count += 17 + (chroma_cbp ? 8 : 0);
+      int ccbp = 0;
+      if (!chroma_fused(mb, chroma_cbp, cur_qp, 1, &ccbp))
+        return kErrBitstream;
+
+      // ---- emit
+      wb.seen[mb] = 1;
+      wb.i4x4[mb] = 0;
+      int out15 = luma15 && any_ac;
+      if (h.is_p) {
+        enc.decision(14, 1);
+        enc.decision(17, 1);
+      } else {
+        enc.decision(3 + wb.mb_type_inc(mb), 1);
+      }
+      enc.terminate(0);
+      enc.decision(c_luma15, out15);
+      enc.decision(c_cb0, ccbp ? 1 : 0);
+      if (ccbp) enc.decision(c_cb1, ccbp == 2 ? 1 : 0);
+      enc.decision(c_ph, (pred >> 1) & 1);
+      enc.decision(c_pl, pred & 1);
+      wb.cbpl[mb] = out15 ? 15 : 0;
+      wb.cbpc[mb] = ccbp;
+      emit_cmode(enc, wb, mb, cmode);
+      {
+        int32_t qp_out_mb = cur_qp + delta_qp;
+        if (!emit_dqp(enc, wb, qp_out_mb - prev_qp))
+          return kErrUnsupported;
+        prev_qp = qp_out_mb;
+      }
+      bool any_dc = false;
+      for (int i = 0; i < 16; ++i) any_dc |= rows[i] != 0;
+      enc.decision(85 + 0 + wb.dc_cbf_inc(mb), any_dc ? 1 : 0);
+      wb.dccbf[mb] = any_dc ? 1 : 0;
+      if (any_dc) cabac_residual_enc(enc, 0, rows, 16);
+      for (int b = 0; b < 16; ++b) {
+        int x4, y4;
+        blk_xy(b, &x4, &y4);
+        int gx = mbx4 + x4, gy = mby4 + y4;
+        const int16_t *lv = rows + (1 + b) * 16;
+        if (out15) {
+          bool any = false;
+          for (int i = 0; i < 15; ++i) any |= lv[i] != 0;
+          enc.decision(85 + 4 + wb.luma_cbf_inc(gx, gy), any ? 1 : 0);
+          wb.set_lcbf(gx, gy, any ? 1 : 0);
+          if (any) cabac_residual_enc(enc, 1, lv, 15);
+        } else {
+          wb.set_lcbf(gx, gy, 0);
+        }
+      }
+      chroma_emit(mb, ccbp, 1);
+    }
+    if (!dec.ok) return kErrBitstream;
+    end_mb = mb + 1;
+    int done = dec.terminate();
+    enc.terminate(done);
+    if (done) break;
+  }
+  if (mbs_out) *mbs_out = end_mb - static_cast<int>(first_mb);
+  if (blocks_out)
+    *blocks_out = static_cast<int32_t>(
+        blk_count > INT32_MAX ? INT32_MAX : blk_count);
+
+  enc.finish_bytes();
   for (uint8_t byte : enc.bytes) bw.bits(byte, 8);
 
   std::vector<uint8_t> wire;
