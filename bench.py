@@ -670,12 +670,26 @@ def requant_drift_stats() -> dict:
         rq = SliceRequantizer(dq)
         open_loop = psnr(img, decode_iframe(
             [rq.transform_nal(x) for x in src]))
+        # the rung's CLOSED-LOOP mode (round 5): residuals re-derived
+        # against the output reconstruction, full 8.3 prediction
+        rq_cl = SliceRequantizer(dq, prefer_native=False,
+                                 closed_loop=True)
+        t0 = time.perf_counter()
+        closed_rung = psnr(img, decode_iframe(
+            [rq_cl.transform_nal(x) for x in src]))
+        cl_dt = time.perf_counter() - t0
         closed = psnr(img, decode_iframe(encode_iframe(img, 24 + dq)))
         out[f"requant_drift_q{dq}"] = {
             "open_loop_psnr_db": round(open_loop, 2),
+            "closed_loop_rung_psnr_db": round(closed_rung, 2),
             "closed_loop_psnr_db": round(closed, 2),
-            "drift_cost_db": round(closed - open_loop, 2)}
-    out["h264_requant_drift_db_q6"] =         out["requant_drift_q6"]["drift_cost_db"]
+            "drift_cost_db": round(closed - open_loop, 2),
+            "closed_rung_gap_db": round(closed - closed_rung, 2),
+            "closed_rung_mbs_per_sec": round(36 / cl_dt, 0)}
+    out["h264_requant_drift_db_q6"] = \
+        out["requant_drift_q6"]["drift_cost_db"]
+    out["h264_requant_closed_gap_db_q6"] = \
+        out["requant_drift_q6"]["closed_rung_gap_db"]
     return out
 
 

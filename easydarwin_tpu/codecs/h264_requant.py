@@ -59,6 +59,16 @@ class RequantStats:
         self.bytes_out += d.bytes_out
 
 
+def _peek_is_p(nal: bytes) -> bool:
+    """slice_type of a coded-slice NAL (2nd ue of the header) % 5 == 0."""
+    try:
+        br = BitReader(nal_to_rbsp(nal[1:9]))
+        br.ue()                          # first_mb_in_slice
+        return br.ue() % 5 == 0
+    except (ValueError, EOFError, IndexError):
+        return False
+
+
 def _scalar_batch(levels: np.ndarray, qp_in: np.ndarray,
                   qp_out: np.ndarray) -> np.ndarray:
     out = np.empty_like(levels)
@@ -119,7 +129,7 @@ class SliceRequantizer:
     variant run."""
 
     def __init__(self, delta_qp: int, *, requant_fn=None, chroma_fn=None,
-                 prefer_native: bool = True):
+                 prefer_native: bool = True, closed_loop: bool = False):
         if delta_qp < 6 or delta_qp % 6:
             # +6k steps are EXACT level shifts (table periodicity); other
             # deltas would need transform-normalization terms
@@ -129,6 +139,14 @@ class SliceRequantizer:
         self.chroma_fn = chroma_fn or _scalar_batch_chroma
         self._native = (prefer_native and requant_fn is None
                         and chroma_fn is None)
+        # closed_loop: I slices re-derive residuals against the OUTPUT
+        # reconstruction (codecs.h264_closed_loop) instead of the
+        # open-loop level shift — kills intra drift at a CPU cost.
+        # Reconstruction state spans the slices of one picture, so a
+        # closed-loop rung must see its AUs IN ORDER (single worker).
+        self.closed_loop = closed_loop
+        self._cl_orig = None
+        self._cl_out = None
         self.sps: Sps | None = None
         self.pps: Pps | None = None
         self.stats = RequantStats()
@@ -165,7 +183,10 @@ class SliceRequantizer:
             return nal, delta
         delta.bytes_in += len(nal)
         out = None
-        if self._native:
+        use_native = self._native
+        if self.closed_loop and use_native and not _peek_is_p(nal):
+            use_native = False           # I slices take the closed loop
+        if use_native:
             res = self._requant_native(nal, sps, pps)
             if res is not None:
                 out, _n_slice_mbs, n_blocks = res
@@ -223,6 +244,52 @@ class SliceRequantizer:
                default=qp_in_base) + self.delta_qp > 51:
             raise ValueError("qp already at ladder ceiling")
 
+        if self.closed_loop and not hdr.is_p:
+            n_blocks = self._closed_loop_slice(sps, pps, hdr, mbs)
+        else:
+            n_blocks = self._open_loop_levels(pps, mbs, n_blocks)
+        for mb in mbs:
+            if isinstance(mb, MacroblockPSkip):
+                continue
+            ccbp = (2 if np.any(mb.chroma_ac) else
+                    1 if np.any(mb.chroma_dc) else 0)
+            if isinstance(mb, MacroblockI16x16):
+                mb.luma_cbp15 = bool(np.any(mb.ac_levels))
+                mb.chroma_cbp = ccbp
+            else:                      # I_4x4 and inter share the CBP
+                cbp = 0                # recompute shape
+                for g in range(4):
+                    if np.any(mb.levels[4 * g:4 * g + 4]):
+                        cbp |= 1 << g
+                mb.cbp = cbp | (ccbp << 4)
+            mb.qp = mb.qp + self.delta_qp
+        if cabac_codec is not None:
+            return cabac_codec.write_slice(hdr, hdr.first_mb, mbs,
+                                           qp_out_base), n_blocks
+        bw = BitWriter()
+        codec.write_slice_header(bw, hdr, qp_out_base)
+        codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb, hdr)
+        bw.rbsp_trailing()
+        return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes()), n_blocks
+
+    def _closed_loop_slice(self, sps: Sps, pps: Pps, hdr, mbs) -> int:
+        """Closed-loop intra requant of one slice's MBs (mutates their
+        levels in place); returns the block count for stats parity."""
+        from .h264_closed_loop import PictureRecon, requant_mb_closed
+        if hdr.first_mb % sps.width_mbs:
+            raise ValueError("closed loop needs MB-row-aligned slices")
+        if hdr.first_mb == 0 or self._cl_orig is None:
+            self._cl_orig = PictureRecon(sps.width_mbs, sps.height_mbs)
+            self._cl_out = PictureRecon(sps.width_mbs, sps.height_mbs)
+        n_blocks = 0
+        for i, mb in enumerate(mbs, start=hdr.first_mb):
+            requant_mb_closed(self._cl_orig, self._cl_out, sps, pps, i,
+                              mb, hdr.first_mb, self.delta_qp)
+            n_blocks += (17 if isinstance(mb, MacroblockI16x16) else 16)
+            n_blocks += 8 if mb.chroma_cbp else 0
+        return n_blocks
+
+    def _open_loop_levels(self, pps: Pps, mbs, n_blocks: int) -> int:
         # gather every block with its per-MB source/target QP; the +6k
         # step is uniform so every MB shifts by the same k.  I_16x16 MBs
         # contribute a DC row + 16 zero-padded 15-coeff AC rows (the op
@@ -291,27 +358,4 @@ class SliceRequantizer:
             for j, i in enumerate(centries):
                 mbs[i].chroma_dc = d2[j]
                 mbs[i].chroma_ac = a2[j]
-
-        for mb in mbs:
-            if isinstance(mb, MacroblockPSkip):
-                continue
-            ccbp = (2 if np.any(mb.chroma_ac) else
-                    1 if np.any(mb.chroma_dc) else 0)
-            if isinstance(mb, MacroblockI16x16):
-                mb.luma_cbp15 = bool(np.any(mb.ac_levels))
-                mb.chroma_cbp = ccbp
-            else:                      # I_4x4 and inter share the CBP
-                cbp = 0                # recompute shape
-                for g in range(4):
-                    if np.any(mb.levels[4 * g:4 * g + 4]):
-                        cbp |= 1 << g
-                mb.cbp = cbp | (ccbp << 4)
-            mb.qp = mb.qp + self.delta_qp
-        if cabac_codec is not None:
-            return cabac_codec.write_slice(hdr, hdr.first_mb, mbs,
-                                           qp_out_base), n_blocks
-        bw = BitWriter()
-        codec.write_slice_header(bw, hdr, qp_out_base)
-        codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb, hdr)
-        bw.rbsp_trailing()
-        return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes()), n_blocks
+        return n_blocks

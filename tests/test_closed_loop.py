@@ -1,0 +1,128 @@
+"""Closed-loop intra requant tests (VERDICT r4 item 3).
+
+The decoder half (full 8.3 intra prediction over the shared MB model)
+is proven pixel-exact against libavcodec on x264 streams — every
+prediction mode a production encoder emits, both entropy layers.  The
+loop itself must then beat open-loop drift by a wide margin while its
+output still decodes bit-clean through the err_detect=explode oracle."""
+
+import numpy as np
+import pytest
+
+import lavc_encode as le
+from easydarwin_tpu.codecs.h264_bits import BitReader, nal_to_rbsp
+from easydarwin_tpu.codecs.h264_closed_loop import decode_intra_picture
+from easydarwin_tpu.codecs.h264_intra import (Pps, SliceCodec, Sps,
+                                              decode_iframe,
+                                              encode_iframe, psnr)
+from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
+from easydarwin_tpu.utils.synth import synth_luma
+
+pytestmark = pytest.mark.skipif(not le.available(),
+                                reason="x264 encode shim unavailable")
+
+W = H = 192
+
+
+def _parse_picture(nals):
+    sps = Sps.parse(next(n for n in nals if n[0] & 0x1F == 7))
+    pps = Pps.parse(next(n for n in nals if n[0] & 0x1F == 8))
+    slices = []
+    for nal in nals:
+        if nal[0] & 0x1F != 5:
+            continue
+        if pps.entropy_cabac:
+            from easydarwin_tpu.codecs.h264_cabac import CabacSliceCodec
+            hdr, _f, mbs, _q = CabacSliceCodec(sps, pps).parse_slice(nal)
+        else:
+            codec = SliceCodec(sps, pps)
+            br = BitReader(nal_to_rbsp(nal[1:]))
+            hdr = codec.parse_slice_header(br, nal[0])
+            mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb, hdr)
+        slices.append((hdr, mbs))
+    return sps, pps, slices
+
+
+@pytest.mark.parametrize("cabac", [False, True])
+@pytest.mark.parametrize("qp", [22, 30])
+def test_full_mode_decoder_pixel_exact_vs_lavc(cabac, qp):
+    """Every intra mode x264 picks must reconstruct EXACTLY as
+    libavcodec does (deblocking off: prediction runs pre-filter)."""
+    from lavc_oracle import LavcH264Decoder
+
+    nals = le.encode_ippp(W, H, 1, qp=qp, cabac=cabac,
+                          extra="no-deblock=1")
+    sps, pps, slices = _parse_picture(nals)
+    y, cb, cr = decode_intra_picture(sps, pps, slices)
+    ref = LavcH264Decoder().decode(
+        [n for n in nals if (n[0] & 0x1F) in (7, 8, 5)], W, H)
+    assert ref is not None
+    for ours, theirs in zip((y, cb, cr), ref):
+        assert np.array_equal(ours, theirs)
+
+
+def test_full_mode_decoder_multislice():
+    from lavc_oracle import LavcH264Decoder
+
+    nals = le.encode_ippp(W, H, 1, qp=26, cabac=True, slices=3,
+                          extra="no-deblock=1")
+    sps, pps, slices = _parse_picture(nals)
+    assert len(slices) == 3
+    y, cb, cr = decode_intra_picture(sps, pps, slices)
+    ref = LavcH264Decoder().decode(
+        [n for n in nals if (n[0] & 0x1F) in (7, 8, 5)], W, H)
+    for ours, theirs in zip((y, cb, cr), ref):
+        assert np.array_equal(ours, theirs)
+
+
+@pytest.mark.parametrize("cabac", [False, True])
+def test_closed_loop_beats_open_loop_on_x264_iframe(cabac):
+    """The headline: closed-loop kills drift on REAL encoder output —
+    several dB better than open loop at comparable bitrate, output
+    decoding bit-clean through the explode oracle."""
+    from lavc_oracle import LavcH264StreamDecoder
+
+    nals = le.encode_ippp(W, H, 1, qp=26, cabac=cabac,
+                          extra="no-deblock=1")
+    orig = LavcH264StreamDecoder().decode_stream(le.split_aus(nals), W, H)
+    scores = {}
+    sizes = {}
+    for mode in ("open", "closed"):
+        rq = SliceRequantizer(6, prefer_native=False,
+                              closed_loop=(mode == "closed"))
+        out = [rq.transform_nal(n) for n in nals]
+        assert rq.stats.slices_passed_through == 0
+        dec = LavcH264StreamDecoder().decode_stream(le.split_aus(out),
+                                                    W, H)
+        scores[mode] = psnr(orig[0][0], dec[0][0])
+        sizes[mode] = sum(len(n) for n in out)
+    assert scores["closed"] > scores["open"] + 4.0
+    assert sizes["closed"] < 1.15 * sizes["open"]
+
+
+def test_closed_rung_approaches_reencode_bound():
+    """On the DC-only drift probe the closed-loop rung must land within
+    ~3 dB of a ground-up re-encode at the target QP (VERDICT r4's
+    acceptance line; open loop was 12.9 dB away)."""
+    img = synth_luma(96)
+    src = encode_iframe(img, 24)
+    rq = SliceRequantizer(6, prefer_native=False, closed_loop=True)
+    closed_rung = psnr(img, decode_iframe(
+        [rq.transform_nal(x) for x in src]))
+    bound = psnr(img, decode_iframe(encode_iframe(img, 30)))
+    assert bound - closed_rung < 3.0
+
+
+def test_closed_loop_p_slices_fall_back_open_loop():
+    """IPPP input: the IDR closes the loop, P slices keep the open-loop
+    shift — the whole stream still requants with zero pass-through."""
+    from lavc_oracle import LavcH264StreamDecoder
+
+    nals = le.encode_ippp(W, H, 6, qp=26, cabac=False,
+                          extra="no-deblock=1")
+    rq = SliceRequantizer(6, prefer_native=False, closed_loop=True)
+    out = [rq.transform_nal(n) for n in nals]
+    assert rq.stats.slices_requantized == 6
+    assert rq.stats.slices_passed_through == 0
+    dec = LavcH264StreamDecoder().decode_stream(le.split_aus(out), W, H)
+    assert len(dec) == 6
