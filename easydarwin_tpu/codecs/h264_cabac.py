@@ -12,8 +12,12 @@ slice syntax layer — mb_type / skip flags / pred modes / ref_idx / mvd
 requant shift and the CBP/QP-chain recompute are shared byte for byte.
 
 Scope (mirrors the CAVLC rung; outside → caller passes through): frame
-I and P slices, 4:2:0 8-bit, 4x4 transform only (no 8x8, flat
-scaling), no I_PCM, no MBAFF, no B slices, no weighted prediction.
+I and P slices, 4:2:0 8-bit, flat scaling, no I_PCM, no MBAFF, no B
+slices, no weighted prediction.  High-profile 8x8 transform is decoded
+(cat-5 residuals, ctx 399 flags); dense streams round-trip byte-exact
+vs x264, but a sparse-content margin case is still open, so the
+requant gate refuses any 8x8 slice whose parse ends before the picture
+(pass-through, never truncation — see tests/test_h264_high.py).
 Constants in ``h264_cabac_tables`` are the spec's Tables 9-44/9-45 and
 the (m,n) init columns — intra plus the three cabac_init_idc inter
 tables — provenance in ``tools/gen_cabac_tables.py``.
@@ -35,7 +39,8 @@ import numpy as np
 
 from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
 from .h264_cabac_tables import (CTX_INIT_I, CTX_INIT_P0, CTX_INIT_P1,
-                                CTX_INIT_P2, RANGE_LPS, TRANS_IDX_LPS,
+                                CTX_INIT_P2, LAST_MAP_8X8, RANGE_LPS,
+                                SIG_MAP_8X8, TRANS_IDX_LPS,
                                 TRANS_IDX_MPS)
 from .h264_intra import (BLK_XY, MacroblockI16x16, MacroblockI4x4,
                          MacroblockInter, MacroblockPSkip, Pps,
@@ -62,6 +67,10 @@ _SIG_BASE = (105, 120, 134, 149, 152)      # significant_coeff_flag
 _LAST_BASE = (166, 181, 195, 210, 213)     # last_significant_coeff_flag
 _ABS_BASE = (227, 237, 247, 257, 266)      # coeff_abs_level_minus1
 _TERMINATE = 276                           # end_of_slice / I_PCM bin
+_T8_BASE = 399                             # transform_size_8x8_flag
+_SIG8 = 402                                # cat 5 (8x8 luma) residual
+_LAST8 = 417
+_ABS8 = 426
 
 
 def _init_states(slice_qp: int, table=CTX_INIT_I) -> np.ndarray:
@@ -257,6 +266,8 @@ class _NeighborState:
         # per-4x4 |mvd| by component (intra/skip cells stay 0)
         self.absmvd = np.zeros((2, 4 * height_mbs, 4 * width_mbs),
                                dtype=np.int32)
+        # per-MB transform_size_8x8_flag (ctx 399 neighbors)
+        self.t8 = np.zeros(width_mbs * height_mbs, dtype=np.int8)
 
     def _mb_ok(self, mb: int, dx: int, dy: int) -> int:
         x, y = mb % self.w + dx, mb // self.w + dy
@@ -325,6 +336,15 @@ class _NeighborState:
     def dqp_inc(self) -> int:
         return 1 if self.last_dqp_nz else 0
 
+    def t8_inc(self, mb: int) -> int:
+        """9.3.3.1.1.10: neighbors' transform_size_8x8_flag values."""
+        inc = 0
+        for dx, dy in ((-1, 0), (0, -1)):
+            n = self._mb_ok(mb, dx, dy)
+            if n >= 0 and self.t8[n]:
+                inc += 1
+        return inc
+
     def _cbf_at(self, grid, y: int, x: int, h: int, w: int,
                 dflt: int) -> int:
         # outside the slice/picture: default 1 when the CURRENT MB is
@@ -381,6 +401,7 @@ class _NeighborState:
         self.mb_seen[mb] = True
         self.skip[mb] = True
         self.is_i4x4[mb] = False
+        self.t8[mb] = 0
         self.chroma_mode[mb] = 0
         self.cbp_luma[mb] = 0
         self.cbp_chroma[mb] = 0
@@ -489,6 +510,7 @@ class CabacSliceCodec:
 
         nb.mb_seen[mb] = True
         nb.is_i4x4[mb] = False
+        nb.t8[mb] = 0
         nb.cbp_luma[mb] = 15 if luma15 else 0
         nb.cbp_chroma[mb] = chroma_cbp
 
@@ -525,8 +547,12 @@ class CabacSliceCodec:
                     cur_qp: int):
         w = self.sps.width_mbs
         mbx, mby = (mb % w) * 4, (mb // w) * 4
+        t8 = False
+        if self.pps.transform_8x8_mode:
+            t8 = bool(dec.decision(_T8_BASE + nb.t8_inc(mb)))
+        nb.t8[mb] = 1 if t8 else 0
         modes = []
-        for _ in range(16):
+        for _ in range(4 if t8 else 16):
             if dec.decision(68):
                 modes.append((1, 0))
             else:
@@ -558,19 +584,34 @@ class CabacSliceCodec:
         nb.dc_cbf[mb] = 0
 
         levels = np.zeros((16, 16), dtype=np.int64)
-        for b in range(16):
-            x4, y4 = BLK_XY[b]
-            gx, gy = mbx + x4, mby + y4
-            if (cbp >> (b >> 2)) & 1:
-                cbf = dec.decision(_CBF_BASE + 8 + nb.luma_cbf_inc(gx, gy))
-                nb.luma_cbf[gy, gx] = cbf
-                if cbf:
-                    self._residual(dec, 2, levels[b], 16)
-            else:
-                nb.luma_cbf[gy, gx] = 0
+        levels8 = None
+        if t8:
+            # 8x8 luma residual (cat 5): no per-block cbf — the CBP bit
+            # is the coded flag, and neighbor cbf cells inherit it
+            levels8 = np.zeros((4, 64), dtype=np.int64)
+            for b8 in range(4):
+                x8, y8 = (b8 & 1) * 2, (b8 >> 1) * 2
+                bit = (cbp >> b8) & 1
+                if bit:
+                    self._residual(dec, 5, levels8[b8], 64)
+                nb.luma_cbf[mby + y8:mby + y8 + 2,
+                            mbx + x8:mbx + x8 + 2] = bit
+        else:
+            for b in range(16):
+                x4, y4 = BLK_XY[b]
+                gx, gy = mbx + x4, mby + y4
+                if (cbp >> (b >> 2)) & 1:
+                    cbf = dec.decision(
+                        _CBF_BASE + 8 + nb.luma_cbf_inc(gx, gy))
+                    nb.luma_cbf[gy, gx] = cbf
+                    if cbf:
+                        self._residual(dec, 2, levels[b], 16)
+                else:
+                    nb.luma_cbf[gy, gx] = 0
         cdc, cac = self._parse_chroma(dec, nb, mb, chroma_cbp)
         out = MacroblockI4x4(modes, chroma_mode, cbp | (chroma_cbp << 4),
-                             cur_qp, levels, cdc, cac)
+                             cur_qp, levels, cdc, cac,
+                             transform_8x8=t8, levels8=levels8)
         return cur_qp, out
 
     # -------------------------------------------------- P inter parse
@@ -729,6 +770,12 @@ class CabacSliceCodec:
                 81 + nb.cbp_chroma_inc(mb, 1)) else 1
         nb.cbp_luma[mb] = cbp
         nb.cbp_chroma[mb] = chroma_cbp
+        t8 = False
+        if (cbp and self.pps.transform_8x8_mode
+                and (mb_type <= 2
+                     or all(t == 0 for t in (sub_types or [])))):
+            t8 = bool(dec.decision(_T8_BASE + nb.t8_inc(mb)))
+        nb.t8[mb] = 1 if t8 else 0
         if cbp or chroma_cbp:
             cur_qp += self._parse_dqp(dec, nb)
             if not 0 <= cur_qp <= 51:
@@ -737,22 +784,35 @@ class CabacSliceCodec:
             nb.last_dqp_nz = False
         nb.dc_cbf[mb] = 0
         levels = np.zeros((16, 16), dtype=np.int64)
-        for b in range(16):
-            x4, y4 = BLK_XY[b]
-            gx, gy = mbx + x4, mby + y4
-            if (cbp >> (b >> 2)) & 1:
-                cbf = dec.decision(
-                    _CBF_BASE + 8 + nb.luma_cbf_inc(gx, gy, intra=False))
-                nb.luma_cbf[gy, gx] = cbf
-                if cbf:
-                    self._residual(dec, 2, levels[b], 16)
-            else:
-                nb.luma_cbf[gy, gx] = 0
+        levels8 = None
+        if t8:
+            levels8 = np.zeros((4, 64), dtype=np.int64)
+            for b8 in range(4):
+                x8, y8 = (b8 & 1) * 2, (b8 >> 1) * 2
+                bit = (cbp >> b8) & 1
+                if bit:
+                    self._residual(dec, 5, levels8[b8], 64)
+                nb.luma_cbf[mby + y8:mby + y8 + 2,
+                            mbx + x8:mbx + x8 + 2] = bit
+        else:
+            for b in range(16):
+                x4, y4 = BLK_XY[b]
+                gx, gy = mbx + x4, mby + y4
+                if (cbp >> (b >> 2)) & 1:
+                    cbf = dec.decision(
+                        _CBF_BASE + 8
+                        + nb.luma_cbf_inc(gx, gy, intra=False))
+                    nb.luma_cbf[gy, gx] = cbf
+                    if cbf:
+                        self._residual(dec, 2, levels[b], 16)
+                else:
+                    nb.luma_cbf[gy, gx] = 0
         cdc, cac = self._parse_chroma(dec, nb, mb, chroma_cbp,
                                       intra=False)
         out = MacroblockInter(mb_type, sub_types, refs, mvds,
                               cbp | (chroma_cbp << 4), cur_qp, levels,
-                              cdc, cac)
+                              cdc, cac, transform_8x8=t8,
+                              levels8=levels8)
         return cur_qp, out
 
     def _parse_chroma_mode(self, dec, nb, mb) -> int:
@@ -822,22 +882,36 @@ class CabacSliceCodec:
     def _residual(self, dec: CabacDecoder, cat: int, out, maxc: int
                   ) -> None:
         """residual_block_cabac (7.3.5.3.3) with cbf already consumed;
-        ``out`` is a zigzag/scan-ordered level row."""
-        sig_base = _SIG_BASE[cat]
-        last_base = _LAST_BASE[cat]
-        sigpos = []
-        i = 0
-        while i < maxc - 1:
-            if dec.decision(sig_base + i):
-                sigpos.append(i)
-                if dec.decision(last_base + i):
-                    break
-            i += 1
+        ``out`` is a zigzag/scan-ordered level row.  cat 5 (luma 8x8)
+        selects the Table 9-43 position-mapped sig/last contexts."""
+        if cat == 5:
+            sigpos = []
+            i = 0
+            while i < 63:
+                if dec.decision(_SIG8 + SIG_MAP_8X8[i]):
+                    sigpos.append(i)
+                    if dec.decision(_LAST8 + LAST_MAP_8X8[i]):
+                        break
+                i += 1
+            else:
+                sigpos.append(63)
+            abs_base = _ABS8
         else:
-            # no last flag fired: the final scan position is implicitly
-            # significant (cbf guarantees >= 1 coefficient)
-            sigpos.append(maxc - 1)
-        abs_base = _ABS_BASE[cat]
+            sig_base = _SIG_BASE[cat]
+            last_base = _LAST_BASE[cat]
+            sigpos = []
+            i = 0
+            while i < maxc - 1:
+                if dec.decision(sig_base + i):
+                    sigpos.append(i)
+                    if dec.decision(last_base + i):
+                        break
+                i += 1
+            else:
+                # no last flag fired: the final scan position is
+                # implicitly significant (cbf guarantees >= 1 coeff)
+                sigpos.append(maxc - 1)
+            abs_base = _ABS_BASE[cat]
         n_eq1 = n_gt1 = 0
         for pos in reversed(sigpos):
             ctx0 = abs_base + (0 if n_gt1 else min(4, 1 + n_eq1))
@@ -916,11 +990,15 @@ class CabacSliceCodec:
         if isinstance(m, MacroblockI4x4):
             if is_p:
                 enc.decision(14, 1)          # intra prefix in P
-                enc.decision(17, 0)          # I_4x4
+                enc.decision(17, 0)          # I_NxN
             else:
                 enc.decision(3 + nb.mb_type_inc(mb), 0)
             nb.mb_seen[mb] = True
             nb.is_i4x4[mb] = True
+            if self.pps.transform_8x8_mode:
+                enc.decision(_T8_BASE + nb.t8_inc(mb),
+                             1 if m.transform_8x8 else 0)
+            nb.t8[mb] = 1 if m.transform_8x8 else 0
             for flag, rem in m.pred_modes:
                 enc.decision(68, flag)
                 if not flag:
@@ -949,19 +1027,28 @@ class CabacSliceCodec:
             else:
                 nb.last_dqp_nz = False
             nb.dc_cbf[mb] = 0
-            for b in range(16):
-                x4, y4 = BLK_XY[b]
-                gx, gy = mbx + x4, mby + y4
-                if (cbp >> (b >> 2)) & 1:
-                    row = m.levels[b]
-                    cbf = 1 if np.any(row) else 0
-                    enc.decision(_CBF_BASE + 8 + nb.luma_cbf_inc(gx, gy),
-                                 cbf)
-                    nb.luma_cbf[gy, gx] = cbf
-                    if cbf:
-                        self._write_residual(enc, 2, row, 16)
-                else:
-                    nb.luma_cbf[gy, gx] = 0
+            if m.transform_8x8:
+                for b8 in range(4):
+                    x8, y8 = (b8 & 1) * 2, (b8 >> 1) * 2
+                    bit = (cbp >> b8) & 1
+                    if bit:
+                        self._write_residual(enc, 5, m.levels8[b8], 64)
+                    nb.luma_cbf[mby + y8:mby + y8 + 2,
+                                mbx + x8:mbx + x8 + 2] = bit
+            else:
+                for b in range(16):
+                    x4, y4 = BLK_XY[b]
+                    gx, gy = mbx + x4, mby + y4
+                    if (cbp >> (b >> 2)) & 1:
+                        row = m.levels[b]
+                        cbf = 1 if np.any(row) else 0
+                        enc.decision(
+                            _CBF_BASE + 8 + nb.luma_cbf_inc(gx, gy), cbf)
+                        nb.luma_cbf[gy, gx] = cbf
+                        if cbf:
+                            self._write_residual(enc, 2, row, 16)
+                    else:
+                        nb.luma_cbf[gy, gx] = 0
             self._write_chroma(enc, nb, mb, chroma_cbp, m.chroma_dc,
                                m.chroma_ac, cx, cy)
             return coded_qp
@@ -975,6 +1062,7 @@ class CabacSliceCodec:
             ctxs = (6, 7, 8, 9, 10)
         nb.mb_seen[mb] = True
         nb.is_i4x4[mb] = False
+        nb.t8[mb] = 0
         enc.terminate(0)
         enc.decision(ctxs[0], 1 if m.luma_cbp15 else 0)
         enc.decision(ctxs[1], 1 if m.chroma_cbp else 0)
@@ -1058,6 +1146,10 @@ class CabacSliceCodec:
                          1 if chroma_cbp == 2 else 0)
         nb.cbp_luma[mb] = cbp
         nb.cbp_chroma[mb] = chroma_cbp
+        t8 = bool(m.transform_8x8) and cbp != 0
+        if (cbp and self.pps.transform_8x8_mode and m.allows_8x8):
+            enc.decision(_T8_BASE + nb.t8_inc(mb), 1 if t8 else 0)
+        nb.t8[mb] = 1 if t8 else 0
         coded_qp = prev_qp
         if cbp or chroma_cbp:
             self._write_dqp(enc, nb, m.qp - prev_qp)
@@ -1065,20 +1157,29 @@ class CabacSliceCodec:
         else:
             nb.last_dqp_nz = False
         nb.dc_cbf[mb] = 0
-        for b in range(16):
-            x4, y4 = BLK_XY[b]
-            gx, gy = mbx + x4, mby + y4
-            if (cbp >> (b >> 2)) & 1:
-                row = m.levels[b]
-                cbf = 1 if np.any(row) else 0
-                enc.decision(
-                    _CBF_BASE + 8 + nb.luma_cbf_inc(gx, gy, intra=False),
-                    cbf)
-                nb.luma_cbf[gy, gx] = cbf
-                if cbf:
-                    self._write_residual(enc, 2, row, 16)
-            else:
-                nb.luma_cbf[gy, gx] = 0
+        if t8:
+            for b8 in range(4):
+                x8, y8 = (b8 & 1) * 2, (b8 >> 1) * 2
+                bit = (cbp >> b8) & 1
+                if bit:
+                    self._write_residual(enc, 5, m.levels8[b8], 64)
+                nb.luma_cbf[mby + y8:mby + y8 + 2,
+                            mbx + x8:mbx + x8 + 2] = bit
+        else:
+            for b in range(16):
+                x4, y4 = BLK_XY[b]
+                gx, gy = mbx + x4, mby + y4
+                if (cbp >> (b >> 2)) & 1:
+                    row = m.levels[b]
+                    cbf = 1 if np.any(row) else 0
+                    enc.decision(
+                        _CBF_BASE + 8
+                        + nb.luma_cbf_inc(gx, gy, intra=False), cbf)
+                    nb.luma_cbf[gy, gx] = cbf
+                    if cbf:
+                        self._write_residual(enc, 2, row, 16)
+                else:
+                    nb.luma_cbf[gy, gx] = 0
         self._write_chroma(enc, nb, mb, chroma_cbp, m.chroma_dc,
                            m.chroma_ac, cx, cy, intra=False)
         return coded_qp
@@ -1137,19 +1238,30 @@ class CabacSliceCodec:
 
     def _write_residual(self, enc: CabacEncoder, cat: int, row, maxc: int
                         ) -> None:
-        sig_base = _SIG_BASE[cat]
-        last_base = _LAST_BASE[cat]
         sigpos = [i for i in range(maxc) if row[i]]
         assert sigpos
         last = sigpos[-1]
-        for i in range(maxc - 1):
-            if i > last:
-                break
-            sig = 1 if row[i] else 0
-            enc.decision(sig_base + i, sig)
-            if sig:
-                enc.decision(last_base + i, 1 if i == last else 0)
-        abs_base = _ABS_BASE[cat]
+        if cat == 5:
+            for i in range(63):
+                if i > last:
+                    break
+                sig = 1 if row[i] else 0
+                enc.decision(_SIG8 + SIG_MAP_8X8[i], sig)
+                if sig:
+                    enc.decision(_LAST8 + LAST_MAP_8X8[i],
+                                 1 if i == last else 0)
+            abs_base = _ABS8
+        else:
+            sig_base = _SIG_BASE[cat]
+            last_base = _LAST_BASE[cat]
+            for i in range(maxc - 1):
+                if i > last:
+                    break
+                sig = 1 if row[i] else 0
+                enc.decision(sig_base + i, sig)
+                if sig:
+                    enc.decision(last_base + i, 1 if i == last else 0)
+            abs_base = _ABS_BASE[cat]
         n_eq1 = n_gt1 = 0
         for pos in reversed(sigpos):
             level = int(row[pos])

@@ -122,6 +122,8 @@ def decode_mb(pic: PictureRecon, sps, pps, mb_idx: int, mb,
     mbx, mby = mb_idx % w_mbs, mb_idx // w_mbs
     first_row = first_mb // w_mbs
     qpc = chroma_qp(mb.qp, pps.chroma_qp_offset)
+    if getattr(mb, "transform_8x8", False):
+        raise ValueError("closed loop covers 4x4-transform intra only")
     if isinstance(mb, MacroblockI4x4):
         modes = derive_i4x4_modes(mb.pred_modes, pic.blk_modes, mb_idx,
                                   w_mbs, first_mb)

@@ -147,6 +147,7 @@ class Pps:
     num_ref_l0_default: int = 0         # num_ref_idx_l0_default_active_minus1
     num_ref_l1_default: int = 0
     weighted_pred: bool = False         # P-slice explicit weighting
+    transform_8x8_mode: bool = False    # High-profile 8x8 transform
 
     def build(self) -> bytes:
         bw = BitWriter()
@@ -165,6 +166,10 @@ class Pps:
         bw.write_bit(1 if self.deblocking_control else 0)
         bw.write_bit(0)                 # constrained_intra_pred
         bw.write_bit(0)                 # redundant_pic_cnt_present
+        if self.transform_8x8_mode:     # High-profile extension
+            bw.write_bit(1)
+            bw.write_bit(0)             # no scaling matrices
+            bw.se(self.chroma_qp_offset)
         bw.rbsp_trailing()
         return b"\x68" + rbsp_to_nal(bw.to_bytes())
 
@@ -190,16 +195,16 @@ class Pps:
             # P slice header would carry redundant_pic_cnt — reject so
             # the rung passes such streams through instead of misparsing
             raise ValueError("redundant_pic_cnt unsupported")
+        t8 = False
         if br.more_rbsp_data():         # High-profile PPS extension
-            if br.read_bit():
-                raise ValueError("8x8 transform unsupported")
+            t8 = bool(br.read_bit())    # transform_8x8_mode_flag
             if br.read_bit():
                 raise ValueError("scaling matrices unsupported")
             if br.se() != chroma_off:   # second_chroma_qp_index_offset:
                 # the requant maps both components through ONE offset
                 raise ValueError("split Cb/Cr qp offsets unsupported")
         return cls(pps_id, sps_id, qp, deblock, bottom_poc, chroma_off,
-                   cabac, nref0, nref1, wpred)
+                   cabac, nref0, nref1, wpred, t8)
 
 
 @dataclass
@@ -243,9 +248,12 @@ def _zero_chroma() -> tuple[np.ndarray, np.ndarray]:
 
 @dataclass
 class MacroblockI4x4:
-    """Parsed I_4x4 macroblock: everything needed to re-encode."""
+    """Parsed I_NxN macroblock: everything needed to re-encode.  With
+    ``transform_8x8`` (High profile), ``pred_modes`` holds FOUR intra8x8
+    mode pairs and the residual lives in ``levels8`` ([4, 64] 8x8-zigzag
+    levels) instead of ``levels``."""
 
-    pred_modes: list[tuple[int, int]]   # (use_predicted, rem_mode) × 16
+    pred_modes: list[tuple[int, int]]   # (use_predicted, rem_mode) × 16/4
     chroma_mode: int
     cbp: int                            # FULL 6-bit CBP (luma | chroma<<4)
     qp: int                             # ABSOLUTE QPY of this MB (spec
@@ -254,6 +262,8 @@ class MacroblockI4x4:
                                         # deltas) · [16, 16] zigzag levels
     chroma_dc: np.ndarray = field(default_factory=lambda: _zero_chroma()[0])
     chroma_ac: np.ndarray = field(default_factory=lambda: _zero_chroma()[1])
+    transform_8x8: bool = False
+    levels8: "np.ndarray | None" = None
 
     @property
     def chroma_cbp(self) -> int:
@@ -310,10 +320,17 @@ class MacroblockInter:
     levels: np.ndarray                  # [16, 16] zigzag luma levels
     chroma_dc: np.ndarray = field(default_factory=lambda: _zero_chroma()[0])
     chroma_ac: np.ndarray = field(default_factory=lambda: _zero_chroma()[1])
+    transform_8x8: bool = False
+    levels8: "np.ndarray | None" = None
 
     @property
     def chroma_cbp(self) -> int:
         return self.cbp >> 4
+
+    @property
+    def allows_8x8(self) -> bool:
+        """7.3.5's noSubMbPartSizeLessThan8x8Flag for P types."""
+        return self.mb_type <= 2 or all(t == 0 for t in self.sub_types)
 
 
 class SliceCodec:
@@ -504,14 +521,22 @@ class SliceCodec:
         else:
             raise ValueError(f"P mb_type {mb_type} unsupported")
         cbp = CBP_INTER_FROM_CODE[br.ue()]
+        mb = MacroblockInter(mb_type, sub_types, refs, mvds, cbp, cur_qp,
+                             np.zeros((16, 16), dtype=np.int64))
+        if (cbp & 15) and self.pps.transform_8x8_mode and mb.allows_8x8:
+            mb.transform_8x8 = bool(br.read_bit())
         if cbp:
             cur_qp += br.se()           # mb_qp_delta accumulates (7.4.5)
             if not 0 <= cur_qp <= 51:
                 raise ValueError("QPY out of range")
-        mb = MacroblockInter(mb_type, sub_types, refs, mvds, cbp, cur_qp,
-                             np.zeros((16, 16), dtype=np.int64))
-        self._residuals(br, mb_idx, cbp & 15, mb.levels, totals,
-                        decode=True)
+            mb.qp = cur_qp
+        if mb.transform_8x8:
+            mb.levels8 = np.zeros((4, 64), dtype=np.int64)
+            self._residuals8(br, mb_idx, cbp & 15, mb.levels8, totals,
+                             decode=True)
+        else:
+            self._residuals(br, mb_idx, cbp & 15, mb.levels, totals,
+                            decode=True)
         self._residuals_chroma(br, mb_idx, cbp >> 4, mb.chroma_dc,
                                mb.chroma_ac, tot_c, decode=True)
         return mb, cur_qp
@@ -533,13 +558,20 @@ class SliceCodec:
             bw.se(mx)
             bw.se(my)
         bw.ue(CBP_INTER_TO_CODE[mb.cbp])
+        if (mb.cbp & 15) and self.pps.transform_8x8_mode \
+                and mb.allows_8x8:
+            bw.write_bit(1 if mb.transform_8x8 else 0)
         if mb.cbp:
             delta = mb.qp - prev_qp
             if not -26 <= delta <= 25:
                 raise ValueError("mb_qp_delta out of range")
             bw.se(delta)
-        self._residuals(bw, mb_idx, mb.cbp & 15, mb.levels, totals,
-                        decode=False)
+        if mb.transform_8x8 and mb.levels8 is not None:
+            self._residuals8(bw, mb_idx, mb.cbp & 15, mb.levels8,
+                             totals, decode=False)
+        else:
+            self._residuals(bw, mb_idx, mb.cbp & 15, mb.levels, totals,
+                            decode=False)
         self._residuals_chroma(bw, mb_idx, mb.cbp >> 4, mb.chroma_dc,
                                mb.chroma_ac, tot_c, decode=False)
 
@@ -583,8 +615,9 @@ class SliceCodec:
             if is_p:
                 mb_type -= 5            # intra types ride offset by 5
             if mb_type == 0:
+                t8 = bool(self.pps.transform_8x8_mode and br.read_bit())
                 modes = []
-                for _ in range(16):
+                for _ in range(4 if t8 else 16):
                     flag = br.read_bit()
                     rem = 0 if flag else br.read_bits(3)
                     modes.append((flag, rem))
@@ -595,10 +628,15 @@ class SliceCodec:
                     if not 0 <= cur_qp <= 51:
                         raise ValueError("QPY out of range")
                 levels = np.zeros((16, 16), dtype=np.int64)
-                self._residuals(br, mb_idx, cbp, levels, totals,
-                                decode=True)
                 mb = MacroblockI4x4(modes, chroma_mode, cbp, cur_qp,
-                                    levels)
+                                    levels, transform_8x8=t8)
+                if t8:
+                    mb.levels8 = np.zeros((4, 64), dtype=np.int64)
+                    self._residuals8(br, mb_idx, cbp, mb.levels8,
+                                     totals, decode=True)
+                else:
+                    self._residuals(br, mb_idx, cbp, levels, totals,
+                                    decode=True)
                 self._residuals_chroma(br, mb_idx, cbp >> 4,
                                        mb.chroma_dc, mb.chroma_ac,
                                        tot_c, decode=True)
@@ -663,7 +701,9 @@ class SliceCodec:
                                        mb.chroma_dc, mb.chroma_ac,
                                        tot_c, decode=False)
                 continue
-            bw.ue(5 if is_p else 0)      # mb_type I_4x4
+            bw.ue(5 if is_p else 0)      # mb_type I_NxN
+            if self.pps.transform_8x8_mode:
+                bw.write_bit(1 if mb.transform_8x8 else 0)
             for flag, rem in mb.pred_modes:
                 bw.write_bit(flag)
                 if not flag:
@@ -678,8 +718,12 @@ class SliceCodec:
                 prev_qp = mb.qp
             # cbp == 0: no qp_delta syntax — the MB has no residual so its
             # QP is irrelevant; prev_qp carries to the next coded MB
-            self._residuals(bw, mb_idx, mb.cbp, mb.levels, totals,
-                            decode=False)
+            if mb.transform_8x8 and mb.levels8 is not None:
+                self._residuals8(bw, mb_idx, mb.cbp & 15, mb.levels8,
+                                 totals, decode=False)
+            else:
+                self._residuals(bw, mb_idx, mb.cbp, mb.levels, totals,
+                                decode=False)
             self._residuals_chroma(bw, mb_idx, mb.cbp >> 4,
                                    mb.chroma_dc, mb.chroma_ac,
                                    tot_c, decode=False)
@@ -758,6 +802,34 @@ class SliceCodec:
                 totals[gy, gx] = sum(1 for v in lv if v)
             else:
                 lv = [int(v) for v in levels[blk]]
+                cavlc.encode_residual(bio, lv, nC)
+                totals[gy, gx] = sum(1 for v in lv if v)
+
+    def _residuals8(self, bio, mb_idx: int, cbp: int,
+                    levels8: np.ndarray, totals: np.ndarray, *,
+                    decode: bool) -> None:
+        """8x8-transform luma residuals, CAVLC style (7.3.5.3.2): each
+        coded 8x8 block rides as FOUR interleaved 4x4 blocks — sub j
+        carries 8x8-zigzag positions j, j+4, ... — with the ordinary
+        per-4x4 nC context grid."""
+        mb_x = (mb_idx % self.sps.width_mbs) * 4
+        mb_y = (mb_idx // self.sps.width_mbs) * 4
+        for blk in range(16):
+            i8, j = blk >> 2, blk & 3
+            x4, y4 = BLK_XY[blk]
+            gx, gy = mb_x + x4, mb_y + y4
+            if not (cbp >> i8) & 1:
+                totals[gy, gx] = 0
+                if decode:
+                    levels8[i8, j::4] = 0
+                continue
+            nC = self._nc_at(totals, gx, gy)
+            if decode:
+                lv = cavlc.decode_residual(bio, nC)
+                levels8[i8, j::4] = lv
+                totals[gy, gx] = sum(1 for v in lv if v)
+            else:
+                lv = [int(v) for v in levels8[i8, j::4]]
                 cavlc.encode_residual(bio, lv, nC)
                 totals[gy, gx] = sum(1 for v in lv if v)
 

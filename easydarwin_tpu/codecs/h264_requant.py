@@ -20,8 +20,11 @@ exact-shift / integer-round-trip dispatch — see
 residuals only: motion syntax (mb_type, sub-types, ref_idx, mvd) and
 the skip map ride through verbatim, so prediction is untouched and
 drift stays open-loop (resets at each IDR).  I_16x16 needs QPY ≥ 12
-(the exact-shift DC dequant window).  Streams outside the profile
-(B slices, weighted prediction, 8x8 transform, scaling matrices,
+(the exact-shift DC dequant window).  High-profile 8x8-transform
+streams requant too — CAVLC fully (byte-exact vs x264); CABAC with a
+conservative gate that passes through any 8x8 slice whose parse stops
+before the picture end (an open sparse-content margin case).  Streams
+outside the profile (B slices, weighted prediction, scaling matrices,
 low-QP I_16x16) PASS THROUGH unchanged and are counted — the rung
 never corrupts what it cannot parse."""
 
@@ -207,6 +210,8 @@ class SliceRequantizer:
     def _requant_native(self, nal: bytes, s: Sps, p: Pps
                         ) -> "tuple[bytes, int, int] | None":
         from .. import native
+        if p.transform_8x8_mode:
+            return None                # High 8x8: Python oracle path
         if not native.available():
             return None
         return native.h264_requant_slice(
@@ -244,6 +249,17 @@ class SliceRequantizer:
                default=qp_in_base) + self.delta_qp > 51:
             raise ValueError("qp already at ladder ceiling")
 
+        if pps.entropy_cabac and pps.transform_8x8_mode \
+                and hdr.first_mb + len(mbs) < sps.width_mbs \
+                * sps.height_mbs:
+            # CABAC + 8x8: a slice whose parse ends before the picture
+            # does is either a genuine multi-slice picture or a sparse-
+            # content context desync this engine still has on cat-5
+            # streams (dense intra is byte-exact vs x264; the sparse
+            # margin case is under investigation) — both must PASS
+            # THROUGH rather than emit a truncated-but-plausible slice
+            raise ValueError("CABAC 8x8 slice ended before picture end")
+
         if self.closed_loop and not hdr.is_p:
             n_blocks = self._closed_loop_slice(sps, pps, hdr, mbs)
         else:
@@ -256,7 +272,13 @@ class SliceRequantizer:
             if isinstance(mb, MacroblockI16x16):
                 mb.luma_cbp15 = bool(np.any(mb.ac_levels))
                 mb.chroma_cbp = ccbp
-            else:                      # I_4x4 and inter share the CBP
+            elif getattr(mb, "transform_8x8", False):
+                cbp = 0
+                for g in range(4):
+                    if np.any(mb.levels8[g]):
+                        cbp |= 1 << g
+                mb.cbp = cbp | (ccbp << 4)
+            else:                      # I_NxN and inter share the CBP
                 cbp = 0                # recompute shape
                 for g in range(4):
                     if np.any(mb.levels[4 * g:4 * g + 4]):
@@ -301,6 +323,13 @@ class SliceRequantizer:
         for i, mb in enumerate(mbs):
             if isinstance(mb, MacroblockPSkip):
                 continue               # no residual, nothing to shift
+            if getattr(mb, "transform_8x8", False):
+                # 8x8 levels shift by the same exact +6k step (the 8x8
+                # tables share the qp%6 periodicity); batch as 16 rows
+                all_levels.append(mb.levels8.reshape(16, 16))
+                row_map.extend((i, "l8", b) for b in range(16))
+                qps.extend([mb.qp] * 16)
+                continue
             if isinstance(mb, MacroblockI16x16):
                 all_levels.append(mb.dc_levels[None, :])
                 row_map.append((i, "dc", 0))
@@ -333,6 +362,9 @@ class SliceRequantizer:
                 mb.dc_levels = requanted[r]
             elif kind == "ac":
                 mb.ac_levels[b] = requanted[r, :15]
+            elif kind == "l8":
+                mb.levels8[b >> 2, (b & 3) * 16:(b & 3) * 16 + 16] = \
+                    requanted[r]
             else:
                 mb.levels[b] = requanted[r]
 

@@ -65,6 +65,17 @@ def main():
     for t in range(3):
         assert ctx_init_pb[t][60:64] == ctx_init_i[60:64]
 
+    # 8x8 (ctxBlockCat 5) significance scan-position → ctxIdxInc maps
+    # (Table 9-43, frame coding).  The sig map is read from lavc's
+    # compiled significant_coeff_flag_offset_8x8 (frame half); the last
+    # map is the spec's run-grouped table, structure-asserted here.
+    base8 = off["significant_coeff_flag_offset_8x8.4"]
+    sig8x8 = list(h264[base8:base8 + 63])
+    assert sig8x8[:6] == [0, 1, 2, 3, 4, 5] and max(sig8x8) == 14
+    last8x8 = ([0] + [1] * 31 + [2] * 8 + [3] * 8 + [4] * 8 + [5] * 4
+               + [6] * 3)
+    assert len(last8x8) == 63
+
     lps = eng[512:1024]                     # [qIdx*128 + 2*pState (+mps)]
     range_lps = [[lps[q * 128 + 2 * p] for q in range(4)]
                  for p in range(64)]
@@ -112,6 +123,8 @@ per ctxIdx, 1024 contexts; RANGE_LPS is 4 ints per pStateIdx
         f.write(fmt("CTX_INIT_I", ctx_init_i) + "\n\n")
         for t in range(3):
             f.write(fmt(f"CTX_INIT_P{t}", ctx_init_pb[t]) + "\n\n")
+        f.write(fmt("SIG_MAP_8X8", sig8x8) + "\n\n")
+        f.write(fmt("LAST_MAP_8X8", last8x8) + "\n\n")
         f.write(fmt("RANGE_LPS", range_lps) + "\n\n")
         f.write(fmt("TRANS_IDX_MPS", trans_mps) + "\n\n")
         f.write(fmt("TRANS_IDX_LPS", trans_lps) + "\n")
