@@ -48,36 +48,82 @@ def _roles_of(module) -> list[str]:
                          if klass is not Module and klass is not object))
 
 
+def _session_node(app, sess):
+    """A session's tree node reads through its (cached) AttrStore and
+    per-stream stores — the qtssClientSession/RTPStream dictionaries."""
+    from . import dictionary as dct
+    store = getattr(sess, "attr_store", None)
+    if store is None:
+        store = sess.attr_store = dct.session_store(app, sess)
+    streams = {}
+    for tid in sess.streams:
+        skey = f"track{tid}"
+        cache = getattr(sess, "_stream_stores", None)
+        if cache is None:
+            cache = sess._stream_stores = {}
+        if tid not in cache:
+            cache[tid] = dct.stream_store(sess, tid)
+        streams[skey] = cache[tid]
+    return {"attrs": store, "streams": streams}
+
+
 def build_tree(app) -> dict[str, Any]:
     """Assemble the browseable dictionary tree from live server state.
 
     Mirrors the reference's top-level element list (AdminElementNode
     ``GetElementFromArray``): server attributes, prefs, connected
-    sessions, loaded modules."""
+    sessions, loaded modules.  Nodes are either plain dict containers
+    or ``AttrStore`` objects — the reflective dictionaries every query
+    and set resolves through (QTSSDictionaryMap)."""
+    from . import dictionary as dct
+    sstore = getattr(app, "attr_store", None)
+    if sstore is None:
+        sstore = app.attr_store = dct.server_store(app)
+    cstore = getattr(app.config, "attr_store", None)
+    if cstore is None:
+        cstore = app.config.attr_store = dct.config_store(app.config)
     sessions = {}
-    for s in app.live_sessions():
-        sessions[s["Path"].strip("/").replace("/", "~")] = dict(s)
-    cfg = {k: v for k, v in app.config.to_dict().items()
-           if k != "rest_password"}
+    for s in app.registry.sessions.values():
+        sessions[s.path.strip("/").replace("/", "~")] = \
+            _session_node(app, s)
+    modules = {}
+    for m in getattr(app.modules, "modules", []):
+        node: dict[str, Any] = {"roles": _roles_of(m),
+                                **_module_attrs(m)}
+        mstore = getattr(m, "attr_store", None)
+        if mstore is not None and mstore.describe():
+            node["instance_attrs"] = mstore
+        modules[m.name] = node
     return {
         "server": {
-            "info": dict(app.server_info()),
-            "prefs": cfg,
+            "info": sstore,
+            "prefs": cstore,
             "sessions": sessions,
-            "modules": {m.name: {"roles": _roles_of(m),
-                                 **_module_attrs(m)}
-                        for m in getattr(app.modules, "modules", [])},
+            "modules": modules,
         },
     }
+
+
+def _materialize(node: Any) -> Any:
+    from .dictionary import AttrStore
+    if isinstance(node, AttrStore):
+        return node.as_dict()
+    if isinstance(node, dict):
+        return {k: _materialize(v) for k, v in node.items()}
+    return node
 
 
 def query(app, path: str, *, recurse: bool = False) -> tuple[int, Any]:
     """``command=get`` — resolve a tree path.
 
-    Returns (status, payload).  A trailing ``*`` lists children one level
-    deep (or the whole subtree with ``recurse``); a concrete path returns
-    the node value.  Unknown paths → 404, like the reference's
-    404-in-body answers (QTSSAdminModule.cpp ReportErr)."""
+    Returns (status, payload).  A trailing ``*`` lists children one
+    level deep (or the whole subtree with ``recurse``); a concrete path
+    returns the node value.  Inside an ``AttrStore`` node, a segment is
+    an attribute name or ``@<id>`` (get-by-id), and the reserved
+    segment ``parameters`` returns the attribute metadata (id, type,
+    access) like the reference's ?parameters view.  Unknown paths →
+    404 (QTSSAdminModule.cpp ReportErr)."""
+    from .dictionary import AttrStore
     tree: Any = build_tree(app)
     parts = [p for p in path.strip("/").split("/") if p]
     wildcard = bool(parts) and parts[-1] == "*"
@@ -85,43 +131,56 @@ def query(app, path: str, *, recurse: bool = False) -> tuple[int, Any]:
         parts = parts[:-1]
     node = tree
     for part in parts:
+        if isinstance(node, AttrStore):
+            if part == "parameters":
+                node = node.describe()
+                continue
+            try:
+                node = node.get(part)
+            except KeyError:
+                return 404, {"error": f"no such path: {path}"}
+            continue
         if not isinstance(node, dict) or part not in node:
             return 404, {"error": f"no such path: {path}"}
         node = node[part]
+    if isinstance(node, AttrStore) and not wildcard:
+        return 200, node.as_dict()
     if wildcard:
+        if isinstance(node, AttrStore):
+            return 200, node.as_dict()
         if not isinstance(node, dict):
             return 400, {"error": "wildcard on a leaf"}
         if recurse:
-            return 200, node
-        return 200, {k: (v if not isinstance(v, dict) else "*container*")
+            return 200, _materialize(node)
+        return 200, {k: (v if not isinstance(v, (dict, AttrStore))
+                         else "*container*")
                      for k, v in node.items()}
-    return 200, node
+    return 200, _materialize(node)
 
 
 def set_pref(app, path: str, value: str) -> tuple[int, Any]:
-    """``command=set`` — write one pref (server/prefs/<name> only; the
-    reference likewise only honors sets on preference attributes)."""
+    """``command=set`` — write one pref through the prefs AttrStore
+    (``server/prefs/<name>`` or ``server/prefs/@<id>``; the reference
+    likewise only honors sets on preference attributes, and read-only
+    attributes refuse with the QTSS_ReadOnly analogue)."""
+    from . import dictionary as dct
     parts = [p for p in path.strip("/").split("/") if p]
     if len(parts) != 3 or parts[:2] != ["server", "prefs"]:
         return 400, {"error": "set supports server/prefs/<name> only"}
-    name = parts[2]
-    current = app.config.to_dict()
-    if name not in current:
-        return 404, {"error": f"no such pref: {name}"}
-    old = current[name]
-    # coerce through the current value's type, as GenerateXMLPrefs did
+    cstore = getattr(app.config, "attr_store", None)
+    if cstore is None:
+        cstore = app.config.attr_store = dct.config_store(app.config)
     try:
-        if isinstance(old, bool):
-            new: Any = value.lower() in ("1", "true", "yes", "on")
-        elif isinstance(old, int):
-            new = int(value)
-        elif isinstance(old, float):
-            new = float(value)
-        else:
-            new = value
-        app.config.update(**{name: new})
+        spec = cstore.spec(parts[2])
+    except KeyError:
+        return 404, {"error": f"no such pref: {parts[2]}"}
+    old = cstore.get(spec.attr_id)
+    try:
+        new = cstore.set(spec.attr_id, value)
+    except PermissionError as e:
+        return 400, {"error": str(e)}
     except (TypeError, ValueError) as e:
         return 400, {"error": str(e)}
-    if name == "rest_password":        # match the read-side redaction
-        return 200, {name: "(redacted)"}
-    return 200, {name: new, "was": old}
+    if spec.name == "rest_password":   # match the read-side redaction
+        return 200, {spec.name: "(redacted)"}
+    return 200, {spec.name: new, "was": old}

@@ -36,11 +36,23 @@ class Module:
 
     def attributes(self) -> dict:
         """Module-added attributes for the admin dictionary tree — the
-        extensible half of the QTSS dictionary system
+        static half of the QTSS dictionary system
         (``QTSS_AddStaticAttribute``; modules exposed live counters and
         state through it, browseable under ``modules/<name>``).  Return
         a flat or nested dict of JSON-able values."""
         return {}
+
+    def add_instance_attr(self, name: str, getter, *, type: str = "str",
+                          writable: bool = False, setter=None) -> int:
+        """The ``QTSS_AddInstanceAttribute`` analogue: attach a typed
+        attribute to THIS module instance at runtime.  It appears in
+        the admin tree under ``modules/<name>/instance_attrs`` on the
+        next query, with get/set-by-id via the reflective store."""
+        from .dictionary import AttrStore
+        if not hasattr(self, "attr_store"):
+            self.attr_store = AttrStore(f"module:{self.name}")
+        return self.attr_store.add_instance_attr(
+            name, getter, type=type, writable=writable, setter=setter)
 
     def initialize(self, server) -> None:
         pass

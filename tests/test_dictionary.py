@@ -1,0 +1,130 @@
+"""Reflective typed attribute store (SURVEY row 16 — the QTSS
+dictionary system, ``QTSSDictionary.cpp:59`` / ``QTSSDictionaryMap``).
+
+Every server object exposes typed attributes (id + name + type +
+access) through an ``AttrStore`` whose getters read LIVE state; the
+admin tree and set path resolve through the stores, including
+get/set-by-id (``@<n>``) and the runtime instance-attribute hook
+(``QTSS_AddInstanceAttribute`` analogue)."""
+
+import asyncio
+
+import pytest
+
+from easydarwin_tpu.server import admin
+from easydarwin_tpu.server.app import StreamingServer
+from easydarwin_tpu.server.config import ServerConfig
+from easydarwin_tpu.server.dictionary import (AttrStore, config_store,
+                                              server_store)
+from easydarwin_tpu.server.modules import Module
+
+
+def test_store_typed_specs_and_id_access():
+    box = {"n": 7}
+    st = AttrStore("test")
+    i0 = st.add_attr("name", lambda: "x")
+    i1 = st.add_attr("count", lambda: box["n"], type="int",
+                     writable=True,
+                     setter=lambda v: box.__setitem__("n", v))
+    assert (i0, i1) == (0, 1)
+    assert st.get("count") == 7
+    assert st.get(1) == 7
+    assert st.get("@1") == 7            # the admin path form
+    st.set("@1", "12")                  # string input coerces by type
+    assert box["n"] == 12 and st.get("count") == 12
+    meta = {d["name"]: d for d in st.describe()}
+    assert meta["count"]["type"] == "int"
+    assert meta["count"]["access"] == "rw"
+    assert meta["name"]["access"] == "r"
+
+
+def test_read_only_refuses_set():
+    st = AttrStore("test")
+    st.add_attr("fixed", lambda: 1, type="int")
+    with pytest.raises(PermissionError):
+        st.set("fixed", 2)
+    with pytest.raises(KeyError):
+        st.get("@9")
+
+
+def test_server_and_prefs_read_live_through_stores():
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0))
+    st = server_store(app)
+    assert st.get("ServerName") == "easydarwin-tpu"
+    cs = config_store(app.config)
+    assert cs.get("bucket_delay_ms") == app.config.bucket_delay_ms
+    # live: a config change is visible without rebuilding the store
+    app.config.update(bucket_delay_ms=77)
+    assert cs.get("bucket_delay_ms") == 77
+    # set-by-id runs the validated update path
+    pid = cs.spec("bucket_delay_ms").attr_id
+    cs.set(pid, "91")
+    assert app.config.bucket_delay_ms == 91
+    assert cs.get("rest_password") == "(redacted)"
+
+
+def test_admin_tree_set_by_id_and_parameters_view():
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0))
+    status, params = admin.query(app, "server/prefs/parameters")
+    assert status == 200
+    byname = {d["name"]: d for d in params}
+    pid = byname["bucket_delay_ms"]["id"]
+    status, res = admin.set_pref(app, f"server/prefs/@{pid}", "63")
+    assert status == 200 and app.config.bucket_delay_ms == 63
+    status, val = admin.query(app, f"server/prefs/@{pid}")
+    assert status == 200 and val == 63
+
+
+async def test_live_session_and_stream_attrs_via_store():
+    """A pushed session appears in the admin tree THROUGH its
+    AttrStore, with per-stream stores exposing live counters."""
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0))
+    sdp_text = ("v=0\r\no=- 1 1 IN IP4 0.0.0.0\r\ns=t\r\nt=0 0\r\n"
+                "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+                "a=control:trackID=1\r\n")
+    sess = app.registry.find_or_create("/cam", sdp_text)
+    pkt = bytes([0x80, 96, 0, 1, 0, 0, 0, 0, 0, 0, 0, 1,
+                 (3 << 5) | 5]) + bytes(24)
+    sess.push(1, pkt)
+    status, node = admin.query(app, "server/sessions/cam/attrs/*")
+    assert status == 200 and node["Path"] == "/cam"
+    status, trk = admin.query(app,
+                              "server/sessions/cam/streams/track1/*")
+    assert status == 200 and trk["packets_in"] == 1
+    # get-by-id inside a stream store
+    status, params = admin.query(
+        app, "server/sessions/cam/streams/track1/parameters")
+    pid = {d["name"]: d for d in params}["packets_in"]["id"]
+    status, v = admin.query(
+        app, f"server/sessions/cam/streams/track1/@{pid}")
+    assert status == 200 and v == 1
+    # live: another packet shows on the next query without rebuilds
+    sess.push(1, pkt)
+    status, v = admin.query(
+        app, f"server/sessions/cam/streams/track1/@{pid}")
+    assert v == 2
+
+
+def test_module_runtime_instance_attributes():
+    """QTSS_AddInstanceAttribute analogue: a module attaches a typed
+    attribute at runtime; the admin tree serves it on the next query."""
+    app = StreamingServer(ServerConfig(rtsp_port=0, service_port=0))
+
+    class Counter(Module):
+        name = "counter"
+
+        def __init__(self):
+            self.hits = 0
+
+    mod = Counter()
+    app.modules.modules.append(mod)
+    status, node = admin.query(app, "server/modules/counter/*")
+    assert status == 200 and "instance_attrs" not in node
+    mod.add_instance_attr("hits", lambda: mod.hits, type="int")
+    mod.hits = 5
+    status, val = admin.query(
+        app, "server/modules/counter/instance_attrs/hits")
+    assert status == 200 and val == 5
+    status, val = admin.query(
+        app, "server/modules/counter/instance_attrs/@0")
+    assert status == 200 and val == 5

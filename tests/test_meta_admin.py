@@ -114,7 +114,9 @@ def test_admin_tree_browse():
     assert status == 200 and set(listing) >= {"info", "prefs", "sessions"}
     status, prefs = admin.query(app, "server/prefs/*", recurse=True)
     assert status == 200 and "rtsp_port" in prefs
-    assert "rest_password" not in prefs
+    # present as an attribute (the reflective store registers every
+    # pref) but the VALUE never leaves the server
+    assert prefs.get("rest_password") == "(redacted)"
     status, port = admin.query(app, "server/prefs/rtsp_port")
     assert status == 200 and port == 0
     status, _ = admin.query(app, "server/nope")
