@@ -3,6 +3,7 @@
 
 #include <algorithm>
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
 #include <cstring>
 #include <map>
@@ -45,6 +46,33 @@ namespace {
 // hard per-datagram error (caller skips past it, oracle ERROR semantics).
 // Partial counts alone cannot distinguish the two cases.
 thread_local int g_stop_errno = 0;
+
+// Cumulative data-plane counters (see ed_stats in the header).  Relaxed
+// atomics: each increment sits next to a syscall, so the cost is noise,
+// and cross-thread snapshot skew of a few counts is acceptable for
+// metrics.
+struct StatCells {
+  std::atomic<int64_t> sendmmsg_calls{0}, sendto_calls{0}, send_packets{0},
+      gso_supers{0}, gso_segments{0}, eagain_stops{0}, hard_errors{0},
+      bytes_to_wire{0}, recvmmsg_calls{0}, recv_datagrams{0}, recv_bytes{0},
+      oversize_dropped{0};
+};
+StatCells g_stat;
+
+inline void stat_add(std::atomic<int64_t> &c, int64_t v) {
+  c.fetch_add(v, std::memory_order_relaxed);
+}
+
+// A stopped send still ISSUED its syscall: count the call too, so the
+// calls counter is a true denominator for the EAGAIN/error ratios
+// (under pure backpressure, eagain_stops/sendmmsg_calls must read 1.0,
+// not divide by zero).
+inline void note_send_stop(int err) {
+  if (err == EAGAIN || err == EWOULDBLOCK)
+    stat_add(g_stat.eagain_stops, 1);
+  else
+    stat_add(g_stat.hard_errors, 1);
+}
 }  // namespace
 
 extern "C" {
@@ -52,6 +80,37 @@ extern "C" {
 const char *ed_version(void) { return "edtpu_core 0.1.0"; }
 
 int32_t ed_last_send_errno(void) { return g_stop_errno; }
+
+void ed_get_stats(ed_stats *out) {
+  out->sendmmsg_calls = g_stat.sendmmsg_calls.load(std::memory_order_relaxed);
+  out->sendto_calls = g_stat.sendto_calls.load(std::memory_order_relaxed);
+  out->send_packets = g_stat.send_packets.load(std::memory_order_relaxed);
+  out->gso_supers = g_stat.gso_supers.load(std::memory_order_relaxed);
+  out->gso_segments = g_stat.gso_segments.load(std::memory_order_relaxed);
+  out->eagain_stops = g_stat.eagain_stops.load(std::memory_order_relaxed);
+  out->hard_errors = g_stat.hard_errors.load(std::memory_order_relaxed);
+  out->bytes_to_wire = g_stat.bytes_to_wire.load(std::memory_order_relaxed);
+  out->recvmmsg_calls = g_stat.recvmmsg_calls.load(std::memory_order_relaxed);
+  out->recv_datagrams = g_stat.recv_datagrams.load(std::memory_order_relaxed);
+  out->recv_bytes = g_stat.recv_bytes.load(std::memory_order_relaxed);
+  out->oversize_dropped =
+      g_stat.oversize_dropped.load(std::memory_order_relaxed);
+}
+
+void ed_reset_stats(void) {
+  g_stat.sendmmsg_calls.store(0, std::memory_order_relaxed);
+  g_stat.sendto_calls.store(0, std::memory_order_relaxed);
+  g_stat.send_packets.store(0, std::memory_order_relaxed);
+  g_stat.gso_supers.store(0, std::memory_order_relaxed);
+  g_stat.gso_segments.store(0, std::memory_order_relaxed);
+  g_stat.eagain_stops.store(0, std::memory_order_relaxed);
+  g_stat.hard_errors.store(0, std::memory_order_relaxed);
+  g_stat.bytes_to_wire.store(0, std::memory_order_relaxed);
+  g_stat.recvmmsg_calls.store(0, std::memory_order_relaxed);
+  g_stat.recv_datagrams.store(0, std::memory_order_relaxed);
+  g_stat.recv_bytes.store(0, std::memory_order_relaxed);
+  g_stat.oversize_dropped.store(0, std::memory_order_relaxed);
+}
 
 int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
                            const int32_t *ring_len, int32_t capacity,
@@ -66,6 +125,7 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
   std::vector<sockaddr_in> addrs(kSendBatch);
   // stack of rendered headers for the in-flight batch
   std::vector<uint8_t> hdrs(static_cast<size_t>(kSendBatch) * 12);
+  std::vector<int32_t> blens(kSendBatch);  // per-msg bytes for accounting
 
   int32_t done = 0;
   while (done < n_ops) {
@@ -79,6 +139,7 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
                            static_cast<size_t>(op.slot) * slot_size;
       int32_t len = ring_len[op.slot];
       if (len < 12 || len > slot_size) return -EINVAL;
+      blens[batch] = len;
       uint8_t *h = hdrs.data() + static_cast<size_t>(batch) * 12;
       render_header(h, pkt, seq_off[op.out], ts_off[op.out], ssrc[op.out]);
       iovec *iv = &iovs[static_cast<size_t>(batch) * 2];
@@ -104,6 +165,8 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
       if (n < 0) {
         if (errno == EINTR) continue;
         g_stop_errno = errno;
+        stat_add(g_stat.sendmmsg_calls, 1);
+        note_send_stop(errno);
         if (errno == EAGAIN || errno == EWOULDBLOCK)
           return done + sent;  // WouldBlock: caller keeps its bookmark
         // hard mid-batch error: report what WAS delivered (callers advance
@@ -113,6 +176,11 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
         int32_t got = done + sent;
         return got > 0 ? got : -errno;
       }
+      stat_add(g_stat.sendmmsg_calls, 1);
+      stat_add(g_stat.send_packets, n);
+      int64_t nb = 0;
+      for (int i = sent; i < sent + n; ++i) nb += blens[i];
+      stat_add(g_stat.bytes_to_wire, nb);
       sent += n;
     }
     done += batch;
@@ -152,6 +220,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
     alignas(cmsghdr) char ctl[CMSG_SPACE(sizeof(uint16_t))];
     int n_segs = 0;
     int n_ops = 0;  // ops consumed by this super (== n_segs)
+    int64_t bytes = 0;
   };
   // per-thread scratch: this runs once per source per window
   static thread_local std::vector<mmsghdr> msgs(kSupers);
@@ -180,10 +249,28 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
       if (n < 0) {
         if (errno == EINTR) continue;
         g_stop_errno = errno;
+        stat_add(g_stat.sendmmsg_calls, 1);
+        note_send_stop(errno);
         if (errno != EAGAIN && errno != EWOULDBLOCK) flush_err = errno;
         int32_t ops_sent = 0;
         for (int i = 0; i < sent; ++i) ops_sent += supers[i].n_ops;
         return ops_sent;
+      }
+      stat_add(g_stat.sendmmsg_calls, 1);
+      int64_t pk = 0, nb = 0, sup = 0, seg = 0;
+      for (int i = sent; i < sent + n; ++i) {
+        pk += supers[i].n_ops;
+        nb += supers[i].bytes;
+        if (supers[i].n_segs > 1) {
+          sup += 1;
+          seg += supers[i].n_segs;
+        }
+      }
+      stat_add(g_stat.send_packets, pk);
+      stat_add(g_stat.bytes_to_wire, nb);
+      if (sup) {
+        stat_add(g_stat.gso_supers, sup);
+        stat_add(g_stat.gso_segments, seg);
       }
       sent += n;
     }
@@ -209,6 +296,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
     Super &sp = supers[n_super];
     sp.n_segs = 0;
     sp.n_ops = 0;
+    sp.bytes = 0;
     std::memset(&sp.sa, 0, sizeof(sp.sa));
     sp.sa.sin_family = AF_INET;
     sp.sa.sin_addr.s_addr = dest[first.out].ip_be;
@@ -242,6 +330,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
       staged++;
       if (len < gs_size) break;  // short segment ends the super-datagram
     }
+    sp.bytes = static_cast<int64_t>(bytes);
 
     mmsghdr &m = msgs[n_super];
     std::memset(&m, 0, sizeof(m));
@@ -337,9 +426,16 @@ int32_t ed_scalar_baseline_send(int fd, const uint8_t *ring_data,
     for (;;) {
       ssize_t r = sendto(fd, scratch, static_cast<size_t>(len), 0,
                          reinterpret_cast<sockaddr *>(&sa), sizeof(sa));
-      if (r >= 0) break;
+      if (r >= 0) {
+        stat_add(g_stat.sendto_calls, 1);
+        stat_add(g_stat.send_packets, 1);
+        stat_add(g_stat.bytes_to_wire, len);
+        break;
+      }
       if (errno == EINTR) continue;
       g_stop_errno = errno;
+      stat_add(g_stat.sendto_calls, 1);
+      note_send_stop(errno);
       if (errno == EAGAIN || errno == EWOULDBLOCK) return i;
       return i > 0 ? i : -errno;
     }
@@ -399,7 +495,9 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
       return total > 0 ? total : -errno;
     }
     if (n == 0) break;
+    stat_add(g_stat.recvmmsg_calls, 1);
     int wrote = 0;
+    int64_t admitted_bytes = 0;
     for (int i = 0; i < n; ++i) {
       int64_t src = (*head + i) % capacity;
       // a kernel-truncated datagram (larger than the slot) is DROPPED,
@@ -408,9 +506,11 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
       // drop on the Python ingest path)
       if (msgs[i].msg_hdr.msg_flags & MSG_TRUNC) {
         if (oversize_dropped) ++*oversize_dropped;
+        stat_add(g_stat.oversize_dropped, 1);
         continue;
       }
       int32_t len = static_cast<int32_t>(msgs[i].msg_len);
+      admitted_bytes += len;
       int64_t dst = (*head + wrote) % capacity;
       if (dst != src)                      // compact over dropped slots
         std::memmove(ring_data + dst * slot_size,
@@ -429,6 +529,10 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
     *head += wrote;
     total += wrote;
     processed += n;
+    if (wrote) {
+      stat_add(g_stat.recv_datagrams, wrote);
+      stat_add(g_stat.recv_bytes, admitted_bytes);
+    }
     if (n < want) break;
   }
   return total;

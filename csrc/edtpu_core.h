@@ -27,6 +27,32 @@ const char *ed_version(void);
  * the scalar oracle's WriteResult.ERROR advance).  Thread-local. */
 int32_t ed_last_send_errno(void);
 
+/* ---------------------------------------------------------------- stats */
+
+/* Process-wide cumulative data-plane counters, maintained with relaxed
+ * atomics on every egress/ingest entry point (negligible next to the
+ * syscalls they count).  Python mirrors this snapshot into the obs
+ * metric registry (easydarwin_tpu/obs) at scrape time.  The discard
+ * drains (ed_udp_drain*) are bench receivers, not server ingest, and
+ * are deliberately NOT counted. */
+typedef struct {
+  int64_t sendmmsg_calls;   /* sendmmsg(2) syscalls (plain + GSO paths) */
+  int64_t sendto_calls;     /* sendto(2) syscalls (scalar baseline) */
+  int64_t send_packets;     /* wire datagram-equivalents handed to kernel */
+  int64_t gso_supers;       /* multi-segment UDP_SEGMENT super-datagrams */
+  int64_t gso_segments;     /* wire segments inside those supers */
+  int64_t eagain_stops;     /* sends stopped by EAGAIN/EWOULDBLOCK */
+  int64_t hard_errors;      /* sends stopped by a hard errno */
+  int64_t bytes_to_wire;    /* bytes handed to the kernel by sends */
+  int64_t recvmmsg_calls;   /* recvmmsg(2) syscalls (ring ingest) */
+  int64_t recv_datagrams;   /* datagrams admitted into rings */
+  int64_t recv_bytes;       /* bytes admitted into rings */
+  int64_t oversize_dropped; /* kernel-truncated datagrams dropped */
+} ed_stats;
+
+void ed_get_stats(ed_stats *out);
+void ed_reset_stats(void);
+
 /* ---------------------------------------------------------------- egress */
 
 /* One send op: packet (ring slot) -> subscriber (output index). */

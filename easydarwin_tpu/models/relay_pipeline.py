@@ -12,11 +12,14 @@ two output modes:
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 
+from .. import obs
+from ..obs import TRACER
 from ..ops import fanout as fanout_ops
 from ..ops import gop as gop_ops
 from ..ops.parse import PARSE_PREFIX, parse_packets
@@ -45,7 +48,22 @@ class RelayPipeline:
             codec=self.config.codec))
 
     def __call__(self, prefix, length, age_ms, out_state, buckets):
-        return self._step(prefix, length, age_ms, out_state, buckets)
+        t0 = time.perf_counter_ns()
+        out = self._step(prefix, length, age_ms, out_state, buckets)
+        # dispatch-side accounting (jax dispatch is async: this times the
+        # host cost of one step, not device occupancy — exactly the cost
+        # the pump loop pays per pass)
+        dur = time.perf_counter_ns() - t0
+        obs.TPU_PASS_SECONDS.observe(dur / 1e9, stage="pipeline_dispatch")
+        for a in (prefix, length, age_ms, out_state, buckets):
+            obs.TPU_H2D_BYTES.inc(getattr(a, "nbytes", 0))
+        if self.config.mode == "headers":
+            n_sub = out_state.shape[-2]
+            n_pkt = length.shape[-1]
+            obs.TPU_HEADERS_RENDERED.inc(n_sub * n_pkt)
+        TRACER.add("pipeline.step", t0, dur, cat="tpu",
+                   mode=self.config.mode)
+        return out
 
     @property
     def step_fn(self):

@@ -17,6 +17,8 @@ import threading
 
 import numpy as np
 
+from .obs import TRACER
+
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                      "csrc")
 # EDTPU_CORE_SO overrides the library path (sanitizer builds: make
@@ -31,6 +33,17 @@ _tried = False
 
 class SendOp(ctypes.Structure):
     _fields_ = [("slot", ctypes.c_int32), ("out", ctypes.c_int32)]
+
+
+#: field order MUST match struct ed_stats in csrc/edtpu_core.h
+_STAT_FIELDS = ("sendmmsg_calls", "sendto_calls", "send_packets",
+                "gso_supers", "gso_segments", "eagain_stops",
+                "hard_errors", "bytes_to_wire", "recvmmsg_calls",
+                "recv_datagrams", "recv_bytes", "oversize_dropped")
+
+
+class EdStats(ctypes.Structure):
+    _fields_ = [(n, ctypes.c_int64) for n in _STAT_FIELDS]
 
 
 class Dest(ctypes.Structure):
@@ -59,7 +72,7 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        if not hasattr(lib, "ed_h264_requant_slice_cabac"):  # newest symbol
+        if not hasattr(lib, "ed_get_stats"):  # newest symbol
             # stale prebuilt .so from an older source tree: rebuild in place
             # (make relinks to a fresh inode, so a second dlopen maps the
             # new library; the old one is never deleted, in case no
@@ -70,7 +83,7 @@ def _load():
                 lib = ctypes.CDLL(_SO)
             except OSError:
                 return None
-            if not hasattr(lib, "ed_h264_requant_slice_cabac"):
+            if not hasattr(lib, "ed_get_stats"):
                 return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -116,6 +129,10 @@ def _load():
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32)]
+        lib.ed_get_stats.restype = None
+        lib.ed_get_stats.argtypes = [ctypes.POINTER(EdStats)]
+        lib.ed_reset_stats.restype = None
+        lib.ed_reset_stats.argtypes = []
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
@@ -144,9 +161,30 @@ def available() -> bool:
     return _load() is not None
 
 
+def loaded() -> bool:
+    """True if the library is ALREADY loaded — never triggers a build
+    (metric scrapes must not spend 100 ms compiling C++)."""
+    return _lib is not None
+
+
 def version() -> str | None:
     lib = _load()
     return lib.ed_version().decode() if lib else None
+
+
+def get_stats() -> dict[str, int]:
+    """Cumulative native data-plane counters (struct ed_stats)."""
+    lib = _load()
+    assert lib is not None
+    s = EdStats()
+    lib.ed_get_stats(ctypes.byref(s))
+    return {n: getattr(s, n) for n in _STAT_FIELDS}
+
+
+def reset_stats() -> None:
+    lib = _load()
+    assert lib is not None
+    lib.ed_reset_stats()
 
 
 def _u8(a: np.ndarray):
@@ -244,11 +282,15 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
     # the param row may be wider than the dest table (fewer real sockets
     # than logical subscribers); ops only reference outs < len(dests)
     assert seq.shape[1] >= len(dests)
-    return lib.ed_fanout_send_multi(
+    t0 = TRACER.begin()
+    r = lib.ed_fanout_send_multi(
         fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
         ring_data.shape[0], ring_data.shape[1],
         _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
         dests, len(dests), ops, n_ops, int(use_gso))
+    TRACER.end("native.egress", t0, cat="native", ops=n_ops, sent=int(r),
+               gso=bool(use_gso))
+    return r
 
 
 def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
@@ -418,3 +460,35 @@ class TimerWheel:
     @property
     def pending(self) -> int:
         return self._lib.ed_wheel_pending(self._w)
+
+
+# ------------------------------------------------------------- observability
+def _collect_native_stats() -> None:
+    """Pre-scrape collector: mirror the C data-plane's cumulative
+    ``ed_stats`` snapshot into the obs counter families.  A no-op until
+    the library is loaded — a metrics scrape must never trigger a
+    compile; the families simply read 0 like any idle counter."""
+    if _lib is None:
+        return
+    from . import obs
+    s = get_stats()
+    obs.EGRESS_SENDMMSG_CALLS.set_to(s["sendmmsg_calls"])
+    obs.EGRESS_SENDTO_CALLS.set_to(s["sendto_calls"])
+    obs.EGRESS_PACKETS.set_to(s["send_packets"])
+    obs.EGRESS_BYTES.set_to(s["bytes_to_wire"])
+    obs.EGRESS_GSO_SUPERS.set_to(s["gso_supers"])
+    obs.EGRESS_GSO_SEGMENTS.set_to(s["gso_segments"])
+    obs.EGRESS_EAGAIN.set_to(s["eagain_stops"])
+    obs.EGRESS_SEND_ERRORS.set_to(s["hard_errors"])
+    obs.INGEST_RECVMMSG_CALLS.set_to(s["recvmmsg_calls"])
+    obs.INGEST_DATAGRAMS.set_to(s["recv_datagrams"])
+    obs.INGEST_BYTES.set_to(s["recv_bytes"])
+    obs.INGEST_OVERSIZE_DROPPED.set_to(s["oversize_dropped"])
+
+
+def _register_collector() -> None:
+    from .obs import REGISTRY
+    REGISTRY.add_collector(_collect_native_stats)
+
+
+_register_collector()

@@ -1,0 +1,123 @@
+"""The metric inventory: every family this server exports, in one place.
+
+Central registration (instead of per-module scatter) guarantees the
+``/metrics`` exposition, the admin ``server/metrics`` tree and
+``tools/metrics_lint.py`` all see the complete, stable family set no
+matter which subsystems have been exercised yet — a scrape taken one
+second after boot already carries every family's HELP/TYPE header
+(unlabeled families at value 0; labeled children appear on first
+observation).
+
+Naming convention: snake_case; counters end ``_total``; histograms and
+unit-carrying gauges end in their unit (``_seconds``, ``_bytes``,
+``_ratio``).  ``tools/metrics_lint.py`` enforces this and is run from
+the test suite.
+"""
+
+from __future__ import annotations
+
+from .metrics import TIME_BUCKETS, Registry
+
+#: the process-wide default registry (``/metrics`` serves exactly this)
+REGISTRY = Registry()
+
+# ------------------------------------------------------------- relay latency
+#: packet bytes are log-spaced 2^k; device pass times are sub-ms — the
+#: shared TIME_BUCKETS ladder covers 100 µs…60 s for both
+RELAY_INGEST_TO_WIRE = REGISTRY.histogram(
+    "relay_ingest_to_wire_seconds",
+    "In-server ingest(arrival stamp at push_rtp)->wire latency per relayed "
+    "packet, by egress engine (native sendmmsg/GSO, device batch-header, "
+    "scalar oracle)",
+    labels=("engine",), buckets=TIME_BUCKETS)
+
+# ------------------------------------------------------------ device engine
+TPU_PASS_SECONDS = REGISTRY.histogram(
+    "tpu_pass_seconds",
+    "Duration of one relay engine pass, by stage (engine_step = full "
+    "TpuFanoutEngine.step; pipeline_dispatch = RelayPipeline device "
+    "dispatch; device_params = affine-param refresh fetch)",
+    labels=("stage",), buckets=TIME_BUCKETS)
+TPU_PASSES = REGISTRY.counter(
+    "tpu_passes_total", "TpuFanoutEngine.step passes executed")
+TPU_PACKETS_SENT = REGISTRY.counter(
+    "tpu_packets_sent_total",
+    "(packet, subscriber) sends completed by the TPU fan-out engine")
+TPU_HEADERS_RENDERED = REGISTRY.counter(
+    "tpu_headers_rendered_total",
+    "Rewritten 12-byte RTP headers rendered by device batch steps")
+TPU_H2D_BYTES = REGISTRY.counter(
+    "tpu_h2d_bytes_total",
+    "Host->device bytes staged (packet prefixes + metadata appended to "
+    "the resident device ring, plus pipeline step inputs)")
+TPU_D2H_BYTES = REGISTRY.counter(
+    "tpu_d2h_bytes_total",
+    "Device->host bytes fetched (affine egress params, header blocks)")
+TPU_PARAM_REFRESHES = REGISTRY.counter(
+    "tpu_param_refreshes_total",
+    "Device affine-param recomputes (membership/rebase state changes)")
+
+# ------------------------------------------------------------ native egress
+# Mirrored from the C data-plane's cumulative ed_stats snapshot by the
+# collector native.py registers (see _EGRESS_FIELDS there).
+EGRESS_SENDMMSG_CALLS = REGISTRY.counter(
+    "egress_sendmmsg_calls_total",
+    "sendmmsg(2) syscalls issued by the native egress (plain + GSO)")
+EGRESS_SENDTO_CALLS = REGISTRY.counter(
+    "egress_sendto_calls_total",
+    "sendto(2) syscalls issued by the scalar-baseline egress")
+EGRESS_PACKETS = REGISTRY.counter(
+    "egress_packets_total",
+    "Wire datagram-equivalents handed to the kernel by native egress")
+EGRESS_BYTES = REGISTRY.counter(
+    "egress_bytes_total",
+    "Bytes-to-wire handed to the kernel by native egress")
+EGRESS_GSO_SUPERS = REGISTRY.counter(
+    "egress_gso_supers_total",
+    "UDP_SEGMENT super-datagrams sent (multi-segment only)")
+EGRESS_GSO_SEGMENTS = REGISTRY.counter(
+    "egress_gso_segments_total",
+    "Wire segments carried inside UDP_SEGMENT super-datagrams")
+EGRESS_EAGAIN = REGISTRY.counter(
+    "egress_eagain_total",
+    "Native sends stopped early by EAGAIN/EWOULDBLOCK (flow control; "
+    "callers keep bookmarks and replay)")
+EGRESS_SEND_ERRORS = REGISTRY.counter(
+    "egress_send_errors_total",
+    "Native sends stopped by a hard per-datagram errno (skipped past)")
+
+# ------------------------------------------------------------ native ingest
+INGEST_RECVMMSG_CALLS = REGISTRY.counter(
+    "ingest_recvmmsg_calls_total",
+    "recvmmsg(2) syscalls issued by the native ring ingest")
+INGEST_DATAGRAMS = REGISTRY.counter(
+    "ingest_datagrams_total",
+    "Datagrams admitted into packet rings by the native ingest")
+INGEST_BYTES = REGISTRY.counter(
+    "ingest_bytes_total", "Bytes admitted by the native ring ingest")
+INGEST_OVERSIZE_DROPPED = REGISTRY.counter(
+    "ingest_oversize_dropped_total",
+    "Datagrams dropped at ingest because they exceed the ring slot")
+
+# ------------------------------------------------------------------- QoS
+QOS_FRACTION_LOST = REGISTRY.gauge(
+    "qos_fraction_lost_ratio",
+    "Most recent RTCP receiver-report fraction-lost (0..1) per "
+    "subscribed stream", labels=("path", "track"))
+QOS_JITTER = REGISTRY.gauge(
+    "qos_jitter_seconds",
+    "Most recent RTCP receiver-report interarrival jitter per "
+    "subscribed stream", labels=("path", "track"))
+QOS_THINS = REGISTRY.counter(
+    "qos_thins_total",
+    "Quality-level increases (stream thinned) across all outputs")
+QOS_THICKENS = REGISTRY.counter(
+    "qos_thickens_total",
+    "Quality-level decreases (stream thickened) across all outputs")
+
+# ------------------------------------------------------------------- logs
+LOG_LINES = REGISTRY.counter(
+    "log_lines_total", "Lines written to rolling logs, by log and level",
+    labels=("log", "level"))
+LOG_ROLLS = REGISTRY.counter(
+    "log_rolls_total", "Rolling-log roll events, by log", labels=("log",))

@@ -16,9 +16,12 @@ delivered by ``TpuFanoutEngine.step`` equal those of ``RelayStream.reflect``.
 from __future__ import annotations
 
 import errno as errno_mod
+import time
 
 import numpy as np
 
+from .. import obs
+from ..obs import TRACER
 from ..ops import device_ring
 from ..ops import fanout as fanout_ops
 from ..ops import parse as parse_ops
@@ -151,6 +154,7 @@ class TpuFanoutEngine:
 
     # -- the batch pass ----------------------------------------------------
     def step(self, stream: RelayStream, now_ms: int) -> int:
+        t0 = time.perf_counter_ns()
         ring = stream.rtp_ring
         flat = self._flat_outputs(stream)
         if not flat or len(ring) == 0:
@@ -178,6 +182,13 @@ class TpuFanoutEngine:
         stream.stats.packets_out += sent
         self.steps += 1
         self.packets_sent += sent
+        dur = time.perf_counter_ns() - t0
+        obs.TPU_PASS_SECONDS.observe(dur / 1e9, stage="engine_step")
+        obs.TPU_PASSES.inc()
+        if sent:
+            obs.TPU_PACKETS_SENT.inc(sent)
+        TRACER.add("engine.step", t0, dur, cat="tpu", sent=sent,
+                   outputs=len(flat))
         return sent
 
     # -- native fast path --------------------------------------------------
@@ -221,6 +232,7 @@ class TpuFanoutEngine:
             self._dring, prefix, length, arrival, np.int32(len(ids)))
         self._dring_appended = ring.head
         self.h2d_appended_bytes += b_pad * (self.prefix_width + 8)
+        obs.TPU_H2D_BYTES.inc(b_pad * (self.prefix_width + 8))
 
     def _device_params(self, fast, ring, now_ms: int):
         """Affine egress params from the device step over the RESIDENT
@@ -236,6 +248,7 @@ class TpuFanoutEngine:
                      o.rewrite.out_ts_start) for o, _ in fast)
         if key == self._params_key:
             return self._params
+        t0 = time.perf_counter_ns()
         S = len(fast)
         s_pad = _pow2(S, 8)
         state = np.zeros((s_pad, fanout_ops.STATE_COLS), np.uint32)
@@ -254,6 +267,12 @@ class TpuFanoutEngine:
                         np.ascontiguousarray(ssrc))
         self._params_key = key
         self.device_param_refreshes += 1
+        obs.TPU_PARAM_REFRESHES.inc()
+        # the three [1,S] uint32 param rows + the keyframe scalar crossed
+        # device→host to serve this refresh
+        obs.TPU_D2H_BYTES.inc(sum(a.nbytes for a in self._params) + 8)
+        obs.TPU_PASS_SECONDS.observe((time.perf_counter_ns() - t0) / 1e9,
+                                     stage="device_params")
         return self._params
 
     def _native_step(self, stream: RelayStream, fast, now_ms: int) -> int:
@@ -358,7 +377,8 @@ class TpuFanoutEngine:
         # bookmark/stat accounting, exact under partial (EAGAIN) sends
         taken = 0
         hard_consumed = False
-        for (out, hi, pids, _slots, lens), n in zip(per_out, counts):
+        sent_slots: list[np.ndarray] = []   # → ingest→wire histogram
+        for (out, hi, pids, slots, lens), n in zip(per_out, counts):
             k = min(max(r - taken, 0), n)
             taken += n
             if n == 0:
@@ -383,6 +403,16 @@ class TpuFanoutEngine:
                 sent_bytes = int(lens[:k].sum())
                 out.bytes_sent += sent_bytes
                 out.payload_octets += sent_bytes - 12 * k
+                sent_slots.append(slots[:k])
+        if sent_slots:
+            # one vectorized observe per pass: perf_counter stamp at
+            # push_rtp minus now, per delivered (packet, subscriber) pair
+            now_ns = time.perf_counter_ns()
+            all_slots = (sent_slots[0] if len(sent_slots) == 1
+                         else np.concatenate(sent_slots))
+            obs.RELAY_INGEST_TO_WIRE.observe_many(
+                (now_ns - ring.arrival_ns[all_slots]) / 1e9,
+                engine="native")
         self.native_sent += r
         self.native_passes += 1
         return int(r)
@@ -408,8 +438,15 @@ class TpuFanoutEngine:
             prefix, lengths.astype(np.int32), age, state, buckets,
             np.int32(stream.settings.bucket_delay_ms))
         headers = np.asarray(res["headers"])
+        # the whole window's prefixes+metadata crossed to the device and
+        # the [S, P, 12] header block crossed back
+        obs.TPU_H2D_BYTES.inc(prefix.nbytes + lengths.nbytes + age.nbytes
+                              + np.asarray(state).nbytes)
+        obs.TPU_D2H_BYTES.inc(headers.nbytes)
+        obs.TPU_HEADERS_RENDERED.inc(headers.shape[0] * headers.shape[1])
 
         sent = 0
+        lat_ns: list[int] = []
         delay = stream.settings.bucket_delay_ms
         for s, (out, b_idx) in enumerate(flat):
             pid = out.bookmark
@@ -444,5 +481,11 @@ class TpuFanoutEngine:
                     out.bytes_sent += 12 + len(payload)
                     out.payload_octets += len(payload)
                     sent += 1
+                    lat_ns.append(int(ring.arrival_ns[slot]))
             out.bookmark = pid
+        if lat_ns:
+            now_ns = time.perf_counter_ns()
+            obs.RELAY_INGEST_TO_WIRE.observe_many(
+                (now_ns - np.asarray(lat_ns, dtype=np.int64)) / 1e9,
+                engine="batch")
         return sent

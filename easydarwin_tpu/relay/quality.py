@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from .. import obs
 from .ring import PacketFlags
 
 MAX_LEVEL = 3
@@ -110,9 +111,33 @@ class QualityController:
         new = max(0, min(MAX_LEVEL, self.level + d))
         if new > self.level:
             self.thins += 1
+            obs.QOS_THINS.inc()
         elif new < self.level:
             self.thickens += 1
+            obs.QOS_THICKENS.inc()
         self.level = new
+
+
+def record_rr_qos(path: str, track_id, fraction_lost: float,
+                  jitter_units: int, clock_rate: int | None = None) -> None:
+    """Fold one RTCP receiver report into the per-stream QoS gauges.
+
+    ``jitter_units`` is the RFC 3550 interarrival jitter in RTP timestamp
+    units; it is converted to seconds with the stream clock rate (90 kHz
+    when unknown).  Called from the RTSP RTCP demux for every matched
+    report block — gauges carry the MOST RECENT report, the counters
+    (qos_thins/thickens) accumulate the adaptation decisions."""
+    rate = clock_rate or 90000
+    labels = {"path": path or "-", "track": str(track_id)}
+    obs.QOS_FRACTION_LOST.set(round(float(fraction_lost), 6), **labels)
+    obs.QOS_JITTER.set(round(jitter_units / rate, 6), **labels)
+
+
+def drop_qos(path: str, track_id) -> None:
+    """Remove a departed stream's QoS gauges from the exposition."""
+    labels = {"path": path or "-", "track": str(track_id)}
+    obs.QOS_FRACTION_LOST.remove(**labels)
+    obs.QOS_JITTER.remove(**labels)
 
 
 @dataclass

@@ -21,6 +21,8 @@ between the CPU oracle and the device batch.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from ..protocol import mjpeg, nalu, rtp
@@ -57,6 +59,10 @@ class PacketRing:
         self.data = np.zeros((capacity, slot_size), dtype=np.uint8)
         self.length = np.zeros(capacity, dtype=np.int32)
         self.arrival = np.zeros(capacity, dtype=np.int64)
+        #: high-resolution ingest stamp (perf_counter_ns) — feeds the
+        #: in-server ingest→wire latency histogram; ``arrival`` stays on
+        #: the coarse relay clock that drives bucket delays/eviction
+        self.arrival_ns = np.zeros(capacity, dtype=np.int64)
         self.flags = np.zeros(capacity, dtype=np.int32)
         self.seq = np.zeros(capacity, dtype=np.int32)
         self.timestamp = np.zeros(capacity, dtype=np.int64)
@@ -127,6 +133,7 @@ class PacketRing:
             self.data[s, n:] = 0
         self.length[s] = n
         self.arrival[s] = arrival_ms
+        self.arrival_ns[s] = time.perf_counter_ns()
         self.classify_slot(s, packet, is_rtcp=is_rtcp)
         self.head = pid + 1
         return pid
@@ -147,8 +154,10 @@ class PacketRing:
         self.total_oversize += oversize
         if n <= 0:
             return 0
+        stamp_ns = time.perf_counter_ns()   # one stamp per drained batch
         for pid in range(self.head, new_head):
             s = self.slot(pid)
+            self.arrival_ns[s] = stamp_ns
             self.classify_slot(
                 s, self.data[s, :self.length[s]].tobytes())
         self.head = new_head

@@ -21,6 +21,9 @@ import random
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
+from .. import obs
 from ..protocol import rtcp as rtcp_mod
 from ..protocol.sdp import StreamInfo
 from .output import RelayOutput, WriteResult
@@ -224,6 +227,7 @@ class RelayStream:
         stop-on-WouldBlock (bookmark holds for replay next pass)."""
         ring = self.rtp_ring
         sent = 0
+        lat_ns: list[int] = []          # ingest stamps of delivered packets
         for b_idx, bucket in enumerate(self.buckets):
             deadline = now_ms - b_idx * self.settings.bucket_delay_ms
             for out in bucket:
@@ -251,8 +255,14 @@ class RelayStream:
                     pid += 1
                     if res is WriteResult.OK:
                         sent += 1
+                        lat_ns.append(int(ring.arrival_ns[ring.slot(pid - 1)]))
                 out.bookmark = pid
         self.stats.packets_out += sent
+        if lat_ns:
+            obs.RELAY_INGEST_TO_WIRE.observe_many(
+                (time.perf_counter_ns()
+                 - np.asarray(lat_ns, dtype=np.int64)) / 1e9,
+                engine="scalar")
         self.relay_rtcp(now_ms)
         return sent
 

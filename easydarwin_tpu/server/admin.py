@@ -82,6 +82,9 @@ def build_tree(app) -> dict[str, Any]:
     cstore = getattr(app.config, "attr_store", None)
     if cstore is None:
         cstore = app.config.attr_store = dct.config_store(app.config)
+    mstore = getattr(app, "metrics_store", None)
+    if mstore is None:
+        mstore = app.metrics_store = dct.metrics_store()
     sessions = {}
     for s in app.registry.sessions.values():
         sessions[s.path.strip("/").replace("/", "~")] = \
@@ -98,6 +101,7 @@ def build_tree(app) -> dict[str, Any]:
         "server": {
             "info": sstore,
             "prefs": cstore,
+            "metrics": mstore,
             "sessions": sessions,
             "modules": modules,
         },

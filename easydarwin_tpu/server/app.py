@@ -80,7 +80,8 @@ class StreamingServer:
         self.restart_event = asyncio.Event()
         self._engines: dict[int, TpuFanoutEngine] = {}
         self.started_at = time.time()
-        self.status = None
+        from .status import StatusMonitor
+        self.status = StatusMonitor(self)
         self.presence = None
         self._redis_client = redis_client
         self.config.on_change(self._on_config_change)
@@ -114,8 +115,6 @@ class StreamingServer:
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
         ]
         if self.config.stats_interval_sec or self.config.status_file_path:
-            from .status import StatusMonitor
-            self.status = StatusMonitor(self)
             self._tasks.append(
                 asyncio.create_task(self._status_loop(), name="status"))
         if self.config.cloud_enabled:
@@ -292,8 +291,9 @@ class StreamingServer:
         last_console = 0.0
         while self._running:
             await asyncio.sleep(interval)
-            snap = self.status.sample()     # ONE sample per tick: sample()
-            # moves the rate baseline, so console and file must share it
+            snap = self.status.tick()       # the ONE baseline advance per
+            # tick; console and file read the returned snapshot (and any
+            # concurrent REST reader uses the pure snapshot())
             now = time.monotonic()
             if (self.config.stats_interval_sec and now - last_console
                     >= self.config.stats_interval_sec - interval / 2):
@@ -351,17 +351,24 @@ class StreamingServer:
 
     # ------------------------------------------------------------- queries
     def server_info(self) -> dict:
-        s = self.rtsp.stats
+        # pure snapshot(): REST readers share the status loop's tick()
+        # baseline instead of racing it (the old sample()-everywhere
+        # design zeroed whichever reader came second in a tick)
+        d = self.status.snapshot()
         return {
             "ServerName": "easydarwin-tpu",
             "Version": "0.1.0",
-            "UpTimeSec": str(int(time.time() - self.started_at)),
+            "UpTimeSec": str(d["uptime_sec"]),
             "RTSPPort": str(self.rtsp.port or self.config.rtsp_port),
             "ServicePort": str(self.rest.port or self.config.service_port),
-            "Connections": str(len(self.rtsp.connections)),
-            "PushSessions": str(len(self.registry.sessions)),
-            "Requests": str(s["requests"]),
-            "PacketsIn": str(s["packets_in"]),
+            "Connections": str(d["rtsp_connections"]),
+            "PushSessions": str(d["push_sessions"]),
+            "Requests": str(d["requests"]),
+            "PacketsIn": str(d["packets_in"]),
+            "PacketsOut": str(d["packets_out"]),
+            "InRatePps": str(d["in_rate"]),
+            "OutRatePps": str(d["out_rate"]),
+            "IngestToWireP99Ms": str(d["ingest_to_wire_p99_ms"]),
             "TpuFanout": "1" if self.config.tpu_fanout else "0",
         }
 

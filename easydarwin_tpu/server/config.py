@@ -10,7 +10,11 @@ listeners that components subscribe to (the RereadPrefs equivalent).
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:        # Python < 3.11: same API from tomli
+    import tomli as tomllib        # type: ignore[no-redef]
 from dataclasses import dataclass, field
 from typing import Callable
 

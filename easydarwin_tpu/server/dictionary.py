@@ -182,6 +182,33 @@ def _age_sec(sess) -> int:
     return int((now_ms() - sess.created_ms) // 1000)
 
 
+def metrics_store() -> AttrStore:
+    """Reflective view over the obs metric registry: one attribute per
+    registered family, getters read the LIVE family value (counters as
+    numbers, histograms as {count,sum,p50,p99}, labelled families as
+    name→value maps).  ``server/metrics/<family>`` and ``@<id>`` admin
+    queries therefore see exactly what a ``/metrics`` scrape sees."""
+    import time as _time
+
+    from .. import obs
+    st = AttrStore("metrics")
+    last_collect = [0.0]
+
+    def _live(fam):
+        # refresh external sources (ed_stats) at most once per 50 ms: an
+        # as_dict() tree sweep reads ~26 getters back-to-back and must
+        # not re-snapshot the native counters for every one of them
+        now = _time.monotonic()
+        if now - last_collect[0] > 0.05:
+            last_collect[0] = now
+            obs.REGISTRY.collect()
+        return fam.as_value()
+
+    for fam in obs.REGISTRY.families():
+        st.add_attr(fam.name, (lambda f=fam: _live(f)), type="json")
+    return st
+
+
 def stream_store(sess, track_id: int) -> AttrStore:
     """qtssRTPStreamObjectType: per-track live counters (the per-stream
     set the RTPStream dictionary exposed)."""

@@ -113,6 +113,12 @@ class RestApi:
         params = parse_qs(url.query)
         if path == "/stats":
             return 200, self._webstats_html()
+        if path == "/metrics":
+            # Prometheus scrape: unauthenticated read-only exposition,
+            # same trust level as /stats
+            from .. import obs
+            return (200, obs.REGISTRY.expose(),
+                    "text/plain; version=0.0.4; charset=utf-8")
         if path == "/admin":
             if not self._authorized(headers, params):
                 return 401, "<h1>401</h1>"
@@ -368,6 +374,12 @@ class RestApi:
         from . import admin
         path = params.get("path", ["server/*"])[0]
         command = params.get("command", ["get"])[0].lower()
+        if command == "trace":
+            # span-ring dump: the raw Chrome trace-event document (NOT
+            # envelope-wrapped) so chrome://tracing / Perfetto load the
+            # response body directly
+            from ..obs import TRACER
+            return 200, json.dumps(TRACER.dump()), "application/json"
         if command == "set":
             status, payload = admin.set_pref(
                 self.app, path, params.get("value", [""])[0])

@@ -655,6 +655,12 @@ class RtspConnection:
             self.vod_file.close()
             self.vod_file = None
         self._detach_outputs()
+        if self.player_tracks:
+            # a departed player's QoS gauges must not linger in /metrics
+            # (a surviving subscriber's next RR re-creates them)
+            from ..relay import quality as quality_mod
+            for tid in self.player_tracks:
+                quality_mod.drop_qos(self.path, tid)
         egress = self.server.shared_egress
         for pt in self.player_tracks.values():
             if pt.udp_pair:
@@ -837,6 +843,8 @@ class RtspServer:
             return
         outputs = {pt.output.rewrite.ssrc: pt.output
                    for pt in conn.player_tracks.values()}
+        track_of = {pt.output.rewrite.ssrc: tid
+                    for tid, pt in conn.player_tracks.items()}
         # the RTCP source address names the track (each SETUP registers its
         # own client rtcp port) — required for acks, whose 16-bit seq
         # spaces collide across tracks (a video ack must never pop an
@@ -855,6 +863,16 @@ class RtspServer:
                     if out is not None:
                         proven = True
                         out.on_receiver_report(rb.fraction_lost / 256.0)
+                        # fold loss/jitter into the scrapeable per-stream
+                        # QoS gauges (obs registry)
+                        from ..relay import quality as quality_mod
+                        tid = track_of.get(rb.ssrc)
+                        rate = None
+                        if conn.relay is not None and tid in conn.relay.streams:
+                            rate = conn.relay.streams[tid].info.clock_rate
+                        quality_mod.record_rr_qos(
+                            conn.path, tid, rb.fraction_lost / 256.0,
+                            rb.jitter, rate)
             elif isinstance(p, rtcp_mod.Nadu):
                 # 3GPP NADU buffer state → per-output rate adaptation;
                 # each block names the media sender SSRC it reports on

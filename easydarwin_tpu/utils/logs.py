@@ -12,13 +12,18 @@ import os
 import time
 from dataclasses import dataclass
 
+from .. import obs
+from .http_misc import parse_user_agent
+
 
 class RollingLog:
     """Append-only log rolled by size and/or age; files get .N suffixes."""
 
     def __init__(self, path: str, *, max_bytes: int = 10_000_000,
-                 max_age_sec: float = 7 * 86400, keep: int = 5):
+                 max_age_sec: float = 7 * 86400, keep: int = 5,
+                 name: str | None = None):
         self.path = path
+        self.name = name or os.path.splitext(os.path.basename(path))[0]
         self.max_bytes = max_bytes
         self.max_age_sec = max_age_sec
         self.keep = keep
@@ -37,6 +42,11 @@ class RollingLog:
                 or time.time() - self._opened_at >= self.max_age_sec):
             self.roll()
         self._f.write(line.rstrip("\n") + "\n")
+        if self._f.tell() >= self.max_bytes:
+            # roll AFTER a crossing write too: one oversized line must not
+            # leave the file permanently over the cap (the pre-write check
+            # alone only notices at the NEXT write, which may never come)
+            self.roll()
 
     def roll(self) -> None:
         if self._f is not None:
@@ -48,6 +58,7 @@ class RollingLog:
                 os.replace(src, f"{self.path}.{i + 1}")
         if os.path.exists(self.path):
             os.replace(self.path, f"{self.path}.1")
+        obs.LOG_ROLLS.inc(log=self.name)
         self._open()
 
     def close(self) -> None:
@@ -69,6 +80,7 @@ class ErrorLog:
         if self.LEVELS.get(level, 3) <= self.verbosity:
             ts = time.strftime("%Y-%m-%d %H:%M:%S")
             self.log.write_line(f"{ts} [{level.upper()}] {message}")
+            obs.LOG_LINES.inc(log=self.log.name, level=level)
 
     def fatal(self, m):
         self.write("fatal", m)
@@ -121,7 +133,6 @@ class AccessLog:
         ua = (r.user_agent or "-").replace(" ", "_")
         # c-playerid/... columns from the DSS User-Agent grammar
         # (UserAgentParser parity; "-" when the client doesn't send them)
-        from .http_misc import parse_user_agent
         att = parse_user_agent(r.user_agent or "")
         cols = " ".join((att.get(k) or "-").replace(" ", "_")
                         for k in ("qtid", "qtver", "os", "osver", "cpu"))
@@ -130,3 +141,4 @@ class AccessLog:
             f"{time.strftime('%H:%M:%S', now)} {r.uri} {r.method} "
             f"{r.status} {r.duration_sec:.1f} {r.bytes_sent} "
             f"{r.packets_sent} {r.packets_lost} {ua} {r.transport} {cols}")
+        obs.LOG_LINES.inc(log=self.log.name, level="access")

@@ -473,3 +473,103 @@ async def test_rtcp_refreshes_udp_player_timeout():
             await pusher.close()
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_metrics_endpoint_admin_tree_and_trace():
+    """ISSUE 1 acceptance: after a real relay pass, GET /metrics returns
+    valid Prometheus text with a nonzero in-server ingest→wire histogram
+    and per-pass TPU families; the same values read through the admin
+    AttrStore tree; command=trace returns loadable Chrome-trace JSON
+    with engine-pass spans."""
+    import json
+    import re
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, reflect_interval_ms=5,
+                       bind_ip="127.0.0.1", access_log_enabled=False,
+                       tpu_fanout=True, tpu_min_outputs=1)
+    app = await _start(cfg)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/obs"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(0, 0, nal_type=5))
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        await player.play_start(uri)
+        for i in range(1, 9):
+            pusher.push_packet(0, vid_pkt(i, i * 3000))
+        for _ in range(9):
+            await asyncio.wait_for(player.recv_interleaved(0), 5.0)
+
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       app.rest.port)
+
+        async def get(path):
+            writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            head = await reader.readuntil(b"\r\n\r\n")
+            status = int(head.split(b" ")[1])
+            ctype = [ln for ln in head.split(b"\r\n")
+                     if ln.lower().startswith(b"content-type")][0]
+            clen = int([ln for ln in head.split(b"\r\n")
+                        if ln.lower().startswith(b"content-length")][0]
+                       .split(b":")[1])
+            return status, ctype.decode(), await reader.readexactly(clen)
+
+        # --- /metrics scrape: exposition + the acceptance families
+        st, ctype, body = await get("/metrics")
+        assert st == 200 and "text/plain" in ctype and "0.0.4" in ctype
+        text = body.decode()
+        assert "# TYPE relay_ingest_to_wire_seconds histogram" in text
+        counts = {m[0]: float(m[1]) for m in re.findall(
+            r'relay_ingest_to_wire_seconds_count\{engine="(\w+)"\} (\S+)',
+            text)}
+        assert sum(counts.values()) > 0, "in-server latency histogram empty"
+        assert re.search(r"^tpu_passes_total [1-9]", text, re.M)
+        assert re.search(r"^tpu_h2d_bytes_total [1-9]", text, re.M)
+        assert re.search(r'tpu_pass_seconds_count\{stage="engine_step"\} '
+                         r"[1-9]", text)
+        for fam in ("egress_sendmmsg_calls_total", "egress_bytes_total",
+                    "egress_eagain_total", "ingest_recvmmsg_calls_total"):
+            assert re.search(rf"^{fam} \d", text, re.M), fam
+
+        # --- the same values through the reflective admin tree
+        st, _, body = await get("/api/v1/admin?path=server/metrics/"
+                                "relay_ingest_to_wire_seconds")
+        assert st == 200
+        val = json.loads(body)["EasyDarwin"]["Body"]["Value"]
+        assert sum(v["count"] for v in val.values()) >= sum(counts.values())
+        st, _, body = await get("/api/v1/admin?path=server/metrics/*")
+        assert st == 200
+        fams = json.loads(body)["EasyDarwin"]["Body"]["Value"]
+        assert fams["tpu_passes_total"] >= 1
+        # get-by-id: @<id> resolves through the AttrStore like any attr
+        mstore = app.metrics_store
+        aid = mstore.spec("tpu_passes_total").attr_id
+        st, _, body = await get(f"/api/v1/admin?path=server/metrics/@{aid}")
+        assert st == 200
+        # >= : the engine keeps passing between the two queries
+        assert json.loads(body)["EasyDarwin"]["Body"]["Value"] \
+            >= fams["tpu_passes_total"]
+
+        # --- command=trace: loadable Chrome trace with engine spans
+        st, ctype, body = await get("/api/v1/admin?command=trace")
+        assert st == 200 and "application/json" in ctype
+        doc = json.loads(body)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "engine.step" in names
+        for e in doc["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] >= 0
+
+        # --- getserverinfo rides the same snapshot (PacketsOut live)
+        st, _, body = await get("/api/v1/getserverinfo")
+        info = json.loads(body)["EasyDarwin"]["Body"]
+        assert int(info["PacketsOut"]) >= 9
+        assert "IngestToWireP99Ms" in info
+
+        writer.close()
+        await player.close()
+        await pusher.close()
+    finally:
+        await app.stop()

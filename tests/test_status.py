@@ -116,3 +116,39 @@ async def test_console_and_file_share_one_sample(tmp_path):
         assert json.loads(open(path).read())["in_rate"] == snap["in_rate"]
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_snapshot_readers_do_not_zero_tick_rates():
+    """The old single sample() mutated the rate baseline on every call:
+    console + status file + REST getserverinfo in one tick zeroed each
+    other's rates.  Now only tick() advances the baseline; snapshot() is
+    pure and all readers inside a tick see the same rates."""
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        mon = app.status
+        mon.tick()
+        app.rtsp.stats["packets_in"] += 500
+        await asyncio.sleep(0.05)
+        d = mon.tick()
+        assert d["in_rate"] > 0
+        # any number of pure reads keep the tick's rates (REST + file +
+        # console in one tick), and do NOT move the baseline
+        for _ in range(3):
+            assert mon.snapshot()["in_rate"] == d["in_rate"]
+        info = app.server_info()
+        assert float(info["InRatePps"]) == d["in_rate"]
+        app.rtsp.stats["packets_in"] += 500
+        await asyncio.sleep(0.05)
+        # the next tick still sees the full delta: snapshots didn't eat it
+        assert mon.tick()["in_rate"] > 0
+        # obs mirror fields ride every snapshot
+        snap = mon.snapshot()
+        for k in ("ingest_to_wire_count", "ingest_to_wire_p50_ms",
+                  "ingest_to_wire_p99_ms", "wire_bytes", "tpu_passes"):
+            assert k in snap
+    finally:
+        await app.stop()

@@ -1,0 +1,76 @@
+"""Metric-inventory lint: naming convention + help-text conformance.
+
+Imports the process-wide registry (``easydarwin_tpu.obs``) and asserts
+every registered family follows the convention documented in
+ARCHITECTURE.md "Observability":
+
+* names are snake_case (``[a-z][a-z0-9_]*``), no double underscores;
+* counters end in ``_total``;
+* histograms and gauges end in a unit suffix (``_seconds``, ``_bytes``,
+  ``_ratio``, ``_total``, ``_count``);
+* every family has non-empty help text that doesn't just restate the name;
+* label names are snake_case and never the reserved ``le``;
+* histogram bucket bounds are strictly increasing and finite.
+
+Run standalone (``python tools/metrics_lint.py``, exit 1 on violations)
+or from the test suite (``tests/test_obs.py`` imports ``lint``).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count")
+
+
+def lint(registry) -> list[str]:
+    """Return a list of human-readable violations (empty = clean)."""
+    errs: list[str] = []
+    for fam in registry.families():
+        n = fam.name
+        if not NAME_RE.match(n) or "__" in n:
+            errs.append(f"{n}: not snake_case")
+        if fam.kind == "counter" and not n.endswith("_total"):
+            errs.append(f"{n}: counter must end in _total")
+        if fam.kind in ("gauge", "histogram") \
+                and not n.endswith(UNIT_SUFFIXES):
+            errs.append(f"{n}: {fam.kind} must carry a unit suffix "
+                        f"{UNIT_SUFFIXES}")
+        if fam.kind == "histogram" and n.endswith("_total"):
+            errs.append(f"{n}: histogram must not end in _total "
+                        "(collides with counter convention)")
+        if not (fam.help or "").strip():
+            errs.append(f"{n}: missing help text")
+        elif fam.help.strip().lower().replace(" ", "_") == n:
+            errs.append(f"{n}: help text just restates the name")
+        for ln in fam.label_names:
+            if not NAME_RE.match(ln):
+                errs.append(f"{n}: label {ln!r} not snake_case")
+            if ln == "le":
+                errs.append(f"{n}: label 'le' is reserved for histogram "
+                            "buckets")
+        bounds = getattr(fam, "bounds", None)
+        if bounds is not None:
+            if any(b != b or b in (float("inf"), float("-inf"))
+                   for b in bounds):
+                errs.append(f"{n}: non-finite bucket bound")
+            if list(bounds) != sorted(set(bounds)):
+                errs.append(f"{n}: bucket bounds not strictly increasing")
+    return errs
+
+
+def main() -> int:
+    sys.path.insert(0, ".")
+    from easydarwin_tpu import obs
+    errs = lint(obs.REGISTRY)
+    for e in errs:
+        print(f"metrics_lint: {e}", file=sys.stderr)
+    if not errs:
+        print(f"metrics_lint: {len(obs.REGISTRY.families())} families OK")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

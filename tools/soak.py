@@ -50,6 +50,53 @@ def synth_frame(f: int, n: int = 64) -> np.ndarray:
     return synth_luma(n, f)
 
 
+def parse_metrics(text: str) -> dict[str, float]:
+    """Prometheus text exposition → {sample line name+labels: value}."""
+    out: dict[str, float] = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, _, val = ln.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+def check_metrics(scrapes: list[dict[str, float]]) -> list[str]:
+    """Counter-regression checks over the soak's periodic scrapes."""
+    errs: list[str] = []
+    if not scrapes:
+        return ["no /metrics scrapes completed"]
+    last = scrapes[-1]
+    if last.get("ingest_oversize_dropped_total", 0) > 0:
+        errs.append(f"ingest drops: "
+                    f"{last['ingest_oversize_dropped_total']:.0f}")
+    if last.get("egress_send_errors_total", 0) > 0:
+        errs.append(f"hard egress errors: "
+                    f"{last['egress_send_errors_total']:.0f}")
+    calls = last.get("egress_sendmmsg_calls_total", 0) \
+        + last.get("egress_sendto_calls_total", 0)
+    eagain = last.get("egress_eagain_total", 0)
+    if calls and eagain / calls > 0.5:
+        errs.append(f"EAGAIN retry ratio {eagain / calls:.2f} > 0.5 "
+                    f"({eagain:.0f}/{calls:.0f})")
+    lat = sum(v for k, v in last.items()
+              if k.startswith("relay_ingest_to_wire_seconds_count"))
+    if lat == 0:
+        errs.append("relay_ingest_to_wire_seconds histogram stayed empty")
+    # cumulative families must be monotonic across scrapes (a reset
+    # mid-run means double-registration or a counter bug)
+    for a, b in zip(scrapes, scrapes[1:]):
+        for k, v in a.items():
+            # match the FAMILY name: labeled samples end in '}', not _total
+            if k.split("{")[0].endswith("_total") and b.get(k, v) < v:
+                errs.append(f"counter {k} went backwards: {v} -> {b[k]}")
+                break
+    return errs
+
+
 async def soak(seconds: float) -> int:
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
@@ -132,6 +179,7 @@ async def soak(seconds: float) -> int:
         f = 0
         seq_a = seq_b = 0
         seq_aud = 0
+        scrapes: list[dict[str, float]] = []
         tcp_rx = [0]
         udp_rx = [0]
 
@@ -222,6 +270,10 @@ async def soak(seconds: float) -> int:
                 assert st == 200
                 st, _ = await rest_get("/api/v1/gethlsstreams")
                 assert st == 200
+            if f % 60 == 40:           # periodic Prometheus scrape
+                st, body = await rest_get("/metrics")
+                assert st == 200
+                scrapes.append(parse_metrics(body.decode()))
             f += 1
             await asyncio.sleep(0.03)
         await drain_task
@@ -264,6 +316,11 @@ async def soak(seconds: float) -> int:
         for eng in app._engines.values():
             if eng.send_errors:
                 failures.append(f"engine send errors: {eng.send_errors}")
+        st, body = await rest_get("/metrics")   # final scrape for checks
+        if st == 200:
+            scrapes.append(parse_metrics(body.decode()))
+        failures.extend(check_metrics(scrapes))
+        mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
             "audio_aus": seq_aud,
@@ -279,6 +336,13 @@ async def soak(seconds: float) -> int:
             "requant": str(q6.requant.stats) if q6 else None,
             "hls_shed": q6.shed if q6 else None,
             "rtcp_in": egress.rtcp_in,
+            "metrics_scrapes": len(scrapes),
+            "wire_bytes": mlast.get("egress_bytes_total"),
+            "sendmmsg_calls": mlast.get("egress_sendmmsg_calls_total"),
+            "eagain": mlast.get("egress_eagain_total"),
+            "ingest_to_wire_count": sum(
+                v for k, v in mlast.items()
+                if k.startswith("relay_ingest_to_wire_seconds_count")),
             "native_ingest": {
                 s.native_ingest_pkts and "ok" or 0: s.native_ingest_pkts
                 for sess in app.registry.sessions.values()
