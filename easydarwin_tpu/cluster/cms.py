@@ -17,9 +17,11 @@ from __future__ import annotations
 import asyncio
 import base64
 import os
+import secrets
 import time
 from dataclasses import dataclass, field
 
+from ..obs import EVENTS
 from . import protocol as ep
 from .presence import PresenceService
 
@@ -120,6 +122,13 @@ class CmsServer:
 
     async def _dispatch(self, msg: ep.Message, writer, bound):
         mt = msg.message_type
+        # trace ingress: adopt the caller's TraceId or mint one, so every
+        # forwarded request / ack / event of this RPC correlates
+        if not msg.trace_id:
+            msg.trace_id = secrets.token_hex(8)
+        EVENTS.emit("cms.rpc", trace_id=msg.trace_id,
+                    msg_type=f"0x{mt:04X}", cseq=msg.cseq,
+                    serial=str(msg.body.get("Serial", "")))
         if mt == ep.MSG_DS_REGISTER_REQ:
             return self._register_device(msg, writer)
         if mt == ep.MSG_DS_PUSH_STREAM_ACK:
@@ -141,7 +150,7 @@ class CmsServer:
                   ep.MSG_CS_TALKBACK_CTRL_REQ):
             return await self._forward_ctrl(msg), None
         return ep.ack(ep.MSG_SC_EXCEPTION, msg.cseq,
-                      ep.ERR_BAD_REQUEST), None
+                      ep.ERR_BAD_REQUEST, trace_id=msg.trace_id), None
 
     # ------------------------------------------------------------ handlers
     def _register_device(self, msg: ep.Message, writer):
@@ -149,7 +158,7 @@ class CmsServer:
         serial = str(b.get("Serial", "")).strip()
         if not serial:
             return ep.ack(ep.MSG_SD_REGISTER_ACK, msg.cseq,
-                          ep.ERR_BAD_REQUEST), None
+                          ep.ERR_BAD_REQUEST, trace_id=msg.trace_id), None
         rec = self.devices.get(serial) or DeviceRecord(serial)
         rec.name = str(b.get("Name", rec.name or serial))
         rec.device_type = str(b.get("Type", rec.device_type))
@@ -158,8 +167,11 @@ class CmsServer:
         rec.writer = writer
         rec.last_seen = time.time()
         self.devices[serial] = rec
+        EVENTS.emit("cms.register", trace_id=msg.trace_id, serial=serial,
+                    name=rec.name)
         return ep.ack(ep.MSG_SD_REGISTER_ACK, msg.cseq, ep.ERR_OK,
-                      {"Serial": serial, "Token": rec.token}), rec
+                      {"Serial": serial, "Token": rec.token},
+                      trace_id=msg.trace_id), rec
 
     def _post_snap(self, msg: ep.Message):
         b = msg.body
@@ -169,7 +181,7 @@ class CmsServer:
             raw = base64.b64decode(img)
         except (ValueError, TypeError):
             return ep.ack(ep.MSG_SD_POST_SNAP_ACK, msg.cseq,
-                          ep.ERR_BAD_REQUEST)
+                          ep.ERR_BAD_REQUEST, trace_id=msg.trace_id)
         path = os.path.join(self.snap_dir, f"{serial}_{int(time.time())}.jpg")
         with open(path, "wb") as f:
             f.write(raw)
@@ -177,7 +189,7 @@ class CmsServer:
         if rec is not None:
             rec.last_seen = time.time()
         return ep.ack(ep.MSG_SD_POST_SNAP_ACK, msg.cseq, ep.ERR_OK,
-                      {"SnapURL": f"file://{path}"})
+                      {"SnapURL": f"file://{path}"}, trace_id=msg.trace_id)
 
     def _device_list(self, msg: ep.Message):
         now = time.time()
@@ -188,16 +200,16 @@ class CmsServer:
         } for d in self.devices.values()
             if now - d.last_seen < self.device_timeout_sec]
         return ep.ack(ep.MSG_SC_DEVICE_LIST_ACK, msg.cseq, ep.ERR_OK,
-                      {"DeviceCount": str(len(devs)), "Devices": devs})
+                      {"DeviceCount": str(len(devs)), "Devices": devs}, trace_id=msg.trace_id)
 
     def _device_info(self, msg: ep.Message):
         rec = self.devices.get(str(msg.body.get("Serial", "")))
         if rec is None:
             return ep.ack(ep.MSG_SC_DEVICE_INFO_ACK, msg.cseq,
-                          ep.ERR_NOT_FOUND)
+                          ep.ERR_NOT_FOUND, trace_id=msg.trace_id)
         return ep.ack(ep.MSG_SC_DEVICE_INFO_ACK, msg.cseq, ep.ERR_OK, {
             "Serial": rec.serial, "Name": rec.name, "Type": rec.device_type,
-            "Online": "1" if rec.online else "0", "Channels": rec.channels})
+            "Online": "1" if rec.online else "0", "Channels": rec.channels}, trace_id=msg.trace_id)
 
     async def _get_stream(self, msg: ep.Message):
         """Client wants a device's stream: place it on the least-loaded
@@ -208,16 +220,17 @@ class CmsServer:
         rec = self.devices.get(serial)
         if rec is None or not rec.online:
             return ep.ack(ep.MSG_SC_GET_STREAM_ACK, msg.cseq,
-                          ep.ERR_DEVICE_OFFLINE)
+                          ep.ERR_DEVICE_OFFLINE, trace_id=msg.trace_id)
         # already pushing this channel? answer with the existing URL
         if channel in rec.pushing:
             return ep.ack(ep.MSG_SC_GET_STREAM_ACK, msg.cseq, ep.ERR_OK,
                           {"URL": rec.pushing[channel], "Serial": serial,
-                           "Channel": channel})
+                           "Channel": channel}, trace_id=msg.trace_id)
         server = await PresenceService.pick_least_loaded(self.redis)
         if server is None:
             return ep.ack(ep.MSG_SC_GET_STREAM_ACK, msg.cseq,
-                          ep.ERR_INTERNAL, {"Detail": "no media servers"})
+                          ep.ERR_INTERNAL, {"Detail": "no media servers"},
+                          trace_id=msg.trace_id)
         url = (f"rtsp://{server['IP']}:{server['RTSP']}"
                f"/{serial}/{channel}.sdp")
         fut = asyncio.get_running_loop().create_future()
@@ -225,17 +238,22 @@ class CmsServer:
         rec.writer.write(_frame(ep.Message(
             ep.MSG_SD_PUSH_STREAM_REQ, msg.cseq,
             body={"Serial": serial, "Channel": channel, "URL": url,
-                  "IP": server["IP"], "Port": server["RTSP"]}).to_json()))
+                  "IP": server["IP"], "Port": server["RTSP"]},
+            trace_id=msg.trace_id).to_json()))
         await rec.writer.drain()
         try:
             await asyncio.wait_for(fut, 5.0)
         except asyncio.TimeoutError:
             self._pending_push.pop(serial, None)
             return ep.ack(ep.MSG_SC_GET_STREAM_ACK, msg.cseq,
-                          ep.ERR_DEVICE_OFFLINE, {"Detail": "push timeout"})
+                          ep.ERR_DEVICE_OFFLINE, {"Detail": "push timeout"},
+                          trace_id=msg.trace_id)
         rec.pushing[channel] = url
+        EVENTS.emit("cms.push_stream", trace_id=msg.trace_id,
+                    serial=serial, url=url)
         return ep.ack(ep.MSG_SC_GET_STREAM_ACK, msg.cseq, ep.ERR_OK,
-                      {"URL": url, "Serial": serial, "Channel": channel})
+                      {"URL": url, "Serial": serial, "Channel": channel},
+                      trace_id=msg.trace_id)
 
     async def _free_stream(self, msg: ep.Message):
         """Last viewer left → tell the device to stop pushing (the
@@ -245,14 +263,16 @@ class CmsServer:
         rec = self.devices.get(serial)
         if rec is None:
             return ep.ack(ep.MSG_SC_FREE_STREAM_ACK, msg.cseq,
-                          ep.ERR_NOT_FOUND)
+                          ep.ERR_NOT_FOUND, trace_id=msg.trace_id)
         rec.pushing.pop(channel, None)
         if rec.online:
             rec.writer.write(_frame(ep.Message(
                 ep.MSG_SD_STREAM_STOP_REQ, msg.cseq,
-                body={"Serial": serial, "Channel": channel}).to_json()))
+                body={"Serial": serial, "Channel": channel},
+                trace_id=msg.trace_id).to_json()))
             await rec.writer.drain()
-        return ep.ack(ep.MSG_SC_FREE_STREAM_ACK, msg.cseq, ep.ERR_OK)
+        return ep.ack(ep.MSG_SC_FREE_STREAM_ACK, msg.cseq, ep.ERR_OK,
+                      trace_id=msg.trace_id)
 
     async def _forward_ctrl(self, msg: ep.Message):
         """PTZ / preset / talkback commands forwarded to the device."""
@@ -264,8 +284,11 @@ class CmsServer:
             ep.MSG_CS_TALKBACK_CTRL_REQ: ep.MSG_SC_TALKBACK_CTRL_ACK,
         }[msg.message_type]
         if rec is None or not rec.online:
-            return ep.ack(ack_type, msg.cseq, ep.ERR_DEVICE_OFFLINE)
+            return ep.ack(ack_type, msg.cseq, ep.ERR_DEVICE_OFFLINE,
+                          trace_id=msg.trace_id)
         rec.writer.write(_frame(ep.Message(
-            ep.MSG_SD_CONTROL_PTZ_REQ, msg.cseq, body=msg.body).to_json()))
+            ep.MSG_SD_CONTROL_PTZ_REQ, msg.cseq, body=msg.body,
+            trace_id=msg.trace_id).to_json()))
         await rec.writer.drain()
-        return ep.ack(ack_type, msg.cseq, ep.ERR_OK)
+        return ep.ack(ack_type, msg.cseq, ep.ERR_OK,
+                      trace_id=msg.trace_id)

@@ -70,6 +70,12 @@ class Message:
     cseq: int = 1
     error: int | None = None            # None for requests, set for ACKs
     body: dict[str, Any] = field(default_factory=dict)
+    #: traceparent-style correlation id: the CMS stamps one on ingress
+    #: when absent and echoes/propagates it on every forwarded request
+    #: and ack, so a device-control round trip greps as one trace across
+    #: client → CMS → device logs.  Optional — stock EasyDarwin tooling
+    #: that omits (or ignores) the Header field interoperates unchanged.
+    trace_id: str | None = None
 
     def to_json(self) -> str:
         header: dict[str, Any] = {
@@ -77,6 +83,8 @@ class Message:
             "MessageType": f"0x{self.message_type:04X}",
             "Version": VERSION,
         }
+        if self.trace_id:
+            header["TraceId"] = self.trace_id
         if self.error is not None:
             header["ErrorNum"] = str(self.error)
             header["ErrorString"] = _ERROR_STRINGS.get(self.error, "Unknown")
@@ -99,13 +107,16 @@ class Message:
         except ValueError as e:
             raise ProtocolError(f"bad MessageType {h.get('MessageType')!r}") from e
         err = h.get("ErrorNum")
+        tid = h.get("TraceId")
         return cls(
             message_type=message_type,
             cseq=int(h.get("CSeq", "1") or 1),
             error=int(err) if err is not None else None,
-            body=env.get("Body") or {})
+            body=env.get("Body") or {},
+            trace_id=str(tid) if tid else None)
 
 
 def ack(message_type: int, cseq: int = 1, error: int = ERR_OK,
-        body: dict | None = None) -> str:
-    return Message(message_type, cseq, error, body or {}).to_json()
+        body: dict | None = None, *, trace_id: str | None = None) -> str:
+    return Message(message_type, cseq, error, body or {},
+                   trace_id=trace_id).to_json()

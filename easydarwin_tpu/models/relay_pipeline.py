@@ -40,6 +40,12 @@ class RelayPipelineConfig:
 class RelayPipeline:
     def __init__(self, config: RelayPipelineConfig | None = None):
         self.config = config or RelayPipelineConfig()
+        #: session correlation id for spans this pipeline records; a
+        #: caller that serves one session (graft/bench harnesses, an
+        #: embedding engine) stamps it — or passes ``trace_id=`` per
+        #: call — so one Perfetto query selects that session across
+        #: pipeline/engine/egress hops.  Unset, spans stay uncorrelated
+        self.trace_id: str | None = None
         self._step = jax.jit(functools.partial(
             _pipeline_step,
             use_pallas=self.config.use_pallas_parse,
@@ -47,7 +53,8 @@ class RelayPipeline:
             bucket_delay_ms=self.config.bucket_delay_ms,
             codec=self.config.codec))
 
-    def __call__(self, prefix, length, age_ms, out_state, buckets):
+    def __call__(self, prefix, length, age_ms, out_state, buckets, *,
+                 trace_id: str | None = None):
         t0 = time.perf_counter_ns()
         out = self._step(prefix, length, age_ms, out_state, buckets)
         # dispatch-side accounting (jax dispatch is async: this times the
@@ -61,8 +68,11 @@ class RelayPipeline:
             n_sub = out_state.shape[-2]
             n_pkt = length.shape[-1]
             obs.TPU_HEADERS_RENDERED.inc(n_sub * n_pkt)
-        TRACER.add("pipeline.step", t0, dur, cat="tpu",
-                   mode=self.config.mode)
+        span_args = {"mode": self.config.mode}
+        tid = trace_id or self.trace_id
+        if tid is not None:
+            span_args["trace_id"] = tid
+        TRACER.add("pipeline.step", t0, dur, cat="tpu", **span_args)
         return out
 
     @property

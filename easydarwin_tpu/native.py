@@ -266,12 +266,15 @@ def fanout_send_udp_gso(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
 def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
                       seq_off: np.ndarray, ts_off: np.ndarray,
                       ssrc: np.ndarray, dests, ops, n_ops: int,
-                      *, use_gso: bool | int = True) -> int:
+                      *, use_gso: bool | int = True,
+                      trace_id: str | None = None) -> int:
     """Multi-source egress: ``seq_off``/``ts_off``/``ssrc`` are
     [n_src, n_outs]; ONE C call sends every source's window (the hot loop
     makes one Python→C transition per pass instead of n_src).
 
-    ``use_gso``: 0/False plain sendmmsg, 1/True UDP_SEGMENT."""
+    ``use_gso``: 0/False plain sendmmsg, 1/True UDP_SEGMENT.
+    ``trace_id`` stamps the egress span for session correlation (the
+    engine passes the stream's session trace)."""
     lib = _load()
     assert lib is not None
     assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
@@ -288,8 +291,10 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         ring_data.shape[0], ring_data.shape[1],
         _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
         dests, len(dests), ops, n_ops, int(use_gso))
-    TRACER.end("native.egress", t0, cat="native", ops=n_ops, sent=int(r),
-               gso=bool(use_gso))
+    span_args = {"ops": n_ops, "sent": int(r), "gso": bool(use_gso)}
+    if trace_id is not None:
+        span_args["trace_id"] = trace_id
+    TRACER.end("native.egress", t0, cat="native", **span_args)
     return r
 
 

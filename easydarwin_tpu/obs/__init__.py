@@ -1,19 +1,26 @@
-"""Unified observability layer: metrics registry + span tracer.
+"""Unified observability layer: metrics + spans + events + flight boxes.
 
 ``obs.families.REGISTRY`` is the process-wide registry the REST
 ``/metrics`` endpoint exposes; ``obs.trace.TRACER`` is the span ring
-``command=trace`` dumps.  See ARCHITECTURE.md "Observability".
+``command=trace`` dumps; ``obs.events.EVENTS`` is the structured event
+log every lifecycle transition emits into; ``obs.flight.FLIGHT`` holds
+the per-session crash black boxes (``command=flight`` /
+``GET /api/v1/sessions/<id>/trace``).  See ARCHITECTURE.md
+"Observability".
 """
 
+from .events import EVENTS, EventLog  # noqa: F401
 from .families import (  # noqa: F401  (re-exported inventory)
     EGRESS_BYTES, EGRESS_EAGAIN, EGRESS_GSO_SEGMENTS, EGRESS_GSO_SUPERS,
     EGRESS_PACKETS, EGRESS_SENDMMSG_CALLS, EGRESS_SENDTO_CALLS,
-    EGRESS_SEND_ERRORS, INGEST_BYTES, INGEST_DATAGRAMS,
+    EGRESS_SEND_ERRORS, EVENTS_DROPPED, EVENTS_EMITTED, EVENTS_INVALID,
+    EVENTS_SINK_FAILURES, FLIGHT_DUMPS, INGEST_BYTES, INGEST_DATAGRAMS,
     INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS,
     QOS_FRACTION_LOST, QOS_JITTER, QOS_THICKENS, QOS_THINS, REGISTRY,
     RELAY_INGEST_TO_WIRE, TPU_D2H_BYTES, TPU_H2D_BYTES,
     TPU_HEADERS_RENDERED, TPU_PACKETS_SENT, TPU_PARAM_REFRESHES,
     TPU_PASSES, TPU_PASS_SECONDS)
+from .flight import FLIGHT, FlightRecorder  # noqa: F401
 from .metrics import (  # noqa: F401
     TIME_BUCKETS, Counter, Gauge, Histogram, Registry)
 from .trace import TRACER, SpanTracer  # noqa: F401

@@ -1,0 +1,160 @@
+"""Bounded, thread-safe structured event log (JSON-lines records).
+
+The reference EasyDarwin's operational story for "why did this session
+die" was grep-the-error-log; aggregate counters (PR 1) cannot answer it
+either.  This module is the middle layer: every lifecycle transition —
+RTSP state machine steps, relay session/stream membership, broadcast
+source binds, pull-relay EOFs, reliable-UDP give-ups, cluster RPCs —
+emits one structured record carrying the correlation envelope
+(``session``/``stream``/``trace``) plus event-specific fields.
+
+Records are plain dicts appended to a bounded ring (oldest evicted,
+evictions counted in ``events_dropped_total``); rendering to JSON lines
+happens only at read time.  Registered sinks (the per-session flight
+recorder, ``obs.flight``) see every record synchronously, so a session's
+black box is complete at the moment it dies.
+
+Event names are ``layer.action`` (dotted snake_case); every name and its
+REQUIRED free-form fields are declared in ``SCHEMA`` below, which
+``tools/metrics_lint.py`` lints (naming convention, reserved envelope
+keys) and cross-checks against every ``emit("...")`` call site in the
+source tree.  Emitting an undeclared event or omitting a required field
+is tolerated at runtime (observability must never take the server down)
+but counted in ``events_invalid_total`` and flagged ``"invalid": true``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+#: envelope keys an event's free-form fields may never shadow
+RESERVED_KEYS = frozenset(("ts", "level", "event", "session", "stream",
+                           "trace", "invalid"))
+
+LEVELS = ("debug", "info", "warn", "error")
+
+#: default ring capacity (records); lifecycle events are rare relative to
+#: packets — 4096 holds hours of a busy server's session churn
+DEFAULT_CAPACITY = 4096
+
+#: event name -> REQUIRED free-form field names (the envelope —
+#: session/stream/trace — is always optional).  tools/metrics_lint.py
+#: validates this table and the call sites against it.
+SCHEMA: dict[str, tuple[str, ...]] = {
+    # RTSP state machine (server/rtsp.py)
+    "rtsp.announce": ("status",),
+    "rtsp.setup": ("status", "track", "mode"),
+    "rtsp.play": ("status",),
+    "rtsp.record": ("status",),
+    "rtsp.pause": ("status",),
+    "rtsp.teardown": ("status",),
+    "rtsp.error": ("method", "status"),
+    "rtsp.exception": ("error",),
+    "rtsp.close": ("reason",),
+    # relay session / stream lifecycle (relay/session.py, relay/stream.py)
+    "session.create": ("path", "streams"),
+    "session.remove": ("path",),
+    "stream.output_add": ("track", "outputs"),
+    "stream.output_remove": ("track", "outputs"),
+    # broadcast sources (relay/source.py)
+    "source.open": ("path",),
+    "source.close": ("path",),
+    # pull relays (relay/pull.py)
+    "pull.start": ("url",),
+    "pull.eof": ("url",),
+    "pull.stop": ("url", "packets"),
+    # reliable-UDP retransmit path (relay/reliable.py)
+    "reliable.expired": ("expired", "resent"),
+    # cluster RPCs (cluster/cms.py)
+    "cms.rpc": ("msg_type",),
+    "cms.register": ("serial",),
+    "cms.push_stream": ("serial", "url"),
+    # flight recorder (obs/flight.py)
+    "flight.dump": ("reason",),
+}
+
+
+class EventLog:
+    """Bounded ring of structured event records + fan-out to sinks."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._sinks: list = []
+        self.dropped = 0
+
+    # -- wiring ------------------------------------------------------
+    def add_sink(self, fn) -> None:
+        """Register ``fn(record: dict)`` called synchronously per emit
+        (the flight recorder registers here).  A raising sink is
+        swallowed and counted (``events_sink_failures_total``), never
+        removed — one transient MemoryError must not silently disable
+        the flight recorder forever."""
+        self._sinks.append(fn)
+
+    # -- write side --------------------------------------------------
+    def emit(self, event: str, *, level: str = "info",
+             session_id: str | None = None, stream: str | None = None,
+             trace_id: str | None = None, **fields) -> dict:
+        """Record one structured event; returns the record."""
+        from . import families
+        rec: dict = {"ts": round(time.time(), 6), "level": level,
+                     "event": event}
+        if session_id is not None:
+            rec["session"] = session_id
+        if stream is not None:
+            rec["stream"] = stream
+        if trace_id is not None:
+            rec["trace"] = trace_id
+        required = SCHEMA.get(event)
+        if (required is None or level not in LEVELS
+                or not set(required) <= fields.keys()
+                or not RESERVED_KEYS.isdisjoint(fields)):
+            rec["invalid"] = True
+            families.EVENTS_INVALID.inc()
+        for k in RESERVED_KEYS:
+            fields.pop(k, None)         # envelope keys stay authoritative
+        rec.update(fields)
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+                families.EVENTS_DROPPED.inc()
+            self._ring.append(rec)
+        families.EVENTS_EMITTED.inc(level=level if level in LEVELS
+                                    else "error")
+        for sink in tuple(self._sinks):
+            try:
+                sink(rec)
+            except Exception:
+                families.EVENTS_SINK_FAILURES.inc()
+        return rec
+
+    # -- read side ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def tail(self, n: int | None = None) -> list[dict]:
+        """Newest-last snapshot of the last ``n`` records (all if None;
+        n <= 0 is empty — recs[-0:] would be the whole ring)."""
+        with self._lock:
+            recs = list(self._ring)
+        if n is None:
+            return recs
+        return recs[-n:] if n > 0 else []
+
+    def dump_lines(self, n: int | None = None) -> list[str]:
+        """JSON-lines rendering (one compact JSON object per record)."""
+        return [json.dumps(r, separators=(",", ":"), default=str)
+                for r in self.tail(n)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+
+#: process-wide event log every instrumented layer emits into
+EVENTS = EventLog()

@@ -121,3 +121,24 @@ LOG_LINES = REGISTRY.counter(
     labels=("log", "level"))
 LOG_ROLLS = REGISTRY.counter(
     "log_rolls_total", "Rolling-log roll events, by log", labels=("log",))
+
+# -------------------------------------------------- structured events/flight
+EVENTS_EMITTED = REGISTRY.counter(
+    "events_emitted_total",
+    "Structured event-log records emitted, by level", labels=("level",))
+EVENTS_DROPPED = REGISTRY.counter(
+    "events_dropped_total",
+    "Structured event-log records evicted from the bounded ring before "
+    "being read (ring overflow)")
+EVENTS_INVALID = REGISTRY.counter(
+    "events_invalid_total",
+    "Structured events emitted with an undeclared name or missing a "
+    "schema-required field (recorded anyway, flagged invalid)")
+EVENTS_SINK_FAILURES = REGISTRY.counter(
+    "events_sink_failures_total",
+    "Exceptions raised by registered event sinks (the flight recorder); "
+    "the record still lands in the main ring and the sink stays wired")
+FLIGHT_DUMPS = REGISTRY.counter(
+    "flight_dumps_total",
+    "Per-session flight-recorder dumps written on abnormal teardown "
+    "(timeout sweep, uncaught exception, hard protocol error)")

@@ -187,8 +187,10 @@ class TpuFanoutEngine:
         obs.TPU_PASSES.inc()
         if sent:
             obs.TPU_PACKETS_SENT.inc(sent)
-        TRACER.add("engine.step", t0, dur, cat="tpu", sent=sent,
-                   outputs=len(flat))
+        span_args = {"sent": sent, "outputs": len(flat)}
+        if stream.trace_id is not None:
+            span_args["trace_id"] = stream.trace_id
+        TRACER.add("engine.step", t0, dur, cat="tpu", **span_args)
         return sent
 
     # -- native fast path --------------------------------------------------
@@ -329,16 +331,17 @@ class TpuFanoutEngine:
         dests = self._dests_for(fast)
         ops = native.ops_from_numpy(ops_np)
         used_gso = not self._gso_disabled
+        trace_id = stream.trace_id
         r = -1
         if used_gso:
             r = native.fanout_send_multi(
                 self.egress_fd, ring.data, ring.length, seq_off, ts_off,
-                ssrc, dests, ops, total, use_gso=True)
+                ssrc, dests, ops, total, use_gso=True, trace_id=trace_id)
         if r < 0:                           # GSO off/unsupported/failed
             used_gso = False
             r = native.fanout_send_multi(
                 self.egress_fd, ring.data, ring.length, seq_off, ts_off,
-                ssrc, dests, ops, total, use_gso=False)
+                ssrc, dests, ops, total, use_gso=False, trace_id=trace_id)
             if r >= 0 and not self._gso_disabled:
                 self._gso_strikes += 1      # GSO failed, plain path works
                 if self._gso_strikes >= 2:
@@ -369,7 +372,7 @@ class TpuFanoutEngine:
                 r2 = native.fanout_send_multi(
                     self.egress_fd, ring.data, ring.length, seq_off,
                     ts_off, ssrc, dests, native.ops_from_numpy(rem),
-                    total - r, use_gso=False)
+                    total - r, use_gso=False, trace_id=trace_id)
                 if r2 >= 0:
                     r += r2
                     hard = r < total and native.last_send_errno() not in (

@@ -18,9 +18,11 @@ One ``PullRelay`` = one upstream TCP-interleaved session feeding one
 from __future__ import annotations
 
 import asyncio
+import secrets
 import time
 from urllib.parse import urlparse
 
+from ..obs import EVENTS
 from ..utils.client import RtspClient
 from .session import RelaySession, SessionRegistry
 
@@ -45,6 +47,8 @@ class PullRelay:
         self.url = url
         self.registry = registry
         self.on_packet = on_packet          # pump-wake hook
+        #: correlation id for this pull's session/spans/events
+        self.trace_id = secrets.token_hex(8)
         self.client = RtspClient()
         self.session: RelaySession | None = None
         self.started_at = time.time()
@@ -70,7 +74,10 @@ class PullRelay:
             self._channel_map[2 * i + 1] = (st.track_id, True)
         self.session = self.registry.find_or_create(self.local_path, sd.raw)
         self.session.owner = self
+        self.session.set_trace(self.trace_id)
         self.alive = True
+        EVENTS.emit("pull.start", stream=self.local_path,
+                    trace_id=self.trace_id, url=self.url)
         self._forward_task = asyncio.create_task(
             self._forward_loop(), name=f"pull:{self.local_path}")
 
@@ -96,6 +103,10 @@ class PullRelay:
         except (asyncio.CancelledError, ConnectionError):
             pass
         finally:
+            if self.alive:              # upstream EOF, not a local stop()
+                EVENTS.emit("pull.eof", level="warn",
+                            stream=self.local_path, trace_id=self.trace_id,
+                            url=self.url)
             self.alive = False
             # release the session NOW, exactly as a pusher disconnect tears
             # its session down — a later ANNOUNCE must get a fresh session,
@@ -126,6 +137,9 @@ class PullRelay:
                 and self.session.owner is self):
             self.registry.remove(self.local_path)
         self.session = None
+        EVENTS.emit("pull.stop", stream=self.local_path,
+                    trace_id=self.trace_id, url=self.url,
+                    packets=self.client.stats.packets)
 
     def stats(self) -> dict:
         return {

@@ -254,6 +254,10 @@ class ReliableUdpOutput(RelayOutput):
         self.resender = PacketResender(self.tracker)
         import time as _time
         self._clock = clock or (lambda: int(_time.monotonic() * 1000))
+        #: correlation envelope (stamped by the RTSP layer at SETUP)
+        self.session_id: str | None = getattr(transport, "session_id", None)
+        self.trace_id: str | None = getattr(transport, "trace_id", None)
+        self._expired_reported = 0
 
     @property
     def rtcp_addr(self):
@@ -290,4 +294,14 @@ class ReliableUdpOutput(RelayOutput):
             if self.transport.send_bytes(data, is_rtcp=False) \
                     is WriteResult.OK:
                 n += 1
+        if self.resender.expired > self._expired_reported:
+            # packets past MAX_RESENDS gave up this sweep: that is real
+            # loss the session's black box must show (per-sweep, never
+            # per packet — this path rides the pump)
+            from ..obs import EVENTS
+            self._expired_reported = self.resender.expired
+            EVENTS.emit("reliable.expired", level="warn",
+                        session_id=self.session_id, trace_id=self.trace_id,
+                        expired=self.resender.expired,
+                        resent=self.resender.resent)
         return n

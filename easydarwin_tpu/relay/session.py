@@ -13,9 +13,11 @@ earlier (reference: audio bookmark resync on keyframe flag,
 
 from __future__ import annotations
 
+import secrets
 import time
 from dataclasses import dataclass, field
 
+from ..obs import EVENTS
 from ..protocol import sdp as sdp_mod
 from .output import RelayOutput
 from .stream import RelayStream, StreamSettings
@@ -31,9 +33,14 @@ class RelaySession:
         self.path = path
         self.description = description
         self.settings = settings or StreamSettings()
+        #: correlation id carried on every engine-pass / native-egress
+        #: span and lifecycle event of this source.  A feeder that owns a
+        #: trace (ANNOUNCE pusher, pull relay) re-stamps via set_trace().
+        self.trace_id = secrets.token_hex(8)
         self.streams: dict[int, RelayStream] = {}
         for info in description.streams:
             self.streams[info.track_id] = RelayStream(info, self.settings)
+        self.set_trace(self.trace_id)
         self.created_ms = now_ms()
         self.last_ingest_ms = self.created_ms
         self.pusher_alive = True
@@ -44,6 +51,14 @@ class RelaySession:
         #: ADOPTS the session (find_or_create returns the same object), so
         #: `registry.find(p) is session` alone cannot detect takeover.
         self.owner: object | None = None
+
+    def set_trace(self, trace_id: str) -> None:
+        """Adopt the feeder's trace id and propagate it to every stream
+        (the engine reads it off the stream when recording spans)."""
+        self.trace_id = trace_id
+        for st in self.streams.values():
+            st.trace_id = trace_id
+            st.session_path = self.path
 
     # -- ingest ------------------------------------------------------------
     def push(self, track_id: int, packet: bytes, *, is_rtcp: bool = False,
@@ -146,12 +161,18 @@ class SessionRegistry:
             sess = RelaySession(key, sdp_mod.parse(sdp_text), self.settings)
             self.sessions[key] = sess
             self.sdp_cache.set(key, sdp_text)
+            EVENTS.emit("session.create", stream=key,
+                        trace_id=sess.trace_id, path=key,
+                        streams=len(sess.streams))
         return sess
 
     def remove(self, path: str) -> None:
         key = sdp_mod._norm(path)
-        self.sessions.pop(key, None)
+        sess = self.sessions.pop(key, None)
         self.sdp_cache.pop(key)
+        if sess is not None:
+            EVENTS.emit("session.remove", stream=key,
+                        trace_id=sess.trace_id, path=key)
 
     def paths(self) -> list[str]:
         return sorted(self.sessions)

@@ -24,6 +24,7 @@ import os
 import socket
 import time
 
+from ..obs import EVENTS
 from ..protocol import sdp as sdp_mod
 from .session import RelaySession, SessionRegistry
 
@@ -175,6 +176,9 @@ class SdpFileRelaySource:
                     self.registry.remove(key)
                 return None
             self.sources[key] = src
+            EVENTS.emit("source.open", stream=key,
+                        trace_id=session.trace_id, path=key,
+                        transports=len(src.transports))
             return session
 
     def _make_cb(self, src: BroadcastSource, track_id: int, *, is_rtcp: bool):
@@ -189,6 +193,8 @@ class SdpFileRelaySource:
         src = self.sources.pop(sdp_mod._norm(path), None)
         if src is not None:
             src.close()
+            EVENTS.emit("source.close", stream=src.path,
+                        trace_id=src.session.trace_id, path=src.path)
             sess = self.registry.find(src.path)
             if sess is src.session and sess.owner is self:
                 self.registry.remove(src.path)

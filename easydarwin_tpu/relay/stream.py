@@ -75,6 +75,10 @@ class RelayStream:
         self.keyframe_id: int | None = None
         self._kf_run_active = False
         self.has_keyframe_update = False     # SetHasVideoKeyFrameUpdate
+        #: correlation envelope stamped by the owning RelaySession
+        #: (set_trace): the engine reads these when recording spans/events
+        self.trace_id: str | None = None
+        self.session_path: str | None = None
         self.buckets: list[list[RelayOutput]] = []
         #: outputs needing per-pass retransmit sweeps (reliable-UDP); kept
         #: separately so the pump pays nothing when none exist
@@ -183,8 +187,13 @@ class RelayStream:
         for bucket in self.buckets:
             if len(bucket) < self.settings.bucket_size:
                 bucket.append(output)
-                return
-        self.buckets.append([output])
+                break
+        else:
+            self.buckets.append([output])
+        obs.EVENTS.emit("stream.output_add", stream=self.session_path,
+                        trace_id=self.trace_id,
+                        session_id=getattr(output, "session_id", None),
+                        track=self.info.track_id, outputs=self.num_outputs)
 
     def remove_output(self, output: RelayOutput) -> bool:
         if output in self.tickable_outputs:
@@ -192,6 +201,11 @@ class RelayStream:
         for bucket in self.buckets:
             if output in bucket:
                 bucket.remove(output)
+                obs.EVENTS.emit(
+                    "stream.output_remove", stream=self.session_path,
+                    trace_id=self.trace_id,
+                    session_id=getattr(output, "session_id", None),
+                    track=self.info.track_id, outputs=self.num_outputs)
                 return True
         return False
 

@@ -162,6 +162,23 @@ def query(app, path: str, *, recurse: bool = False) -> tuple[int, Any]:
     return 200, _materialize(node)
 
 
+def flight_query(app, session_id: str) -> tuple[int, Any]:
+    """``command=flight&session=<id>`` — the session's black box.
+
+    A LIVE session answers with its current event ring + correlated span
+    summaries (no dump side effects, ``"live": true``); an abnormally
+    torn-down one answers with its stored flight dump.  Without
+    ``session=``, lists what is retrievable (live rings + kept dumps)."""
+    from ..obs import FLIGHT
+    if not session_id:
+        return 200, {"live": FLIGHT.live_sessions(),
+                     "dumps": sorted(FLIGHT.dumps)}
+    doc = FLIGHT.lookup(session_id)
+    if doc is None:
+        return 404, {"error": f"no flight data for session {session_id}"}
+    return 200, doc
+
+
 def set_pref(app, path: str, value: str) -> tuple[int, Any]:
     """``command=set`` — write one pref through the prefs AttrStore
     (``server/prefs/<name>`` or ``server/prefs/@<id>``; the reference

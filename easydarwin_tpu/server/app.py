@@ -97,6 +97,14 @@ class StreamingServer:
 
     async def start(self) -> None:
         self._running = True
+        # crash flight dumps land next to this server's rolling logs
+        # (written only when a dump happens; write failures swallowed).
+        # The recorder — like REGISTRY/TRACER/EVENTS — is process-global,
+        # so only a server actually STARTING claims the directory; a
+        # merely-constructed instance never redirects a running one's
+        import os
+        from ..obs import FLIGHT
+        FLIGHT.dump_dir = os.path.join(self.config.log_folder, "flight")
         # plugins register before the listeners accept anything, so their
         # filter/authorize hooks cover every request (the reference loads
         # modules before CreateListeners' ports go live too)

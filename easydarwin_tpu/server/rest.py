@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import json
+import re
 import secrets
 import time
 from urllib.parse import parse_qs, urlparse
@@ -22,6 +23,10 @@ from ..cluster import protocol as ep
 from .config import ServerConfig
 
 SERVER_NAME = "easydarwin-tpu/0.1"
+
+#: /api/v1/sessions/<rtsp-session-id>/trace (ids are token_hex, so the
+#: route()-level lowercasing is lossless)
+_SESSION_TRACE_RE = re.compile(r"^sessions/([0-9a-f]+)/trace$")
 
 
 class RestApi:
@@ -141,6 +146,14 @@ class RestApi:
             return self._login(params, headers)
         if not self._authorized(headers, params):
             return 401, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_UNAUTHORIZED)
+        # per-session trace retrieval: GET /api/v1/sessions/<id>/trace
+        # (the flight recorder's REST face; raw JSON, not the envelope,
+        # so operators can pipe it straight to jq / a file)
+        m = _SESSION_TRACE_RE.match(cmd)
+        if m is not None:
+            from . import admin
+            status, doc = admin.flight_query(self.app, m.group(1))
+            return status, json.dumps(doc, default=str), "application/json"
         if self.config.auth_enabled and self._mutates(cmd, params) \
                 and headers.get("x-token") not in self.tokens:
             # CSRF altitude guard on the STATE CHANGE itself, not just
@@ -380,6 +393,21 @@ class RestApi:
             # response body directly
             from ..obs import TRACER
             return 200, json.dumps(TRACER.dump()), "application/json"
+        if command == "flight":
+            # per-session black box (live ring or stored dump) — raw
+            # JSON for the same pipe-to-jq reason as command=trace
+            status, doc = admin.flight_query(
+                self.app, params.get("session", [""])[0])
+            return status, json.dumps(doc, default=str), "application/json"
+        if command == "events":
+            # structured event log tail as JSON lines (newest last)
+            from ..obs import EVENTS
+            try:
+                n = int(params.get("n", ["256"])[0])
+            except ValueError:
+                n = 256
+            return (200, "\n".join(EVENTS.dump_lines(n)) + "\n",
+                    "application/x-ndjson")
         if command == "set":
             status, payload = admin.set_pref(
                 self.app, path, params.get("value", [""])[0])

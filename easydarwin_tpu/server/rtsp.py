@@ -20,6 +20,7 @@ import secrets
 import time
 from dataclasses import dataclass, field
 
+from ..obs import EVENTS, FLIGHT, TRACER
 from ..protocol import rtsp, sdp
 from ..relay.session import RelaySession, SessionRegistry, now_ms
 from .config import ServerConfig
@@ -88,6 +89,14 @@ class RtspConnection:
         self.created_at = time.monotonic()
         peer = writer.get_extra_info("peername") or ("?", 0)
         self.client_ip = peer[0]
+        #: correlation id threaded through every span/event/flight record
+        #: this connection produces (and stamped onto its relay session /
+        #: outputs, so engine-pass and native-egress spans carry it too)
+        self.trace_id = secrets.token_hex(8)
+        #: why this connection died, when not a clean TEARDOWN/EOF —
+        #: set by the timeout sweep or the uncaught-exception catch;
+        #: non-None at close() triggers the flight-recorder dump
+        self.abnormal_reason: str | None = None
 
     # ------------------------------------------------------------------ io
     async def run(self) -> None:
@@ -114,6 +123,19 @@ class RtspConnection:
             pass
         except rtsp.RtspError as e:
             self._reply(rtsp.RtspResponse(e.status), cseq=0)
+            self.abnormal_reason = f"protocol: {e.status}"
+        except Exception as e:
+            # crash flight recorder: an uncaught handler exception must
+            # leave a black box — including the stack frames asyncio
+            # would have printed, or the crash is undiagnosable
+            import traceback
+            self.abnormal_reason = (f"exception: {type(e).__name__}: "
+                                    f"{e}"[:200])
+            EVENTS.emit("rtsp.exception", level="error",
+                        session_id=self.session_id, stream=self.path,
+                        trace_id=self.trace_id,
+                        error=f"{type(e).__name__}: {e}"[:200],
+                        tb=traceback.format_exc(limit=12)[-2000:])
         finally:
             await self.close()
 
@@ -241,12 +263,33 @@ class RtspConnection:
             self._reply(rtsp.RtspResponse(403), req.cseq)
             return
         self._last_response = None
+        t0 = TRACER.begin()
+        errored = False
         try:
             await handler(req)
         except rtsp.RtspError as e:
+            errored = True
             self._reply(rtsp.RtspResponse(e.status), req.cseq)
+            EVENTS.emit("rtsp.error", level="warn",
+                        session_id=self.session_id, stream=self.path,
+                        trace_id=self.trace_id, method=req.method,
+                        status=e.status)
+        finally:
+            TRACER.end(f"rtsp.{req.method.lower()}", t0, cat="rtsp",
+                       trace_id=self.trace_id)
+        if (not errored and req.method in self._EVENT_METHODS
+                and self._last_response is not None):
+            EVENTS.emit(f"rtsp.{req.method.lower()}",
+                        session_id=self.session_id, stream=self.path,
+                        trace_id=self.trace_id,
+                        status=self._last_response.status)
         if self._last_response is not None:
             mods.run_postprocess(self, req, self._last_response)
+
+    #: media lifecycle methods that emit a generic status event from the
+    #: dispatcher (SETUP emits its richer event inside _do_setup)
+    _EVENT_METHODS = frozenset(("ANNOUNCE", "PLAY", "RECORD", "PAUSE",
+                                "TEARDOWN"))
 
     async def _do_options(self, req: rtsp.RtspRequest) -> None:
         self._reply(rtsp.RtspResponse(200, {"Public": ALLOWED}), req.cseq)
@@ -276,6 +319,9 @@ class RtspConnection:
         self.relay = self.server.registry.find_or_create(
             path, req.body.decode("utf-8", "replace"))
         self.relay.owner = self         # ANNOUNCE takes ownership (adoption)
+        # ownership carries the trace: engine-pass / native-egress spans
+        # for this broadcast now correlate to THIS pusher connection
+        self.relay.set_trace(self.trace_id)
         self.path = self.relay.path
         self.is_pusher = True
         self.server.stats["pushers"] += 1
@@ -289,10 +335,18 @@ class RtspConnection:
         base, track_id = _extract_track(req.path())
         if self.session_id is None:
             self.session_id = secrets.token_hex(8)
-        if t.mode == "RECORD" or self.is_pusher:
+            FLIGHT.register(self.session_id, trace_id=self.trace_id,
+                            client_ip=self.client_ip, path=base)
+        mode = "record" if (t.mode == "RECORD" or self.is_pusher) else "play"
+        if mode == "record":
             await self._setup_record(req, base, track_id, t)
         else:
             await self._setup_play(req, base, track_id, t)
+        EVENTS.emit("rtsp.setup", session_id=self.session_id,
+                    stream=self.path or base, trace_id=self.trace_id,
+                    status=self._last_response.status
+                    if self._last_response else 0,
+                    track=track_id, mode=mode)
 
     async def _setup_record(self, req, base, track_id, t) -> None:
         if self.relay is None:
@@ -392,6 +446,10 @@ class RtspConnection:
                 old.udp_pair.close()
             elif egress is not None and hasattr(old.output, "rtcp_addr"):
                 egress.unregister(old.output, self)
+        # correlate this output's retransmit/QoS events back to the
+        # player's session (reliable-UDP emits through these)
+        out.trace_id = self.trace_id
+        out.session_id = self.session_id
         self.player_tracks[track_id] = _PlayerTrack(track_id, out, pair)
         if egress is not None and pair is None and hasattr(out, "rtcp_addr"):
             egress.register(out, self)
@@ -646,6 +704,17 @@ class RtspConnection:
         if self.closed:
             return
         self.closed = True
+        if self.session_id is not None:
+            EVENTS.emit("rtsp.close", session_id=self.session_id,
+                        stream=self.path, trace_id=self.trace_id,
+                        level="warn" if self.abnormal_reason else "info",
+                        reason=self.abnormal_reason or "eof")
+            if self.abnormal_reason and (self.player_tracks
+                                         or self.is_pusher):
+                # abnormal media-session death → freeze the black box
+                FLIGHT.dump(self.session_id, reason=self.abnormal_reason)
+            else:
+                FLIGHT.discard(self.session_id)
         self.server.modules.run_session_closing(self)
         self.server.on_session_closed(self)
         if self.vod_session is not None:
@@ -928,6 +997,9 @@ class RtspServer:
             if conn.is_pusher and self.relay_active(conn):
                 limit = max(limit, self.config.push_timeout_sec)
             if idle > limit:
+                conn.abnormal_reason = (conn.abnormal_reason
+                                        or f"timeout: idle {idle:.1f}s "
+                                           f"> {limit}s")
                 asyncio.get_event_loop().create_task(conn.close())
                 killed += 1
         return killed

@@ -60,7 +60,7 @@ def test_partial_gso_hard_error_retries_remainder_plain(monkeypatch):
     errno_box = {"v": 0}
 
     def fake_send_multi(fd, data, length, seq_off, ts_off, ssrc, dests,
-                        ops, n_ops, *, use_gso=True):
+                        ops, n_ops, *, use_gso=True, trace_id=None):
         calls.append((n_ops, use_gso))
         if use_gso:
             errno_box["v"] = 22            # EINVAL after a partial delivery

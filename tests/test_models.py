@@ -27,6 +27,22 @@ def test_relay_pipeline_modes_agree():
     assert int(aff["newest_keyframe"]) == int(hdr["newest_keyframe"])
 
 
+def test_relay_pipeline_spans_carry_trace_id():
+    """pipeline.step spans carry the session correlation key — per-call
+    trace_id= wins over the stamped default, absent means uncorrelated."""
+    from easydarwin_tpu.obs import TRACER
+    pipe = RelayPipeline(RelayPipelineConfig(window=64, subscribers=8))
+    args = pipe.example_args()
+    pipe(*args)
+    pipe.trace_id = "sess-default"
+    pipe(*args)
+    pipe(*args, trace_id="sess-override")
+    tids = [(a or {}).get("trace_id")
+            for name, _c, _t, _d, _tid, a in TRACER.records()
+            if name == "pipeline.step"][-3:]
+    assert tids == [None, "sess-default", "sess-override"]
+
+
 def test_relay_pipeline_pallas_backend_matches():
     cfg = RelayPipelineConfig(window=64, subscribers=8)
     a = RelayPipeline(cfg)

@@ -14,12 +14,15 @@ import json
 import pathlib
 import re
 import socket
+import threading
 
 import numpy as np
 import pytest
 
 from easydarwin_tpu import native, obs
-from easydarwin_tpu.obs import Counter, Gauge, Histogram, Registry, SpanTracer
+from easydarwin_tpu.obs import (Counter, EventLog, FlightRecorder, Gauge,
+                                Histogram, Registry, SpanTracer)
+from easydarwin_tpu.obs import events as events_mod
 
 
 # ------------------------------------------------------------- exposition
@@ -162,6 +165,28 @@ def test_metrics_lint_catches_violations():
     assert any("_total" in e for e in errs)
 
 
+def test_obs_lint_event_schema_clean():
+    """The real event vocabulary and every emit call site pass the lint
+    (the obs-lint half of the inventory contract)."""
+    mod = _load_lint()
+    assert mod.lint_events(events_mod.SCHEMA) == []
+    pkg = pathlib.Path(__file__).resolve().parents[1] / "easydarwin_tpu"
+    assert mod.lint_emit_sites(pkg, events_mod.SCHEMA) == []
+
+
+def test_obs_lint_catches_event_violations(tmp_path):
+    mod = _load_lint()
+    bad = {
+        "NotDotted": ("x",),                    # no layer dot, not lower
+        "rtsp.ok": ("Bad-Field", "ts"),         # bad name + envelope shadow
+    }
+    errs = mod.lint_events(bad, reserved=events_mod.RESERVED_KEYS)
+    assert len(errs) == 3
+    (tmp_path / "m.py").write_text('EVENTS.emit("un.declared", x=1)\n')
+    errs = mod.lint_emit_sites(tmp_path, events_mod.SCHEMA)
+    assert len(errs) == 1 and "un.declared" in errs[0]
+
+
 # -------------------------------------------------------- native parity
 def test_native_stats_parity_counted_send():
     if not native.available():
@@ -265,6 +290,170 @@ def test_tracer_ring_is_bounded():
     assert tr.dropped_hint == 42
     names = {e["name"] for e in tr.dump()["traceEvents"]}
     assert names == {f"s{i}" for i in range(42, 50)}
+    # clear() resets the drop counter too (ISSUE 2 satellite)
+    tr.clear()
+    assert len(tr) == 0 and tr.dropped_hint == 0
+
+
+def test_tracer_span_records_on_exception_path():
+    tr = SpanTracer(capacity=8)
+    with pytest.raises(ValueError):
+        with tr.span("boom", cat="test", n=1):
+            raise ValueError("nope")
+    evs = tr.dump()["traceEvents"]
+    assert len(evs) == 1 and evs[0]["name"] == "boom"
+    # the failed span is tagged with the error class for trace queries
+    assert evs[0]["args"] == {"n": 1, "error": "ValueError"}
+
+
+def test_tracer_concurrent_writers_dump_stable():
+    """Hammer the ring from several threads while dump()/clear() run:
+    no exceptions, exact drop accounting, every dump JSON-renderable."""
+    tr = SpanTracer(capacity=64)
+    n_threads, per_thread = 8, 2000
+    errs = []
+
+    def writer(k):
+        try:
+            for i in range(per_thread):
+                tr.add(f"t{k}", 0, i, cat="load", i=i)
+        except Exception as e:          # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for _ in range(50):                 # concurrent readers
+        json.dumps(tr.dump())
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(tr) == 64
+    # the lock makes drop accounting exact: every append past capacity
+    assert tr.dropped_hint == n_threads * per_thread - 64
+
+
+@pytest.mark.asyncio
+async def test_metrics_exposition_content_type_header():
+    """GET /metrics answers the Prometheus 0.0.4 content type through
+    the real REST route (no server sockets needed)."""
+    from easydarwin_tpu.server.config import ServerConfig
+    from easydarwin_tpu.server.rest import RestApi
+    api = RestApi(ServerConfig(), None)
+    status, body, ctype = await api.route("GET", "/metrics", {}, b"")
+    assert status == 200
+    assert ctype == "text/plain; version=0.0.4; charset=utf-8"
+    assert body.startswith("# HELP ") and body.endswith("\n")
+
+
+# ------------------------------------------------------------------ events
+def test_event_log_emit_ring_and_json_lines():
+    log = EventLog(capacity=4)
+    log.emit("session.create", stream="/live/a", trace_id="t1",
+             path="/live/a", streams=2)
+    rec = log.tail()[-1]
+    assert rec["event"] == "session.create" and rec["trace"] == "t1"
+    assert rec["stream"] == "/live/a" and "invalid" not in rec
+    line = json.loads(log.dump_lines()[-1])
+    assert line == rec
+    for i in range(10):                 # bounded: oldest evicted, counted
+        log.emit("session.remove", path=f"/p{i}")
+    assert len(log) == 4 and log.dropped == 7
+    assert [r["path"] for r in log.tail(2)] == ["/p8", "/p9"]
+    assert log.tail(0) == []            # not recs[-0:] == everything
+    log.clear()
+    assert len(log) == 0 and log.dropped == 0
+
+
+def test_event_log_schema_validation_flags_invalid():
+    log = EventLog()
+    before = obs.EVENTS_INVALID.value()
+    log.emit("no.such.event", foo=1)
+    assert log.tail()[-1]["invalid"] is True
+    log.emit("session.create")          # missing required path/streams
+    assert log.tail()[-1]["invalid"] is True
+    log.emit("session.create", path="/x", streams=1, level="bogus")
+    assert log.tail()[-1]["invalid"] is True
+    assert obs.EVENTS_INVALID.value() == before + 3
+    # envelope keys can never be shadowed by free-form fields
+    log.emit("session.remove", path="/x", ts="spoofed")
+    assert isinstance(log.tail()[-1]["ts"], float)
+
+
+def test_event_log_broken_sink_counted_not_fatal_not_dropped():
+    log = EventLog()
+    seen = []
+    before = obs.EVENTS_SINK_FAILURES.value()
+    log.add_sink(lambda rec: 1 / 0)
+    log.add_sink(seen.append)
+    log.emit("session.remove", path="/a")
+    log.emit("session.remove", path="/b")
+    # healthy sinks keep receiving; the broken one is counted every
+    # time, never silently unwired (a transient failure must not
+    # permanently disable the flight recorder)
+    assert [r["path"] for r in seen] == ["/a", "/b"]
+    assert obs.EVENTS_SINK_FAILURES.value() == before + 2
+    assert len(log._sinks) == 2
+
+
+# ------------------------------------------------------------------ flight
+def test_flight_recorder_ring_dump_and_lookup(tmp_path):
+    fr = FlightRecorder(dump_dir=str(tmp_path))
+    fr.register("sess1", trace_id="tr1", client_ip="1.2.3.4",
+                path="/live/a")
+    for i in range(300):                # ring keeps the newest ~256
+        fr.on_event({"session": "sess1", "event": "rtsp.play", "i": i})
+    fr.on_event({"session": "other", "event": "rtsp.play"})  # not ours
+    live = fr.lookup("sess1")
+    assert live["live"] is True and len(live["events"]) == 256
+    assert live["events"][-1]["i"] == 299
+    before = obs.FLIGHT_DUMPS.value()
+    doc = fr.dump("sess1", reason="timeout: idle")
+    assert obs.FLIGHT_DUMPS.value() == before + 1
+    assert doc["reason"] == "timeout: idle" and doc["trace"] == "tr1"
+    assert doc["meta"]["client_ip"] == "1.2.3.4"
+    # written to disk as loadable JSON, and retrievable post-mortem
+    on_disk = json.load(open(doc["file"]))
+    assert on_disk["session"] == "sess1"
+    assert fr.lookup("sess1")["reason"] == "timeout: idle"
+    assert fr.lookup("nope") is None
+    assert fr.dump("sess1", reason="again") is None   # already dumped
+    # clean teardown leaves nothing behind
+    fr.register("sess2")
+    fr.discard("sess2")
+    assert fr.lookup("sess2") is None and obs.FLIGHT_DUMPS.value() \
+        == before + 1
+
+
+def test_flight_dump_correlates_spans_by_trace_id(tmp_path):
+    from easydarwin_tpu.obs import TRACER
+    fr = FlightRecorder(dump_dir=str(tmp_path))
+    fr.register("s9", trace_id="deadbeef")
+    TRACER.end("engine.step", TRACER.begin(), cat="tpu",
+               trace_id="deadbeef", sent=3)
+    TRACER.end("engine.step", TRACER.begin(), cat="tpu",
+               trace_id="someone-else")
+    doc = fr.dump("s9", reason="exception: Boom")
+    assert [s["name"] for s in doc["spans"]] == ["engine.step"]
+    assert doc["spans"][0]["args"] == {"sent": 3}
+
+
+# ------------------------------------------------------- cluster traceparent
+def test_protocol_envelope_carries_trace_id():
+    from easydarwin_tpu.cluster import protocol as ep
+    m = ep.Message(ep.MSG_CS_GET_STREAM_REQ, 7, body={"Serial": "d1"},
+                   trace_id="abc123")
+    doc = json.loads(m.to_json())
+    assert doc["EasyDarwin"]["Header"]["TraceId"] == "abc123"
+    rt = ep.Message.parse(m.to_json())
+    assert rt.trace_id == "abc123" and rt.cseq == 7
+    # absent field parses to None and is omitted on the wire (stock
+    # EasyDarwin tooling compatibility)
+    plain = ep.Message(ep.MSG_CS_GET_STREAM_REQ)
+    assert "TraceId" not in json.loads(plain.to_json())["EasyDarwin"]["Header"]
+    assert ep.Message.parse(plain.to_json()).trace_id is None
+    assert "TraceId" in ep.ack(ep.MSG_SC_GET_STREAM_ACK, trace_id="x")
 
 
 def test_global_exposition_contains_required_families():

@@ -573,3 +573,124 @@ async def test_metrics_endpoint_admin_tree_and_trace():
         await pusher.close()
     finally:
         await app.stop()
+
+
+@pytest.mark.asyncio
+async def test_trace_correlation_and_flight_recorder_e2e():
+    """ISSUE 2 acceptance: one session's trace_id appears on spans at all
+    three hops (RTSP handler, engine pass, native egress), and an
+    abnormal teardown produces a flight dump retrievable via BOTH the
+    admin command and the per-session REST endpoint."""
+    import json
+    import socket as _socket
+
+    from easydarwin_tpu import native, obs
+
+    cfg = ServerConfig(rtsp_port=0, service_port=0, reflect_interval_ms=5,
+                       bind_ip="127.0.0.1", access_log_enabled=False,
+                       tpu_fanout=True, tpu_min_outputs=1)
+    app = await _start(cfg)
+    udp_rtp = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    udp_rtcp = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    try:
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/flight"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        await pusher.push_start(uri, PUSH_SDP)
+        pusher.push_packet(0, vid_pkt(0, 0, nal_type=5))
+
+        # UDP player on the shared egress → the engine's NATIVE fast path
+        for s in (udp_rtp, udp_rtcp):
+            s.bind(("127.0.0.1", 0))
+            s.setblocking(False)
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        await player.play_start(uri, tcp=False, client_ports=[
+            (udp_rtp.getsockname()[1], udp_rtcp.getsockname()[1])])
+        for i in range(1, 12):
+            pusher.push_packet(0, vid_pkt(i, i * 3000))
+        await asyncio.sleep(0.3)        # several engine passes
+
+        conns = {c.is_pusher: c for c in app.rtsp.connections}
+        push_conn, play_conn = conns[True], conns[False]
+        tid = push_conn.trace_id
+        assert app.registry.find("/live/flight").trace_id == tid
+
+        # --- hop correlation: the pusher session's trace_id on spans at
+        # the RTSP handler, the engine pass, and the native egress
+        by_hop = {}
+        for ev in obs.TRACER.dump()["traceEvents"]:
+            if (ev.get("args") or {}).get("trace_id") == tid:
+                by_hop.setdefault(ev["name"].split(".")[0], set()
+                                  ).add(ev["name"])
+        assert "rtsp.announce" in by_hop.get("rtsp", set())
+        assert "rtsp.setup" in by_hop["rtsp"]
+        assert "engine.step" in by_hop.get("engine", set())
+        if native.available():
+            assert "native.egress" in by_hop.get("native", set())
+
+        # the player's session events carry ITS trace end-to-end too
+        play_sid = play_conn.session_id
+        assert play_sid is not None
+
+        # --- abnormal teardown: the sweep reaps the idle player and the
+        # flight recorder freezes its black box
+        dumps_before = obs.FLIGHT_DUMPS.value()
+        play_conn.last_activity -= 10_000
+        assert app.rtsp.sweep_timeouts() >= 1
+        await asyncio.sleep(0.1)
+        assert obs.FLIGHT_DUMPS.value() == dumps_before + 1
+
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       app.rest.port)
+
+        async def get(path):
+            writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+            head = await reader.readuntil(b"\r\n\r\n")
+            status = int(head.split(b" ")[1])
+            clen = int([ln for ln in head.split(b"\r\n")
+                        if ln.lower().startswith(b"content-length")][0]
+                       .split(b":")[1])
+            return status, await reader.readexactly(clen)
+
+        # --- retrieval 1: the admin command
+        st, body = await get(f"/api/v1/admin?command=flight"
+                             f"&session={play_sid}")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["session"] == play_sid
+        assert doc["reason"].startswith("timeout")
+        assert doc["trace"] == play_conn.trace_id
+        events = {e["event"] for e in doc["events"]}
+        assert {"rtsp.setup", "rtsp.play", "rtsp.close"} <= events
+        assert any(e["event"] == "rtsp.close"
+                   and e["reason"].startswith("timeout")
+                   for e in doc["events"])
+
+        # --- retrieval 2: the per-session REST endpoint, same box
+        st, body = await get(f"/api/v1/sessions/{play_sid}/trace")
+        assert st == 200
+        assert json.loads(body)["events"] == doc["events"]
+        st, _b = await get("/api/v1/sessions/feedfeed/trace")
+        assert st == 404
+
+        # a LIVE session reads back its current ring, no dump minted
+        push_sid = push_conn.session_id
+        st, body = await get(f"/api/v1/sessions/{push_sid}/trace")
+        assert st == 200 and json.loads(body)["live"] is True
+        assert obs.FLIGHT_DUMPS.value() == dumps_before + 1
+
+        # --- clean teardown leaves no black box behind
+        await pusher.teardown(uri)
+        await asyncio.sleep(0.05)
+        st, _b = await get(f"/api/v1/sessions/{push_sid}/trace")
+        assert st == 404
+        assert obs.FLIGHT_DUMPS.value() == dumps_before + 1
+
+        writer.close()
+        await player.close()
+        await pusher.close()
+    finally:
+        udp_rtp.close()
+        udp_rtcp.close()
+        await app.stop()

@@ -1,4 +1,4 @@
-"""Metric-inventory lint: naming convention + help-text conformance.
+"""Obs-inventory lint: metric naming/help conformance + event schema.
 
 Imports the process-wide registry (``easydarwin_tpu.obs``) and asserts
 every registered family follows the convention documented in
@@ -12,17 +12,33 @@ ARCHITECTURE.md "Observability":
 * label names are snake_case and never the reserved ``le``;
 * histogram bucket bounds are strictly increasing and finite.
 
+It also lints the structured-event vocabulary (``obs.events.SCHEMA``):
+
+* event names are dotted snake_case (``layer.action``);
+* required field names are snake_case and never shadow the record
+  envelope (``ts``/``level``/``event``/``session``/``stream``/``trace``);
+* every ``emit("name", ...)`` call site in ``easydarwin_tpu/`` names a
+  declared event — an undeclared emit would be flagged ``invalid`` at
+  runtime, and this catches it at review time instead.
+
 Run standalone (``python tools/metrics_lint.py``, exit 1 on violations)
-or from the test suite (``tests/test_obs.py`` imports ``lint``).
+or from the test suite (``tests/test_obs.py`` imports ``lint``,
+``lint_events`` and ``lint_emit_sites``).
 """
 
 from __future__ import annotations
 
+import pathlib
 import re
 import sys
 
 NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count")
+
+EVENT_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+#: emit("event.name", ...) — the positional literal, plain or f-string
+#: (\s* spans newlines: a call wrapped after ``emit(`` still matches)
+EMIT_SITE_RE = re.compile(r"""\bemit\(\s*(f?)['"]([^'"]+)['"]""")
 
 
 def lint(registry) -> list[str]:
@@ -61,14 +77,67 @@ def lint(registry) -> list[str]:
     return errs
 
 
+def lint_events(schema: dict, reserved=None) -> list[str]:
+    """Validate the structured-event vocabulary table itself."""
+    if reserved is None:
+        from easydarwin_tpu.obs import events as ev
+        reserved = ev.RESERVED_KEYS
+    errs: list[str] = []
+    for name, fields in schema.items():
+        if not EVENT_NAME_RE.match(name):
+            errs.append(f"event {name}: not dotted snake_case "
+                        "(layer.action)")
+        for f in fields:
+            if not NAME_RE.match(f):
+                errs.append(f"event {name}: field {f!r} not snake_case")
+            if f in reserved:
+                errs.append(f"event {name}: field {f!r} shadows the "
+                            "record envelope")
+    return errs
+
+
+def lint_emit_sites(root: pathlib.Path, schema: dict) -> list[str]:
+    """Every ``emit("...")`` literal in the source tree must name a
+    declared event — the static counterpart of the runtime
+    ``events_invalid_total`` flag.  Whole-file scan, so calls wrapped
+    after ``emit(`` are covered; f-string sites (``emit(f"rtsp.{x}")``)
+    are checked as prefix families against the declared names."""
+    errs: list[str] = []
+    for py in sorted(root.rglob("*.py")):
+        text = py.read_text(encoding="utf-8", errors="replace")
+        for m in EMIT_SITE_RE.finditer(text):
+            line_no = text.count("\n", 0, m.start()) + 1
+            is_f, name = m.group(1), m.group(2)
+            if is_f:
+                # dynamic name: require the literal prefix up to the
+                # first placeholder to match at least one declared event
+                prefix = name.split("{")[0]
+                if not any(ev.startswith(prefix) for ev in schema):
+                    errs.append(f"{py.name}:{line_no}: f-string emit "
+                                f"prefix {prefix!r} matches no declared "
+                                "event")
+                continue
+            if not EVENT_NAME_RE.match(name):
+                continue                # not an event emit (no layer dot)
+            if name not in schema:
+                errs.append(f"{py.name}:{line_no}: emit of undeclared "
+                            f"event {name!r}")
+    return errs
+
+
 def main() -> int:
     sys.path.insert(0, ".")
     from easydarwin_tpu import obs
+    from easydarwin_tpu.obs import events as ev
     errs = lint(obs.REGISTRY)
+    errs += lint_events(ev.SCHEMA)
+    pkg = pathlib.Path(__file__).resolve().parents[1] / "easydarwin_tpu"
+    errs += lint_emit_sites(pkg, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:
-        print(f"metrics_lint: {len(obs.REGISTRY.families())} families OK")
+        print(f"metrics_lint: {len(obs.REGISTRY.families())} families, "
+              f"{len(ev.SCHEMA)} events OK")
     return 1 if errs else 0
 
 

@@ -5,9 +5,12 @@ recvmmsg ingest), interleaved players, UDP players on the shared egress
 (one with reliable-UDP, one sending NADU feedback), an HLS viewer
 pulling the temporal + requant renditions, and REST polling — then
 checks: no error-log growth, all players progressing, requant stats
-advancing, zero engine send errors.
+advancing, zero engine send errors, zero flight-recorder dumps (an
+abnormal session teardown during a clean soak IS the regression), and
+no structured-event ring overflow.
 
-Usage: python tools/soak.py [seconds]   (default 120)
+Usage: python tools/soak.py [--duration SECONDS]   (default 120;
+the bare positional form ``soak.py 120`` still works)
 """
 
 from __future__ import annotations
@@ -86,6 +89,16 @@ def check_metrics(scrapes: list[dict[str, float]]) -> list[str]:
               if k.startswith("relay_ingest_to_wire_seconds_count"))
     if lat == 0:
         errs.append("relay_ingest_to_wire_seconds histogram stayed empty")
+    if last.get("flight_dumps_total", 0) > 0:
+        errs.append(f"flight-recorder dumps during a clean soak: "
+                    f"{last['flight_dumps_total']:.0f} (a session died "
+                    f"abnormally — fetch command=flight for the black box)")
+    if last.get("events_dropped_total", 0) > 0:
+        errs.append(f"structured-event ring overflowed: "
+                    f"{last['events_dropped_total']:.0f} dropped")
+    if last.get("events_invalid_total", 0) > 0:
+        errs.append(f"schema-invalid events emitted: "
+                    f"{last['events_invalid_total']:.0f}")
     # cumulative families must be monotonic across scrapes (a reset
     # mid-run means double-registration or a counter bug)
     for a, b in zip(scrapes, scrapes[1:]):
@@ -340,6 +353,10 @@ async def soak(seconds: float) -> int:
             "wire_bytes": mlast.get("egress_bytes_total"),
             "sendmmsg_calls": mlast.get("egress_sendmmsg_calls_total"),
             "eagain": mlast.get("egress_eagain_total"),
+            "flight_dumps": mlast.get("flight_dumps_total"),
+            "events_emitted": sum(
+                v for k, v in mlast.items()
+                if k.startswith("events_emitted_total")),
             "ingest_to_wire_count": sum(
                 v for k, v in mlast.items()
                 if k.startswith("relay_ingest_to_wire_seconds_count")),
@@ -363,6 +380,20 @@ async def soak(seconds: float) -> int:
     return 1 if failures else 0
 
 
+def _parse_args(argv: list[str]) -> float:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="integration soak (see module docstring)")
+    ap.add_argument("--duration", type=float, default=None,
+                    metavar="SECONDS", help="soak length (default 120)")
+    ap.add_argument("seconds", nargs="?", type=float, default=None,
+                    help="legacy positional form of --duration")
+    ns = ap.parse_args(argv)
+    if ns.duration is not None and ns.seconds is not None:
+        ap.error("give --duration or the positional seconds, not both")
+    d = ns.duration if ns.duration is not None else ns.seconds
+    return 120.0 if d is None else d
+
+
 if __name__ == "__main__":
-    secs = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
-    raise SystemExit(asyncio.run(soak(secs)))
+    raise SystemExit(asyncio.run(soak(_parse_args(sys.argv[1:]))))
