@@ -7,6 +7,7 @@
 #include <cerrno>
 #include <cstring>
 #include <map>
+#include <ctime>
 #include <netinet/in.h>
 #include <netinet/udp.h>
 #include <sys/socket.h>
@@ -55,7 +56,7 @@ struct StatCells {
   std::atomic<int64_t> sendmmsg_calls{0}, sendto_calls{0}, send_packets{0},
       gso_supers{0}, gso_segments{0}, eagain_stops{0}, hard_errors{0},
       bytes_to_wire{0}, recvmmsg_calls{0}, recv_datagrams{0}, recv_bytes{0},
-      oversize_dropped{0};
+      oversize_dropped{0}, send_ns{0}, ingest_ns{0};
 };
 StatCells g_stat;
 
@@ -73,6 +74,25 @@ inline void note_send_stop(int err) {
   else
     stat_add(g_stat.hard_errors, 1);
 }
+
+inline int64_t mono_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000 + ts.tv_nsec;
+}
+
+// RAII bracket: adds the entry point's wall time to one timing counter on
+// every exit path (returns, EAGAIN stops, hard errors).  One
+// clock_gettime pair per CALL — noise next to the sendmmsg/recvmmsg the
+// call exists to issue — feeding the obs layer's egress_native phase
+// attribution (ed_fanout_send_multi's children each bracket themselves,
+// so the wrapper adds nothing and never double-counts).
+struct StatTimer {
+  std::atomic<int64_t> &cell;
+  int64_t t0;
+  explicit StatTimer(std::atomic<int64_t> &c) : cell(c), t0(mono_ns()) {}
+  ~StatTimer() { stat_add(cell, mono_ns() - t0); }
+};
 }  // namespace
 
 extern "C" {
@@ -95,6 +115,15 @@ void ed_get_stats(ed_stats *out) {
   out->recv_bytes = g_stat.recv_bytes.load(std::memory_order_relaxed);
   out->oversize_dropped =
       g_stat.oversize_dropped.load(std::memory_order_relaxed);
+  out->send_ns = g_stat.send_ns.load(std::memory_order_relaxed);
+  out->ingest_ns = g_stat.ingest_ns.load(std::memory_order_relaxed);
+}
+
+// Correct by construction: adding an ed_stats field updates this
+// automatically, so the Python-side ABI handshake can never desync from
+// the struct it guards (every field is int64_t by design).
+int32_t ed_stats_fields(void) {
+  return static_cast<int32_t>(sizeof(ed_stats) / sizeof(int64_t));
 }
 
 void ed_reset_stats(void) {
@@ -110,6 +139,8 @@ void ed_reset_stats(void) {
   g_stat.recv_datagrams.store(0, std::memory_order_relaxed);
   g_stat.recv_bytes.store(0, std::memory_order_relaxed);
   g_stat.oversize_dropped.store(0, std::memory_order_relaxed);
+  g_stat.send_ns.store(0, std::memory_order_relaxed);
+  g_stat.ingest_ns.store(0, std::memory_order_relaxed);
 }
 
 int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
@@ -120,6 +151,7 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
                            const ed_sendop *ops, int32_t n_ops) {
   g_stop_errno = 0;
   if (n_ops <= 0) return 0;
+  StatTimer timer(g_stat.send_ns);
   std::vector<mmsghdr> msgs(kSendBatch);
   std::vector<iovec> iovs(static_cast<size_t>(kSendBatch) * 2);
   std::vector<sockaddr_in> addrs(kSendBatch);
@@ -210,6 +242,7 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
                                const ed_sendop *ops, int32_t n_ops) {
   g_stop_errno = 0;
   if (n_ops <= 0) return 0;
+  StatTimer timer(g_stat.send_ns);
   const int send_flags = 0;
   // One super-send = one msg_hdr with [hdr|payload] iovec pairs for a run of
   // same-subscriber, same-size packets, plus a UDP_SEGMENT cmsg.
@@ -405,6 +438,7 @@ int32_t ed_scalar_baseline_send(int fd, const uint8_t *ring_data,
                                 const ed_dest *dest, int32_t n_outs,
                                 const ed_sendop *ops, int32_t n_ops) {
   g_stop_errno = 0;
+  StatTimer timer(g_stat.send_ns);
   uint8_t scratch[65536];
   for (int32_t i = 0; i < n_ops; ++i) {
     const ed_sendop &op = ops[i];
@@ -468,6 +502,7 @@ int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,
                       int64_t *ring_arrival, int32_t capacity,
                       int32_t slot_size, int64_t now_ms, int64_t *head,
                       int32_t max_pkts, int32_t *oversize_dropped) {
+  StatTimer timer(g_stat.ingest_ns);
   int32_t total = 0;      // datagrams ADMITTED into the ring
   int32_t processed = 0;  // datagrams consumed from the socket — this is
                           // what max_pkts bounds, so an oversize flood

@@ -48,10 +48,22 @@ typedef struct {
   int64_t recv_datagrams;   /* datagrams admitted into rings */
   int64_t recv_bytes;       /* bytes admitted into rings */
   int64_t oversize_dropped; /* kernel-truncated datagrams dropped */
+  /* Per-call CLOCK_MONOTONIC deltas (phase attribution, obs/profile.py):
+   * cumulative wall ns spent INSIDE the egress send entry points
+   * (ed_fanout_send_udp / _gso / ed_scalar_baseline_send — the _multi
+   * wrapper accumulates through its children, never double-counts) and
+   * the ring ingest.  Appended at the struct tail so older readers of
+   * the 12-field prefix keep working; ed_stats_fields() is the ABI
+   * handshake the Python bridge checks before trusting the tail. */
+  int64_t send_ns;          /* cumulative ns inside egress entry points */
+  int64_t ingest_ns;        /* cumulative ns inside ed_udp_ingest */
 } ed_stats;
 
 void ed_get_stats(ed_stats *out);
 void ed_reset_stats(void);
+/* Number of int64 fields in ed_stats — the newest symbol; its presence
+ * tells the ctypes bridge this library writes the timing tail. */
+int32_t ed_stats_fields(void);
 
 /* ---------------------------------------------------------------- egress */
 

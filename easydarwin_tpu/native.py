@@ -36,10 +36,13 @@ class SendOp(ctypes.Structure):
 
 
 #: field order MUST match struct ed_stats in csrc/edtpu_core.h
+#: (send_ns/ingest_ns are the clock_gettime timing tail; the loader
+#: refuses any library too old to write them — ed_stats_fields check)
 _STAT_FIELDS = ("sendmmsg_calls", "sendto_calls", "send_packets",
                 "gso_supers", "gso_segments", "eagain_stops",
                 "hard_errors", "bytes_to_wire", "recvmmsg_calls",
-                "recv_datagrams", "recv_bytes", "oversize_dropped")
+                "recv_datagrams", "recv_bytes", "oversize_dropped",
+                "send_ns", "ingest_ns")
 
 
 class EdStats(ctypes.Structure):
@@ -72,7 +75,19 @@ def _load():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
-        if not hasattr(lib, "ed_get_stats"):  # newest symbol
+        def _abi_ok(candidate) -> bool:
+            """The handshake proper: the library must write EXACTLY the
+            fields our EdStats buffer holds.  Fewer means a stale build
+            (the timing tail would read as zeros); more means a NEWER
+            library whose ed_get_stats would write past our buffer —
+            heap corruption, the one failure mode worse than refusing."""
+            if not hasattr(candidate, "ed_stats_fields"):
+                return False
+            candidate.ed_stats_fields.restype = ctypes.c_int32
+            candidate.ed_stats_fields.argtypes = []
+            return candidate.ed_stats_fields() == len(_STAT_FIELDS)
+
+        if not _abi_ok(lib):
             # stale prebuilt .so from an older source tree: rebuild in place
             # (make relinks to a fresh inode, so a second dlopen maps the
             # new library; the old one is never deleted, in case no
@@ -83,7 +98,7 @@ def _load():
                 lib = ctypes.CDLL(_SO)
             except OSError:
                 return None
-            if not hasattr(lib, "ed_get_stats"):
+            if not _abi_ok(lib):
                 return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -489,6 +504,10 @@ def _collect_native_stats() -> None:
     obs.INGEST_DATAGRAMS.set_to(s["recv_datagrams"])
     obs.INGEST_BYTES.set_to(s["recv_bytes"])
     obs.INGEST_OVERSIZE_DROPPED.set_to(s["oversize_dropped"])
+    # per-call clock_gettime deltas → cumulative busy-seconds counters
+    # (the native half of the egress_native phase attribution)
+    obs.EGRESS_BUSY_SECONDS.set_to(s["send_ns"] / 1e9)
+    obs.INGEST_BUSY_SECONDS.set_to(s["ingest_ns"] / 1e9)
 
 
 def _register_collector() -> None:

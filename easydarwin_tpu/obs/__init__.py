@@ -5,22 +5,30 @@
 ``command=trace`` dumps; ``obs.events.EVENTS`` is the structured event
 log every lifecycle transition emits into; ``obs.flight.FLIGHT`` holds
 the per-session crash black boxes (``command=flight`` /
-``GET /api/v1/sessions/<id>/trace``).  See ARCHITECTURE.md
-"Observability".
+``GET /api/v1/sessions/<id>/trace``); ``obs.profile.PROFILER`` is the
+always-on phase profiler behind ``relay_phase_seconds`` /
+``command=top`` / ``GET /debug/profile``; ``obs.slo.SloWatchdog``
+evaluates latency/drop burn-rate budgets on top of it.  See
+ARCHITECTURE.md "Observability" and "Phase attribution & SLO".
 """
 
 from .events import EVENTS, EventLog  # noqa: F401
 from .families import (  # noqa: F401  (re-exported inventory)
-    EGRESS_BYTES, EGRESS_EAGAIN, EGRESS_GSO_SEGMENTS, EGRESS_GSO_SUPERS,
-    EGRESS_PACKETS, EGRESS_SENDMMSG_CALLS, EGRESS_SENDTO_CALLS,
-    EGRESS_SEND_ERRORS, EVENTS_DROPPED, EVENTS_EMITTED, EVENTS_INVALID,
-    EVENTS_SINK_FAILURES, FLIGHT_DUMPS, INGEST_BYTES, INGEST_DATAGRAMS,
-    INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS,
+    EGRESS_BUSY_SECONDS, EGRESS_BYTES, EGRESS_EAGAIN, EGRESS_GSO_SEGMENTS,
+    EGRESS_GSO_SUPERS, EGRESS_PACKETS, EGRESS_SENDMMSG_CALLS,
+    EGRESS_SENDTO_CALLS, EGRESS_SEND_ERRORS, EVENTS_DROPPED, EVENTS_EMITTED,
+    EVENTS_INVALID, EVENTS_SINK_FAILURES, FLIGHT_DUMPS, INGEST_BUSY_SECONDS,
+    INGEST_BYTES, INGEST_DATAGRAMS, INGEST_OVERSIZE_DROPPED,
+    INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS, PROFILE_PHASE_DRIFT,
     QOS_FRACTION_LOST, QOS_JITTER, QOS_THICKENS, QOS_THINS, REGISTRY,
-    RELAY_INGEST_TO_WIRE, TPU_D2H_BYTES, TPU_H2D_BYTES,
-    TPU_HEADERS_RENDERED, TPU_PACKETS_SENT, TPU_PARAM_REFRESHES,
-    TPU_PASSES, TPU_PASS_SECONDS)
+    RELAY_INGEST_TO_WIRE, RELAY_PHASE_SECONDS, SLO_BUDGET_REMAINING,
+    SLO_VIOLATIONS, TPU_D2H_BYTES, TPU_H2D_BYTES, TPU_HEADERS_RENDERED,
+    TPU_PACKETS_SENT, TPU_PARAM_REFRESHES, TPU_PASSES, TPU_PASS_SECONDS)
 from .flight import FLIGHT, FlightRecorder  # noqa: F401
 from .metrics import (  # noqa: F401
     TIME_BUCKETS, Counter, Gauge, Histogram, Registry)
+from .profile import (  # noqa: F401
+    ENGINES, PHASES, PROFILER, PhaseProfiler, build_pprof,
+    phase_breakdown, phase_snapshot)
+from .slo import SloConfig, SloWatchdog  # noqa: F401
 from .trace import TRACER, SpanTracer  # noqa: F401

@@ -74,6 +74,10 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "cms.push_stream": ("serial", "url"),
     # flight recorder (obs/flight.py)
     "flight.dump": ("reason",),
+    # SLO watchdog (obs/slo.py): one per burn-window rising edge (latched,
+    # never per tick) / falling edge
+    "slo.violation": ("slo", "burn"),
+    "slo.recover": ("slo",),
 }
 
 

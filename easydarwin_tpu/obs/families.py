@@ -31,6 +31,33 @@ RELAY_INGEST_TO_WIRE = REGISTRY.histogram(
     "scalar oracle)",
     labels=("engine",), buckets=TIME_BUCKETS)
 
+# ------------------------------------------------------- phase attribution
+#: per-pass stage decomposition of the relay hot path (obs/profile.py):
+#: label vocabulary is the CLOSED set obs.profile.PHASES / ENGINES —
+#: tools/metrics_lint.py rejects any child outside it
+RELAY_PHASE_SECONDS = REGISTRY.histogram(
+    "relay_phase_seconds",
+    "Duration of one named relay-pass phase (wake_to_pass queueing, h2d "
+    "staging, fused device_step, d2h param fetch, egress_native wire "
+    "scatter, rtcp_qos), by phase and engine — the always-on ingest->wire "
+    "latency attribution layer",
+    labels=("engine", "phase"), buckets=TIME_BUCKETS)
+PROFILE_PHASE_DRIFT = REGISTRY.counter(
+    "profile_phase_drift_total",
+    "Passes whose summed phase durations disagreed with the bracketing "
+    "pass total beyond tolerance (instrumentation covering different "
+    "work than the pass timer — a profiler bug, not a server bug)")
+
+# -------------------------------------------------------------- SLO watchdog
+SLO_VIOLATIONS = REGISTRY.counter(
+    "slo_violations_total",
+    "Multi-window burn-rate violations raised by the SLO watchdog, by "
+    "objective", labels=("slo",))
+SLO_BUDGET_REMAINING = REGISTRY.gauge(
+    "slo_budget_remaining_ratio",
+    "Fraction of the error budget left in the slow burn window per "
+    "objective (1 = untouched, <= 0 = exhausted)", labels=("slo",))
+
 # ------------------------------------------------------------ device engine
 TPU_PASS_SECONDS = REGISTRY.histogram(
     "tpu_pass_seconds",
@@ -85,6 +112,11 @@ EGRESS_EAGAIN = REGISTRY.counter(
 EGRESS_SEND_ERRORS = REGISTRY.counter(
     "egress_send_errors_total",
     "Native sends stopped by a hard per-datagram errno (skipped past)")
+EGRESS_BUSY_SECONDS = REGISTRY.counter(
+    "egress_busy_seconds_total",
+    "Cumulative wall time spent inside the native egress entry points "
+    "(clock_gettime deltas in ed_stats; the denominator for per-call "
+    "egress cost and the native half of the egress_native phase)")
 
 # ------------------------------------------------------------ native ingest
 INGEST_RECVMMSG_CALLS = REGISTRY.counter(
@@ -98,6 +130,10 @@ INGEST_BYTES = REGISTRY.counter(
 INGEST_OVERSIZE_DROPPED = REGISTRY.counter(
     "ingest_oversize_dropped_total",
     "Datagrams dropped at ingest because they exceed the ring slot")
+INGEST_BUSY_SECONDS = REGISTRY.counter(
+    "ingest_busy_seconds_total",
+    "Cumulative wall time spent inside the native recvmmsg ring ingest "
+    "(clock_gettime deltas in ed_stats)")
 
 # ------------------------------------------------------------------- QoS
 QOS_FRACTION_LOST = REGISTRY.gauge(

@@ -108,15 +108,28 @@ class FlightRecorder:
             "spans": self._span_summaries(box.trace_id),
         }
 
-    def dump(self, session_id: str, *, reason: str) -> dict | None:
-        """Freeze a session's black box on abnormal teardown.  Returns
-        the document (None for an unregistered session)."""
+    def dump(self, session_id: str, *, reason: str,
+             keep_live: bool = False) -> dict | None:
+        """Freeze a session's black box.  Returns the document (None for
+        an unregistered session).
+
+        ``keep_live=False`` (abnormal teardown): the box is removed —
+        the session is gone.  ``keep_live=True`` (SLO quality flagging):
+        the dump is a SNAPSHOT and the live box stays registered, so the
+        recorder keeps recording and a later genuine crash still gets
+        its own dump — flagging must never disable the black box it
+        flags."""
         from . import families
         with self._lock:
-            box = self._live.pop(session_id, None)
+            if keep_live:
+                box = self._live.get(session_id)
+                events = list(box.ring) if box is not None else None
+            else:
+                box = self._live.pop(session_id, None)
+                events = None
         if box is None:
             return None
-        doc = self._doc(session_id, box, reason)
+        doc = self._doc(session_id, box, reason, events)
         path = None
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
@@ -141,6 +154,18 @@ class FlightRecorder:
                     stream=box.meta.get("path"), trace_id=box.trace_id,
                     reason=reason, file=path)
         return doc
+
+    def dump_path(self, path: str, *, reason: str) -> list[str]:
+        """Freeze every live session on stream ``path`` (the SLO
+        watchdog's abnormal-QUALITY flagging — the sessions are alive
+        and misbehaving, not torn down).  Returns the session ids
+        dumped; [] when nothing live matches."""
+        with self._lock:
+            sids = [sid for sid, box in self._live.items()
+                    if box.meta.get("path") == path]
+        return [sid for sid in sids
+                if self.dump(sid, reason=reason,
+                             keep_live=True) is not None]
 
     # -- retrieval ----------------------------------------------------
     def lookup(self, session_id: str) -> dict | None:

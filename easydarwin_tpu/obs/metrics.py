@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import math
 import threading
-from bisect import bisect_left
+from bisect import bisect_left, bisect_right
 from typing import Any, Callable, Iterable
 
 import numpy as np
@@ -79,6 +79,28 @@ def _labelstr(names: tuple, values: tuple) -> str:
 TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                 60.0)
+
+
+def bucket_quantile(counts, total: int, bounds, q: float) -> float:
+    """Estimated quantile from per-bucket (NOT cumulative) counts:
+    linear interpolation inside the bucket that crosses rank q; 0.0 on
+    empty.  The ONE copy of this math — histograms and the profiler's
+    per-session latency ladders (obs/profile.py) both resolve here, so
+    bucket semantics can never drift between them."""
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += int(c)
+    return bounds[-1]
 
 
 class _Family:
@@ -259,30 +281,34 @@ class Histogram(_Family):
     def total_count(self) -> int:
         return sum(st.count for st in self._states.values())
 
+    def total_sum(self) -> float:
+        return sum(st.sum for st in self._states.values())
+
+    def count_above(self, threshold: float) -> int:
+        """Observations above ``threshold``, merged over all label
+        children, at bucket resolution: only buckets whose (inclusive)
+        upper bound is <= threshold count as good, so a threshold BETWEEN
+        bounds counts the whole straddling bucket as *bad* — the
+        conservative direction for an SLO source.  Put thresholds on a
+        bucket bound for exact semantics.  Cumulative, O(buckets)."""
+        cut = bisect_right(self.bounds, threshold)
+        bad = 0
+        # list() is one C-level op: safe against a concurrent engine
+        # thread inserting a new label child mid-scan
+        for st in list(self._states.values()):
+            bad += st.count - sum(st.counts[:cut])
+        return bad
+
     def quantile(self, q: float) -> float:
         """Estimated quantile over ALL label children merged (status
-        mirror convenience): linear interpolation inside the bucket that
-        crosses rank q.  Returns 0.0 on an empty histogram."""
+        mirror convenience).  Returns 0.0 on an empty histogram."""
         merged = [0] * (len(self.bounds) + 1)
         total = 0
-        for st in self._states.values():
+        for st in list(self._states.values()):
             total += st.count
             for i, c in enumerate(st.counts):
                 merged[i] += c
-        if total == 0:
-            return 0.0
-        rank = q * total
-        cum = 0
-        for i, c in enumerate(merged):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
-                frac = (rank - cum) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            cum += c
-        return self.bounds[-1]
+        return bucket_quantile(merged, total, self.bounds, q)
 
     def expose_lines(self) -> list[str]:
         lines = []
@@ -316,20 +342,7 @@ class Histogram(_Family):
         return out
 
     def _child_quantile(self, st: _HistState, q: float) -> float:
-        if st.count == 0:
-            return 0.0
-        rank = q * st.count
-        cum = 0
-        for i, c in enumerate(st.counts):
-            if c == 0:
-                continue
-            if cum + c >= rank:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i] if i < len(self.bounds) \
-                    else self.bounds[-1]
-                return lo + (hi - lo) * min(max((rank - cum) / c, 0.0), 1.0)
-            cum += c
-        return self.bounds[-1]
+        return bucket_quantile(st.counts, st.count, self.bounds, q)
 
 
 class Registry:

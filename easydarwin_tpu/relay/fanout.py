@@ -21,7 +21,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..obs import TRACER
+from ..obs import PROFILER, TRACER
 from ..ops import device_ring
 from ..ops import fanout as fanout_ops
 from ..ops import parse as parse_ops
@@ -115,6 +115,18 @@ class TpuFanoutEngine:
         self._dring_epoch = 0               # arrival-ms epoch (int32 room)
         self.h2d_appended_bytes = 0
         self.h2d_window_equiv_bytes = 0     # what per-pass restaging costs
+        # per-pass phase attribution scratch (obs/profile.py), keyed
+        # (engine, phase): sub-steps accumulate brackets here; step()
+        # reports the merged dict once per engine
+        self._pass_phases: dict[tuple[str, str], int] = {}
+        self._pass_wire_bytes = 0
+        # first-trace latches PER JIT SHAPE: a cold pass's compile goes
+        # to the profiler's compile notes, NOT the phase histograms —
+        # one 100 ms+ outlier would own every phase mean/p99 forever.
+        # Keyed by the padded shapes because jax re-traces when a
+        # session grows past a power-of-two pad, and that recompile is
+        # just as much compile as the first one
+        self._traced_shapes: set[tuple] = set()
 
     # -- helpers -----------------------------------------------------------
     def _flat_outputs(self, stream: RelayStream):
@@ -153,12 +165,27 @@ class TpuFanoutEngine:
                 out.rewrite.base_src_ts = int(ring.timestamp[s])
 
     # -- the batch pass ----------------------------------------------------
+    def _phase_add(self, phase: str, dur_ns: int,
+                   engine: str = "native") -> None:
+        """Accumulate one phase bracket into the current pass (sub-steps
+        may hit a phase more than once per pass — GSO retry, params
+        refresh); ``step()`` hands the merged dict to the profiler ONCE
+        per pass, so histogram cost stays per-pass, never per-bracket.
+        Keyed (engine, phase): a mixed pass (native-addressed AND
+        TCP/meta outputs) must file each sub-path's brackets under its
+        own engine label, not whichever path happened to run."""
+        key = (engine, phase)
+        self._pass_phases[key] = self._pass_phases.get(key, 0) + dur_ns
+
     def step(self, stream: RelayStream, now_ms: int) -> int:
         t0 = time.perf_counter_ns()
         ring = stream.rtp_ring
         flat = self._flat_outputs(stream)
         if not flat or len(ring) == 0:
             return 0
+        profiled = PROFILER.enabled
+        self._pass_phases = {}
+        self._pass_wire_bytes = 0
         self._prime(stream, flat, now_ms)
         fast: list[tuple[RelayOutput, int]] = []
         slow: list[tuple[RelayOutput, int]] = []
@@ -178,7 +205,13 @@ class TpuFanoutEngine:
         if slow:
             sent += self._batch_header_step(stream, slow, now_ms)
         # RTCP relay + SR origination, identical to the scalar path
-        stream.relay_rtcp(now_ms)
+        if profiled:
+            pr = time.perf_counter_ns()
+            stream.relay_rtcp(now_ms)
+            self._phase_add("rtcp_qos", time.perf_counter_ns() - pr,
+                            engine="native" if fast else "batch")
+        else:
+            stream.relay_rtcp(now_ms)
         stream.stats.packets_out += sent
         self.steps += 1
         self.packets_sent += sent
@@ -187,6 +220,17 @@ class TpuFanoutEngine:
         obs.TPU_PASSES.inc()
         if sent:
             obs.TPU_PACKETS_SENT.inc(sent)
+        if profiled and self._pass_phases:
+            by_engine: dict[str, dict[str, int]] = {}
+            for (eng, ph), ns in self._pass_phases.items():
+                by_engine.setdefault(eng, {})[ph] = ns
+            first_slice = True      # session bytes/passes counted once
+            for eng, phases in by_engine.items():
+                PROFILER.account_pass(
+                    eng, dur, phases, path=stream.session_path,
+                    wire_bytes=self._pass_wire_bytes if first_slice else 0,
+                    count_pass=first_slice)
+                first_slice = False
         span_args = {"sent": sent, "outputs": len(flat)}
         if stream.trace_id is not None:
             span_args["trace_id"] = stream.trace_id
@@ -219,6 +263,7 @@ class TpuFanoutEngine:
         n_new = ring.head - self._dring_appended
         if n_new <= 0:
             return
+        t_h2d = time.perf_counter_ns() if PROFILER.enabled else 0
         ids, lengths, _f = ring.window_meta(self._dring_appended, n_new)
         b_pad = _pow2(len(ids), 16)
         prefix = np.zeros((b_pad, self.prefix_width), np.uint8)
@@ -235,6 +280,16 @@ class TpuFanoutEngine:
         self._dring_appended = ring.head
         self.h2d_appended_bytes += b_pad * (self.prefix_width + 8)
         obs.TPU_H2D_BYTES.inc(b_pad * (self.prefix_width + 8))
+        if t_h2d:
+            # staging + async append dispatch — the pass's host-side H2D
+            # cost (the device-side copy overlaps later phases)
+            dur = time.perf_counter_ns() - t_h2d
+            shape_key = ("append", b_pad)
+            if shape_key not in self._traced_shapes:
+                self._traced_shapes.add(shape_key)
+                PROFILER.note_compile("device_ring.append", dur / 1e9)
+            else:
+                self._phase_add("h2d", dur)
 
     def _device_params(self, fast, ring, now_ms: int):
         """Affine egress params from the device step over the RESIDENT
@@ -258,10 +313,26 @@ class TpuFanoutEngine:
             fanout_ops.pack_output_state([o for o, _ in fast]))
         res = device_ring.query(self._dring, state,
                                 np.int32(now_ms - self._dring_epoch))
+        # phase split: dispatching the fused query is device_step; the
+        # np.asarray fetches below BLOCK on the result crossing back —
+        # that wait is d2h, and charging it to device_step (or letting it
+        # leak into egress, as the pre-profiler timing did) is exactly
+        # the attribution error the phase layer exists to kill
+        t_dev = time.perf_counter_ns()
         seq_off = np.asarray(res["seq_off"])[None, :S]
         ts_off = np.asarray(res["ts_off"])[None, :S]
         ssrc = np.asarray(res["ssrc"])[None, :S]
         kf_abs = int(res["newest_keyframe_abs"])
+        t_d2h = time.perf_counter_ns()
+        if PROFILER.enabled:
+            shape_key = ("query", s_pad)
+            if shape_key not in self._traced_shapes:
+                self._traced_shapes.add(shape_key)
+                PROFILER.note_compile("device_ring.query",
+                                      (t_d2h - t0) / 1e9)
+            else:
+                self._phase_add("device_step", t_dev - t0)
+                self._phase_add("d2h", t_d2h - t_dev)
         self.last_newest_keyframe = (self._dring_base + kf_abs
                                      if kf_abs >= 0 else -1)
         self._params = (np.ascontiguousarray(seq_off),
@@ -283,6 +354,7 @@ class TpuFanoutEngine:
         from .. import native
         ring = stream.rtp_ring
         delay = stream.settings.bucket_delay_ms
+        t_win = time.perf_counter_ns() if PROFILER.enabled else 0
         start = min(o.bookmark for o, _ in fast)
         ids, lengths, _flags = ring.window_meta(start, ring.head - start)
         if len(ids) == 0:
@@ -291,6 +363,9 @@ class TpuFanoutEngine:
         idx = (ids % ring.capacity).astype(np.int32)
         arrivals = ring.arrival[idx]        # nondecreasing (ingest clock)
         valid = lengths >= 12
+        if t_win:
+            # extracting the host window view is part of staging it
+            self._phase_add("h2d", time.perf_counter_ns() - t_win)
         self._ring_sync(ring, now_ms)
         # counterfactual H2D of a design that re-stages the device's full
         # classification window every pass (what keeping the window fresh
@@ -299,6 +374,11 @@ class TpuFanoutEngine:
         live_window = ring.head - max(ring.tail, ring.head - ring.capacity)
         self.h2d_window_equiv_bytes += live_window * (self.prefix_width + 8)
         seq_off, ts_off, ssrc = self._device_params(fast, ring, now_ms)
+        # egress_native starts HERE: everything from params-in-hand to
+        # wire — per-output span selection, the scatter op list, and the
+        # native sendmmsg/GSO calls — is the egress stage (leaving the
+        # op-list numpy unphased put Σ(phases) ~15% under the pass total)
+        t_egress = time.perf_counter_ns() if PROFILER.enabled else 0
         # per-output eligible spans (numpy slices, no per-op Python)
         per_out = []                        # (out, hi, pids, slots, lens)
         total = 0
@@ -377,6 +457,15 @@ class TpuFanoutEngine:
                     r += r2
                     hard = r < total and native.last_send_errno() not in (
                         0, errno_mod.EAGAIN, errno_mod.EWOULDBLOCK)
+        # the packets are ON THE WIRE here: latency stamps below use this
+        # instant, not a fresh read after the accounting walk (which
+        # would bill our own bookkeeping to the network)
+        wire_ns = time.perf_counter_ns()
+        if t_egress:
+            # every native send this pass (op-list build, GSO try, plain
+            # fallback, GSO remainder retry) — the Python-side bracket;
+            # csrc's ed_stats.send_ns carries the in-library half
+            self._phase_add("egress_native", wire_ns - t_egress)
         # bookmark/stat accounting, exact under partial (EAGAIN) sends
         taken = 0
         hard_consumed = False
@@ -406,16 +495,18 @@ class TpuFanoutEngine:
                 sent_bytes = int(lens[:k].sum())
                 out.bytes_sent += sent_bytes
                 out.payload_octets += sent_bytes - 12 * k
+                self._pass_wire_bytes += sent_bytes
                 sent_slots.append(slots[:k])
         if sent_slots:
             # one vectorized observe per pass: perf_counter stamp at
-            # push_rtp minus now, per delivered (packet, subscriber) pair
-            now_ns = time.perf_counter_ns()
+            # push_rtp minus the send-return instant, per delivered
+            # (packet, subscriber) pair
             all_slots = (sent_slots[0] if len(sent_slots) == 1
                          else np.concatenate(sent_slots))
-            obs.RELAY_INGEST_TO_WIRE.observe_many(
-                (now_ns - ring.arrival_ns[all_slots]) / 1e9,
-                engine="native")
+            lat_s = (wire_ns - ring.arrival_ns[all_slots]) / 1e9
+            obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="native")
+            # per-session attribution (top-by-p99 in command=top)
+            PROFILER.account_latency(stream.session_path, lat_s)
         self.native_sent += r
         self.native_passes += 1
         return int(r)
@@ -431,16 +522,33 @@ class TpuFanoutEngine:
         ids, data, lengths, _flags = ring.window_arrays(start, ring.head - start)
         if len(ids) == 0:
             return 0
+        t_h2d = time.perf_counter_ns() if PROFILER.enabled else 0
         idx = ids % ring.capacity
         prefix = data[:, :self.prefix_width]
         age = (now_ms - ring.arrival[idx]).astype(np.int32)
         state = fanout_ops.pack_output_state([o for o, _ in flat])
         buckets = np.array([b for _, b in flat], dtype=np.int32)
 
+        t_dev = time.perf_counter_ns() if t_h2d else 0
         res = fanout_ops.relay_batch_step(
             prefix, lengths.astype(np.int32), age, state, buckets,
             np.int32(stream.settings.bucket_delay_ms))
-        headers = np.asarray(res["headers"])
+        t_d2h = time.perf_counter_ns() if t_h2d else 0
+        headers = np.asarray(res["headers"])     # blocks: the D2H wait
+        if t_h2d:
+            self._phase_add("h2d", t_dev - t_h2d, engine="batch")
+            shape_key = ("batch", prefix.shape, len(flat))
+            if shape_key not in self._traced_shapes:
+                # relay_batch_step re-traces per (window, outputs) shape
+                self._traced_shapes.add(shape_key)
+                PROFILER.note_compile(
+                    "relay_batch_step",
+                    (time.perf_counter_ns() - t_dev) / 1e9)
+            else:
+                self._phase_add("device_step", t_d2h - t_dev,
+                                engine="batch")
+                self._phase_add("d2h", time.perf_counter_ns() - t_d2h,
+                                engine="batch")
         # the whole window's prefixes+metadata crossed to the device and
         # the [S, P, 12] header block crossed back
         obs.TPU_H2D_BYTES.inc(prefix.nbytes + lengths.nbytes + age.nbytes
@@ -483,12 +591,13 @@ class TpuFanoutEngine:
                     out.packets_sent += 1
                     out.bytes_sent += 12 + len(payload)
                     out.payload_octets += len(payload)
+                    self._pass_wire_bytes += 12 + len(payload)
                     sent += 1
                     lat_ns.append(int(ring.arrival_ns[slot]))
             out.bookmark = pid
         if lat_ns:
             now_ns = time.perf_counter_ns()
-            obs.RELAY_INGEST_TO_WIRE.observe_many(
-                (now_ns - np.asarray(lat_ns, dtype=np.int64)) / 1e9,
-                engine="batch")
+            lat_s = (now_ns - np.asarray(lat_ns, dtype=np.int64)) / 1e9
+            obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="batch")
+            PROFILER.account_latency(stream.session_path, lat_s)
         return sent

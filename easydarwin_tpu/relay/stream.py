@@ -241,6 +241,7 @@ class RelayStream:
         stop-on-WouldBlock (bookmark holds for replay next pass)."""
         ring = self.rtp_ring
         sent = 0
+        bytes_out = 0
         lat_ns: list[int] = []          # ingest stamps of delivered packets
         for b_idx, bucket in enumerate(self.buckets):
             deadline = now_ms - b_idx * self.settings.bucket_delay_ms
@@ -269,14 +270,22 @@ class RelayStream:
                     pid += 1
                     if res is WriteResult.OK:
                         sent += 1
+                        bytes_out += len(data)
                         lat_ns.append(int(ring.arrival_ns[ring.slot(pid - 1)]))
                 out.bookmark = pid
         self.stats.packets_out += sent
         if lat_ns:
-            obs.RELAY_INGEST_TO_WIRE.observe_many(
-                (time.perf_counter_ns()
-                 - np.asarray(lat_ns, dtype=np.int64)) / 1e9,
-                engine="scalar")
+            lat_s = (time.perf_counter_ns()
+                     - np.asarray(lat_ns, dtype=np.int64)) / 1e9
+            obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="scalar")
+            # per-session attribution (command=top) works on the scalar
+            # oracle too — small fan-outs are still sessions operators ask
+            # about, and the SLO watchdog's offender lookup reads this
+            obs.PROFILER.account_latency(self.session_path, lat_s)
+            if self.session_path is not None:
+                obs.PROFILER.account_pass("scalar", 0, {},
+                                          path=self.session_path,
+                                          wire_bytes=bytes_out)
         self.relay_rtcp(now_ms)
         return sent
 

@@ -179,6 +179,20 @@ def flight_query(app, session_id: str) -> tuple[int, Any]:
     return 200, doc
 
 
+def profile_snapshot(app) -> dict:
+    """``command=top`` / ``GET /api/v1/profile`` — the live attribution
+    document: per-phase latency summaries, top sessions by wire bytes
+    and by p99 contribution (obs/profile.py), plus the SLO watchdog's
+    budget status when the server carries one (the raw profiler shape is
+    preserved so operators' jq pipelines survive a headless profiler)."""
+    from ..obs import PROFILER
+    doc = PROFILER.snapshot()
+    slo = getattr(app, "slo", None)
+    if slo is not None:
+        doc["slo"] = slo.status()
+    return doc
+
+
 def set_pref(app, path: str, value: str) -> tuple[int, Any]:
     """``command=set`` — write one pref through the prefs AttrStore
     (``server/prefs/<name>`` or ``server/prefs/@<id>``; the reference

@@ -74,6 +74,14 @@ class StreamingServer:
         self.mp3 = Mp3Service(self.config.movie_folder)
         self.rtsp.http_get_handler = self._rtsp_port_http_get
         self._pump_event = asyncio.Event()
+        #: first un-serviced wake's perf stamp — the wake→pass queueing
+        #: delay phase (obs/profile.py); None = no wake pending
+        self._wake_ns: int | None = None
+        #: SLO watchdog over the obs families; the pump's 1 Hz
+        #: maintenance block ticks it, violations flag flight recorders
+        from ..obs import PROFILER, SloWatchdog
+        self.slo = SloWatchdog(self.config.slo_config(),
+                               offender=PROFILER.top_offender)
         self._tasks: list[asyncio.Task] = []
         self._running = False
         self._restart_requested = False
@@ -169,6 +177,8 @@ class StreamingServer:
         self.rtsp.modules.run_reread_prefs(cfg)
 
     def _wake(self) -> None:
+        if self._wake_ns is None:
+            self._wake_ns = time.perf_counter_ns()
         self._pump_event.set()
 
     # ---------------------------------------------------------- pump loop
@@ -182,6 +192,14 @@ class StreamingServer:
 
     def _reflect_all(self) -> int:
         t = now_ms()
+        wake_ns, self._wake_ns = self._wake_ns, None
+        if wake_ns is not None:
+            # wake→pass queueing delay: ingest set the event at wake_ns,
+            # the loop got scheduled and reached the pass now — event-loop
+            # lag the per-pass phases cannot see but players feel
+            from ..obs import PROFILER
+            PROFILER.observe("wake_to_pass", "pump",
+                             time.perf_counter_ns() - wake_ns)
         sent = 0
         use_tpu = self.config.tpu_fanout
         for sess in list(self.registry.sessions.values()):
@@ -275,6 +293,12 @@ class StreamingServer:
                     sess.prune(t)
                     for st in sess.streams.values():
                         st.send_upstream_rr(t)  # 5 s pusher liveness RRs
+                if self.config.slo_enabled:
+                    try:
+                        self.slo.tick()
+                    except Exception as e:
+                        if self.error_log:
+                            self.error_log.warning(f"slo tick: {e!r}")
                 if self.presence is not None:
                     self.presence.set_load(sum(
                         s.num_outputs

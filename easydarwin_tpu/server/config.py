@@ -73,6 +73,17 @@ class ServerConfig:
     # per-IP cap (QTSSSpamDefenseModule num_conns_per_ip; 0 = unlimited,
     # matching the reference's Linux build which omits the module)
     max_connections_per_ip: int = 0
+    # --- SLO watchdog (obs/slo.py: multi-window burn-rate budgets over
+    # the obs families, evaluated once per pump maintenance tick)
+    slo_enabled: bool = True
+    slo_latency_objective_ms: float = 50.0   # a good packet hits the wire…
+    slo_latency_target: float = 0.99         # …within this for 99% of them
+    slo_drop_objective: float = 0.01         # budgeted bad-packet fraction
+    slo_fast_window_sec: float = 60.0
+    slo_slow_window_sec: float = 600.0
+    slo_fast_burn: float = 14.0              # SRE-workbook page-tier rates
+    slo_slow_burn: float = 2.0
+    slo_min_events: int = 200                # below this a window is noise
     # --- status (RunServer.cpp:248-483: -S console + server_status file)
     stats_interval_sec: int = 0        # 0 = console display off
     status_file_path: str = ""         # "" = no status file
@@ -127,6 +138,18 @@ class ServerConfig:
         return "\n".join(out) + "\n"
 
     # -- derived -----------------------------------------------------------
+    def slo_config(self):
+        from ..obs.slo import SloConfig
+        return SloConfig(
+            latency_objective_ms=self.slo_latency_objective_ms,
+            latency_target=self.slo_latency_target,
+            drop_objective=self.slo_drop_objective,
+            fast_window_s=self.slo_fast_window_sec,
+            slow_window_s=self.slo_slow_window_sec,
+            fast_burn=self.slo_fast_burn,
+            slow_burn=self.slo_slow_burn,
+            min_events=self.slo_min_events)
+
     def stream_settings(self):
         from ..relay.stream import StreamSettings
         return StreamSettings(

@@ -124,6 +124,13 @@ class RestApi:
             from .. import obs
             return (200, obs.REGISTRY.expose(),
                     "text/plain; version=0.0.4; charset=utf-8")
+        if path == "/debug/profile":
+            # span-ring flamegraph as a gzipped pprof Profile proto
+            # (`go tool pprof http://host:port/debug/profile` /
+            # speedscope); aggregation happens at request time, same
+            # read-only trust level as /metrics
+            from ..obs import build_pprof
+            return 200, build_pprof(), "application/octet-stream"
         if path == "/admin":
             if not self._authorized(headers, params):
                 return 401, "<h1>401</h1>"
@@ -203,6 +210,14 @@ class RestApi:
         token = params.get("token", [""])[0]
         self.tokens.discard(token)
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK)
+
+    def _cmd_profile(self, params: dict, body: bytes) -> tuple[int, str, str]:
+        """GET /api/v1/profile — the phase profiler's live snapshot
+        (same document as admin command=top; raw JSON, not the
+        envelope, so it pipes straight to jq)."""
+        from . import admin
+        return (200, json.dumps(admin.profile_snapshot(self.app),
+                                default=str), "application/json")
 
     def _cmd_getserverinfo(self, params: dict, body: bytes) -> tuple[int, str]:
         st = self.app.server_info()
@@ -408,6 +423,11 @@ class RestApi:
                 n = 256
             return (200, "\n".join(EVENTS.dump_lines(n)) + "\n",
                     "application/x-ndjson")
+        if command == "top":
+            # live phase/session attribution snapshot (raw JSON for the
+            # same pipe-to-jq reason as command=trace)
+            return (200, json.dumps(admin.profile_snapshot(self.app),
+                                    default=str), "application/json")
         if command == "set":
             status, payload = admin.set_pref(
                 self.app, path, params.get("value", [""])[0])

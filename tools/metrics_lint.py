@@ -21,9 +21,16 @@ It also lints the structured-event vocabulary (``obs.events.SCHEMA``):
   declared event — an undeclared emit would be flagged ``invalid`` at
   runtime, and this catches it at review time instead.
 
+It also enforces the phase-attribution contract (``lint_phases``): the
+``relay_phase_seconds`` label vocabulary is the CLOSED
+``obs.profile.PHASES``/``ENGINES`` set, and the time histograms the
+profiler/SLO layers read keep strictly-increasing bounds covering the
+full TIME_BUCKETS range.
+
 Run standalone (``python tools/metrics_lint.py``, exit 1 on violations)
 or from the test suite (``tests/test_obs.py`` imports ``lint``,
-``lint_events`` and ``lint_emit_sites``).
+``lint_events`` and ``lint_emit_sites``; ``tests/test_profile.py``
+imports ``lint_phases``).
 """
 
 from __future__ import annotations
@@ -74,6 +81,64 @@ def lint(registry) -> list[str]:
                 errs.append(f"{n}: non-finite bucket bound")
             if list(bounds) != sorted(set(bounds)):
                 errs.append(f"{n}: bucket bounds not strictly increasing")
+    return errs
+
+
+def lint_phases(registry, phases=None, engines=None) -> list[str]:
+    """Phase-attribution contract (ISSUE 3): the ``relay_phase_seconds``
+    family exists with the (engine, phase) label pair; every observed
+    child stays inside the CLOSED ``obs.profile.PHASES`` / ``ENGINES``
+    vocabulary (an open vocabulary would silently shard the histograms
+    and break every dashboard ratio); the vocabulary itself is
+    snake_case; and the time histograms the SLO/profiler layers read
+    (``relay_phase_seconds``, ``relay_ingest_to_wire_seconds``) keep
+    strictly-increasing bounds COVERING the shared TIME_BUCKETS range —
+    a narrower ladder would clip ``count_above`` budgets and quantiles."""
+    if phases is None or engines is None:
+        from easydarwin_tpu.obs.profile import ENGINES, PHASES
+        phases = phases or PHASES
+        engines = engines or ENGINES
+    from easydarwin_tpu.obs.metrics import TIME_BUCKETS
+    errs: list[str] = []
+    for v in tuple(phases) + tuple(engines):
+        if not NAME_RE.match(v):
+            errs.append(f"phase/engine vocabulary entry {v!r} not "
+                        "snake_case")
+    for fam_name in ("relay_phase_seconds", "relay_ingest_to_wire_seconds"):
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"{fam_name}: family missing from the registry")
+            continue
+        bounds = getattr(fam, "bounds", ())
+        if list(bounds) != sorted(set(bounds)):
+            errs.append(f"{fam_name}: bucket bounds not strictly "
+                        "increasing")
+        if not bounds or bounds[0] > TIME_BUCKETS[0] \
+                or bounds[-1] < TIME_BUCKETS[-1]:
+            errs.append(f"{fam_name}: bucket bounds do not cover the "
+                        f"TIME_BUCKETS range [{TIME_BUCKETS[0]}, "
+                        f"{TIME_BUCKETS[-1]}]")
+    fam = None
+    try:
+        fam = registry.get("relay_phase_seconds")
+    except KeyError:
+        pass
+    if fam is not None:
+        if tuple(fam.label_names) != ("engine", "phase"):
+            errs.append("relay_phase_seconds: labels must be "
+                        "(engine, phase), got "
+                        f"{tuple(fam.label_names)}")
+        else:
+            for engine, phase in getattr(fam, "_states", {}):
+                if phase not in phases:
+                    errs.append(f"relay_phase_seconds: observed phase "
+                                f"{phase!r} outside the closed set "
+                                f"{tuple(phases)}")
+                if engine not in engines:
+                    errs.append(f"relay_phase_seconds: observed engine "
+                                f"{engine!r} outside the closed set "
+                                f"{tuple(engines)}")
     return errs
 
 
@@ -130,9 +195,15 @@ def main() -> int:
     from easydarwin_tpu import obs
     from easydarwin_tpu.obs import events as ev
     errs = lint(obs.REGISTRY)
+    errs += lint_phases(obs.REGISTRY)
     errs += lint_events(ev.SCHEMA)
     pkg = pathlib.Path(__file__).resolve().parents[1] / "easydarwin_tpu"
     errs += lint_emit_sites(pkg, ev.SCHEMA)
+    # the SLO watchdog's vocabulary must be declared, not just emitted
+    # somewhere: the soak/test layers key on these exact names
+    for name in ("slo.violation", "slo.recover"):
+        if name not in ev.SCHEMA:
+            errs.append(f"event {name} missing from SCHEMA")
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -6,8 +6,10 @@ recvmmsg ingest), interleaved players, UDP players on the shared egress
 pulling the temporal + requant renditions, and REST polling — then
 checks: no error-log growth, all players progressing, requant stats
 advancing, zero engine send errors, zero flight-recorder dumps (an
-abnormal session teardown during a clean soak IS the regression), and
-no structured-event ring overflow.
+abnormal session teardown during a clean soak IS the regression), no
+structured-event ring overflow, live phase-attribution histograms
+(``relay_phase_seconds``), and zero SLO burn (no ``slo.violation``
+events counted, no ``slo_budget_remaining_ratio`` at or below zero).
 
 Usage: python tools/soak.py [--duration SECONDS]   (default 120;
 the bare positional form ``soak.py 120`` still works)
@@ -99,6 +101,25 @@ def check_metrics(scrapes: list[dict[str, float]]) -> list[str]:
     if last.get("events_invalid_total", 0) > 0:
         errs.append(f"schema-invalid events emitted: "
                     f"{last['events_invalid_total']:.0f}")
+    # phase attribution must be live: the pump observes wake_to_pass on
+    # every ingest-driven pass even on the scalar path, so an empty
+    # relay_phase_seconds means the profiler died or was disabled
+    phase_count = sum(v for k, v in last.items()
+                      if k.startswith("relay_phase_seconds_count"))
+    if phase_count == 0:
+        errs.append("relay_phase_seconds histograms stayed empty "
+                    "(phase profiler not recording)")
+    # SLO burn during a clean soak IS the regression: any violation
+    # event (counted per objective) or an exhausted error budget fails
+    slo_viol = sum(v for k, v in last.items()
+                   if k.startswith("slo_violations_total"))
+    if slo_viol > 0:
+        errs.append(f"SLO violations during a clean soak: {slo_viol:.0f} "
+                    "(fetch command=events / command=flight for the "
+                    "burn evidence)")
+    for k, v in last.items():
+        if k.startswith("slo_budget_remaining_ratio") and v <= 0:
+            errs.append(f"SLO error budget exhausted: {k} = {v}")
     # cumulative families must be monotonic across scrapes (a reset
     # mid-run means double-registration or a counter bug)
     for a, b in zip(scrapes, scrapes[1:]):
@@ -360,6 +381,13 @@ async def soak(seconds: float) -> int:
             "ingest_to_wire_count": sum(
                 v for k, v in mlast.items()
                 if k.startswith("relay_ingest_to_wire_seconds_count")),
+            "phase_counts": {
+                k[len("relay_phase_seconds_count"):]: v
+                for k, v in mlast.items()
+                if k.startswith("relay_phase_seconds_count")},
+            "slo_budget": {
+                k: v for k, v in mlast.items()
+                if k.startswith("slo_budget_remaining_ratio")},
             "native_ingest": {
                 s.native_ingest_pkts and "ok" or 0: s.native_ingest_pkts
                 for sess in app.registry.sessions.values()
