@@ -519,6 +519,156 @@ def measured_added_latency(addrs, *, n_outputs=256, seconds=3.0):
             ls[min(len(ls) - 1, int(len(ls) * 0.99))] * 1000, eng)
 
 
+def multi_source_latency(addrs, *, n_src=16, n_sub=16, seconds=6.0):
+    """ISSUE 4 multi-source section: per-wake added latency with the
+    cross-stream megabatch scheduler vs per-stream stepping, at
+    ``n_src`` concurrent sources × ``n_sub`` native-addressed
+    subscribers each.
+
+    Two identical stream sets are fed the same bursts and stepped
+    ALTERNATELY inside one loop (step order flipped per wake), so this
+    shared VM's load drift cancels the same way the headline's paired
+    ratios do.  Device passes per wake are counted from the engines'
+    own dispatch counters: per-stream = ring appends + param queries;
+    megabatch = stacked bucket passes + fallback queries."""
+    from easydarwin_tpu.obs import phase_breakdown, phase_snapshot
+    from easydarwin_tpu.protocol import sdp as sdp_mod
+    from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+    from easydarwin_tpu.relay.megabatch import MegabatchScheduler
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+
+    sdp_txt = ("v=0\r\ns=b\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+               "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+
+    def build_set():
+        rng = np.random.default_rng(11)
+        streams, engines = [], []
+        for s in range(n_src):
+            st = RelayStream(sdp_mod.parse(sdp_txt).streams[0],
+                             StreamSettings(bucket_delay_ms=0))
+            for i in range(n_sub):
+                o = CollectingOutput(
+                    ssrc=int(rng.integers(0, 2**32)),
+                    out_seq_start=int(rng.integers(0, 2**16)))
+                o.native_addr = addrs[(s * n_sub + i) % len(addrs)]
+                st.add_output(o)
+            streams.append(st)
+            engines.append(TpuFanoutEngine(egress_fd=send_fd))
+        return streams, engines
+
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
+    send_fd = send_sock.fileno()
+    set_mb = build_set()
+    set_ps = build_set()
+    sched = MegabatchScheduler()
+    pkt = bytes([0x80, 96]) + bytes(10) + bytes(PKT_BYTES - 12)
+    BURST = 4
+
+    def push(streams, seq, t):
+        for st in streams:
+            for b in range(BURST):
+                st.push_rtp(pkt[:2] + ((seq + b) & 0xFFFF).to_bytes(2, "big")
+                            + pkt[4:], t)
+        return seq + BURST
+
+    def step_mb(t):
+        pairs = list(zip(*set_mb))
+        sched.begin_wake(pairs, t)
+        for st, eng in pairs:
+            eng.step(st, t)
+        sched.end_wake(pairs, t)
+
+    def step_ps(t):
+        for st, eng in zip(*set_ps):
+            eng.megabatch_owned = False
+            eng.step(st, t)
+
+    # prime both paths (compile + GSO probe) outside the timed loop
+    t = int(time.monotonic() * 1000)
+    seq = push(set_mb[0], 0, t)
+    push(set_ps[0], 0, t)
+    step_mb(t)
+    step_ps(t)
+    sched.drain()
+    phase_base = phase_snapshot()
+    base_counts = (sched.passes,
+                   sum(e.device_param_refreshes + e.dring_appends
+                       for e in set_mb[1]),
+                   sum(e.device_param_refreshes + e.dring_appends
+                       for e in set_ps[1]))
+    lat_mb, lat_ps = [], []
+    wakes = 0
+    t_end = time.perf_counter() + seconds
+    while time.perf_counter() < t_end:
+        t = int(time.monotonic() * 1000)
+        t_push = time.perf_counter()
+        seq = push(set_mb[0], seq, t)
+        push(set_ps[0], seq - BURST, t)
+        # only the FIRST-stepped mode samples this wake (a true
+        # push→wire measure, uncontaminated by the other mode's step);
+        # the order flip gives both modes the same number of samples
+        # under the same conditions
+        if wakes % 2 == 0:
+            step_mb(t)
+            lat_mb.append(time.perf_counter() - t_push)
+            step_ps(t)
+        else:
+            step_ps(t)
+            lat_ps.append(time.perf_counter() - t_push)
+            step_mb(t)
+        wakes += 1
+        if wakes % 16 == 0:
+            for st in set_mb[0] + set_ps[0]:
+                st.prune(t)
+        time.sleep(0.002)
+    sched.drain()
+    send_sock.close()
+
+    def pct(xs, q):
+        if not xs:
+            return 0.0
+        ys = sorted(xs)
+        return ys[min(len(ys) - 1, int(len(ys) * q))] * 1000
+
+    mb_passes = sched.passes - base_counts[0]
+    mb_extra = (sum(e.device_param_refreshes + e.dring_appends
+                    for e in set_mb[1]) - base_counts[1])
+    ps_passes = (sum(e.device_param_refreshes + e.dring_appends
+                     for e in set_ps[1]) - base_counts[2])
+    phases = phase_breakdown(since=phase_base)
+    return {
+        "sources": n_src,
+        "subscribers_per_source": n_sub,
+        "wakes": wakes,
+        "streams_per_pass": sched.stats()["streams_per_pass"],
+        "megabatch_passes": mb_passes,
+        "megabatch_p50_added_ms": round(pct(lat_mb, 0.5), 3),
+        "megabatch_p99_added_ms": round(pct(lat_mb, 0.99), 3),
+        "per_stream_p50_added_ms": round(pct(lat_ps, 0.5), 3),
+        "per_stream_p99_added_ms": round(pct(lat_ps, 0.99), 3),
+        "megabatch_device_passes_per_wake": round(
+            (mb_passes + mb_extra) / max(wakes, 1), 3),
+        "per_stream_device_passes_per_wake": round(
+            ps_passes / max(wakes, 1), 3),
+        "megabatch_wire_mismatches": sched.mismatches,
+        "phase_ms": {ph: row["mean_ms"]
+                     for ph, row in sorted(phases.items())},
+        "method": (
+            "Two identical stream sets fed the same bursts, stepped "
+            "alternately (order flipped per wake) in one loop: "
+            "megabatch set under the cross-stream scheduler, per-stream "
+            "set with one engine pass per source.  added_ms = wall time "
+            "from the burst push to the mode's last engine-pass return, "
+            "sampled only on wakes where that mode steps first (so the "
+            "other mode's step never contaminates the sample).  "
+            "device_passes_per_wake counts actual dispatches "
+            "(stacked bucket passes + fallback queries vs per-stream "
+            "ring appends + param queries)."),
+    }
+
+
 def cpu_reference_rate(ring, lens, addrs, *, seconds=2.0) -> float:
     """Pure-Python scalar loop (round-1's flattering denominator — kept
     only as a labelled extra)."""
@@ -613,7 +763,7 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
     # the production harness (hls/requant.py): one shared pool, the
     # native walk releases the GIL — measure the AGGREGATE rate with
     # every core fed, which is what a multi-rung ladder gets
-    from easydarwin_tpu.hls.requant import pool_workers
+    from easydarwin_tpu.hls.requant import pool_workers, widen_affinity
     workers = pool_workers()
     agg_mbs_s = mbs_s
     if workers > 1:
@@ -622,6 +772,10 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
         stop = [False]
 
         def grind(i):
+            # un-inherit the TPU runtime's one-core main-thread pin, the
+            # same way the production pool's initializer does — without
+            # it every grinder stacks on one CPU and parallel == serial
+            widen_affinity()
             r = SliceRequantizer(6)
             for nal in nals[:2]:
                 r.transform_nal(nal)
@@ -833,6 +987,14 @@ def main():
         sum(row["mean_ms"] for row in phases_full.values()), 4)
     eng_extra["ingest_to_wire_mean_ms"] = round(itw_mean_ms, 4)
 
+    # ISSUE 4 multi-source section: megabatch vs per-stream at 16
+    # concurrent sources (the drain threads are still running, so the
+    # receiver queues never overflow)
+    ms_box = run_with_timeout(multi_source_latency, (addrs,), 90.0) \
+        if have_native else {}
+    ms_extra = ms_box.get("result",
+                          {"error": ms_box.get("error", "unavailable")})
+
     rq_extra = rq_box.get("result",
                           {"h264_requant_note":
                            rq_box.get("error", "unavailable")})
@@ -909,6 +1071,7 @@ def main():
                 "pass (scalar cost is per-op; rate is volume-invariant). "
                 "Loopback UDP GSO/GRO stands in for NIC UDP offload. "
                 "p50/p99_added_ms: see latency_method."),
+            "multi_source": ms_extra,
             **eng_extra,
             **rq_extra,
             **info,
@@ -936,6 +1099,17 @@ def main():
             "sustainable_1080p30_subscribers_per_source",
             "phase_ms", "phase_sum_mean_ms", "ingest_to_wire_mean_ms")
         if k in ex}
+    ms = ex.get("multi_source") or {}
+    compact_extra["multi_source"] = {
+        k: ms[k] for k in (
+            "sources", "streams_per_pass", "megabatch_p99_added_ms",
+            "per_stream_p99_added_ms", "megabatch_device_passes_per_wake",
+            "per_stream_device_passes_per_wake",
+            # the wire-mismatch scalar and the error marker MUST survive
+            # the compact projection: the trajectory gate reads only this
+            # line, and a stripped error would read as a malformed round
+            "megabatch_wire_mismatches", "error")
+        if k in ms}
     compact_extra["details_file"] = "bench_details.json"
     print(json.dumps({
         "metric": details["metric"],

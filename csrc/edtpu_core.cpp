@@ -56,7 +56,8 @@ struct StatCells {
   std::atomic<int64_t> sendmmsg_calls{0}, sendto_calls{0}, send_packets{0},
       gso_supers{0}, gso_segments{0}, eagain_stops{0}, hard_errors{0},
       bytes_to_wire{0}, recvmmsg_calls{0}, recv_datagrams{0}, recv_bytes{0},
-      oversize_dropped{0}, send_ns{0}, ingest_ns{0};
+      oversize_dropped{0}, send_ns{0}, ingest_ns{0}, stage_gather_ns{0},
+      staged_bytes{0};
 };
 StatCells g_stat;
 
@@ -117,6 +118,9 @@ void ed_get_stats(ed_stats *out) {
       g_stat.oversize_dropped.load(std::memory_order_relaxed);
   out->send_ns = g_stat.send_ns.load(std::memory_order_relaxed);
   out->ingest_ns = g_stat.ingest_ns.load(std::memory_order_relaxed);
+  out->stage_gather_ns =
+      g_stat.stage_gather_ns.load(std::memory_order_relaxed);
+  out->staged_bytes = g_stat.staged_bytes.load(std::memory_order_relaxed);
 }
 
 // Correct by construction: adding an ed_stats field updates this
@@ -141,6 +145,8 @@ void ed_reset_stats(void) {
   g_stat.oversize_dropped.store(0, std::memory_order_relaxed);
   g_stat.send_ns.store(0, std::memory_order_relaxed);
   g_stat.ingest_ns.store(0, std::memory_order_relaxed);
+  g_stat.stage_gather_ns.store(0, std::memory_order_relaxed);
+  g_stat.staged_bytes.store(0, std::memory_order_relaxed);
 }
 
 int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
@@ -283,7 +289,12 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
         if (errno == EINTR) continue;
         g_stop_errno = errno;
         stat_add(g_stat.sendmmsg_calls, 1);
-        note_send_stop(errno);
+        // EINVAL/EOPNOTSUPP on the UDP_SEGMENT path is "this kernel has
+        // no UDP GSO" — a capability probe outcome the caller handles by
+        // falling back to the plain path, not a destination failure;
+        // counting it into hard_errors would page operators on every
+        // boot of a pre-4.18 kernel
+        if (errno != EINVAL && errno != EOPNOTSUPP) note_send_stop(errno);
         if (errno != EAGAIN && errno != EWOULDBLOCK) flush_err = errno;
         int32_t ops_sent = 0;
         for (int i = 0; i < sent; ++i) ops_sent += supers[i].n_ops;
@@ -496,6 +507,43 @@ int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
     out_lens[i] = len;
   }
   return n_ops;
+}
+
+int32_t ed_stage_gather(const uint8_t *ring_data, const int32_t *ring_len,
+                        int32_t capacity, int32_t slot_size,
+                        const int32_t *slots, int32_t n_slots,
+                        int32_t prefix_width, uint8_t *out,
+                        int32_t out_stride, int32_t out_rows) {
+  if (n_slots < 0 || out_rows < n_slots || prefix_width <= 0 ||
+      prefix_width > slot_size || out_stride < prefix_width + 4)
+    return -EINVAL;
+  StatTimer timer(g_stat.stage_gather_ns);
+  for (int32_t i = 0; i < n_slots; ++i) {
+    int32_t slot = slots[i];
+    if (slot < 0 || slot >= capacity) return -EINVAL;
+    uint8_t *row = out + static_cast<size_t>(i) * out_stride;
+    // ring slots are zero-padded past their length (the ingest paths
+    // maintain that invariant), so a straight prefix_width copy never
+    // leaks a previous occupant's bytes
+    std::memcpy(row, ring_data + static_cast<size_t>(slot) * slot_size,
+                static_cast<size_t>(prefix_width));
+    uint32_t len = static_cast<uint32_t>(ring_len[slot]);
+    row[prefix_width + 0] = static_cast<uint8_t>(len);
+    row[prefix_width + 1] = static_cast<uint8_t>(len >> 8);
+    row[prefix_width + 2] = static_cast<uint8_t>(len >> 16);
+    row[prefix_width + 3] = static_cast<uint8_t>(len >> 24);
+    if (out_stride > prefix_width + 4)
+      std::memset(row + prefix_width + 4, 0,
+                  static_cast<size_t>(out_stride - prefix_width - 4));
+  }
+  // zero the pow2 padding rows so a reused double buffer never re-uploads
+  // a previous wake's packets as live rows
+  if (out_rows > n_slots)
+    std::memset(out + static_cast<size_t>(n_slots) * out_stride, 0,
+                static_cast<size_t>(out_rows - n_slots) * out_stride);
+  stat_add(g_stat.staged_bytes,
+           static_cast<int64_t>(n_slots) * (prefix_width + 4));
+  return n_slots;
 }
 
 int32_t ed_udp_ingest(int fd, uint8_t *ring_data, int32_t *ring_len,

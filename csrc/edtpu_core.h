@@ -57,6 +57,12 @@ typedef struct {
    * handshake the Python bridge checks before trusting the tail. */
   int64_t send_ns;          /* cumulative ns inside egress entry points */
   int64_t ingest_ns;        /* cumulative ns inside ed_udp_ingest */
+  /* Megabatch staging tail (second ABI bump, fields 15-16): the
+   * ed_stage_gather upload packer's cumulative cost and volume.  Same
+   * handshake discipline — ed_stats_fields() now reports 16 and the
+   * Python bridge refuses a library that disagrees. */
+  int64_t stage_gather_ns;  /* cumulative ns inside ed_stage_gather */
+  int64_t staged_bytes;     /* prefix+length bytes packed for upload */
 } ed_stats;
 
 void ed_get_stats(ed_stats *out);
@@ -162,6 +168,24 @@ int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
                          const ed_sendop *ops, int32_t n_ops,
                          uint8_t *out, int32_t out_stride,
                          int32_t *out_lens);
+
+/* ------------------------------------------------------- megabatch staging */
+
+/* Pack `n_slots` ring slots into consecutive rows of a contiguous upload
+ * buffer (the megabatch scheduler's H2D staging gather): row i receives
+ * the first `prefix_width` bytes of slot slots[i] followed by the slot's
+ * length as 4 little-endian bytes (the ops.fanout pack_window layout the
+ * device step decodes).  Rows [n_slots, out_rows) are zeroed so a
+ * pow2-padded stage never leaks a previous wake's bytes into the pad.
+ * out_stride must be >= prefix_width + 4.  Returns n_slots, or -EINVAL
+ * on bad slot/stride arguments.  One memcpy walk per stream per wake —
+ * the host half of double-buffered staging, counted into
+ * ed_stats.stage_gather_ns / staged_bytes. */
+int32_t ed_stage_gather(const uint8_t *ring_data, const int32_t *ring_len,
+                        int32_t capacity, int32_t slot_size,
+                        const int32_t *slots, int32_t n_slots,
+                        int32_t prefix_width, uint8_t *out,
+                        int32_t out_stride, int32_t out_rows);
 
 /* ---------------------------------------------------------------- ingest */
 

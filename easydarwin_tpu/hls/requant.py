@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from ..codecs.h264_requant import (SliceRequantizer, device_batch,
@@ -42,20 +43,73 @@ from .segmenter import HlsOutput
 #: from one OR many renditions run truly concurrently; the pure-Python
 #: fallback path still benefits from staying off the event loop
 _pool: ThreadPoolExecutor | None = None
+_workers_cache: int | None = None
+
+
+def widen_affinity() -> None:
+    """Undo a ONE-CORE pin on the calling thread.  The TPU runtime
+    plugin pins the thread that initializes it (on the bench/server box:
+    the main thread, at interpreter start via sitecustomize) to a single
+    core; threads spawned afterwards inherit that one-core mask, which
+    is how a 2-core host ran the whole requant pool on one CPU
+    (``workers=1``, ``parallel == serial`` in bench r04/r05).
+
+    Deliberately narrow: only the exact one-core signature is widened,
+    so an operator's multi-core confinement (``taskset -c 0,1``) is
+    preserved; the kernel intersects the widened mask with the cpuset,
+    so a cpuset quota is never escaped either.  What this CANNOT see is
+    a pure bandwidth quota (cgroup ``cpu.max`` on a big node) — size the
+    pool explicitly with ``EDTPU_REQUANT_WORKERS`` there (the override
+    also disables widening entirely)."""
+    if os.environ.get("EDTPU_REQUANT_WORKERS"):
+        return
+    try:
+        if len(os.sched_getaffinity(0)) == 1 and (os.cpu_count() or 1) > 1:
+            os.sched_setaffinity(0, range(os.cpu_count() or 1))
+    except (AttributeError, OSError, ValueError):
+        pass
 
 
 def pool_workers() -> int:
-    try:
-        return max(1, len(os.sched_getaffinity(0)))
-    except (AttributeError, OSError):
-        return max(1, os.cpu_count() or 1)
+    """Worker count for the shared requant pool: the number of CPUs the
+    cgroup actually allows, measured from a throwaway thread that first
+    widens its own affinity — so a runtime-pinned importing thread can
+    no longer collapse the pool to 1.  ``EDTPU_REQUANT_WORKERS``
+    overrides (sizing experiments / CI determinism).  Memoized: the
+    cgroup quota doesn't move at runtime."""
+    global _workers_cache
+    env = os.environ.get("EDTPU_REQUANT_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    if _workers_cache is not None:
+        return _workers_cache
+    box: list[int] = []
+
+    def probe() -> None:
+        widen_affinity()
+        try:
+            box.append(len(os.sched_getaffinity(0)))
+        except (AttributeError, OSError):
+            box.append(os.cpu_count() or 1)
+
+    t = threading.Thread(target=probe, name="hls-requant-probe")
+    t.start()
+    t.join()
+    _workers_cache = max(1, box[0] if box else 1)
+    return _workers_cache
 
 
 def _get_pool() -> ThreadPoolExecutor:
     global _pool
     if _pool is None:
+        # initializer: each worker un-inherits the importing thread's
+        # one-core pin, or the sized pool still stacks on a single CPU
         _pool = ThreadPoolExecutor(max_workers=pool_workers(),
-                                   thread_name_prefix="hls-requant")
+                                   thread_name_prefix="hls-requant",
+                                   initializer=widen_affinity)
     return _pool
 
 

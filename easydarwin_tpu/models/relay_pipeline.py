@@ -14,10 +14,12 @@ from __future__ import annotations
 import functools
 import os
 import time
+import warnings
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import obs
 from ..obs import PROFILER, TRACER
@@ -159,6 +161,63 @@ class RelayPipeline:
         if n_src == 1:
             return (prefix[0], length[0], age[0], out_state[0], buckets[0])
         return (prefix, length, age, out_state, buckets)
+
+
+# ------------------------------------------------------------- megabatch
+# The cross-stream stacked pass (relay/megabatch.py): every eligible
+# stream's staged window rides ONE device dispatch per shape bucket
+# instead of one per stream.  The leading axis is the STREAM axis; the
+# fused pack_window layout means the whole bucket is a single H2D
+# transfer.  The staging buffer is donated — once the upload lands, XLA
+# may reuse its HBM for the pass's temporaries/result instead of holding
+# both live (the scheduler's host-side double buffer is the only copy
+# that persists).
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def megabatch_window_step(window, out_state):
+    """Stacked relay device pass over a leading stream axis.
+
+    ``window``: [B, P, 96+4] uint8 (``ops.staging`` fused rows, pow2-
+    padded in every dimension) · ``out_state``: [B, S, STATE_COLS]
+    uint32 → packed egress params [B, 3·S + 1] uint32
+    (``seq_off[S] ∥ ts_off[S] ∥ ssrc[S] ∥ newest_keyframe``).
+
+    The window buffer is donated; XLA's "donated buffer was not usable"
+    warning is filtered ONCE at import (below) because the uint8 input
+    can never alias the uint32 output — the donation still releases the
+    staged upload the moment the pass consumes it, which is the point.
+    A per-call ``warnings.catch_warnings`` would mutate process-global
+    filter state on the pump hot path and is not thread-safe.
+    """
+    from ..ops.fanout import relay_affine_step_window
+    return relay_affine_step_window(window, out_state)
+
+
+warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
+
+
+def scatter_affine_segments(packed, n_subs):
+    """Segment scatter: split one stacked packed result back into
+    per-stream affine param sets.
+
+    ``packed``: the [B, 3·S_pad + 1] device result (any array-like) ·
+    ``n_subs``: per-stream REAL subscriber counts (<= S_pad; extra rows
+    beyond ``len(n_subs)`` are bucket padding and ignored).  Returns one
+    ``(seq_off[1, n], ts_off[1, n], ssrc[1, n], newest_kf)`` tuple per
+    stream — the exact ``TpuFanoutEngine._params`` shape, contiguous, so
+    the scheduler can install them without further massaging.
+    ``newest_kf`` is the per-stream newest-keyframe SLOT index within the
+    staged rows (-1 = none; the uint32 wire sentinel wraps back here)."""
+    arr = np.asarray(packed)
+    s_pad = (arr.shape[1] - 1) // 3
+    out = []
+    for row, n in zip(arr, n_subs):
+        out.append((
+            np.ascontiguousarray(row[None, 0:n]),
+            np.ascontiguousarray(row[None, s_pad:s_pad + n]),
+            np.ascontiguousarray(row[None, 2 * s_pad:2 * s_pad + n]),
+            int(row[3 * s_pad].astype(np.int32))))
+    return out
 
 
 def _pipeline_step(prefix, length, age_ms, out_state, buckets, *,

@@ -36,13 +36,15 @@ class SendOp(ctypes.Structure):
 
 
 #: field order MUST match struct ed_stats in csrc/edtpu_core.h
-#: (send_ns/ingest_ns are the clock_gettime timing tail; the loader
-#: refuses any library too old to write them — ed_stats_fields check)
+#: (send_ns/ingest_ns are the clock_gettime timing tail; stage_gather_ns/
+#: staged_bytes are the megabatch staging tail — second ABI bump; the
+#: loader refuses any library too old to write them — ed_stats_fields
+#: check)
 _STAT_FIELDS = ("sendmmsg_calls", "sendto_calls", "send_packets",
                 "gso_supers", "gso_segments", "eagain_stops",
                 "hard_errors", "bytes_to_wire", "recvmmsg_calls",
                 "recv_datagrams", "recv_bytes", "oversize_dropped",
-                "send_ns", "ingest_ns")
+                "send_ns", "ingest_ns", "stage_gather_ns", "staged_bytes")
 
 
 class EdStats(ctypes.Structure):
@@ -144,6 +146,11 @@ def _load():
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_int32),
                 ctypes.POINTER(ctypes.c_int32)]
+        lib.ed_stage_gather.restype = ctypes.c_int32
+        lib.ed_stage_gather.argtypes = [
+            u8p, i32p, ctypes.c_int32, ctypes.c_int32, i32p,
+            ctypes.c_int32, ctypes.c_int32, u8p, ctypes.c_int32,
+            ctypes.c_int32]
         lib.ed_get_stats.restype = None
         lib.ed_get_stats.argtypes = [ctypes.POINTER(EdStats)]
         lib.ed_reset_stats.restype = None
@@ -360,6 +367,26 @@ def h264_requant_slice(nal: bytes, *, width_mbs: int, height_mbs: int,
     return (out[:n].tobytes(), mbs.value, blocks.value) if n > 0 else None
 
 
+def stage_gather(ring_data: np.ndarray, ring_len: np.ndarray,
+                 slots: np.ndarray, prefix_width: int,
+                 out_rows_buf: np.ndarray) -> int:
+    """Pack ``slots``' ring prefixes + le32 lengths into the rows of
+    ``out_rows_buf`` ([rows, stride] uint8, C-contiguous) — the megabatch
+    scheduler's H2D staging gather (one memcpy walk per stream per wake;
+    padding rows are zeroed).  Returns rows written, negative on bad
+    arguments."""
+    lib = _load()
+    assert lib is not None
+    assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+    assert out_rows_buf.dtype == np.uint8 and out_rows_buf.flags.c_contiguous
+    slots32 = np.ascontiguousarray(slots, np.int32)
+    return lib.ed_stage_gather(
+        _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1], _i32(slots32), len(slots32),
+        prefix_width, _u8(out_rows_buf), out_rows_buf.shape[1],
+        out_rows_buf.shape[0])
+
+
 def last_send_errno() -> int:
     """Why the calling thread's last send stopped short (see C header)."""
     lib = _load()
@@ -508,6 +535,8 @@ def _collect_native_stats() -> None:
     # (the native half of the egress_native phase attribution)
     obs.EGRESS_BUSY_SECONDS.set_to(s["send_ns"] / 1e9)
     obs.INGEST_BUSY_SECONDS.set_to(s["ingest_ns"] / 1e9)
+    obs.STAGE_GATHER_BUSY_SECONDS.set_to(s["stage_gather_ns"] / 1e9)
+    obs.STAGE_GATHER_BYTES.set_to(s["staged_bytes"])
 
 
 def _register_collector() -> None:

@@ -84,6 +84,38 @@ TPU_PARAM_REFRESHES = REGISTRY.counter(
     "tpu_param_refreshes_total",
     "Device affine-param recomputes (membership/rebase state changes)")
 
+# -------------------------------------------------------- megabatch scheduler
+# The cross-stream relay scheduler (relay/megabatch.py): one shape-bucketed
+# stacked device pass per pump wake instead of one dispatch per stream.
+MEGABATCH_PASSES = REGISTRY.counter(
+    "megabatch_passes_total",
+    "Stacked cross-stream device passes dispatched by the megabatch "
+    "scheduler (one per shape bucket per pump wake)")
+MEGABATCH_STREAMS = REGISTRY.counter(
+    "megabatch_streams_total",
+    "Streams coalesced into megabatch passes (streams_total / passes_total "
+    "= mean streams per stacked pass)")
+MEGABATCH_FALLBACK = REGISTRY.counter(
+    "megabatch_fallback_total",
+    "Per-stream device param queries taken while a stream was megabatch-"
+    "owned (override missing or stale — the slow path the scheduler "
+    "replaces in steady state)")
+MEGABATCH_WIRE_MISMATCH = REGISTRY.counter(
+    "megabatch_wire_mismatch_total",
+    "Megabatch-computed affine egress params that disagreed with the host "
+    "arithmetic oracle for the same rewrite state (the result is discarded "
+    "and the stream falls back to per-stream stepping; any nonzero value "
+    "is a device/host divergence bug)")
+STAGE_GATHER_BYTES = REGISTRY.counter(
+    "stage_gather_bytes_total",
+    "Prefix+length bytes packed into contiguous upload buffers by the "
+    "native staging gather (csrc ed_stage_gather)")
+STAGE_GATHER_BUSY_SECONDS = REGISTRY.counter(
+    "stage_gather_busy_seconds_total",
+    "Cumulative wall time spent inside the native staging gather "
+    "(clock_gettime deltas in ed_stats; the native half of the "
+    "stage_gather phase)")
+
 # ------------------------------------------------------------ native egress
 # Mirrored from the C data-plane's cumulative ed_stats snapshot by the
 # collector native.py registers (see _EGRESS_FIELDS there).

@@ -57,13 +57,20 @@ from .metrics import TIME_BUCKETS, bucket_quantile
 from .trace import TRACER
 
 #: the CLOSED phase vocabulary (tools/metrics_lint.py rejects children of
-#: relay_phase_seconds outside this set)
+#: relay_phase_seconds outside this set).  ``stage_gather`` is the
+#: megabatch scheduler's host gather of ring slices into the contiguous
+#: upload buffer; ``h2d_overlap`` is the fetch wait on a stacked result
+#: that was NOT yet ready at harvest — the un-hidden remainder of
+#: transfer+compute (a ready result's fetch files under plain ``d2h``),
+#: so any weight here means double-buffering stopped hiding the device
 PHASES = ("wake_to_pass", "h2d", "device_step", "d2h", "egress_native",
-          "rtcp_qos")
+          "rtcp_qos", "stage_gather", "h2d_overlap")
 #: engines that record phases: the native sendmmsg fast path, the
 #: [S,P,12] batch-header path, the scalar oracle, the jitted model
-#: pipeline, the pump loop (wake→pass only) and test harnesses
-ENGINES = ("native", "batch", "scalar", "pipeline", "pump", "test")
+#: pipeline, the pump loop (wake→pass only), the cross-stream megabatch
+#: scheduler and test harnesses
+ENGINES = ("native", "batch", "scalar", "pipeline", "pump", "megabatch",
+           "test")
 
 #: sessions tracked for top-N attribution (LRU beyond this)
 MAX_SESSIONS = 256

@@ -1,0 +1,58 @@
+"""Batched window extraction for the megabatch scheduler's H2D staging.
+
+One stream's contribution to a stacked device pass is a run of ring
+packets packed into the fused ``pack_window`` layout (``ops.fanout``):
+``[prefix_width bytes | le32 length]`` per row, pow2-padded.  The gather
+runs through ``csrc ed_stage_gather`` when the native core is loaded
+(one memcpy walk, counted into ``stage_gather_busy_seconds_total``) and
+falls back to the numpy fancy-index copy otherwise — same bytes either
+way, so the device step never sees which host packed its input.
+
+The staging buffers themselves are owned by the scheduler
+(``relay.megabatch``), double-buffered per shape bucket: while the
+device/DMA reads the buffer dispatched at wake N, the host gathers wake
+N+1 into the alternate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .fanout import WINDOW_EXTRA
+from .parse import PARSE_PREFIX
+
+#: bytes per fused staging row (prefix + trailing le32 length)
+ROW_STRIDE = PARSE_PREFIX + WINDOW_EXTRA
+
+
+def gather_window(ring, start: int, count: int, out_rows: np.ndarray,
+                  prefix_width: int = PARSE_PREFIX) -> int:
+    """Pack ``count`` packets from absolute id ``start`` of ``ring`` (a
+    ``relay.ring.PacketRing``) into ``out_rows`` ([rows, stride] uint8,
+    C-contiguous, rows >= count) in the fused window layout; zero-fills
+    the padding rows.  Returns the number of live rows staged (clamped to
+    the ring's live window)."""
+    start = max(start, ring.tail)
+    stop = min(start + count, ring.head)
+    n = max(stop - start, 0)
+    if n > out_rows.shape[0]:
+        raise ValueError(f"staging buffer too small: {n} > "
+                         f"{out_rows.shape[0]} rows")
+    if n == 0:
+        out_rows[:] = 0
+        return 0
+    slots = (np.arange(start, stop) % ring.capacity).astype(np.int32)
+    from .. import native
+    if native.loaded():
+        r = native.stage_gather(ring.data, ring.length, slots,
+                                prefix_width, out_rows)
+        if r == n:
+            return n
+        # bad-argument fall-through: the numpy path below is always safe
+    out_rows[:n, :prefix_width] = ring.data[slots, :prefix_width]
+    lens = np.ascontiguousarray(ring.length[slots], "<u4")
+    out_rows[:n, prefix_width:prefix_width + 4] = \
+        lens[:, None].view(np.uint8)
+    out_rows[:n, prefix_width + 4:] = 0
+    out_rows[n:] = 0
+    return n

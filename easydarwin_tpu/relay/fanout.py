@@ -57,6 +57,17 @@ def _pow2(n: int, lo: int) -> int:
     return p
 
 
+def params_key(outputs) -> tuple:
+    """The affine-params cache key: one 5-tuple of rewrite state per fast
+    output, in fast-list order.  The single definition shared by the
+    per-stream engine and the megabatch scheduler — a scheduler-computed
+    key that didn't match the engine's would silently force the slow
+    path on every pass."""
+    return tuple((o.rewrite.ssrc, o.rewrite.base_src_seq,
+                  o.rewrite.base_src_ts, o.rewrite.out_seq_start,
+                  o.rewrite.out_ts_start) for o in outputs)
+
+
 def _native_mod():
     from .. import native
     return native if native.available() else None
@@ -113,8 +124,21 @@ class TpuFanoutEngine:
         self._dring_appended = 0            # host pid appended up to
         self._dring_base = 0                # host pid of device abs id 0
         self._dring_epoch = 0               # arrival-ms epoch (int32 room)
+        self.dring_appends = 0              # device append dispatches
         self.h2d_appended_bytes = 0
         self.h2d_window_equiv_bytes = 0     # what per-pass restaging costs
+        # -- megabatch scheduler hooks (relay/megabatch.py) --------------
+        #: True while the cross-stream scheduler owns this stream's
+        #: device work: step() skips the per-wake device-ring append (the
+        #: scheduler's stacked staging replaces it) and the scheduler
+        #: harvest installs params via ``megabatch_params``
+        self.megabatch_owned = False
+        #: (params_key, (seq_off, ts_off, ssrc)) installed by the last
+        #: scheduler harvest — consumed by ``_device_params`` when the
+        #: key still matches; a stale key falls back to the per-stream
+        #: device query (the slow path)
+        self.megabatch_params: tuple | None = None
+        self.megabatch_installs = 0
         # per-pass phase attribution scratch (obs/profile.py), keyed
         # (engine, phase): sub-steps accumulate brackets here; step()
         # reports the merged dict once per engine
@@ -129,6 +153,27 @@ class TpuFanoutEngine:
         self._traced_shapes: set[tuple] = set()
 
     # -- helpers -----------------------------------------------------------
+    def _native_ok(self) -> bool:
+        return (self.egress_fd is not None and self.egress_fd >= 0
+                and _native_mod() is not None)
+
+    @staticmethod
+    def _fast_eligible(out, native_ok: bool) -> bool:
+        """Native fast-path predicate — the ONE definition step() and the
+        megabatch scheduler share, so the scheduler stages params for
+        exactly the output set the engine will send through sendmmsg."""
+        return (native_ok and out.bookmark is not None
+                and getattr(out, "native_addr", None) is not None
+                and out.meta_field_ids is None
+                and out.thinning.passthrough())
+
+    def fast_outputs(self, stream: RelayStream) -> list:
+        """This stream's native-fast outputs in fast-list order (the
+        order ``params_key`` and the dest table are built in)."""
+        ok = self._native_ok()
+        return [out for out, _ in self._flat_outputs(stream)
+                if self._fast_eligible(out, ok)]
+
     def _flat_outputs(self, stream: RelayStream):
         flat: list[tuple[RelayOutput, int]] = []
         for b_idx, bucket in enumerate(stream.buckets):
@@ -189,13 +234,9 @@ class TpuFanoutEngine:
         self._prime(stream, flat, now_ms)
         fast: list[tuple[RelayOutput, int]] = []
         slow: list[tuple[RelayOutput, int]] = []
-        native_ok = (self.egress_fd is not None and self.egress_fd >= 0
-                     and _native_mod() is not None)
+        native_ok = self._native_ok()
         for out, b_idx in flat:
-            if (native_ok and out.bookmark is not None
-                    and getattr(out, "native_addr", None) is not None
-                    and out.meta_field_ids is None
-                    and out.thinning.passthrough()):
+            if self._fast_eligible(out, native_ok):
                 fast.append((out, b_idx))
             else:
                 slow.append((out, b_idx))
@@ -208,8 +249,21 @@ class TpuFanoutEngine:
         if profiled:
             pr = time.perf_counter_ns()
             stream.relay_rtcp(now_ms)
-            self._phase_add("rtcp_qos", time.perf_counter_ns() - pr,
-                            engine="native" if fast else "batch")
+            dt = time.perf_counter_ns() - pr
+            # file one slice per engine actually exercised this pass,
+            # splitting the bracket so a mixed pass neither hides the
+            # batch path's share under "native" nor double-counts the
+            # wall time in the session's phase_ns
+            engines = [e for e, ran in (("native", bool(fast)),
+                                        ("batch", bool(slow))) if ran]
+            share = dt // len(engines)
+            for i, e in enumerate(engines):
+                # last slice takes the division remainder so the summed
+                # slices equal the measured bracket exactly
+                self._phase_add("rtcp_qos",
+                                dt - share * (len(engines) - 1)
+                                if i == len(engines) - 1 else share,
+                                engine=e)
         else:
             stream.relay_rtcp(now_ms)
         stream.stats.packets_out += sent
@@ -278,6 +332,7 @@ class TpuFanoutEngine:
         self._dring = device_ring.append(
             self._dring, prefix, length, arrival, np.int32(len(ids)))
         self._dring_appended = ring.head
+        self.dring_appends += 1
         self.h2d_appended_bytes += b_pad * (self.prefix_width + 8)
         obs.TPU_H2D_BYTES.inc(b_pad * (self.prefix_width + 8))
         if t_h2d:
@@ -300,11 +355,24 @@ class TpuFanoutEngine:
         state changes (subscribe/unsubscribe/latch) — the common-case
         pass reuses the cached triples and spends nothing on the device.
         Shapes are padded to powers of two to bound jit specializations."""
-        key = tuple((o.rewrite.ssrc, o.rewrite.base_src_seq,
-                     o.rewrite.base_src_ts, o.rewrite.out_seq_start,
-                     o.rewrite.out_ts_start) for o, _ in fast)
+        key = params_key([o for o, _ in fast])
         if key == self._params_key:
             return self._params
+        mb = self.megabatch_params
+        if mb is not None and mb[0] == key:
+            # the cross-stream scheduler already computed this key's
+            # params in a stacked pass — install, no device round-trip
+            self._params = mb[1]
+            self._params_key = key
+            self.megabatch_installs += 1
+            return self._params
+        if self.megabatch_owned:
+            # owned stream whose override is missing/stale (fresh join,
+            # rebase latch mid-wake): per-stream device query is the
+            # fallback.  The resident ring was not synced this pass
+            # (the scheduler owns staging), so catch it up lazily first.
+            obs.MEGABATCH_FALLBACK.inc()
+            self._ring_sync(ring, now_ms)
         t0 = time.perf_counter_ns()
         S = len(fast)
         s_pad = _pow2(S, 8)
@@ -366,7 +434,12 @@ class TpuFanoutEngine:
         if t_win:
             # extracting the host window view is part of staging it
             self._phase_add("h2d", time.perf_counter_ns() - t_win)
-        self._ring_sync(ring, now_ms)
+        if not self.megabatch_owned:
+            # scheduler-owned streams skip the per-wake device append:
+            # the megabatch's stacked staging replaces it (the resident
+            # ring catches up lazily if a per-stream query is ever
+            # needed again)
+            self._ring_sync(ring, now_ms)
         # counterfactual H2D of a design that re-stages the device's full
         # classification window every pass (what keeping the window fresh
         # without a resident ring costs); h2d_appended_bytes is the O(new)

@@ -1,0 +1,441 @@
+"""Cross-stream megabatch relay scheduler (ISSUE 4 tentpole).
+
+The per-stream engine pays a fixed device-dispatch overhead per stream
+per pump wake (the PR 3 profiler put the per-pass floor at ~5 ms p50 by
+256 flows), so per-wake cost grows linearly with source count.  This
+scheduler coalesces every eligible stream's device work into **one
+shape-bucketed stacked pass per wake**:
+
+* **collect** — each megabatch-owned stream contributes its ring window
+  tail (the packets not yet staged) and its fast-output rewrite state;
+* **bucket** — streams are grouped by pow2-padded (window, subscriber)
+  shape so jit specializations stay latched per bucket shape, reusing
+  the PR 3 compile-note discipline (a bucket-growth retrace files a
+  compile note, never a phase sample);
+* **stage** — each bucket's windows are gathered into ONE contiguous
+  upload buffer (``csrc ed_stage_gather`` when native, numpy otherwise)
+  in the fused ``pack_window`` layout — a single H2D transfer per
+  bucket.  Buffers are **double-buffered** per bucket shape: the buffer
+  dispatched at wake N is never rewritten before its result was
+  harvested, so the host gathers wake N+1 while the device/DMA still
+  owns wake N's upload;
+* **dispatch** — one donated ``models.relay_pipeline.megabatch_window_
+  step`` call per bucket, result fetch started asynchronously;
+* **harvest** (next wake) — the packed result is scattered back into
+  per-stream affine param sets (``scatter_affine_segments``) and
+  installed into each engine's ``megabatch_params`` override.  Install
+  is keyed by the same ``params_key`` the engine checks, so a stream
+  whose membership changed mid-flight simply ignores the stale segment
+  and takes the per-stream query fallback for one wake.
+
+Correctness lever: the affine egress params depend ONLY on per-output
+rewrite state, never on packet content — so consuming a pass dispatched
+one wake earlier is byte-identical to computing it synchronously, and
+the overlap (device computes wake N while the host assembles wake N+1)
+costs nothing.  Every harvested segment is additionally checked against
+the host arithmetic oracle for its key; a disagreement increments
+``megabatch_wire_mismatch_total`` and the segment is discarded (the
+stream falls back to per-stream stepping), so a device/host divergence
+can never reach the wire.
+
+The harvest never blocks a wake: an in-flight result that is not ready
+yet simply stays in flight (engines keep their cached params — on a
+tunneled device with ~180 ms RTT the pipeline depth absorbs the
+latency), bounded by ``max_inflight`` outstanding passes.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import obs
+from ..models.relay_pipeline import (megabatch_window_step,
+                                     scatter_affine_segments)
+from ..obs import PROFILER, TRACER
+from ..ops import staging
+from ..ops.fanout import STATE_COLS, pack_output_state
+from .fanout import _pow2, params_key
+
+
+def _host_affine_params(key) -> tuple:
+    """The affine rewrite computed by plain host arithmetic from a
+    ``params_key`` — the oracle every harvested device segment is
+    checked against (same uint32 formulas as ``ops.fanout.
+    affine_params`` over ``pack_output_state``'s max(·, 0) clamping)."""
+    st = np.asarray(key, dtype=np.int64).reshape(-1, 5)
+    ssrc = (st[:, 0] & 0xFFFFFFFF).astype(np.uint32)
+    base_seq = np.maximum(st[:, 1], 0).astype(np.uint32)
+    base_ts = np.maximum(st[:, 2], 0).astype(np.uint32)
+    seq0 = (st[:, 3] & 0xFFFFFFFF).astype(np.uint32)
+    ts0 = (st[:, 4] & 0xFFFFFFFF).astype(np.uint32)
+    return ((seq0 - base_seq) & np.uint32(0xFFFF), ts0 - base_ts, ssrc)
+
+
+class _InFlight:
+    """One dispatched stacked pass awaiting harvest."""
+
+    __slots__ = ("result", "entries", "buf", "dispatch_ns")
+
+    def __init__(self, result, entries, buf, dispatch_ns):
+        self.result = result
+        #: per-row (stream, engine, key, n_fast, base_pid)
+        self.entries = entries
+        #: the host staging buffer this pass was uploaded from — held
+        #: until harvest so no later wake can rewrite it while the
+        #: device/DMA may still be reading it, then recycled
+        self.buf = buf
+        self.dispatch_ns = dispatch_ns
+
+
+class MegabatchScheduler:
+    """One per server; the pump calls ``begin_wake`` before the
+    per-stream step loop and ``end_wake`` after it."""
+
+    #: never stage more than this many packets per stream per pass (a
+    #: burst beyond it restages from the newest tail, mirroring the
+    #: per-stream resident ring's fell-behind restart)
+    MAX_STAGE_ROWS = 1024
+    #: outstanding stacked passes before staging pauses (tunneled-device
+    #: RTT absorption without unbounded queue growth)
+    MAX_INFLIGHT = 2
+    #: an in-flight pass older than this is force-fetched even if the
+    #: runtime cannot report readiness (safety valve, not the hot path)
+    FORCE_FETCH_NS = 2_000_000_000
+
+    def __init__(self):
+        self._tracked: dict[int, int] = {}     # id(stream) → staged head
+        #: id(stream) → (params_key, packed out_state row) — the packed
+        #: state is a pure function of the key, and the key comparison
+        #: is already paid every wake; skips the O(S) python pack loop
+        #: on unchanged membership
+        self._state_cache: dict[int, tuple] = {}
+        #: id(stream) → (fast, key) computed by this WAKE's prime scan;
+        #: _collect reuses it instead of re-walking the outputs (the
+        #: pump loop is single-threaded, so membership cannot change
+        #: between begin_wake and end_wake; a stale entry would merely
+        #: stage params for a key the engine ignores)
+        self._wake_fast: dict[int, tuple] = {}
+        self._inflight: list[_InFlight] = []
+        # double-buffered staging: a free pool per (b_pad, p_pad) shape;
+        # a buffer leaves the pool at dispatch and returns at harvest,
+        # so the upload the device still owns is never rewritten while
+        # the host gathers the next wake into a fresh/recycled one
+        # (steady state: two buffers per hot shape)
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._traced_shapes: set[tuple] = set()
+        self.wakes = 0
+        self.passes = 0
+        self.streams_coalesced = 0
+        self.harvests = 0
+        self.mismatches = 0
+
+    # ------------------------------------------------------------- wake API
+    def begin_wake(self, pairs, now_ms: int) -> None:
+        """Harvest any finished stacked pass, mark ownership so the
+        engines skip their per-stream device work this wake, and prime
+        params for streams whose membership changed — ONE stacked pass
+        for every joined/rebased stream instead of one per-stream query
+        each (the mass-join case the per-stream path serves linearly)."""
+        self.wakes += 1
+        for _stream, eng in pairs:
+            eng.megabatch_owned = True
+        self._harvest()
+        self._prime_stale(pairs, now_ms)
+
+    def idle_wake(self) -> None:
+        """Called by the pump on wakes where the megabatch is NOT
+        engaged (eligible streams fell below ``megabatch_min_streams``):
+        keeps harvesting whatever is still in flight so a mass teardown
+        can't pin streams/buffers inside ``_InFlight`` records forever,
+        and drops the per-stream cursors once nothing is in flight (a
+        later re-engagement re-tracks from the live window)."""
+        if self._inflight:
+            self._harvest()
+        if not self._inflight and self._tracked:
+            self._tracked.clear()
+            self._state_cache.clear()
+
+    def end_wake(self, pairs, now_ms: int) -> None:
+        """Collect, bucket, stage and dispatch the next stacked pass."""
+        t0 = time.perf_counter_ns()
+        # prune dead streams BEFORE any early return: a torn-down
+        # stream's id() can be recycled by a new RelayStream, and a
+        # stale staged-head surviving a saturated wake would silently
+        # skip the new stream's first packets
+        live = {id(s) for s, _ in pairs}
+        for sid in [k for k in self._tracked if k not in live]:
+            del self._tracked[sid]
+            self._state_cache.pop(sid, None)
+        if len(self._inflight) >= self.MAX_INFLIGHT:
+            return
+        work = self._collect(pairs)
+        if not work:
+            return
+        buckets: dict[tuple, list] = {}
+        for item in work:
+            _stream, _eng, fast, _key, _base, n_new = item
+            shape = (_pow2(max(n_new, 1), 16), _pow2(len(fast), 8))
+            buckets.setdefault(shape, []).append(item)
+        gather_ns = 0
+        h2d_ns = 0
+        for (p_pad, s_pad), entries in sorted(buckets.items()):
+            g, h = self._dispatch_bucket(entries, p_pad, s_pad)
+            gather_ns += g
+            h2d_ns += h
+        total = time.perf_counter_ns() - t0
+        phases = {"stage_gather": gather_ns, "h2d": h2d_ns}
+        PROFILER.account_pass("megabatch", total, phases)
+        TRACER.add("megabatch.dispatch", t0, total, cat="tpu",
+                   buckets=len(buckets), streams=len(work))
+
+    # ------------------------------------------------------------- prime
+    def _prime_stale(self, pairs, now_ms: int) -> None:
+        """Synchronous stacked param pass for key-stale streams.
+
+        Runs the engine's own deterministic bookmark/rebase latch first
+        (idempotent — the engine's step re-runs it as a no-op with the
+        same wake timestamp), so the key computed here is the key the
+        engine will check moments later in the same wake.  The affine
+        params depend only on that rewrite state, so the windows staged
+        here are all-zero padding: no packet bytes ride the prime."""
+        stale = []
+        self._wake_fast.clear()
+        for stream, eng in pairs:
+            flat = eng._flat_outputs(stream)     # one scan: prime + filter
+            eng._prime(stream, flat, now_ms)
+            ok = eng._native_ok()
+            fast = [o for o, _ in flat if eng._fast_eligible(o, ok)]
+            key = params_key(fast) if fast else None
+            self._wake_fast[id(stream)] = (fast, key)
+            if not fast:
+                continue
+            if key == eng._params_key or (
+                    eng.megabatch_params is not None
+                    and eng.megabatch_params[0] == key):
+                continue
+            stale.append((eng, fast, key))
+        if not stale:
+            return
+        import jax
+
+        t0 = time.perf_counter_ns()
+        buckets: dict[int, list] = {}
+        for item in stale:
+            buckets.setdefault(_pow2(len(item[1]), 8), []).append(item)
+        for s_pad, items in sorted(buckets.items()):
+            b_pad = _pow2(len(items), 1)
+            # fresh zeros, never a recycled buffer: a stale le32 length
+            # row would resurrect a previous wake's packets into the
+            # keyframe scan
+            win = np.zeros((b_pad, 16, staging.ROW_STRIDE), np.uint8)
+            state = np.zeros((b_pad, s_pad, STATE_COLS), np.uint32)
+            for i, (_eng, fast, _key) in enumerate(items):
+                state[i, :len(fast)] = np.asarray(pack_output_state(fast))
+            t_h = time.perf_counter_ns()
+            res = megabatch_window_step(jax.device_put(win), state)
+            t_d = time.perf_counter_ns()
+            packed = np.asarray(res)             # the blocking fetch
+            t_f = time.perf_counter_ns()         # scatter is host work,
+            segs = scatter_affine_segments(      # NOT d2h — unphased
+                packed, [len(f) for (_e, f, _k) in items])
+            shape = (b_pad, 16, s_pad)
+            if shape not in self._traced_shapes:
+                self._traced_shapes.add(shape)
+                PROFILER.note_compile(
+                    f"megabatch.step[{b_pad}x16x{s_pad}]",
+                    (t_f - t_h) / 1e9)
+            else:
+                PROFILER.account_pass(
+                    "megabatch", t_f - t_h,
+                    {"device_step": t_d - t_h, "d2h": t_f - t_d})
+            for (eng, _fast, key), seg in zip(items, segs):
+                self._install_segment(eng, key, seg)
+            self._note_pass(len(items), win.nbytes + state.nbytes)
+        TRACER.add("megabatch.prime", t0, time.perf_counter_ns() - t0,
+                   cat="tpu", streams=len(stale))
+
+    # ------------------------------------------------------------- collect
+    def _collect(self, pairs) -> list:
+        work = []
+        for stream, eng in pairs:
+            ring = stream.rtp_ring
+            cached = self._wake_fast.get(id(stream))
+            if cached is not None:
+                fast, key = cached
+            else:                          # end_wake without a prime scan
+                fast = eng.fast_outputs(stream)
+                key = params_key(fast) if fast else None
+            if not fast:
+                self._tracked[id(stream)] = ring.head
+                continue
+            base = self._tracked.get(id(stream))
+            floor = max(ring.tail, ring.head - self.MAX_STAGE_ROWS)
+            if base is None or base > ring.head or base < floor:
+                base = floor               # new/recycled/fell-behind
+            n_new = ring.head - base
+            need_params = (key != eng._params_key
+                           and not (eng.megabatch_params is not None
+                                    and eng.megabatch_params[0] == key))
+            if n_new <= 0 and not need_params:
+                continue                   # idle stream: zero device work
+            work.append((stream, eng, fast, key, base, n_new))
+        return work
+
+    # ------------------------------------------------------------ dispatch
+    def _buffer(self, b_pad: int, p_pad: int) -> np.ndarray:
+        pool = self._free.get((b_pad, p_pad))
+        if pool:
+            return pool.pop()
+        return np.zeros((b_pad, p_pad, staging.ROW_STRIDE), np.uint8)
+
+    def _recycle(self, buf: np.ndarray) -> None:
+        pool = self._free.setdefault((buf.shape[0], buf.shape[1]), [])
+        if len(pool) < 2:                  # double buffer per shape; a
+            pool.append(buf)               # cold shape's extras are GC'd
+
+    def _install_segment(self, eng, key, seg, base=None) -> bool:
+        """Oracle-check one scattered segment and install it as the
+        engine's params override — the ONE definition both the harvest
+        and the synchronous prime go through, so a tightened mismatch
+        check can never apply to one path and not the other.  Returns
+        False (and counts the mismatch) on device/host divergence; the
+        stream then falls back to per-stream stepping."""
+        seq_off, ts_off, ssrc, kf = seg
+        host = _host_affine_params(key)
+        if not (np.array_equal(seq_off[0], host[0])
+                and np.array_equal(ts_off[0], host[1])
+                and np.array_equal(ssrc[0], host[2])):
+            self.mismatches += 1
+            obs.MEGABATCH_WIRE_MISMATCH.inc()
+            eng.megabatch_params = None
+            return False
+        eng.megabatch_params = (key, (seq_off, ts_off, ssrc))
+        if base is not None and kf >= 0:
+            # parity with the per-stream query, which maintains this
+            # diagnostic field — an owned stream must not hold it stale
+            # just because the scheduler took over
+            eng.last_newest_keyframe = max(eng.last_newest_keyframe,
+                                           base + kf)
+        return True
+
+    def _note_pass(self, n_streams: int, h2d_bytes: int) -> None:
+        self.passes += 1
+        self.streams_coalesced += n_streams
+        obs.MEGABATCH_PASSES.inc()
+        obs.MEGABATCH_STREAMS.inc(n_streams)
+        obs.TPU_H2D_BYTES.inc(h2d_bytes)
+
+    def _packed_state(self, stream, fast, key) -> np.ndarray:
+        cached = self._state_cache.get(id(stream))
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        packed = np.asarray(pack_output_state(fast))
+        self._state_cache[id(stream)] = (key, packed)
+        return packed
+
+    def _dispatch_bucket(self, entries, p_pad: int,
+                         s_pad: int) -> tuple[int, int]:
+        import jax
+
+        b_pad = _pow2(len(entries), 1)
+        t_g = time.perf_counter_ns()
+        win = self._buffer(b_pad, p_pad)
+        state = np.zeros((b_pad, s_pad, STATE_COLS), np.uint32)
+        recs = []
+        for i, (stream, eng, fast, key, base, n_new) in enumerate(entries):
+            staging.gather_window(stream.rtp_ring, base, n_new, win[i])
+            state[i, :len(fast)] = self._packed_state(stream, fast, key)
+            self._tracked[id(stream)] = base + n_new
+            recs.append((stream, eng, key, len(fast), base))
+        if b_pad > len(entries):
+            win[len(entries):] = 0         # bucket padding rows
+        gather_ns = time.perf_counter_ns() - t_g
+        t_h = time.perf_counter_ns()
+        dwin = jax.device_put(win)
+        res = megabatch_window_step(dwin, state)
+        try:
+            res.copy_to_host_async()
+        except AttributeError:
+            pass
+        h2d_ns = time.perf_counter_ns() - t_h
+        shape = (b_pad, p_pad, s_pad)
+        if shape not in self._traced_shapes:
+            # bucket-growth retrace: the cold trace is a compile note,
+            # never a phase sample (PR 3 latch discipline)
+            self._traced_shapes.add(shape)
+            PROFILER.note_compile(
+                f"megabatch.step[{b_pad}x{p_pad}x{s_pad}]", h2d_ns / 1e9)
+            h2d_ns = 0
+        self._inflight.append(
+            _InFlight(res, recs, win, time.perf_counter_ns()))
+        self._note_pass(len(entries), win.nbytes + state.nbytes)
+        return gather_ns, h2d_ns
+
+    # ------------------------------------------------------------- harvest
+    def _harvest(self, *, force: bool = False) -> int:
+        if not self._inflight:
+            return 0
+        t0 = time.perf_counter_ns()
+        keep: list[_InFlight] = []
+        installed = 0
+        overlap_ns = 0
+        d2h_ns = 0
+        for inf in self._inflight:
+            age = time.perf_counter_ns() - inf.dispatch_ns
+            try:
+                ready = bool(inf.result.is_ready())
+            except AttributeError:
+                ready = age >= self.FORCE_FETCH_NS
+            if not (ready or force or age >= self.FORCE_FETCH_NS):
+                keep.append(inf)           # never stall the wake on it
+                continue
+            t_f = time.perf_counter_ns()
+            packed = np.asarray(inf.result)
+            fetch_ns = time.perf_counter_ns() - t_f
+            # honest split (PR 3 attribution discipline): a READY result's
+            # fetch is the d2h copy, same meaning as the engine's d2h; a
+            # NOT-ready fetch (forced/aged) is the pipeline's un-hidden
+            # remainder — h2d_overlap.  The scatter/oracle/install below
+            # is host bookkeeping and stays unphased.
+            if ready:
+                d2h_ns += fetch_ns
+            else:
+                overlap_ns += fetch_ns
+            obs.TPU_D2H_BYTES.inc(packed.nbytes)
+            segs = scatter_affine_segments(
+                packed, [n for (_s, _e, _k, n, _b) in inf.entries])
+            for (stream, eng, key, n_fast, base), seg in zip(inf.entries,
+                                                             segs):
+                if self._install_segment(eng, key, seg, base=base):
+                    installed += 1
+            self._recycle(inf.buf)
+            self.harvests += 1
+        self._inflight = keep
+        if overlap_ns or d2h_ns:
+            PROFILER.account_pass(
+                "megabatch", time.perf_counter_ns() - t0,
+                {"h2d_overlap": overlap_ns, "d2h": d2h_ns})
+        return installed
+
+    # -------------------------------------------------------------- stats
+    def drain(self) -> int:
+        """Force-fetch everything in flight (tests/teardown)."""
+        return self._harvest(force=True)
+
+    def stats(self) -> dict:
+        return {
+            "wakes": self.wakes,
+            "passes": self.passes,
+            "streams_coalesced": self.streams_coalesced,
+            "streams_per_pass": round(
+                self.streams_coalesced / self.passes, 2) if self.passes
+            else 0.0,
+            "inflight": len(self._inflight),
+            "harvests": self.harvests,
+            "mismatches": self.mismatches,
+        }
+
+
+__all__ = ["MegabatchScheduler"]

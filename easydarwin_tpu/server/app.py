@@ -87,6 +87,9 @@ class StreamingServer:
         self._restart_requested = False
         self.restart_event = asyncio.Event()
         self._engines: dict[int, TpuFanoutEngine] = {}
+        #: cross-stream megabatch scheduler (relay/megabatch.py) — built
+        #: lazily on the first wake with enough engine-eligible streams
+        self.megabatch = None
         self.started_at = time.time()
         from .status import StatusMonitor
         self.status = StatusMonitor(self)
@@ -202,6 +205,42 @@ class StreamingServer:
                              time.perf_counter_ns() - wake_ns)
         sent = 0
         use_tpu = self.config.tpu_fanout
+        # megabatch: coalesce every engine-eligible stream's device work
+        # into one shape-bucketed stacked pass per wake (ISSUE 4).  The
+        # scheduler harvests the previous wake's in-flight pass here,
+        # the per-stream steps below consume the installed params, and
+        # end_wake stages+dispatches the next pass after the loop.  Any
+        # scheduler failure degrades to per-stream stepping, never to a
+        # halted pump.
+        mega_pairs = []
+        if use_tpu and self.config.megabatch_enabled:
+            for sess in list(self.registry.sessions.values()):
+                for stream in sess.streams.values():
+                    if stream.num_outputs >= self.config.tpu_min_outputs:
+                        mega_pairs.append((stream,
+                                           self._engine_for(stream)))
+            if len(mega_pairs) >= self.config.megabatch_min_streams:
+                if self.megabatch is None:
+                    from ..relay.megabatch import MegabatchScheduler
+                    self.megabatch = MegabatchScheduler()
+                try:
+                    self.megabatch.begin_wake(mega_pairs, t)
+                except Exception as e:
+                    mega_pairs = []
+                    if self.error_log:
+                        self.error_log.warning(f"megabatch harvest: {e!r}")
+            else:
+                mega_pairs = []
+        if not mega_pairs and self.megabatch is not None:
+            # scheduler built but not engaged this wake (mass teardown,
+            # megabatch disabled): keep harvesting in-flight passes so
+            # they can't pin torn-down streams and staging buffers
+            try:
+                self.megabatch.idle_wake()
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(f"megabatch idle: {e!r}")
+        mega_ids = {id(s) for s, _ in mega_pairs}
         for sess in list(self.registry.sessions.values()):
             for stream in sess.streams.values():
                 # per-stream guard: one bad output (broken socket, buggy
@@ -210,7 +249,9 @@ class StreamingServer:
                     pre_stalls = stream.stats.stalls
                     if (use_tpu and stream.num_outputs
                             >= self.config.tpu_min_outputs):
-                        sent += self._engine_for(stream).step(stream, t)
+                        eng = self._engine_for(stream)
+                        eng.megabatch_owned = id(stream) in mega_ids
+                        sent += eng.step(stream, t)
                     else:
                         sent += stream.reflect(t)
                     for out in stream.tickable_outputs:
@@ -227,6 +268,12 @@ class StreamingServer:
                     if self.error_log:
                         self.error_log.warning(
                             f"reflect error on {sess.path}: {e!r}")
+        if mega_pairs:
+            try:
+                self.megabatch.end_wake(mega_pairs, t)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(f"megabatch stage: {e!r}")
         return sent
 
     def _make_pump_wheel(self):

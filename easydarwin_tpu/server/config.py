@@ -43,6 +43,14 @@ class ServerConfig:
     # --- device tier
     tpu_fanout: bool = False           # batch engine instead of scalar loop
     tpu_min_outputs: int = 8           # below this the scalar loop wins
+    # cross-stream megabatch scheduler (relay/megabatch.py): coalesce all
+    # engine-eligible streams into one shape-bucketed device pass per pump
+    # wake, with double-buffered H2D staging.  Off → every stream pays its
+    # own per-wake device dispatch (the pre-ISSUE-4 behavior).
+    megabatch_enabled: bool = True
+    # below this many engine-eligible streams the coalescing overhead
+    # isn't worth a stacked pass; per-stream stepping is used as-is
+    megabatch_min_streams: int = 2
     # shared UDP egress pair for players (RTPSocketPool/UDPDemuxer shape;
     # required by the native sendmmsg/GSO fan-out). Falls back to per-client
     # port pairs when off or when the native core is unavailable.

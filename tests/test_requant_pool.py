@@ -1,0 +1,86 @@
+"""Requant worker pool sizing (ISSUE 4 satellite).
+
+Bench r04/r05 reported ``h264_requant_workers == 1`` and
+``parallel_mbs_per_sec == serial`` on a multi-core host: the TPU runtime
+plugin pins the interpreter's main thread to one core at startup
+(sitecustomize), every thread spawned afterwards inherits the one-core
+mask, and the old ``sched_getaffinity``-based sizing faithfully reported
+the collapsed view.  The fix probes the cgroup's REAL allowance from a
+thread that first widens its own affinity, and the pool's initializer
+widens each worker the same way.
+"""
+
+import os
+
+import pytest
+
+import easydarwin_tpu.hls.requant as rq
+
+needs_affinity = pytest.mark.skipif(
+    not hasattr(os, "sched_setaffinity"),
+    reason="platform without sched_setaffinity")
+
+
+def _reset_cache():
+    rq._workers_cache = None
+
+
+@needs_affinity
+def test_pool_sizing_survives_pinned_importing_thread():
+    """A one-core pin on the calling thread (what the TPU runtime does to
+    the main thread) must not collapse the pool size."""
+    orig = os.sched_getaffinity(0)
+    _reset_cache()
+    full = rq.pool_workers()               # unpinned: the cgroup's truth
+    try:
+        os.sched_setaffinity(0, {min(orig)})
+        _reset_cache()
+        assert rq.pool_workers() == full
+    finally:
+        os.sched_setaffinity(0, orig)
+        _reset_cache()
+
+
+def test_pool_workers_env_override(monkeypatch):
+    monkeypatch.setenv("EDTPU_REQUANT_WORKERS", "3")
+    assert rq.pool_workers() == 3
+    monkeypatch.setenv("EDTPU_REQUANT_WORKERS", "bogus")
+    _reset_cache()
+    assert rq.pool_workers() >= 1
+
+
+@needs_affinity
+def test_pool_threads_get_widened_affinity():
+    """Workers un-inherit a pinned creator: a job running in the shared
+    pool must see the full allowed CPU set, or a sized-N pool still
+    stacks on one core and parallel == serial."""
+    _reset_cache()
+    full = rq.pool_workers()
+    orig = os.sched_getaffinity(0)
+    old_pool, rq._pool = rq._pool, None
+    try:
+        os.sched_setaffinity(0, {min(orig)})
+        pool = rq._get_pool()
+        seen = pool.submit(lambda: len(os.sched_getaffinity(0))).result(10)
+        assert seen == full
+    finally:
+        os.sched_setaffinity(0, orig)
+        if rq._pool is not None and rq._pool is not old_pool:
+            rq._pool.shutdown(wait=False)
+        rq._pool = old_pool
+        _reset_cache()
+
+
+@needs_affinity
+def test_widen_affinity_respects_cgroup_quota():
+    """widen_affinity never grants more CPUs than the cgroup allows: the
+    kernel intersects the requested mask, so the post-widen set equals
+    the measured allowance."""
+    _reset_cache()
+    full = rq.pool_workers()
+    orig = os.sched_getaffinity(0)
+    try:
+        rq.widen_affinity()
+        assert len(os.sched_getaffinity(0)) == full
+    finally:
+        os.sched_setaffinity(0, orig)

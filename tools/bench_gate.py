@@ -92,11 +92,36 @@ def check_trajectory(traj: list[dict],
         v = parsed.get("value")
         if not isinstance(v, (int, float)) or not math.isfinite(v) or v <= 0:
             errs.append(f"{name}: non-positive/NaN headline value {v!r}")
-        phases = (parsed.get("extra") or {}).get("phase_ms") or {}
+        extra = parsed.get("extra") or {}
+        phases = extra.get("phase_ms") or {}
         for ph in phases:
             if ph not in PHASES:
                 errs.append(f"{name}: phase {ph!r} outside the closed "
                             f"vocabulary {PHASES}")
+        # ISSUE 4 multi-source section — OPTIONAL (rounds predating the
+        # megabatch scheduler stay valid), but when present its fields
+        # must be sane: a later refactor that silently breaks the
+        # section would otherwise poison the trajectory unnoticed
+        ms = extra.get("multi_source")
+        if isinstance(ms, dict) and ms and "error" not in ms:
+            spp = ms.get("streams_per_pass")
+            if not isinstance(spp, (int, float)) or not math.isfinite(spp) \
+                    or spp < 1:
+                errs.append(f"{name}: multi_source.streams_per_pass "
+                            f"{spp!r} (< 1 means no coalescing happened)")
+            p99 = ms.get("megabatch_p99_added_ms")
+            if not isinstance(p99, (int, float)) or not math.isfinite(p99) \
+                    or p99 <= 0:
+                errs.append(f"{name}: multi_source.megabatch_p99_added_ms "
+                            f"{p99!r} not a positive finite latency")
+            mm = ms.get("megabatch_wire_mismatches", 0)
+            if mm:
+                errs.append(f"{name}: multi_source recorded {mm} megabatch "
+                            "wire mismatches (device/host divergence)")
+            for ph in (ms.get("phase_ms") or {}):
+                if ph not in PHASES:
+                    errs.append(f"{name}: multi_source phase {ph!r} outside "
+                                f"the closed vocabulary {PHASES}")
     if usable == 0:
         errs.append("every trajectory round is unusable (parsed: null)")
     return errs

@@ -204,6 +204,23 @@ def main() -> int:
     for name in ("slo.violation", "slo.recover"):
         if name not in ev.SCHEMA:
             errs.append(f"event {name} missing from SCHEMA")
+    # the megabatch scheduler's vocabulary (ISSUE 4): the engine label,
+    # its phases, and the counter families the soak/bench layers key on
+    # — a vocabulary revert would silently orphan their checks
+    from easydarwin_tpu.obs.profile import ENGINES, PHASES
+    if "megabatch" not in ENGINES:
+        errs.append("engine 'megabatch' missing from obs.profile.ENGINES")
+    for ph in ("stage_gather", "h2d_overlap"):
+        if ph not in PHASES:
+            errs.append(f"phase {ph!r} missing from obs.profile.PHASES")
+    for fam in ("megabatch_passes_total", "megabatch_streams_total",
+                "megabatch_fallback_total", "megabatch_wire_mismatch_total",
+                "stage_gather_bytes_total",
+                "stage_gather_busy_seconds_total"):
+        try:
+            obs.REGISTRY.get(fam)
+        except KeyError:
+            errs.append(f"megabatch family {fam} missing from the registry")
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -69,12 +69,24 @@ def parse_metrics(text: str) -> dict[str, float]:
     return out
 
 
-def check_metrics(scrapes: list[dict[str, float]]) -> list[str]:
+def check_metrics(scrapes: list[dict[str, float]], *,
+                  expect_megabatch: bool = False) -> list[str]:
     """Counter-regression checks over the soak's periodic scrapes."""
     errs: list[str] = []
     if not scrapes:
         return ["no /metrics scrapes completed"]
     last = scrapes[-1]
+    # megabatch invariants (ISSUE 4): a device/host param divergence is
+    # a wire-corruption bug at ANY time; and a multi-source soak where
+    # the scheduler never coalesced a single pass means the megabatch
+    # path silently disengaged
+    if last.get("megabatch_wire_mismatch_total", 0) > 0:
+        errs.append(f"megabatch wire mismatches: "
+                    f"{last['megabatch_wire_mismatch_total']:.0f} "
+                    "(device params disagreed with the host oracle)")
+    if expect_megabatch and last.get("megabatch_passes_total", 0) == 0:
+        errs.append("multi-source soak ran zero megabatched passes "
+                    "(scheduler disengaged)")
     if last.get("ingest_oversize_dropped_total", 0) > 0:
         errs.append(f"ingest drops: "
                     f"{last['ingest_oversize_dropped_total']:.0f}")
@@ -131,7 +143,97 @@ def check_metrics(scrapes: list[dict[str, float]]) -> list[str]:
     return errs
 
 
-async def soak(seconds: float) -> int:
+def multi_source_section(n_sources: int, seconds: float = 2.0) -> list[str]:
+    """Drive the cross-stream megabatch scheduler with ``n_sources``
+    native-addressed relay streams in-process (same obs globals the
+    server scrapes, so megabatch_* counters land in /metrics).  Returns
+    failures; success means stacked passes ran, the per-stream device
+    path stayed idle, and zero wire mismatches were counted."""
+    import numpy as np
+
+    from easydarwin_tpu.protocol import sdp as sdp_mod
+    from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+    from easydarwin_tpu.relay.megabatch import MegabatchScheduler
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+
+    errs: list[str] = []
+    OUTS_PER_STREAM = 8
+    sdp_txt = ("v=0\r\ns=m\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+               "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.setblocking(False)
+    recv.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 22)
+    send = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rng = np.random.default_rng(5)
+    streams, engines = [], []
+    for s in range(n_sources):
+        st = RelayStream(sdp_mod.parse(sdp_txt).streams[0],
+                         StreamSettings(bucket_delay_ms=0))
+        for _ in range(OUTS_PER_STREAM):
+            o = CollectingOutput(ssrc=int(rng.integers(0, 2**32)),
+                                 out_seq_start=int(rng.integers(0, 2**16)))
+            o.native_addr = recv.getsockname()
+            st.add_output(o)
+        streams.append(st)
+        engines.append(TpuFanoutEngine(egress_fd=send.fileno()))
+    sched = MegabatchScheduler()
+    pkt = bytes([0x80, 96]) + bytes(10) + bytes(188)
+    # pre-compile the stacked step for the shapes this section uses,
+    # BEFORE any packet carries an arrival stamp: a cold jit trace with
+    # a live backlog turns compile time into real ingest→wire latency
+    # and burns the SLO budget the soak asserts on
+    import jax
+
+    from easydarwin_tpu.models.relay_pipeline import megabatch_window_step
+    from easydarwin_tpu.ops.fanout import STATE_COLS
+    from easydarwin_tpu.ops.staging import ROW_STRIDE
+    from easydarwin_tpu.relay.fanout import _pow2
+    b_pad = _pow2(n_sources, 1)
+    np.asarray(megabatch_window_step(
+        jax.device_put(np.zeros((b_pad, 16, ROW_STRIDE), np.uint8)),
+        np.zeros((b_pad, _pow2(OUTS_PER_STREAM, 8), STATE_COLS),
+                 np.uint32)))
+    t = int(time.monotonic() * 1000)
+    seq = 0
+    t_end = time.time() + seconds
+    while time.time() < t_end:
+        for st in streams:
+            for _ in range(3):
+                st.push_rtp(pkt[:2] + (seq & 0xFFFF).to_bytes(2, "big")
+                            + pkt[4:], t)
+                seq += 1
+        pairs = list(zip(streams, engines))
+        sched.begin_wake(pairs, t)
+        for st, eng in pairs:
+            eng.step(st, t)
+        sched.end_wake(pairs, t)
+        try:                               # keep the receiver queue empty
+            while True:
+                recv.recv(65536)
+        except BlockingIOError:
+            pass
+        t += 10
+        time.sleep(0.005)
+    sched.drain()
+    recv.close()
+    send.close()
+    if sched.passes == 0:
+        errs.append(f"multi-source section: zero megabatched passes over "
+                    f"{n_sources} sources")
+    if sched.mismatches:
+        errs.append(f"multi-source section: {sched.mismatches} megabatch/"
+                    "per-stream wire mismatches")
+    per_stream = sum(e.device_param_refreshes + e.dring_appends
+                     for e in engines)
+    if per_stream:
+        errs.append(f"multi-source section: {per_stream} per-stream device "
+                    "dispatches while megabatch-owned (coalescing leak)")
+    return errs
+
+
+async def soak(seconds: float, n_sources: int = 0) -> int:
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
                        access_log_enabled=False)
@@ -350,10 +452,17 @@ async def soak(seconds: float) -> int:
         for eng in app._engines.values():
             if eng.send_errors:
                 failures.append(f"engine send errors: {eng.send_errors}")
+        # multi-source megabatch section BEFORE the final scrape, so its
+        # megabatch_* counters are visible to check_metrics (same
+        # process-global registry the server exports)
+        if n_sources >= 2:
+            failures.extend(await asyncio.to_thread(
+                multi_source_section, n_sources))
         st, body = await rest_get("/metrics")   # final scrape for checks
         if st == 200:
             scrapes.append(parse_metrics(body.decode()))
-        failures.extend(check_metrics(scrapes))
+        failures.extend(check_metrics(scrapes,
+                                      expect_megabatch=n_sources >= 2))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -408,20 +517,24 @@ async def soak(seconds: float) -> int:
     return 1 if failures else 0
 
 
-def _parse_args(argv: list[str]) -> float:
+def _parse_args(argv: list[str]) -> tuple[float, int]:
     import argparse
     ap = argparse.ArgumentParser(
         description="integration soak (see module docstring)")
     ap.add_argument("--duration", type=float, default=None,
                     metavar="SECONDS", help="soak length (default 120)")
+    ap.add_argument("--sources", type=int, default=16, metavar="N",
+                    help="multi-source megabatch section stream count "
+                         "(default 16; < 2 disables the section)")
     ap.add_argument("seconds", nargs="?", type=float, default=None,
                     help="legacy positional form of --duration")
     ns = ap.parse_args(argv)
     if ns.duration is not None and ns.seconds is not None:
         ap.error("give --duration or the positional seconds, not both")
     d = ns.duration if ns.duration is not None else ns.seconds
-    return 120.0 if d is None else d
+    return (120.0 if d is None else d), ns.sources
 
 
 if __name__ == "__main__":
-    raise SystemExit(asyncio.run(soak(_parse_args(sys.argv[1:]))))
+    _dur, _src = _parse_args(sys.argv[1:])
+    raise SystemExit(asyncio.run(soak(_dur, _src)))
