@@ -763,8 +763,9 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
     # the production harness (hls/requant.py): one shared pool, the
     # native walk releases the GIL — measure the AGGREGATE rate with
     # every core fed, which is what a multi-rung ladder gets
-    from easydarwin_tpu.hls.requant import pool_workers, widen_affinity
-    workers = pool_workers()
+    from easydarwin_tpu.hls.requant import pool_sizing, widen_affinity
+    sizing = pool_sizing()
+    workers = sizing["workers"]
     agg_mbs_s = mbs_s
     if workers > 1:
         import threading
@@ -798,6 +799,11 @@ def h264_requant_throughput(*, seconds: float = 2.0) -> dict:
         "h264_requant_mbs_per_sec": round(mbs_s, 0),
         "h264_requant_cabac_mbs_per_sec": round(cabac_mbs_s, 0),
         "h264_requant_workers": workers,
+        # which sizing signal won and what every signal read (ISSUE 5
+        # satellite: r05 shipped workers=1 with no way to tell whether
+        # that was one real CPU or a collapsed probe under a cpu.max
+        # bandwidth quota)
+        "h264_requant_sizing": sizing,
         "h264_requant_parallel_mbs_per_sec": round(agg_mbs_s, 0),
         "h264_requant_1080p30_renditions":
             round(agg_mbs_s / (8160 * 30), 2),
@@ -1094,7 +1100,7 @@ def main():
             "h264_requant_cabac_mbs_per_sec",
             "h264_requant_parallel_mbs_per_sec",
             "h264_requant_1080p30_renditions", "h264_requant_workers",
-            "h264_requant_drift_db_q6",
+            "h264_requant_sizing", "h264_requant_drift_db_q6",
             "device", "device_fallback_cpu",
             "sustainable_1080p30_subscribers_per_source",
             "phase_ms", "phase_sum_mean_ms", "ingest_to_wire_mean_ms")

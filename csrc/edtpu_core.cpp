@@ -57,7 +57,7 @@ struct StatCells {
       gso_supers{0}, gso_segments{0}, eagain_stops{0}, hard_errors{0},
       bytes_to_wire{0}, recvmmsg_calls{0}, recv_datagrams{0}, recv_bytes{0},
       oversize_dropped{0}, send_ns{0}, ingest_ns{0}, stage_gather_ns{0},
-      staged_bytes{0};
+      staged_bytes{0}, fault_injections{0};
 };
 StatCells g_stat;
 
@@ -94,6 +94,50 @@ struct StatTimer {
   explicit StatTimer(std::atomic<int64_t> &c) : cell(c), t0(mono_ns()) {}
   ~StatTimer() { stat_add(cell, mono_ns() - t0); }
 };
+
+// Deterministic egress fault knobs (ed_fault_set): counter-based — every
+// Nth send-call attempt fails/sleeps — so a chaos run with one
+// configuration replays one schedule.  Relaxed atomics: the counters sit
+// next to syscalls, and cross-thread skew of a count is acceptable for a
+// fault schedule the same way it is for metrics.
+struct FaultCells {
+  std::atomic<int64_t> eagain_every{0}, enobufs_every{0}, latency_every{0},
+      latency_us{0};
+  std::atomic<int64_t> eagain_calls{0}, enobufs_calls{0}, latency_calls{0};
+};
+FaultCells g_fault;
+
+inline bool fault_due(std::atomic<int64_t> &every,
+                      std::atomic<int64_t> &calls) {
+  int64_t n = every.load(std::memory_order_relaxed);
+  if (n <= 0) return false;
+  int64_t c = calls.fetch_add(1, std::memory_order_relaxed) + 1;
+  return c % n == 0;
+}
+
+// Run before each egress syscall attempt.  Returns 0 = proceed, or the
+// errno the attempt should fail with (EAGAIN / ENOBUFS) — the caller
+// takes exactly its real-kernel error path, so injected faults exercise
+// the production bookmark/skip machinery, not a parallel one.
+inline int fault_egress_gate() {
+  if (fault_due(g_fault.latency_every, g_fault.latency_calls)) {
+    stat_add(g_stat.fault_injections, 1);
+    int64_t us = g_fault.latency_us.load(std::memory_order_relaxed);
+    if (us > 0) {
+      timespec ts{us / 1000000, (us % 1000000) * 1000};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  if (fault_due(g_fault.eagain_every, g_fault.eagain_calls)) {
+    stat_add(g_stat.fault_injections, 1);
+    return EAGAIN;
+  }
+  if (fault_due(g_fault.enobufs_every, g_fault.enobufs_calls)) {
+    stat_add(g_stat.fault_injections, 1);
+    return ENOBUFS;
+  }
+  return 0;
+}
 }  // namespace
 
 extern "C" {
@@ -121,6 +165,8 @@ void ed_get_stats(ed_stats *out) {
   out->stage_gather_ns =
       g_stat.stage_gather_ns.load(std::memory_order_relaxed);
   out->staged_bytes = g_stat.staged_bytes.load(std::memory_order_relaxed);
+  out->fault_injections =
+      g_stat.fault_injections.load(std::memory_order_relaxed);
 }
 
 // Correct by construction: adding an ed_stats field updates this
@@ -147,7 +193,23 @@ void ed_reset_stats(void) {
   g_stat.ingest_ns.store(0, std::memory_order_relaxed);
   g_stat.stage_gather_ns.store(0, std::memory_order_relaxed);
   g_stat.staged_bytes.store(0, std::memory_order_relaxed);
+  g_stat.fault_injections.store(0, std::memory_order_relaxed);
 }
+
+void ed_fault_set(int64_t eagain_every, int64_t enobufs_every,
+                  int64_t latency_every, int64_t latency_us) {
+  g_fault.eagain_every.store(eagain_every, std::memory_order_relaxed);
+  g_fault.enobufs_every.store(enobufs_every, std::memory_order_relaxed);
+  g_fault.latency_every.store(latency_every, std::memory_order_relaxed);
+  g_fault.latency_us.store(latency_us, std::memory_order_relaxed);
+  // fresh schedule: counters restart so one configuration is one
+  // deterministic sequence regardless of what ran before arming
+  g_fault.eagain_calls.store(0, std::memory_order_relaxed);
+  g_fault.enobufs_calls.store(0, std::memory_order_relaxed);
+  g_fault.latency_calls.store(0, std::memory_order_relaxed);
+}
+
+void ed_fault_clear(void) { ed_fault_set(0, 0, 0, 0); }
 
 int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
                            const int32_t *ring_len, int32_t capacity,
@@ -199,6 +261,15 @@ int32_t ed_fanout_send_udp(int fd, const uint8_t *ring_data,
     }
     int sent = 0;
     while (sent < batch) {
+      int ferr = fault_egress_gate();
+      if (ferr) {  // injected: the caller takes its real-kernel path
+        g_stop_errno = ferr;
+        stat_add(g_stat.sendmmsg_calls, 1);
+        note_send_stop(ferr);
+        if (ferr == EAGAIN) return done + sent;
+        int32_t got = done + sent;
+        return got > 0 ? got : -ferr;
+      }
       int n = sendmmsg(fd, msgs.data() + sent, batch - sent, 0);
       if (n < 0) {
         if (errno == EINTR) continue;
@@ -284,6 +355,16 @@ int32_t ed_fanout_send_udp_gso(int fd, const uint8_t *ring_data,
     int sent = 0;
     flush_err = 0;
     while (sent < n_super) {
+      int ferr = fault_egress_gate();
+      if (ferr) {  // injected: mirror the real stop accounting exactly
+        g_stop_errno = ferr;
+        stat_add(g_stat.sendmmsg_calls, 1);
+        note_send_stop(ferr);
+        if (ferr != EAGAIN) flush_err = ferr;
+        int32_t ops_sent = 0;
+        for (int i = 0; i < sent; ++i) ops_sent += supers[i].n_ops;
+        return ops_sent;
+      }
       int n = sendmmsg(fd, msgs.data() + sent, n_super - sent, send_flags);
       if (n < 0) {
         if (errno == EINTR) continue;
@@ -469,6 +550,14 @@ int32_t ed_scalar_baseline_send(int fd, const uint8_t *ring_data,
     sa.sin_addr.s_addr = dest[op.out].ip_be;
     sa.sin_port = dest[op.out].port_be;
     for (;;) {
+      int ferr = fault_egress_gate();
+      if (ferr) {
+        g_stop_errno = ferr;
+        stat_add(g_stat.sendto_calls, 1);
+        note_send_stop(ferr);
+        if (ferr == EAGAIN) return i;
+        return i > 0 ? i : -ferr;
+      }
       ssize_t r = sendto(fd, scratch, static_cast<size_t>(len), 0,
                          reinterpret_cast<sockaddr *>(&sa), sizeof(sa));
       if (r >= 0) {

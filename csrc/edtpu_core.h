@@ -63,6 +63,10 @@ typedef struct {
    * Python bridge refuses a library that disagrees. */
   int64_t stage_gather_ns;  /* cumulative ns inside ed_stage_gather */
   int64_t staged_bytes;     /* prefix+length bytes packed for upload */
+  /* Fault-injection tail (third ABI bump, field 17): egress faults
+   * deliberately provoked by the ed_fault_* knobs (chaos testing).
+   * ed_stats_fields() now reports 17. */
+  int64_t fault_injections; /* injected EAGAIN/ENOBUFS/latency events */
 } ed_stats;
 
 void ed_get_stats(ed_stats *out);
@@ -70,6 +74,24 @@ void ed_reset_stats(void);
 /* Number of int64 fields in ed_stats — the newest symbol; its presence
  * tells the ctypes bridge this library writes the timing tail. */
 int32_t ed_stats_fields(void);
+
+/* ---------------------------------------------------- fault injection */
+
+/* Deterministic egress fault knobs (the resilience subsystem's chaos
+ * schedule, easydarwin_tpu/resilience/inject.py).  Counter-based, never
+ * random: every `eagain_every`-th send CALL (one sendmmsg/sendto batch
+ * attempt) stops with EAGAIN before issuing the syscall (WouldBlock
+ * semantics: callers keep bookmarks and replay); every
+ * `enobufs_every`-th stops with ENOBUFS (a hard per-datagram error:
+ * callers skip past it); every `latency_every`-th sleeps `latency_us`
+ * before the syscall (a latency spike, not a failure).  0 disables a
+ * knob.  Injections count into ed_stats.fault_injections (and the
+ * EAGAIN/hard-error counters, exactly as a real kernel stop would).
+ * Each knob keeps its own call counter, reset by ed_fault_set/clear, so
+ * a given configuration yields one deterministic schedule. */
+void ed_fault_set(int64_t eagain_every, int64_t enobufs_every,
+                  int64_t latency_every, int64_t latency_us);
+void ed_fault_clear(void);
 
 /* ---------------------------------------------------------------- egress */
 

@@ -43,7 +43,7 @@ from .segmenter import HlsOutput
 #: from one OR many renditions run truly concurrently; the pure-Python
 #: fallback path still benefits from staying off the event loop
 _pool: ThreadPoolExecutor | None = None
-_workers_cache: int | None = None
+_sizing_cache: dict | None = None
 
 
 def widen_affinity() -> None:
@@ -70,22 +70,81 @@ def widen_affinity() -> None:
         pass
 
 
-def pool_workers() -> int:
-    """Worker count for the shared requant pool: the number of CPUs the
-    cgroup actually allows, measured from a throwaway thread that first
-    widens its own affinity — so a runtime-pinned importing thread can
-    no longer collapse the pool to 1.  ``EDTPU_REQUANT_WORKERS``
-    overrides (sizing experiments / CI determinism).  Memoized: the
-    cgroup quota doesn't move at runtime."""
-    global _workers_cache
-    env = os.environ.get("EDTPU_REQUANT_WORKERS")
-    if env:
+def _own_cgroup_path(proc_cgroup: str, controller: str | None) -> str:
+    """This process's cgroup path for ``controller`` (None = the v2
+    unified hierarchy) from ``/proc/self/cgroup`` — the effective quota
+    lives in OUR cgroup, not the root (a systemd CPUQuota= service sits
+    in system.slice/<svc> where the root's cpu.max reads 'max')."""
+    try:
+        with open(proc_cgroup, encoding="ascii") as f:
+            for ln in f:
+                parts = ln.strip().split(":", 2)
+                if len(parts) != 3:
+                    continue
+                if controller is None and parts[0] == "0":
+                    return parts[2]
+                if controller is not None and \
+                        controller in parts[1].split(","):
+                    return parts[2]
+    except OSError:
+        pass
+    return ""
+
+
+def _cgroup_quota_cpus(proc_cgroup: str = "/proc/self/cgroup",
+                       fs_root: str = "/sys/fs/cgroup") -> float | None:
+    """CPU-equivalents allowed by the cgroup's *bandwidth* quota (the
+    signal affinity masks cannot see): cgroup v2 ``cpu.max`` or v1
+    ``cpu.cfs_quota_us``/``cpu.cfs_period_us``, read from THIS
+    process's cgroup and every ancestor up to the root — the effective
+    limit is the minimum along the chain.  None = no quota anywhere
+    (or not on Linux/cgroups)."""
+    best: float | None = None
+
+    def note(v: float) -> None:
+        nonlocal best
+        best = v if best is None else min(best, v)
+
+    def walk(root: str, rel: str, read) -> None:
+        node = root + rel if rel and rel != "/" else root
+        while True:
+            v = read(node)
+            if v is not None:
+                note(v)
+            if node == root or not node.startswith(root):
+                break
+            node = os.path.dirname(node)
+
+    def read_v2(node: str) -> float | None:
         try:
-            return max(1, int(env))
-        except ValueError:
+            with open(node + "/cpu.max", encoding="ascii") as f:
+                quota, _, period = f.read().strip().partition(" ")
+            if quota != "max" and float(period) > 0:
+                return float(quota) / float(period)
+        except (OSError, ValueError):
             pass
-    if _workers_cache is not None:
-        return _workers_cache
+        return None
+
+    def read_v1(node: str) -> float | None:
+        try:
+            with open(node + "/cpu.cfs_quota_us", encoding="ascii") as f:
+                quota = float(f.read().strip())
+            with open(node + "/cpu.cfs_period_us", encoding="ascii") as f:
+                period = float(f.read().strip())
+            if quota > 0 and period > 0:
+                return quota / period
+        except (OSError, ValueError):
+            pass
+        return None
+
+    walk(fs_root, _own_cgroup_path(proc_cgroup, None), read_v2)
+    walk(fs_root + "/cpu", _own_cgroup_path(proc_cgroup, "cpu"), read_v1)
+    return best
+
+
+def _probe_affinity() -> int:
+    """CPUs visible to a fresh thread that first widens its own affinity
+    (un-inheriting the TPU runtime's one-core main-thread pin)."""
     box: list[int] = []
 
     def probe() -> None:
@@ -98,8 +157,76 @@ def pool_workers() -> int:
     t = threading.Thread(target=probe, name="hls-requant-probe")
     t.start()
     t.join()
-    _workers_cache = max(1, box[0] if box else 1)
-    return _workers_cache
+    return max(1, box[0] if box else 1)
+
+
+def pool_sizing(*, affinity: int | None = None,
+                quota: float | None = None,
+                cpu_count: int | None = None,
+                env: str | None = None) -> dict:
+    """Worker count for the shared requant pool PLUS the rationale —
+    which signal won and what every signal read — surfaced into the
+    bench JSON ``extra`` so a wrong sizing is diagnosable from the
+    trajectory alone (BENCH_r05 shipped ``workers: 1`` with nothing to
+    say why).
+
+    Signals, in precedence order:
+
+    * ``EDTPU_REQUANT_WORKERS`` — explicit operator override;
+    * the **affinity probe** (widened throwaway thread) — the CPUs the
+      scheduler will actually run our threads on;
+    * the **cgroup bandwidth quota** (``cpu.max`` / cfs_quota) — the
+      signal the affinity mask cannot see.  Two regressions it fixes:
+      the bench-box case where the probe collapses to 1 (the runtime's
+      one-core pin survives because ``sched_setaffinity`` is denied in
+      the container) while the quota provisions several CPUs — trust
+      the quota, the per-worker initializer still retries the widen;
+      and the big-node case where affinity says 96 but ``cpu.max``
+      caps at 2 — sizing to 96 just trades throughput for preemption
+      thrash, so the quota caps the pool.
+
+    Keyword arguments override the probed signals (tests); the no-
+    argument call is memoized — none of these signals move at runtime."""
+    global _sizing_cache
+    injected = (affinity is not None or quota is not None
+                or cpu_count is not None or env is not None)
+    if not injected and _sizing_cache is not None:
+        return _sizing_cache
+    env = os.environ.get("EDTPU_REQUANT_WORKERS") if env is None else env
+    if env:
+        try:
+            sizing = {"workers": max(1, int(env)), "source": "env",
+                      "affinity_cpus": None, "quota_cpus": None,
+                      "cpu_count": os.cpu_count() or 1}
+            if not injected:
+                _sizing_cache = sizing
+            return sizing
+        except ValueError:
+            pass
+    ncpu = cpu_count if cpu_count is not None else (os.cpu_count() or 1)
+    aff = affinity if affinity is not None else _probe_affinity()
+    q = quota if quota is not None else _cgroup_quota_cpus()
+    q_cpus = max(1, int(q)) if q is not None and q >= 1 else \
+        (1 if q is not None else None)
+    if aff <= 1 and q_cpus is not None and q_cpus > 1:
+        workers, source = min(q_cpus, ncpu), "cpu_max_quota"
+    elif q_cpus is not None and q_cpus < aff:
+        workers, source = q_cpus, "cpu_max_cap"
+    else:
+        workers, source = aff, "affinity"
+    sizing = {"workers": max(1, workers), "source": source,
+              "affinity_cpus": aff,
+              "quota_cpus": round(q, 2) if q is not None else None,
+              "cpu_count": ncpu}
+    if not injected:
+        _sizing_cache = sizing
+    return sizing
+
+
+def pool_workers() -> int:
+    """Worker count for the shared requant pool (see ``pool_sizing``
+    for the decision rationale)."""
+    return pool_sizing()["workers"]
 
 
 def _get_pool() -> ThreadPoolExecutor:

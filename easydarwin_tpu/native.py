@@ -37,14 +37,16 @@ class SendOp(ctypes.Structure):
 
 #: field order MUST match struct ed_stats in csrc/edtpu_core.h
 #: (send_ns/ingest_ns are the clock_gettime timing tail; stage_gather_ns/
-#: staged_bytes are the megabatch staging tail — second ABI bump; the
-#: loader refuses any library too old to write them — ed_stats_fields
-#: check)
+#: staged_bytes are the megabatch staging tail — second ABI bump;
+#: fault_injections is the resilience subsystem's egress fault counter —
+#: third ABI bump; the loader refuses any library whose field count
+#: disagrees — ed_stats_fields check)
 _STAT_FIELDS = ("sendmmsg_calls", "sendto_calls", "send_packets",
                 "gso_supers", "gso_segments", "eagain_stops",
                 "hard_errors", "bytes_to_wire", "recvmmsg_calls",
                 "recv_datagrams", "recv_bytes", "oversize_dropped",
-                "send_ns", "ingest_ns", "stage_gather_ns", "staged_bytes")
+                "send_ns", "ingest_ns", "stage_gather_ns", "staged_bytes",
+                "fault_injections")
 
 
 class EdStats(ctypes.Structure):
@@ -155,6 +157,10 @@ def _load():
         lib.ed_get_stats.argtypes = [ctypes.POINTER(EdStats)]
         lib.ed_reset_stats.restype = None
         lib.ed_reset_stats.argtypes = []
+        lib.ed_fault_set.restype = None
+        lib.ed_fault_set.argtypes = [ctypes.c_int64] * 4
+        lib.ed_fault_clear.restype = None
+        lib.ed_fault_clear.argtypes = []
         lib.ed_udp_ingest.restype = ctypes.c_int32
         lib.ed_udp_ingest.argtypes = [
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
@@ -207,6 +213,23 @@ def reset_stats() -> None:
     lib = _load()
     assert lib is not None
     lib.ed_reset_stats()
+
+
+def fault_set(eagain_every: int, enobufs_every: int,
+              latency_every: int, latency_us: int) -> None:
+    """Arm the deterministic egress fault knobs (resilience/inject.py):
+    every Nth send-call attempt fails EAGAIN / ENOBUFS or sleeps a
+    latency spike before its syscall; setting restarts the schedule."""
+    lib = _load()
+    assert lib is not None
+    lib.ed_fault_set(int(eagain_every), int(enobufs_every),
+                     int(latency_every), int(latency_us))
+
+
+def fault_clear() -> None:
+    lib = _load()
+    assert lib is not None
+    lib.ed_fault_clear()
 
 
 def _u8(a: np.ndarray):
@@ -537,6 +560,11 @@ def _collect_native_stats() -> None:
     obs.INGEST_BUSY_SECONDS.set_to(s["ingest_ns"] / 1e9)
     obs.STAGE_GATHER_BUSY_SECONDS.set_to(s["stage_gather_ns"] / 1e9)
     obs.STAGE_GATHER_BYTES.set_to(s["staged_bytes"])
+    # egress faults injected by the C-side ed_fault_* knobs land under
+    # their own site label next to the Python-side injection sites
+    if s["fault_injections"]:
+        obs.FAULT_INJECTED.set_to(s["fault_injections"],
+                                  site="egress_native")
 
 
 def _register_collector() -> None:

@@ -17,15 +17,19 @@ from .families import (  # noqa: F401  (re-exported inventory)
     EGRESS_BUSY_SECONDS, EGRESS_BYTES, EGRESS_EAGAIN, EGRESS_GSO_SEGMENTS,
     EGRESS_GSO_SUPERS, EGRESS_PACKETS, EGRESS_SENDMMSG_CALLS,
     EGRESS_SENDTO_CALLS, EGRESS_SEND_ERRORS, EVENTS_DROPPED, EVENTS_EMITTED,
-    EVENTS_INVALID, EVENTS_SINK_FAILURES, FLIGHT_DUMPS, INGEST_BUSY_SECONDS,
-    INGEST_BYTES, INGEST_DATAGRAMS, INGEST_OVERSIZE_DROPPED,
-    INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS, MEGABATCH_FALLBACK,
-    MEGABATCH_PASSES, MEGABATCH_STREAMS, MEGABATCH_WIRE_MISMATCH,
-    PROFILE_PHASE_DRIFT, QOS_FRACTION_LOST, QOS_JITTER, QOS_THICKENS,
-    QOS_THINS, REGISTRY, RELAY_INGEST_TO_WIRE, RELAY_PHASE_SECONDS,
-    SLO_BUDGET_REMAINING, SLO_VIOLATIONS, STAGE_GATHER_BUSY_SECONDS,
-    STAGE_GATHER_BYTES, TPU_D2H_BYTES, TPU_H2D_BYTES, TPU_HEADERS_RENDERED,
-    TPU_PACKETS_SENT, TPU_PARAM_REFRESHES, TPU_PASSES, TPU_PASS_SECONDS)
+    EVENTS_INVALID, EVENTS_SINK_FAILURES, FAULT_INJECTED, FLIGHT_DUMPS,
+    INGEST_BUSY_SECONDS, INGEST_BYTES, INGEST_DATAGRAMS,
+    INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS,
+    MEGABATCH_FALLBACK, MEGABATCH_PASSES, MEGABATCH_STREAMS,
+    MEGABATCH_WIRE_MISMATCH, PROFILE_PHASE_DRIFT, QOS_FRACTION_LOST,
+    QOS_JITTER, QOS_THICKENS, QOS_THINS, REGISTRY, RELAY_INGEST_TO_WIRE,
+    RELAY_PHASE_SECONDS, RESILIENCE_CKPT_BYTES, RESILIENCE_CKPT_ERRORS,
+    RESILIENCE_CKPT_RESTORES, RESILIENCE_CKPT_WRITES,
+    RESILIENCE_LADDER_LEVEL, RESILIENCE_RETRIES, RESILIENCE_SHED_OUTPUTS,
+    RESILIENCE_TRANSITIONS, SLO_BUDGET_REMAINING, SLO_VIOLATIONS,
+    STAGE_GATHER_BUSY_SECONDS, STAGE_GATHER_BYTES, TPU_D2H_BYTES,
+    TPU_H2D_BYTES, TPU_HEADERS_RENDERED, TPU_PACKETS_SENT,
+    TPU_PARAM_REFRESHES, TPU_PASSES, TPU_PASS_SECONDS)
 from .flight import FLIGHT, FlightRecorder  # noqa: F401
 from .metrics import (  # noqa: F401
     TIME_BUCKETS, Counter, Gauge, Histogram, Registry)

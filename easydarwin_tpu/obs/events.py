@@ -78,6 +78,18 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # never per tick) / falling edge
     "slo.violation": ("slo", "burn"),
     "slo.recover": ("slo",),
+    # resilience subsystem (easydarwin_tpu/resilience/)
+    # fault.injected is rate-limited to one per site per second with the
+    # accumulated count — never per packet
+    "fault.injected": ("site", "count"),
+    # ladder transitions are latched per rung change, never per tick;
+    # soak --chaos pairs degrades with recovers per stream
+    "ladder.degrade": ("rung", "from_rung", "reason"),
+    "ladder.recover": ("rung", "from_rung"),
+    "ladder.shed": ("outputs",),
+    # checkpoint lifecycle (resilience/checkpoint.py)
+    "ckpt.save": ("sessions",),
+    "ckpt.restore": ("sessions", "outputs"),
 }
 
 

@@ -210,3 +210,53 @@ FLIGHT_DUMPS = REGISTRY.counter(
     "flight_dumps_total",
     "Per-session flight-recorder dumps written on abnormal teardown "
     "(timeout sweep, uncaught exception, hard protocol error)")
+
+# ------------------------------------------------------------- resilience
+# The fault-injection / degradation-ladder / checkpoint subsystem
+# (easydarwin_tpu/resilience/).  tools/metrics_lint.py enforces this
+# family set and tools/soak.py --chaos keys on it.
+FAULT_INJECTED = REGISTRY.counter(
+    "fault_injected_total",
+    "Faults deliberately injected by the armed FaultPlan, by site "
+    "(ingest drop/reorder/corrupt, native egress EAGAIN/ENOBUFS/latency, "
+    "device-dispatch exceptions, stale params, slow-subscriber "
+    "backpressure); nonzero only under chaos testing", labels=("site",))
+RESILIENCE_LADDER_LEVEL = REGISTRY.gauge(
+    "resilience_ladder_level",
+    "Current degradation-ladder rung per stream (0 = megabatch full "
+    "service, 1 = per-stream device, 2 = CPU oracle, 3 = shedding the "
+    "newest subscribers); anything above 0 means degraded service",
+    labels=("stream",))
+RESILIENCE_TRANSITIONS = REGISTRY.counter(
+    "resilience_transitions_total",
+    "Degradation-ladder rung changes, by direction (down = degrade, "
+    "up = recover); paired ladder.degrade/ladder.recover events carry "
+    "the rung names", labels=("direction",))
+RESILIENCE_RETRIES = REGISTRY.counter(
+    "resilience_retries_total",
+    "Transient device errors absorbed by bounded retry-with-backoff "
+    "WITHOUT a ladder rung change (the errors that did cost a rung are "
+    "counted in resilience_transitions_total{direction=down})")
+RESILIENCE_SHED_OUTPUTS = REGISTRY.counter(
+    "resilience_shed_outputs_total",
+    "Subscriber outputs shed by ladder rung 3 (newest-first, one per "
+    "maintenance tick) to keep an overloaded stream live for everyone "
+    "else")
+RESILIENCE_CKPT_WRITES = REGISTRY.counter(
+    "resilience_checkpoint_writes_total",
+    "Relay-state checkpoint documents written to <log_folder>/ckpt/ "
+    "(atomic tmp+rename, one per resilience_checkpoint_interval_sec)")
+RESILIENCE_CKPT_BYTES = REGISTRY.counter(
+    "resilience_checkpoint_bytes_total",
+    "Serialized checkpoint bytes written (ring cursors + rewrite "
+    "5-tuples + RR accounting are plain integers, so this stays KB-scale "
+    "even at hundreds of sessions)")
+RESILIENCE_CKPT_RESTORES = REGISTRY.counter(
+    "resilience_checkpoint_restores_total",
+    "Startup hot-restores that rebuilt at least one relay session from "
+    "a fresh checkpoint (supervisor-restarted server resuming without "
+    "re-SETUP)")
+RESILIENCE_CKPT_ERRORS = REGISTRY.counter(
+    "resilience_checkpoint_errors_total",
+    "Checkpoint write/parse failures (full disk, version mismatch, "
+    "malformed session record); the server keeps serving either way")

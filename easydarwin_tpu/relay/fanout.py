@@ -25,6 +25,7 @@ from ..obs import PROFILER, TRACER
 from ..ops import device_ring
 from ..ops import fanout as fanout_ops
 from ..ops import parse as parse_ops
+from ..resilience.inject import INJECTOR
 from .output import RelayOutput, WriteResult
 from .stream import RelayStream
 
@@ -355,6 +356,17 @@ class TpuFanoutEngine:
         state changes (subscribe/unsubscribe/latch) — the common-case
         pass reuses the cached triples and spends nothing on the device.
         Shapes are padded to powers of two to bound jit specializations."""
+        if INJECTOR.active:
+            # chaos sites (resilience/inject.py): stale_params discards
+            # the cached/installed affine params (forcing the refresh
+            # path); device_dispatch raises a transient InjectedFault
+            # BEFORE any send, so the pump's per-stream guard and the
+            # ladder's retry-with-backoff see exactly what a real device
+            # error produces
+            if INJECTOR.stale_params():
+                self._params_key = None
+                self.megabatch_params = None
+            INJECTOR.device_dispatch("fanout.device_params")
         key = params_key([o for o, _ in fast])
         if key == self._params_key:
             return self._params

@@ -56,6 +56,7 @@ from ..models.relay_pipeline import (megabatch_window_step,
 from ..obs import PROFILER, TRACER
 from ..ops import staging
 from ..ops.fanout import STATE_COLS, pack_output_state
+from ..resilience.inject import INJECTOR
 from .fanout import _pow2, params_key
 
 
@@ -339,6 +340,11 @@ class MegabatchScheduler:
                          s_pad: int) -> tuple[int, int]:
         import jax
 
+        if INJECTOR.active:
+            # chaos site: a stacked-dispatch failure BEFORE staging
+            # mutates cursors — the pump catches it, degrades the wake
+            # to per-stream stepping and charges the ladder
+            INJECTOR.device_dispatch("megabatch.dispatch")
         b_pad = _pow2(len(entries), 1)
         t_g = time.perf_counter_ns()
         win = self._buffer(b_pad, p_pad)

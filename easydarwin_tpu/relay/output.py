@@ -19,6 +19,7 @@ import enum
 from dataclasses import dataclass
 
 from ..protocol import rtcp, rtp
+from ..resilience.inject import INJECTOR
 
 
 class WriteResult(enum.Enum):
@@ -88,6 +89,11 @@ class RelayOutput:
         """Send a device-rewritten packet: 12-byte header + original bytes
         from offset 12.  Default concatenates; socket-backed outputs override
         with vectored I/O so the shared payload is never copied."""
+        if INJECTOR.active and INJECTOR.slow_subscriber():
+            # chaos site: slow-subscriber backpressure — the engine's
+            # WOULD_BLOCK machinery (bookmark replay) handles it, the
+            # same as a genuinely full socket
+            return WriteResult.WOULD_BLOCK
         if self.meta_field_ids is not None:
             return self.send_bytes(self.wrap_meta(header, tail),
                                    is_rtcp=False)
@@ -124,6 +130,9 @@ class RelayOutput:
         if rw.base_src_seq < 0:
             rw.base_src_seq = rtp.peek_seq(packet)
             rw.base_src_ts = rtp.peek_timestamp(packet)
+        if INJECTOR.active and INJECTOR.slow_subscriber():
+            self.stalls += 1            # same accounting as a real block
+            return WriteResult.WOULD_BLOCK
         out = rtp.rewrite_header(
             packet,
             seq=rw.map_seq(rtp.peek_seq(packet)),

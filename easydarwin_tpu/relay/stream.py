@@ -26,6 +26,7 @@ import numpy as np
 from .. import obs
 from ..protocol import rtcp as rtcp_mod
 from ..protocol.sdp import StreamInfo
+from ..resilience.inject import INJECTOR
 from .output import RelayOutput, WriteResult
 from .ring import DEFAULT_CAPACITY, PacketFlags, PacketRing
 
@@ -108,6 +109,10 @@ class RelayStream:
         #: the per-step relay_rtcp call early-return without touching the
         #: output list (it is on the fan-out hot path)
         self._next_sr_due_ms = 0
+        #: chaos reorder hold (resilience/inject.py): the one-slot
+        #: buffer an armed ingest_reorder fault parks a packet in —
+        #: owned by the stream so a held packet dies with it
+        self._chaos_hold: list = []
         #: reception accounting for those RRs (RFC 3550 A.3)
         self._rr_base_seq: int | None = None
         self._rr_max_seq = 0
@@ -152,6 +157,16 @@ class RelayStream:
             # latch the RTCP wall anchor at first ingest so engines
             # stepping a copied stream state share the exact base
             self._wall_base = time.time() - now_ms / 1000.0
+        if INJECTOR.active:
+            # chaos gauntlet (resilience/inject.py): seeded drop /
+            # adjacent-swap reorder / payload corruption — one attribute
+            # check when no plan is armed
+            pid = -1
+            for pkt in INJECTOR.ingest(packet, self._chaos_hold):
+                pid = self.rtp_ring.push(pkt, now_ms)
+                if pid >= 0:
+                    self._note_rtp_ingested(pid)
+            return pid
         pid = self.rtp_ring.push(packet, now_ms)
         if pid >= 0:
             self._note_rtp_ingested(pid)
@@ -167,6 +182,11 @@ class RelayStream:
             self._wall_base = time.time() - now_ms / 1000.0
         pre = self.rtp_ring.head
         n = self.rtp_ring.native_drain(fd, now_ms, max_pkts)
+        if n > 0 and INJECTOR.active:
+            # chaos gauntlet for the recvmmsg path: drops/corruption
+            # mutate the just-landed slots in place (a dropped slot
+            # becomes a runt nothing ever relays)
+            INJECTOR.ingest_ring(self.rtp_ring, pre, self.rtp_ring.head)
         for pid in range(pre, self.rtp_ring.head):
             self._note_rtp_ingested(pid)
         if n > 0:
@@ -178,18 +198,27 @@ class RelayStream:
         return self.rtcp_ring.push(packet, now_ms, is_rtcp=True)
 
     # -- output management -------------------------------------------------
-    def add_output(self, output: RelayOutput) -> None:
+    def add_output(self, output: RelayOutput, *,
+                   bucket: int | None = None) -> None:
         """Place in the first bucket with a free slot, growing the bucket
-        array as needed (``ReflectorStream::AddOutput`` cpp:280-322)."""
+        array as needed (``ReflectorStream::AddOutput`` cpp:280-322).
+        ``bucket`` pins an explicit index instead (checkpoint restore:
+        the delay-stagger tier a subscriber was in is part of its
+        serving state, and first-fit would repack over the holes)."""
         self._next_sr_due_ms = 0        # new output: SR due immediately
         if hasattr(output, "tick"):     # reliable-UDP retransmit sweeps
             self.tickable_outputs.append(output)
-        for bucket in self.buckets:
-            if len(bucket) < self.settings.bucket_size:
-                bucket.append(output)
-                break
+        if bucket is not None:
+            while len(self.buckets) <= bucket:
+                self.buckets.append([])
+            self.buckets[bucket].append(output)
         else:
-            self.buckets.append([output])
+            for b in self.buckets:
+                if len(b) < self.settings.bucket_size:
+                    b.append(output)
+                    break
+            else:
+                self.buckets.append([output])
         obs.EVENTS.emit("stream.output_add", stream=self.session_path,
                         trace_id=self.trace_id,
                         session_id=getattr(output, "session_id", None),

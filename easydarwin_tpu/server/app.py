@@ -24,6 +24,31 @@ from .rest import RestApi
 from .rtsp import RtspServer
 
 
+class _RestoredSubscriber:
+    """Connection stand-in for a checkpoint-restored UDP subscriber.
+
+    The real RTSP connection died with the previous process; this
+    adapter duck-types what ``RtspServer.on_client_rtcp`` needs
+    (``player_tracks``/``relay``/``path``/``stats``/``last_activity``)
+    so the restored output's receiver reports keep driving quality
+    adaptation AND proving liveness — and the sweep reaps the output
+    after ``rtsp_timeout_sec`` of RTCP silence, so a player that never
+    came back cannot be relayed to forever."""
+
+    is_pusher = False
+
+    def __init__(self, sess, track_id: int, stream, output):
+        import types
+        self.relay = sess
+        self.path = sess.path
+        self.stream = stream
+        self.output = output
+        self.player_tracks = {track_id: types.SimpleNamespace(
+            output=output)}
+        self.stats: dict = {}
+        self.last_activity = time.monotonic()
+
+
 class StreamingServer:
     def __init__(self, config: ServerConfig | None = None, *,
                  describe_fallback=None, redis_client=None):
@@ -82,6 +107,20 @@ class StreamingServer:
         from ..obs import PROFILER, SloWatchdog
         self.slo = SloWatchdog(self.config.slo_config(),
                                offender=PROFILER.top_offender)
+        #: degradation ladder (resilience/ladder.py): per-stream rung
+        #: megabatch → per-stream device → CPU oracle → shed, consulted
+        #: by the pump per wake and ticked by the 1 Hz maintenance block
+        self.ladder = None
+        if self.config.resilience_enabled:
+            from ..resilience import DegradationLadder
+            self.ladder = DegradationLadder(self.config.ladder_config())
+        #: session checkpoint/hot-restore (resilience/checkpoint.py) —
+        #: built in start() once log_folder is final
+        self.checkpoint = None
+        #: adapters owning hot-restored subscribers (RTCP demux +
+        #: silence reaping); swept alongside the RTSP timeout sweep
+        self._restored_subs: list[_RestoredSubscriber] = []
+        self._armed_faults = False
         self._tasks: list[asyncio.Task] = []
         self._running = False
         self._restart_requested = False
@@ -126,8 +165,35 @@ class StreamingServer:
                     on_error=lambda f, e: self.error_log
                     and self.error_log.warning(f"module {f} failed: {e}")):
                 self.register_module(m)
+        # chaos plan (resilience/inject.py): armed before anything serves
+        # so the very first pass already runs under the fault schedule
+        plan = self.config.fault_plan()
+        if plan is not None:
+            from ..resilience import INJECTOR
+            INJECTOR.arm(plan)
+            self._armed_faults = True
         await self.rtsp.start()
         await self.rest.start()
+        if self.config.resilience_checkpoint_enabled:
+            # hot-restore AFTER the egress pair exists (restored UDP
+            # subscribers send through it) and BEFORE the pump starts
+            from ..resilience import CheckpointManager
+            self.checkpoint = CheckpointManager(
+                os.path.join(self.config.log_folder, "ckpt"),
+                interval_sec=self.config.resilience_checkpoint_interval_sec,
+                max_age_sec=self.config.resilience_checkpoint_max_age_sec)
+            try:
+                n_sess, n_out = self.checkpoint.restore(
+                    self.registry, output_factory=self._restored_output)
+                if n_out:
+                    self._adopt_restored_outputs()
+                if n_sess and self.error_log:
+                    self.error_log.info(
+                        f"checkpoint: restored {n_sess} sessions / "
+                        f"{n_out} subscribers")
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(f"checkpoint restore: {e!r}")
         self.rtsp.modules.run_initialize(self)
         self._tasks = [
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
@@ -152,6 +218,18 @@ class StreamingServer:
 
     async def stop(self) -> None:
         self._running = False
+        if self.checkpoint is not None:
+            # final snapshot while the registry is still intact, so a
+            # supervisor relaunch (EXIT_RESTART) resumes from the very
+            # last state, not the last periodic interval
+            try:
+                self.checkpoint.write(self.registry)
+            except Exception:
+                pass
+        if self._armed_faults:
+            from ..resilience import INJECTOR
+            INJECTOR.disarm()
+            self._armed_faults = False
         self.rtsp.modules.run_shutdown(self)
         if self.presence is not None:
             await self.presence.stop()
@@ -184,6 +262,64 @@ class StreamingServer:
             self._wake_ns = time.perf_counter_ns()
         self._pump_event.set()
 
+    def _restored_output(self, rec: dict):
+        """Checkpoint output factory: rebuild a UDP subscriber on the
+        shared egress pair (the address pair IS the transport — the
+        client never learns the server restarted).  Interleaved/TCP
+        outputs died with their connections and are skipped."""
+        if rec.get("kind") != "udp" or not rec.get("rtp_addr"):
+            return None
+        egress = self.rtsp.shared_egress
+        if egress is None or not egress.active:
+            return None
+        from .egress import NativeUdpOutput
+        ip, rtp_port = rec["rtp_addr"]
+        rtcp = rec.get("rtcp_addr") or (ip, int(rtp_port) + 1)
+        out = NativeUdpOutput(egress, ip, int(rtp_port), int(rtcp[1]))
+        # the RTCP destination may live on a DIFFERENT host than the RTP
+        # one (RTSP Transport destination semantics) — restore it whole
+        out.rtcp_addr = (rtcp[0], int(rtcp[1]))
+        return out
+
+    def _adopt_restored_outputs(self) -> None:
+        """Give every just-restored UDP output a connection stand-in:
+        register it with the shared-egress RTCP demux (quality feedback
+        + liveness proof flow again) and track it for the silence sweep.
+        Runs only right after restore, when every output in the registry
+        IS a restored one."""
+        egress = self.rtsp.shared_egress
+        if egress is None:
+            return
+        for sess in self.registry.sessions.values():
+            for tid, stream in sess.streams.items():
+                for out in stream.outputs:
+                    if getattr(out, "native_addr", None) is None:
+                        continue
+                    sub = _RestoredSubscriber(sess, tid, stream, out)
+                    self._restored_subs.append(sub)
+                    egress.register(out, sub)
+
+    def _sweep_restored(self) -> None:
+        """Reap restored subscribers whose player never proved itself:
+        no ownership-proven RTCP for ``rtsp_timeout_sec`` (the same
+        clock a live UDP player's connection is held to) removes the
+        output — a vanished player cannot be relayed to forever."""
+        if not self._restored_subs:
+            return
+        now = time.monotonic()
+        egress = self.rtsp.shared_egress
+        for sub in list(self._restored_subs):
+            stale = (now - sub.last_activity
+                     > self.config.rtsp_timeout_sec)
+            gone = self.registry.find(sub.path) is not sub.relay
+            if not (stale or gone):
+                continue
+            self._restored_subs.remove(sub)
+            if not gone:
+                sub.stream.remove_output(sub.output)
+            if egress is not None:
+                egress.unregister(sub.output, sub)
+
     # ---------------------------------------------------------- pump loop
     def _engine_for(self, stream) -> TpuFanoutEngine:
         eng = self._engines.get(id(stream))
@@ -213,10 +349,13 @@ class StreamingServer:
         # scheduler failure degrades to per-stream stepping, never to a
         # halted pump.
         mega_pairs = []
+        lad = self.ladder
         if use_tpu and self.config.megabatch_enabled:
             for sess in list(self.registry.sessions.values()):
                 for stream in sess.streams.values():
-                    if stream.num_outputs >= self.config.tpu_min_outputs:
+                    if (stream.num_outputs >= self.config.tpu_min_outputs
+                            and (lad is None
+                                 or lad.allows_megabatch(sess.path))):
                         mega_pairs.append((stream,
                                            self._engine_for(stream)))
             if len(mega_pairs) >= self.config.megabatch_min_streams:
@@ -226,6 +365,9 @@ class StreamingServer:
                 try:
                     self.megabatch.begin_wake(mega_pairs, t)
                 except Exception as e:
+                    if lad is not None:
+                        lad.note_scheduler_error(
+                            [s.session_path for s, _ in mega_pairs])
                     mega_pairs = []
                     if self.error_log:
                         self.error_log.warning(f"megabatch harvest: {e!r}")
@@ -245,33 +387,58 @@ class StreamingServer:
             for stream in sess.streams.values():
                 # per-stream guard: one bad output (broken socket, buggy
                 # transcoder tap) must never halt fan-out for the rest
+                pre_stalls = stream.stats.stalls
+                # ladder rung (resilience/ladder.py): ≤1 keeps the
+                # device engine (0 = megabatch-coalesced); ≥2 — or a
+                # retry-backoff window — serves via the CPU oracle,
+                # the mandatory fallback the north star requires
+                mode = 0 if lad is None else lad.engine_mode(sess.path)
+                device = (use_tpu and stream.num_outputs
+                          >= self.config.tpu_min_outputs and mode <= 1)
                 try:
-                    pre_stalls = stream.stats.stalls
-                    if (use_tpu and stream.num_outputs
-                            >= self.config.tpu_min_outputs):
+                    if device:
                         eng = self._engine_for(stream)
                         eng.megabatch_owned = id(stream) in mega_ids
                         sent += eng.step(stream, t)
+                        if lad is not None:
+                            lad.note_device_ok(sess.path)
                     else:
                         sent += stream.reflect(t)
+                except Exception as e:
+                    if device and lad is not None:
+                        # the DEVICE path failed: bounded retry with
+                        # backoff first, rung change only past the
+                        # budget.  Oracle-path failures (one broken
+                        # output) are logged only — they are not device
+                        # health and must not move the ladder
+                        lad.note_device_error(sess.path)
+                    if self.error_log:
+                        self.error_log.warning(
+                            f"reflect error on {sess.path}: {e!r}")
+                try:
                     for out in stream.tickable_outputs:
                         # reliable-UDP retransmit sweep (RTO-expired
                         # packets; RTPPacketResender resend-on-interval)
                         sent += out.tick(t)
-                    # wheel hint: a due-but-held bucket release on a
-                    # NON-stalled stream just matured mid-pass and may be
-                    # armed immediately; a stalled stream must not be (a
-                    # time wake cannot unblock a full socket)
-                    stream._last_pass_stalled = \
-                        stream.stats.stalls > pre_stalls
                 except Exception as e:
+                    # one buggy output's sweep must neither halt fan-out
+                    # nor masquerade as a device error
                     if self.error_log:
                         self.error_log.warning(
-                            f"reflect error on {sess.path}: {e!r}")
+                            f"tick error on {sess.path}: {e!r}")
+                # wheel hint: a due-but-held bucket release on a
+                # NON-stalled stream just matured mid-pass and may be
+                # armed immediately; a stalled stream must not be (a
+                # time wake cannot unblock a full socket)
+                stream._last_pass_stalled = \
+                    stream.stats.stalls > pre_stalls
         if mega_pairs:
             try:
                 self.megabatch.end_wake(mega_pairs, t)
             except Exception as e:
+                if lad is not None:
+                    lad.note_scheduler_error(
+                        [s.session_path for s, _ in mega_pairs])
                 if self.error_log:
                     self.error_log.warning(f"megabatch stage: {e!r}")
         return sent
@@ -346,6 +513,18 @@ class StreamingServer:
                     except Exception as e:
                         if self.error_log:
                             self.error_log.warning(f"slo tick: {e!r}")
+                if self.ladder is not None:
+                    try:
+                        self._ladder_maintenance()
+                    except Exception as e:
+                        if self.error_log:
+                            self.error_log.warning(f"ladder tick: {e!r}")
+                if self.checkpoint is not None:
+                    try:
+                        self.checkpoint.maybe_write(self.registry)
+                    except Exception as e:
+                        if self.error_log:
+                            self.error_log.warning(f"checkpoint: {e!r}")
                 if self.presence is not None:
                     self.presence.set_load(sum(
                         s.num_outputs
@@ -354,6 +533,36 @@ class StreamingServer:
                         await self.presence.sync_streams(self.registry.paths())
                     except Exception:
                         pass
+
+    def _ladder_maintenance(self) -> None:
+        """1 Hz ladder duties: evaluate recovery/SLO pressure, then shed
+        the newest subscriber of any rung-3 stream (one per session per
+        tick — shedding is a pressure valve, not an eviction sweep)."""
+        from .. import obs
+        from ..resilience import LEVEL_SHED
+        stalls = {
+            sess.path: sum(st.stats.stalls
+                           for st in sess.streams.values())
+            for sess in self.registry.sessions.values()}
+        slo_status = None
+        offender = None
+        if self.config.slo_enabled:
+            slo_status = self.slo.status()
+            from ..obs import PROFILER
+            offender = PROFILER.top_offender()
+        self.ladder.tick(stalls, slo_status=slo_status, offender=offender)
+        for sess in list(self.registry.sessions.values()):
+            if self.ladder.level(sess.path) < LEVEL_SHED:
+                continue
+            for stream in sess.streams.values():
+                out = self.ladder.shed_candidate(stream)
+                if out is not None and stream.remove_output(out):
+                    obs.RESILIENCE_SHED_OUTPUTS.inc()
+                    obs.EVENTS.emit(
+                        "ladder.shed", level="warn", stream=sess.path,
+                        trace_id=sess.trace_id,
+                        outputs=stream.num_outputs)
+                    break
 
     async def _status_loop(self) -> None:
         """The 1 Hz supervisor's status duties (RunServer.cpp:620-719):
@@ -395,6 +604,7 @@ class StreamingServer:
         while self._running:
             await asyncio.sleep(self.config.timeout_sweep_sec)
             self.rtsp.sweep_timeouts()
+            self._sweep_restored()
             self.relay_source.sweep()
             self.transcodes.sweep()
             self.hls.sweep()

@@ -92,6 +92,24 @@ class ServerConfig:
     slo_fast_burn: float = 14.0              # SRE-workbook page-tier rates
     slo_slow_burn: float = 2.0
     slo_min_events: int = 200                # below this a window is noise
+    # --- resilience (easydarwin_tpu/resilience/: deterministic fault
+    # injection, health-driven degradation ladder, session checkpoint)
+    resilience_enabled: bool = True          # degradation ladder active
+    # FaultPlan spec armed at startup (chaos testing), e.g.
+    # "seed=7,ingest_drop=0.05,egress_enobufs_every=300"; "" = none
+    resilience_fault_plan: str = ""
+    resilience_recover_sec: float = 10.0     # clean time per rung climbed
+    resilience_max_retries: int = 3          # device retries before a drop
+    resilience_backoff_ms: float = 250.0     # first retry backoff (doubles)
+    # session checkpoint/hot-restore (<log_folder>/ckpt/): off by default
+    # — a restore resurrects sessions from the PREVIOUS process, which an
+    # operator opts into (the supervisor deployment), not a test run
+    # sharing /tmp state
+    resilience_checkpoint_enabled: bool = False
+    resilience_checkpoint_interval_sec: float = 5.0
+    # a checkpoint older than this is ignored at startup (stale files
+    # must not resurrect long-dead sessions)
+    resilience_checkpoint_max_age_sec: float = 60.0
     # --- status (RunServer.cpp:248-483: -S console + server_status file)
     stats_interval_sec: int = 0        # 0 = console display off
     status_file_path: str = ""         # "" = no status file
@@ -157,6 +175,22 @@ class ServerConfig:
             fast_burn=self.slo_fast_burn,
             slow_burn=self.slo_slow_burn,
             min_events=self.slo_min_events)
+
+    def ladder_config(self):
+        from ..resilience.ladder import LadderConfig
+        return LadderConfig(
+            recover_sec=self.resilience_recover_sec,
+            max_retries=self.resilience_max_retries,
+            backoff_ms=self.resilience_backoff_ms)
+
+    def fault_plan(self):
+        """The armed FaultPlan, or None when no chaos spec is set.  A
+        malformed spec raises at startup — a typo'd plan that silently
+        injects nothing would void the chaos run it was meant to drive."""
+        if not self.resilience_fault_plan.strip():
+            return None
+        from ..resilience.inject import FaultPlan
+        return FaultPlan.parse(self.resilience_fault_plan)
 
     def stream_settings(self):
         from ..relay.stream import StreamSettings

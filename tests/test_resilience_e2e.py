@@ -1,0 +1,131 @@
+"""End-to-end checkpoint/hot-restore: a real server restart resumes a
+live UDP subscriber without re-SETUP (ISSUE 5 tentpole, server half).
+
+Server A relays a pushed session to a UDP player, checkpoints, and
+stops.  Server B starts over the same ``log_folder``, hot-restores the
+session + subscriber, the pusher re-ANNOUNCEs (the reference's
+re-register/re-push recovery protocol) and keeps pushing — the player's
+socket, which never learned anything happened, must see the stream
+resume with the SAME ssrc and CONTINUOUS rewritten seq numbering.
+"""
+
+import asyncio
+import socket
+import struct
+
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.utils.client import RtspClient
+
+SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=ck\r\nt=0 0\r\n"
+       "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+       "a=control:trackID=1\r\n")
+
+
+def _pkt(seq: int) -> bytes:
+    return (struct.pack("!BBHII", 0x80, 96, seq & 0xFFFF, seq * 90, 0xB)
+            + bytes([0x65]) + bytes(60))
+
+
+def _cfg(tmp_path) -> ServerConfig:
+    return ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                        reflect_interval_ms=10, bucket_delay_ms=0,
+                        log_folder=str(tmp_path),
+                        access_log_enabled=False,
+                        resilience_checkpoint_enabled=True,
+                        resilience_checkpoint_interval_sec=0.5)
+
+
+async def _drain(sock, out: list, seconds: float) -> None:
+    t_end = asyncio.get_event_loop().time() + seconds
+    while asyncio.get_event_loop().time() < t_end:
+        try:
+            out.append(sock.recv(65536))
+        except BlockingIOError:
+            await asyncio.sleep(0.01)
+
+
+async def test_server_restart_resumes_udp_subscriber(tmp_path):
+    rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rtp.bind(("127.0.0.1", 0))
+    rtp.setblocking(False)
+    rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rtcp.bind(("127.0.0.1", 0))
+    rtcp.setblocking(False)
+    rx: list[bytes] = []
+    app_a = StreamingServer(_cfg(tmp_path))
+    await app_a.start()
+    try:
+        push = RtspClient()
+        await push.connect("127.0.0.1", app_a.rtsp.port)
+        await push.push_start(f"rtsp://127.0.0.1:{app_a.rtsp.port}"
+                              "/live/ck", SDP)
+        player = RtspClient()
+        await player.connect("127.0.0.1", app_a.rtsp.port)
+        await player.play_start(
+            f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/ck", tcp=False,
+            client_ports=[(rtp.getsockname()[1], rtcp.getsockname()[1])])
+        for seq in range(20):
+            push.push_packet(0, _pkt(seq))
+            await asyncio.sleep(0.005)
+        await _drain(rtp, rx, 0.3)
+        assert len(rx) >= 10           # phase A flowed
+        assert app_a.checkpoint.write(app_a.registry)
+        # the "crash": the player connection is never torn down — its
+        # transport state lives only in the checkpoint now
+        await push.close()
+    finally:
+        await app_a.stop()
+
+    n_before = len(rx)
+    app_b = StreamingServer(_cfg(tmp_path))
+    await app_b.start()
+    try:
+        sess = app_b.registry.find("/live/ck")
+        assert sess is not None        # hot-restored, no re-SETUP
+        st = sess.streams[1]
+        assert st.num_outputs == 1
+        # the reference's recovery half: the pusher re-ANNOUNCEs the
+        # same path (adopting the restored session) and keeps numbering
+        push2 = RtspClient()
+        await push2.connect("127.0.0.1", app_b.rtsp.port)
+        await push2.push_start(f"rtsp://127.0.0.1:{app_b.rtsp.port}"
+                               "/live/ck", SDP)
+        for seq in range(20, 40):
+            push2.push_packet(0, _pkt(seq))
+            await asyncio.sleep(0.005)
+        await _drain(rtp, rx, 0.3)
+        assert len(rx) > n_before      # the player kept receiving
+        ssrcs = {p[8:12] for p in rx if len(p) >= 12}
+        assert len(ssrcs) == 1         # SAME subscriber identity
+        seqs = [struct.unpack("!H", p[2:4])[0] for p in rx
+                if len(p) >= 12]
+        # continuous rewritten numbering across the restart: every step
+        # is +1 mod 2^16 — a rewrite reset would jump back to out_seq0
+        deltas = {(b - a) & 0xFFFF for a, b in zip(seqs, seqs[1:])}
+        assert deltas <= {0, 1}, f"seq discontinuity: {sorted(deltas)}"
+
+        # the restored subscriber got a connection stand-in: RTCP demux
+        # is wired (RRs drive QoS + liveness again) and the silence
+        # sweep reaps the output if the player never proves itself
+        assert len(app_b._restored_subs) == 1
+        sub = app_b._restored_subs[0]
+        egress = app_b.rtsp.shared_egress
+        out = sub.output
+        ssrc = out.rewrite.ssrc
+        rr = struct.pack("!BBHIIIIIII", 0x81, 201, 7, 0x7A7A,
+                         ssrc, 0, 0, 0, 0, 0)
+        before = sub.last_activity
+        await asyncio.sleep(0.02)
+        rtcp.sendto(rr, ("127.0.0.1", egress.rtcp_port))
+        await asyncio.sleep(0.2)
+        assert sub.last_activity > before      # RR proved liveness
+        # force staleness: the sweep removes the output + demux entry
+        sub.last_activity -= app_b.config.rtsp_timeout_sec + 1
+        app_b._sweep_restored()
+        assert app_b._restored_subs == []
+        assert st.num_outputs == 0
+        await push2.close()
+    finally:
+        await app_b.stop()
+        rtp.close()
+        rtcp.close()

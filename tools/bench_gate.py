@@ -122,6 +122,27 @@ def check_trajectory(traj: list[dict],
                 if ph not in PHASES:
                     errs.append(f"{name}: multi_source phase {ph!r} outside "
                                 f"the closed vocabulary {PHASES}")
+        # ISSUE 5 chaos section — OPTIONAL (rounds predating the
+        # resilience subsystem stay valid), but when present its two
+        # headline numbers must be sane: degraded-mode throughput and
+        # the fault-clearance → full-service recovery time the chaos
+        # soak measures
+        ch = extra.get("chaos")
+        if isinstance(ch, dict) and ch and "error" not in ch:
+            dg = ch.get("degraded_pkts_per_sec")
+            if not isinstance(dg, (int, float)) or not math.isfinite(dg) \
+                    or dg <= 0:
+                errs.append(f"{name}: chaos.degraded_pkts_per_sec {dg!r} "
+                            "not a positive finite rate (a chaos run "
+                            "where nothing flowed proves nothing)")
+            rec = ch.get("recovery_sec")
+            if not isinstance(rec, (int, float)) or not math.isfinite(rec) \
+                    or rec < 0:
+                errs.append(f"{name}: chaos.recovery_sec {rec!r} not a "
+                            "finite non-negative duration")
+            elif rec > 30.0:
+                errs.append(f"{name}: chaos.recovery_sec {rec} exceeds "
+                            "the 30 s full-service recovery budget")
     if usable == 0:
         errs.append("every trajectory round is unusable (parsed: null)")
     return errs

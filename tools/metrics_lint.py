@@ -40,7 +40,10 @@ import re
 import sys
 
 NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count")
+#: ``_level`` is the degradation-ladder rung index (resilience/ladder.py)
+#: — a dimensionless ordinal, the same way ``_count`` is
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count",
+                 "_level")
 
 EVENT_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 #: emit("event.name", ...) — the positional literal, plain or f-string
@@ -142,6 +145,53 @@ def lint_phases(registry, phases=None, engines=None) -> list[str]:
     return errs
 
 
+def lint_resilience(registry, schema: dict) -> list[str]:
+    """The resilience contract (ISSUE 5): the fault-injection /
+    degradation-ladder / checkpoint families exist with their exact
+    label sets, the injection-site vocabulary is closed (an open set
+    would shard ``fault_injected_total`` across typo'd sites), and the
+    ``fault.*`` / ``ladder.*`` / ``ckpt.*`` event names are declared —
+    the chaos soak and the flight recorder key on these names."""
+    errs: list[str] = []
+    want_labels = {
+        "fault_injected_total": ("site",),
+        "resilience_ladder_level": ("stream",),
+        "resilience_transitions_total": ("direction",),
+        "resilience_retries_total": (),
+        "resilience_shed_outputs_total": (),
+        "resilience_checkpoint_writes_total": (),
+        "resilience_checkpoint_bytes_total": (),
+        "resilience_checkpoint_restores_total": (),
+        "resilience_checkpoint_errors_total": (),
+    }
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"resilience family {fam_name} missing from the "
+                        "registry")
+            continue
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    from easydarwin_tpu.resilience.inject import SITES
+    fam = None
+    try:
+        fam = registry.get("fault_injected_total")
+    except KeyError:
+        pass
+    if fam is not None:
+        for (site,) in getattr(fam, "_values", {}):
+            if site not in SITES:
+                errs.append(f"fault_injected_total: observed site "
+                            f"{site!r} outside the closed set {SITES}")
+    for name in ("fault.injected", "ladder.degrade", "ladder.recover",
+                 "ladder.shed", "ckpt.save", "ckpt.restore"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -221,6 +271,10 @@ def main() -> int:
             obs.REGISTRY.get(fam)
         except KeyError:
             errs.append(f"megabatch family {fam} missing from the registry")
+    # the resilience subsystem's vocabulary (ISSUE 5): fault sites,
+    # ladder rung gauge, checkpoint counters and the fault.*/ladder.*/
+    # ckpt.* event schema
+    errs += lint_resilience(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -11,8 +11,20 @@ structured-event ring overflow, live phase-attribution histograms
 (``relay_phase_seconds``), and zero SLO burn (no ``slo.violation``
 events counted, no ``slo_budget_remaining_ratio`` at or below zero).
 
-Usage: python tools/soak.py [--duration SECONDS]   (default 120;
-the bare positional form ``soak.py 120`` still works)
+``--chaos [SEED]`` runs the same soak under a seeded FaultPlan
+(resilience/inject.py: 5% ingest drop, periodic egress ENOBUFS +
+latency spikes, device-dispatch failures, stale params) with the engine
+paths and the degradation ladder engaged, clears the faults with ~45 s
+left, and fails on: zero injected faults, zero ladder degradations, any
+``ladder.degrade`` without a matching ``ladder.recover``, any stream
+still below full service at exit, recovery slower than 30 s after
+clearance, nonzero megabatch wire mismatches, or starved players — the
+"never stops serving" half of the contract.  Feature-completeness
+checks that the injected drops legitimately break (HLS muxing/requant
+stats) are asserted only by the clean soak.
+
+Usage: python tools/soak.py [--duration SECONDS] [--chaos [SEED]]
+(default 120; the bare positional form ``soak.py 120`` still works)
 """
 
 from __future__ import annotations
@@ -70,12 +82,31 @@ def parse_metrics(text: str) -> dict[str, float]:
 
 
 def check_metrics(scrapes: list[dict[str, float]], *,
-                  expect_megabatch: bool = False) -> list[str]:
-    """Counter-regression checks over the soak's periodic scrapes."""
+                  expect_megabatch: bool = False,
+                  chaos: bool = False) -> list[str]:
+    """Counter-regression checks over the soak's periodic scrapes.
+
+    ``chaos=True`` (a seeded FaultPlan was armed) skips exactly the
+    checks the plan deliberately violates — injected ENOBUFS are hard
+    errors, injected drops burn the SLO, a shed subscriber dumps its
+    flight box — and adds the resilience invariants instead: faults
+    actually injected, every ladder rung back at full service, and the
+    wire-mismatch/event-hygiene checks that hold under ANY amount of
+    chaos."""
     errs: list[str] = []
     if not scrapes:
         return ["no /metrics scrapes completed"]
     last = scrapes[-1]
+    if chaos:
+        faults = sum(v for k, v in last.items()
+                     if k.startswith("fault_injected_total"))
+        if faults == 0:
+            errs.append("chaos soak injected zero faults (plan never "
+                        "engaged — the run proved nothing)")
+        for k, v in last.items():
+            if k.startswith("resilience_ladder_level") and v != 0:
+                errs.append(f"ladder stuck below full service at exit: "
+                            f"{k} = {v:.0f}")
     # megabatch invariants (ISSUE 4): a device/host param divergence is
     # a wire-corruption bug at ANY time; and a multi-source soak where
     # the scheduler never coalesced a single pass means the megabatch
@@ -90,20 +121,20 @@ def check_metrics(scrapes: list[dict[str, float]], *,
     if last.get("ingest_oversize_dropped_total", 0) > 0:
         errs.append(f"ingest drops: "
                     f"{last['ingest_oversize_dropped_total']:.0f}")
-    if last.get("egress_send_errors_total", 0) > 0:
+    if not chaos and last.get("egress_send_errors_total", 0) > 0:
         errs.append(f"hard egress errors: "
                     f"{last['egress_send_errors_total']:.0f}")
     calls = last.get("egress_sendmmsg_calls_total", 0) \
         + last.get("egress_sendto_calls_total", 0)
     eagain = last.get("egress_eagain_total", 0)
-    if calls and eagain / calls > 0.5:
+    if not chaos and calls and eagain / calls > 0.5:
         errs.append(f"EAGAIN retry ratio {eagain / calls:.2f} > 0.5 "
                     f"({eagain:.0f}/{calls:.0f})")
     lat = sum(v for k, v in last.items()
               if k.startswith("relay_ingest_to_wire_seconds_count"))
     if lat == 0:
         errs.append("relay_ingest_to_wire_seconds histogram stayed empty")
-    if last.get("flight_dumps_total", 0) > 0:
+    if not chaos and last.get("flight_dumps_total", 0) > 0:
         errs.append(f"flight-recorder dumps during a clean soak: "
                     f"{last['flight_dumps_total']:.0f} (a session died "
                     f"abnormally — fetch command=flight for the black box)")
@@ -122,16 +153,19 @@ def check_metrics(scrapes: list[dict[str, float]], *,
         errs.append("relay_phase_seconds histograms stayed empty "
                     "(phase profiler not recording)")
     # SLO burn during a clean soak IS the regression: any violation
-    # event (counted per objective) or an exhausted error budget fails
+    # event (counted per objective) or an exhausted error budget fails.
+    # Under chaos the injected drops/latency are SUPPOSED to burn — the
+    # ladder checks above own the pass/fail there.
     slo_viol = sum(v for k, v in last.items()
                    if k.startswith("slo_violations_total"))
-    if slo_viol > 0:
+    if not chaos and slo_viol > 0:
         errs.append(f"SLO violations during a clean soak: {slo_viol:.0f} "
                     "(fetch command=events / command=flight for the "
                     "burn evidence)")
-    for k, v in last.items():
-        if k.startswith("slo_budget_remaining_ratio") and v <= 0:
-            errs.append(f"SLO error budget exhausted: {k} = {v}")
+    if not chaos:
+        for k, v in last.items():
+            if k.startswith("slo_budget_remaining_ratio") and v <= 0:
+                errs.append(f"SLO error budget exhausted: {k} = {v}")
     # cumulative families must be monotonic across scrapes (a reset
     # mid-run means double-registration or a counter bug)
     for a, b in zip(scrapes, scrapes[1:]):
@@ -233,10 +267,84 @@ def multi_source_section(n_sources: int, seconds: float = 2.0) -> list[str]:
     return errs
 
 
-async def soak(seconds: float, n_sources: int = 0) -> int:
+#: the seeded FaultPlan ``--chaos`` arms (ISSUE 5 acceptance shape: 5%
+#: ingest drop, periodic egress ENOBUFS + latency spikes, frequent
+#: device-dispatch failures, stale-params invalidations)
+CHAOS_PLAN = ("ingest_drop=0.05,egress_enobufs_every=300,"
+              "egress_latency_every=200,egress_latency_us=2000,"
+              "device_error_every=25,stale_params_every=50")
+
+
+def _check_chaos(app, clear_time: float, t_full: float | None,
+                 rx_at_clear: int, fault_window: float,
+                 out_stats: dict) -> list[str]:
+    """The --chaos verdicts (ISSUE 5 acceptance): the plan provoked at
+    least one ladder degradation, every ladder.degrade has a matching
+    ladder.recover, and full service returned within 30 s of fault
+    clearance.  Fills ``out_stats`` with the chaos headline the bench
+    trajectory's optional ``extra.chaos`` section carries (degraded-mode
+    throughput + recovery time, validated by bench_gate --check-only)."""
+    from easydarwin_tpu import obs as obs_mod
+    errs: list[str] = []
+    degrades: dict[str, int] = {}
+    recovers: dict[str, int] = {}
+    for rec in obs_mod.EVENTS.tail():
+        path = rec.get("stream")
+        if rec.get("event") == "ladder.degrade":
+            degrades[path] = degrades.get(path, 0) + 1
+        elif rec.get("event") == "ladder.recover":
+            recovers[path] = recovers.get(path, 0) + 1
+    if not degrades:
+        errs.append("chaos soak provoked zero ladder degradations "
+                    "(the plan never bit — nothing was proven)")
+    for path, n in sorted(degrades.items()):
+        if recovers.get(path, 0) != n:
+            errs.append(f"unrecovered ladder.degrade on {path}: {n} "
+                        f"degrades vs {recovers.get(path, 0)} recovers")
+    now = time.time()
+    if (t_full is None and clear_time and app.ladder is not None
+            and app.ladder.worst_level() == 0):
+        # the last rung recovered between the measurement loop's exit
+        # and these checks (the 1 Hz maintenance task kept ticking):
+        # charge the full elapsed time as an honest UPPER BOUND so a
+        # slow recovery cannot slip past the 30 s budget unmeasured
+        t_full = now
+    if t_full is None:
+        recovery_sec = max(now - clear_time, 0.0)   # still not recovered
+        if app.ladder is not None and app.ladder.worst_level() > 0:
+            errs.append("ladder never returned to full service after "
+                        f"fault clearance: {app.ladder.status()}")
+    else:
+        recovery_sec = max(t_full - clear_time, 0.0)
+        if recovery_sec > 30.0:
+            errs.append(f"recovery to full service took "
+                        f"{recovery_sec:.1f} s (> 30 s budget)")
+    out_stats.update({
+        "degraded_pkts_per_sec":
+            round(rx_at_clear / max(fault_window, 1e-9), 1),
+        # always a finite number (bench_gate's extra.chaos schema
+        # rejects null) — an unrecovered run already failed above
+        "recovery_sec": round(recovery_sec, 2),
+        "degrades": sum(degrades.values()),
+        "recovers": sum(recovers.values()),
+        "ladder": app.ladder.status() if app.ladder is not None else {},
+    })
+    return errs
+
+
+async def soak(seconds: float, n_sources: int = 0,
+               chaos_seed: int | None = None) -> int:
+    chaos = chaos_seed is not None
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
                        access_log_enabled=False)
+    if chaos:
+        # chaos runs the ENGINE paths (that is what degrades): every
+        # output is TPU-eligible, the megabatch engages across the
+        # pushers, and the seeded plan is armed by the server at start
+        cfg.tpu_fanout = True
+        cfg.tpu_min_outputs = 1
+        cfg.resilience_fault_plan = f"seed={chaos_seed},{CHAOS_PLAN}"
     app = StreamingServer(cfg)
     await app.start()
     failures: list[str] = []
@@ -284,6 +392,25 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
                        and any(hasattr(pt.output, "resender")
                                for pt in cn.player_tracks.values())
                        ).player_tracks[1].output
+
+        # plain UDP player on /live/b (no retransmit wrap): the one
+        # output shape that rides the NATIVE sendmmsg fast path, so the
+        # engine's device-param dispatch and the csrc egress fault knobs
+        # are actually exercised (the reliable player's resender wrap
+        # routes it down the batch-header path)
+        udp2_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        udp2_rtp.bind(("127.0.0.1", 0))
+        udp2_rtp.setblocking(False)
+        udp2_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        udp2_rtcp.bind(("127.0.0.1", 0))
+        udp2_rtcp.setblocking(False)
+        plain_player = RtspClient()
+        await plain_player.connect("127.0.0.1", app.rtsp.port)
+        await plain_player.play_start(
+            f"{base}/live/b", tcp=False,
+            client_ports=[(udp2_rtp.getsockname()[1],
+                           udp2_rtcp.getsockname()[1])])
+        udp2_rx = [0]
 
         # --- HLS with the requant rung (REST calls must not block the
         # loop the server itself runs on)
@@ -339,6 +466,14 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
 
         drain_task = asyncio.ensure_future(tcp_drain())
         last_seen_out_seq = None
+        # chaos timeline: faults stay armed until clear_at, then the
+        # remainder of the soak (>= ~45 s at the default duration) is
+        # the recovery budget the ISSUE acceptance pins at 30 s
+        clear_at = max(seconds * 0.4, seconds - 45.0) if chaos else None
+        cleared = False
+        clear_time = 0.0
+        rx_at_clear = 0
+        t_full: float | None = None
         while time.time() - t0 < seconds:
             ts = int(f * 3000)
             for nal in cycle[f % 16]:
@@ -366,6 +501,14 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
                             marker_on_last=(nal[0] & 0x1F == 5)):
                         seq_c += 1
                         push_c.push_packet(0, p)
+            # drain the plain (native-path) UDP player
+            while True:
+                try:
+                    d = udp2_rtp.recv(65536)
+                except BlockingIOError:
+                    break
+                if len(d) >= 12:
+                    udp2_rx[0] += 1
             # drain UDP player + ack its packets (reliable window)
             acked = 0
             while True:
@@ -410,48 +553,75 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
                 st, body = await rest_get("/metrics")
                 assert st == 200
                 scrapes.append(parse_metrics(body.decode()))
+            if chaos and not cleared and time.time() - t0 >= clear_at:
+                from easydarwin_tpu.resilience import INJECTOR
+                INJECTOR.disarm()
+                cleared = True
+                clear_time = time.time()
+                rx_at_clear = tcp_rx[0] + udp_rx[0] + udp2_rx[0]
+            if (chaos and cleared and t_full is None
+                    and app.ladder is not None
+                    and app.ladder.worst_level() == 0):
+                t_full = time.time()   # every rung back at full service
             f += 1
             await asyncio.sleep(0.03)
         await drain_task
 
-        # --- checks
-        st, body = await rest_get("/hls/live/a/q6/index.m3u8")
-        if b"#EXTINF" not in body:
-            failures.append("q6 rendition produced no segments")
+        # --- checks.  Feature-completeness checks (HLS muxing, requant
+        # throughput, drained reliable windows) hold for the CLEAN soak;
+        # under chaos the injected 5% ingest drop legitimately breaks
+        # coded AUs, so chaos asserts the resilience invariants instead.
         entry = app.hls.outputs.get("/live/a")
         q6 = entry.renditions.get("q6") if entry else None
-        if q6 is None or q6.requant.stats.slices_requantized < 10:
-            failures.append(f"requant stats too low: "
-                            f"{q6 and q6.requant.stats}")
-        if q6 is not None and q6.requant.stats.native_slices == 0:
-            failures.append("native requant engine unused")
-        for nm in ("", "q6"):
-            rend = entry.renditions.get(nm) if entry else None
-            if rend is None or rend.audio_samples_muxed == 0:
-                failures.append(f"rendition {nm!r} muxed no audio")
-            elif rend.segments and rend.segments[-1].data.count(b"traf") != 2:
-                failures.append(f"rendition {nm!r} segments not A/V")
         entry_c = app.hls.outputs.get("/live/c")
         q6c = entry_c.renditions.get("q6") if entry_c else None
-        if q6c is None or q6c.requant.stats.slices_requantized < 5:
-            failures.append(f"CABAC requant stats too low: "
-                            f"{q6c and q6c.requant.stats}")
-        if q6c is not None and q6c.requant.stats.slices_passed_through:
-            failures.append(
-                f"CABAC slices passed through unrequanted: "
-                f"{q6c.requant.stats}")
-        if q6c is not None and q6c.requant.stats.native_slices == 0:
-            failures.append("native CABAC requant engine unused")
-        if tcp_rx[0] < f * 0.5:
+        if not chaos:
+            st, body = await rest_get("/hls/live/a/q6/index.m3u8")
+            if b"#EXTINF" not in body:
+                failures.append("q6 rendition produced no segments")
+            if q6 is None or q6.requant.stats.slices_requantized < 10:
+                failures.append(f"requant stats too low: "
+                                f"{q6 and q6.requant.stats}")
+            if q6 is not None and q6.requant.stats.native_slices == 0:
+                failures.append("native requant engine unused")
+            for nm in ("", "q6"):
+                rend = entry.renditions.get(nm) if entry else None
+                if rend is None or rend.audio_samples_muxed == 0:
+                    failures.append(f"rendition {nm!r} muxed no audio")
+                elif rend.segments and \
+                        rend.segments[-1].data.count(b"traf") != 2:
+                    failures.append(f"rendition {nm!r} segments not A/V")
+            if q6c is None or q6c.requant.stats.slices_requantized < 5:
+                failures.append(f"CABAC requant stats too low: "
+                                f"{q6c and q6c.requant.stats}")
+            if q6c is not None and q6c.requant.stats.slices_passed_through:
+                failures.append(
+                    f"CABAC slices passed through unrequanted: "
+                    f"{q6c.requant.stats}")
+            if q6c is not None and q6c.requant.stats.native_slices == 0:
+                failures.append("native CABAC requant engine unused")
+        # "never stops serving": players keep progressing even under the
+        # plan (threshold scaled to the injected 5% drop + shed risk)
+        floor = 0.3 if chaos else 0.5
+        if tcp_rx[0] < f * floor:
             failures.append(f"tcp player starved: {tcp_rx[0]}/{f}")
-        if udp_rx[0] < f * 0.5:
+        if udp_rx[0] < f * floor:
             failures.append(f"udp player starved: {udp_rx[0]}/{f}")
-        if rel_out.resender.in_flight > 200:
+        if udp2_rx[0] < f * floor:
+            failures.append(
+                f"native-path udp player starved: {udp2_rx[0]}/{f}")
+        if not chaos and rel_out.resender.in_flight > 200:
             failures.append(
                 f"reliable window never drains: {rel_out.resender.in_flight}")
-        for eng in app._engines.values():
-            if eng.send_errors:
-                failures.append(f"engine send errors: {eng.send_errors}")
+        if not chaos:
+            for eng in app._engines.values():
+                if eng.send_errors:
+                    failures.append(f"engine send errors: {eng.send_errors}")
+        chaos_stats: dict = {}
+        if chaos:
+            failures.extend(_check_chaos(app, clear_time, t_full,
+                                         rx_at_clear, clear_at,
+                                         chaos_stats))
         # multi-source megabatch section BEFORE the final scrape, so its
         # megabatch_* counters are visible to check_metrics (same
         # process-global registry the server exports)
@@ -462,7 +632,8 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
         if st == 200:
             scrapes.append(parse_metrics(body.decode()))
         failures.extend(check_metrics(scrapes,
-                                      expect_megabatch=n_sources >= 2))
+                                      expect_megabatch=n_sources >= 2,
+                                      chaos=chaos))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -473,6 +644,7 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
             "cabac_shed": q6c.shed if q6c else None,
             "tcp_rx": tcp_rx[0],
             "udp_rx": udp_rx[0],
+            "udp2_rx": udp2_rx[0],
             "reliable_in_flight": rel_out.resender.in_flight,
             "reliable_acks": rel_out.tracker.acks,
             "retransmits": rel_out.resender.resent,
@@ -502,22 +674,25 @@ async def soak(seconds: float, n_sources: int = 0) -> int:
                 for sess in app.registry.sessions.values()
                 for s in sess.streams.values()},
         }
+        if chaos:
+            stats["chaos"] = chaos_stats
         print("SOAK", "FAIL" if failures else "OK", stats)
         for msg in failures:
             print("  -", msg)
         await tcp_player.close()
         await rel_player.close()
+        await plain_player.close()
         await push_a.close()
         await push_c.close()
         await push_b.close()
-        for s in (b_sock, udp_rtp, udp_rtcp):
+        for s in (b_sock, udp_rtp, udp_rtcp, udp2_rtp, udp2_rtcp):
             s.close()
     finally:
         await app.stop()
     return 1 if failures else 0
 
 
-def _parse_args(argv: list[str]) -> tuple[float, int]:
+def _parse_args(argv: list[str]) -> tuple[float, int, int | None]:
     import argparse
     ap = argparse.ArgumentParser(
         description="integration soak (see module docstring)")
@@ -526,15 +701,21 @@ def _parse_args(argv: list[str]) -> tuple[float, int]:
     ap.add_argument("--sources", type=int, default=16, metavar="N",
                     help="multi-source megabatch section stream count "
                          "(default 16; < 2 disables the section)")
+    ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
+                    metavar="SEED",
+                    help="run under a seeded FaultPlan (resilience/"
+                         "inject.py) and assert the degradation ladder "
+                         "recovers to full service; same seed → same "
+                         "injection schedule (default seed 7)")
     ap.add_argument("seconds", nargs="?", type=float, default=None,
                     help="legacy positional form of --duration")
     ns = ap.parse_args(argv)
     if ns.duration is not None and ns.seconds is not None:
         ap.error("give --duration or the positional seconds, not both")
     d = ns.duration if ns.duration is not None else ns.seconds
-    return (120.0 if d is None else d), ns.sources
+    return (120.0 if d is None else d), ns.sources, ns.chaos
 
 
 if __name__ == "__main__":
-    _dur, _src = _parse_args(sys.argv[1:])
-    raise SystemExit(asyncio.run(soak(_dur, _src)))
+    _dur, _src, _chaos = _parse_args(sys.argv[1:])
+    raise SystemExit(asyncio.run(soak(_dur, _src, _chaos)))
