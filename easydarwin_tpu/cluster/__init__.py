@@ -5,6 +5,13 @@ Reference parity: ``EasyProtocol/`` (JSON envelope + message IDs,
 (presence + load keys with TTL), and the EasyCMS daemon
 (``EasyCMS/Server.tproj/HTTPSession.cpp`` device register / list / stream
 start-stop / PTZ / snapshot flows).
+
+The fault-tolerant robustness layer (ISSUE 6) on top:
+``presence.LeaseManager`` (TTL'd fenced leases), ``placement`` (consistent-
+hash stream ownership + fenced claims), ``pull`` (cross-server pull relay
+with retry/backoff/breaker envelope), and ``service.ClusterService``
+(checkpoint publication + live session migration) — see ARCHITECTURE.md
+"Cluster tier".
 """
 
 from . import protocol  # noqa: F401

@@ -81,6 +81,7 @@ class CmsServer:
         self.device_timeout_sec = device_timeout_sec
         self.devices: dict[str, DeviceRecord] = {}
         self._server: asyncio.AbstractServer | None = None
+        self._reap_task: asyncio.Task | None = None
         self.port: int | None = None
         self._pending_push: dict[str, asyncio.Future] = {}
 
@@ -89,14 +90,57 @@ class CmsServer:
         self._server = await asyncio.start_server(
             self._on_connection, self.bind_ip, self.cfg_port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._reap_task = asyncio.create_task(self._reap_loop(),
+                                              name="cms-reap")
 
     async def stop(self) -> None:
+        if self._reap_task is not None:
+            self._reap_task.cancel()
+            try:
+                await self._reap_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reap_task = None
         for d in self.devices.values():
             if d.writer is not None:
                 d.writer.close()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+
+    # ------------------------------------------------------------- reaping
+    def reap(self, now: float | None = None) -> list[str]:
+        """Drop ``DeviceRecord``s whose keepalive lapsed past
+        ``device_timeout_sec`` — without this, every device that ever
+        registered accumulates in ``devices`` forever.  Lapse alone
+        decides: a device behind a silently dropped network never sends
+        FIN, so its writer still looks open — the timer is the only
+        trustworthy liveness signal (any message from a bound device
+        refreshes it).  Each reap closes the stale writer and emits one
+        ``cms.device_offline`` event; returns the reaped serials."""
+        now = time.time() if now is None else now
+        gone = [serial for serial, rec in self.devices.items()
+                if now - rec.last_seen > self.device_timeout_sec]
+        for serial in gone:
+            rec = self.devices.pop(serial)
+            self._pending_push.pop(serial, None)
+            if rec.writer is not None:
+                try:
+                    rec.writer.close()
+                except Exception:
+                    pass
+            EVENTS.emit("cms.device_offline", level="warn", serial=serial,
+                        name=rec.name)
+        return gone
+
+    async def _reap_loop(self) -> None:
+        interval = max(self.device_timeout_sec / 5.0, 1.0)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.reap()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ sessions
     async def _on_connection(self, reader: asyncio.StreamReader,
@@ -110,6 +154,9 @@ class CmsServer:
                 reply, bound = await self._dispatch(msg, writer, bound_device)
                 if bound is not None:
                     bound_device = bound
+                if bound_device is not None:
+                    # any traffic from a bound device IS its keepalive
+                    bound_device.last_seen = time.time()
                 if reply is not None:
                     writer.write(_frame(reply, request=False))
                     await writer.drain()

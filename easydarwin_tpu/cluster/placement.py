@@ -1,0 +1,174 @@
+"""Consistent-hash stream placement over live leases.
+
+Who serves ``/live/cam1``?  The reference answers with the CMS's
+least-loaded pick at stream-start and nothing afterwards — a dead server
+is an outage for its streams.  Here placement is a pure function of the
+LIVE lease set (``presence.ClusterRegistry``): every node hashes to
+``vnodes`` points on a ring, a stream belongs to the first node
+clockwise of its own hash, and when a lease expires the ring shrinks —
+each orphaned stream lands on a DETERMINISTIC successor every surviving
+node computes identically, so adoption needs no coordinator and no
+election.  Node join/leave moves only ~1/N of the streams (the
+consistent-hashing contract, pinned by ``tests/test_cluster_failover``).
+
+Ownership is materialized as fenced ``Own:{path}`` records (claim token
+= the claimant's freshly minted fencing token), so the ring decides who
+*should* own while the fence decides whose writes *count* — a zombie
+ex-owner that re-appears computes the same ring everyone else does, but
+its stale claim token loses every fenced write
+(``cluster_lease_fence_rejected_total``) and it must release the stream
+instead of double-serving it.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import zlib
+
+from .. import obs
+from .presence import ClusterRegistry
+
+OWN_KEY_PREFIX = "Own:"
+#: virtual points per node: enough that a 2..16-node ring splits paths
+#: evenly, few enough that building the ring stays trivial
+DEFAULT_VNODES = 64
+
+
+def _h(s: str) -> int:
+    return zlib.crc32(s.encode()) & 0xFFFFFFFF
+
+
+def own_key(path: str) -> str:
+    return f"{OWN_KEY_PREFIX}{path.strip('/')}"
+
+
+class HashRing:
+    """Classic consistent-hash ring; order-insensitive in its node set
+    (the ring is sorted by point, not by insertion)."""
+
+    def __init__(self, nodes, vnodes: int = DEFAULT_VNODES):
+        self.nodes = sorted(set(nodes))
+        self.vnodes = vnodes
+        self._points: list[tuple[int, str]] = sorted(
+            (_h(f"{n}#{i}"), n) for n in self.nodes for i in range(vnodes))
+        self._keys = [p for p, _ in self._points]
+
+    def rank(self, path: str) -> list[str]:
+        """Every node, in deterministic preference order for ``path``
+        (clockwise ring walk, distinct nodes) — ``rank[0]`` is the
+        owner, ``rank[1]`` the first failover successor."""
+        if not self._points:
+            return []
+        start = bisect.bisect_left(self._keys, _h(path.strip("/")))
+        seen: list[str] = []
+        for i in range(len(self._points)):
+            _, n = self._points[(start + i) % len(self._points)]
+            if n not in seen:
+                seen.append(n)
+                if len(seen) == len(self.nodes):
+                    break
+        return seen
+
+    def owner(self, path: str) -> str | None:
+        r = self.rank(path)
+        return r[0] if r else None
+
+
+class PlacementService:
+    """Placement decisions + fenced ownership claims for one node."""
+
+    def __init__(self, redis, node_id: str, *,
+                 vnodes: int = DEFAULT_VNODES, events=None):
+        self.redis = redis
+        self.node_id = node_id
+        self.vnodes = vnodes
+        self._events = events if events is not None else obs.EVENTS
+        #: last observed owner per path — placement-move edge detection
+        self._observed: dict[str, str] = {}
+
+    async def live_nodes(self) -> dict[str, dict]:
+        return await ClusterRegistry.live_nodes(self.redis)
+
+    def ring(self, nodes) -> HashRing:
+        return HashRing(nodes, self.vnodes)
+
+    async def resolve(self, path: str,
+                      nodes: dict[str, dict] | None = None
+                      ) -> tuple[str, dict] | None:
+        """The node currently responsible for ``path``: a LIVE claimant
+        recorded in ``Own:{path}`` wins (placement is sticky while the
+        owner lives); otherwise the consistent-hash owner over the live
+        lease set — the deterministic re-placement every peer agrees on
+        when a lease expires.  None when the cluster is empty."""
+        if nodes is None:
+            nodes = await self.live_nodes()
+        if not nodes:
+            return None
+        claimed = await self.claimant(path)
+        if claimed is not None and claimed in nodes:
+            self._note(path, claimed)
+            return claimed, nodes[claimed]
+        owner = self.ring(nodes).owner(path)
+        if owner is None:
+            return None
+        self._note(path, owner)
+        return owner, nodes[owner]
+
+    async def claimant(self, path: str) -> str | None:
+        """The node recorded in ``Own:{path}`` (live or not)."""
+        cur = await self.redis.fget(own_key(path))
+        if cur is None:
+            return None
+        try:
+            rec = json.loads(cur[1])
+        except ValueError:
+            return None
+        # non-dict JSON / missing node (a corrupt or operator-written
+        # record) must read as "unclaimed", not crash the caller's tick
+        # or fabricate a truthy "None" phantom node id
+        node = rec.get("node") if isinstance(rec, dict) else None
+        return str(node) if node else None
+
+    def _note(self, path: str, owner: str) -> None:
+        prev = self._observed.get(path)
+        self._observed[path] = owner
+        if prev is not None and prev != owner:
+            obs.CLUSTER_PLACEMENT_MOVES.inc()
+            self._events.emit("cluster.placement_move", stream=path,
+                              owner=owner, prev=prev)
+
+    def forget(self, path: str) -> None:
+        self._observed.pop(path, None)
+
+    # -- fenced claims -----------------------------------------------------
+    def claim_command(self, path: str, token: int, *, ttl: int = 0):
+        """The pipeline-able form of :meth:`claim` (fenced EVAL fset);
+        pair each pipelined reply with :meth:`claim_result`."""
+        from .redis_client import FENCE_SET_LUA
+        return ("EVAL", FENCE_SET_LUA, 1, own_key(path), int(token),
+                json.dumps({"node": self.node_id}, separators=(",", ":")),
+                int(ttl))
+
+    def claim_result(self, path: str, ok) -> bool:
+        """Book one claim attempt's outcome (move note / rejection
+        counter + event); returns the boolean verdict."""
+        if ok:
+            self._note(path, self.node_id)
+        else:
+            obs.CLUSTER_LEASE_FENCE_REJECTED.inc()
+            self._events.emit("cluster.fence_rejected", level="warn",
+                              node=self.node_id, key=own_key(path),
+                              stream=path)
+        return bool(ok)
+
+    async def claim(self, path: str, token: int, *, ttl: int = 0) -> bool:
+        """Record this node as ``path``'s owner, fenced by ``token``.
+        False = a newer token holds the record (we are the zombie)."""
+        ok = await self.redis.execute(
+            *self.claim_command(path, token, ttl=ttl))
+        return self.claim_result(path, ok)
+
+    async def release(self, path: str, token: int) -> bool:
+        self.forget(path)
+        return await self.redis.fdel(own_key(path), token)

@@ -1,4 +1,4 @@
-"""Cluster presence + load balancing over Redis.
+"""Cluster presence + leases + load balancing over Redis.
 
 Reference parity: ``EasyRedisHandler.cpp`` —
 * ``EasyDarwin:{id}`` presence hash {IP, HTTP, RTSP, Load} with 15 s TTL,
@@ -9,15 +9,128 @@ Reference parity: ``EasyRedisHandler.cpp`` —
   ``RedisGetAssociatedDarwin``).
 A dead server or stale stream simply ages out of discovery — liveness *is*
 the TTL, exactly the reference's failure-detection story (SURVEY §5).
+
+The robustness tier (ISSUE 6) grows this into a real Lease/Registry
+pair: :class:`LeaseManager` heartbeats a TTL'd **fenced** lease
+(``Node:{id}`` = ``token:json``, token minted from the global
+``Cluster:fence`` INCR counter at every acquire) and
+:class:`ClusterRegistry` reads the live lease set peers place streams
+against.  The fencing token is the split-brain guard: a zombie whose
+lease lapsed during a partition re-acquires with a NEW token, so every
+write it fences with its OLD token is rejected (``fset`` → False) and it
+must release the streams it thinks it still owns instead of
+double-serving them.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
+import time
+
+from .. import obs
 
 SERVER_TTL_SEC = 15          # EasyRedisHandler.cpp:177
 STREAM_TTL_SEC = 150         # EasyRedisHandler.cpp:272
 TICK_SEC = 5                 # RunServer.cpp:642
+
+#: global monotonic fencing-token counter (INCR — strictly increasing
+#: across every node, so "newer claim" is a total order)
+FENCE_COUNTER_KEY = "Cluster:fence"
+#: per-node lease key prefix (fenced value: ``token:json-meta``)
+NODE_KEY_PREFIX = "Node:"
+
+
+class LeaseManager:
+    """One server's TTL'd, fenced lease in Redis.
+
+    ``acquire`` mints a fresh fencing token and writes the lease;
+    ``heartbeat`` re-asserts the TTL while the stored token is still
+    ours, and on observed loss (TTL expiry during a partition, injected
+    ``lease_loss`` fault) counts ``cluster_lease_lost_total`` and
+    re-acquires with a NEW token — from that moment every claim fenced
+    with the old token is stale by construction."""
+
+    def __init__(self, redis, node_id: str, *, ttl_sec: float = 5.0,
+                 meta: dict | None = None, events=None):
+        self.redis = redis
+        self.node_id = node_id
+        self.ttl_sec = max(1, int(round(ttl_sec)))
+        self.meta = dict(meta or {})
+        self.token: int | None = None
+        self.acquired_at = 0.0
+        self.losses = 0
+        self._events = events if events is not None else obs.EVENTS
+
+    @property
+    def key(self) -> str:
+        return f"{NODE_KEY_PREFIX}{self.node_id}"
+
+    def payload(self) -> str:
+        return json.dumps({"node": self.node_id, **self.meta},
+                          separators=(",", ":"))
+
+    async def acquire(self) -> int:
+        self.token = int(await self.redis.incr(FENCE_COUNTER_KEY))
+        await self.redis.fset(self.key, self.token, self.payload(),
+                              ttl=self.ttl_sec)
+        self.acquired_at = time.monotonic()
+        obs.CLUSTER_LEASE_ACQUIRED.inc()
+        self._events.emit("cluster.lease_acquire", node=self.node_id,
+                          token=self.token)
+        return self.token
+
+    async def heartbeat(self) -> bool:
+        """Re-assert the lease TTL; returns False when the lease was
+        found lost/stolen (a fresh one has been re-acquired — the caller
+        must treat its pre-loss stream claims as stale)."""
+        if self.token is None:
+            await self.acquire()
+            return False
+        from ..resilience import INJECTOR
+        if INJECTOR.active and INJECTOR.lease_loss():
+            await self.redis.delete(self.key)   # simulated TTL expiry
+        cur = await self.redis.fget(self.key)
+        if cur is None or cur[0] != self.token:
+            self.losses += 1
+            obs.CLUSTER_LEASE_LOST.inc()
+            self._events.emit("cluster.lease_lost", level="warn",
+                              node=self.node_id)
+            await self.acquire()
+            return False
+        await self.redis.fset(self.key, self.token, self.payload(),
+                              ttl=self.ttl_sec)
+        obs.CLUSTER_LEASE_RENEWALS.inc()
+        return True
+
+    async def release(self) -> None:
+        if self.token is not None:
+            await self.redis.fdel(self.key, self.token)
+            self.token = None
+
+
+class ClusterRegistry:
+    """Read side of the lease set: the live node list placement runs
+    over.  A node is alive iff its ``Node:{id}`` lease still exists —
+    failure detection IS the TTL, no extra gossip."""
+
+    @staticmethod
+    async def live_nodes(redis) -> dict[str, dict]:
+        """``node_id -> {"token": int, **meta}`` for every live lease."""
+        from .redis_client import scan_fenced
+        out: dict[str, dict] = {}
+        for key, (token, payload) in \
+                (await scan_fenced(redis, NODE_KEY_PREFIX)).items():
+            try:
+                meta = json.loads(payload)
+            except ValueError:
+                continue
+            if not isinstance(meta, dict):
+                continue            # corrupt lease payload: skip it
+            node = str(meta.get("node") or key[len(NODE_KEY_PREFIX):])
+            meta["token"] = token
+            out[node] = meta
+        return out
 
 
 class PresenceService:

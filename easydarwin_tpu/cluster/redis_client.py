@@ -2,11 +2,28 @@
 
 Reference parity: ``EasyRedisClient`` (vendored hiredis + C++ wrapper:
 connect-with-timeout, command, pipeline) — rebuilt as a small asyncio RESP2
-codec.  ``InMemoryRedis`` implements the command subset the presence layer
-uses (hset/hgetall/expire/setex/del/keys/ttl/get/set/ping) with an
-injectable clock, serving as the hermetic test backend; ``MiniRedisServer``
-wraps it behind real RESP sockets so the wire codec is integration-tested
-without a redis installation.
+codec.  ``InMemoryRedis`` implements the command subset the cluster tier
+uses (hset/hgetall/expire/setex/del/keys/ttl/get/set/setnx/incr/ping plus
+the fenced lease ops below) with an injectable clock, serving as the
+hermetic test backend; ``MiniRedisServer`` wraps it behind real RESP
+sockets so the wire codec is integration-tested without a redis
+installation.
+
+**Robustness contract** (ISSUE 6): every ``AsyncRedis`` command runs under
+a per-command timeout covering connect+write+read — a hung or partitioned
+Redis raises :class:`RedisTimeout` instead of wedging the caller forever —
+with ONE transparent reconnect attempt (the connection is assumed stale,
+not the server dead); failures count ``redis_errors_total`` and the caller
+degrades gracefully (a lease that cannot be renewed simply ages out and a
+peer takes over).
+
+**Fencing** (split-brain guard): fenced records are stored as
+``"<token>:<payload>"`` strings.  :meth:`AsyncRedis.fset` writes only when
+no NEWER token holds the key and :meth:`AsyncRedis.fdel` deletes only a
+same-or-older token — both atomic server-side via ``EVAL`` (real Redis
+runs the Lua; ``InMemoryRedis``/``MiniRedisServer`` recognize the exact
+scripts and dispatch to equivalent atomic backend ops, so the single
+client code path is integration-tested over real RESP sockets too).
 """
 
 from __future__ import annotations
@@ -19,6 +36,71 @@ from typing import Any
 
 class RedisError(Exception):
     pass
+
+
+class RedisTimeout(RedisError):
+    """A command exceeded its per-command timeout (hung/partitioned
+    Redis); the connection has been dropped."""
+
+
+#: fenced SET: write "<token>:<payload>" unless the stored token is newer.
+#: Returns 1 on write, 0 on fence rejection (a newer owner holds the key).
+FENCE_SET_LUA = (
+    "local cur = redis.call('GET', KEYS[1]) "
+    "if cur then "
+    "local t = tonumber(string.match(cur, '^(%d+):')) "
+    "if t and t > tonumber(ARGV[1]) then return 0 end end "
+    "redis.call('SET', KEYS[1], ARGV[1] .. ':' .. ARGV[2]) "
+    "if tonumber(ARGV[3]) > 0 then "
+    "redis.call('EXPIRE', KEYS[1], ARGV[3]) end "
+    "return 1")
+
+#: fenced DEL: delete only when the stored token is same-or-older than
+#: ours (a release must never destroy a NEWER claimant's record).
+FENCE_DEL_LUA = (
+    "local cur = redis.call('GET', KEYS[1]) "
+    "if not cur then return 1 end "
+    "local t = tonumber(string.match(cur, '^(%d+):')) "
+    "if t and t > tonumber(ARGV[1]) then return 0 end "
+    "redis.call('DEL', KEYS[1]) "
+    "return 1")
+
+
+def split_fenced(raw) -> tuple[int, str] | None:
+    """``"<token>:<payload>"`` → ``(token, payload)``; None when the
+    value is missing or not fenced-formatted."""
+    if raw is None:
+        return None
+    if isinstance(raw, bytes):
+        raw = raw.decode()
+    tok, sep, payload = str(raw).partition(":")
+    if not sep or not tok.isdigit():
+        return None
+    return int(tok), payload
+
+
+async def scan_fenced(redis, prefix: str) -> dict[str, tuple[int, str]]:
+    """Every live fenced record under ``prefix`` as ``key -> (token,
+    payload)`` — one KEYS + one pipelined GET batch (two roundtrips
+    regardless of record count; a deployment sharing a huge keyspace
+    would swap KEYS for a maintained set).  The lease registry and the
+    migration scan both go through here so the decode/skip rules cannot
+    drift apart."""
+    keys = await redis.keys(f"{prefix}*")
+    if not keys:
+        return {}
+    raws = await redis.pipeline([("GET", k) for k in keys])
+    out: dict[str, tuple[int, str]] = {}
+    for key, raw in zip(keys, raws):
+        cur = split_fenced(raw)
+        if cur is not None:
+            out[key] = cur
+    return out
+
+
+def _count_error() -> None:
+    from .. import obs
+    obs.REDIS_ERRORS.inc()
 
 
 # --------------------------------------------------------------- wire codec
@@ -66,6 +148,10 @@ class AsyncRedis:
         self.host, self.port, self.timeout = host, port, timeout
         self._r: asyncio.StreamReader | None = None
         self._w: asyncio.StreamWriter | None = None
+        #: one in-flight roundtrip at a time: concurrent callers sharing
+        #: this connection must not interleave writes/reads, or replies
+        #: pair with the wrong commands
+        self._lock = asyncio.Lock()
 
     async def connect(self) -> None:
         self._r, self._w = await asyncio.wait_for(
@@ -80,20 +166,62 @@ class AsyncRedis:
     def connected(self) -> bool:
         return self._w is not None and not self._w.is_closing()
 
-    async def execute(self, *args) -> Any:
-        if not self.connected:
-            await self.connect()
-        self._w.write(encode_command(*args))
-        await self._w.drain()
-        return await asyncio.wait_for(read_reply(self._r), self.timeout)
-
-    async def pipeline(self, commands: list[tuple]) -> list[Any]:
+    async def _roundtrip(self, commands: list[tuple]) -> list[Any]:
         if not self.connected:
             await self.connect()
         self._w.write(b"".join(encode_command(*c) for c in commands))
         await self._w.drain()
-        return [await asyncio.wait_for(read_reply(self._r), self.timeout)
-                for _ in commands]
+        return [await read_reply(self._r) for _ in commands]
+
+    async def _guarded(self, commands: list[tuple]) -> list[Any]:
+        """One per-command-timeout roundtrip with ONE transparent
+        reconnect: a stale/hung connection (idle timeout, failover, the
+        peer restarted) is retried on a fresh socket; a second failure
+        surfaces — the server really is unreachable.  RedisError replies
+        (``-ERR ...``) are protocol-level and never retried."""
+        async with self._lock:
+            for attempt in (0, 1):
+                try:
+                    return await asyncio.wait_for(
+                        self._roundtrip(commands), self.timeout)
+                except RedisError:
+                    # a protocol-level error reply (-ERR ...) mid-batch
+                    # leaves the REMAINING replies unread in the socket
+                    # buffer — keeping the connection would pair them
+                    # with the NEXT commands.  Drop it and surface; the
+                    # next command reconnects cleanly.
+                    await self.close()
+                    raise
+                except asyncio.CancelledError:
+                    # caller cancelled mid-roundtrip (a pull being
+                    # retired, service stop): the command was already
+                    # written, so its un-read reply would pair with the
+                    # NEXT command — same desync as the -ERR case
+                    await self.close()
+                    raise
+                except asyncio.TimeoutError:
+                    _count_error()
+                    await self.close()
+                    if attempt:
+                        raise RedisTimeout(
+                            f"redis command timed out after "
+                            f"{self.timeout}s")
+                except (ConnectionError, asyncio.IncompleteReadError,
+                        OSError) as e:
+                    # RedisTimeout/RedisError subclass none of these, so
+                    # protocol errors propagate immediately
+                    _count_error()
+                    await self.close()
+                    if attempt:
+                        raise RedisError(
+                            f"redis connection failed: {e}") from e
+            raise RedisError("unreachable")
+
+    async def execute(self, *args) -> Any:
+        return (await self._guarded([args]))[0]
+
+    async def pipeline(self, commands: list[tuple]) -> list[Any]:
+        return await self._guarded(list(commands))
 
     # convenience
     async def ping(self) -> bool:
@@ -121,6 +249,39 @@ class AsyncRedis:
     async def keys(self, pattern: str) -> list[str]:
         raw = await self.execute("KEYS", pattern) or []
         return [k.decode() if isinstance(k, bytes) else k for k in raw]
+
+    async def get(self, key: str) -> str | None:
+        raw = await self.execute("GET", key)
+        return raw.decode() if isinstance(raw, bytes) else raw
+
+    async def set(self, key: str, value: str, *, ex: int = 0) -> None:
+        if ex > 0:
+            await self.execute("SET", key, value, "EX", ex)
+        else:
+            await self.execute("SET", key, value)
+
+    async def setnx(self, key: str, value: str) -> bool:
+        return bool(await self.execute("SETNX", key, value))
+
+    async def incr(self, key: str) -> int:
+        return int(await self.execute("INCR", key))
+
+    # -- fenced lease ops (split-brain guard) ------------------------------
+    async def fset(self, key: str, token: int, payload: str,
+                   ttl: int = 0) -> bool:
+        """Write ``token:payload`` unless a NEWER token holds ``key``;
+        True on write, False on fence rejection (atomic via EVAL)."""
+        return bool(await self.execute(
+            "EVAL", FENCE_SET_LUA, 1, key, token, payload, ttl))
+
+    async def fget(self, key: str) -> tuple[int, str] | None:
+        return split_fenced(await self.execute("GET", key))
+
+    async def fdel(self, key: str, token: int) -> bool:
+        """Delete ``key`` only when its stored token is same-or-older;
+        True when the key is gone afterwards."""
+        return bool(await self.execute(
+            "EVAL", FENCE_DEL_LUA, 1, key, token))
 
 
 # ------------------------------------------------------------ in-memory fake
@@ -161,12 +322,49 @@ class InMemoryRedis:
     async def hgetall(self, key: str) -> dict:
         return dict(self._data.get(key, {})) if self._alive(key) else {}
 
-    async def set(self, key: str, value: str) -> None:
+    async def set(self, key: str, value: str, *, ex: int = 0) -> None:
         self._data[key] = str(value)
         self._expiry.pop(key, None)
+        if ex > 0:
+            self._expiry[key] = self._clock() + ex
 
     async def get(self, key: str):
         return self._data.get(key) if self._alive(key) else None
+
+    async def setnx(self, key: str, value: str) -> bool:
+        if self._alive(key):
+            return False
+        await self.set(key, value)
+        return True
+
+    async def incr(self, key: str) -> int:
+        cur = int(self._data.get(key, "0")) if self._alive(key) else 0
+        cur += 1
+        self._data[key] = str(cur)
+        # a fresh INCR revives an expired key with NO TTL (real-Redis
+        # semantics — a stale expiry would reset the counter forever)
+        self._expiry.pop(key, None)
+        return cur
+
+    # -- fenced lease ops (the EVAL scripts' atomic equivalents) -----------
+    async def fset(self, key: str, token: int, payload: str,
+                   ttl: int = 0) -> bool:
+        cur = split_fenced(await self.get(key))
+        if cur is not None and cur[0] > int(token):
+            return False
+        await self.set(key, f"{int(token)}:{payload}",
+                       ex=int(ttl) if ttl else 0)
+        return True
+
+    async def fget(self, key: str) -> tuple[int, str] | None:
+        return split_fenced(await self.get(key))
+
+    async def fdel(self, key: str, token: int) -> bool:
+        cur = split_fenced(await self.get(key))
+        if cur is not None and cur[0] > int(token):
+            return False
+        await self.delete(key)
+        return True
 
     async def expire(self, key: str, seconds: int) -> None:
         if self._alive(key):
@@ -185,6 +383,9 @@ class InMemoryRedis:
     async def keys(self, pattern: str = "*") -> list[str]:
         return [k for k in list(self._data) if self._alive(k)
                 and fnmatch.fnmatch(k, pattern)]
+
+    async def pipeline(self, commands: list) -> list:
+        return [await self.execute(*c) for c in commands]
 
     async def execute(self, *args):
         cmd = args[0].upper()
@@ -210,13 +411,36 @@ class InMemoryRedis:
         if cmd == "KEYS":
             return [k.encode() for k in await self.keys(args[1])]
         if cmd == "SET":
-            await self.set(args[1], args[2])
+            ex = 0
+            rest = [str(a).upper() if isinstance(a, str) else a
+                    for a in args[3:]]
+            if "EX" in rest:
+                ex = int(args[3 + rest.index("EX") + 1])
+            if "NX" in rest and self._alive(args[1]):
+                return None
+            await self.set(args[1], args[2], ex=ex)
             return "OK"
         if cmd == "GET":
             v = await self.get(args[1])
             return None if v is None else str(v).encode()
         if cmd == "TTL":
             return await self.ttl(args[1])
+        if cmd == "SETNX":
+            return 1 if await self.setnx(args[1], args[2]) else 0
+        if cmd == "INCR":
+            return await self.incr(args[1])
+        if cmd == "EVAL":
+            # recognized scripts only: the two fencing ops the cluster
+            # tier uses, dispatched to their atomic backend equivalents
+            # (real Redis runs the Lua itself — one client code path)
+            script = args[1]
+            if script == FENCE_SET_LUA:
+                return 1 if await self.fset(
+                    args[3], int(args[4]), str(args[5]),
+                    int(float(args[6]))) else 0
+            if script == FENCE_DEL_LUA:
+                return 1 if await self.fdel(args[3], int(args[4])) else 0
+            raise RedisError("unsupported EVAL script")
         raise RedisError(f"unsupported command {cmd}")
 
 

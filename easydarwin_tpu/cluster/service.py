@@ -1,0 +1,515 @@
+"""The fault-tolerant cluster service: one node's membership duties.
+
+Ties the pieces into the failure story ROADMAP item 2 names (and the
+reference never had — an EasyDarwin death was an outage for its streams):
+
+* **lease** — heartbeat a TTL'd fenced lease (``presence.LeaseManager``)
+  plus the reference-shaped ``EasyDarwin:{id}``/``Live:{name}`` presence
+  records the CMS reads;
+* **claims** — every locally-sourced stream is claimed in Redis
+  (``placement.PlacementService``), fenced by a fresh token minted at
+  claim time; refreshes that lose the fence mean a NEWER owner exists —
+  this node is the zombie and releases the stream's cluster duties
+  instead of double-serving;
+* **checkpoint publication** — each owned stream's PR 5 checkpoint
+  (ring cursors, rewrite 5-tuples, RR accounting — plain ints) is
+  published to ``Ckpt:{name}`` each tick, fenced by the claim token, so
+  the stream's recovery state exists OUTSIDE the process that may die;
+* **migration** — each tick scans ownership records; a claimant whose
+  lease is gone triggers deterministic re-placement (consistent hash
+  over the live lease set) and, when this node is the successor, it
+  mints a fresh token, claims, and hot-restores the published
+  checkpoint: same ssrc, gapless rewritten seq, UDP subscribers
+  re-pointed without re-SETUP (``cluster_migrations_total``);
+* **pulls** — a subscriber landing here for a stream another node owns
+  is served through a ``cluster.pull.RemotePull`` (retry/backoff/breaker
+  envelope, owner re-resolution, ladder coupling);
+* **drain** — planned handoff: publish fresh checkpoints for everything
+  owned, release the lease, and let the peers' normal migration scan
+  adopt within one tick (no TTL wait).
+
+The service runs its own asyncio task at ``heartbeat_sec``; every tick
+is guarded — a partitioned Redis (real or injected ``redis_partition``)
+skips the tick, the lease ages toward expiry, and the cluster treats
+this node exactly like a dead one.  That symmetry is the design: there
+is ONE failure path, and chaos soaks drive it on purpose.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from .. import obs
+from ..resilience.checkpoint import CKPT_VERSION, snapshot_session
+from .placement import OWN_KEY_PREFIX, PlacementService
+from .presence import FENCE_COUNTER_KEY, LeaseManager, PresenceService
+from .pull import PullConfig, RemotePull
+from .redis_client import FENCE_SET_LUA, RedisTimeout
+
+CKPT_KEY_PREFIX = "Ckpt:"
+
+
+def ckpt_key(path: str) -> str:
+    return f"{CKPT_KEY_PREFIX}{path.strip('/')}"
+
+
+class ClusterConfig:
+    """Mirrored from the ``cluster_*`` ServerConfig keys (plain class:
+    the app fills ports at start once listeners are bound)."""
+
+    def __init__(self, node_id: str, *, ip: str = "127.0.0.1",
+                 rtsp_port: int = 0, http_port: int = 0,
+                 lease_ttl_sec: float = 5.0, heartbeat_sec: float = 1.0,
+                 vnodes: int = 64, own_ttl_sec: float = 30.0,
+                 migration_ttl_sec: float = 30.0,
+                 pull: PullConfig | None = None):
+        self.node_id = node_id
+        self.ip = ip
+        self.rtsp_port = rtsp_port
+        self.http_port = http_port
+        self.lease_ttl_sec = lease_ttl_sec
+        self.heartbeat_sec = heartbeat_sec
+        self.vnodes = vnodes
+        self.own_ttl_sec = own_ttl_sec
+        self.migration_ttl_sec = migration_ttl_sec
+        self.pull = pull or PullConfig()
+
+
+class ClusterService:
+    """One server's cluster membership: lease + claims + checkpoint
+    publication + migration + remote pulls."""
+
+    def __init__(self, redis, config: ClusterConfig, *, registry,
+                 pull_manager=None, restore_doc=None, on_pull_failure=None,
+                 on_fence_lost=None, error_log=None, events=None):
+        self.redis = redis
+        self.config = config
+        self.registry = registry
+        self.pull_manager = pull_manager
+        #: app hook: ``restore_doc(doc) -> (sessions, outputs)`` rebuilds
+        #: sessions + UDP subscribers from a checkpoint document
+        self.restore_doc = restore_doc
+        self.on_pull_failure = on_pull_failure
+        #: app hook: a NEWER owner fenced us out of this path — the DATA
+        #: PLANE must stop serving it here (close the local source, drop
+        #: restored stand-ins, remove the session); popping the Redis
+        #: claim alone would leave two nodes transmitting to the same
+        #: subscribers
+        self.on_fence_lost = on_fence_lost
+        self.error_log = error_log
+        self._events = events if events is not None else obs.EVENTS
+        self.lease = LeaseManager(
+            redis, config.node_id, ttl_sec=config.lease_ttl_sec,
+            meta={"ip": config.ip, "rtsp": config.rtsp_port,
+                  "http": config.http_port})
+        self.placement = PlacementService(redis, config.node_id,
+                                          vnodes=config.vnodes)
+        #: reference-shaped presence (EasyDarwin:/Live: records) so the
+        #: CMS's least-loaded pick keeps working against cluster nodes
+        self.presence = PresenceService(
+            redis, config.node_id, ip=config.ip,
+            rtsp_port=config.rtsp_port, http_port=config.http_port)
+        #: locally-claimed paths -> claim fencing token
+        self._claims: dict[str, int] = {}
+        #: adoptions whose checkpoint restore did not materialize a
+        #: session yet: path -> (claim token, tries).  Retried each tick
+        #: so a transient restore failure cannot strand the stream with
+        #: a live claim and no server behind it.
+        self._adopt_retry: dict[str, tuple[int, int]] = {}
+        #: path -> RemotePull for streams served here but owned elsewhere
+        self.pulls: dict[str, RemotePull] = {}
+        self._task: asyncio.Task | None = None
+        self._running = False
+        self.ticks = 0
+        self.migrations = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        self._running = True
+        self.lease.meta = {"ip": self.config.ip,
+                           "rtsp": self.config.rtsp_port,
+                           "http": self.config.http_port}
+        self.presence.rtsp_port = self.config.rtsp_port
+        self.presence.http_port = self.config.http_port
+        try:
+            await self.lease.acquire()
+            await self.presence.assert_presence()
+        except Exception as e:
+            self._warn(f"cluster start: {e!r}")
+        self._task = asyncio.create_task(self._loop(), name="cluster")
+
+    async def stop(self, *, drain: bool = True) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        for rp in list(self.pulls.values()):
+            await rp.stop()
+        self.pulls.clear()
+        if drain:
+            try:
+                await self.drain()
+            except Exception as e:
+                self._warn(f"cluster drain: {e!r}")
+
+    async def drain(self) -> None:
+        """Planned handoff: final fresh checkpoints for every claim,
+        then release the lease — the ownership records stay, so peers'
+        migration scan adopts within one tick instead of a TTL wait."""
+        for path, tok in list(self._claims.items()):
+            try:
+                await self._publish_ckpt(path, tok)
+            except Exception:
+                pass
+        self._events.emit("cluster.drain", node=self.config.node_id,
+                          streams=len(self._claims))
+        try:
+            await self.presence.stop()
+        except Exception:
+            pass
+        await self.lease.release()
+
+    def crash(self) -> None:
+        """Abrupt death for tests/chaos: stop ticking WITHOUT releasing
+        the lease or claims — peers must detect this node via TTL expiry,
+        exactly as a SIGKILL'd process would look."""
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def _warn(self, msg: str) -> None:
+        if self.error_log is not None:
+            self.error_log.warning(msg)
+
+    # -- the tick ----------------------------------------------------------
+    async def _loop(self) -> None:
+        while self._running:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a partitioned Redis (RedisTimeout — real or injected)
+                # skips the tick; the lease ages toward expiry and peers
+                # treat this node as dead — the ONE failure path
+                self._warn(f"cluster tick: {e!r}")
+            await asyncio.sleep(self.config.heartbeat_sec)
+
+    async def tick(self) -> None:
+        from ..resilience import INJECTOR
+        if INJECTOR.active and INJECTOR.redis_partition():
+            raise RedisTimeout("injected redis partition")
+        self.ticks += 1
+        await self.lease.heartbeat()
+        nodes = await self.placement.live_nodes()
+        await self._claim_local_sources(nodes)
+        await self._retry_adoptions()
+        await self._migration_scan(nodes)
+        await self._sweep_pulls()
+        # reference-shaped presence for the CMS tier.  Only locally-
+        # SOURCED paths are advertised: a pull replica writing (and on
+        # retirement DELETing) the owner's Live:{name} record would flap
+        # and blank the owner's still-valid advertisement.
+        self.presence.set_load(sum(
+            s.num_outputs for s in self.registry.sessions.values()))
+        try:
+            await self.presence.assert_presence()
+            await self.presence.sync_streams(self.local_source_paths())
+        except Exception:
+            pass
+
+    # -- claims + checkpoint publication -----------------------------------
+    def local_source_paths(self) -> list[str]:
+        """Paths fed by a LOCAL source (pusher, file broadcast, adopted
+        migration) — everything in the registry except our own remote
+        pulls (those belong to their upstream owner)."""
+        pulled = set(self.pulls)
+        return [p for p in self.registry.paths() if p not in pulled]
+
+    async def _claim_local_sources(self, nodes: dict) -> None:
+        cfg = self.config
+        local = self.local_source_paths()
+        # fresh claims (rare: a source just attached) stay individual —
+        # they need a claimant read + a minted token first
+        for path in local:
+            if path in self._claims:
+                continue
+            claimant = await self.placement.claimant(path)
+            if claimant and claimant != cfg.node_id and claimant in nodes:
+                # a LIVE peer owns this path (we may be a zombie with a
+                # still-connected source): do not fight it
+                continue
+            tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
+            if await self.placement.claim(path, tok,
+                                          ttl=int(cfg.own_ttl_sec)):
+                self._claims[path] = tok
+            else:
+                self._fence_lost(path)
+        # steady state: ONE pipelined batch refreshes every claim and
+        # ONE publishes every checkpoint — per-stream roundtrips would
+        # serialize behind the connection lock and crowd the heartbeat
+        claimed = [(p, self._claims[p]) for p in local if p in self._claims]
+        if claimed:
+            replies = await self.redis.pipeline(
+                [self.placement.claim_command(p, t, ttl=int(cfg.own_ttl_sec))
+                 for p, t in claimed])
+            publishes = []
+            for (path, tok), ok in zip(claimed, replies):
+                if not self.placement.claim_result(path, ok):
+                    # fence lost: a newer owner claimed while we were
+                    # away — release the stream, cluster AND data plane
+                    self._claims.pop(path, None)
+                    self._fence_lost(path)
+                    continue
+                cmd = self._publish_cmd(path, tok)
+                if cmd is not None:
+                    publishes.append(cmd)
+            if publishes:
+                await self.redis.pipeline(publishes)
+        # claims for sessions that no longer exist locally are released
+        for path in [p for p in self._claims
+                     if self.registry.find(p) is None]:
+            tok = self._claims.pop(path)
+            try:
+                await self.placement.release(path, tok)
+                await self.redis.fdel(ckpt_key(path), tok)
+            except Exception:
+                pass
+
+    def _fence_lost(self, path: str) -> None:
+        """A newer fencing token holds this path: hand the stream's
+        DATA PLANE back too (placement already counted the rejection)."""
+        if self.on_fence_lost is None:
+            return
+        try:
+            self.on_fence_lost(path)
+        except Exception as e:
+            self._warn(f"fence-lost release {path}: {e!r}")
+
+    def _publish_cmd(self, path: str, token: int):
+        """The pipeline-able checkpoint publish (fenced EVAL fset), or
+        None when the session has nothing restorable."""
+        sess_doc = snapshot_session(self.registry, path)
+        if sess_doc is None:
+            return None
+        doc = {"version": CKPT_VERSION,
+               "saved_wall": round(time.time(), 3),
+               "node": self.config.node_id,
+               "sessions": [sess_doc]}
+        return ("EVAL", FENCE_SET_LUA, 1, ckpt_key(path), int(token),
+                json.dumps(doc, separators=(",", ":")),
+                int(self.config.migration_ttl_sec))
+
+    async def _publish_ckpt(self, path: str, token: int) -> bool:
+        cmd = self._publish_cmd(path, token)
+        if cmd is None:
+            return False
+        await self.redis.execute(*cmd)
+        return True
+
+    # -- migration ---------------------------------------------------------
+    async def _migration_scan(self, nodes: dict) -> None:
+        """Adopt any stream whose recorded owner's lease is gone and
+        whose deterministic successor (consistent hash over the LIVE
+        lease set) is this node."""
+        from .redis_client import scan_fenced
+        cfg = self.config
+        ring = self.placement.ring(nodes)
+        records = await scan_fenced(self.redis, OWN_KEY_PREFIX)
+        for key, (_token, payload) in records.items():
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or not rec.get("node"):
+                continue            # corrupt record: skip, don't abort
+            holder = str(rec["node"])
+            if holder == cfg.node_id or holder in nodes:
+                continue                      # live owner (or us)
+            path = "/" + key[len(OWN_KEY_PREFIX):]
+            if ring.owner(path) != cfg.node_id:
+                continue                      # a different successor
+            await self._adopt(path, holder)
+
+    async def _adopt(self, path: str, from_node: str) -> None:
+        cfg = self.config
+        raw_ckpt = await self.redis.fget(ckpt_key(path))
+        tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
+        if not await self.placement.claim(path, tok,
+                                          ttl=int(cfg.own_ttl_sec)):
+            return                            # lost an adoption race
+        # drop any pull we were running toward the dead owner: the
+        # stream is OURS now and the source will re-attach here
+        rp = self.pulls.pop(path, None)
+        if rp is not None:
+            await rp.stop()
+        n_out = self._try_restore(path, raw_ckpt)
+        if self.registry.find(path) is None:
+            # restore didn't materialize a session (transient factory/
+            # egress failure): HOLD the fenced claim but park the path
+            # for per-tick retry — recording it in _claims now would let
+            # the stale-claim cleanup delete the published checkpoint,
+            # destroying the only recovery state that exists
+            self._adopt_retry[path] = (tok, 0)
+            if raw_ckpt is not None:
+                await self.redis.fset(ckpt_key(path), tok, raw_ckpt[1],
+                                      ttl=int(cfg.migration_ttl_sec))
+            return
+        await self._finish_adoption(path, tok, n_out, from_node)
+
+    async def _finish_adoption(self, path: str, tok: int, n_out: int,
+                               from_node: str) -> None:
+        """Book one completed adoption: claim recorded, checkpoint
+        re-published under OUR token (a second failover keeps working),
+        migration counted + latched event."""
+        self._claims[path] = tok
+        await self._publish_ckpt(path, tok)
+        self.migrations += 1
+        obs.CLUSTER_MIGRATIONS.inc()
+        self._events.emit("cluster.migrate", level="warn", stream=path,
+                          from_node=from_node, outputs=n_out)
+
+    def _try_restore(self, path: str, raw_ckpt) -> int:
+        """Run the app's restore hook on a fenced checkpoint payload;
+        returns outputs restored (0 on failure — the caller decides
+        whether a session materialized)."""
+        if raw_ckpt is None or self.restore_doc is None:
+            return 0
+        try:
+            _, n_out = self.restore_doc(json.loads(raw_ckpt[1]))
+            return n_out
+        except Exception as e:
+            obs.RESILIENCE_CKPT_ERRORS.inc()
+            self._warn(f"migration restore {path}: {e!r}")
+            return 0
+
+    async def _retry_adoptions(self) -> None:
+        """Finish adoptions whose restore failed transiently; a path
+        whose checkpoint is gone or that keeps failing is released so
+        the ownership record doesn't point at a server with nothing
+        behind it."""
+        for path, (tok, tries) in list(self._adopt_retry.items()):
+            if path in self._claims:
+                # the source re-attached and _claim_local_sources minted
+                # a NEWER claim while this adoption was parked: the live
+                # session wins — installing the stale parked token would
+                # fence US out next tick and tear the healthy stream down
+                del self._adopt_retry[path]
+                continue
+            raw_ckpt = await self.redis.fget(ckpt_key(path))
+            n_out = self._try_restore(path, raw_ckpt)
+            if self.registry.find(path) is not None:
+                del self._adopt_retry[path]
+                await self._finish_adoption(path, tok, n_out, "retry")
+            elif raw_ckpt is None or tries + 1 >= 10:
+                del self._adopt_retry[path]
+                await self.placement.release(path, tok)
+            else:
+                self._adopt_retry[path] = (tok, tries + 1)
+
+    # -- remote pulls -------------------------------------------------------
+    async def describe(self, path: str) -> str | None:
+        """RTSP DESCRIBE fallback: a path another node owns is served
+        locally through a pull relay; returns the SDP once the pull's
+        session exists (None → the caller 404s).  A pull is started only
+        for a path with a LIVE ownership claim — the hash ring names an
+        'owner' for EVERY string, so without this gate a path-scanning
+        client would turn each bogus DESCRIBE into a multi-tick
+        cross-server retry loop."""
+        if self.pull_manager is None:
+            return None
+        nodes = await self.placement.live_nodes()
+        claimant = await self.placement.claimant(path)
+        if (not claimant or claimant == self.config.node_id
+                or claimant not in nodes):
+            return None               # no live source anywhere: 404
+        rp = self.ensure_pull(path)
+        deadline = time.monotonic() + self.config.pull.connect_timeout_sec
+        while time.monotonic() < deadline:
+            text = self.registry.sdp_cache.get(path)
+            if text is not None:
+                return text
+            if rp.breaker.state == "open":
+                break
+            await asyncio.sleep(0.05)
+        return self.registry.sdp_cache.get(path)
+
+    def ensure_pull(self, path: str) -> RemotePull:
+        rp = self.pulls.get(path)
+        if rp is None:
+            import zlib
+            rp = RemotePull(
+                path, lambda: self._owner_url(path), self.pull_manager,
+                self.config.pull,
+                # crc32, not hash(): the jitter schedule must be the
+                # same across processes (hash() is salt-randomized)
+                seed=zlib.crc32(
+                    f"{self.config.node_id}#{path}".encode()) & 0xFFFF,
+                on_failure=self.on_pull_failure)
+            self.pulls[path] = rp
+            rp.start()
+        return rp
+
+    async def _owner_url(self, path: str) -> str | None:
+        """Re-resolve the owner's pull URL (placement-aware: a migrated
+        stream is re-pulled from its NEW owner automatically)."""
+        res = await self.placement.resolve(path)
+        if res is None:
+            return None
+        node, meta = res
+        if node == self.config.node_id:
+            return None                       # we became the owner
+        ip, port = meta.get("ip"), meta.get("rtsp")
+        if not ip or not port:
+            return None
+        return f"rtsp://{ip}:{int(port)}{path}"
+
+    async def _sweep_pulls(self) -> None:
+        """Retire pulls whose local audience left.  The idle budget
+        covers the whole DESCRIBE wait window (connect timeout) plus
+        one tick of SETUP-in-flight slack — the sweep must never win a
+        race against a describe() that is still legitimately waiting on
+        this pull's first SDP."""
+        budget = max(2, int(self.config.pull.connect_timeout_sec
+                            / max(self.config.heartbeat_sec, 0.05)) + 1)
+        for path, rp in list(self.pulls.items()):
+            sess = self.registry.find(path)
+            if (sess is not None and sess.owner is not None
+                    and sess.owner is not rp
+                    and sess.owner is not rp._pull):
+                # a LOCAL source adopted this session (a pusher was
+                # directed here and re-ANNOUNCEd): the pull is
+                # superseded — retire it so the path leaves self.pulls
+                # and the claim machinery takes ownership next tick;
+                # two feeds must never share one session
+                self.pulls.pop(path, None)
+                await rp.stop()
+                continue
+            idle = sess is None or sess.num_outputs == 0
+            rp.idle_strikes = rp.idle_strikes + 1 if idle else 0
+            if rp.idle_strikes >= budget:
+                self.pulls.pop(path, None)
+                await rp.stop()
+                if (sess is not None
+                        and self.registry.find(path) is sess
+                        and sess.owner is rp):
+                    self.registry.remove(path)
+
+    # -- introspection ------------------------------------------------------
+    def status(self) -> dict:
+        return {
+            "node": self.config.node_id,
+            "lease_token": self.lease.token,
+            "claims": dict(self._claims),
+            "pulls": {p: {"alive": rp.alive, "retries": rp.retries,
+                          "breaker": rp.breaker.state}
+                      for p, rp in self.pulls.items()},
+            "migrations": self.migrations,
+            "ticks": self.ticks,
+        }

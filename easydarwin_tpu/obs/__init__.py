@@ -14,7 +14,11 @@ ARCHITECTURE.md "Observability" and "Phase attribution & SLO".
 
 from .events import EVENTS, EventLog  # noqa: F401
 from .families import (  # noqa: F401  (re-exported inventory)
-    EGRESS_BUSY_SECONDS, EGRESS_BYTES, EGRESS_EAGAIN, EGRESS_GSO_SEGMENTS,
+    CLUSTER_LEASE_ACQUIRED, CLUSTER_LEASE_FENCE_REJECTED,
+    CLUSTER_LEASE_LOST, CLUSTER_LEASE_RENEWALS, CLUSTER_MIGRATIONS,
+    CLUSTER_PLACEMENT_MOVES, CLUSTER_PULL_BREAKER_OPEN,
+    CLUSTER_PULL_RETRIES, EGRESS_BUSY_SECONDS, EGRESS_BYTES, EGRESS_EAGAIN,
+    EGRESS_GSO_SEGMENTS,
     EGRESS_GSO_SUPERS, EGRESS_PACKETS, EGRESS_SENDMMSG_CALLS,
     EGRESS_SENDTO_CALLS, EGRESS_SEND_ERRORS, EVENTS_DROPPED, EVENTS_EMITTED,
     EVENTS_INVALID, EVENTS_SINK_FAILURES, FAULT_INJECTED, FLIGHT_DUMPS,
@@ -22,7 +26,8 @@ from .families import (  # noqa: F401  (re-exported inventory)
     INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS,
     MEGABATCH_FALLBACK, MEGABATCH_PASSES, MEGABATCH_STREAMS,
     MEGABATCH_WIRE_MISMATCH, PROFILE_PHASE_DRIFT, QOS_FRACTION_LOST,
-    QOS_JITTER, QOS_THICKENS, QOS_THINS, REGISTRY, RELAY_INGEST_TO_WIRE,
+    QOS_JITTER, QOS_THICKENS, QOS_THINS, REDIS_ERRORS, REGISTRY,
+    RELAY_INGEST_TO_WIRE,
     RELAY_PHASE_SECONDS, RESILIENCE_CKPT_BYTES, RESILIENCE_CKPT_ERRORS,
     RESILIENCE_CKPT_RESTORES, RESILIENCE_CKPT_WRITES,
     RESILIENCE_LADDER_LEVEL, RESILIENCE_RETRIES, RESILIENCE_SHED_OUTPUTS,

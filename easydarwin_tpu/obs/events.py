@@ -72,6 +72,21 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "cms.rpc": ("msg_type",),
     "cms.register": ("serial",),
     "cms.push_stream": ("serial", "url"),
+    # lapsed-keepalive device reaping (cluster/cms.py)
+    "cms.device_offline": ("serial",),
+    # cluster robustness tier (cluster/presence.py, placement.py,
+    # pull.py, service.py): leases + fencing, placement moves, the pull
+    # retry/breaker envelope, and checkpoint-driven migration.  All
+    # latched per transition, never per tick.
+    "cluster.lease_acquire": ("node", "token"),
+    "cluster.lease_lost": ("node",),
+    "cluster.fence_rejected": ("node", "key"),
+    "cluster.placement_move": ("owner", "prev"),
+    "cluster.pull_retry": ("url", "attempt"),
+    "cluster.breaker_open": ("url", "failures"),
+    "cluster.breaker_close": ("url",),
+    "cluster.migrate": ("from_node", "outputs"),
+    "cluster.drain": ("node", "streams"),
     # flight recorder (obs/flight.py)
     "flight.dump": ("reason",),
     # SLO watchdog (obs/slo.py): one per burn-window rising edge (latched,

@@ -260,3 +260,53 @@ RESILIENCE_CKPT_ERRORS = REGISTRY.counter(
     "resilience_checkpoint_errors_total",
     "Checkpoint write/parse failures (full disk, version mismatch, "
     "malformed session record); the server keeps serving either way")
+
+# --------------------------------------------------------------- cluster tier
+# The fault-tolerant cluster layer (easydarwin_tpu/cluster/): Redis
+# leases + fencing, consistent-hash stream placement, cross-server pull
+# relay with retry/breaker envelope, and checkpoint-driven live session
+# migration.  tools/metrics_lint.py enforces this family set and
+# tools/soak.py --cluster keys on it.
+REDIS_ERRORS = REGISTRY.counter(
+    "redis_errors_total",
+    "Redis commands that failed (timeout, connection error, partition — "
+    "real or injected); the caller degrades gracefully, a lapsed lease "
+    "simply ages out and a peer takes over")
+CLUSTER_LEASE_ACQUIRED = REGISTRY.counter(
+    "cluster_lease_acquired_total",
+    "Server leases acquired in Redis (boot + every re-acquire after an "
+    "observed loss); each acquire mints a fresh monotonic fencing token")
+CLUSTER_LEASE_RENEWALS = REGISTRY.counter(
+    "cluster_lease_renewals_total",
+    "Successful lease heartbeat renewals (TTL re-asserted while the "
+    "stored fencing token still matches ours)")
+CLUSTER_LEASE_LOST = REGISTRY.counter(
+    "cluster_lease_lost_total",
+    "Heartbeats that found our lease gone or stolen (TTL expiry during "
+    "a partition, injected lease loss); the server re-acquires with a "
+    "NEW fencing token, so its pre-loss claims are now stale")
+CLUSTER_LEASE_FENCE_REJECTED = REGISTRY.counter(
+    "cluster_lease_fence_rejected_total",
+    "Fenced Redis writes rejected because a NEWER fencing token holds "
+    "the record — the split-brain guard firing: a zombie ex-owner came "
+    "back and must release the stream instead of double-serving it")
+CLUSTER_PLACEMENT_MOVES = REGISTRY.counter(
+    "cluster_placement_moves_total",
+    "Stream ownership moves observed by the placement layer (consistent-"
+    "hash re-placement after a node joined, left, or its lease expired)")
+CLUSTER_PULL_RETRIES = REGISTRY.counter(
+    "cluster_pull_retries_total",
+    "Cross-server pull-relay restart attempts taken by the retry/backoff "
+    "envelope (connect timeout, upstream EOF, read stall — each retry "
+    "waits a capped jittered exponential backoff first)")
+CLUSTER_PULL_BREAKER_OPEN = REGISTRY.counter(
+    "cluster_pull_breaker_open_total",
+    "Pull-relay circuit-breaker open transitions (N consecutive failures "
+    "against one upstream; while open no connect is attempted until the "
+    "half-open probe window)")
+CLUSTER_MIGRATIONS = REGISTRY.counter(
+    "cluster_migrations_total",
+    "Live session migrations completed: this node adopted a stream whose "
+    "owner's lease expired (or drained), restored its Redis-published "
+    "checkpoint (same ssrc, gapless rewritten seq) and re-pointed the "
+    "subscribers without re-SETUP")

@@ -31,6 +31,18 @@ class PullError(Exception):
     pass
 
 
+#: strong refs to detached cleanup tasks — the event loop holds tasks
+#: weakly, so an unreferenced fire-and-forget task can be GC'd before
+#: it runs (the documented asyncio pitfall)
+_CLEANUP_TASKS: set = set()
+
+
+def _spawn_cleanup(coro) -> None:
+    t = asyncio.get_running_loop().create_task(coro)
+    _CLEANUP_TASKS.add(t)
+    t.add_done_callback(_CLEANUP_TASKS.discard)
+
+
 def parse_rtsp_url(url: str) -> tuple[str, int, str]:
     u = urlparse(url)
     if u.scheme != "rtsp" or not u.hostname:
@@ -63,6 +75,12 @@ class PullRelay:
         try:
             await asyncio.wait_for(self.client.connect(host, port), timeout)
             sd = await self.client.play_start(self.url, tcp=True)
+        except asyncio.CancelledError:
+            # a caller-side timeout (e.g. the cluster envelope's
+            # wait_for) cancels us mid-handshake: the connected socket
+            # and its reader task must not leak on every retry
+            await self.client.close()
+            raise
         except (OSError, asyncio.TimeoutError, AssertionError) as e:
             await self.client.close()
             raise PullError(f"upstream {self.url}: {e}") from e
@@ -158,7 +176,13 @@ class PullRelayManager:
         self.pulls: dict[str, PullRelay] = {}
         self._lock = asyncio.Lock()         # concurrent REST start/stop
 
-    async def start_pull(self, local_path: str, url: str) -> PullRelay:
+    async def start_pull(self, local_path: str, url: str, *,
+                         adopt: bool = False) -> PullRelay:
+        """``adopt=True`` (the cluster pull envelope) reuses an existing
+        session on the path instead of refusing it: a restarted pull
+        must feed the SAME session so local subscribers survive the
+        upstream hiccup (the envelope re-owns the session, so the dead
+        pull's teardown never removed it)."""
         key = local_path.rstrip("/") or "/"
         async with self._lock:
             old = self.pulls.get(key)
@@ -169,11 +193,19 @@ class PullRelayManager:
                 # socket, drop its stale session/SDP) before restarting
                 self.pulls.pop(key, None)
                 await old.stop()
-            elif self.registry.find(key) is not None:
+            elif not adopt and self.registry.find(key) is not None:
                 raise PullError(f"{key} already has a live session")
             pull = PullRelay(key, url, self.registry,
                              on_packet=self.on_packet)
-            await pull.start()
+            try:
+                await pull.start()
+            except asyncio.CancelledError:
+                # cancelled between a successful start and registration:
+                # retire the fully-alive pull from a fresh task (this
+                # one is being torn down) so its forward loop and socket
+                # don't feed the session as an untracked duplicate
+                _spawn_cleanup(pull.stop())
+                raise
             self.pulls[key] = pull
             return pull
 

@@ -86,20 +86,29 @@ def _snapshot_stream(st) -> dict:
     }
 
 
+def snapshot_session(registry, path: str) -> dict | None:
+    """One session's serializable record (the cluster tier publishes
+    these per-stream to Redis for migration); None when the session is
+    missing or not restorable (no cached SDP)."""
+    sess = registry.find(path)
+    if sess is None:
+        return None
+    sdp = registry.sdp_cache.get(sess.path)
+    if sdp is None:
+        return None
+    return {
+        "path": sess.path,
+        "sdp": sdp,
+        "streams": [_snapshot_stream(st) for st in sess.streams.values()],
+    }
+
+
 def snapshot_registry(registry) -> dict:
     """One serializable document for every live relay session (pure
     reads — safe from the pump's maintenance block)."""
-    sessions = []
-    for sess in registry.sessions.values():
-        sdp = registry.sdp_cache.get(sess.path)
-        if sdp is None:
-            continue                  # not restorable without its SDP
-        sessions.append({
-            "path": sess.path,
-            "sdp": sdp,
-            "streams": [_snapshot_stream(st)
-                        for st in sess.streams.values()],
-        })
+    sessions = [doc for sess in registry.sessions.values()
+                if (doc := snapshot_session(registry, sess.path))
+                is not None]
     return {"version": CKPT_VERSION, "saved_wall": round(time.time(), 3),
             "sessions": sessions}
 
@@ -111,6 +120,14 @@ def _restore_stream(st, rec: dict, output_factory) -> int:
     # the bytes are gone; the id space continues — every bookmark and
     # eviction invariant holds with an empty [head, head) window
     ring.head = ring.tail = head
+    # merging into a LIVE session (cluster migration onto a node that
+    # was pull-serving this path): pre-existing subscribers' bookmarks
+    # live in the old local id space — one ahead of the restored head
+    # would stall silently until new ids caught up.  Resume them at the
+    # next ingested packet, exactly like the restored outputs below.
+    for out in st.outputs:
+        if out.bookmark is not None and out.bookmark > head:
+            out.bookmark = head
     kf = rec.get("keyframe_id")
     st.keyframe_id = int(kf) if kf is not None else None
     st.reporter_ssrc = int(rec.get("reporter_ssrc", st.reporter_ssrc))

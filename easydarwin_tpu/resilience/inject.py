@@ -47,10 +47,16 @@ from dataclasses import dataclass, fields, replace
 from .. import obs
 
 #: the closed injection-site vocabulary (the ``site`` label of
-#: ``fault_injected_total``; ``egress_native`` is counted by csrc)
+#: ``fault_injected_total``; ``egress_native`` is counted by csrc).
+#: The cluster sites (ISSUE 6): ``lease_loss`` deletes this node's own
+#: Redis lease mid-heartbeat (a simulated TTL expiry — peers adopt its
+#: streams), ``redis_partition`` makes a cluster tick's Redis access
+#: time out, ``pull_stall`` freezes a cross-server pull's read loop so
+#: the retry/backoff envelope must recover it.
 SITES = ("ingest_drop", "ingest_reorder", "ingest_corrupt",
          "egress_native", "device_dispatch", "stale_params",
-         "slow_subscriber")
+         "slow_subscriber", "lease_loss", "redis_partition",
+         "pull_stall")
 
 #: minimum seconds between ``fault.injected`` events per site
 EMIT_INTERVAL_S = 1.0
@@ -87,6 +93,10 @@ class FaultPlan:
     # write reports WOULD_BLOCK; 0.05 is NOT a probability — it coerces
     # to 0 and disables the site) ----------------------------------------
     slow_sub_every: int = 0
+    # -- cluster tier (deterministic every-N; see SITES above) -----------
+    lease_loss_every: int = 0          # Nth heartbeat finds the lease gone
+    redis_partition_every: int = 0     # Nth cluster tick's Redis times out
+    pull_stall_every: int = 0          # Nth pull liveness probe stalls
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -305,6 +315,35 @@ class FaultInjector:
                                         p.slow_sub_every):
             return False
         self._note("slow_subscriber")
+        return True
+
+    # -- cluster sites ----------------------------------------------------
+    def lease_loss(self) -> bool:
+        """True when this heartbeat should find its lease gone (the
+        caller deletes its own lease key — indistinguishable from a TTL
+        expiry to every peer)."""
+        p = self.plan
+        if p is None or not self._every("lease_loss", p.lease_loss_every):
+            return False
+        self._note("lease_loss")
+        return True
+
+    def redis_partition(self) -> bool:
+        """True when this cluster tick's Redis access should time out."""
+        p = self.plan
+        if p is None or not self._every("redis_partition",
+                                        p.redis_partition_every):
+            return False
+        self._note("redis_partition")
+        return True
+
+    def pull_stall(self) -> bool:
+        """True when a cross-server pull's liveness probe should treat
+        the upstream as stalled (forcing the retry envelope)."""
+        p = self.plan
+        if p is None or not self._every("pull_stall", p.pull_stall_every):
+            return False
+        self._note("pull_stall")
         return True
 
 

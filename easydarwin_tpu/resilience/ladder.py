@@ -132,10 +132,14 @@ class DegradationLadder:
 
     # -- error inputs -----------------------------------------------------
     def note_device_error(self, path: str | None,
-                          now: float | None = None) -> None:
+                          now: float | None = None, *,
+                          reason: str = "device_errors") -> None:
         """A device-path failure (dispatch exception, injected fault) on
         one stream: retry with exponential backoff; past ``max_retries``
-        consecutive failures, drop one rung (0→1 or 1→2)."""
+        consecutive failures, drop one rung (0→1 or 1→2).  The cluster
+        pull envelope charges upstream-pull failures through the same
+        machinery with ``reason="pull_errors"`` — a broken pull degrades
+        the stream's rung, it never kills the session."""
         if path is None:
             return
         now = self._clock() if now is None else now
@@ -155,7 +159,7 @@ class DegradationLadder:
             h.backoff_until = now + backoff
             self._retries.inc()
         else:
-            self._degrade(path, h, now, reason="device_errors")
+            self._degrade(path, h, now, reason=reason)
 
     def note_device_ok(self, path: str | None,
                        now: float | None = None) -> None:

@@ -133,6 +133,10 @@ class StreamingServer:
         from .status import StatusMonitor
         self.status = StatusMonitor(self)
         self.presence = None
+        #: fault-tolerant cluster tier (cluster/service.py) — built in
+        #: start() once the listener ports are known
+        self.cluster = None
+        self._user_describe_fallback = describe_fallback
         self._redis_client = redis_client
         self.config.on_change(self._on_config_change)
 
@@ -202,7 +206,27 @@ class StreamingServer:
         if self.config.stats_interval_sec or self.config.status_file_path:
             self._tasks.append(
                 asyncio.create_task(self._status_loop(), name="status"))
-        if self.config.cloud_enabled:
+        if self.config.cluster_enabled:
+            # the fault-tolerant tier: lease + placement + pull relay +
+            # migration.  It subsumes the passive presence records, so
+            # cloud_enabled presence is skipped when it runs.
+            from ..cluster.redis_client import AsyncRedis
+            from ..cluster.service import ClusterService
+            redis = self._redis_client or AsyncRedis(
+                self.config.redis_host, self.config.redis_port)
+            ccfg = self.config.cluster_config()
+            ccfg.rtsp_port = self.rtsp.port or self.config.rtsp_port
+            ccfg.http_port = self.rest.port or self.config.service_port
+            self.cluster = ClusterService(
+                redis, ccfg, registry=self.registry,
+                pull_manager=self.pulls,
+                restore_doc=self._cluster_restore,
+                on_pull_failure=self._on_pull_failure,
+                on_fence_lost=self._cluster_fence_lost,
+                error_log=self.error_log)
+            await self.cluster.start()
+            self.rtsp.describe_fallback = self._cluster_describe
+        elif self.config.cloud_enabled:
             from ..cluster.presence import PresenceService
             from ..cluster.redis_client import AsyncRedis
             redis = self._redis_client or AsyncRedis(
@@ -231,6 +255,15 @@ class StreamingServer:
             INJECTOR.disarm()
             self._armed_faults = False
         self.rtsp.modules.run_shutdown(self)
+        if self.cluster is not None:
+            # planned drain: fresh checkpoints published + lease released
+            # while the registry is still intact, so peers adopt within
+            # one tick instead of a TTL wait
+            try:
+                await self.cluster.stop(drain=True)
+            except Exception:
+                pass
+            self.cluster = None
         if self.presence is not None:
             await self.presence.stop()
             self.presence = None
@@ -281,23 +314,96 @@ class StreamingServer:
         out.rtcp_addr = (rtcp[0], int(rtcp[1]))
         return out
 
-    def _adopt_restored_outputs(self) -> None:
+    def _adopt_restored_outputs(self, paths=None, exclude_ids=()) -> None:
         """Give every just-restored UDP output a connection stand-in:
         register it with the shared-egress RTCP demux (quality feedback
         + liveness proof flow again) and track it for the silence sweep.
-        Runs only right after restore, when every output in the registry
-        IS a restored one."""
+        At startup every output in the registry IS a restored one; a
+        mid-run migration restore passes ``paths`` (the restored
+        sessions) and ``exclude_ids`` (outputs that existed before the
+        restore) so live subscribers are never double-registered."""
         egress = self.rtsp.shared_egress
         if egress is None:
             return
+        exclude = set(exclude_ids)
         for sess in self.registry.sessions.values():
+            if paths is not None and sess.path not in paths:
+                continue
             for tid, stream in sess.streams.items():
                 for out in stream.outputs:
-                    if getattr(out, "native_addr", None) is None:
+                    if getattr(out, "native_addr", None) is None \
+                            or id(out) in exclude:
                         continue
                     sub = _RestoredSubscriber(sess, tid, stream, out)
                     self._restored_subs.append(sub)
                     egress.register(out, sub)
+
+    def _cluster_restore(self, doc: dict) -> tuple[int, int]:
+        """Cluster migration hook: rebuild the adopted stream's sessions
+        + UDP subscribers from its Redis-published checkpoint.  The
+        subscribers' address pairs ARE their transport, so the players
+        are re-pointed at this node without re-SETUP."""
+        from ..resilience.checkpoint import restore_registry
+        paths = {s.get("path") for s in doc.get("sessions", ())}
+        pre = {id(o)
+               for p in paths if p
+               for sess in (self.registry.find(p),) if sess is not None
+               for st in sess.streams.values() for o in st.outputs}
+        n_sess, n_out = restore_registry(
+            self.registry, doc, output_factory=self._restored_output)
+        if n_out:
+            self._adopt_restored_outputs(paths=paths, exclude_ids=pre)
+        self._wake()
+        return n_sess, n_out
+
+    def _on_pull_failure(self, path: str) -> None:
+        """Cluster pull envelope → ladder coupling: an upstream pull
+        failure degrades the stream's rung, never kills the session."""
+        if self.ladder is not None:
+            self.ladder.note_device_error(path, reason="pull_errors")
+
+    def _cluster_fence_lost(self, path: str) -> None:
+        """A NEWER owner fenced us out of ``path``: stop serving it on
+        THIS node.  Dropping only the Redis claim would leave a zombie
+        data plane — two nodes transmitting the same ssrc to the same
+        subscribers.  The local source connection is closed (the device
+        re-registers and re-pushes to the new owner — the reference
+        recovery protocol), restored stand-ins are unregistered and the
+        session removed."""
+        sess = self.registry.find(path)
+        if sess is None:
+            return
+        egress = self.rtsp.shared_egress
+        for sub in [s for s in self._restored_subs if s.path == path]:
+            self._restored_subs.remove(sub)
+            if egress is not None:
+                egress.unregister(sub.output, sub)
+        from ..relay.pull import _spawn_cleanup
+        for conn in [c for c in list(self.rtsp.connections)
+                     if c.is_pusher and c.path == path]:
+            if conn.writer is not None:
+                try:
+                    conn.writer.close()
+                except Exception:
+                    pass
+            _spawn_cleanup(conn.close())
+        if self.registry.find(path) is sess:
+            self.registry.remove(path)
+
+    async def _cluster_describe(self, path: str):
+        """DESCRIBE fallback under cluster mode: a path another node
+        owns is served locally through the pull envelope; any
+        user-supplied fallback still gets the last word."""
+        text = None
+        if self.cluster is not None:
+            try:
+                text = await self.cluster.describe(path)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(f"cluster describe: {e!r}")
+        if text is None and self._user_describe_fallback is not None:
+            text = await self._user_describe_fallback(path)
+        return text
 
     def _sweep_restored(self) -> None:
         """Reap restored subscribers whose player never proved itself:

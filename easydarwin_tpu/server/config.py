@@ -70,6 +70,24 @@ class ServerConfig:
     cms_host: str = "127.0.0.1"
     cms_port: int = 10000
     wan_ip: str = "127.0.0.1"
+    # --- fault-tolerant cluster tier (cluster/service.py: Redis leases +
+    # fencing, consistent-hash placement, cross-server pull relay,
+    # checkpoint-driven live session migration).  Supersedes the passive
+    # cloud_enabled presence when on.
+    cluster_enabled: bool = False
+    cluster_lease_ttl_sec: float = 5.0     # lease TTL = failure-detect time
+    cluster_heartbeat_sec: float = 1.0     # service tick cadence
+    cluster_vnodes: int = 64               # ring points per node
+    cluster_own_ttl_sec: float = 30.0      # Own:{path} record TTL
+    cluster_migration_ttl_sec: float = 30.0  # Ckpt:{path} record TTL
+    # cross-server pull relay envelope (cluster/pull.py)
+    cluster_pull_connect_timeout_sec: float = 5.0
+    cluster_pull_read_timeout_sec: float = 5.0   # no packet → stall
+    cluster_pull_backoff_ms: float = 200.0       # first retry (doubles)
+    cluster_pull_backoff_cap_ms: float = 5000.0
+    cluster_pull_jitter_frac: float = 0.25       # ± anti-stampede jitter
+    cluster_pull_breaker_failures: int = 5       # consecutive → open
+    cluster_pull_breaker_open_sec: float = 10.0
     # --- auth / misc
     auth_enabled: bool = False
     rest_username: str = "admin"
@@ -175,6 +193,25 @@ class ServerConfig:
             fast_burn=self.slo_fast_burn,
             slow_burn=self.slo_slow_burn,
             min_events=self.slo_min_events)
+
+    def cluster_config(self):
+        from ..cluster.pull import PullConfig
+        from ..cluster.service import ClusterConfig
+        return ClusterConfig(
+            self.server_id, ip=self.wan_ip,
+            lease_ttl_sec=self.cluster_lease_ttl_sec,
+            heartbeat_sec=self.cluster_heartbeat_sec,
+            vnodes=self.cluster_vnodes,
+            own_ttl_sec=self.cluster_own_ttl_sec,
+            migration_ttl_sec=self.cluster_migration_ttl_sec,
+            pull=PullConfig(
+                connect_timeout_sec=self.cluster_pull_connect_timeout_sec,
+                read_timeout_sec=self.cluster_pull_read_timeout_sec,
+                backoff_ms=self.cluster_pull_backoff_ms,
+                backoff_cap_ms=self.cluster_pull_backoff_cap_ms,
+                jitter_frac=self.cluster_pull_jitter_frac,
+                breaker_failures=self.cluster_pull_breaker_failures,
+                breaker_open_sec=self.cluster_pull_breaker_open_sec))
 
     def ladder_config(self):
         from ..resilience.ladder import LadderConfig

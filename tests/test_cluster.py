@@ -72,6 +72,119 @@ async def test_resp_client_against_mini_server():
 
 
 @pytest.mark.asyncio
+async def test_fenced_and_lease_ops_over_resp():
+    """The cluster tier's command surface (SETNX/INCR/EVAL fencing)
+    works identically over real RESP sockets — one client code path for
+    the mini server and a real Redis."""
+    srv = MiniRedisServer()
+    await srv.start()
+    try:
+        c = AsyncRedis("127.0.0.1", srv.port)
+        assert await c.setnx("lock", "a")
+        assert not await c.setnx("lock", "b")       # already held
+        assert await c.incr("fence") == 1
+        assert await c.incr("fence") == 2
+        assert await c.fset("Own:x", 5, "n=a", ttl=100)
+        assert await c.fget("Own:x") == (5, "n=a")
+        assert not await c.fset("Own:x", 4, "n=zombie")   # stale write
+        assert await c.fget("Own:x") == (5, "n=a")
+        assert not await c.fdel("Own:x", 4)               # stale delete
+        assert await c.fdel("Own:x", 5)
+        assert await c.fget("Own:x") is None
+        await c.set("tmp", "v", ex=100)
+        assert await c.execute("TTL", "tmp") > 90
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_async_redis_timeout_and_reconnect():
+    from easydarwin_tpu import obs
+    from easydarwin_tpu.cluster.redis_client import RedisTimeout
+
+    # a server that accepts and never replies: the per-command timeout
+    # must surface instead of wedging the caller forever
+    async def _blackhole(reader, writer):
+        try:
+            await reader.read(1 << 16)
+            await asyncio.sleep(30)
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    hung = await asyncio.start_server(_blackhole, "127.0.0.1", 0)
+    port = hung.sockets[0].getsockname()[1]
+    errs_before = obs.REDIS_ERRORS.value()
+    c = AsyncRedis("127.0.0.1", port, timeout=0.2)
+    with pytest.raises(RedisTimeout):
+        await c.ping()
+    # both the first attempt and the one transparent retry counted
+    assert obs.REDIS_ERRORS.value() == errs_before + 2
+    hung.close()
+    await hung.wait_closed()
+
+    # a stale connection (peer closed it under us) is retried ONCE on a
+    # fresh socket — the caller never sees the hiccup
+    srv = MiniRedisServer()
+    await srv.start()
+    try:
+        c2 = AsyncRedis("127.0.0.1", srv.port)
+        assert await c2.ping()
+        c2._w.close()                   # simulate idle-timeout kill
+        await asyncio.sleep(0.05)
+        assert await c2.ping()          # transparent reconnect
+        await c2.close()
+    finally:
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_cms_reaps_lapsed_devices():
+    """Dead DeviceRecords must not accumulate forever: a device whose
+    keepalive lapsed while offline is reaped, with one
+    ``cms.device_offline`` event (ISSUE 6 satellite)."""
+    import time as _time
+
+    from easydarwin_tpu import obs
+    from easydarwin_tpu.cluster.cms import DeviceRecord
+
+    redis = InMemoryRedis()
+    cms = CmsServer(redis, bind_ip="127.0.0.1", device_timeout_sec=10.0)
+    await cms.start()
+    try:
+        class _SilentSocket:
+            """Open-looking writer whose network died without a FIN."""
+            closed = False
+
+            def is_closing(self):
+                return False
+
+            def close(self):
+                self.closed = True
+
+        now = _time.time()
+        cms.devices["dead1"] = DeviceRecord("dead1", name="cam-dead",
+                                            last_seen=now - 60)
+        w = _SilentSocket()
+        cms.devices["ghost"] = DeviceRecord("ghost", writer=w,
+                                            last_seen=now - 60)
+        cms.devices["fresh"] = DeviceRecord("fresh", last_seen=now)
+        reaped = cms.reap()
+        # lapse alone decides: the silently-dead socket is reaped too,
+        # and its stale writer is closed
+        assert sorted(reaped) == ["dead1", "ghost"] and w.closed
+        assert "dead1" not in cms.devices and "fresh" in cms.devices
+        evs = [r for r in obs.EVENTS.tail(50)
+               if r.get("event") == "cms.device_offline"]
+        assert {e["serial"] for e in evs} >= {"dead1", "ghost"}
+        assert cms.reap() == []         # idempotent
+    finally:
+        await cms.stop()
+
+
+@pytest.mark.asyncio
 async def test_presence_assert_and_pick_least_loaded():
     t = [0.0]
     r = InMemoryRedis(clock=lambda: t[0])

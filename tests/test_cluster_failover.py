@@ -1,0 +1,554 @@
+"""Cluster robustness tier (ISSUE 6): leases + fencing, consistent-hash
+placement, the pull retry/breaker envelope, and checkpoint-driven live
+session migration — unit state machines on fake clocks plus a two-server
+kill→migrate e2e asserting gapless rewritten seq at the player socket.
+"""
+
+import asyncio
+import socket
+import struct
+import time
+
+import pytest
+
+from easydarwin_tpu import obs
+from easydarwin_tpu.cluster.placement import HashRing, PlacementService
+from easydarwin_tpu.cluster.presence import (FENCE_COUNTER_KEY,
+                                             ClusterRegistry, LeaseManager)
+from easydarwin_tpu.cluster.pull import Backoff, CircuitBreaker, PullConfig
+from easydarwin_tpu.cluster.redis_client import InMemoryRedis
+from easydarwin_tpu.cluster.service import (ClusterConfig, ClusterService,
+                                            ckpt_key)
+from easydarwin_tpu.relay.session import SessionRegistry
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.utils.client import RtspClient
+
+SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=fo\r\nt=0 0\r\n"
+       "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+       "a=control:trackID=1\r\n")
+
+
+def _pkt(seq: int) -> bytes:
+    return (struct.pack("!BBHII", 0x80, 96, seq & 0xFFFF, seq * 90, 0xFE)
+            + bytes([0x65]) + bytes(60))
+
+
+# --------------------------------------------------------------- lease layer
+async def test_lease_acquire_heartbeat_and_expiry():
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    lease = LeaseManager(r, "n1", ttl_sec=5, meta={"ip": "10.0.0.1"})
+    tok = await lease.acquire()
+    assert tok >= 1
+    nodes = await ClusterRegistry.live_nodes(r)
+    assert nodes["n1"]["token"] == tok and nodes["n1"]["ip"] == "10.0.0.1"
+    # heartbeat inside the TTL renews; liveness survives past the
+    # original expiry because the TTL was re-asserted
+    t[0] = 4.0
+    assert await lease.heartbeat() is True
+    t[0] = 8.0
+    assert "n1" in await ClusterRegistry.live_nodes(r)
+    # no heartbeat past the TTL: the lease ages out — failure detection
+    # IS the TTL
+    t[0] = 20.0
+    assert await ClusterRegistry.live_nodes(r) == {}
+
+
+async def test_lease_loss_reacquires_with_new_token():
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    lease = LeaseManager(r, "n1", ttl_sec=5)
+    tok1 = await lease.acquire()
+    lost_before = obs.CLUSTER_LEASE_LOST.value()
+    t[0] = 10.0                     # expired while "partitioned"
+    assert await lease.heartbeat() is False
+    assert lease.losses == 1
+    assert obs.CLUSTER_LEASE_LOST.value() == lost_before + 1
+    assert lease.token > tok1       # fresh token: old claims now stale
+    assert "n1" in await ClusterRegistry.live_nodes(r)
+
+
+# ----------------------------------------------------------------- fencing
+async def test_fencing_rejects_stale_owner_write():
+    r = InMemoryRedis()
+    assert await r.fset("Own:live/x", 3, '{"node":"a"}')
+    # a NEWER owner claims
+    assert await r.fset("Own:live/x", 7, '{"node":"b"}')
+    # the zombie's stale write is rejected and the record untouched
+    assert not await r.fset("Own:live/x", 3, '{"node":"a"}')
+    tok, payload = await r.fget("Own:live/x")
+    assert tok == 7 and '"b"' in payload
+    # stale delete rejected too; current-token delete succeeds
+    assert not await r.fdel("Own:live/x", 3)
+    assert await r.fdel("Own:live/x", 7)
+    assert await r.fget("Own:live/x") is None
+
+
+async def test_placement_claim_fence_rejection_counts():
+    r = InMemoryRedis()
+    a = PlacementService(r, "a")
+    b = PlacementService(r, "b")
+    assert await a.claim("/live/x", 3)
+    assert await b.claim("/live/x", 9)
+    rej_before = obs.CLUSTER_LEASE_FENCE_REJECTED.value()
+    assert not await a.claim("/live/x", 3)      # a is the zombie now
+    assert obs.CLUSTER_LEASE_FENCE_REJECTED.value() == rej_before + 1
+    assert await b.claimant("/live/x") == "b"
+
+
+# ------------------------------------------------------------ consistent hash
+def test_hash_ring_deterministic_and_minimal_movement():
+    paths = [f"/live/cam{i}" for i in range(200)]
+    r3 = HashRing(["a", "b", "c"])
+    # deterministic: same node set (any order) → same placement
+    assert all(HashRing(["c", "a", "b"]).owner(p) == r3.owner(p)
+               for p in paths)
+    # every node serves a sane share of a 200-path universe
+    share = {n: sum(1 for p in paths if r3.owner(p) == n)
+             for n in ("a", "b", "c")}
+    assert all(v > 20 for v in share.values()), share
+    # node join moves only a fraction of the paths (consistent hashing)
+    r4 = HashRing(["a", "b", "c", "d"])
+    moved = sum(1 for p in paths if r4.owner(p) != r3.owner(p))
+    assert 0 < moved < len(paths) // 2, moved
+    # node leave: ONLY the dead node's paths move, each to its ranked
+    # successor — the deterministic re-placement every survivor computes
+    r2 = HashRing(["a", "b"])
+    for p in paths:
+        if r3.owner(p) != "c":
+            assert r2.owner(p) == r3.owner(p)
+        else:
+            succ = [n for n in r3.rank(p) if n != "c"][0]
+            assert r2.owner(p) == succ
+
+
+async def test_placement_resolve_sticky_then_replaces_dead_owner():
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    la = LeaseManager(r, "a", ttl_sec=5)
+    lb = LeaseManager(r, "b", ttl_sec=5)
+    await la.acquire()
+    await lb.acquire()
+    pb = PlacementService(r, "b")
+    # a live claimant wins regardless of the ring
+    await r.fset("Own:live/s", 4, '{"node":"a"}')
+    owner, meta = await pb.resolve("/live/s")
+    assert owner == "a"
+    # the claimant's lease dies → deterministic ring owner over the
+    # survivors, and the observed move is counted
+    moves_before = obs.CLUSTER_PLACEMENT_MOVES.value()
+    t[0] = 10.0
+    await lb.heartbeat()            # b re-asserts; a ages out
+    owner2, _ = await pb.resolve("/live/s")
+    assert owner2 == "b"
+    assert obs.CLUSTER_PLACEMENT_MOVES.value() == moves_before + 1
+
+
+# ------------------------------------------------------- backoff + breaker
+def test_backoff_schedule_deterministic_capped():
+    cfg = PullConfig(backoff_ms=100.0, backoff_cap_ms=800.0,
+                     jitter_frac=0.25)
+    a, b = Backoff(cfg, seed=42), Backoff(cfg, seed=42)
+    da = [a.next_delay() for _ in range(6)]
+    db = [b.next_delay() for _ in range(6)]
+    assert da == db                         # same seed → same schedule
+    base = [0.1, 0.2, 0.4, 0.8, 0.8, 0.8]   # doubles, then capped
+    for d, want in zip(da, base):
+        assert want * 0.75 <= d <= want * 1.25, (d, want)
+    a.reset()
+    assert a.next_delay() <= 0.1 * 1.25     # reset restarts the ladder
+    # jitter disabled → exact schedule
+    c = Backoff(PullConfig(backoff_ms=100.0, backoff_cap_ms=800.0,
+                           jitter_frac=0.0))
+    assert [c.next_delay() for _ in range(4)] == [0.1, 0.2, 0.4, 0.8]
+
+
+def test_circuit_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(3, 10.0, clock=lambda: t[0])
+    assert br.allow()
+    assert not br.failure() and not br.failure()
+    assert br.failure()                     # 3rd consecutive → open
+    assert br.state == "open" and br.opened == 1
+    assert not br.allow()                   # open: no attempts at all
+    t[0] = 9.9
+    assert not br.allow()
+    t[0] = 10.5                             # open window over → probe
+    assert br.allow() and br.state == "half_open"
+    assert br.failure()                     # probe failed → re-open
+    assert br.state == "open" and br.opened == 2
+    t[0] = 21.0
+    assert br.allow()
+    br.success()                            # probe succeeded → closed
+    assert br.state == "closed" and br.allow()
+
+
+# ---------------------------------------------------- migration state machine
+async def test_service_migration_adopts_and_zombie_rejected():
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    reg_a, reg_b = SessionRegistry(), SessionRegistry()
+    reg_a.find_or_create("/live/m", SDP)
+    restored: list[dict] = []
+
+    def _restore(doc):
+        restored.append(doc)
+        for srec in doc.get("sessions", ()):       # materialize, as the
+            reg_b.find_or_create(srec["path"], srec["sdp"])  # app hook does
+        return len(doc.get("sessions", ())), 1
+
+    released: list[str] = []
+    svc_a = ClusterService(r, ClusterConfig("a", lease_ttl_sec=5),
+                           registry=reg_a,
+                           on_fence_lost=released.append)
+    svc_b = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                           registry=reg_b, restore_doc=_restore)
+    await svc_a.lease.acquire()
+    await svc_b.lease.acquire()
+    await svc_a.tick()
+    # a's claim + published checkpoint exist, fenced by a's claim token
+    assert "/live/m" in svc_a._claims
+    ck = await r.fget(ckpt_key("/live/m"))
+    assert ck is not None and '"path":"/live/m"' in ck[1]
+    old_claim_token = svc_a._claims["/live/m"]
+
+    # --- a dies (no heartbeats; lease ages out), b's scan adopts
+    mig_before = obs.CLUSTER_MIGRATIONS.value()
+    t[0] = 10.0
+    await svc_b.tick()
+    assert svc_b.migrations == 1
+    assert obs.CLUSTER_MIGRATIONS.value() == mig_before + 1
+    assert restored and restored[0]["sessions"][0]["path"] == "/live/m"
+    assert await svc_b.placement.claimant("/live/m") == "b"
+    new_tok, _ = await r.fget("Own:live/m")
+    assert new_tok > old_claim_token
+    # b re-published the checkpoint under its own token
+    ck2 = await r.fget(ckpt_key("/live/m"))
+    assert ck2 is not None and ck2[0] == new_tok
+
+    # --- the zombie returns: lease re-acquired with a NEW token, but its
+    # stale stream claim is fence-rejected and it releases the stream
+    rej_before = obs.CLUSTER_LEASE_FENCE_REJECTED.value()
+    await svc_a.tick()
+    assert svc_a.lease.losses == 1
+    assert "/live/m" not in svc_a._claims
+    assert obs.CLUSTER_LEASE_FENCE_REJECTED.value() > rej_before
+    # the fence loss reached the DATA PLANE hook: the zombie must stop
+    # serving the stream locally, not just drop its Redis claim
+    assert released == ["/live/m"]
+    assert await svc_b.placement.claimant("/live/m") == "b"
+    # idempotence: another b tick neither re-migrates nor flaps
+    await svc_b.tick()
+    assert svc_b.migrations == 1
+
+
+async def test_adoption_retries_failed_restore_without_losing_ckpt():
+    """A transient restore failure during adoption must not strand the
+    stream: the published checkpoint survives, the adoption is retried
+    next tick, and exactly one migration is counted once it lands."""
+    t = [0.0]
+    r = InMemoryRedis(clock=lambda: t[0])
+    reg_a, reg_b = SessionRegistry(), SessionRegistry()
+    reg_a.find_or_create("/live/m", SDP)
+    calls = [0]
+
+    def _flaky_restore(doc):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("egress not ready yet")
+        for srec in doc.get("sessions", ()):
+            reg_b.find_or_create(srec["path"], srec["sdp"])
+        return 1, 1
+
+    svc_a = ClusterService(r, ClusterConfig("a", lease_ttl_sec=5),
+                           registry=reg_a)
+    svc_b = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                           registry=reg_b, restore_doc=_flaky_restore)
+    await svc_a.lease.acquire()
+    await svc_b.lease.acquire()
+    await svc_a.tick()
+    t[0] = 10.0                     # a's lease ages out
+    mig_before = obs.CLUSTER_MIGRATIONS.value()
+    await svc_b.tick()              # adoption attempt: restore fails
+    assert svc_b.migrations == 0
+    assert "/live/m" in svc_b._adopt_retry
+    # the recovery state is NOT destroyed by the failed attempt
+    assert await r.fget(ckpt_key("/live/m")) is not None
+    await svc_b.tick()              # retry lands
+    assert svc_b.migrations == 1
+    assert obs.CLUSTER_MIGRATIONS.value() == mig_before + 1
+    assert svc_b._adopt_retry == {}
+    assert "/live/m" in svc_b._claims
+    assert reg_b.find("/live/m") is not None
+    # further ticks are stable — no double count, claim + ckpt held
+    await svc_b.tick()
+    assert svc_b.migrations == 1
+    assert await r.fget(ckpt_key("/live/m")) is not None
+
+
+async def test_migration_merge_clamps_preexisting_bookmarks():
+    """Restoring a checkpoint INTO a live session (migration onto a
+    node that was pull-serving the path) resets the ring to the
+    checkpoint's id space; a pre-existing subscriber bookmarked ahead
+    of that head must be clamped to it, or it stalls silently until new
+    ids catch up."""
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.resilience.checkpoint import (CKPT_VERSION,
+                                                      restore_registry)
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/live/mg", SDP)
+    st = sess.streams[1]
+    ahead, behind = CollectingOutput(), CollectingOutput()
+    st.add_output(ahead)
+    st.add_output(behind)
+    ahead.bookmark = 500            # pull-fed ring ran further locally
+    behind.bookmark = 10            # … or lagged behind the checkpoint
+    doc = {"version": CKPT_VERSION, "saved_wall": time.time(),
+           "sessions": [{"path": "/live/mg", "sdp": SDP, "streams": [
+               {"track": 1, "head": 60, "keyframe_id": None,
+                "reporter_ssrc": 1, "rr": [-1, 0, 0, 0, 0, 0],
+                "packets_in": 0, "packets_out": 0, "outputs": []}]}]}
+    restore_registry(reg, doc)
+    assert st.rtp_ring.head == 60 and st.rtp_ring.tail == 60
+    assert ahead.bookmark == 60     # resumes at the next ingested packet
+    assert behind.bookmark == 10    # reflect clamps < tail itself
+
+
+# ------------------------------------------------------------ two-server e2e
+def _server_cfg(tmp_path, node: str) -> ServerConfig:
+    return ServerConfig(
+        rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+        wan_ip="127.0.0.1", reflect_interval_ms=10, bucket_delay_ms=0,
+        log_folder=str(tmp_path / node), access_log_enabled=False,
+        server_id=node, cluster_enabled=True,
+        cluster_lease_ttl_sec=1.0, cluster_heartbeat_sec=0.2,
+        cluster_pull_connect_timeout_sec=3.0,
+        cluster_pull_read_timeout_sec=1.0,
+        cluster_pull_backoff_ms=100.0)
+
+
+async def _drain(sock, out: list, seconds: float) -> None:
+    t_end = asyncio.get_event_loop().time() + seconds
+    while asyncio.get_event_loop().time() < t_end:
+        try:
+            out.append(sock.recv(65536))
+        except BlockingIOError:
+            await asyncio.sleep(0.01)
+
+
+async def test_two_server_kill_migrate_gapless_e2e(tmp_path):
+    """Kill the stream's owner mid-relay: the surviving node adopts via
+    the Redis-published checkpoint and the UDP player — which never
+    re-SETUPs — sees the stream resume with the SAME ssrc and gapless
+    rewritten seq, within the 10 s failover budget."""
+    redis = InMemoryRedis()
+    app_a = StreamingServer(_server_cfg(tmp_path, "node-a"),
+                            redis_client=redis)
+    app_b = StreamingServer(_server_cfg(tmp_path, "node-b"),
+                            redis_client=redis)
+    await app_a.start()
+    await app_b.start()
+    rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rtp.bind(("127.0.0.1", 0))
+    rtp.setblocking(False)
+    rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rtcp.bind(("127.0.0.1", 0))
+    rtcp.setblocking(False)
+    rx: list[bytes] = []
+    push2 = None
+    try:
+        push = RtspClient()
+        await push.connect("127.0.0.1", app_a.rtsp.port)
+        await push.push_start(
+            f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/fo", SDP)
+        player = RtspClient()
+        await player.connect("127.0.0.1", app_a.rtsp.port)
+        await player.play_start(
+            f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/fo", tcp=False,
+            client_ports=[(rtp.getsockname()[1], rtcp.getsockname()[1])])
+        for seq in range(20):
+            push.push_packet(0, _pkt(seq))
+            await asyncio.sleep(0.005)
+        await _drain(rtp, rx, 0.3)
+        assert len(rx) >= 10
+        # at least one cluster tick so the claim + checkpoint are
+        # published (the checkpoint's rewrite 5-tuple is set-once, so
+        # later packets don't stale it)
+        await asyncio.sleep(0.5)
+        assert "/live/fo" in app_a.cluster._claims
+
+        # --- the kill: cluster state is left EXACTLY as a SIGKILL
+        # would leave it (lease + claims NOT released), then the
+        # process's sockets close
+        mig_before = obs.CLUSTER_MIGRATIONS.value()
+        app_a.cluster.crash()
+        app_a.cluster = None
+        t_kill = time.monotonic()
+        await app_a.stop()
+
+        # --- the survivor adopts after lease expiry (deterministic:
+        # it is the only live node)
+        while time.monotonic() - t_kill < 10.0:
+            if app_b.registry.find("/live/fo") is not None:
+                break
+            await asyncio.sleep(0.05)
+        recovery = time.monotonic() - t_kill
+        assert app_b.registry.find("/live/fo") is not None, \
+            f"no migration within 10 s (waited {recovery:.1f}s)"
+        assert recovery <= 10.0
+        assert obs.CLUSTER_MIGRATIONS.value() == mig_before + 1
+        assert len(app_b._restored_subs) == 1   # player re-pointed
+
+        # --- the source re-attaches to the new owner (the reference's
+        # re-register/re-push recovery) and keeps numbering
+        n_before = len(rx)
+        push2 = RtspClient()
+        await push2.connect("127.0.0.1", app_b.rtsp.port)
+        await push2.push_start(
+            f"rtsp://127.0.0.1:{app_b.rtsp.port}/live/fo", SDP)
+        for seq in range(20, 40):
+            push2.push_packet(0, _pkt(seq))
+            await asyncio.sleep(0.005)
+        await _drain(rtp, rx, 0.3)
+        assert len(rx) > n_before
+        ssrcs = {p[8:12] for p in rx if len(p) >= 12}
+        assert len(ssrcs) == 1                  # SAME wire identity
+        seqs = [struct.unpack("!H", p[2:4])[0] for p in rx if len(p) >= 12]
+        deltas = {(b - a) & 0xFFFF for a, b in zip(seqs, seqs[1:])}
+        assert deltas <= {0, 1}, f"seq gap across migration: {sorted(deltas)}"
+        await player.close()
+        await push.close()
+    finally:
+        if push2 is not None:
+            await push2.close()
+        await app_b.stop()
+        rtp.close()
+        rtcp.close()
+
+
+async def test_cross_server_pull_serves_remote_subscriber(tmp_path):
+    """A subscriber landing on a NON-owner node is served through the
+    pull envelope; when the upstream dies the session survives (rung
+    degrades, envelope retries) instead of tearing the player down."""
+    redis = InMemoryRedis()
+    app_a = StreamingServer(_server_cfg(tmp_path, "node-a"),
+                            redis_client=redis)
+    app_b = StreamingServer(_server_cfg(tmp_path, "node-b"),
+                            redis_client=redis)
+    await app_a.start()
+    await app_b.start()
+    try:
+        push = RtspClient()
+        await push.connect("127.0.0.1", app_a.rtsp.port)
+        await push.push_start(
+            f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/pl", SDP)
+        await asyncio.sleep(0.5)        # a's claim lands in Redis
+        player = RtspClient()
+        await player.connect("127.0.0.1", app_b.rtsp.port)
+        await player.play_start(
+            f"rtsp://127.0.0.1:{app_b.rtsp.port}/live/pl")
+        assert "/live/pl" in app_b.cluster.pulls
+        for seq in range(40):
+            push.push_packet(0, _pkt(seq))
+            await asyncio.sleep(0.002)
+        got = 0
+        for _ in range(40):
+            try:
+                await player.recv_interleaved(0, timeout=0.5)
+                got += 1
+            except asyncio.TimeoutError:
+                break
+        assert got >= 10                # A → B pull → local player
+        sess_b = app_b.registry.find("/live/pl")
+        assert sess_b is not None
+        # the envelope owns the session, so an upstream EOF can't
+        # remove it out from under the player
+        assert sess_b.owner is app_b.cluster.pulls["/live/pl"]
+
+        # --- upstream dies: the pull retries with backoff, the local
+        # session SURVIVES, failures charge the ladder (pull coupling)
+        await push.close()
+        rp = app_b.cluster.pulls.get("/live/pl")
+        assert rp is not None
+        for _ in range(80):             # stall detect = read_timeout + poll
+            if rp.retries >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert rp.retries >= 1
+        assert app_b.registry.find("/live/pl") is sess_b
+        assert obs.CLUSTER_PULL_RETRIES.value() >= 1
+
+        # --- the source is re-directed HERE and re-ANNOUNCEs (the CMS
+        # recovery flow): the superseded pull retires, the node claims
+        # the path itself — two feeds must never share one session
+        push3 = RtspClient()
+        await push3.connect("127.0.0.1", app_b.rtsp.port)
+        await push3.push_start(
+            f"rtsp://127.0.0.1:{app_b.rtsp.port}/live/pl", SDP)
+        for _ in range(40):
+            if ("/live/pl" not in app_b.cluster.pulls
+                    and "/live/pl" in app_b.cluster._claims):
+                break
+            await asyncio.sleep(0.1)
+        assert "/live/pl" not in app_b.cluster.pulls
+        assert "/live/pl" in app_b.cluster._claims
+        assert app_b.registry.find("/live/pl") is sess_b  # same session
+        await push3.close()
+        await player.close()
+    finally:
+        await app_a.stop()
+        await app_b.stop()
+
+
+# ------------------------------------------------------- 2-process variant
+@pytest.mark.slow
+def test_cluster_soak_two_real_processes():
+    """The full acceptance scenario with REAL processes: 2 servers +
+    mini Redis, churn, flash crowd, seeded owner SIGKILL → gapless
+    migration within 10 s (tools/soak.py --cluster 2).  Marked slow —
+    the in-process e2e above covers the same machinery in tier-1."""
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    out = subprocess.run(
+        [_sys.executable, str(root / "tools" / "soak.py"),
+         "--cluster", "2", "--duration", "35"],
+        cwd=root, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, \
+        f"cluster soak failed:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}"
+    assert "SOAK CLUSTER OK" in out.stdout
+
+
+# ------------------------------------------------------------------- lint
+def test_cluster_lint_contract():
+    import pathlib
+    import sys
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]
+                           / "tools"))
+    import metrics_lint
+    from easydarwin_tpu.obs import events as ev
+    assert metrics_lint.lint_cluster(obs.REGISTRY, ev.SCHEMA) == []
+    # the new families obey the global naming lint too
+    assert metrics_lint.lint(obs.REGISTRY) == []
+
+
+async def test_redis_partition_skips_tick_and_counts():
+    from easydarwin_tpu.resilience import INJECTOR
+    from easydarwin_tpu.resilience.inject import FaultPlan
+    r = InMemoryRedis()
+    svc = ClusterService(r, ClusterConfig("n1"),
+                         registry=SessionRegistry())
+    await svc.lease.acquire()
+    INJECTOR.arm(FaultPlan.parse("seed=3,redis_partition_every=1"))
+    try:
+        fi_before = obs.FAULT_INJECTED.value(site="redis_partition")
+        import pytest
+        from easydarwin_tpu.cluster.redis_client import RedisTimeout
+        with pytest.raises(RedisTimeout):
+            await svc.tick()
+        assert obs.FAULT_INJECTED.value(site="redis_partition") \
+            == fi_before + 1
+    finally:
+        INJECTOR.disarm()

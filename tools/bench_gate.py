@@ -143,6 +143,30 @@ def check_trajectory(traj: list[dict],
             elif rec > 30.0:
                 errs.append(f"{name}: chaos.recovery_sec {rec} exceeds "
                             "the 30 s full-service recovery budget")
+        # ISSUE 6 cluster section — OPTIONAL (rounds predating the
+        # cluster tier stay valid), but when present its two headline
+        # numbers must hold the failover contract: a migration must be
+        # GAPLESS at the player socket and full recovery must land
+        # within the 10 s budget the acceptance pins
+        cl = extra.get("cluster")
+        if isinstance(cl, dict) and cl and "error" not in cl:
+            gap = cl.get("migration_gap_packets")
+            if not isinstance(gap, (int, float)) or not math.isfinite(gap) \
+                    or gap < 0:
+                errs.append(f"{name}: cluster.migration_gap_packets "
+                            f"{gap!r} not a finite non-negative count")
+            elif gap != 0:
+                errs.append(f"{name}: cluster.migration_gap_packets "
+                            f"{gap:.0f} (a migration dropped packets at "
+                            "the player socket — must be exactly 0)")
+            rec = cl.get("failover_recovery_sec")
+            if not isinstance(rec, (int, float)) or not math.isfinite(rec) \
+                    or rec < 0:
+                errs.append(f"{name}: cluster.failover_recovery_sec "
+                            f"{rec!r} not a finite non-negative duration")
+            elif rec > 10.0:
+                errs.append(f"{name}: cluster.failover_recovery_sec {rec} "
+                            "exceeds the 10 s failover budget")
     if usable == 0:
         errs.append("every trajectory round is unusable (parsed: null)")
     return errs

@@ -192,6 +192,49 @@ def lint_resilience(registry, schema: dict) -> list[str]:
     return errs
 
 
+def lint_cluster(registry, schema: dict) -> list[str]:
+    """The cluster-tier contract (ISSUE 6): the lease/placement/pull/
+    migration families exist with their exact label sets, and the
+    ``cluster.*`` / ``cms.device_offline`` event names are declared —
+    ``tools/soak.py --cluster`` and the failover e2e key on them."""
+    errs: list[str] = []
+    want_labels = {
+        "redis_errors_total": (),
+        "cluster_lease_acquired_total": (),
+        "cluster_lease_renewals_total": (),
+        "cluster_lease_lost_total": (),
+        "cluster_lease_fence_rejected_total": (),
+        "cluster_placement_moves_total": (),
+        "cluster_pull_retries_total": (),
+        "cluster_pull_breaker_open_total": (),
+        "cluster_migrations_total": (),
+    }
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"cluster family {fam_name} missing from the "
+                        "registry")
+            continue
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    for name in ("cluster.lease_acquire", "cluster.lease_lost",
+                 "cluster.fence_rejected", "cluster.placement_move",
+                 "cluster.pull_retry", "cluster.breaker_open",
+                 "cluster.breaker_close", "cluster.migrate",
+                 "cluster.drain", "cms.device_offline"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    # the cluster fault sites ride the closed injection vocabulary
+    from easydarwin_tpu.resilience.inject import SITES
+    for site in ("lease_loss", "redis_partition", "pull_stall"):
+        if site not in SITES:
+            errs.append(f"cluster fault site {site!r} missing from the "
+                        "closed SITES vocabulary")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -275,6 +318,9 @@ def main() -> int:
     # ladder rung gauge, checkpoint counters and the fault.*/ladder.*/
     # ckpt.* event schema
     errs += lint_resilience(obs.REGISTRY, ev.SCHEMA)
+    # the cluster tier's vocabulary (ISSUE 6): lease/placement/pull/
+    # migration families + cluster.* events + cluster fault sites
+    errs += lint_cluster(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -23,8 +23,20 @@ clearance, nonzero megabatch wire mismatches, or starved players — the
 checks that the injected drops legitimately break (HLS muxing/requant
 stats) are asserted only by the clean soak.
 
+``--cluster N`` runs the multi-server robustness scenario instead
+(ISSUE 6): a mini Redis + N real server processes with the cluster tier
+on, one pushed stream placed by consistent hash, a UDP subscriber on the
+owner, a persistent pull-relay subscriber on a non-owner, subscriber
+churn, a flash-crowd join wave — and a seeded SIGKILL of the owner
+mid-soak that must recover via checkpoint-driven migration: the UDP
+player (which never re-SETUPs) sees the SAME ssrc with ZERO sequence
+gap, recovery lands within 10 s, the survivor's metrics show nonzero
+``cluster_migrations_total``, and every ladder rung is back at full
+service at exit.
+
 Usage: python tools/soak.py [--duration SECONDS] [--chaos [SEED]]
-(default 120; the bare positional form ``soak.py 120`` still works)
+[--cluster N] (default 120; the bare positional form ``soak.py 120``
+still works)
 """
 
 from __future__ import annotations
@@ -692,7 +704,375 @@ async def soak(seconds: float, n_sources: int = 0,
     return 1 if failures else 0
 
 
-def _parse_args(argv: list[str]) -> tuple[float, int, int | None]:
+# ===================================================================== cluster
+# The multi-process cluster soak (ISSUE 6 acceptance scenario).
+
+async def _cluster_node_main(node_id: str, redis_port: int) -> None:
+    """Child-process entry: one cluster-enabled server that announces
+    its bound ports on stdout and serves until killed."""
+    import os
+    log_dir = f"/tmp/edtpu_cluster_soak/{node_id}"
+    os.makedirs(log_dir, exist_ok=True)
+    cfg = ServerConfig(
+        rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+        wan_ip="127.0.0.1", reflect_interval_ms=10, bucket_delay_ms=0,
+        access_log_enabled=False, log_folder=log_dir, server_id=node_id,
+        redis_port=redis_port, cluster_enabled=True,
+        cluster_lease_ttl_sec=2.0, cluster_heartbeat_sec=0.5,
+        cluster_pull_connect_timeout_sec=3.0,
+        cluster_pull_read_timeout_sec=1.5,
+        cluster_pull_backoff_ms=150.0)
+    app = StreamingServer(cfg)
+    await app.start()
+    print(f"NODE_READY rtsp={app.rtsp.port} rest={app.rest.port}",
+          flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await app.stop()
+
+
+def _seq_gap(seqs: list[int]) -> int:
+    """Missing rewritten seq numbers at the player socket (mod 2^16;
+    duplicates — the pusher's resend tail — count as 0)."""
+    gap = 0
+    for a, b in zip(seqs, seqs[1:]):
+        d = (b - a) & 0xFFFF
+        if 1 < d < 0x8000:            # forward jump: d-1 packets missing
+            gap += d - 1
+    return gap
+
+
+class _ClusterPusher:
+    """The soak's source: pushes to the stream's current owner, keeps a
+    resend tail, and on owner death re-resolves against Redis and
+    re-ANNOUNCEs to the adopter — the reference's re-register/re-push
+    recovery, with the tail resent so packets that died inside the old
+    owner's socket are not a wire gap (duplicates rewrite to duplicate
+    seqs, which the gap check tolerates)."""
+
+    def __init__(self, path: str, redis, rtsp_ports: dict[str, int]):
+        from collections import deque
+
+        from easydarwin_tpu.cluster.placement import PlacementService
+        self.path = path
+        self.redis = redis
+        self.rtsp_ports = rtsp_ports
+        self.placement = PlacementService(redis, "soak-harness")
+        self.seq = 0
+        self.tail: deque[bytes] = deque(maxlen=64)
+        self.client: RtspClient | None = None
+        self.target: str | None = None
+        self.reconnects = 0
+
+    def _pkt(self) -> bytes:
+        p = (struct.pack("!BBHII", 0x80, 96, self.seq & 0xFFFF,
+                         self.seq * 90, 0xFE)
+             + bytes([0x65]) + bytes(100))
+        self.seq += 1
+        return p
+
+    async def connect_to(self, node: str) -> None:
+        if self.client is not None:
+            try:
+                await self.client.close()
+            except Exception:
+                pass
+        self.client = RtspClient()
+        port = self.rtsp_ports[node]
+        await self.client.connect("127.0.0.1", port)
+        await self.client.push_start(
+            f"rtsp://127.0.0.1:{port}{self.path}", SDP)
+        self.target = node
+        for p in list(self.tail):     # cover in-flight loss at the kill
+            self.client.push_packet(0, p)
+
+    async def ensure_connected(self, dead: set[str]) -> bool:
+        """Reconnect toward the current claimant when our connection
+        died or ownership moved to a live node; False while the cluster
+        has not re-placed the stream yet."""
+        alive = (self.client is not None and self.client.writer is not None
+                 and not self.client.writer.is_closing()
+                 and self.target not in dead)
+        claimant = await self.placement.claimant(self.path)
+        want = claimant if claimant and claimant not in dead else None
+        if alive and (want is None or want == self.target):
+            return True
+        if want is None:
+            return False              # adoption still in flight
+        await self.connect_to(want)
+        self.reconnects += 1
+        return True
+
+    def push(self) -> None:
+        p = self._pkt()
+        self.tail.append(p)
+        if self.client is not None:
+            self.client.push_packet(0, p)
+
+
+async def cluster_soak(n_nodes: int, seconds: float,
+                       seed: int = 7) -> int:
+    import json as _json
+    import os
+    import random
+
+    from easydarwin_tpu.cluster.placement import HashRing
+    from easydarwin_tpu.cluster.redis_client import (AsyncRedis,
+                                                     MiniRedisServer)
+
+    assert n_nodes >= 2, "--cluster needs at least 2 nodes"
+    seconds = max(seconds, 30.0)
+    rng = random.Random(seed)
+    failures: list[str] = []
+    mini = MiniRedisServer()
+    await mini.start()
+    redis = AsyncRedis("127.0.0.1", mini.port)
+    node_ids = [f"soak-node-{i}" for i in range(n_nodes)]
+    procs: dict[str, asyncio.subprocess.Process] = {}
+    rtsp_ports: dict[str, int] = {}
+    rest_ports: dict[str, int] = {}
+    here = os.path.abspath(__file__)
+    for nid in node_ids:
+        p = await asyncio.create_subprocess_exec(
+            sys.executable, here, "--cluster-node", "--node-id", nid,
+            "--redis-port", str(mini.port),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL)
+        procs[nid] = p
+        line = await asyncio.wait_for(p.stdout.readline(), 60)
+        if not line.startswith(b"NODE_READY"):
+            raise RuntimeError(f"{nid} failed to boot: {line!r}")
+        kv = dict(t.split("=") for t in line.decode().split()[1:])
+        rtsp_ports[nid] = int(kv["rtsp"])
+        rest_ports[nid] = int(kv["rest"])
+
+    path = "/live/m"
+    ring = HashRing(node_ids, 64)
+    owner = ring.owner(path)
+    successor = [n for n in ring.rank(path) if n != owner][0]
+    pull_node = successor             # a guaranteed non-owner
+    dead: set[str] = set()
+    stats: dict = {"owner": owner, "successor": successor}
+
+    def _metrics(nid: str) -> dict[str, float]:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest_ports[nid]}/metrics",
+                timeout=5) as r:
+            return parse_metrics(r.read().decode())
+
+    udp_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    udp_rtp.bind(("127.0.0.1", 0))
+    udp_rtp.setblocking(False)
+    udp_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    udp_rtcp.bind(("127.0.0.1", 0))
+    udp_rtcp.setblocking(False)
+    pusher = _ClusterPusher(path, redis, rtsp_ports)
+    churn_ok = [0]
+    pull_rx = [0]
+    flash = []
+    try:
+        await pusher.connect_to(owner)
+        for _ in range(10):           # prime before anyone subscribes
+            pusher.push()
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(1.2)      # ≥2 cluster ticks: claim + ckpt up
+
+        # the subscriber that must survive the kill WITHOUT re-SETUP
+        udp_player = RtspClient()
+        await udp_player.connect("127.0.0.1", rtsp_ports[owner])
+        await udp_player.play_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[owner]}{path}", tcp=False,
+            client_ports=[(udp_rtp.getsockname()[1],
+                           udp_rtcp.getsockname()[1])])
+        # the cross-server subscriber (pull relay on a non-owner)
+        pull_player = RtspClient()
+        await pull_player.connect("127.0.0.1", rtsp_ports[pull_node])
+        await pull_player.play_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[pull_node]}{path}")
+
+        t0 = time.time()
+        t_kill = max(seconds * 0.45, seconds - 30.0)
+        t_flash_in, t_flash_out = seconds * 0.25, seconds * 0.7
+        killed = False
+        kill_mono = 0.0
+        recovery_sec: float | None = None
+        rx_seqs: list[int] = []
+        rx_ssrcs: set[bytes] = set()
+        pull_rx_after_kill = [0]
+
+        async def _pull_drain() -> None:
+            while time.time() - t0 < seconds:
+                try:
+                    await pull_player.recv_interleaved(0, timeout=0.25)
+                except asyncio.TimeoutError:
+                    continue
+                except (ConnectionError, Exception):
+                    return
+                pull_rx[0] += 1
+                if killed:
+                    pull_rx_after_kill[0] += 1
+
+        async def _churn() -> None:
+            """Short-lived UDP subscriber joins on random nodes — the
+            SETUP/TEARDOWN path must stay healthy under failover."""
+            while time.time() - t0 < seconds:
+                await asyncio.sleep(rng.uniform(1.5, 2.5))
+                nid = rng.choice([n for n in node_ids if n not in dead])
+                s1 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s1.bind(("127.0.0.1", 0))
+                s2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s2.bind(("127.0.0.1", 0))
+                c = RtspClient()
+                try:
+                    await c.connect("127.0.0.1", rtsp_ports[nid])
+                    await asyncio.wait_for(c.play_start(
+                        f"rtsp://127.0.0.1:{rtsp_ports[nid]}{path}",
+                        tcp=False,
+                        client_ports=[(s1.getsockname()[1],
+                                       s2.getsockname()[1])]), 5)
+                    churn_ok[0] += 1
+                    await asyncio.sleep(rng.uniform(0.5, 1.0))
+                except Exception:
+                    pass
+                finally:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                    s1.close()
+                    s2.close()
+
+        drain_task = asyncio.ensure_future(_pull_drain())
+        churn_task = asyncio.ensure_future(_churn())
+        while time.time() - t0 < seconds:
+            now = time.time() - t0
+            if await pusher.ensure_connected(dead):
+                pusher.push()
+            # drain the migrating UDP player, stamping recovery
+            while True:
+                try:
+                    d = udp_rtp.recv(65536)
+                except BlockingIOError:
+                    break
+                if len(d) >= 12:
+                    rx_seqs.append(struct.unpack("!H", d[2:4])[0])
+                    rx_ssrcs.add(d[8:12])
+                    if killed and recovery_sec is None:
+                        recovery_sec = time.monotonic() - kill_mono
+            if "flash_joined" not in stats and now >= t_flash_in:
+                # flash-crowd join wave on the non-owner (one-shot latch:
+                # list emptiness would re-fire the wave every iteration
+                # after the leave)
+                for _ in range(8):
+                    c = RtspClient()
+                    await c.connect("127.0.0.1", rtsp_ports[pull_node])
+                    await c.play_start(
+                        f"rtsp://127.0.0.1:{rtsp_ports[pull_node]}{path}")
+                    flash.append(c)
+                stats["flash_joined"] = len(flash)
+            if flash and now >= t_flash_out:
+                for c in flash:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                flash = []
+            if not killed and now >= t_kill:
+                # the seeded node-kill: SIGKILL the owner mid-relay
+                procs[owner].kill()
+                dead.add(owner)
+                killed = True
+                kill_mono = time.monotonic()
+                stats["killed_at"] = round(now, 1)
+            await asyncio.sleep(0.03)
+        await drain_task
+        await churn_task
+
+        # ------------------------------------------------------ verdicts
+        if not killed:
+            failures.append("node-kill never fired (duration too short)")
+        gap = _seq_gap(rx_seqs)
+        post_kill = recovery_sec is not None
+        if not post_kill:
+            failures.append("UDP player never resumed after the kill "
+                            "(no migration)")
+            recovery_sec = float("inf")
+        elif recovery_sec > 10.0:
+            failures.append(f"failover recovery {recovery_sec:.1f}s "
+                            "exceeds the 10 s budget")
+        if gap != 0:
+            failures.append(f"sequence gap across migration: {gap} "
+                            "packets missing at the player socket")
+        if len(rx_ssrcs) != 1:
+            failures.append(f"ssrc changed across migration: "
+                            f"{len(rx_ssrcs)} identities seen")
+        if len(rx_seqs) < 100:
+            failures.append(f"UDP player starved: {len(rx_seqs)} packets")
+        if pull_rx[0] < 50:
+            failures.append(f"pull subscriber starved: {pull_rx[0]}")
+        if pull_rx_after_kill[0] == 0:
+            failures.append("pull subscriber never progressed after the "
+                            "kill (adoption/pull re-resolution failed)")
+        if churn_ok[0] == 0:
+            failures.append("zero churn subscribers completed SETUP/PLAY")
+        m = _metrics(successor)
+        if m.get("cluster_migrations_total", 0) == 0:
+            failures.append("survivor counted zero cluster_migrations_total")
+        for k, v in m.items():
+            if k.startswith("resilience_ladder_level") and v != 0:
+                failures.append(f"unrecovered degradation at exit: "
+                                f"{k} = {v:.0f}")
+        for nid in node_ids:
+            if nid not in dead and procs[nid].returncode is not None:
+                failures.append(f"{nid} died unexpectedly "
+                                f"(rc={procs[nid].returncode})")
+        stats.update({
+            "udp_rx": len(rx_seqs),
+            "pull_rx": pull_rx[0],
+            "pull_rx_after_kill": pull_rx_after_kill[0],
+            "churn_ok": churn_ok[0],
+            "pusher_reconnects": pusher.reconnects,
+            "migrations": m.get("cluster_migrations_total"),
+            "pull_retries": m.get("cluster_pull_retries_total"),
+            "lease_lost": m.get("cluster_lease_lost_total"),
+            "redis_errors": m.get("redis_errors_total"),
+            # the bench extra.cluster shape bench_gate --check-only
+            # validates: {migration_gap_packets == 0,
+            # failover_recovery_sec <= 10}
+            "cluster": {
+                "migration_gap_packets": gap,
+                "failover_recovery_sec":
+                    round(recovery_sec, 2) if post_kill else None,
+            },
+        })
+        print("SOAK CLUSTER", "FAIL" if failures else "OK",
+              _json.dumps(stats))
+        for msg in failures:
+            print("  -", msg)
+    finally:
+        for c in flash:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for nid, p in procs.items():
+            if p.returncode is None:
+                p.kill()
+        for p in procs.values():
+            try:
+                await asyncio.wait_for(p.wait(), 10)
+            except asyncio.TimeoutError:
+                pass
+        await redis.close()
+        await mini.stop()
+        udp_rtp.close()
+        udp_rtcp.close()
+    return 1 if failures else 0
+
+
+def _parse_args(argv: list[str]):
     import argparse
     ap = argparse.ArgumentParser(
         description="integration soak (see module docstring)")
@@ -707,15 +1087,36 @@ def _parse_args(argv: list[str]) -> tuple[float, int, int | None]:
                          "inject.py) and assert the degradation ladder "
                          "recovers to full service; same seed → same "
                          "injection schedule (default seed 7)")
+    ap.add_argument("--cluster", type=int, default=0, metavar="N",
+                    help="multi-process cluster scenario instead: N "
+                         "server processes + mini Redis, subscriber "
+                         "churn, a flash-crowd wave, and a seeded "
+                         "owner SIGKILL that must recover via live "
+                         "session migration (ISSUE 6)")
+    # hidden child-process mode (spawned by --cluster)
+    ap.add_argument("--cluster-node", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--node-id", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--redis-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("seconds", nargs="?", type=float, default=None,
                     help="legacy positional form of --duration")
     ns = ap.parse_args(argv)
     if ns.duration is not None and ns.seconds is not None:
         ap.error("give --duration or the positional seconds, not both")
     d = ns.duration if ns.duration is not None else ns.seconds
-    return (120.0 if d is None else d), ns.sources, ns.chaos
+    ns.duration = 120.0 if d is None else d
+    return ns
 
 
 if __name__ == "__main__":
-    _dur, _src, _chaos = _parse_args(sys.argv[1:])
-    raise SystemExit(asyncio.run(soak(_dur, _src, _chaos)))
+    _ns = _parse_args(sys.argv[1:])
+    if _ns.cluster_node:
+        raise SystemExit(asyncio.run(
+            _cluster_node_main(_ns.node_id, _ns.redis_port)))
+    if _ns.cluster:
+        raise SystemExit(asyncio.run(
+            cluster_soak(_ns.cluster, _ns.duration,
+                         _ns.chaos if _ns.chaos is not None else 7)))
+    raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
+                                      _ns.chaos)))
