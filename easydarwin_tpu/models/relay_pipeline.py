@@ -196,6 +196,49 @@ def megabatch_window_step(window, out_state):
 warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
 
 
+#: built sharded megabatch steps, keyed by the mesh's device ids — a
+#: rebuilt-but-identical mesh (server restart path in tests) reuses the
+#: jitted step instead of paying a recompile per scheduler instance
+_SHARDED_STEPS: dict[tuple, object] = {}
+
+
+def sharded_megabatch_step(mesh):
+    """``megabatch_window_step`` placed across a relay mesh's ``src`` axis.
+
+    The stacked pass is a pure vmap over the leading STREAM axis —
+    per-stream parse/affine math with zero cross-stream dependencies —
+    so sharding that axis over ``src`` partitions the pass with no
+    collectives at all: each device parses and rewrites only its block
+    of streams.  In/out shardings reuse the dryrun-proven spec shape
+    (``parallel.mesh``: leading axis on ``src``, everything else
+    replicated per shard), and ``out_shardings`` keeps the packed result
+    sharded so the scheduler's harvest can fetch each device's slice
+    independently (per-device D2H, keyed egress scatter).
+
+    The window buffer is donated exactly as in the single-device step:
+    the scheduler assembles it from per-device staging buffers
+    (``jax.make_array_from_single_device_arrays``), so each shard's
+    upload is one contiguous H2D from host memory that device alone
+    reads.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # keyed by ids AND axis layout: the same devices reshaped (2,2,2)
+    # vs (8,1,1) partition the leading axis differently
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.devices.shape)
+    step = _SHARDED_STEPS.get(key)
+    if step is None:
+        win_s = NamedSharding(mesh, P("src", None, None))
+        out_s = NamedSharding(mesh, P("src", None))
+        from ..ops.fanout import relay_affine_step_window
+        step = jax.jit(relay_affine_step_window,
+                       in_shardings=(win_s, win_s), out_shardings=out_s,
+                       donate_argnums=(0,))
+        _SHARDED_STEPS[key] = step
+    return step
+
+
 def scatter_affine_segments(packed, n_subs):
     """Segment scatter: split one stacked packed result back into
     per-stream affine param sets.

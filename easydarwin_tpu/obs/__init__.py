@@ -24,6 +24,8 @@ from .families import (  # noqa: F401  (re-exported inventory)
     EVENTS_INVALID, EVENTS_SINK_FAILURES, FAULT_INJECTED, FLIGHT_DUMPS,
     INGEST_BUSY_SECONDS, INGEST_BYTES, INGEST_DATAGRAMS,
     INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS,
+    MEGABATCH_DEVICE_PASSES, MEGABATCH_DEVICE_PHASE_SECONDS,
+    MEGABATCH_DEVICE_STREAMS,
     MEGABATCH_FALLBACK, MEGABATCH_PASSES, MEGABATCH_STREAMS,
     MEGABATCH_WIRE_MISMATCH, PROFILE_PHASE_DRIFT, QOS_FRACTION_LOST,
     QOS_JITTER, QOS_THICKENS, QOS_THINS, REDIS_ERRORS, REGISTRY,

@@ -106,6 +106,31 @@ MEGABATCH_WIRE_MISMATCH = REGISTRY.counter(
     "arithmetic oracle for the same rewrite state (the result is discarded "
     "and the stream falls back to per-stream stepping; any nonzero value "
     "is a device/host divergence bug)")
+# Mesh dispatch (ISSUE 7): the stacked pass sharded over a (src)-axis
+# device mesh.  The ``device`` label is the SHARD INDEX within the mesh
+# ("0".."N-1"), never a backend device-id string — tools/metrics_lint.py
+# bounds the cardinality (a full v5 pod slice is 256 chips; an id string
+# like "TPU_v5litepod_..." would shard the family per hostname).  On a
+# 1-device box (no mesh) these families stay at zero with no children.
+MEGABATCH_DEVICE_PASSES = REGISTRY.counter(
+    "megabatch_device_passes_total",
+    "Stacked megabatch shard passes executed per mesh device (one per "
+    "device per dispatched bucket that carried at least one real stream "
+    "row for that shard)", labels=("device",))
+MEGABATCH_DEVICE_STREAMS = REGISTRY.counter(
+    "megabatch_device_streams_total",
+    "Streams whose window rode each mesh device's shard of a stacked "
+    "megabatch pass (streams/passes per device = shard occupancy; a "
+    "skewed distribution means the stream->shard split is unbalanced)",
+    labels=("device",))
+MEGABATCH_DEVICE_PHASE_SECONDS = REGISTRY.histogram(
+    "megabatch_device_phase_seconds",
+    "Per-mesh-device phase durations of the sharded megabatch path: h2d "
+    "= that shard's contiguous staging upload, device_step = the "
+    "harvest-side wait for that shard's result to become ready, d2h = "
+    "fetching that shard's packed params slice; device label is the "
+    "shard index within the serving mesh",
+    labels=("device", "phase"), buckets=TIME_BUCKETS)
 STAGE_GATHER_BYTES = REGISTRY.counter(
     "stage_gather_bytes_total",
     "Prefix+length bytes packed into contiguous upload buffers by the "

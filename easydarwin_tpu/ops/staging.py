@@ -11,7 +11,13 @@ way, so the device step never sees which host packed its input.
 The staging buffers themselves are owned by the scheduler
 (``relay.megabatch``), double-buffered per shape bucket: while the
 device/DMA reads the buffer dispatched at wake N, the host gathers wake
-N+1 into the alternate.
+N+1 into the alternate.  Under a serving mesh (ISSUE 7) the bucket's
+rows are split into PER-DEVICE buffers — one independent C-contiguous
+array per mesh shard, sized by ``rows_per_shard`` — so each device's
+H2D transfer is a single contiguous copy from host memory that only
+that device reads (a global buffer sliced per shard would couple every
+device's upload to one allocation's lifetime and defeat the per-shard
+double buffer).
 """
 
 from __future__ import annotations
@@ -23,6 +29,29 @@ from .parse import PARSE_PREFIX
 
 #: bytes per fused staging row (prefix + trailing le32 length)
 ROW_STRIDE = PARSE_PREFIX + WINDOW_EXTRA
+
+
+def pow2(n: int, lo: int) -> int:
+    """Smallest power-of-two multiple of ``lo`` that is >= ``n`` (with
+    ``lo`` itself a power of two) — THE bucket-shape rounding rule every
+    staging path shares (per-stream pads, megabatch buckets, per-shard
+    blocks), so jit specializations latch on one shape family."""
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+def rows_per_shard(n_rows: int, n_shards: int) -> int:
+    """Stream rows each mesh shard stages for a bucket of ``n_rows``
+    real streams over ``n_shards`` devices: the pow2-padded per-shard
+    block (min 1), so the GLOBAL leading axis is ``n_shards * rows_per``
+    — divisible by the mesh's ``src`` axis for any device count, while
+    jit specializations stay latched per pow2 bucket shape exactly as
+    on the single-device path.  Uneven stream counts leave the tail
+    shard(s) with zero-filled pad rows (the dryrun's pad-mask rule:
+    zero windows + zero state stage nothing and install nothing)."""
+    return pow2((max(n_rows, 1) + n_shards - 1) // n_shards, 1)
 
 
 def gather_window(ring, start: int, count: int, out_rows: np.ndarray,

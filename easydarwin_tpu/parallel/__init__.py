@@ -15,7 +15,7 @@ All collectives ride ICI inside a pod; the Redis/JSON control plane is kept
 unchanged (it is orthogonal to the data path) for multi-host DCN scale-out.
 """
 
-from .mesh import (make_relay_mesh, sharded_relay_step,  # noqa: F401
-                   example_batch)
+from .mesh import (make_megabatch_mesh, make_relay_mesh,  # noqa: F401
+                   sharded_relay_step, example_batch)
 from .distributed import (init_from_env, make_cluster_mesh,  # noqa: F401
-                          process_span)
+                          mesh_summary, process_span)

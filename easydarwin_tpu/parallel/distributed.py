@@ -118,5 +118,25 @@ def process_span(mesh: Mesh) -> dict:
             "mesh_shape": dict(zip(AXES, devs.shape))}
 
 
+def mesh_summary(mesh: Mesh) -> dict[str, str]:
+    """``process_span`` flattened to the string-valued dict shape the
+    REST ``getserverinfo`` document uses — the live-server surface for
+    the mesh→process mapping (previously reachable only from the
+    dryrun).  Operators read it to confirm the serving mesh matches the
+    deployment: how many hosts, devices per host, the (src, sub, win)
+    factorization, and whether any non-src axis crosses a DCN boundary
+    (it never should — see the module doc)."""
+    span = process_span(mesh)
+    shape = span["mesh_shape"]
+    return {
+        "MeshDevices": str(int(mesh.devices.size)),
+        "MeshShape": ",".join(f"{a}={shape[a]}" for a in AXES),
+        "MeshNumProcesses": str(span["num_processes"]),
+        "MeshLocalDevices": str(span["local_devices"]),
+        "MeshNonSrcAxisCrossesHosts":
+            "1" if span["non_src_axis_crosses_hosts"] else "0",
+    }
+
+
 __all__ = ["init_from_env", "make_cluster_mesh", "make_relay_mesh",
-           "process_span"]
+           "mesh_summary", "process_span"]

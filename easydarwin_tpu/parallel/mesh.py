@@ -35,6 +35,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import fanout as fanout_ops
 from ..ops import parse as parse_ops
 
+try:                                    # jax >= 0.4.38 exports it top-level
+    _shard_map = jax.shard_map
+except AttributeError:                  # older: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 AXES = ("src", "sub", "win")
 
 
@@ -53,6 +58,23 @@ def make_relay_mesh(devices=None, *, src: int | None = None,
     if src * sub * win != n:
         raise ValueError(f"mesh {src}x{sub}x{win} != {n} devices")
     return Mesh(devices.reshape(src, sub, win), AXES)
+
+
+def make_megabatch_mesh(n_devices: int = 0, devices=None) -> Mesh | None:
+    """The megabatch scheduler's serving mesh: ``src``-only (streams
+    shard over devices; ``sub``/``win`` stay whole because the stacked
+    pass is already one fused window per stream).
+
+    ``n_devices``: 0 = every local device, N = the first N local
+    devices.  Returns ``None`` when fewer than two devices would
+    participate — the caller then keeps the single-device dispatch path
+    (a 1-device box degrades to exactly the pre-mesh behavior)."""
+    import jax
+    devices = list(devices) if devices is not None else jax.local_devices()
+    n = len(devices) if n_devices <= 0 else min(n_devices, len(devices))
+    if n < 2:
+        return None
+    return make_relay_mesh(devices[:n], src=n, sub=1, win=1)
 
 
 def _local_step(prefix, length, age, out_state, buckets, bucket_delay_ms):
@@ -95,7 +117,7 @@ def sharded_relay_step(mesh: Mesh, bucket_delay_ms: int = 73):
                 P("src", "sub", None), P("src", "sub"))
     out_specs = (P("src", "sub", "win", None), P("src", "sub", "win"),
                  P("src"), P())
-    step = jax.shard_map(
+    step = _shard_map(
         functools.partial(_local_step, bucket_delay_ms=bucket_delay_ms),
         mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     return jax.jit(step)

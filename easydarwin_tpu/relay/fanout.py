@@ -51,11 +51,9 @@ def render_headers(b01: np.ndarray, seq: np.ndarray, ts: np.ndarray,
     return out
 
 
-def _pow2(n: int, lo: int) -> int:
-    p = lo
-    while p < n:
-        p <<= 1
-    return p
+# the ONE bucket-shape rounding rule (ops/staging.py); re-exported under
+# the historical name every megabatch consumer imports from here
+from ..ops.staging import pow2 as _pow2  # noqa: E402
 
 
 def params_key(outputs) -> tuple:
@@ -140,6 +138,12 @@ class TpuFanoutEngine:
         #: device query (the slow path)
         self.megabatch_params: tuple | None = None
         self.megabatch_installs = 0
+        #: mesh shard index that computed the last installed override
+        #: (-1 = single-device dispatch or synchronous prime) — the
+        #: per-stream half of the scheduler's device-keyed scatter,
+        #: surfaced so an operator chasing one stream's divergence can
+        #: see which chip produced its params
+        self.megabatch_shard = -1
         # per-pass phase attribution scratch (obs/profile.py), keyed
         # (engine, phase): sub-steps accumulate brackets here; step()
         # reports the merged dict once per engine

@@ -42,6 +42,34 @@ The harvest never blocks a wake: an in-flight result that is not ready
 yet simply stays in flight (engines keep their cached params — on a
 tunneled device with ~180 ms RTT the pipeline depth absorbs the
 latency), bounded by ``max_inflight`` outstanding passes.
+
+**Mesh dispatch (ISSUE 7).**  Given a serving mesh
+(``parallel.mesh.make_megabatch_mesh`` — ``src``-only, built once at
+server startup from ``megabatch_devices``), each bucket's leading
+stream axis is sharded over the mesh instead of landing on the default
+device:
+
+* staging is split into PER-DEVICE buffers (``ops.staging.
+  rows_per_shard`` rows each, same pow2 bucket-shape latching), so each
+  shard's H2D is one contiguous upload only that device reads;
+* one ``models.relay_pipeline.sharded_megabatch_step`` dispatch per
+  bucket — the pass is a pure vmap over streams, so the ``src``
+  sharding partitions it with zero collectives;
+* harvest stays non-blocking under the same ``MAX_INFLIGHT`` double
+  buffer and fetches each device's packed slice independently
+  (``addressable_shards``), and the egress scatter is keyed by shard:
+  a stream's params are installed from the device that computed them,
+  through the SAME ``_install_segment`` host-oracle check — a sharding
+  bug degrades that stream to per-stream stepping, never the wire;
+* uneven stream counts pad-mask the ``src`` axis exactly as the
+  multichip dryrun does: tail rows are zero windows + zero state,
+  which stage nothing and install nothing.
+
+With no mesh (1-device box, ``megabatch_devices=1``, mesh build
+failure) every dispatch takes the original single-device path and the
+``megabatch_device_*`` families stay empty.  A mesh dispatch failure
+propagates to the pump like any device error (the PR 5 ladder owns the
+degradation).
 """
 
 from __future__ import annotations
@@ -52,7 +80,8 @@ import numpy as np
 
 from .. import obs
 from ..models.relay_pipeline import (megabatch_window_step,
-                                     scatter_affine_segments)
+                                     scatter_affine_segments,
+                                     sharded_megabatch_step)
 from ..obs import PROFILER, TRACER
 from ..ops import staging
 from ..ops.fanout import STATE_COLS, pack_output_state
@@ -77,17 +106,21 @@ def _host_affine_params(key) -> tuple:
 class _InFlight:
     """One dispatched stacked pass awaiting harvest."""
 
-    __slots__ = ("result", "entries", "buf", "dispatch_ns")
+    __slots__ = ("result", "entries", "buf", "dispatch_ns", "rows_per")
 
-    def __init__(self, result, entries, buf, dispatch_ns):
+    def __init__(self, result, entries, buf, dispatch_ns, rows_per=None):
         self.result = result
-        #: per-row (stream, engine, key, n_fast, base_pid)
+        #: per-row (stream, engine, key, n_fast, base_pid, shard)
         self.entries = entries
-        #: the host staging buffer this pass was uploaded from — held
-        #: until harvest so no later wake can rewrite it while the
-        #: device/DMA may still be reading it, then recycled
+        #: the host staging this pass was uploaded from — one buffer on
+        #: the single-device path, a per-shard buffer LIST on the mesh
+        #: path — held until harvest so no later wake can rewrite it
+        #: while the device/DMA may still be reading it, then recycled
         self.buf = buf
         self.dispatch_ns = dispatch_ns
+        #: mesh passes only: stream rows per shard (the leading-axis
+        #: block each device owns); None = single-device pass
+        self.rows_per = rows_per
 
 
 class MegabatchScheduler:
@@ -105,7 +138,23 @@ class MegabatchScheduler:
     #: runtime cannot report readiness (safety valve, not the hot path)
     FORCE_FETCH_NS = 2_000_000_000
 
-    def __init__(self):
+    def __init__(self, mesh=None):
+        #: the serving mesh (``parallel.mesh.make_megabatch_mesh``), or
+        #: None for the single-device dispatch path.  Built once by the
+        #: caller — the scheduler never probes devices itself, so a
+        #: 1-device box constructs in microseconds with zero jax calls
+        self.mesh = None
+        self._mesh_devices: list = []
+        self._sharded_step = None
+        if mesh is not None and mesh.devices.size > 1:
+            self.mesh = mesh
+            # src-major flat order: shard k of the leading stream axis
+            # lands on _mesh_devices[k]
+            self._mesh_devices = list(mesh.devices.reshape(-1))
+            self._sharded_step = sharded_megabatch_step(mesh)
+        #: staging buffers kept per hot shape: 2 per device (the double
+        #: buffer), since every shard of a bucket draws from one pool
+        self._pool_cap = 2 * max(1, len(self._mesh_devices))
         self._tracked: dict[int, int] = {}     # id(stream) → staged head
         #: id(stream) → (params_key, packed out_state row) — the packed
         #: state is a pure function of the key, and the key comparison
@@ -128,6 +177,7 @@ class MegabatchScheduler:
         self._traced_shapes: set[tuple] = set()
         self.wakes = 0
         self.passes = 0
+        self.sharded_passes = 0            # mesh-dispatched buckets
         self.streams_coalesced = 0
         self.harvests = 0
         self.mismatches = 0
@@ -293,16 +343,20 @@ class MegabatchScheduler:
 
     def _recycle(self, buf: np.ndarray) -> None:
         pool = self._free.setdefault((buf.shape[0], buf.shape[1]), [])
-        if len(pool) < 2:                  # double buffer per shape; a
-            pool.append(buf)               # cold shape's extras are GC'd
+        if len(pool) < self._pool_cap:     # double buffer per shape (per
+            pool.append(buf)               # shard under a mesh); a cold
+            # shape's extras are GC'd
 
-    def _install_segment(self, eng, key, seg, base=None) -> bool:
+    def _install_segment(self, eng, key, seg, base=None,
+                         shard: int = -1) -> bool:
         """Oracle-check one scattered segment and install it as the
-        engine's params override — the ONE definition both the harvest
-        and the synchronous prime go through, so a tightened mismatch
-        check can never apply to one path and not the other.  Returns
-        False (and counts the mismatch) on device/host divergence; the
-        stream then falls back to per-stream stepping."""
+        engine's params override — the ONE definition the harvest (both
+        dispatch paths) and the synchronous prime go through, so a
+        tightened mismatch check can never apply to one path and not
+        the other.  ``shard`` records which mesh device computed the
+        segment (-1 = single-device/prime).  Returns False (and counts
+        the mismatch) on device/host divergence; the stream then falls
+        back to per-stream stepping."""
         seq_off, ts_off, ssrc, kf = seg
         host = _host_affine_params(key)
         if not (np.array_equal(seq_off[0], host[0])
@@ -311,8 +365,10 @@ class MegabatchScheduler:
             self.mismatches += 1
             obs.MEGABATCH_WIRE_MISMATCH.inc()
             eng.megabatch_params = None
+            eng.megabatch_shard = -1
             return False
         eng.megabatch_params = (key, (seq_off, ts_off, ssrc))
+        eng.megabatch_shard = shard
         if base is not None and kf >= 0:
             # parity with the per-stream query, which maintains this
             # diagnostic field — an owned stream must not hold it stale
@@ -345,6 +401,8 @@ class MegabatchScheduler:
             # mutates cursors — the pump catches it, degrades the wake
             # to per-stream stepping and charges the ladder
             INJECTOR.device_dispatch("megabatch.dispatch")
+        if self._sharded_step is not None:
+            return self._dispatch_bucket_mesh(entries, p_pad, s_pad)
         b_pad = _pow2(len(entries), 1)
         t_g = time.perf_counter_ns()
         win = self._buffer(b_pad, p_pad)
@@ -354,7 +412,7 @@ class MegabatchScheduler:
             staging.gather_window(stream.rtp_ring, base, n_new, win[i])
             state[i, :len(fast)] = self._packed_state(stream, fast, key)
             self._tracked[id(stream)] = base + n_new
-            recs.append((stream, eng, key, len(fast), base))
+            recs.append((stream, eng, key, len(fast), base, -1))
         if b_pad > len(entries):
             win[len(entries):] = 0         # bucket padding rows
         gather_ns = time.perf_counter_ns() - t_g
@@ -379,6 +437,128 @@ class MegabatchScheduler:
         self._note_pass(len(entries), win.nbytes + state.nbytes)
         return gather_ns, h2d_ns
 
+    def _dispatch_bucket_mesh(self, entries, p_pad: int,
+                              s_pad: int) -> tuple[int, int]:
+        """One bucket sharded over the serving mesh's ``src`` axis.
+
+        Stream i rides global row i; shard k owns the contiguous row
+        block [k·rows_per, (k+1)·rows_per), staged into its OWN host
+        buffer so each device's upload is one contiguous H2D.  The
+        global window is assembled from the per-device uploads without
+        any host-side concatenation (``make_array_from_single_device_
+        arrays``), then donated to the sharded step.  Trailing rows —
+        bucket pow2 padding AND the uneven-stream-count remainder — are
+        zero windows + zero state, the dryrun's pad-mask rule."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = len(self._mesh_devices)
+        rows_per = staging.rows_per_shard(len(entries), n_dev)
+        b_pad = rows_per * n_dev
+        t_g = time.perf_counter_ns()
+        shard_bufs = [self._buffer(rows_per, p_pad) for _ in range(n_dev)]
+        state = np.zeros((b_pad, s_pad, STATE_COLS), np.uint32)
+        recs = []
+        filled = [0] * n_dev
+        for i, (stream, eng, fast, key, base, n_new) in enumerate(entries):
+            k, r = divmod(i, rows_per)
+            staging.gather_window(stream.rtp_ring, base, n_new,
+                                  shard_bufs[k][r])
+            state[i, :len(fast)] = self._packed_state(stream, fast, key)
+            self._tracked[id(stream)] = base + n_new
+            recs.append((stream, eng, key, len(fast), base, k))
+            filled[k] = r + 1
+        for k, buf in enumerate(shard_bufs):
+            if filled[k] < rows_per:
+                buf[filled[k]:] = 0        # shard/bucket padding rows
+        gather_ns = time.perf_counter_ns() - t_g
+        t_h = time.perf_counter_ns()
+        win_s = NamedSharding(self.mesh, P("src", None, None))
+        arrs = []
+        for k, buf in enumerate(shard_bufs):
+            t_k = time.perf_counter_ns()
+            arrs.append(jax.device_put(buf, self._mesh_devices[k]))
+            obs.MEGABATCH_DEVICE_PHASE_SECONDS.observe(
+                (time.perf_counter_ns() - t_k) / 1e9,
+                device=str(k), phase="h2d")
+        dwin = jax.make_array_from_single_device_arrays(
+            (b_pad, p_pad, staging.ROW_STRIDE), win_s, arrs)
+        dstate = jax.device_put(state, win_s)
+        res = self._sharded_step(dwin, dstate)
+        try:
+            res.copy_to_host_async()
+        except AttributeError:
+            pass
+        h2d_ns = time.perf_counter_ns() - t_h
+        shape = ("mesh", b_pad, p_pad, s_pad)
+        if shape not in self._traced_shapes:
+            self._traced_shapes.add(shape)
+            PROFILER.note_compile(
+                f"megabatch.step[mesh{n_dev}:{b_pad}x{p_pad}x{s_pad}]",
+                h2d_ns / 1e9)
+            h2d_ns = 0
+        self._inflight.append(
+            _InFlight(res, recs, shard_bufs, time.perf_counter_ns(),
+                      rows_per=rows_per))
+        self.sharded_passes += 1
+        for k, n in enumerate(filled):
+            if n:                          # pad-only shards count nothing
+                obs.MEGABATCH_DEVICE_PASSES.inc(device=str(k))
+                obs.MEGABATCH_DEVICE_STREAMS.inc(n, device=str(k))
+        self._note_pass(len(entries),
+                        sum(b.nbytes for b in shard_bufs) + state.nbytes)
+        return gather_ns, h2d_ns
+
+    def _consume_mesh(self, inf: _InFlight, ready: bool) -> tuple[int, int]:
+        """Harvest one mesh pass per device: fetch each shard's packed
+        slice independently and scatter/install ONLY the streams that
+        shard computed — the egress scatter keyed by device the tentpole
+        requires, so a single misplaced shard can corrupt at most its
+        own block (and the host oracle then catches every row of it).
+        Returns (installed, fetch_ns) where fetch_ns covers the
+        wait+copy brackets only (scatter/install stays unphased)."""
+        import jax
+
+        installed = 0
+        fetch_ns = 0
+        shards = sorted(inf.result.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        for k, sh in enumerate(shards):
+            ents = inf.entries[k * inf.rows_per:(k + 1) * inf.rows_per]
+            if not ents:
+                continue               # padding-only shard: nothing to fetch
+            dat = sh.data
+            t_w = time.perf_counter_ns()
+            if ready:
+                shard_ready = True     # whole array ready ⇒ every shard is
+            else:
+                try:
+                    shard_ready = bool(dat.is_ready())
+                except AttributeError:
+                    shard_ready = True
+            if not shard_ready:
+                # the un-hidden remainder of THIS device's compute (a
+                # skewed shard shows up here, not smeared over the mesh)
+                jax.block_until_ready(dat)
+                obs.MEGABATCH_DEVICE_PHASE_SECONDS.observe(
+                    (time.perf_counter_ns() - t_w) / 1e9,
+                    device=str(k), phase="device_step")
+            t_f = time.perf_counter_ns()
+            packed = np.asarray(dat)
+            t_d = time.perf_counter_ns()
+            fetch_ns += t_d - t_w
+            obs.MEGABATCH_DEVICE_PHASE_SECONDS.observe(
+                (t_d - t_f) / 1e9, device=str(k), phase="d2h")
+            obs.TPU_D2H_BYTES.inc(packed.nbytes)
+            segs = scatter_affine_segments(
+                packed, [n for (_s, _e, _k, n, _b, _sh) in ents])
+            for (stream, eng, key, n_fast, base, shard), seg in zip(ents,
+                                                                    segs):
+                if self._install_segment(eng, key, seg, base=base,
+                                         shard=shard):
+                    installed += 1
+        return installed, fetch_ns
+
     # ------------------------------------------------------------- harvest
     def _harvest(self, *, force: bool = False) -> int:
         if not self._inflight:
@@ -397,26 +577,33 @@ class MegabatchScheduler:
             if not (ready or force or age >= self.FORCE_FETCH_NS):
                 keep.append(inf)           # never stall the wake on it
                 continue
-            t_f = time.perf_counter_ns()
-            packed = np.asarray(inf.result)
-            fetch_ns = time.perf_counter_ns() - t_f
+            if inf.rows_per is not None:
+                got, fetch_ns = self._consume_mesh(inf, ready)
+                installed += got
+            else:
+                t_f = time.perf_counter_ns()
+                packed = np.asarray(inf.result)
+                fetch_ns = time.perf_counter_ns() - t_f
+                obs.TPU_D2H_BYTES.inc(packed.nbytes)
+                segs = scatter_affine_segments(
+                    packed, [n for (_s, _e, _k, n, _b, _sh)
+                             in inf.entries])
+                for (stream, eng, key, n_fast, base, _sh), seg in zip(
+                        inf.entries, segs):
+                    if self._install_segment(eng, key, seg, base=base):
+                        installed += 1
             # honest split (PR 3 attribution discipline): a READY result's
             # fetch is the d2h copy, same meaning as the engine's d2h; a
             # NOT-ready fetch (forced/aged) is the pipeline's un-hidden
-            # remainder — h2d_overlap.  The scatter/oracle/install below
+            # remainder — h2d_overlap.  The scatter/oracle/install work
             # is host bookkeeping and stays unphased.
             if ready:
                 d2h_ns += fetch_ns
             else:
                 overlap_ns += fetch_ns
-            obs.TPU_D2H_BYTES.inc(packed.nbytes)
-            segs = scatter_affine_segments(
-                packed, [n for (_s, _e, _k, n, _b) in inf.entries])
-            for (stream, eng, key, n_fast, base), seg in zip(inf.entries,
-                                                             segs):
-                if self._install_segment(eng, key, seg, base=base):
-                    installed += 1
-            self._recycle(inf.buf)
+            for b in (inf.buf if isinstance(inf.buf, list)
+                      else (inf.buf,)):
+                self._recycle(b)
             self.harvests += 1
         self._inflight = keep
         if overlap_ns or d2h_ns:
@@ -434,6 +621,8 @@ class MegabatchScheduler:
         return {
             "wakes": self.wakes,
             "passes": self.passes,
+            "sharded_passes": self.sharded_passes,
+            "mesh_devices": len(self._mesh_devices),
             "streams_coalesced": self.streams_coalesced,
             "streams_per_pass": round(
                 self.streams_coalesced / self.passes, 2) if self.passes

@@ -129,6 +129,10 @@ class StreamingServer:
         #: cross-stream megabatch scheduler (relay/megabatch.py) — built
         #: lazily on the first wake with enough engine-eligible streams
         self.megabatch = None
+        #: the megabatch serving mesh (megabatch_devices > 1), built in
+        #: start() so a bad device config fails loudly at boot, not on
+        #: the first busy wake; None = single-device dispatch
+        self.megabatch_mesh = None
         self.started_at = time.time()
         from .status import StatusMonitor
         self.status = StatusMonitor(self)
@@ -199,6 +203,27 @@ class StreamingServer:
                 if self.error_log:
                     self.error_log.warning(f"checkpoint restore: {e!r}")
         self.rtsp.modules.run_initialize(self)
+        if (self.config.tpu_fanout and self.config.megabatch_enabled
+                and self.config.megabatch_devices != 1):
+            # the megabatch serving mesh (ISSUE 7): built before the
+            # pump's first wake; any failure here (bad device count, no
+            # backend) degrades to single-device dispatch with a logged
+            # warning rather than a dead pump
+            try:
+                from ..parallel.mesh import make_megabatch_mesh
+                self.megabatch_mesh = make_megabatch_mesh(
+                    self.config.megabatch_devices)
+                if self.megabatch_mesh is not None and self.error_log:
+                    from ..parallel.distributed import process_span
+                    self.error_log.info(
+                        "megabatch mesh: "
+                        f"{process_span(self.megabatch_mesh)}")
+            except Exception as e:
+                self.megabatch_mesh = None
+                if self.error_log:
+                    self.error_log.warning(
+                        f"megabatch mesh unavailable, serving "
+                        f"single-device: {e!r}")
         self._tasks = [
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
@@ -467,7 +492,8 @@ class StreamingServer:
             if len(mega_pairs) >= self.config.megabatch_min_streams:
                 if self.megabatch is None:
                     from ..relay.megabatch import MegabatchScheduler
-                    self.megabatch = MegabatchScheduler()
+                    self.megabatch = MegabatchScheduler(
+                        mesh=self.megabatch_mesh)
                 try:
                     self.megabatch.begin_wake(mega_pairs, t)
                 except Exception as e:
@@ -750,7 +776,20 @@ class StreamingServer:
         # baseline instead of racing it (the old sample()-everywhere
         # design zeroed whichever reader came second in a tick)
         d = self.status.snapshot()
+        mesh_info = {}
+        if self.megabatch_mesh is not None:
+            # the mesh→process mapping, live (previously only the
+            # multichip dryrun could see process_span)
+            try:
+                from ..parallel.distributed import mesh_summary
+                mesh_info = mesh_summary(self.megabatch_mesh)
+                if self.megabatch is not None:
+                    mesh_info["MeshShardedPasses"] = str(
+                        self.megabatch.sharded_passes)
+            except Exception:
+                mesh_info = {}
         return {
+            **mesh_info,
             "ServerName": "easydarwin-tpu",
             "Version": "0.1.0",
             "UpTimeSec": str(d["uptime_sec"]),

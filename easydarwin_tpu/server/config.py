@@ -51,6 +51,12 @@ class ServerConfig:
     # below this many engine-eligible streams the coalescing overhead
     # isn't worth a stacked pass; per-stream stepping is used as-is
     megabatch_min_streams: int = 2
+    # devices the megabatch serves from (ISSUE 7): 1 = the default
+    # single-device dispatch; N > 1 = shard each shape bucket's stream
+    # axis over the first N local devices (parallel.mesh src-only mesh);
+    # 0 = every local device.  Clamped to what the box actually has —
+    # a 1-device box always degrades to the single-device path
+    megabatch_devices: int = 1
     # shared UDP egress pair for players (RTPSocketPool/UDPDemuxer shape;
     # required by the native sendmmsg/GSO fan-out). Falls back to per-client
     # port pairs when off or when the native core is unavailable.

@@ -122,6 +122,42 @@ def check_trajectory(traj: list[dict],
                 if ph not in PHASES:
                     errs.append(f"{name}: multi_source phase {ph!r} outside "
                                 f"the closed vocabulary {PHASES}")
+        # ISSUE 7 multichip section — OPTIONAL (rounds predating the
+        # mesh dispatch stay valid), but when present its figures must
+        # be sane: a real device count, positive finite rates, a finite
+        # positive scaling efficiency, zero wire mismatches, and any
+        # per-device phase names inside the closed mesh-phase subset.
+        # Efficiency is NOT gated against a target here — on the forced-
+        # host CPU mesh the "devices" share cores and sub-linear is the
+        # honest result; near-linear is the goal on real chips only
+        mc = extra.get("multichip")
+        if isinstance(mc, dict) and mc and "error" not in mc:
+            nd = mc.get("n_devices")
+            if not isinstance(nd, int) or nd < 1:
+                errs.append(f"{name}: multichip.n_devices {nd!r} not a "
+                            "positive device count")
+            for kf in ("packets_per_sec_per_device", "scaling_efficiency"):
+                v2 = mc.get(kf)
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 <= 0:
+                    errs.append(f"{name}: multichip.{kf} {v2!r} not a "
+                                "positive finite figure")
+            mm = mc.get("wire_mismatches", 0)
+            if mm:
+                errs.append(f"{name}: multichip recorded {mm} wire "
+                            "mismatches (device/host divergence on the "
+                            "mesh path)")
+            if isinstance(nd, int) and nd > 1 \
+                    and mc.get("sharded_passes", 0) == 0:
+                errs.append(f"{name}: multichip ran {nd} devices but "
+                            "zero sharded passes (mesh never dispatched)")
+            from tools.metrics_lint import MESH_PHASES
+            for dev, phs in (mc.get("device_phase_ms") or {}).items():
+                for ph in phs:
+                    if ph not in MESH_PHASES:
+                        errs.append(f"{name}: multichip device phase "
+                                    f"{ph!r} outside the closed set "
+                                    f"{MESH_PHASES}")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

@@ -145,6 +145,68 @@ def lint_phases(registry, phases=None, engines=None) -> list[str]:
     return errs
 
 
+#: megabatch mesh metrics: the device label is a SHARD INDEX, and a
+#: serving mesh is bounded by one host's devices — anything past this is
+#: an id string / hostname leaking into the label (unbounded cardinality)
+MAX_MESH_SHARDS = 64
+#: the per-device phase vocabulary (a subset of obs.profile.PHASES)
+MESH_PHASES = ("h2d", "device_step", "d2h")
+
+
+def lint_megabatch_devices(registry) -> list[str]:
+    """The mesh-dispatch contract (ISSUE 7): the ``megabatch_device_*``
+    families exist with their exact label sets; every observed
+    ``device`` label is a decimal shard index below ``MAX_MESH_SHARDS``
+    (never a backend device-id string — "TPU_v5litepod_4x4_..." would
+    shard the family per hostname and break every per-device ratio);
+    and the per-device phase vocabulary stays inside the closed
+    ``MESH_PHASES`` subset of ``obs.profile.PHASES``."""
+    errs: list[str] = []
+    want_labels = {
+        "megabatch_device_passes_total": ("device",),
+        "megabatch_device_streams_total": ("device",),
+        "megabatch_device_phase_seconds": ("device", "phase"),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"megabatch mesh family {fam_name} missing from "
+                        "the registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+
+    def check_device(fam_name: str, device: str) -> None:
+        if not device.isdigit() or int(device) >= MAX_MESH_SHARDS:
+            errs.append(f"{fam_name}: device label {device!r} is not a "
+                        f"shard index < {MAX_MESH_SHARDS} (device-id "
+                        "strings are unbounded-cardinality)")
+
+    for fam_name in ("megabatch_device_passes_total",
+                     "megabatch_device_streams_total"):
+        for key in getattr(fams.get(fam_name), "_values", {}):
+            check_device(fam_name, key[0])
+    fam = fams.get("megabatch_device_phase_seconds")
+    if fam is not None:
+        from easydarwin_tpu.obs.profile import PHASES
+        for device, phase in getattr(fam, "_states", {}):
+            check_device("megabatch_device_phase_seconds", device)
+            if phase not in MESH_PHASES:
+                errs.append(f"megabatch_device_phase_seconds: phase "
+                            f"{phase!r} outside the closed set "
+                            f"{MESH_PHASES}")
+            elif phase not in PHASES:
+                errs.append(f"megabatch_device_phase_seconds: phase "
+                            f"{phase!r} is in MESH_PHASES but missing "
+                            "from obs.profile.PHASES (vocabularies out "
+                            "of sync)")
+    return errs
+
+
 def lint_resilience(registry, schema: dict) -> list[str]:
     """The resilience contract (ISSUE 5): the fault-injection /
     degradation-ladder / checkpoint families exist with their exact
@@ -314,6 +376,9 @@ def main() -> int:
             obs.REGISTRY.get(fam)
         except KeyError:
             errs.append(f"megabatch family {fam} missing from the registry")
+    # the mesh-dispatch vocabulary (ISSUE 7): megabatch_device_* family
+    # set, shard-index device labels, closed per-device phase subset
+    errs += lint_megabatch_devices(obs.REGISTRY)
     # the resilience subsystem's vocabulary (ISSUE 5): fault sites,
     # ladder rung gauge, checkpoint counters and the fault.*/ladder.*/
     # ckpt.* event schema

@@ -189,12 +189,18 @@ def check_metrics(scrapes: list[dict[str, float]], *,
     return errs
 
 
-def multi_source_section(n_sources: int, seconds: float = 2.0) -> list[str]:
+def multi_source_section(n_sources: int, seconds: float = 2.0,
+                         devices: int = 1) -> list[str]:
     """Drive the cross-stream megabatch scheduler with ``n_sources``
     native-addressed relay streams in-process (same obs globals the
     server scrapes, so megabatch_* counters land in /metrics).  Returns
     failures; success means stacked passes ran, the per-stream device
-    path stayed idle, and zero wire mismatches were counted."""
+    path stayed idle, and zero wire mismatches were counted.
+
+    ``devices > 1`` (``--devices N``) places the stacked passes over a
+    src-axis device mesh (ISSUE 7) and additionally fails on zero
+    SHARDED passes — a mesh run that silently fell back to
+    single-device dispatch proves nothing about the mesh path."""
     import numpy as np
 
     from easydarwin_tpu.protocol import sdp as sdp_mod
@@ -204,6 +210,14 @@ def multi_source_section(n_sources: int, seconds: float = 2.0) -> list[str]:
     from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
 
     errs: list[str] = []
+    mesh = None
+    if devices > 1:
+        from easydarwin_tpu.parallel.mesh import make_megabatch_mesh
+        mesh = make_megabatch_mesh(devices)
+        if mesh is None:
+            return [f"--devices {devices}: no mesh (box exposes too few "
+                    "devices; set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count)"]
     OUTS_PER_STREAM = 8
     sdp_txt = ("v=0\r\ns=m\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
                "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
@@ -224,23 +238,15 @@ def multi_source_section(n_sources: int, seconds: float = 2.0) -> list[str]:
             st.add_output(o)
         streams.append(st)
         engines.append(TpuFanoutEngine(egress_fd=send.fileno()))
-    sched = MegabatchScheduler()
+    sched = MegabatchScheduler(mesh=mesh)
     pkt = bytes([0x80, 96]) + bytes(10) + bytes(188)
     # pre-compile the stacked step for the shapes this section uses,
     # BEFORE any packet carries an arrival stamp: a cold jit trace with
     # a live backlog turns compile time into real ingest→wire latency
-    # and burns the SLO budget the soak asserts on
-    import jax
-
-    from easydarwin_tpu.models.relay_pipeline import megabatch_window_step
-    from easydarwin_tpu.ops.fanout import STATE_COLS
-    from easydarwin_tpu.ops.staging import ROW_STRIDE
-    from easydarwin_tpu.relay.fanout import _pow2
-    b_pad = _pow2(n_sources, 1)
-    np.asarray(megabatch_window_step(
-        jax.device_put(np.zeros((b_pad, 16, ROW_STRIDE), np.uint8)),
-        np.zeros((b_pad, _pow2(OUTS_PER_STREAM, 8), STATE_COLS),
-                 np.uint32)))
+    # and burns the SLO budget the soak asserts on (the burst of 3
+    # below pads to the same 16-row window the harness traces)
+    from easydarwin_tpu.parallel.megabench import _precompile
+    _precompile(sched, n_sources, OUTS_PER_STREAM, burst=3)
     t = int(time.monotonic() * 1000)
     seq = 0
     t_end = time.time() + seconds
@@ -268,6 +274,9 @@ def multi_source_section(n_sources: int, seconds: float = 2.0) -> list[str]:
     if sched.passes == 0:
         errs.append(f"multi-source section: zero megabatched passes over "
                     f"{n_sources} sources")
+    if mesh is not None and sched.sharded_passes == 0:
+        errs.append(f"--devices {devices}: zero SHARDED passes (mesh "
+                    "dispatch never engaged)")
     if sched.mismatches:
         errs.append(f"multi-source section: {sched.mismatches} megabatch/"
                     "per-stream wire mismatches")
@@ -345,7 +354,7 @@ def _check_chaos(app, clear_time: float, t_full: float | None,
 
 
 async def soak(seconds: float, n_sources: int = 0,
-               chaos_seed: int | None = None) -> int:
+               chaos_seed: int | None = None, devices: int = 1) -> int:
     chaos = chaos_seed is not None
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
@@ -639,7 +648,7 @@ async def soak(seconds: float, n_sources: int = 0,
         # process-global registry the server exports)
         if n_sources >= 2:
             failures.extend(await asyncio.to_thread(
-                multi_source_section, n_sources))
+                multi_source_section, n_sources, 2.0, devices))
         st, body = await rest_get("/metrics")   # final scrape for checks
         if st == 200:
             scrapes.append(parse_metrics(body.decode()))
@@ -1081,6 +1090,13 @@ def _parse_args(argv: list[str]):
     ap.add_argument("--sources", type=int, default=16, metavar="N",
                     help="multi-source megabatch section stream count "
                          "(default 16; < 2 disables the section)")
+    ap.add_argument("--devices", type=int, default=1, metavar="N",
+                    help="shard the multi-source section's stacked "
+                         "passes over an N-device src-axis mesh "
+                         "(ISSUE 7); on a 1-device box an 8-virtual-"
+                         "device CPU mesh is forced via XLA_FLAGS, and "
+                         "the run fails on zero sharded passes or any "
+                         "megabatch_wire_mismatch_total > 0")
     ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
                     metavar="SEED",
                     help="run under a seeded FaultPlan (resilience/"
@@ -1104,6 +1120,12 @@ def _parse_args(argv: list[str]):
     ns = ap.parse_args(argv)
     if ns.duration is not None and ns.seconds is not None:
         ap.error("give --duration or the positional seconds, not both")
+    if ns.devices > 1 and ns.sources < 2:
+        # the mesh section rides the multi-source section; silently
+        # printing SOAK OK without a single sharded pass would be a
+        # false validation of a multi-device deployment
+        ap.error("--devices requires --sources >= 2 (the mesh section "
+                 "is the multi-source section)")
     d = ns.duration if ns.duration is not None else ns.seconds
     ns.duration = 120.0 if d is None else d
     return ns
@@ -1111,6 +1133,18 @@ def _parse_args(argv: list[str]):
 
 if __name__ == "__main__":
     _ns = _parse_args(sys.argv[1:])
+    if _ns.devices > 1:
+        # jax backends have not initialized yet (imports above only
+        # DEFINE jitted fns) — force the virtual host-device mesh now
+        # unless the environment already provides enough devices
+        import os as _os
+        _flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            # widens only the HOST (cpu) platform — a real accelerator
+            # fleet is untouched and keeps its own device count
+            _os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count="
+                f"{max(_ns.devices, 8)}").strip()
     if _ns.cluster_node:
         raise SystemExit(asyncio.run(
             _cluster_node_main(_ns.node_id, _ns.redis_port)))
@@ -1119,4 +1153,4 @@ if __name__ == "__main__":
             cluster_soak(_ns.cluster, _ns.duration,
                          _ns.chaos if _ns.chaos is not None else 7)))
     raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
-                                      _ns.chaos)))
+                                      _ns.chaos, _ns.devices)))
