@@ -10,7 +10,11 @@
 #include <ctime>
 #include <netinet/in.h>
 #include <netinet/udp.h>
+#include <sys/mman.h>
+#include <sys/resource.h>
 #include <sys/socket.h>
+#include <sys/syscall.h>
+#include <unistd.h>
 #include <vector>
 
 namespace {
@@ -57,7 +61,8 @@ struct StatCells {
       gso_supers{0}, gso_segments{0}, eagain_stops{0}, hard_errors{0},
       bytes_to_wire{0}, recvmmsg_calls{0}, recv_datagrams{0}, recv_bytes{0},
       oversize_dropped{0}, send_ns{0}, ingest_ns{0}, stage_gather_ns{0},
-      staged_bytes{0}, fault_injections{0};
+      staged_bytes{0}, fault_injections{0}, uring_sqes{0}, uring_cqes{0},
+      uring_submits{0}, uring_zc_completions{0}, uring_zc_copied{0};
 };
 StatCells g_stat;
 
@@ -167,6 +172,13 @@ void ed_get_stats(ed_stats *out) {
   out->staged_bytes = g_stat.staged_bytes.load(std::memory_order_relaxed);
   out->fault_injections =
       g_stat.fault_injections.load(std::memory_order_relaxed);
+  out->uring_sqes = g_stat.uring_sqes.load(std::memory_order_relaxed);
+  out->uring_cqes = g_stat.uring_cqes.load(std::memory_order_relaxed);
+  out->uring_submits = g_stat.uring_submits.load(std::memory_order_relaxed);
+  out->uring_zc_completions =
+      g_stat.uring_zc_completions.load(std::memory_order_relaxed);
+  out->uring_zc_copied =
+      g_stat.uring_zc_copied.load(std::memory_order_relaxed);
 }
 
 // Correct by construction: adding an ed_stats field updates this
@@ -194,6 +206,11 @@ void ed_reset_stats(void) {
   g_stat.stage_gather_ns.store(0, std::memory_order_relaxed);
   g_stat.staged_bytes.store(0, std::memory_order_relaxed);
   g_stat.fault_injections.store(0, std::memory_order_relaxed);
+  g_stat.uring_sqes.store(0, std::memory_order_relaxed);
+  g_stat.uring_cqes.store(0, std::memory_order_relaxed);
+  g_stat.uring_submits.store(0, std::memory_order_relaxed);
+  g_stat.uring_zc_completions.store(0, std::memory_order_relaxed);
+  g_stat.uring_zc_copied.store(0, std::memory_order_relaxed);
 }
 
 void ed_fault_set(int64_t eagain_every, int64_t enobufs_every,
@@ -511,11 +528,17 @@ int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
     const uint32_t *sq = seq_off + static_cast<size_t>(s) * param_stride;
     const uint32_t *ts = ts_off + static_cast<size_t>(s) * param_stride;
     const uint32_t *sc = ssrc + static_cast<size_t>(s) * param_stride;
-    int32_t r = use_gso
-        ? ed_fanout_send_udp_gso(fd, ring_data, ring_len, capacity,
+    int32_t r;
+    if (use_gso == 2)        // forced scalar rung (egress_backend=scalar)
+      r = ed_scalar_baseline_send(fd, ring_data, ring_len, capacity,
+                                  slot_size, sq, ts, sc, dest, n_outs,
+                                  ops, n_ops);
+    else if (use_gso == 1)
+      r = ed_fanout_send_udp_gso(fd, ring_data, ring_len, capacity,
                                  slot_size, sq, ts, sc, dest, n_outs, ops,
-                                 n_ops)
-        : ed_fanout_send_udp(fd, ring_data, ring_len, capacity, slot_size,
+                                 n_ops);
+    else
+      r = ed_fanout_send_udp(fd, ring_data, ring_len, capacity, slot_size,
                              sq, ts, sc, dest, n_outs, ops, n_ops);
     if (r < 0) return total > 0 ? static_cast<int32_t>(total) : r;
     total += r;
@@ -751,6 +774,884 @@ int64_t ed_udp_drain_ex(const int32_t *fds, int32_t n_fds,
 int64_t ed_udp_drain(const int32_t *fds, int32_t n_fds) {
   return ed_udp_drain_ex(fds, n_fds, nullptr);
 }
+
+}  // extern "C"
+
+/* ---------------------------------------------------- io_uring backend */
+//
+// Raw-syscall io_uring (no liburing dependency) with self-defined ABI
+// structs: the kernel ABI is frozen, while this box's <linux/io_uring.h>
+// predates SEND_ZC/multishot — defining the layouts here means one
+// source builds identically against any header vintage, and the runtime
+// capability PROBE (not compile-time ifdefs) decides what is used.
+// Shares g_stat / g_stop_errno / fault_egress_gate with the sendmmsg
+// paths so the accounting contract and the chaos knobs are identical
+// across backends.
+
+namespace {
+
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#define __NR_io_uring_enter 426
+#define __NR_io_uring_register 427
+#endif
+
+// setup flags
+constexpr uint32_t kSetupSqpoll = 1u << 1;
+constexpr uint32_t kSetupCqsize = 1u << 3;
+constexpr uint32_t kSetupClamp = 1u << 4;
+// features
+constexpr uint32_t kFeatSingleMmap = 1u << 0;
+constexpr uint32_t kFeatNodrop = 1u << 1;
+// mmap offsets
+constexpr uint64_t kOffSqRing = 0;
+constexpr uint64_t kOffCqRing = 0x8000000ULL;
+constexpr uint64_t kOffSqes = 0x10000000ULL;
+// sq ring flags
+constexpr uint32_t kSqNeedWakeup = 1u << 0;
+// enter flags
+constexpr uint32_t kEnterGetevents = 1u << 0;
+constexpr uint32_t kEnterSqWakeup = 1u << 1;
+// register opcodes
+constexpr uint32_t kRegBuffers = 0;
+constexpr uint32_t kRegProbe = 8;
+// sqe flags
+constexpr uint8_t kSqeIoLink = 1u << 2;
+constexpr uint8_t kSqeBufferSelect = 1u << 4;
+// opcodes (ABI-stable ids)
+constexpr uint8_t kOpNop = 0;
+constexpr uint8_t kOpSendmsg = 9;
+constexpr uint8_t kOpRecvmsg = 10;
+constexpr uint8_t kOpProvideBuffers = 31;
+constexpr uint8_t kOpSendZc = 26;
+constexpr uint8_t kOpSendmsgZc = 30;
+// cqe flags
+constexpr uint32_t kCqeFBuffer = 1u << 0;
+constexpr uint32_t kCqeFMore = 1u << 1;
+constexpr uint32_t kCqeFNotif = 1u << 3;
+constexpr uint32_t kCqeBufferShift = 16;
+// sqe->ioprio flags for send/recv ops (IORING_RECVSEND_POLL_FIRST is
+// 1<<0 — NOT used here; a review pass caught FIXED_BUF mis-assigned to
+// that bit, which would have silently pinned pages per send)
+constexpr uint16_t kRecvMultishot = 1u << 1;      // multishot recvmsg
+constexpr uint16_t kRecvsendFixedBuf = 1u << 2;   // SEND_ZC fixed buffer
+constexpr uint16_t kSendZcReportUsage = 1u << 3;  // notif res carries copy bit
+constexpr uint32_t kNotifUsageZcCopied = 1u << 31;
+// probe op flag
+constexpr uint16_t kOpSupported = 1u << 0;
+
+struct EdSqe {  // struct io_uring_sqe (64 bytes, unioned fields flattened)
+  uint8_t opcode;
+  uint8_t flags;
+  uint16_t ioprio;
+  int32_t fd;
+  uint64_t off;        // off / addr2 (SEND_ZC: sockaddr pointer)
+  uint64_t addr;       // buffer / msghdr pointer
+  uint32_t len;
+  uint32_t op_flags;   // msg_flags / rw_flags / ...
+  uint64_t user_data;
+  uint16_t buf_index;  // fixed-buffer index / buf_group
+  uint16_t personality;
+  uint16_t addr_len;   // SEND_ZC: sockaddr length (low half of splice_fd_in)
+  uint16_t pad1;
+  uint64_t addr3;
+  uint64_t pad2;
+};
+static_assert(sizeof(EdSqe) == 64, "io_uring_sqe ABI is 64 bytes");
+
+struct EdCqe {  // struct io_uring_cqe
+  uint64_t user_data;
+  int32_t res;
+  uint32_t flags;
+};
+static_assert(sizeof(EdCqe) == 16, "io_uring_cqe ABI is 16 bytes");
+
+struct EdSqOffsets {
+  uint32_t head, tail, ring_mask, ring_entries, flags, dropped, array, resv1;
+  uint64_t user_addr;
+};
+struct EdCqOffsets {
+  uint32_t head, tail, ring_mask, ring_entries, overflow, cqes, flags, resv1;
+  uint64_t user_addr;
+};
+struct EdUringParams {
+  uint32_t sq_entries, cq_entries, flags, sq_thread_cpu, sq_thread_idle,
+      features, wq_fd, resv[3];
+  EdSqOffsets sq_off;
+  EdCqOffsets cq_off;
+};
+static_assert(sizeof(EdUringParams) == 120, "io_uring_params ABI");
+
+struct EdProbeOp {
+  uint8_t op, resv;
+  uint16_t flags;
+  uint32_t resv2;
+};
+struct EdProbe {
+  uint8_t last_op, ops_len;
+  uint16_t resv;
+  uint32_t resv2[3];
+  EdProbeOp ops[256];
+};
+
+inline int sys_uring_setup(unsigned entries, EdUringParams *p) {
+  return static_cast<int>(syscall(__NR_io_uring_setup, entries, p));
+}
+inline int sys_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                           unsigned flags) {
+  return static_cast<int>(syscall(__NR_io_uring_enter, fd, to_submit,
+                                  min_complete, flags, nullptr, 0));
+}
+inline int sys_uring_register(int fd, unsigned opcode, const void *arg,
+                              unsigned nr_args) {
+  return static_cast<int>(syscall(__NR_io_uring_register, fd, opcode, arg,
+                                  nr_args));
+}
+
+// multishot recvmsg payload header (struct io_uring_recvmsg_out)
+struct EdRecvmsgOut {
+  uint32_t namelen, controllen, payloadlen, flags;
+};
+
+inline uint32_t aload(const unsigned *p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void rstore(unsigned *p, uint32_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+
+}  // namespace
+
+// One mapped ring + its arenas.  Lives outside the anonymous namespace
+// because the public API hands out `ed_uring *`.
+struct ed_uring {
+  int ring_fd = -1;
+  int sock_fd = -1;
+  int caps = 0;          // ED_URING_CAP_* actually active on this ring
+  bool sqpoll = false;
+  bool zerocopy = false;
+  uint32_t features = 0;
+  unsigned sq_entries = 0, cq_entries = 0;
+  // mappings
+  void *sq_ptr = nullptr;
+  size_t sq_map_sz = 0;
+  void *cq_ptr = nullptr;   // == sq_ptr under FEAT_SINGLE_MMAP
+  size_t cq_map_sz = 0;
+  EdSqe *sqes = nullptr;
+  size_t sqes_sz = 0;
+  // ring pointers (into the mappings)
+  unsigned *sq_head = nullptr, *sq_tail = nullptr, *sq_mask = nullptr,
+           *sq_array = nullptr, *sq_flags = nullptr;
+  unsigned *cq_head = nullptr, *cq_tail = nullptr, *cq_mask = nullptr;
+  EdCqe *cqes = nullptr;
+  unsigned queued = 0;   // SQEs filled via get_sqe, published by submit()
+  // egress arenas, sized to sq_entries ops in flight
+  int32_t max_pkt = 0;
+  std::vector<uint8_t> arena;        // rendered packets / headers
+  bool arena_registered = false;     // arena is fixed-buffer index 0
+  std::vector<iovec> iovs;           // 2 per op (hdr | payload)
+  std::vector<msghdr> msgs;
+  std::vector<sockaddr_in> addrs;
+  std::vector<int32_t> results;      // per-chain-index CQE res
+  int zc_pending = 0;                // ZC notifs not yet reaped
+  // ingest state
+  bool ingest = false;
+  int32_t n_bufs = 0;
+  std::vector<uint8_t> recv_bufs;    // n_bufs x (16B hdr + max_pkt)
+  msghdr recv_msg{};                 // multishot template
+  bool armed = false;
+
+  ~ed_uring() {
+    if (sq_ptr) munmap(sq_ptr, sq_map_sz);
+    if (cq_ptr && cq_ptr != sq_ptr) munmap(cq_ptr, cq_map_sz);
+    if (sqes) munmap(sqes, sqes_sz);
+    if (ring_fd >= 0) close(ring_fd);
+  }
+};
+
+namespace {
+
+constexpr unsigned kProbeEntries = 8;
+constexpr int32_t kDepthMin = 16, kDepthMax = 1024;
+constexpr int kCqSpin = 4096;  // SQPOLL userspace completion spins
+
+// mmap the three ring regions; returns 0 or -errno (ring_fd stays owned
+// by the caller's ed_uring and is closed by its destructor).
+int map_ring(ed_uring *u, const EdUringParams &p) {
+  u->features = p.features;
+  u->sq_entries = p.sq_entries;
+  u->cq_entries = p.cq_entries;
+  size_t sq_sz = p.sq_off.array + p.sq_entries * sizeof(uint32_t);
+  size_t cq_sz = p.cq_off.cqes + p.cq_entries * sizeof(EdCqe);
+  if (p.features & kFeatSingleMmap) sq_sz = cq_sz = std::max(sq_sz, cq_sz);
+  void *sq = mmap(nullptr, sq_sz, PROT_READ | PROT_WRITE,
+                  MAP_SHARED | MAP_POPULATE, u->ring_fd, kOffSqRing);
+  if (sq == MAP_FAILED) return -errno;
+  u->sq_ptr = sq;
+  u->sq_map_sz = sq_sz;
+  if (p.features & kFeatSingleMmap) {
+    u->cq_ptr = sq;
+    u->cq_map_sz = sq_sz;
+  } else {
+    void *cq = mmap(nullptr, cq_sz, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, u->ring_fd, kOffCqRing);
+    if (cq == MAP_FAILED) return -errno;
+    u->cq_ptr = cq;
+    u->cq_map_sz = cq_sz;
+  }
+  size_t sqes_sz = p.sq_entries * sizeof(EdSqe);
+  void *sqes = mmap(nullptr, sqes_sz, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, u->ring_fd, kOffSqes);
+  if (sqes == MAP_FAILED) return -errno;
+  u->sqes = static_cast<EdSqe *>(sqes);
+  u->sqes_sz = sqes_sz;
+  auto *sqb = static_cast<uint8_t *>(u->sq_ptr);
+  u->sq_head = reinterpret_cast<unsigned *>(sqb + p.sq_off.head);
+  u->sq_tail = reinterpret_cast<unsigned *>(sqb + p.sq_off.tail);
+  u->sq_mask = reinterpret_cast<unsigned *>(sqb + p.sq_off.ring_mask);
+  u->sq_flags = reinterpret_cast<unsigned *>(sqb + p.sq_off.flags);
+  u->sq_array = reinterpret_cast<unsigned *>(sqb + p.sq_off.array);
+  auto *cqb = static_cast<uint8_t *>(u->cq_ptr);
+  u->cq_head = reinterpret_cast<unsigned *>(cqb + p.cq_off.head);
+  u->cq_tail = reinterpret_cast<unsigned *>(cqb + p.cq_off.tail);
+  u->cq_mask = reinterpret_cast<unsigned *>(cqb + p.cq_off.ring_mask);
+  u->cqes = reinterpret_cast<EdCqe *>(cqb + p.cq_off.cqes);
+  return 0;
+}
+
+// Queue one SQE (caller fills the returned slot; published by the next
+// submit()).  The SQ is always drained before the next batch, so a full
+// queue cannot happen by construction — nullptr-guarded anyway.
+EdSqe *get_sqe(ed_uring *u) {
+  uint32_t head = aload(u->sq_head);
+  uint32_t tail = *u->sq_tail + u->queued;  // single submitter: plain read
+  if (tail - head >= u->sq_entries) return nullptr;
+  uint32_t idx = tail & *u->sq_mask;
+  u->sq_array[idx] = idx;
+  EdSqe *sqe = &u->sqes[idx];
+  std::memset(sqe, 0, sizeof(*sqe));
+  u->queued++;
+  return sqe;
+}
+
+// The last SQE queued since the last submit (for terminating a link
+// chain).  Only valid while queued > 0.
+EdSqe *last_sqe(ed_uring *u) {
+  return &u->sqes[(*u->sq_tail + u->queued - 1) & *u->sq_mask];
+}
+
+// Publish every queued SQE and issue (or skip, under SQPOLL) the submit
+// syscall.  wait_for > 0 blocks until that many CQEs are available.
+// Returns 0 or -errno from io_uring_enter.
+int submit(ed_uring *u, unsigned wait_for) {
+  unsigned n = u->queued;
+  u->queued = 0;
+  rstore(u->sq_tail, *u->sq_tail + n);
+  stat_add(g_stat.uring_sqes, n);
+  unsigned flags = 0;
+  unsigned to_submit = n;
+  if (u->sqpoll) {
+    // the poller thread consumes the SQ; only a sleeping poller needs a
+    // syscall — the "steady-state wire pushes need zero syscalls" leg
+    if (aload(u->sq_flags) & kSqNeedWakeup) flags |= kEnterSqWakeup;
+    else if (wait_for == 0) return 0;
+    to_submit = 0;
+  }
+  if (wait_for > 0) flags |= kEnterGetevents;
+  for (;;) {
+    int r = sys_uring_enter(u->ring_fd, to_submit, wait_for, flags);
+    if (r >= 0) {
+      stat_add(g_stat.uring_submits, 1);
+      return 0;
+    }
+    if (errno == EINTR) continue;
+    return -errno;
+  }
+}
+
+// Pop every available CQE through `fn(cqe)`; returns the count reaped.
+template <typename Fn>
+int reap_available(ed_uring *u, Fn &&fn) {
+  uint32_t head = *u->cq_head;
+  uint32_t tail = aload(u->cq_tail);
+  int n = 0;
+  while (head != tail) {
+    const EdCqe &cqe = u->cqes[head & *u->cq_mask];
+    fn(cqe);
+    ++head;
+    ++n;
+  }
+  if (n) {
+    rstore(u->cq_head, head);
+    stat_add(g_stat.uring_cqes, n);
+  }
+  return n;
+}
+
+// Reap until `pred()` is satisfied, entering the kernel as needed.
+// Under SQPOLL a bounded userspace spin usually observes the completion
+// without any syscall.  Bounded (a CQE lost to pre-NODROP overflow must
+// surface as -EIO, not a hung pump).  Returns 0 or -errno.
+template <typename Fn, typename Pred>
+int reap_until(ed_uring *u, Fn &&fn, Pred &&pred) {
+  for (int rounds = 0; rounds < 100000; ++rounds) {
+    reap_available(u, fn);
+    if (pred()) return 0;
+    if (u->sqpoll) {
+      bool got = false;
+      for (int i = 0; i < kCqSpin && !got; ++i)
+        got = aload(u->cq_tail) != *u->cq_head;
+      if (got) continue;
+    }
+    for (;;) {
+      int r = sys_uring_enter(u->ring_fd, 0, 1, kEnterGetevents);
+      if (r >= 0) {
+        stat_add(g_stat.uring_submits, 1);
+        break;
+      }
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+  }
+  return -EIO;
+}
+
+// Drain outstanding zerocopy notification CQEs so the arena slots (and
+// the ring slots the kernel may still reference) are reusable when the
+// caller returns — registered-buffer lifetime is serialized with the
+// send call instead of with ring recycling (ARCHITECTURE "Egress
+// backends" discusses the tradeoff).
+int drain_zc_notifs(ed_uring *u) {
+  auto on_cqe = [u](const EdCqe &cqe) {
+    if (cqe.flags & kCqeFNotif) {
+      u->zc_pending--;
+      stat_add(g_stat.uring_zc_completions, 1);
+      if (cqe.res & static_cast<int32_t>(kNotifUsageZcCopied))
+        stat_add(g_stat.uring_zc_copied, 1);
+    }
+  };
+  return reap_until(u, on_cqe, [u] { return u->zc_pending <= 0; });
+}
+
+int probe_ops(int ring_fd, EdProbe *probe) {
+  std::memset(probe, 0, sizeof(*probe));
+  return sys_uring_register(ring_fd, kRegProbe, probe, 256) < 0 ? -errno : 0;
+}
+
+bool op_supported(const EdProbe &p, uint8_t op) {
+  return op <= p.last_op && (p.ops[op].flags & kOpSupported);
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t ed_uring_probe(void) {
+  EdUringParams params;
+  std::memset(&params, 0, sizeof(params));
+  params.flags = kSetupClamp;
+  int fd = sys_uring_setup(kProbeEntries, &params);
+  if (fd < 0) return -errno;  // ENOSYS / seccomp EPERM / EMFILE
+  int32_t caps = ED_URING_CAP_RING;
+  EdProbe probe;
+  if (probe_ops(fd, &probe) == 0) {
+    if (!op_supported(probe, kOpSendmsg) ||
+        !op_supported(probe, kOpRecvmsg)) {
+      close(fd);
+      return -ENOSYS;  // a ring without sendmsg/recvmsg is useless here
+    }
+    if (op_supported(probe, kOpSendmsgZc)) caps |= ED_URING_CAP_SEND_ZC;
+    // multishot recvmsg (6.0) predates SEND_ZC (6.0/6.1) — the ZC probe
+    // doubles as the multishot gate (no direct probe exists for flags)
+    if (op_supported(probe, kOpSendZc) &&
+        op_supported(probe, kOpProvideBuffers))
+      caps |= ED_URING_CAP_RECV_MULTI;
+  } else {
+    // REGISTER_PROBE itself needs 5.6; a ring that predates it has
+    // sendmsg/recvmsg (5.3) but none of the newer toys
+  }
+  // fixed buffers: one page under the current RLIMIT_MEMLOCK — the
+  // registration either fits or the backend runs unregistered
+  static uint8_t page[4096] __attribute__((aligned(4096)));
+  iovec iov{page, sizeof(page)};
+  if (sys_uring_register(fd, kRegBuffers, &iov, 1) == 0)
+    caps |= ED_URING_CAP_FIXED_BUFS;
+  close(fd);
+  // SQPOLL needs its own setup (the flag changes ring construction);
+  // modern kernels allow unprivileged SQPOLL, old ones want CAP_SYS_NICE
+  EdUringParams sp;
+  std::memset(&sp, 0, sizeof(sp));
+  sp.flags = kSetupClamp | kSetupSqpoll;
+  sp.sq_thread_idle = 50;  // ms before the poller sleeps
+  int sfd = sys_uring_setup(kProbeEntries, &sp);
+  if (sfd >= 0) {
+    caps |= ED_URING_CAP_SQPOLL;
+    close(sfd);
+  }
+  return caps;
+}
+
+ed_uring *ed_uring_egress_new(int fd, int32_t depth, int32_t max_pkt,
+                              int32_t flags, int32_t *err_out) {
+  auto fail = [err_out](int err) -> ed_uring * {
+    if (err_out) *err_out = err < 0 ? err : -err;
+    return nullptr;
+  };
+  if (max_pkt < 64 || max_pkt > 65536) return fail(EINVAL);
+  depth = std::max(kDepthMin, std::min(kDepthMax, depth));
+  int32_t caps = ed_uring_probe();
+  if (caps < 0) return fail(caps);
+  auto u = new ed_uring();
+  u->sock_fd = fd;
+  u->max_pkt = max_pkt;
+  u->sqpoll = (flags & ED_URING_F_SQPOLL) && (caps & ED_URING_CAP_SQPOLL);
+  u->zerocopy = (flags & ED_URING_F_ZEROCOPY) &&
+                (caps & ED_URING_CAP_SEND_ZC) &&
+                (caps & ED_URING_CAP_FIXED_BUFS);
+  EdUringParams params;
+  std::memset(&params, 0, sizeof(params));
+  params.flags = kSetupClamp | kSetupCqsize;
+  // ZC posts two CQEs per send (completion + notif); 4x headroom keeps
+  // NODROP kernels from stalling and pre-NODROP kernels from dropping
+  params.cq_entries = static_cast<uint32_t>(depth) * 4;
+  if (u->sqpoll) {
+    params.flags |= kSetupSqpoll;
+    params.sq_thread_idle = 50;
+  }
+  int rfd = sys_uring_setup(static_cast<unsigned>(depth), &params);
+  if (rfd < 0 && u->sqpoll) {
+    // SQPOLL passed the probe but failed with these params (rlimits,
+    // cgroup cpu policy): degrade to interrupt-driven, not to GSO
+    u->sqpoll = false;
+    params.flags &= ~kSetupSqpoll;
+    rfd = sys_uring_setup(static_cast<unsigned>(depth), &params);
+  }
+  if (rfd < 0) {
+    int e = -errno;
+    delete u;
+    return fail(e);
+  }
+  u->ring_fd = rfd;
+  int mr = map_ring(u, params);
+  if (mr < 0) {
+    delete u;
+    return fail(mr);
+  }
+  // The send arena: every in-flight datagram's rendered bytes live here
+  // (ZC: full packet; SENDMSG: the 12-byte header, payload iovec'd from
+  // the packet ring).  Registered as fixed buffer 0 when the memlock
+  // budget allows, which is what lets SEND_ZC pin pages once instead of
+  // per send.  Sized from sq_entries, NOT the requested depth: the
+  // kernel rounds the ring up to a power of two and ed_uring_send
+  // chains up to sq_entries ops — arenas sized to a smaller requested
+  // depth would overflow on the rounded-up tail.
+  const size_t entries = u->sq_entries;
+  u->arena.assign(entries * max_pkt, 0);
+  if (caps & ED_URING_CAP_FIXED_BUFS) {
+    iovec iov{u->arena.data(), u->arena.size()};
+    if (sys_uring_register(rfd, kRegBuffers, &iov, 1) == 0)
+      u->arena_registered = true;
+    else if (errno == ENOMEM || errno == EPERM)
+      u->zerocopy = false;  // RLIMIT_MEMLOCK too small for the real arena
+    else
+      u->zerocopy = false;
+  } else {
+    u->zerocopy = false;
+  }
+  u->iovs.resize(entries * 2);
+  u->msgs.resize(entries);
+  u->addrs.resize(entries);
+  u->results.resize(entries);
+  u->caps = (caps & (ED_URING_CAP_RING | ED_URING_CAP_SEND_ZC |
+                     ED_URING_CAP_RECV_MULTI)) |
+            (u->sqpoll ? ED_URING_CAP_SQPOLL : 0) |
+            (u->arena_registered ? ED_URING_CAP_FIXED_BUFS : 0);
+  if (err_out) *err_out = 0;
+  return u;
+}
+
+void ed_uring_free(ed_uring *u) {
+  if (!u) return;
+  if (u->zc_pending > 0) drain_zc_notifs(u);
+  delete u;
+}
+
+int32_t ed_uring_caps(const ed_uring *u) { return u ? u->caps : 0; }
+
+int32_t ed_uring_fd(const ed_uring *u) { return u ? u->ring_fd : -1; }
+
+int32_t ed_uring_send(ed_uring *u, const uint8_t *ring_data,
+                      const int32_t *ring_len, int32_t capacity,
+                      int32_t slot_size, const uint32_t *seq_off,
+                      const uint32_t *ts_off, const uint32_t *ssrc,
+                      const ed_dest *dest, int32_t n_outs,
+                      const ed_sendop *ops, int32_t n_ops) {
+  if (!u || u->ingest) return -EINVAL;
+  g_stop_errno = 0;
+  if (n_ops <= 0) return 0;
+  StatTimer timer(g_stat.send_ns);
+  const int depth = static_cast<int>(u->sq_entries);
+  int32_t done = 0;
+  while (done < n_ops) {
+    int ferr = fault_egress_gate();
+    if (ferr) {
+      // injected fault surfaces through the SAME completion-path
+      // bookkeeping a real first-CQE failure takes: count the submit,
+      // classify the stop, honor the EAGAIN-vs-hard return contract
+      g_stop_errno = ferr;
+      stat_add(g_stat.uring_submits, 1);
+      note_send_stop(ferr);
+      if (ferr == EAGAIN) return done;
+      return done > 0 ? done : -ferr;
+    }
+    // A mid-chain validation failure must DISCARD the SQEs queued so
+    // far (u->queued = 0 un-publishes them — the tail was never
+    // advanced) or the next submission would publish stale entries
+    // whose arena/msghdr slots have been reused: duplicate datagrams
+    // with colliding user_data.  g_stop_errno = EINVAL makes a partial
+    // return read as a hard per-datagram stop, so the caller skips the
+    // poisoned op instead of replaying it forever.
+    auto abort_chain = [&](int err) -> int32_t {
+      u->queued = 0;
+      g_stop_errno = err;
+      return done > 0 ? done : -err;
+    };
+    int chain = 0;
+    for (; chain < depth && done + chain < n_ops; ++chain) {
+      const ed_sendop &op = ops[done + chain];
+      if (op.slot < 0 || op.slot >= capacity || op.out < 0 ||
+          op.out >= n_outs)
+        return abort_chain(EINVAL);
+      const uint8_t *pkt = ring_data + static_cast<size_t>(op.slot) * slot_size;
+      int32_t len = ring_len[op.slot];
+      if (len < 12 || len > slot_size || len > u->max_pkt)
+        return abort_chain(EINVAL);
+      uint8_t *slot_arena =
+          u->arena.data() + static_cast<size_t>(chain) * u->max_pkt;
+      sockaddr_in &sa = u->addrs[chain];
+      std::memset(&sa, 0, sizeof(sa));
+      sa.sin_family = AF_INET;
+      sa.sin_addr.s_addr = dest[op.out].ip_be;
+      sa.sin_port = dest[op.out].port_be;
+      EdSqe *sqe = get_sqe(u);
+      if (!sqe) return abort_chain(EBUSY);  // cannot happen: SQ drained
+      if (u->zerocopy) {
+        // render the whole datagram into the registered arena and send
+        // it as ONE fixed-buffer SEND_ZC: the kernel pins the
+        // pre-registered pages instead of copying payload into skb
+        // frags — the copy that remains is ours, at cache speed, once
+        render_header(slot_arena, pkt, seq_off[op.out], ts_off[op.out],
+                      ssrc[op.out]);
+        std::memcpy(slot_arena + 12, pkt + 12,
+                    static_cast<size_t>(len - 12));
+        sqe->opcode = kOpSendZc;
+        sqe->fd = u->sock_fd;
+        sqe->addr = reinterpret_cast<uint64_t>(slot_arena);
+        sqe->len = static_cast<uint32_t>(len);
+        sqe->op_flags = MSG_DONTWAIT;
+        sqe->ioprio = kRecvsendFixedBuf | kSendZcReportUsage;
+        sqe->buf_index = 0;
+        sqe->off = reinterpret_cast<uint64_t>(&sa);  // addr2 = dest
+        sqe->addr_len = sizeof(sa);
+      } else {
+        // header in the arena, payload straight from the packet ring —
+        // the same scatter shape the sendmmsg path uses, minus the
+        // per-datagram syscall slot
+        render_header(slot_arena, pkt, seq_off[op.out], ts_off[op.out],
+                      ssrc[op.out]);
+        iovec *iv = &u->iovs[static_cast<size_t>(chain) * 2];
+        iv[0].iov_base = slot_arena;
+        iv[0].iov_len = 12;
+        iv[1].iov_base = const_cast<uint8_t *>(pkt) + 12;
+        iv[1].iov_len = static_cast<size_t>(len - 12);
+        msghdr &m = u->msgs[chain];
+        std::memset(&m, 0, sizeof(m));
+        m.msg_name = &sa;
+        m.msg_namelen = sizeof(sa);
+        m.msg_iov = iv;
+        m.msg_iovlen = 2;
+        sqe->opcode = kOpSendmsg;
+        sqe->fd = u->sock_fd;
+        sqe->addr = reinterpret_cast<uint64_t>(&m);
+        sqe->op_flags = MSG_DONTWAIT;
+      }
+      // IOSQE_IO_LINK serializes the chain in the kernel: a failure
+      // cancels everything after it, so "ops delivered" is a PREFIX of
+      // the chain and bookmark replay can never duplicate a datagram
+      sqe->flags |= kSqeIoLink;
+      sqe->user_data = static_cast<uint64_t>(chain);
+    }
+    last_sqe(u)->flags &=
+        static_cast<uint8_t>(~kSqeIoLink);  // last link terminates chain
+    std::fill(u->results.begin(), u->results.begin() + chain, INT32_MIN);
+    int pending = chain;
+    int zc_expected = 0;
+    auto on_cqe = [&](const EdCqe &cqe) {
+      if (cqe.flags & kCqeFNotif) {
+        u->zc_pending--;
+        stat_add(g_stat.uring_zc_completions, 1);
+        if (cqe.res & static_cast<int32_t>(kNotifUsageZcCopied))
+          stat_add(g_stat.uring_zc_copied, 1);
+        return;
+      }
+      int idx = static_cast<int>(cqe.user_data);
+      if (idx >= 0 && idx < chain && u->results[idx] == INT32_MIN) {
+        u->results[idx] = cqe.res;
+        pending--;
+        if (cqe.flags & kCqeFMore) {  // ZC: a notif will follow
+          u->zc_pending++;
+          zc_expected++;
+        }
+      }
+    };
+    // SQPOLL: publish and let reap_until's bounded spin observe the
+    // completions — the steady-state zero-syscall path.  Interrupt-
+    // driven rings wait for the whole chain in the submit itself.
+    int sr = submit(u, u->sqpoll ? 0 : static_cast<unsigned>(chain));
+    if (sr < 0) {
+      g_stop_errno = -sr;
+      note_send_stop(-sr);
+      return done > 0 ? done : sr;
+    }
+    int rr = reap_until(u, on_cqe, [&] { return pending <= 0; });
+    if (rr < 0) {
+      g_stop_errno = -rr;
+      note_send_stop(-rr);
+      return done > 0 ? done : rr;
+    }
+    // ops delivered = prefix of successes (linked execution order)
+    int k = 0;
+    int stop_err = 0;
+    for (; k < chain; ++k) {
+      int32_t res = u->results[k];
+      if (res < 0) {
+        stop_err = -res;  // first failure in chain order = the stop errno
+        break;
+      }
+    }
+    if (k > 0) {
+      int64_t nb = 0;
+      for (int i = 0; i < k; ++i) nb += ring_len[ops[done + i].slot];
+      stat_add(g_stat.send_packets, k);
+      stat_add(g_stat.bytes_to_wire, nb);
+    }
+    // ZC buffer lifetime: wait out the notifications before the arena
+    // (and the ring slots) can be touched again
+    if (u->zc_pending > 0) {
+      int dr = drain_zc_notifs(u);
+      if (dr < 0 && k == 0 && done == 0) return dr;
+    }
+    done += k;
+    if (k < chain) {
+      g_stop_errno = stop_err;
+      note_send_stop(stop_err);
+      if (stop_err == EAGAIN || stop_err == EWOULDBLOCK)
+        return done;  // flow control: caller keeps its bookmark
+      return done > 0 ? done : -stop_err;
+    }
+  }
+  return done;
+}
+
+int32_t ed_uring_send_multi(ed_uring *u, const uint8_t *ring_data,
+                            const int32_t *ring_len, int32_t capacity,
+                            int32_t slot_size, const uint32_t *seq_off,
+                            const uint32_t *ts_off, const uint32_t *ssrc,
+                            int32_t n_src, int32_t param_stride,
+                            const ed_dest *dest, int32_t n_outs,
+                            const ed_sendop *ops, int32_t n_ops) {
+  if (param_stride < n_outs) return -EINVAL;
+  int64_t total = 0;
+  for (int32_t s = 0; s < n_src; ++s) {
+    const uint32_t *sq = seq_off + static_cast<size_t>(s) * param_stride;
+    const uint32_t *ts = ts_off + static_cast<size_t>(s) * param_stride;
+    const uint32_t *sc = ssrc + static_cast<size_t>(s) * param_stride;
+    int32_t r = ed_uring_send(u, ring_data, ring_len, capacity, slot_size,
+                              sq, ts, sc, dest, n_outs, ops, n_ops);
+    if (r < 0) return total > 0 ? static_cast<int32_t>(total) : r;
+    total += r;
+  }
+  return static_cast<int32_t>(total);
+}
+
+}  // extern "C"
+
+namespace {
+
+// Re-post drained ingest pool buffers and, when `rearm`, a fresh
+// multishot RECVMSG; one submit covers both.  PROVIDE_BUFFERS ABI:
+// fd = number of buffers, addr = base, len = per-buffer size, off =
+// starting buffer id, buf_index = buffer group.  One-buffer posts keep
+// the bid bookkeeping trivial (recycled bids are rarely contiguous).
+int ingest_post(ed_uring *u, const std::vector<int> &bids, bool rearm) {
+  const size_t stride = sizeof(EdRecvmsgOut) + u->max_pkt;
+  for (int bid : bids) {
+    EdSqe *sqe = get_sqe(u);
+    if (!sqe) return -EBUSY;
+    sqe->opcode = kOpProvideBuffers;
+    sqe->fd = 1;
+    sqe->addr = reinterpret_cast<uint64_t>(u->recv_bufs.data() +
+                                           static_cast<size_t>(bid) * stride);
+    sqe->len = static_cast<uint32_t>(stride);
+    sqe->off = static_cast<uint64_t>(bid);
+    sqe->buf_index = 0;  // buffer group id
+    sqe->user_data = ~0ULL;  // bookkeeping sqe: ignored at reap
+  }
+  if (rearm) {
+    EdSqe *sqe = get_sqe(u);
+    if (!sqe) return -EBUSY;
+    sqe->opcode = kOpRecvmsg;
+    sqe->fd = u->sock_fd;
+    sqe->addr = reinterpret_cast<uint64_t>(&u->recv_msg);
+    sqe->op_flags = 0;
+    sqe->flags |= kSqeBufferSelect;
+    sqe->ioprio = kRecvMultishot;
+    sqe->buf_index = 0;  // buf_group
+    sqe->user_data = 1;  // the multishot anchor
+    u->armed = true;
+  }
+  if (!u->queued) return 0;
+  return submit(u, 0);
+}
+
+}  // namespace
+
+extern "C" {
+
+ed_uring *ed_uring_ingest_new(int fd, int32_t max_pkt, int32_t *err_out) {
+  auto fail = [err_out](int err) -> ed_uring * {
+    if (err_out) *err_out = err < 0 ? err : -err;
+    return nullptr;
+  };
+  if (max_pkt < 64 || max_pkt > 65536) return fail(EINVAL);
+  int32_t caps = ed_uring_probe();
+  if (caps < 0) return fail(caps);
+  if (!(caps & ED_URING_CAP_RECV_MULTI)) return fail(ENOSYS);
+  auto u = new ed_uring();
+  u->ingest = true;
+  u->sock_fd = fd;
+  u->max_pkt = max_pkt;
+  u->n_bufs = 64;
+  EdUringParams params;
+  std::memset(&params, 0, sizeof(params));
+  params.flags = kSetupClamp | kSetupCqsize;
+  params.cq_entries = 256;  // a burst larger than the pool re-arms, never drops
+  int rfd = sys_uring_setup(128, &params);
+  if (rfd < 0) {
+    int e = -errno;
+    delete u;
+    return fail(e);
+  }
+  u->ring_fd = rfd;
+  int mr = map_ring(u, params);
+  if (mr < 0) {
+    delete u;
+    return fail(mr);
+  }
+  const size_t stride = sizeof(EdRecvmsgOut) + max_pkt;
+  u->recv_bufs.assign(static_cast<size_t>(u->n_bufs) * stride, 0);
+  std::memset(&u->recv_msg, 0, sizeof(u->recv_msg));
+  // msg_namelen/controllen = 0: the pool buffer carries only the 16-byte
+  // io_uring_recvmsg_out header + payload (source addr is not demuxed
+  // here — the server binds one ingest socket per pusher)
+  std::vector<int> bids(u->n_bufs);
+  for (int i = 0; i < u->n_bufs; ++i) bids[i] = i;
+  int pr = ingest_post(u, bids, true);
+  if (pr < 0) {
+    delete u;
+    return fail(pr);
+  }
+  u->caps = caps;
+  if (err_out) *err_out = 0;
+  return u;
+}
+
+int32_t ed_uring_ingest_drain(ed_uring *u, uint8_t *ring_data,
+                              int32_t *ring_len, int64_t *ring_arrival,
+                              int32_t capacity, int32_t slot_size,
+                              int64_t now_ms, int64_t *head,
+                              int32_t max_pkts, int32_t *oversize_dropped) {
+  if (!u || !u->ingest) return -EINVAL;
+  StatTimer timer(g_stat.ingest_ns);
+  // flush task_work so completed datagrams become visible CQEs (the
+  // multishot arm itself means no per-batch recvmsg submission)
+  int er = sys_uring_enter(u->ring_fd, 0, 0, kEnterGetevents);
+  if (er < 0 && errno != EINTR && errno != EAGAIN) return -errno;
+  stat_add(g_stat.uring_submits, 1);
+  const size_t stride = sizeof(EdRecvmsgOut) + u->max_pkt;
+  int32_t admitted = 0;
+  int64_t admitted_bytes = 0;
+  bool rearm = false;
+  std::vector<int> recycle;
+  auto on_cqe = [&](const EdCqe &cqe) {
+    if (cqe.user_data == ~0ULL) return;       // PROVIDE_BUFFERS ack
+    if (!(cqe.flags & kCqeFMore)) rearm = true;
+    if (cqe.res < 0) return;                  // ENOBUFS burst / transient
+    if (!(cqe.flags & kCqeFBuffer)) return;
+    int bid = static_cast<int>(cqe.flags >> kCqeBufferShift);
+    if (bid < 0 || bid >= u->n_bufs) return;
+    recycle.push_back(bid);
+    const uint8_t *buf =
+        u->recv_bufs.data() + static_cast<size_t>(bid) * stride;
+    EdRecvmsgOut out;
+    std::memcpy(&out, buf, sizeof(out));
+    int32_t len = static_cast<int32_t>(out.payloadlen);
+    if ((out.flags & MSG_TRUNC) || len > slot_size) {
+      // kernel-truncated datagram: dropped, never admitted capped —
+      // identical policy to the recvmmsg path
+      if (oversize_dropped) ++*oversize_dropped;
+      stat_add(g_stat.oversize_dropped, 1);
+      return;
+    }
+    int64_t dst = (*head + admitted) % capacity;
+    std::memcpy(ring_data + dst * slot_size, buf + sizeof(EdRecvmsgOut),
+                static_cast<size_t>(len));
+    if (len < slot_size)
+      std::memset(ring_data + dst * slot_size + len, 0,
+                  static_cast<size_t>(slot_size - len));
+    ring_len[dst] = len;
+    ring_arrival[dst] = now_ms;
+    admitted_bytes += len;
+    ++admitted;
+  };
+  // Budget-aware reap: STOP (cq_head un-advanced) at the first datagram
+  // CQE past max_pkts so the excess genuinely stays for the next drain
+  // call — reaping it and recycling its buffer unread would be silent,
+  // uncounted packet loss (the recvmmsg path bounds intake inside the
+  // syscall; this is the CQE-world equivalent).
+  {
+    uint32_t h = *u->cq_head;
+    uint32_t tail = aload(u->cq_tail);
+    int reaped = 0;
+    while (h != tail) {
+      const EdCqe &cqe = u->cqes[h & *u->cq_mask];
+      if (admitted >= max_pkts && cqe.user_data != ~0ULL &&
+          cqe.res >= 0 && (cqe.flags & kCqeFBuffer))
+        break;
+      on_cqe(cqe);
+      ++h;
+      ++reaped;
+    }
+    if (reaped) {
+      rstore(u->cq_head, h);
+      stat_add(g_stat.uring_cqes, reaped);
+    }
+  }
+  *head += admitted;
+  if (admitted) {
+    stat_add(g_stat.recv_datagrams, admitted);
+    stat_add(g_stat.recv_bytes, admitted_bytes);
+  }
+  if (!recycle.empty() || rearm) {
+    int pr = ingest_post(u, recycle, rearm);
+    if (pr < 0 && admitted == 0) return pr;
+  }
+  return admitted;
+}
+
+}  // extern "C"
+
+extern "C" {
 
 /* ------------------------------------------------------------- timer wheel */
 

@@ -67,6 +67,17 @@ typedef struct {
    * deliberately provoked by the ed_fault_* knobs (chaos testing).
    * ed_stats_fields() now reports 17. */
   int64_t fault_injections; /* injected EAGAIN/ENOBUFS/latency events */
+  /* io_uring backend tail (fourth ABI bump, fields 18-22): the
+   * per-backend counters behind io_uring_{sqe,cqe,...}_total.  Same
+   * handshake discipline — ed_stats_fields() now reports 22 and the
+   * Python bridge refuses a library that disagrees. */
+  int64_t uring_sqes;       /* SQEs queued for submission */
+  int64_t uring_cqes;       /* CQEs reaped (completions + ZC notifs) */
+  int64_t uring_submits;    /* io_uring_enter(2) syscalls issued */
+  int64_t uring_zc_completions; /* zerocopy notification CQEs reaped */
+  int64_t uring_zc_copied;  /* ZC notifs reporting the kernel copied
+                             * anyway (expected on loopback — counted,
+                             * never hidden) */
 } ed_stats;
 
 void ed_get_stats(ed_stats *out);
@@ -154,8 +165,10 @@ int32_t ed_fanout_send_udp_gso(int fd,
  * are [n_src, param_stride] row-major (the packed device result; the
  * stride may exceed n_outs when fewer sockets stand in for the logical
  * subscriber population).  One Python->C transition per window instead
- * of n_src.  use_gso selects the UDP_SEGMENT path.  Returns total ops
- * sent; negative errno only when nothing was sent. */
+ * of n_src.  use_gso selects the egress rung: 0 = plain sendmmsg,
+ * 1 = UDP_SEGMENT (GSO), 2 = the scalar sendto baseline (the forced
+ * `egress_backend = "scalar"` rung).  Returns total ops sent; negative
+ * errno only when nothing was sent. */
 int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
                              const int32_t *ring_len, int32_t capacity,
                              int32_t slot_size, const uint32_t *seq_off,
@@ -164,6 +177,90 @@ int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
                              const ed_dest *dest,
                              int32_t n_outs, const ed_sendop *ops,
                              int32_t n_ops, int32_t use_gso);
+
+/* ----------------------------------------------------- io_uring backend */
+
+/* Capability bits reported by ed_uring_probe() (>= 0) and
+ * ed_uring_caps().  The probe attacks the syscall boundary the same way
+ * the GSO EINVAL probe does: one throwaway ring at boot answers every
+ * "does this kernel/seccomp/RLIMIT_MEMLOCK combination support X"
+ * question, so steady-state sends never discover a capability the hard
+ * way.  A negative probe return is -errno (ENOSYS = no io_uring at all,
+ * EPERM = seccomp denied it) and callers drop to the GSO rung. */
+#define ED_URING_CAP_RING        1   /* io_uring_setup + mmap worked */
+#define ED_URING_CAP_SQPOLL      2   /* kernel-side submission polling */
+#define ED_URING_CAP_SEND_ZC     4   /* IORING_OP_SEND_ZC (MSG_ZEROCOPY) */
+#define ED_URING_CAP_RECV_MULTI  8   /* multishot recvmsg ingest */
+#define ED_URING_CAP_FIXED_BUFS 16   /* IORING_REGISTER_BUFFERS allowed
+                                      * under this RLIMIT_MEMLOCK */
+int32_t ed_uring_probe(void);
+
+/* Flags for ed_uring_egress_new (requests; silently degraded to what the
+ * probe allows — a request the kernel cannot honor must never turn into
+ * a hard error on the data path). */
+#define ED_URING_F_SQPOLL 1
+#define ED_URING_F_ZEROCOPY 2
+
+typedef struct ed_uring ed_uring;
+
+/* Persistent ring for one egress fd: `depth` SQ entries (clamped to
+ * [16, 1024]), a registered (fixed) send arena of depth x max_pkt bytes
+ * covering the rendered hot window, optional SQPOLL and SEND_ZC.  On
+ * failure returns NULL with -errno in *err_out.  Free with
+ * ed_uring_free (also drains outstanding zerocopy notifications). */
+ed_uring *ed_uring_egress_new(int fd, int32_t depth, int32_t max_pkt,
+                              int32_t flags, int32_t *err_out);
+void ed_uring_free(ed_uring *u);
+int32_t ed_uring_caps(const ed_uring *u);
+/* The ring's own pollable fd (readable when CQEs are pending).  For
+ * armed multishot ingest this — not the SOCKET fd — is the event-loop
+ * wakeup source: the ring consumes the socket's queue before epoll sees
+ * it, so watching the socket would strand completions until the
+ * provided-buffer pool exhausted. */
+int32_t ed_uring_fd(const ed_uring *u);
+
+/* Same contract as ed_fanout_send_udp — ops sent, EAGAIN stops the
+ * batch and returns the count so far (bookmark replay), hard errors
+ * return the delivered count (or -errno when nothing was sent) — but
+ * the datagrams ride one io_uring submission per chain of up to `depth`
+ * linked SQEs instead of one sendmmsg slot each.  IOSQE_IO_LINK keeps
+ * kernel execution in op order, so "count so far" is exact and a replay
+ * never duplicates a delivered datagram (the property the bookmark
+ * invariants rest on).  Faults from ed_fault_set surface through the
+ * same completion-path accounting as real CQE errors. */
+int32_t ed_uring_send(ed_uring *u, const uint8_t *ring_data,
+                      const int32_t *ring_len, int32_t capacity,
+                      int32_t slot_size, const uint32_t *seq_off,
+                      const uint32_t *ts_off, const uint32_t *ssrc,
+                      const ed_dest *dest, int32_t n_outs,
+                      const ed_sendop *ops, int32_t n_ops);
+
+/* Multi-source wrapper over ed_uring_send — the io_uring sibling of
+ * ed_fanout_send_multi (one Python->C transition per window). */
+int32_t ed_uring_send_multi(ed_uring *u, const uint8_t *ring_data,
+                            const int32_t *ring_len, int32_t capacity,
+                            int32_t slot_size, const uint32_t *seq_off,
+                            const uint32_t *ts_off, const uint32_t *ssrc,
+                            int32_t n_src, int32_t param_stride,
+                            const ed_dest *dest, int32_t n_outs,
+                            const ed_sendop *ops, int32_t n_ops);
+
+/* Multishot-recvmsg ingest ring for one UDP socket: a provided-buffer
+ * pool of `max_pkt`-sized slots and one persistent multishot RECVMSG
+ * SQE — datagrams land in CQEs without a per-batch recvmmsg syscall.
+ * Requires ED_URING_CAP_RECV_MULTI; returns NULL/-errno otherwise. */
+ed_uring *ed_uring_ingest_new(int fd, int32_t max_pkt, int32_t *err_out);
+
+/* Same contract as ed_udp_ingest: drains completed datagrams into ring
+ * slots at *head, returns datagrams admitted (oversize dropped +
+ * counted), advances *head.  One io_uring_enter flushes pending
+ * completions; buffer recycling and multishot re-arm ride the same
+ * submission. */
+int32_t ed_uring_ingest_drain(ed_uring *u, uint8_t *ring_data,
+                              int32_t *ring_len, int64_t *ring_arrival,
+                              int32_t capacity, int32_t slot_size,
+                              int64_t now_ms, int64_t *head,
+                              int32_t max_pkts, int32_t *oversize_dropped);
 
 /* The REFERENCE architecture in C, for an honest vs_baseline: one thread,
  * one sendto(2) per (packet, output) with a scalar in-buffer header patch —

@@ -9,6 +9,7 @@ paths, the same CPU-fallback discipline the TPU engine follows.
 from __future__ import annotations
 
 import ctypes
+import errno
 import os
 import socket
 import struct
@@ -39,14 +40,26 @@ class SendOp(ctypes.Structure):
 #: (send_ns/ingest_ns are the clock_gettime timing tail; stage_gather_ns/
 #: staged_bytes are the megabatch staging tail — second ABI bump;
 #: fault_injections is the resilience subsystem's egress fault counter —
-#: third ABI bump; the loader refuses any library whose field count
+#: third ABI bump; the uring_* fields are the io_uring backend tail —
+#: fourth ABI bump; the loader refuses any library whose field count
 #: disagrees — ed_stats_fields check)
 _STAT_FIELDS = ("sendmmsg_calls", "sendto_calls", "send_packets",
                 "gso_supers", "gso_segments", "eagain_stops",
                 "hard_errors", "bytes_to_wire", "recvmmsg_calls",
                 "recv_datagrams", "recv_bytes", "oversize_dropped",
                 "send_ns", "ingest_ns", "stage_gather_ns", "staged_bytes",
-                "fault_injections")
+                "fault_injections", "uring_sqes", "uring_cqes",
+                "uring_submits", "uring_zc_completions", "uring_zc_copied")
+
+#: capability bits reported by ``uring_probe()`` (csrc ED_URING_CAP_*)
+URING_CAP_RING = 1
+URING_CAP_SQPOLL = 2
+URING_CAP_SEND_ZC = 4
+URING_CAP_RECV_MULTI = 8
+URING_CAP_FIXED_BUFS = 16
+#: creation-request flags (csrc ED_URING_F_*)
+URING_F_SQPOLL = 1
+URING_F_ZEROCOPY = 2
 
 
 class EdStats(ctypes.Structure):
@@ -166,6 +179,33 @@ def _load():
             ctypes.c_int, u8p, i32p, i64p, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int64, i64p, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int32)]
+        # io_uring backend (ISSUE 8): probe + persistent egress/ingest rings
+        lib.ed_uring_probe.restype = ctypes.c_int32
+        lib.ed_uring_probe.argtypes = []
+        lib.ed_uring_egress_new.restype = ctypes.c_void_p
+        lib.ed_uring_egress_new.argtypes = [
+            ctypes.c_int, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.ed_uring_free.restype = None
+        lib.ed_uring_free.argtypes = [ctypes.c_void_p]
+        lib.ed_uring_caps.restype = ctypes.c_int32
+        lib.ed_uring_caps.argtypes = [ctypes.c_void_p]
+        lib.ed_uring_fd.restype = ctypes.c_int32
+        lib.ed_uring_fd.argtypes = [ctypes.c_void_p]
+        lib.ed_uring_send_multi.restype = ctypes.c_int32
+        lib.ed_uring_send_multi.argtypes = [
+            ctypes.c_void_p, u8p, i32p, ctypes.c_int32, ctypes.c_int32,
+            u32p, u32p, u32p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(Dest), ctypes.c_int32, ctypes.POINTER(SendOp),
+            ctypes.c_int32]
+        lib.ed_uring_ingest_new.restype = ctypes.c_void_p
+        lib.ed_uring_ingest_new.argtypes = [
+            ctypes.c_int, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        lib.ed_uring_ingest_drain.restype = ctypes.c_int32
+        lib.ed_uring_ingest_drain.argtypes = [
+            ctypes.c_void_p, u8p, i32p, i64p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int64, i64p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32)]
         lib.ed_wheel_new.restype = ctypes.c_void_p
         lib.ed_wheel_new.argtypes = [ctypes.c_int64]
         lib.ed_wheel_free.argtypes = [ctypes.c_void_p]
@@ -230,6 +270,185 @@ def fault_clear() -> None:
     lib = _load()
     assert lib is not None
     lib.ed_fault_clear()
+
+
+# ------------------------------------------------------- io_uring backend
+_uring_probe_cache: int | None = None
+
+
+def uring_probe(*, refresh: bool = False) -> int:
+    """Boot-time io_uring capability probe (csrc ``ed_uring_probe``).
+
+    Returns a bitmask of ``URING_CAP_*`` (>= 0) when the kernel supports
+    io_uring with sendmsg/recvmsg, or ``-errno`` (``-ENOSYS`` pre-5.1,
+    ``-EPERM`` under a seccomp deny) — the probe outcome callers turn
+    into the GSO fallback rung, never into a hard error.  Cached per
+    process: one throwaway ring at boot, zero probes on the hot path."""
+    global _uring_probe_cache
+    if _uring_probe_cache is not None and not refresh:
+        return _uring_probe_cache
+    lib = _load()
+    if lib is None:
+        _uring_probe_cache = -int(getattr(errno, "ENOSYS", 38))
+        return _uring_probe_cache
+    _uring_probe_cache = int(lib.ed_uring_probe())
+    return _uring_probe_cache
+
+
+class UringEgress:
+    """Persistent io_uring over one egress fd (registered send arena,
+    linked-SQE batched submission, optional SQPOLL/zerocopy).
+
+    Construction raising ``OSError`` is a PROBE outcome — callers land
+    on the GSO rung with one ``egress.backend_fallback`` event, exactly
+    the GSO EINVAL probe's shape (never a counted hard_error)."""
+
+    def __init__(self, fd: int, *, depth: int = 256, max_pkt: int = 2048,
+                 sqpoll: bool = True, zerocopy: bool = True):
+        lib = _load()
+        if lib is None:
+            raise OSError(errno.ENOSYS, "native core unavailable")
+        flags = (URING_F_SQPOLL if sqpoll else 0) | \
+                (URING_F_ZEROCOPY if zerocopy else 0)
+        err = ctypes.c_int32(0)
+        self._lib = lib
+        self._h = lib.ed_uring_egress_new(fd, depth, max_pkt, flags,
+                                          ctypes.byref(err))
+        if not self._h:
+            e = -err.value if err.value < 0 else (err.value or errno.ENOSYS)
+            raise OSError(e, os.strerror(e))
+        self.fd = fd
+        self.caps = int(lib.ed_uring_caps(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ed_uring_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def active(self) -> bool:
+        return bool(self._h)
+
+    def send_multi(self, ring_data: np.ndarray, ring_len: np.ndarray,
+                   seq_off: np.ndarray, ts_off: np.ndarray,
+                   ssrc: np.ndarray, dests, ops, n_ops: int,
+                   *, trace_id: str | None = None) -> int:
+        """``fanout_send_multi``'s contract over the io_uring ring: one
+        linked-SQE chain per batch instead of one sendmmsg slot per
+        datagram run.  EAGAIN stops report the delivered count (bookmark
+        replay); ``last_send_errno`` explains a short return."""
+        assert self._h, "closed"
+        assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+        seq = np.ascontiguousarray(seq_off, np.uint32)
+        ts = np.ascontiguousarray(ts_off, np.uint32)
+        sc = np.ascontiguousarray(ssrc, np.uint32)
+        assert seq.ndim == 2 and seq.shape == ts.shape == sc.shape
+        assert seq.shape[1] >= len(dests)
+        t0 = TRACER.begin()
+        r = self._lib.ed_uring_send_multi(
+            self._h, _u8(ring_data),
+            _i32(np.ascontiguousarray(ring_len, np.int32)),
+            ring_data.shape[0], ring_data.shape[1],
+            _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
+            dests, len(dests), ops, n_ops)
+        span_args = {"ops": n_ops, "sent": int(r), "backend": "io_uring"}
+        if trace_id is not None:
+            span_args["trace_id"] = trace_id
+        TRACER.end("native.egress", t0, cat="native", **span_args)
+        return int(r)
+
+
+class UringIngest:
+    """Multishot-recvmsg ingest ring for one pusher socket: datagrams
+    land in CQEs from one persistent armed SQE; ``drain`` admits them
+    into the packet ring with ``ed_udp_ingest`` semantics."""
+
+    def __init__(self, fd: int, *, max_pkt: int = 2048):
+        lib = _load()
+        if lib is None:
+            raise OSError(errno.ENOSYS, "native core unavailable")
+        err = ctypes.c_int32(0)
+        self._lib = lib
+        self._h = lib.ed_uring_ingest_new(fd, max_pkt, ctypes.byref(err))
+        if not self._h:
+            e = -err.value if err.value < 0 else (err.value or errno.ENOSYS)
+            raise OSError(e, os.strerror(e))
+        self.fd = fd
+        #: the ring's pollable fd — the event-loop wakeup source (the
+        #: SOCKET goes quiet once the multishot arm consumes its queue)
+        self.ring_fd = int(lib.ed_uring_fd(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ed_uring_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def drain(self, ring_data: np.ndarray, ring_len: np.ndarray,
+              ring_arrival: np.ndarray, now_ms: int, head: int,
+              max_pkts: int = 256) -> tuple[int, int, int]:
+        """Returns (n_admitted, new_head, oversize_dropped)."""
+        assert self._h, "closed"
+        h = ctypes.c_int64(head)
+        drops = ctypes.c_int32(0)
+        n = self._lib.ed_uring_ingest_drain(
+            self._h, _u8(ring_data), _i32(ring_len), _i64(ring_arrival),
+            ring_data.shape[0], ring_data.shape[1], now_ms,
+            ctypes.byref(h), max_pkts, ctypes.byref(drops))
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return n, h.value, drops.value
+
+
+#: fd → UringIngest for sockets the server armed for io_uring ingest
+#: (server/app.py arms this when the effective egress backend is
+#: io_uring and the probe reports multishot recvmsg).  ``udp_ingest``
+#: routes through it transparently so every ring-drain call site keeps
+#: its recvmmsg fallback untouched.
+_uring_ingests: dict[int, "UringIngest"] = {}
+
+
+def uring_ingest_arm(fd: int, *, max_pkt: int = 2048) -> int | None:
+    """Arm multishot io_uring ingest for ``fd``.  Returns the ring's
+    pollable fd (the event-loop wakeup source — the SOCKET fd goes
+    quiet once the multishot arm consumes its queue, so watching it
+    would strand completions until the buffer pool exhausted), or None
+    (recvmmsg stays in charge) when the kernel lacks the caps —
+    callers treat that as a probe outcome, not an error."""
+    ing = _uring_ingests.get(fd)
+    if ing is not None:
+        return ing.ring_fd
+    caps = uring_probe()
+    if caps < 0 or not caps & URING_CAP_RECV_MULTI:
+        return None
+    try:
+        ing = _uring_ingests[fd] = UringIngest(fd, max_pkt=max_pkt)
+    except OSError:
+        return None
+    return ing.ring_fd
+
+
+def uring_ingest_disarm(fd: int | None = None) -> None:
+    """Drop one armed ingest ring (or all of them when fd is None)."""
+    if fd is None:
+        for ing in _uring_ingests.values():
+            ing.close()
+        _uring_ingests.clear()
+        return
+    ing = _uring_ingests.pop(fd, None)
+    if ing is not None:
+        ing.close()
 
 
 def _u8(a: np.ndarray):
@@ -317,9 +536,10 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
     [n_src, n_outs]; ONE C call sends every source's window (the hot loop
     makes one Python→C transition per pass instead of n_src).
 
-    ``use_gso``: 0/False plain sendmmsg, 1/True UDP_SEGMENT.
-    ``trace_id`` stamps the egress span for session correlation (the
-    engine passes the stream's session trace)."""
+    ``use_gso``: 0/False plain sendmmsg, 1/True UDP_SEGMENT, 2 the
+    scalar sendto baseline (the forced ``egress_backend="scalar"``
+    rung).  ``trace_id`` stamps the egress span for session correlation
+    (the engine passes the stream's session trace)."""
     lib = _load()
     assert lib is not None
     assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
@@ -336,7 +556,8 @@ def fanout_send_multi(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
         ring_data.shape[0], ring_data.shape[1],
         _u32(seq), _u32(ts), _u32(sc), seq.shape[0], seq.shape[1],
         dests, len(dests), ops, n_ops, int(use_gso))
-    span_args = {"ops": n_ops, "sent": int(r), "gso": bool(use_gso)}
+    # 0 = plain sendmmsg, 1 = GSO, 2 = scalar sendto rung
+    span_args = {"ops": n_ops, "sent": int(r), "gso": int(use_gso)}
     if trace_id is not None:
         span_args["trace_id"] = trace_id
     TRACER.end("native.egress", t0, cat="native", **span_args)
@@ -478,7 +699,19 @@ def fanout_render(ring_data: np.ndarray, ring_len: np.ndarray,
 def udp_ingest(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
                ring_arrival: np.ndarray, now_ms: int, head: int,
                max_pkts: int = 256) -> tuple[int, int, int]:
-    """Returns (n_admitted, new_head, oversize_dropped)."""
+    """Returns (n_admitted, new_head, oversize_dropped).
+
+    Routes through an armed multishot io_uring ingest ring when
+    ``uring_ingest_arm(fd)`` succeeded for this socket; any io_uring
+    failure disarms the fd and falls back to the recvmmsg drain for the
+    rest of the process (a degradation, never a dropped drain)."""
+    ing = _uring_ingests.get(fd)
+    if ing is not None:
+        try:
+            return ing.drain(ring_data, ring_len, ring_arrival, now_ms,
+                             head, max_pkts)
+        except OSError:
+            uring_ingest_disarm(fd)
     lib = _load()
     assert lib is not None
     h = ctypes.c_int64(head)
@@ -560,6 +793,15 @@ def _collect_native_stats() -> None:
     obs.INGEST_BUSY_SECONDS.set_to(s["ingest_ns"] / 1e9)
     obs.STAGE_GATHER_BUSY_SECONDS.set_to(s["stage_gather_ns"] / 1e9)
     obs.STAGE_GATHER_BYTES.set_to(s["staged_bytes"])
+    # io_uring backend tail (ISSUE 8): submission/completion volume plus
+    # the zerocopy honesty pair — completions AND how many the kernel
+    # copied anyway (loopback copies by design; hiding that would make
+    # the zerocopy figure a lie)
+    obs.IO_URING_SQE.set_to(s["uring_sqes"])
+    obs.IO_URING_CQE.set_to(s["uring_cqes"])
+    obs.IO_URING_SUBMITS.set_to(s["uring_submits"])
+    obs.IO_URING_ZC_COMPLETIONS.set_to(s["uring_zc_completions"])
+    obs.IO_URING_ZC_COPIED.set_to(s["uring_zc_copied"])
     # egress faults injected by the C-side ed_fault_* knobs land under
     # their own site label next to the Python-side injection sites
     if s["fault_injections"]:

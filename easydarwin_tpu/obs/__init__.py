@@ -17,13 +17,16 @@ from .families import (  # noqa: F401  (re-exported inventory)
     CLUSTER_LEASE_ACQUIRED, CLUSTER_LEASE_FENCE_REJECTED,
     CLUSTER_LEASE_LOST, CLUSTER_LEASE_RENEWALS, CLUSTER_MIGRATIONS,
     CLUSTER_PLACEMENT_MOVES, CLUSTER_PULL_BREAKER_OPEN,
-    CLUSTER_PULL_RETRIES, EGRESS_BUSY_SECONDS, EGRESS_BYTES, EGRESS_EAGAIN,
+    CLUSTER_PULL_RETRIES, EGRESS_BACKEND_FALLBACKS, EGRESS_BACKEND_INFO,
+    EGRESS_BUSY_SECONDS, EGRESS_BYTES, EGRESS_EAGAIN,
     EGRESS_GSO_SEGMENTS,
     EGRESS_GSO_SUPERS, EGRESS_PACKETS, EGRESS_SENDMMSG_CALLS,
     EGRESS_SENDTO_CALLS, EGRESS_SEND_ERRORS, EVENTS_DROPPED, EVENTS_EMITTED,
     EVENTS_INVALID, EVENTS_SINK_FAILURES, FAULT_INJECTED, FLIGHT_DUMPS,
     INGEST_BUSY_SECONDS, INGEST_BYTES, INGEST_DATAGRAMS,
-    INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, LOG_LINES, LOG_ROLLS,
+    INGEST_OVERSIZE_DROPPED, INGEST_RECVMMSG_CALLS, IO_URING_CQE,
+    IO_URING_SQE, IO_URING_SUBMITS, IO_URING_ZC_COMPLETIONS,
+    IO_URING_ZC_COPIED, LOG_LINES, LOG_ROLLS,
     MEGABATCH_DEVICE_PASSES, MEGABATCH_DEVICE_PHASE_SECONDS,
     MEGABATCH_DEVICE_STREAMS,
     MEGABATCH_FALLBACK, MEGABATCH_PASSES, MEGABATCH_STREAMS,

@@ -87,6 +87,11 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "cluster.breaker_close": ("url",),
     "cluster.migrate": ("from_node", "outputs"),
     "cluster.drain": ("node", "streams"),
+    # egress backend probe ladder (server/app.py + relay/fanout.py,
+    # ISSUE 8): ONE latched event per rung drop — backend = the rung
+    # fallen from, fallback = the rung landed on, reason = the probe /
+    # runtime errno that forced it (never per send, never a hard_error)
+    "egress.backend_fallback": ("backend", "fallback", "reason"),
     # flight recorder (obs/flight.py)
     "flight.dump": ("reason",),
     # SLO watchdog (obs/slo.py): one per burn-window rising edge (latched,

@@ -175,6 +175,47 @@ EGRESS_BUSY_SECONDS = REGISTRY.counter(
     "(clock_gettime deltas in ed_stats; the denominator for per-call "
     "egress cost and the native half of the egress_native phase)")
 
+# --------------------------------------------------------- egress backends
+# The boot-time probe ladder (ISSUE 8): io_uring → GSO/sendmmsg →
+# scalar.  ``egress_backend_info`` is an info-style gauge — exactly one
+# backend child reads 1 (the effective backend), the others 0 — so a
+# forced-backend soak can assert what is actually serving the wire.
+EGRESS_BACKEND_INFO = REGISTRY.gauge(
+    "egress_backend_info",
+    "The effective egress backend serving the shared UDP pair (1 = "
+    "active, 0 = probed but not serving), by backend (io_uring / gso / "
+    "scalar); the probe ladder's runtime verdict", labels=("backend",))
+EGRESS_BACKEND_FALLBACKS = REGISTRY.counter(
+    "egress_backend_fallbacks_total",
+    "Backend probe/runtime failures that dropped egress one rung down "
+    "the ladder (ENOSYS/seccomp EPERM/RLIMIT_MEMLOCK at boot, repeated "
+    "send failures at runtime), by the backend fallen FROM; each carries "
+    "one structured egress.backend_fallback event and is never counted "
+    "as a hard send error", labels=("backend",))
+IO_URING_SQE = REGISTRY.counter(
+    "io_uring_sqe_total",
+    "Submission queue entries queued by the io_uring egress/ingest "
+    "backend (one per datagram op, per buffer recycle, per multishot "
+    "re-arm)")
+IO_URING_CQE = REGISTRY.counter(
+    "io_uring_cqe_total",
+    "Completion queue entries reaped by the io_uring backend "
+    "(send/ingest completions plus zerocopy notifications)")
+IO_URING_SUBMITS = REGISTRY.counter(
+    "io_uring_submit_calls_total",
+    "io_uring_enter(2) syscalls issued (sqe_total / submit_calls_total "
+    "= the syscall batching factor; under SQPOLL steady-state pushes "
+    "submit without entering at all)")
+IO_URING_ZC_COMPLETIONS = REGISTRY.counter(
+    "io_uring_zerocopy_completions_total",
+    "Zerocopy send notifications reaped (the kernel released its "
+    "reference to the registered send arena)")
+IO_URING_ZC_COPIED = REGISTRY.counter(
+    "io_uring_zerocopy_copied_total",
+    "Zerocopy notifications reporting the kernel COPIED the payload "
+    "anyway (expected on loopback and some NIC paths — counted so the "
+    "zerocopy figure is honest, never hidden)")
+
 # ------------------------------------------------------------ native ingest
 INGEST_RECVMMSG_CALLS = REGISTRY.counter(
     "ingest_recvmmsg_calls_total",

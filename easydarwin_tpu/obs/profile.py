@@ -63,8 +63,12 @@ from .trace import TRACER
 #: that was NOT yet ready at harvest — the un-hidden remainder of
 #: transfer+compute (a ready result's fetch files under plain ``d2h``),
 #: so any weight here means double-buffering stopped hiding the device
+#: ``egress_io_uring`` is the same wire-scatter bracket as
+#: ``egress_native``, filed under its own phase when the io_uring
+#: backend serves the pass — the backend-labelled attribution that lets
+#: a dashboard compare per-pass egress cost across backends directly
 PHASES = ("wake_to_pass", "h2d", "device_step", "d2h", "egress_native",
-          "rtcp_qos", "stage_gather", "h2d_overlap")
+          "egress_io_uring", "rtcp_qos", "stage_gather", "h2d_overlap")
 #: engines that record phases: the native sendmmsg fast path, the
 #: [S,P,12] batch-header path, the scalar oracle, the jitted model
 #: pipeline, the pump loop (wake→pass only), the cross-stream megabatch

@@ -55,6 +55,12 @@ def render_headers(b01: np.ndarray, seq: np.ndarray, ts: np.ndarray,
 # the historical name every megabatch consumer imports from here
 from ..ops.staging import pow2 as _pow2  # noqa: E402
 
+#: the egress backend ladder (ISSUE 8).  ``auto`` resolves to the best
+#: rung the boot-time capability probe grants: io_uring where the kernel
+#: has it, the GSO/sendmmsg pair otherwise; ``scalar`` forces the
+#: per-datagram sendto baseline (bench denominators, worst-case drills).
+EGRESS_BACKENDS = ("auto", "io_uring", "gso", "scalar")
+
 
 def params_key(outputs) -> tuple:
     """The affine-params cache key: one 5-tuple of rewrite state per fast
@@ -94,9 +100,17 @@ class TpuFanoutEngine:
     """
 
     def __init__(self, prefix_width: int = parse_ops.PARSE_PREFIX,
-                 egress_fd: int | None = None):
+                 egress_fd: int | None = None,
+                 uring=None, egress_backend: str = "auto"):
         self.prefix_width = prefix_width
         self.egress_fd = egress_fd
+        #: native.UringEgress over the same fd (None = no io_uring);
+        #: owned by the server (shared across engines), never closed here
+        self.uring = uring
+        #: requested backend (EGRESS_BACKENDS); ``effective_backend()``
+        #: resolves it against what the probe granted and what runtime
+        #: strikes have since disqualified
+        self.egress_backend = egress_backend
         self.steps = 0
         self.packets_sent = 0
         self.native_sent = 0
@@ -110,6 +124,12 @@ class TpuFanoutEngine:
         # succeeds disable it (transient errors don't)
         self._gso_disabled = False
         self._gso_strikes = 0
+        # io_uring is disqualified the same way GSO is: two passes where
+        # the ring fails outright but the sendmmsg rung succeeds drop
+        # this engine one rung down the ladder, with ONE structured
+        # egress.backend_fallback event (the PR 4 GSO-probe fix shape)
+        self._uring_disabled = False
+        self._uring_strikes = 0
         self._params_key = None
         self._params = None                 # ([1,S] seq_off, ts_off, ssrc)
         self._dests_key = None
@@ -161,6 +181,41 @@ class TpuFanoutEngine:
     def _native_ok(self) -> bool:
         return (self.egress_fd is not None and self.egress_fd >= 0
                 and _native_mod() is not None)
+
+    def effective_backend(self) -> str:
+        """The rung actually serving this engine's wire writes.  A
+        forced ``io_uring`` on a kernel without it reads ``gso`` here —
+        what /metrics' ``egress_backend_info`` reports and what
+        ``tools/soak.py --egress-backend`` asserts against."""
+        if self.egress_backend == "scalar":
+            return "scalar"
+        if (self.egress_backend in ("auto", "io_uring")
+                and not self._uring_disabled
+                and self.uring is not None
+                and getattr(self.uring, "active", False)):
+            return "io_uring"
+        return "gso"
+
+    def _note_uring_failure(self, err: int) -> None:
+        """A whole-batch io_uring failure while sendmmsg still works:
+        strike the backend; two strikes retire it for this engine with
+        ONE structured fallback event — never a counted hard_error
+        (probe-outcome semantics, the PR 4 GSO EINVAL fix shape)."""
+        if self._uring_disabled:
+            return
+        self._uring_strikes += 1
+        if self._uring_strikes < 2:
+            return
+        self._uring_disabled = True
+        reason = (errno_mod.errorcode.get(err, str(err)) if err
+                  else "unknown")
+        obs.EGRESS_BACKEND_FALLBACKS.inc(backend="io_uring")
+        obs.EVENTS.emit("egress.backend_fallback", level="warn",
+                        backend="io_uring", fallback="gso", reason=reason)
+        # the info gauge tracks the engine-observed truth so a scrape
+        # never claims io_uring while the GSO rung serves the wire
+        obs.EGRESS_BACKEND_INFO.set(0, backend="io_uring")
+        obs.EGRESS_BACKEND_INFO.set(1, backend="gso")
 
     @staticmethod
     def _fast_eligible(out, native_ok: bool) -> bool:
@@ -499,24 +554,56 @@ class TpuFanoutEngine:
                 pos += n
         dests = self._dests_for(fast)
         ops = native.ops_from_numpy(ops_np)
-        used_gso = not self._gso_disabled
         trace_id = stream.trace_id
+        backend = self.effective_backend()
+        used_backend = backend
+        used_gso = False
+        uring_failed = False
+        uring_err = 0
         r = -1
-        if used_gso:
+        if backend == "io_uring":
+            # one linked-SQE submission per chain instead of one
+            # sendmmsg slot per run — EAGAIN/hard semantics identical,
+            # so the bookmark accounting below is backend-blind
+            r = self.uring.send_multi(
+                ring.data, ring.length, seq_off, ts_off, ssrc, dests,
+                ops, total, trace_id=trace_id)
+            if r < 0:
+                # whole-batch ring failure with nothing sent: serve this
+                # pass from the GSO rung; strike io_uring only if a
+                # lower rung proves the destinations are fine
+                uring_failed = True
+                uring_err = native.last_send_errno() or -r
+                backend = used_backend = "gso"
+        if backend == "scalar":
+            # forced per-datagram sendto baseline (egress_backend=scalar)
             r = native.fanout_send_multi(
                 self.egress_fd, ring.data, ring.length, seq_off, ts_off,
-                ssrc, dests, ops, total, use_gso=True, trace_id=trace_id)
-        if r < 0:                           # GSO off/unsupported/failed
-            used_gso = False
-            r = native.fanout_send_multi(
-                self.egress_fd, ring.data, ring.length, seq_off, ts_off,
-                ssrc, dests, ops, total, use_gso=False, trace_id=trace_id)
-            if r >= 0 and not self._gso_disabled:
-                self._gso_strikes += 1      # GSO failed, plain path works
-                if self._gso_strikes >= 2:
-                    self._gso_disabled = True
-        elif self._gso_strikes:
-            self._gso_strikes = 0
+                ssrc, dests, ops, total, use_gso=2, trace_id=trace_id)
+        elif backend == "gso":
+            used_gso = not self._gso_disabled
+            r = -1
+            if used_gso:
+                r = native.fanout_send_multi(
+                    self.egress_fd, ring.data, ring.length, seq_off,
+                    ts_off, ssrc, dests, ops, total, use_gso=True,
+                    trace_id=trace_id)
+            if r < 0:                       # GSO off/unsupported/failed
+                used_gso = False
+                r = native.fanout_send_multi(
+                    self.egress_fd, ring.data, ring.length, seq_off,
+                    ts_off, ssrc, dests, ops, total, use_gso=False,
+                    trace_id=trace_id)
+                if r >= 0 and not self._gso_disabled:
+                    self._gso_strikes += 1  # GSO failed, plain path works
+                    if self._gso_strikes >= 2:
+                        self._gso_disabled = True
+            elif self._gso_strikes:
+                self._gso_strikes = 0
+            if uring_failed and r >= 0:
+                # io_uring failed outright but a lower rung delivered:
+                # a backend strike, not a destination failure
+                self._note_uring_failure(uring_err)
         hard = False
         if r < 0:
             # hard error with nothing sent: fall through to accounting as
@@ -551,10 +638,14 @@ class TpuFanoutEngine:
         # would bill our own bookkeeping to the network)
         wire_ns = time.perf_counter_ns()
         if t_egress:
-            # every native send this pass (op-list build, GSO try, plain
-            # fallback, GSO remainder retry) — the Python-side bracket;
-            # csrc's ed_stats.send_ns carries the in-library half
-            self._phase_add("egress_native", wire_ns - t_egress)
+            # every native send this pass (op-list build, backend try,
+            # lower-rung fallback, GSO remainder retry) — the Python-side
+            # bracket; csrc's ed_stats.send_ns carries the in-library
+            # half.  Filed under the BACKEND's phase so per-pass egress
+            # cost is comparable across rungs on one dashboard
+            self._phase_add("egress_io_uring"
+                            if used_backend == "io_uring"
+                            else "egress_native", wire_ns - t_egress)
         # bookmark/stat accounting, exact under partial (EAGAIN) sends
         taken = 0
         hard_consumed = False

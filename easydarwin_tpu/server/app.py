@@ -126,6 +126,14 @@ class StreamingServer:
         self._restart_requested = False
         self.restart_event = asyncio.Event()
         self._engines: dict[int, TpuFanoutEngine] = {}
+        #: io_uring egress ring over the shared UDP pair (ISSUE 8);
+        #: built in start() by the probe ladder, None = GSO/scalar rung
+        self.uring_egress = None
+        #: the rung the probe ladder landed on ("io_uring"/"gso"/
+        #: "scalar") — mirrored into egress_backend_info{backend}
+        self.egress_backend_effective = "gso"
+        #: pusher RTP sockets get multishot io_uring ingest when True
+        self.uring_ingest_enabled = False
         #: cross-stream megabatch scheduler (relay/megabatch.py) — built
         #: lazily on the first wake with enough engine-eligible streams
         self.megabatch = None
@@ -181,6 +189,7 @@ class StreamingServer:
             INJECTOR.arm(plan)
             self._armed_faults = True
         await self.rtsp.start()
+        self._init_egress_backend()
         await self.rest.start()
         if self.config.resilience_checkpoint_enabled:
             # hot-restore AFTER the egress pair exists (restored UDP
@@ -303,6 +312,13 @@ class StreamingServer:
         self.transcodes.stop_all()
         await self.pulls.stop_all()
         await self.rtsp.stop()
+        if self.uring_egress is not None:
+            self.uring_egress.close()
+            self.uring_egress = None
+        if self.uring_ingest_enabled:
+            from .. import native
+            native.uring_ingest_disarm()
+            self.uring_ingest_enabled = False
         await self.rest.stop()
 
     def request_restart(self) -> None:
@@ -451,13 +467,74 @@ class StreamingServer:
             if egress is not None:
                 egress.unregister(sub.output, sub)
 
+    # ------------------------------------------------- egress backend probe
+    def _init_egress_backend(self) -> None:
+        """The boot-time probe ladder (ISSUE 8): resolve the configured
+        ``egress_backend`` against what this kernel actually grants.
+
+        Every probe failure — ENOSYS (pre-5.1), seccomp EPERM,
+        RLIMIT_MEMLOCK too small for the registered arena — lands on the
+        GSO rung with ONE structured ``egress.backend_fallback`` event
+        and a fallback counter tick, never a counted hard_error (the
+        same fix shape as the PR 4 GSO EINVAL probe)."""
+        from .. import native, obs
+        choice = self.config.egress_backend_choice()  # raises on a typo
+        # engines must see the SAME normalized choice the ladder used —
+        # handing them the raw pref ("Auto", "IO_URING ") would make
+        # metrics claim one rung while every pass serves another
+        self._egress_backend_choice = choice
+        egress = self.rtsp.shared_egress
+        effective = "scalar" if choice == "scalar" else "gso"
+        if (choice in ("auto", "io_uring") and egress is not None
+                and egress.active and native.available()):
+            caps = native.uring_probe()
+            if caps >= 0:
+                try:
+                    from ..relay.ring import SLOT_SIZE
+                    self.uring_egress = native.UringEgress(
+                        egress.fileno(), max_pkt=SLOT_SIZE)
+                    effective = "io_uring"
+                    self.uring_ingest_enabled = bool(
+                        self.config.native_ingest
+                        and caps & native.URING_CAP_RECV_MULTI)
+                    self.rtsp.uring_ingest_enabled = \
+                        self.uring_ingest_enabled
+                except OSError as e:
+                    caps = -(e.errno or 38)
+            if caps < 0:
+                import errno as errno_mod
+                reason = errno_mod.errorcode.get(-caps, str(-caps))
+                obs.EGRESS_BACKEND_FALLBACKS.inc(backend="io_uring")
+                obs.EVENTS.emit(
+                    "egress.backend_fallback",
+                    level="warn" if choice == "io_uring" else "info",
+                    backend="io_uring", fallback="gso", reason=reason)
+                if self.error_log:
+                    self.error_log.info(
+                        f"egress backend: io_uring unavailable "
+                        f"({reason}), serving from the GSO rung")
+        self.egress_backend_effective = effective
+        # info-style gauge: exactly one backend child reads 1 so a
+        # forced-backend soak can assert what serves the wire
+        for b in ("io_uring", "gso", "scalar"):
+            obs.EGRESS_BACKEND_INFO.set(1 if b == effective else 0,
+                                        backend=b)
+        if self.error_log and effective != "gso":
+            self.error_log.info(f"egress backend: {effective}"
+                                + (f" (caps={self.uring_egress.caps})"
+                                   if self.uring_egress else ""))
+
     # ---------------------------------------------------------- pump loop
     def _engine_for(self, stream) -> TpuFanoutEngine:
         eng = self._engines.get(id(stream))
         if eng is None:
-            eng = self._engines[id(stream)] = TpuFanoutEngine()
+            eng = self._engines[id(stream)] = TpuFanoutEngine(
+                egress_backend=getattr(self, "_egress_backend_choice",
+                                       None) or "auto",
+                uring=self.uring_egress)
         egress = self.rtsp.shared_egress
         eng.egress_fd = egress.fileno() if egress is not None else None
+        eng.uring = self.uring_egress
         return eng
 
     def _reflect_all(self) -> int:

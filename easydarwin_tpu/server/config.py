@@ -61,6 +61,13 @@ class ServerConfig:
     # required by the native sendmmsg/GSO fan-out). Falls back to per-client
     # port pairs when off or when the native core is unavailable.
     shared_udp_egress: bool = True
+    # egress backend ladder (ISSUE 8): "auto" = best rung the boot-time
+    # capability probe grants (io_uring with registered buffers/SQPOLL/
+    # zerocopy where the kernel has it, the GSO/sendmmsg pair otherwise);
+    # "io_uring"/"gso" force a rung (a forced-but-unavailable io_uring
+    # degrades to gso with ONE egress.backend_fallback event); "scalar"
+    # forces the per-datagram sendto baseline
+    egress_backend: str = "auto"
     # x-Retransmit (reliable UDP) negotiation in SETUP — the reference's
     # reliable_udp pref (QTSServerPrefs; RTPStream.cpp:448 gate)
     reliable_udp: bool = True
@@ -188,6 +195,18 @@ class ServerConfig:
         return "\n".join(out) + "\n"
 
     # -- derived -----------------------------------------------------------
+    def egress_backend_choice(self) -> str:
+        """The validated ``egress_backend`` pref.  A typo'd backend must
+        fail the boot loudly — silently serving from a rung the operator
+        didn't pick would void every forced-backend soak."""
+        from ..relay.fanout import EGRESS_BACKENDS
+        v = self.egress_backend.strip().lower()
+        if v not in EGRESS_BACKENDS:
+            raise ValueError(
+                f"egress_backend {self.egress_backend!r} not one of "
+                f"{EGRESS_BACKENDS}")
+        return v
+
     def slo_config(self):
         from ..obs.slo import SloConfig
         return SloConfig(

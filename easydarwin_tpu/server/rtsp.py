@@ -379,7 +379,9 @@ class RtspConnection:
                     on_readable=lambda fd, tid=tid:
                         self._native_rtp_drain(tid, fd),
                     on_rtcp=lambda d, a, tid=tid: self._udp_ingest(
-                        tid, d, True, addr=a))
+                        tid, d, True, addr=a),
+                    uring=getattr(self.server, "uring_ingest_enabled",
+                                  False))
             else:
                 pair = await self.server.udp_pool.allocate(
                     on_rtp=lambda d, a, tid=tid: self._udp_ingest(
@@ -786,6 +788,10 @@ class RtspServer:
         #: RTPSocketPool shared-pair + UDPDemuxer design; doorway to the
         #: native batched egress (server/egress.py). None until start().
         self.shared_egress = None
+        #: set by the app's egress-backend probe: pusher RTP sockets get
+        #: multishot io_uring ingest (transports.NativeIngestPair arms
+        #: per pair; the recvmmsg drain stays the fallback)
+        self.uring_ingest_enabled = False
         #: SdpFileRelaySource for .sdp-described UDP/multicast broadcasts
         self.relay_source = None
         self.connections: set[RtspConnection] = set()

@@ -130,7 +130,7 @@ class NativeIngestPair(UdpPair):
     datagrams instead of one asyncio callback per datagram."""
 
     def __init__(self, rtp_sock, rtcp_transport, rtcp_proto, rtp_port: int,
-                 loop, on_readable):
+                 loop, on_readable, *, uring: bool = False):
         self.rtp_sock = rtp_sock
         self.rtp_transport = None
         self.rtp_proto = None
@@ -139,14 +139,42 @@ class NativeIngestPair(UdpPair):
         self.rtp_port = rtp_port
         self._loop = loop
         self._fd = rtp_sock.fileno()
-        loop.add_reader(self._fd, on_readable, self._fd)
+        # multishot io_uring ingest (ISSUE 8): armed/disarmed with the
+        # PAIR's lifetime so a recycled fd number can never route a new
+        # socket's drain through a stale ring; native.udp_ingest falls
+        # back to recvmmsg transparently when arming is refused.  When
+        # armed, the event loop watches the RING's pollable fd, not the
+        # socket: the multishot arm consumes the socket queue before
+        # epoll sees it, so socket readability would never fire and
+        # completions would strand until the buffer pool exhausted.
+        self._uring_armed = False
+        self._watch_fds = [self._fd]
+        if uring:
+            from .. import native
+            ring_fd = native.uring_ingest_arm(self._fd)
+            if ring_fd is not None:
+                self._uring_armed = True
+                # watch BOTH: the ring fires in steady state; the socket
+                # only becomes readable again if the ring dies (drain
+                # error → disarm), which keeps the recvmmsg fallback
+                # reachable instead of stalling a watched-ring-only pair
+                self._watch_fds.append(ring_fd)
+        # the callback always receives the SOCKET fd: drains are keyed
+        # by it (native.udp_ingest routes armed fds through the ring)
+        for wfd in self._watch_fds:
+            loop.add_reader(wfd, on_readable, self._fd)
 
     def close(self) -> None:
         if self.rtp_sock is not None:
-            try:
-                self._loop.remove_reader(self._fd)
-            except Exception:
-                pass
+            for wfd in self._watch_fds:
+                try:
+                    self._loop.remove_reader(wfd)
+                except Exception:
+                    pass
+            if self._uring_armed:
+                from .. import native
+                native.uring_ingest_disarm(self._fd)
+                self._uring_armed = False
             self.rtp_sock.close()
             self.rtp_sock = None
         if self.rtcp_transport and not self.rtcp_transport.is_closing():
@@ -202,11 +230,13 @@ class UdpPortPool:
                                                                 on_rtcp)
         return UdpPair(rtp_t, rtp_p, rtcp_t, rtcp_p, port)
 
-    async def allocate_native(self, on_readable, on_rtcp=None
-                              ) -> NativeIngestPair:
+    async def allocate_native(self, on_readable, on_rtcp=None,
+                              uring: bool = False) -> NativeIngestPair:
         """Pair whose RTP socket feeds the native recvmmsg drain:
         ``on_readable(fd)`` runs once per readiness edge and drains a
-        whole batch, instead of one asyncio callback per datagram."""
+        whole batch, instead of one asyncio callback per datagram.
+        ``uring=True`` arms multishot io_uring ingest for the socket
+        (capability-gated; the recvmmsg drain stays the fallback)."""
         import socket as socket_mod
 
         async def make_rtp(loop, port):
@@ -224,4 +254,4 @@ class UdpPortPool:
         rtp_sock, rtcp_t, rtcp_p, port = await self._scan(make_rtp, on_rtcp)
         loop = asyncio.get_running_loop()
         return NativeIngestPair(rtp_sock, rtcp_t, rtcp_p, port, loop,
-                                on_readable)
+                                on_readable, uring=uring)

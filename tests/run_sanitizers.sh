@@ -23,6 +23,7 @@ run_one() {
     # differential + garbage fuzz run jax-free
     env EDTPU_CORE_SO="$PWD/$so" LD_PRELOAD="$rt" \
         ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+        UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1 \
         TSAN_OPTIONS=halt_on_error=1 \
         JAX_PLATFORMS=cpu \
         python -m pytest tests/test_native_core.py \
@@ -30,6 +31,13 @@ run_one() {
         "tests/test_h264_codec.py::test_native_requant_rejects_garbage_cleanly" \
         "tests/test_h264_codec.py::test_i16x16_native_matches_python" \
         "tests/test_h264_codec.py::test_chroma_mixed_slice_native_matches_python" \
+        "tests/test_egress_backend.py::test_native_stats_abi_tail" \
+        "tests/test_egress_backend.py::test_native_uring_probe_shape" \
+        "tests/test_egress_backend.py::test_native_uring_creation_matches_probe" \
+        "tests/test_egress_backend.py::test_native_wire_bytes_identical_across_backends" \
+        "tests/test_egress_backend.py::test_native_eagain_bookmark_replay_parity" \
+        "tests/test_egress_backend.py::test_native_enobufs_hard_contract" \
+        "tests/test_egress_backend.py::test_native_uring_fault_reaches_cqe_path" \
         -q -p no:cacheprovider
 }
 

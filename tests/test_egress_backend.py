@@ -1,0 +1,609 @@
+"""Egress backend ladder (ISSUE 8): io_uring / GSO / scalar.
+
+Two layers:
+
+* **native-level** (jax-free, run under ASan by tests/run_sanitizers.sh):
+  wire bytes byte-identical across the send entry points over real UDP
+  sockets with mixed sizes, EAGAIN bookmark-replay parity and ENOBUFS
+  hard-error contracts via the deterministic fault knobs, the probe's
+  capability/errno shape, and the ed_stats ABI tail.
+* **engine/server-level**: the TpuFanoutEngine serving identical wire
+  bytes per backend, the boot probe ladder landing on GSO with ONE
+  structured ``egress.backend_fallback`` event (never a hard_error) when
+  io_uring is absent or forced-but-unavailable, runtime strike
+  disqualification, config validation, and the metrics-lint/bench-gate/
+  soak contracts the tooling keys on.
+
+io_uring-only paths skip cleanly on kernels without io_uring (the probe
+returns -ENOSYS here) — the fallback half of the acceptance criteria is
+what this box actually exercises.
+"""
+
+import errno
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core unavailable")
+
+URING_OK = native.available() and native.uring_probe() >= 0
+
+
+def _gso_supported() -> bool:
+    """One-shot UDP_SEGMENT capability probe (the raw entry point, not
+    the engine's internal fallback): pre-4.18 kernels fail multi-segment
+    supers with EINVAL and the tests gate the GSO rung exactly like the
+    production ladder does."""
+    if not native.available():
+        return False
+    ring = np.zeros((4, 256), np.uint8)
+    lens = np.zeros(4, np.int32)
+    for i in range(2):
+        ring[i, 0], ring[i, 1] = 0x80, 96
+        lens[i] = 100
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        dests = native.make_dests([rx.getsockname()[:2]])
+        one = np.array([[0]], np.uint32)
+        ops = np.ascontiguousarray(np.array([(0, 0), (1, 0)], np.int32))
+        r = native.fanout_send_multi(tx.fileno(), ring, lens, one, one,
+                                     one, dests,
+                                     native.ops_from_numpy(ops), 2,
+                                     use_gso=1)
+        return r == 2
+    finally:
+        tx.close()
+        rx.close()
+
+
+GSO_OK = _gso_supported()
+
+
+def _mk_ring(n_pkts: int, sizes, seed: int = 0):
+    """A packet ring window with mixed sizes (exercises GSO run splits
+    and the io_uring arena's per-op length handling)."""
+    rng = np.random.default_rng(seed)
+    capacity, slot = 128, 512
+    ring = np.zeros((capacity, slot), np.uint8)
+    lens = np.zeros(capacity, np.int32)
+    for i in range(n_pkts):
+        size = sizes[i % len(sizes)]
+        pkt = np.zeros(size, np.uint8)
+        pkt[0], pkt[1] = 0x80, 96
+        pkt[2:4] = np.frombuffer(struct.pack(">H", i), np.uint8)
+        pkt[4:8] = np.frombuffer(struct.pack(">I", 9000 + 90 * i), np.uint8)
+        pkt[8:12] = np.frombuffer(struct.pack(">I", 0x11223344), np.uint8)
+        pkt[12:] = rng.integers(0, 256, size - 12, dtype=np.uint8)
+        ring[i, :size] = pkt
+        lens[i] = size
+    return ring, lens
+
+
+def _mk_receivers(n: int):
+    socks, addrs = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        s.setblocking(False)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 21)
+        socks.append(s)
+        addrs.append(("127.0.0.1", s.getsockname()[1]))
+    return socks, addrs
+
+
+def _drain(s: socket.socket) -> list[bytes]:
+    out = []
+    while True:
+        try:
+            out.append(s.recv(65536))
+        except BlockingIOError:
+            return out
+
+
+# --------------------------------------------------------- native level
+
+def test_native_stats_abi_tail():
+    """The fourth ABI bump: the loader's handshake accepted a 22-field
+    library and the uring tail reads as integers from field 18 on."""
+    s = native.get_stats()
+    for k in ("uring_sqes", "uring_cqes", "uring_submits",
+              "uring_zc_completions", "uring_zc_copied"):
+        assert isinstance(s[k], int)
+
+
+def test_native_uring_probe_shape():
+    """The probe returns caps (>= 0, RING bit set) or -errno — and is
+    stable across calls (cached: one throwaway ring per process)."""
+    p = native.uring_probe()
+    assert isinstance(p, int)
+    if p >= 0:
+        assert p & native.URING_CAP_RING
+    else:
+        assert -p in (errno.ENOSYS, errno.EPERM, errno.EMFILE,
+                      errno.ENOMEM)
+    assert native.uring_probe() == p
+
+
+def test_native_uring_creation_matches_probe():
+    """Creation succeeds exactly when the probe grants a ring; a refusal
+    is an OSError with the probe's errno, never a crash."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        if URING_OK:
+            ur = native.UringEgress(sock.fileno(), max_pkt=2048)
+            assert ur.active and ur.caps & native.URING_CAP_RING
+            ur.close()
+            assert not ur.active
+        else:
+            with pytest.raises(OSError):
+                native.UringEgress(sock.fileno(), max_pkt=2048)
+    finally:
+        sock.close()
+
+
+def _send_all(send, ops_np, total):
+    """Drive a send entry point to completion with bookmark-replay
+    semantics: EAGAIN returns the delivered count and the caller
+    replays the remainder — the loop every production caller runs."""
+    done = 0
+    for _ in range(64):
+        rem = np.ascontiguousarray(ops_np[done:])
+        r = send(native.ops_from_numpy(rem), total - done)
+        assert r >= 0 or -r in (errno.ENOBUFS,), r
+        if r < 0:
+            continue                      # hard stop with nothing sent
+        done += r
+        if done == total:
+            return done
+    raise AssertionError(f"send never completed: {done}/{total}")
+
+
+def test_native_wire_bytes_identical_across_backends():
+    """Byte-identical wire output across plain sendmmsg / GSO / scalar
+    (and io_uring where the kernel grants it) over real UDP sockets
+    with mixed sizes — the ladder contract: a rung changes syscall
+    shape, never bytes."""
+    n_pkts = 48
+    ring, lens = _mk_ring(n_pkts, sizes=(200, 200, 200, 61, 480))
+    socks, addrs = _mk_receivers(2)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
+    dests = native.make_dests(addrs)
+    seq_off = np.array([[7, 1000]], np.uint32)
+    ts_off = np.array([[90, 4]], np.uint32)
+    ssrc = np.array([[0xAABBCCDD, 0x01020304]], np.uint32)
+    ops_np = np.array([(slot, out) for slot in range(n_pkts)
+                       for out in (0, 1)], np.int32)
+    total = len(ops_np)
+
+    def run(send_fn):
+        _send_all(send_fn, ops_np, total)
+        return [_drain(s) for s in socks]
+
+    try:
+        base = run(lambda ops, n: native.fanout_send_multi(
+            send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
+            dests, ops, n, use_gso=0))
+        assert sum(len(b) for b in base) == total
+        modes = ([1] if GSO_OK else []) + [2]   # GSO rung, scalar rung
+        for mode in modes:
+            got = run(lambda ops, n, m=mode: native.fanout_send_multi(
+                send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
+                dests, ops, n, use_gso=m))
+            assert got == base, f"mode {mode} diverged from plain sendmmsg"
+        if URING_OK:
+            ur = native.UringEgress(send_sock.fileno(), max_pkt=512)
+            got = run(lambda ops, n: ur.send_multi(
+                ring, lens, seq_off, ts_off, ssrc, dests, ops, n))
+            ur.close()
+            assert got == base, "io_uring diverged from plain sendmmsg"
+    finally:
+        send_sock.close()
+        for s in socks:
+            s.close()
+
+
+def test_native_eagain_bookmark_replay_parity():
+    """Injected EAGAIN (the real kernel error path, csrc fault knobs):
+    every rung stops with the delivered count, last_send_errno reads
+    EAGAIN, and replaying from the bookmark delivers the identical
+    byte stream with zero duplicates.
+
+    The fault fires every 2nd SEND CALL, so each rung gets an op list
+    long enough to span at least two of its internal calls (sendmmsg
+    batches 512 ops/call, the io_uring chain is its queue depth, GSO
+    flushes 64 supers, scalar is one call per datagram)."""
+    n_pkts = 32
+    ring, lens = _mk_ring(n_pkts, sizes=(128, 96))
+    socks, addrs = _mk_receivers(1)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
+    dests = native.make_dests(addrs)
+    seq_off = np.array([[3]], np.uint32)
+    ts_off = np.array([[1]], np.uint32)
+    ssrc = np.array([[0x55667788]], np.uint32)
+
+    def ops_list(n_ops):
+        return np.array([(i % n_pkts, 0) for i in range(n_ops)], np.int32)
+
+    def replay(send, ops_np, n_ops):
+        done = 0
+        saw_eagain = False
+        for _ in range(4096):
+            rem = np.ascontiguousarray(ops_np[done:])
+            r = send(native.ops_from_numpy(rem), n_ops - done)
+            assert r >= 0
+            if r < n_ops - done:
+                assert native.last_send_errno() == errno.EAGAIN
+                saw_eagain = True
+            done += r
+            if done == n_ops:
+                return saw_eagain
+        raise AssertionError(f"replay never completed: {done}/{n_ops}")
+
+    rungs = [("sendmmsg", 0, 600), ("scalar", 2, 24)]
+    if GSO_OK:
+        rungs.insert(1, ("gso", 1, 3200))
+    try:
+        base = native.get_stats()
+        for name, mode, n_ops in rungs:
+            ops_np = ops_list(n_ops)
+            r = native.fanout_send_multi(      # oracle: clean run
+                send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
+                dests, native.ops_from_numpy(ops_np), n_ops,
+                use_gso=mode)
+            assert r == n_ops
+            oracle = _drain(socks[0])
+            assert len(oracle) == n_ops
+            native.fault_set(2, 0, 0, 0)   # every 2nd send call → EAGAIN
+            saw = replay(lambda ops, n, m=mode: native.fanout_send_multi(
+                send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
+                dests, ops, n, use_gso=m), ops_np, n_ops)
+            native.fault_clear()
+            assert saw, f"{name}: fault schedule never hit a send call"
+            assert _drain(socks[0]) == oracle, f"{name} replay diverged"
+        if URING_OK:
+            ur = native.UringEgress(send_sock.fileno(), max_pkt=512)
+            n_ops = 600                     # > one chain (queue depth)
+            ops_np = ops_list(n_ops)
+            r = ur.send_multi(ring, lens, seq_off, ts_off, ssrc, dests,
+                              native.ops_from_numpy(ops_np), n_ops)
+            assert r == n_ops
+            oracle = _drain(socks[0])
+            native.fault_set(2, 0, 0, 0)
+            saw = replay(lambda ops, n: ur.send_multi(
+                ring, lens, seq_off, ts_off, ssrc, dests, ops, n),
+                ops_np, n_ops)
+            native.fault_clear()
+            ur.close()
+            assert saw
+            assert _drain(socks[0]) == oracle, "io_uring replay diverged"
+        # injected stops counted as real EAGAIN stops, never hard
+        s = native.get_stats()
+        assert s["eagain_stops"] > base["eagain_stops"]
+        assert s["hard_errors"] == base["hard_errors"]
+        assert s["fault_injections"] > base["fault_injections"]
+    finally:
+        native.fault_clear()
+        send_sock.close()
+        socks[0].close()
+
+
+def test_native_enobufs_hard_contract():
+    """Injected ENOBUFS takes the hard-error path on every rung: a
+    whole-batch failure returns -ENOBUFS with nothing sent, the hard
+    counter ticks, and the EAGAIN counter does not."""
+    n_pkts = 8
+    ring, lens = _mk_ring(n_pkts, sizes=(100,))
+    socks, addrs = _mk_receivers(1)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    dests = native.make_dests(addrs)
+    seq_off = np.array([[0]], np.uint32)
+    ts_off = np.array([[0]], np.uint32)
+    ssrc = np.array([[1]], np.uint32)
+    ops_np = np.array([(slot, 0) for slot in range(n_pkts)], np.int32)
+    try:
+        senders = [lambda m=m: native.fanout_send_multi(
+            send_sock.fileno(), ring, lens, seq_off, ts_off, ssrc,
+            dests, native.ops_from_numpy(ops_np), n_pkts, use_gso=m)
+            for m in [0] + ([1] if GSO_OK else []) + [2]]
+        ur = None
+        if URING_OK:
+            ur = native.UringEgress(send_sock.fileno(), max_pkt=512)
+            senders.append(lambda: ur.send_multi(
+                ring, lens, seq_off, ts_off, ssrc, dests,
+                native.ops_from_numpy(ops_np), n_pkts))
+        for send in senders:
+            base = native.get_stats()
+            native.fault_set(0, 1, 0, 0)   # every send call → ENOBUFS
+            r = send()
+            native.fault_clear()
+            assert r == -errno.ENOBUFS, r
+            s = native.get_stats()
+            assert s["hard_errors"] == base["hard_errors"] + 1
+            assert s["eagain_stops"] == base["eagain_stops"]
+            _drain(socks[0])
+        if ur is not None:
+            ur.close()
+    finally:
+        native.fault_clear()
+        send_sock.close()
+        socks[0].close()
+
+
+@pytest.mark.skipif(not URING_OK, reason="kernel lacks io_uring")
+def test_native_uring_fault_reaches_cqe_path():
+    """The chaos knobs must reach the io_uring completion path: an
+    injected EAGAIN surfaces through the same partial-return +
+    last_send_errno contract a real CQE -EAGAIN would."""
+    n_pkts = 16
+    ring, lens = _mk_ring(n_pkts, sizes=(120,))
+    socks, addrs = _mk_receivers(1)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    dests = native.make_dests(addrs)
+    one = np.array([[0]], np.uint32)
+    ops_np = np.array([(slot, 0) for slot in range(n_pkts)], np.int32)
+    ur = native.UringEgress(send_sock.fileno(), max_pkt=512)
+    try:
+        base = native.get_stats()
+        native.fault_set(1, 0, 0, 0)       # every send call → EAGAIN
+        r = ur.send_multi(ring, lens, one, one, one, dests,
+                          native.ops_from_numpy(ops_np), n_pkts)
+        native.fault_clear()
+        assert r == 0
+        assert native.last_send_errno() == errno.EAGAIN
+        s = native.get_stats()
+        assert s["fault_injections"] > base["fault_injections"]
+        assert s["eagain_stops"] > base["eagain_stops"]
+    finally:
+        native.fault_clear()
+        ur.close()
+        send_sock.close()
+        socks[0].close()
+
+
+# ------------------------------------------------------- engine/server
+
+def _engine_pass(backend: str, addrs, send_sock, uring=None, *,
+                 n_outputs: int = 8, n_pkts: int = 40):
+    """One deterministic engine pass: fresh stream, seeded outputs,
+    mixed-size window, one step.  Returns nothing — the receivers hold
+    the wire bytes."""
+    from easydarwin_tpu.protocol import sdp
+    from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+    from easydarwin_tpu.relay.output import CollectingOutput
+    from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+
+    sdp_txt = ("v=0\r\ns=b\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+               "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+    st = RelayStream(sdp.parse(sdp_txt).streams[0],
+                     StreamSettings(bucket_delay_ms=0))
+    rng = np.random.default_rng(5)
+    for i in range(n_outputs):
+        o = CollectingOutput(ssrc=int(rng.integers(0, 2**32)),
+                             out_seq_start=int(rng.integers(0, 2**16)))
+        o.native_addr = addrs[i % len(addrs)]
+        st.add_output(o)
+    body = rng.integers(0, 256, 512, dtype=np.uint8).tobytes()
+    for i in range(n_pkts):
+        size = (200, 200, 61, 480)[i % 4]
+        pkt = (bytes([0x80, 96]) + struct.pack(">HII", i, 90 * i, 0x42)
+               + body[:size - 12])
+        st.push_rtp(pkt, 0)
+    eng = TpuFanoutEngine(egress_fd=send_sock.fileno(),
+                          egress_backend=backend, uring=uring)
+    sent = eng.step(st, 10_000)
+    assert sent == n_outputs * n_pkts
+    return eng
+
+
+def test_engine_wire_bytes_identical_across_backends():
+    """The live engine serves byte-identical wire output from every
+    rung of the ladder (io_uring compared too when the kernel grants
+    it) — per-destination order over real UDP sockets, mixed sizes."""
+    socks, addrs = _mk_receivers(4)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    send_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 22)
+    try:
+        _engine_pass("gso", addrs, send_sock)
+        base = [_drain(s) for s in socks]
+        assert sum(len(b) for b in base) == 8 * 40
+        _engine_pass("scalar", addrs, send_sock)
+        assert [_drain(s) for s in socks] == base
+        if URING_OK:
+            ur = native.UringEgress(send_sock.fileno(), max_pkt=2048)
+            _engine_pass("io_uring", addrs, send_sock, uring=ur)
+            ur.close()
+            assert [_drain(s) for s in socks] == base
+    finally:
+        send_sock.close()
+        for s in socks:
+            s.close()
+
+
+def test_engine_uring_strikes_fall_back_with_one_event():
+    """Two whole-batch io_uring failures while the sendmmsg rung works
+    retire the backend for the engine with EXACTLY ONE structured
+    egress.backend_fallback event and a fallback counter tick — and
+    zero counted hard send errors (probe-outcome semantics)."""
+    from easydarwin_tpu import obs
+
+    class _BrokenUring:
+        active = True
+
+        def send_multi(self, *a, **kw):
+            return -errno.ENOSYS
+
+    socks, addrs = _mk_receivers(2)
+    send_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    fallbacks0 = obs.EGRESS_BACKEND_FALLBACKS._values.get(("io_uring",), 0)
+    ev0 = sum(1 for r in obs.EVENTS.tail(4096)
+              if r["event"] == "egress.backend_fallback")
+    hard0 = native.get_stats()["hard_errors"]
+    try:
+        from easydarwin_tpu.protocol import sdp
+        from easydarwin_tpu.relay.fanout import TpuFanoutEngine
+        from easydarwin_tpu.relay.output import CollectingOutput
+        from easydarwin_tpu.relay.stream import RelayStream, StreamSettings
+        sdp_txt = ("v=0\r\ns=b\r\nt=0 0\r\nm=video 0 RTP/AVP 96\r\n"
+                   "a=rtpmap:96 H264/90000\r\na=control:trackID=1\r\n")
+        st = RelayStream(sdp.parse(sdp_txt).streams[0],
+                         StreamSettings(bucket_delay_ms=0))
+        for i in range(2):
+            o = CollectingOutput(ssrc=i + 1, out_seq_start=0)
+            o.native_addr = addrs[i]
+            st.add_output(o)
+        st.push_rtp(bytes([0x80, 96]) + bytes(10) + bytes(50), 0)
+        eng = TpuFanoutEngine(egress_fd=send_sock.fileno(),
+                              egress_backend="io_uring",
+                              uring=_BrokenUring())
+        assert eng.effective_backend() == "io_uring"
+        for o in st.buckets[0]:
+            o.bookmark = None
+        eng.step(st, 10_000)                # strike 1 (gso delivered)
+        assert not eng._uring_disabled
+        for o in st.buckets[0]:
+            o.bookmark = st.rtp_ring.tail
+        eng.step(st, 10_000)                # strike 2: retire io_uring
+        assert eng._uring_disabled
+        assert eng.effective_backend() == "gso"
+        for o in st.buckets[0]:
+            o.bookmark = st.rtp_ring.tail
+        eng.step(st, 10_000)                # steady state: gso, no event
+        evs = [r for r in obs.EVENTS.tail(4096)
+               if r["event"] == "egress.backend_fallback"]
+        assert len(evs) == ev0 + 1
+        assert evs[-1]["backend"] == "io_uring"
+        assert evs[-1]["fallback"] == "gso"
+        assert obs.EGRESS_BACKEND_FALLBACKS._values[("io_uring",)] \
+            == fallbacks0 + 1
+        assert native.get_stats()["hard_errors"] == hard0
+    finally:
+        send_sock.close()
+        for s in socks:
+            s.close()
+
+
+async def test_server_probe_ladder_falls_back_cleanly():
+    """A forced-but-unavailable io_uring boots onto the GSO rung: the
+    effective backend reads gso in the info gauge, ONE structured
+    fallback event fires, and no hard_errors are counted.  (On an
+    io_uring-capable kernel the forced backend sticks instead.)"""
+    from easydarwin_tpu import obs
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+
+    ev0 = sum(1 for r in obs.EVENTS.tail(4096)
+              if r["event"] == "egress.backend_fallback")
+    hard0 = native.get_stats()["hard_errors"]
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False,
+                       egress_backend="io_uring")
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        if URING_OK:
+            assert app.egress_backend_effective == "io_uring"
+            assert app.uring_egress is not None
+            assert obs.EGRESS_BACKEND_INFO._values[("io_uring",)] == 1
+        else:
+            assert app.egress_backend_effective == "gso"
+            assert app.uring_egress is None
+            assert obs.EGRESS_BACKEND_INFO._values[("gso",)] == 1
+            assert obs.EGRESS_BACKEND_INFO._values[("io_uring",)] == 0
+            evs = [r for r in obs.EVENTS.tail(4096)
+                   if r["event"] == "egress.backend_fallback"]
+            assert len(evs) == ev0 + 1
+            assert evs[-1]["reason"] in ("ENOSYS", "EPERM")
+        assert native.get_stats()["hard_errors"] == hard0
+    finally:
+        await app.stop()
+
+
+async def test_server_scalar_backend_forced():
+    from easydarwin_tpu import obs
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       access_log_enabled=False, egress_backend="scalar")
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        assert app.egress_backend_effective == "scalar"
+        assert obs.EGRESS_BACKEND_INFO._values[("scalar",)] == 1
+    finally:
+        await app.stop()
+
+
+def test_config_backend_validation():
+    from easydarwin_tpu.server import ServerConfig
+    for good in ("auto", "io_uring", "gso", "scalar", " GSO "):
+        assert ServerConfig(
+            egress_backend=good).egress_backend_choice() == good.strip().lower()
+    with pytest.raises(ValueError):
+        ServerConfig(egress_backend="epoll").egress_backend_choice()
+
+
+# ----------------------------------------------------- tooling contracts
+
+def test_metrics_lint_egress_backend_contract():
+    from easydarwin_tpu import obs
+    from easydarwin_tpu.obs import events as ev
+    from tools.metrics_lint import lint_egress_backends
+    assert lint_egress_backends(obs.REGISTRY, ev.SCHEMA) == []
+
+
+def test_bench_gate_accepts_and_rejects_egress_backends():
+    from tools.bench_gate import check_trajectory
+
+    def entry(eb):
+        return {"file": "BENCH_rT.json", "rc": 0, "parsed": {
+            "metric": "m", "value": 1000.0, "unit": "p/s",
+            "vs_baseline": 1.0, "extra": {"egress_backends": eb}}}
+
+    ok = entry({"backends": {"gso": 65000.0, "scalar": 8000.0},
+                "effective": "gso", "probe_errno": "ENOSYS"})
+    assert check_trajectory([ok]) == []
+    # a round predating the section stays valid
+    assert check_trajectory([entry({})]) == []
+    errs = check_trajectory([entry({"backends": {"gso": -1.0},
+                                    "effective": "gso"})])
+    assert any("positive finite rate" in e for e in errs)
+    errs = check_trajectory([entry({"backends": {"epoll": 10.0},
+                                    "effective": "gso"})])
+    assert any("closed ladder" in e for e in errs)
+    errs = check_trajectory([entry({"backends": {"io_uring": 10.0},
+                                    "effective": "io_uring"})])
+    assert any("probe_caps" in e for e in errs)
+
+
+def test_soak_forced_backend_and_zerocopy_checks():
+    from tools.soak import check_metrics
+    base = {
+        'relay_ingest_to_wire_seconds_count{engine="native"}': 10.0,
+        'relay_phase_seconds_count{engine="native",'
+        'phase="egress_native"}': 10.0,
+    }
+    # forced backend matches the effective gauge → clean
+    ok = dict(base)
+    ok['egress_backend_info{backend="io_uring"}'] = 1.0
+    ok['io_uring_zerocopy_completions_total'] = 5.0
+    ok['io_uring_zerocopy_copied_total'] = 5.0
+    assert not [e for e in check_metrics([ok], forced_backend="io_uring")
+                if "egress backend" in e or "zerocopy" in e]
+    # forced io_uring while gso serves → failure
+    bad = dict(base)
+    bad['egress_backend_info{backend="gso"}'] = 1.0
+    bad['egress_backend_info{backend="io_uring"}'] = 0.0
+    errs = check_metrics([bad], forced_backend="io_uring")
+    assert any("forced egress backend" in e for e in errs)
+    # zerocopy completions with hidden copy verdicts → failure
+    hidden = dict(ok)
+    hidden['io_uring_zerocopy_copied_total'] = 0.0
+    errs = check_metrics([hidden], forced_backend="io_uring")
+    assert any("zerocopy" in e for e in errs)

@@ -158,6 +158,36 @@ def check_trajectory(traj: list[dict],
                         errs.append(f"{name}: multichip device phase "
                                     f"{ph!r} outside the closed set "
                                     f"{MESH_PHASES}")
+        # ISSUE 8 egress-backend section — OPTIONAL (rounds predating
+        # the io_uring backend stay valid), but when present: backend
+        # names stay inside the closed ladder vocabulary, every
+        # recorded rate is positive finite, and an io_uring rate
+        # requires the probe to have granted the capability (a rate
+        # without the caps means the section lied about what ran)
+        eb = extra.get("egress_backends")
+        if isinstance(eb, dict) and eb and "error" not in eb:
+            known = ("io_uring", "gso", "scalar")
+            rates = eb.get("backends")
+            if not isinstance(rates, dict) or not rates:
+                errs.append(f"{name}: egress_backends.backends missing "
+                            "or empty")
+            else:
+                for b, v2 in rates.items():
+                    if b not in known:
+                        errs.append(f"{name}: egress backend {b!r} "
+                                    f"outside the closed ladder {known}")
+                    if not isinstance(v2, (int, float)) \
+                            or not math.isfinite(v2) or v2 <= 0:
+                        errs.append(f"{name}: egress_backends.backends"
+                                    f"[{b!r}] {v2!r} not a positive "
+                                    "finite rate")
+                if "io_uring" in rates and "probe_caps" not in eb:
+                    errs.append(f"{name}: io_uring rate recorded without "
+                                "probe_caps (backend ran unprobed?)")
+            eff = eb.get("effective")
+            if eff is not None and eff not in known:
+                errs.append(f"{name}: egress_backends.effective {eff!r} "
+                            f"outside the closed ladder {known}")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

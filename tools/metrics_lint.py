@@ -41,9 +41,11 @@ import sys
 
 NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
 #: ``_level`` is the degradation-ladder rung index (resilience/ladder.py)
-#: — a dimensionless ordinal, the same way ``_count`` is
+#: — a dimensionless ordinal, the same way ``_count`` is; ``_info`` is
+#: the Prometheus info-metric convention (a constant-1 gauge whose
+#: labels carry the payload — egress_backend_info)
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count",
-                 "_level")
+                 "_level", "_info")
 
 EVENT_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 #: emit("event.name", ...) — the positional literal, plain or f-string
@@ -204,6 +206,67 @@ def lint_megabatch_devices(registry) -> list[str]:
                             f"{phase!r} is in MESH_PHASES but missing "
                             "from obs.profile.PHASES (vocabularies out "
                             "of sync)")
+    return errs
+
+
+#: the closed effective-backend vocabulary (relay/fanout.py
+#: EGRESS_BACKENDS minus "auto" — a REQUEST, never an effective rung);
+#: an open set would shard egress_backend_info per typo and break the
+#: forced-backend soak's equality assertion
+EGRESS_BACKEND_LABELS = ("io_uring", "gso", "scalar")
+
+
+def lint_egress_backends(registry, schema: dict) -> list[str]:
+    """The egress-backend contract (ISSUE 8): the probe-ladder families
+    exist with their exact label sets, every observed ``backend`` label
+    stays inside the closed rung vocabulary, the
+    ``egress.backend_fallback`` event is declared (soak --egress-backend
+    and the fallback tests key on it), the backend-labelled egress phase
+    is in the closed PHASES vocabulary, and the config-side ladder
+    agrees with the lint's."""
+    errs: list[str] = []
+    want_labels = {
+        "egress_backend_info": ("backend",),
+        "egress_backend_fallbacks_total": ("backend",),
+        "io_uring_sqe_total": (),
+        "io_uring_cqe_total": (),
+        "io_uring_submit_calls_total": (),
+        "io_uring_zerocopy_completions_total": (),
+        "io_uring_zerocopy_copied_total": (),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"egress backend family {fam_name} missing from "
+                        "the registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    for fam_name in ("egress_backend_info",
+                     "egress_backend_fallbacks_total"):
+        for key in getattr(fams.get(fam_name), "_values", {}):
+            if key and key[0] not in EGRESS_BACKEND_LABELS:
+                errs.append(f"{fam_name}: observed backend {key[0]!r} "
+                            f"outside the closed set "
+                            f"{EGRESS_BACKEND_LABELS}")
+    if "egress.backend_fallback" not in schema:
+        errs.append("event egress.backend_fallback missing from SCHEMA")
+    from easydarwin_tpu.obs.profile import PHASES
+    if "egress_io_uring" not in PHASES:
+        errs.append("phase 'egress_io_uring' missing from "
+                    "obs.profile.PHASES")
+    from easydarwin_tpu.relay.fanout import EGRESS_BACKENDS
+    for b in EGRESS_BACKEND_LABELS:
+        if b not in EGRESS_BACKENDS:
+            errs.append(f"backend {b!r} missing from the config-side "
+                        "EGRESS_BACKENDS ladder")
+    if "auto" not in EGRESS_BACKENDS:
+        errs.append("'auto' missing from the config-side EGRESS_BACKENDS "
+                    "ladder")
     return errs
 
 
@@ -386,6 +449,9 @@ def main() -> int:
     # the cluster tier's vocabulary (ISSUE 6): lease/placement/pull/
     # migration families + cluster.* events + cluster fault sites
     errs += lint_cluster(obs.REGISTRY, ev.SCHEMA)
+    # the egress-backend ladder's vocabulary (ISSUE 8): probe families,
+    # closed backend labels, the fallback event, the io_uring phase
+    errs += lint_egress_backends(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

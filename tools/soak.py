@@ -95,7 +95,8 @@ def parse_metrics(text: str) -> dict[str, float]:
 
 def check_metrics(scrapes: list[dict[str, float]], *,
                   expect_megabatch: bool = False,
-                  chaos: bool = False) -> list[str]:
+                  chaos: bool = False,
+                  forced_backend: str | None = None) -> list[str]:
     """Counter-regression checks over the soak's periodic scrapes.
 
     ``chaos=True`` (a seeded FaultPlan was armed) skips exactly the
@@ -109,6 +110,25 @@ def check_metrics(scrapes: list[dict[str, float]], *,
     if not scrapes:
         return ["no /metrics scrapes completed"]
     last = scrapes[-1]
+    if forced_backend and forced_backend != "auto":
+        # --egress-backend X: the EFFECTIVE backend (the info gauge's
+        # active child) must be exactly the forced one — a forced
+        # io_uring that silently served from the GSO rung is a failed
+        # soak, not a degraded-but-passing one
+        key = f'egress_backend_info{{backend="{forced_backend}"}}'
+        if last.get(key, 0) != 1:
+            active = [k for k, v in last.items()
+                      if k.startswith("egress_backend_info") and v == 1]
+            errs.append(f"forced egress backend {forced_backend!r} is not "
+                        f"the effective one (active: {active or 'none'})")
+    # zerocopy honesty (any run with ZC completions): on loopback the
+    # kernel copies every "zerocopy" send — the copied counter must SAY
+    # so.  Completions with zero copies on a loopback soak means the
+    # copy verdicts are being dropped, not that zerocopy worked.
+    zc = last.get("io_uring_zerocopy_completions_total", 0)
+    if zc > 0 and last.get("io_uring_zerocopy_copied_total", 0) == 0:
+        errs.append(f"{zc:.0f} zerocopy completions but zero counted "
+                    "copies on loopback (copy verdicts hidden)")
     if chaos:
         faults = sum(v for k, v in last.items()
                      if k.startswith("fault_injected_total"))
@@ -137,7 +157,8 @@ def check_metrics(scrapes: list[dict[str, float]], *,
         errs.append(f"hard egress errors: "
                     f"{last['egress_send_errors_total']:.0f}")
     calls = last.get("egress_sendmmsg_calls_total", 0) \
-        + last.get("egress_sendto_calls_total", 0)
+        + last.get("egress_sendto_calls_total", 0) \
+        + last.get("io_uring_submit_calls_total", 0)
     eagain = last.get("egress_eagain_total", 0)
     if not chaos and calls and eagain / calls > 0.5:
         errs.append(f"EAGAIN retry ratio {eagain / calls:.2f} > 0.5 "
@@ -354,11 +375,20 @@ def _check_chaos(app, clear_time: float, t_full: float | None,
 
 
 async def soak(seconds: float, n_sources: int = 0,
-               chaos_seed: int | None = None, devices: int = 1) -> int:
+               chaos_seed: int | None = None, devices: int = 1,
+               egress_backend: str | None = None) -> int:
     chaos = chaos_seed is not None
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
                        access_log_enabled=False)
+    if egress_backend:
+        # --egress-backend X: force the rung AND run the engine paths
+        # (tpu_min_outputs=1, same shape as --chaos) so the forced
+        # backend actually carries the plain-UDP player's wire traffic
+        # — check_metrics then asserts the effective backend matches
+        cfg.egress_backend = egress_backend
+        cfg.tpu_fanout = True
+        cfg.tpu_min_outputs = 1
     if chaos:
         # chaos runs the ENGINE paths (that is what degrades): every
         # output is TPU-eligible, the megabatch engages across the
@@ -559,6 +589,22 @@ async def soak(seconds: float, n_sources: int = 0,
                 rr = struct.pack("!BBHIIIIIII", 0x81, 201, 7, 0x7A7A,
                                  tcp_out.rewrite.ssrc, 0, 0, 0, 0, 0)
                 tcp_player.send_interleaved(1, rr)
+            if f % 150 == 35:
+                # conformant plain-UDP player: periodic RR from its
+                # registered RTCP address keeps the session alive past
+                # rtsp_timeout (the silent-client reap is CORRECT server
+                # behavior; this player predates soak runs long enough
+                # to hit it — surfaced by the 120 s forced-backend run)
+                plain_out = next(
+                    cn for cn in app.rtsp.connections
+                    if cn.player_tracks
+                    and getattr(cn.player_tracks[1].output,
+                                "native_addr", None) is not None
+                    and not hasattr(cn.player_tracks[1].output,
+                                    "resender")).player_tracks[1].output
+                rr = struct.pack("!BBHIIIIIII", 0x81, 201, 7, 0x7B7B,
+                                 plain_out.rewrite.ssrc, 0, 0, 0, 0, 0)
+                udp2_rtcp.sendto(rr, ("127.0.0.1", egress.rtcp_port))
             if f % 30 == 10:           # periodic NADU (comfortable buffer)
                 from easydarwin_tpu.protocol.rtcp import Nadu, NaduBlock
                 udp_rtcp.sendto(Nadu(9, [NaduBlock(
@@ -654,7 +700,8 @@ async def soak(seconds: float, n_sources: int = 0,
             scrapes.append(parse_metrics(body.decode()))
         failures.extend(check_metrics(scrapes,
                                       expect_megabatch=n_sources >= 2,
-                                      chaos=chaos))
+                                      chaos=chaos,
+                                      forced_backend=egress_backend))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -1097,6 +1144,14 @@ def _parse_args(argv: list[str]):
                          "device CPU mesh is forced via XLA_FLAGS, and "
                          "the run fails on zero sharded passes or any "
                          "megabatch_wire_mismatch_total > 0")
+    ap.add_argument("--egress-backend", default=None,
+                    choices=("auto", "io_uring", "gso", "scalar"),
+                    metavar="BACKEND",
+                    help="force an egress backend rung (ISSUE 8) and "
+                         "fail the soak if the effective backend (from "
+                         "/metrics egress_backend_info) differs from "
+                         "the forced one, or if zerocopy completions "
+                         "hide their loopback copy verdicts")
     ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
                     metavar="SEED",
                     help="run under a seeded FaultPlan (resilience/"
@@ -1153,4 +1208,5 @@ if __name__ == "__main__":
             cluster_soak(_ns.cluster, _ns.duration,
                          _ns.chaos if _ns.chaos is not None else 7)))
     raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
-                                      _ns.chaos, _ns.devices)))
+                                      _ns.chaos, _ns.devices,
+                                      _ns.egress_backend)))
