@@ -30,13 +30,15 @@ never corrupts what it cannot parse."""
 
 from __future__ import annotations
 
+import copy
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from .h264_bits import BitReader, BitWriter, nal_to_rbsp, rbsp_to_nal
 from .h264_intra import (MacroblockI16x16, MacroblockPSkip, Pps,
-                         SliceCodec, Sps)
+                         SliceCodec, SliceHeader, Sps)
 from .h264_transform import (chroma_qp, requant_chroma_scalar,
                              requant_levels_scalar)
 
@@ -49,17 +51,28 @@ class RequantStats:
     blocks: int = 0
     bytes_in: int = 0
     bytes_out: int = 0
+    # merge() must be safe under the requant worker pool: slice jobs of
+    # one AU complete on different workers, and two lock-free read-
+    # modify-write merges into the same target can drop counts.  The
+    # discipline stays "accumulate per-worker deltas locally, merge once
+    # at AU completion", but the fold itself now holds a lock so ANY
+    # caller topology is correct, not just the loop-thread one.
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def merge(self, d: "RequantStats") -> None:
         """Fold a worker's per-AU delta in (pool path: workers requant
-        against snapshot parameter sets and never touch shared stats;
-        the owner thread merges at emit time)."""
-        self.slices_requantized += d.slices_requantized
-        self.slices_passed_through += d.slices_passed_through
-        self.native_slices += d.native_slices
-        self.blocks += d.blocks
-        self.bytes_in += d.bytes_in
-        self.bytes_out += d.bytes_out
+        against snapshot parameter sets and accumulate into LOCAL delta
+        objects; the deltas are merged into the shared stats once per
+        AU).  Thread-safe: concurrent merges into the same target
+        serialize on the target's lock."""
+        with self._lock:
+            self.slices_requantized += d.slices_requantized
+            self.slices_passed_through += d.slices_passed_through
+            self.native_slices += d.native_slices
+            self.blocks += d.blocks
+            self.bytes_in += d.bytes_in
+            self.bytes_out += d.bytes_out
 
 
 def _peek_is_p(nal: bytes) -> bool:
@@ -116,6 +129,431 @@ def device_batch_chroma(dc: np.ndarray, ac: np.ndarray,
                                qpc_out.astype(_np.int32))
     return (_np.asarray(d).astype(_np.int64),
             _np.asarray(a).astype(_np.int64))
+
+
+# ===================================================== shared-parse fan-out
+# The ABR-ladder cost model (ISSUE 9 tentpole): parse/entropy-decode a
+# slice ONCE, requantize the same parsed MB arrays to N ``delta_qp``
+# targets, and re-encode N slices — the parse (the dominant CAVLC/CABAC
+# read on the Python engines) is amortized across the whole rendition
+# ladder instead of paid per rendition.  The pieces compose:
+#
+#   parse_slice_nal()  →  ParsedSlice     (one per slice, shared)
+#   gather_slice()     →  SliceGather     (level rows + QPs, shared)
+#   FusedRequantDispatch(gathers × deltas) — ONE transform dispatch for
+#       every (slice, rendition) of an AU; with ``use_device`` the JAX
+#       call is asynchronous, so the device computes while pool workers
+#       entropy-decode the NEXT AU (the PR 4 double-buffered staging
+#       pattern at AU scale)
+#   recode_parsed()    →  bytes           (per rendition, clones the MBs)
+#
+# ``SliceRequantizer._requant_slice`` runs the SAME pipeline with a
+# single delta and no clone, so the serial path and the fan-out path are
+# one code path — byte-identity between them is structural, and the
+# differential tests pin it.
+
+
+@dataclass
+class ParsedSlice:
+    """One entropy-decoded slice: everything recode needs, engine-agnostic
+    (the MB model is shared by the CAVLC and CABAC layers)."""
+
+    nal0: int                           # original NAL header byte
+    hdr: SliceHeader
+    mbs: list
+    qp_in_base: int                     # slice-header QP (pre-shift)
+    cabac: bool
+    sps: Sps
+    pps: Pps
+
+
+@dataclass
+class SliceGather:
+    """The batched-requant surface of one parsed slice: every residual
+    row with its per-row QP, plus the write-back routing map.  Built
+    once per slice and shared read-only across renditions."""
+
+    rows: np.ndarray                    # [R, 16] luma/8x8 level rows
+    qps: np.ndarray                     # [R] absolute source QPY per row
+    row_map: list                       # (mb_index, kind, blk) per row
+    centries: list                      # mb indices with chroma residual
+    cqp: np.ndarray                     # [C] source QPY of those MBs
+    cdc: np.ndarray                     # [C*2, 4] chroma DC rows
+    cac: np.ndarray                     # [C*2, 4, 15] chroma AC rows
+    n_blocks: int                       # luma + chroma block count
+    max_qp: int                         # slice ceiling input (7.4.5 max)
+
+
+def parse_slice_nal(nal: bytes, sps: Sps, pps: Pps) -> ParsedSlice:
+    """Entropy-decode one coded-slice NAL into the shared MB model
+    (CAVLC or CABAC per the PPS).  Raises ValueError on anything outside
+    the requant profile — the caller passes the slice through."""
+    if pps.entropy_cabac:
+        from .h264_cabac import CabacSliceCodec
+        hdr, _first, mbs, _qps = CabacSliceCodec(sps, pps).parse_slice(nal)
+    else:
+        codec = SliceCodec(sps, pps)
+        br = BitReader(nal_to_rbsp(nal[1:]))
+        hdr = codec.parse_slice_header(br, nal[0])
+        mbs = codec.parse_mbs(br, hdr.qp, hdr.first_mb, hdr)
+    if pps.entropy_cabac and pps.transform_8x8_mode \
+            and hdr.first_mb + len(mbs) < sps.width_mbs * sps.height_mbs:
+        # CABAC + 8x8: a slice whose parse ends before the picture does
+        # is either a genuine multi-slice picture or a sparse-content
+        # context desync this engine still has on cat-5 streams — both
+        # must PASS THROUGH rather than emit a truncated slice
+        raise ValueError("CABAC 8x8 slice ended before picture end")
+    return ParsedSlice(nal[0], hdr, mbs, hdr.qp, pps.entropy_cabac,
+                       sps, pps)
+
+
+def gather_slice(parsed: ParsedSlice) -> SliceGather:
+    """Collect every residual row of a parsed slice with its per-MB
+    source QP (the +6k step is uniform, so the TARGET QP is derived per
+    rendition at dispatch time).  I_16x16 MBs contribute a DC row + 16
+    zero-padded 15-coeff AC rows (the op is elementwise, padding stays
+    zero); a row map routes results back to the right structure."""
+    mbs = parsed.mbs
+    all_levels = []
+    qps: list[int] = []
+    row_map: list[tuple[int, str, int]] = []
+    for i, mb in enumerate(mbs):
+        if isinstance(mb, MacroblockPSkip):
+            continue                   # no residual, nothing to shift
+        if getattr(mb, "transform_8x8", False):
+            # 8x8 levels shift by the same exact +6k step (the 8x8
+            # tables share the qp%6 periodicity); batch as 16 rows
+            all_levels.append(mb.levels8.reshape(16, 16))
+            row_map.extend((i, "l8", b) for b in range(16))
+            qps.extend([mb.qp] * 16)
+            continue
+        if isinstance(mb, MacroblockI16x16):
+            all_levels.append(mb.dc_levels[None, :])
+            row_map.append((i, "dc", 0))
+            qps.append(mb.qp)
+            ac = np.zeros((16, 16), dtype=np.int64)
+            ac[:, :15] = mb.ac_levels
+            all_levels.append(ac)
+            row_map.extend((i, "ac", b) for b in range(16))
+            qps.extend([mb.qp] * 16)
+        else:
+            all_levels.append(mb.levels)
+            row_map.extend((i, "l4", b) for b in range(16))
+            qps.extend([mb.qp] * 16)
+    if all_levels:                     # an all-skip P slice has no rows;
+        # its header QP still shifts (deblocking strength follows the
+        # slice QP even for skipped MBs)
+        rows = np.concatenate(all_levels, axis=0)
+    else:
+        rows = np.zeros((0, 16), dtype=np.int64)
+    n_blocks = rows.shape[0]
+
+    centries = [i for i, mb in enumerate(mbs) if mb.chroma_cbp]
+    if centries:
+        cdc = np.stack([mbs[i].chroma_dc for i in centries]).reshape(-1, 4)
+        cac = np.stack([mbs[i].chroma_ac
+                        for i in centries]).reshape(-1, 4, 15)
+        cqp = np.array([mbs[i].qp for i in centries], dtype=np.int64)
+        n_blocks += 8 * len(centries)
+    else:
+        cdc = np.zeros((0, 4), dtype=np.int64)
+        cac = np.zeros((0, 4, 15), dtype=np.int64)
+        cqp = np.zeros((0,), dtype=np.int64)
+    return SliceGather(rows, np.asarray(qps, dtype=np.int64), row_map,
+                       centries, cqp, cdc, cac, n_blocks,
+                       max((mb.qp for mb in mbs
+                            if not isinstance(mb, MacroblockPSkip)),
+                           default=parsed.qp_in_base))
+
+
+def _device_rows_async(levels: np.ndarray, qp_in: np.ndarray,
+                       qp_out: np.ndarray):
+    """Luma dispatch WITHOUT the host sync: returns the JAX array so the
+    device computes behind the caller (harvest converts)."""
+    from ..ops.transform import h264_requant
+    return h264_requant(levels.astype(np.int32), qp_in.astype(np.int32),
+                        qp_out.astype(np.int32))
+
+
+def _device_chroma_async(dc: np.ndarray, ac: np.ndarray,
+                         qpc_in: np.ndarray, qpc_out: np.ndarray):
+    from ..ops.transform import h264_requant_chroma
+    return h264_requant_chroma(dc.astype(np.int32), ac.astype(np.int32),
+                               qpc_in.astype(np.int32),
+                               qpc_out.astype(np.int32))
+
+
+class FusedRequantDispatch:
+    """ONE transform dispatch covering every (slice, rendition) pair of
+    an access unit (tentpole c): the luma rows and chroma rows of all
+    gathers are tiled across the delta axis and requantized in a single
+    fused call.  With ``use_device=True`` the dispatch goes through the
+    asynchronous JAX op — the device computes while the pool's other
+    workers entropy-decode the next slices/AU, and ``harvest`` blocks
+    only on arrival (PR 4's dispatch-ahead/harvest-behind staging shape,
+    here at AU scale).  Bit-exact vs per-slice-per-delta calls: the op
+    is elementwise per row, so tiling never changes values."""
+
+    def __init__(self, gathers: "list[SliceGather]",
+                 deltas: "tuple[int, ...]", *, requant_fn=None,
+                 chroma_fn=None, chroma_qp_offset: int = 0,
+                 use_device: bool = False):
+        self.deltas = tuple(deltas)
+        self._lock = threading.Lock()
+        self._np_rows = None
+        self._np_chroma = None
+        # a delta every slice of this batch would reject at the QP-51
+        # ceiling is excluded from the tile entirely — a permanently
+        # over-ceiling rung must not tax every AU of the stream with
+        # transform work recode_parsed then discards.  (A delta only
+        # SOME slices reject stays tiled: its under-ceiling slices
+        # still consume their rows.)
+        floor_qp = min((g.max_qp for g in gathers), default=0)
+        self._tile_pos = {}
+        for i, d in enumerate(self.deltas):
+            if floor_qp + d <= 51:
+                self._tile_pos[i] = len(self._tile_pos)
+        active = [self.deltas[i] for i in sorted(self._tile_pos)]
+        nd = len(active)
+        self._offsets = np.cumsum([0] + [g.rows.shape[0]
+                                         for g in gathers])
+        self._coffsets = np.cumsum([0] + [len(g.centries)
+                                          for g in gathers])
+        r_total = int(self._offsets[-1])
+        c_total = int(self._coffsets[-1])
+        self._r_total, self._c_total = r_total, c_total
+        self._pending_rows = None
+        self._pending_chroma = None
+        if r_total and nd:
+            rows = np.concatenate([g.rows for g in gathers], axis=0)
+            qps = np.concatenate([g.qps for g in gathers])
+            batch = np.tile(rows, (nd, 1))
+            qp_in = np.tile(qps, nd)
+            qp_out = np.concatenate([qps + d for d in active])
+            fn = _device_rows_async if use_device \
+                else (requant_fn or _scalar_batch)
+            self._pending_rows = fn(batch, qp_in, qp_out)
+        if c_total and nd:
+            cdc = np.concatenate([g.cdc for g in gathers], axis=0)
+            cac = np.concatenate([g.cac for g in gathers], axis=0)
+            cqp = np.concatenate([g.cqp for g in gathers])
+            qin = np.array([chroma_qp(int(q), chroma_qp_offset)
+                            for q in cqp], dtype=np.int64)
+            dc_t = np.tile(cdc, (nd, 1))
+            ac_t = np.tile(cac, (nd, 1, 1))
+            qin_t = np.repeat(np.tile(qin, nd), 2)
+            qout_t = np.repeat(np.concatenate(
+                [np.array([chroma_qp(int(q) + d, chroma_qp_offset)
+                           for q in cqp], dtype=np.int64)
+                 for d in active]), 2)
+            cfn = _device_chroma_async if use_device \
+                else (chroma_fn or _scalar_batch_chroma)
+            self._pending_chroma = cfn(dc_t, ac_t, qin_t, qout_t)
+
+    def _harvested(self):
+        """Block (once) on the fused results and cache the numpy views."""
+        with self._lock:
+            if self._np_rows is None:
+                if self._pending_rows is not None:
+                    self._np_rows = np.asarray(
+                        self._pending_rows).astype(np.int64)
+                else:
+                    self._np_rows = np.zeros((0, 16), dtype=np.int64)
+                if self._pending_chroma is not None:
+                    d, a = self._pending_chroma
+                    self._np_chroma = (np.asarray(d).astype(np.int64),
+                                       np.asarray(a).astype(np.int64))
+                else:
+                    self._np_chroma = (
+                        np.zeros((0, 4), dtype=np.int64),
+                        np.zeros((0, 4, 15), dtype=np.int64))
+                self._pending_rows = self._pending_chroma = None
+        return self._np_rows, self._np_chroma
+
+    def _pos(self, delta_idx: int) -> int:
+        pos = self._tile_pos.get(delta_idx)
+        if pos is None:
+            # unreachable through recode_parsed (its ceiling check
+            # raises first), kept as the same contract for any caller
+            raise ValueError("qp already at ladder ceiling")
+        return pos
+
+    def luma_rows(self, slice_idx: int, delta_idx: int) -> np.ndarray:
+        rows, _ = self._harvested()
+        base = self._pos(delta_idx) * self._r_total
+        return rows[base + int(self._offsets[slice_idx]):
+                    base + int(self._offsets[slice_idx + 1])]
+
+    def chroma_rows(self, slice_idx: int, delta_idx: int
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        _, (d, a) = self._harvested()
+        lo = 2 * (self._pos(delta_idx) * self._c_total
+                  + int(self._coffsets[slice_idx]))
+        hi = 2 * (self._pos(delta_idx) * self._c_total
+                  + int(self._coffsets[slice_idx + 1]))
+        return (d[lo:hi].reshape(-1, 2, 4),
+                a[lo:hi].reshape(-1, 2, 4, 15))
+
+
+def _clone_mb(mb):
+    """Copy one parsed MB so a rendition's requant write-back never
+    touches the shared parse (arrays the recode mutates are copied;
+    verbatim-carried syntax — pred modes, motion — is shared)."""
+    if isinstance(mb, MacroblockPSkip):
+        return mb                       # stateless marker
+    c = copy.copy(mb)
+    for f in ("levels", "levels8", "dc_levels", "ac_levels",
+              "chroma_dc", "chroma_ac"):
+        v = getattr(c, f, None)
+        if isinstance(v, np.ndarray):
+            setattr(c, f, v.copy())
+    return c
+
+
+def _writeback_rows(mbs: list, gather: SliceGather,
+                    requanted: np.ndarray,
+                    cdc2: np.ndarray, cac2: np.ndarray) -> None:
+    """Route fused-requant rows back into the MB structures (the inverse
+    of ``gather_slice``'s flattening)."""
+    for r, (i, kind, b) in enumerate(gather.row_map):
+        mb = mbs[i]
+        if kind == "dc":
+            mb.dc_levels = requanted[r]
+        elif kind == "ac":
+            mb.ac_levels[b] = requanted[r, :15]
+        elif kind == "l8":
+            mb.levels8[b >> 2, (b & 3) * 16:(b & 3) * 16 + 16] = \
+                requanted[r]
+        else:
+            mb.levels[b] = requanted[r]
+    for j, i in enumerate(gather.centries):
+        mbs[i].chroma_dc = cdc2[j]
+        mbs[i].chroma_ac = cac2[j]
+
+
+def _finalize_mbs(mbs: list, delta_qp: int) -> None:
+    """Recompute CBP/CBP-equivalents from the requanted levels and shift
+    every MB's absolute QP; the writer re-derives deltas vs the previous
+    CODED MB, so a cleared-CBP MB's QP correctly stops influencing the
+    chain."""
+    for mb in mbs:
+        if isinstance(mb, MacroblockPSkip):
+            continue
+        ccbp = (2 if np.any(mb.chroma_ac) else
+                1 if np.any(mb.chroma_dc) else 0)
+        if isinstance(mb, MacroblockI16x16):
+            mb.luma_cbp15 = bool(np.any(mb.ac_levels))
+            mb.chroma_cbp = ccbp
+        elif getattr(mb, "transform_8x8", False):
+            cbp = 0
+            for g in range(4):
+                if np.any(mb.levels8[g]):
+                    cbp |= 1 << g
+            mb.cbp = cbp | (ccbp << 4)
+        else:                          # I_NxN and inter share the CBP
+            cbp = 0                    # recompute shape
+            for g in range(4):
+                if np.any(mb.levels[4 * g:4 * g + 4]):
+                    cbp |= 1 << g
+            mb.cbp = cbp | (ccbp << 4)
+        mb.qp = mb.qp + delta_qp
+
+
+def _write_slice_bytes(parsed: ParsedSlice, mbs: list,
+                       qp_out_base: int) -> bytes:
+    """Serialize the requanted MBs back into a slice NAL (fresh codec
+    per call: the writers are stateless beyond SPS/PPS, so renditions
+    recode concurrently)."""
+    if parsed.cabac:
+        from .h264_cabac import CabacSliceCodec
+        return CabacSliceCodec(parsed.sps, parsed.pps).write_slice(
+            parsed.hdr, parsed.hdr.first_mb, mbs, qp_out_base)
+    codec = SliceCodec(parsed.sps, parsed.pps)
+    bw = BitWriter()
+    codec.write_slice_header(bw, parsed.hdr, qp_out_base)
+    codec.write_mbs(bw, mbs, qp_out_base, parsed.hdr.first_mb,
+                    parsed.hdr)
+    bw.rbsp_trailing()
+    return bytes([parsed.nal0]) + rbsp_to_nal(bw.to_bytes())
+
+
+def _check_ceiling(parsed: ParsedSlice, delta_qp: int) -> None:
+    # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5): the
+    # ceiling check covers the true per-MB maxima; P_Skip MBs carry no QP
+    if max((mb.qp for mb in parsed.mbs
+            if not isinstance(mb, MacroblockPSkip)),
+           default=parsed.qp_in_base) + delta_qp > 51:
+        raise ValueError("qp already at ladder ceiling")
+
+
+def recode_parsed(parsed: ParsedSlice, gather: SliceGather,
+                  dispatch: FusedRequantDispatch, slice_idx: int,
+                  delta_idx: int, *, clone: bool = True
+                  ) -> tuple[bytes, int]:
+    """One rendition's serial entropy re-encode over the shared parse:
+    clone the MB arrays, write the fused-requant rows back, recompute
+    CBP + the shifted QP chain, and serialize.  Raises ValueError when
+    this rendition's target QP would pass the ladder ceiling (the caller
+    passes the slice through for THAT rendition only)."""
+    delta_qp = dispatch.deltas[delta_idx]
+    if gather.max_qp + delta_qp > 51:    # == _check_ceiling, O(1): the
+        # gather already carries the slice's per-MB QP maximum
+        raise ValueError("qp already at ladder ceiling")
+    mbs = [_clone_mb(mb) for mb in parsed.mbs] if clone else parsed.mbs
+    requanted = dispatch.luma_rows(slice_idx, delta_idx)
+    cdc2, cac2 = dispatch.chroma_rows(slice_idx, delta_idx)
+    _writeback_rows(mbs, gather, requanted, cdc2, cac2)
+    _finalize_mbs(mbs, delta_qp)
+    return (_write_slice_bytes(parsed, mbs,
+                               parsed.qp_in_base + delta_qp),
+            gather.n_blocks)
+
+
+def requant_multi(nal: bytes, sps: Sps | None, pps: Pps | None,
+                  deltas: "tuple[int, ...]", *, requant_fn=None,
+                  chroma_fn=None, use_device: bool = False
+                  ) -> "list[tuple[bytes, RequantStats]]":
+    """Shared-parse rendition fan-out over one NAL: parse once, requant
+    + recode to every ``delta_qp`` in ``deltas`` with ONE fused
+    transform dispatch.  Returns (output, stats delta) per rendition —
+    stateless, so pool workers run slices of the same stream
+    concurrently.  Output is byte-identical to N independent
+    ``SliceRequantizer``s with the same engine config (pinned by
+    tests/test_requant_ladder.py)."""
+    t = nal[0] & 0x1F
+    if t not in (1, 5) or sps is None or pps is None:
+        return [(nal, RequantStats()) for _ in deltas]
+    try:
+        parsed = parse_slice_nal(nal, sps, pps)
+        gather = gather_slice(parsed)
+    except (ValueError, EOFError, KeyError, IndexError):
+        out = []
+        for _ in deltas:
+            d = RequantStats()
+            d.bytes_in += len(nal)
+            d.slices_passed_through += 1
+            d.bytes_out += len(nal)
+            out.append((nal, d))
+        return out
+    dispatch = FusedRequantDispatch(
+        [gather], tuple(deltas), requant_fn=requant_fn,
+        chroma_fn=chroma_fn, chroma_qp_offset=pps.chroma_qp_offset,
+        use_device=use_device)
+    out = []
+    for i in range(len(dispatch.deltas)):
+        d = RequantStats()
+        d.bytes_in += len(nal)
+        try:
+            out_nal, n_blocks = recode_parsed(parsed, gather, dispatch,
+                                              0, i)
+            d.slices_requantized += 1
+            d.blocks += n_blocks
+        except (ValueError, EOFError, KeyError, IndexError):
+            out_nal = nal
+            d.slices_passed_through += 1
+        d.bytes_out += len(out_nal)
+        out.append((out_nal, d))
+    return out
 
 
 class SliceRequantizer:
@@ -227,72 +665,26 @@ class SliceRequantizer:
 
     def _requant_slice(self, nal: bytes, sps: Sps, pps: Pps
                        ) -> tuple[bytes, int]:
-        n_blocks = 0
-        cabac_codec = None
-        if pps.entropy_cabac:
-            from .h264_cabac import CabacSliceCodec
-            cabac_codec = CabacSliceCodec(sps, pps)
-            hdr, _first, mbs, _qps = cabac_codec.parse_slice(nal)
-            qp_in_base = hdr.qp
-        else:
-            codec = SliceCodec(sps, pps)
-            br = BitReader(nal_to_rbsp(nal[1:]))
-            hdr = codec.parse_slice_header(br, nal[0])
-            qp_in_base = hdr.qp
-            mbs = codec.parse_mbs(br, qp_in_base, hdr.first_mb, hdr)
-        qp_out_base = qp_in_base + self.delta_qp
-        # mb.qp is ABSOLUTE (parse accumulates mb_qp_delta per 7.4.5):
-        # the ceiling check covers the true per-MB maxima; P_Skip MBs
-        # carry no QP
-        if max((mb.qp for mb in mbs
-                if not isinstance(mb, MacroblockPSkip)),
-               default=qp_in_base) + self.delta_qp > 51:
-            raise ValueError("qp already at ladder ceiling")
-
-        if pps.entropy_cabac and pps.transform_8x8_mode \
-                and hdr.first_mb + len(mbs) < sps.width_mbs \
-                * sps.height_mbs:
-            # CABAC + 8x8: a slice whose parse ends before the picture
-            # does is either a genuine multi-slice picture or a sparse-
-            # content context desync this engine still has on cat-5
-            # streams (dense intra is byte-exact vs x264; the sparse
-            # margin case is under investigation) — both must PASS
-            # THROUGH rather than emit a truncated-but-plausible slice
-            raise ValueError("CABAC 8x8 slice ended before picture end")
-
-        if self.closed_loop and not hdr.is_p:
-            n_blocks = self._closed_loop_slice(sps, pps, hdr, mbs)
-        else:
-            n_blocks = self._open_loop_levels(pps, mbs, n_blocks)
-        for mb in mbs:
-            if isinstance(mb, MacroblockPSkip):
-                continue
-            ccbp = (2 if np.any(mb.chroma_ac) else
-                    1 if np.any(mb.chroma_dc) else 0)
-            if isinstance(mb, MacroblockI16x16):
-                mb.luma_cbp15 = bool(np.any(mb.ac_levels))
-                mb.chroma_cbp = ccbp
-            elif getattr(mb, "transform_8x8", False):
-                cbp = 0
-                for g in range(4):
-                    if np.any(mb.levels8[g]):
-                        cbp |= 1 << g
-                mb.cbp = cbp | (ccbp << 4)
-            else:                      # I_NxN and inter share the CBP
-                cbp = 0                # recompute shape
-                for g in range(4):
-                    if np.any(mb.levels[4 * g:4 * g + 4]):
-                        cbp |= 1 << g
-                mb.cbp = cbp | (ccbp << 4)
-            mb.qp = mb.qp + self.delta_qp
-        if cabac_codec is not None:
-            return cabac_codec.write_slice(hdr, hdr.first_mb, mbs,
-                                           qp_out_base), n_blocks
-        bw = BitWriter()
-        codec.write_slice_header(bw, hdr, qp_out_base)
-        codec.write_mbs(bw, mbs, qp_out_base, hdr.first_mb, hdr)
-        bw.rbsp_trailing()
-        return bytes([nal[0]]) + rbsp_to_nal(bw.to_bytes()), n_blocks
+        """Single-rendition requant: the SAME parse → gather → fused
+        dispatch → recode pipeline the ladder fan-out runs, with one
+        delta and no MB clone — serial/fan-out byte-identity is
+        structural, not coincidental."""
+        parsed = parse_slice_nal(nal, sps, pps)
+        _check_ceiling(parsed, self.delta_qp)
+        if self.closed_loop and not parsed.hdr.is_p:
+            n_blocks = self._closed_loop_slice(sps, pps, parsed.hdr,
+                                               parsed.mbs)
+            _finalize_mbs(parsed.mbs, self.delta_qp)
+            return (_write_slice_bytes(
+                parsed, parsed.mbs,
+                parsed.qp_in_base + self.delta_qp), n_blocks)
+        gather = gather_slice(parsed)
+        dispatch = FusedRequantDispatch(
+            [gather], (self.delta_qp,), requant_fn=self.requant_fn,
+            chroma_fn=self.chroma_fn,
+            chroma_qp_offset=pps.chroma_qp_offset)
+        return recode_parsed(parsed, gather, dispatch, 0, 0,
+                             clone=False)
 
     def _closed_loop_slice(self, sps: Sps, pps: Pps, hdr, mbs) -> int:
         """Closed-loop intra requant of one slice's MBs (mutates their
@@ -311,83 +703,3 @@ class SliceRequantizer:
             n_blocks += 8 if mb.chroma_cbp else 0
         return n_blocks
 
-    def _open_loop_levels(self, pps: Pps, mbs, n_blocks: int) -> int:
-        # gather every block with its per-MB source/target QP; the +6k
-        # step is uniform so every MB shifts by the same k.  I_16x16 MBs
-        # contribute a DC row + 16 zero-padded 15-coeff AC rows (the op
-        # is elementwise, padding stays zero); a row map routes results
-        # back to the right structure
-        all_levels = []
-        qps = []
-        row_map = []                   # (mb_index, kind, blk)
-        for i, mb in enumerate(mbs):
-            if isinstance(mb, MacroblockPSkip):
-                continue               # no residual, nothing to shift
-            if getattr(mb, "transform_8x8", False):
-                # 8x8 levels shift by the same exact +6k step (the 8x8
-                # tables share the qp%6 periodicity); batch as 16 rows
-                all_levels.append(mb.levels8.reshape(16, 16))
-                row_map.extend((i, "l8", b) for b in range(16))
-                qps.extend([mb.qp] * 16)
-                continue
-            if isinstance(mb, MacroblockI16x16):
-                all_levels.append(mb.dc_levels[None, :])
-                row_map.append((i, "dc", 0))
-                qps.append(mb.qp)
-                ac = np.zeros((16, 16), dtype=np.int64)
-                ac[:, :15] = mb.ac_levels
-                all_levels.append(ac)
-                row_map.extend((i, "ac", b) for b in range(16))
-                qps.extend([mb.qp] * 16)
-            else:
-                all_levels.append(mb.levels)
-                row_map.extend((i, "l4", b) for b in range(16))
-                qps.extend([mb.qp] * 16)
-        if all_levels:                 # an all-skip P slice has no rows;
-            # its header QP still shifts (deblocking strength follows
-            # the slice QP even for skipped MBs)
-            batch = np.concatenate(all_levels, axis=0)
-            qps = np.asarray(qps)
-            n_blocks += batch.shape[0]
-            requanted = self.requant_fn(batch, qps, qps + self.delta_qp)
-        else:
-            requanted = np.zeros((0, 16), dtype=np.int64)
-
-        # write back + recompute CBP and the shifted absolute QP per MB;
-        # the writer re-derives deltas vs the previous CODED MB, so a
-        # cleared-CBP MB's QP correctly stops influencing the chain
-        for r, (i, kind, b) in enumerate(row_map):
-            mb = mbs[i]
-            if kind == "dc":
-                mb.dc_levels = requanted[r]
-            elif kind == "ac":
-                mb.ac_levels[b] = requanted[r, :15]
-            elif kind == "l8":
-                mb.levels8[b >> 2, (b & 3) * 16:(b & 3) * 16 + 16] = \
-                    requanted[r]
-            else:
-                mb.levels[b] = requanted[r]
-
-        # chroma: per-MB QPc pairs (Table 8-15 over the shifted QPY)
-        # through the three-way identity/shift/round-trip requant, both
-        # components batched as independent rows
-        centries = [i for i, mb in enumerate(mbs) if mb.chroma_cbp]
-        if centries:
-            off = pps.chroma_qp_offset
-            cdc = np.stack([mbs[i].chroma_dc for i in centries])
-            cac = np.stack([mbs[i].chroma_ac for i in centries])
-            qin = np.array([chroma_qp(mbs[i].qp, off) for i in centries],
-                           dtype=np.int64)
-            qout = np.array(
-                [chroma_qp(mbs[i].qp + self.delta_qp, off)
-                 for i in centries], dtype=np.int64)
-            n_blocks += 8 * len(centries)
-            d2, a2 = self.chroma_fn(
-                cdc.reshape(-1, 4), cac.reshape(-1, 4, 15),
-                np.repeat(qin, 2), np.repeat(qout, 2))
-            d2 = d2.reshape(-1, 2, 4)
-            a2 = a2.reshape(-1, 2, 4, 15)
-            for j, i in enumerate(centries):
-                mbs[i].chroma_dc = d2[j]
-                mbs[i].chroma_ac = a2[j]
-        return n_blocks

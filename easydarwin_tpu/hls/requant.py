@@ -31,12 +31,34 @@ from __future__ import annotations
 import asyncio
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
-from ..codecs.h264_requant import (SliceRequantizer, device_batch,
-                                   device_batch_chroma)
+from ..codecs.h264_requant import (FusedRequantDispatch, RequantStats,
+                                   SliceRequantizer, device_batch,
+                                   device_batch_chroma, gather_slice,
+                                   parse_slice_nal, recode_parsed)
+from ..obs import (REQUANT_AUS, REQUANT_REASSEMBLY_MISMATCH,
+                   REQUANT_RENDITIONS, REQUANT_SHED, REQUANT_SLICES,
+                   REQUANT_STAGE_SECONDS)
+from ..relay.output import RelayOutput, WriteResult
 from ..vod.depacketize import AccessUnit
 from .segmenter import HlsOutput
+
+#: the CLOSED requant-pipeline stage vocabulary behind
+#: ``requant_stage_seconds{stage}`` (tools/metrics_lint.py rejects any
+#: observed child outside it): ``parse`` = shared entropy decode of a
+#: slice, ``entropy`` = the fused native walk (serial CAVLC/CABAC state
+#: machines, decode+recode in one pass), ``transform_device`` = the
+#: fused device requant dispatch + harvest for every (slice, rendition)
+#: of an AU, ``recode`` = one rendition's serial entropy re-encode over
+#: the shared parse, ``reassemble`` = the ordered per-AU emit.
+REQUANT_STAGES = ("parse", "entropy", "transform_device", "recode",
+                  "reassemble")
+
+
+def _stage(stage: str, t0: float) -> None:
+    REQUANT_STAGE_SECONDS.observe(time.perf_counter() - t0, stage=stage)
 
 #: one shared pool for ALL requant renditions, sized to the cores the
 #: process may use: the native walk releases the GIL (ctypes), so jobs
@@ -346,3 +368,432 @@ class RequantHlsOutput(HlsOutput):
         while self._next_emit in self._ready:
             super()._on_unit(self._ready.pop(self._next_emit))
             self._next_emit += 1
+
+
+# ========================================================== the ABR ladder
+# ISSUE 9 tentpole: one shared-parse, slice-parallel, device-overlapped
+# pipeline feeding EVERY q-rung rendition of a source.
+#
+#   AU ──► slice NALs ──► [parse ×S across the pool]          (Python path)
+#            │                    │
+#            │                    └► ONE FusedRequantDispatch (S slices ×
+#            │                       N renditions, async device) ──►
+#            │                       [recode ×S×N across the pool]
+#            │
+#            └──────────► [native walk ×S×N across the pool]  (native path)
+#                                 │
+#                    ordered per-AU reassembly ──► rendition muxers
+#
+# The native engine keeps its fused decode+requant+recode walk (two
+# orders faster than the Python slice walk, so N independent walks beat
+# one shared Python parse at any ladder width) — its ladder lever is the
+# slice × rendition fan-out across the pool.  The Python engines (device
+# or scalar transform) parse each slice ONCE and recode N times, with
+# all (slice, rendition) transform rows batched into a single device
+# dispatch per AU, double-buffered: the JAX dispatch is asynchronous and
+# admission allows ~2×workers AUs in flight, so the device computes AU
+# k's rows while the pool entropy-decodes AU k+1 (the PR 4 staging
+# pattern).  A single-slice, single-rendition AU degenerates to exactly
+# the serial ``SliceRequantizer`` path — bit-identity is pinned by
+# tests/test_requant_ladder.py.
+
+
+class LadderRendition(HlsOutput):
+    """One rung's CMAF muxer: fed already-requantized AUs by its ladder
+    (never raw packets — ``send_bytes`` on a rendition is a wiring bug).
+    Keeps the ``.requant`` / ``.shed`` surface the admin/soak layers
+    read on q-rung outputs."""
+
+    def __init__(self, ladder: "RequantLadder", delta_qp: int,
+                 engine: SliceRequantizer, **kw):
+        super().__init__(**kw)
+        self._ladder = ladder
+        self.delta_qp = delta_qp
+        #: the per-rendition stats container (and serial engine config);
+        #: worker deltas merge into ``requant.stats`` once per AU
+        self.requant = engine
+        #: share the ladder's depacketizer so the init segment sees the
+        #: source SPS/PPS (requant never rewrites parameter sets)
+        self.depack = ladder.depack
+
+    def send_bytes(self, data: bytes, *, is_rtcp: bool):
+        raise RuntimeError("ladder renditions are fed AUs by the "
+                           "ladder, not packets")
+
+    @property
+    def shed(self) -> int:
+        """AUs shed at ladder admission (sheds apply to every rendition
+        of the ladder together — degrade in frame rate, never latency)."""
+        return self._ladder.shed
+
+    @property
+    def pending(self) -> int:
+        return self._ladder.pending
+
+
+class _AuJob:
+    """Bookkeeping for one AU in flight through the ladder pool: per-
+    rendition output slots (slice-ordered), per-worker stats deltas, and
+    the outstanding-unit counter that triggers reassembly."""
+
+    __slots__ = ("seq", "au", "deltas", "sps", "pps", "slice_idx",
+                 "outs", "stats", "remaining", "lock", "parsed",
+                 "mismatch")
+
+    def __init__(self, seq: int, au: AccessUnit, deltas, sps, pps):
+        self.seq = seq
+        self.au = au
+        self.deltas = deltas
+        self.sps = sps
+        self.pps = pps
+        self.slice_idx = [i for i, n in enumerate(au.nals)
+                          if n and (n[0] & 0x1F) in (1, 5)
+                          and sps is not None and pps is not None]
+        # non-slice NALs ride through in place; slice slots start EMPTY
+        # so the reassembly check catches a genuinely lost unit instead
+        # of silently emitting the source slice
+        slice_set = set(self.slice_idx)
+        self.outs = {d: [None if i in slice_set else n
+                         for i, n in enumerate(au.nals)]
+                     for d in deltas}
+        self.stats = {d: [] for d in deltas}
+        self.remaining = 0
+        self.lock = threading.Lock()
+        self.parsed = {}                # slice pos -> (ParsedSlice, gather)
+        self.mismatch = False
+
+
+class RequantLadder(RelayOutput):
+    """The multi-rendition transform-domain requant pipeline: ONE relay
+    sink per published path that depacketizes once, requantizes each AU
+    to every rung of its ladder through the shared worker pool, and
+    feeds the per-rendition muxers in source order."""
+
+    def __init__(self, *, use_device: bool = True,
+                 target_duration: float = 2.0, window: int = 6,
+                 audio=None):
+        super().__init__(ssrc=0x415)
+        # identity rewrite, same as HlsOutput: every rendition keeps the
+        # SOURCE timestamps so ABR switching never jumps in time
+        self.rewrite.base_src_seq = 0
+        self.rewrite.base_src_ts = 0
+        self.rewrite.out_seq_start = 0
+        self.rewrite.out_ts_start = 0
+        from ..vod.depacketize import H264Depacketizer
+        self.depack = H264Depacketizer()
+        self.target_duration = target_duration
+        self.window = window
+        self.audio = audio
+        from .. import native as native_mod
+        self._use_native = native_mod.available()
+        self._use_device = bool(use_device) and not self._use_native
+        self._fn = None if self._use_native else \
+            (device_batch if use_device else None)
+        self._cfn = None if self._use_native else \
+            (device_batch_chroma if use_device else None)
+        self.renditions: dict[int, LadderRendition] = {}
+        self._sps = None
+        self._pps = None
+        self._sps_raw: bytes | None = None
+        self._pps_raw: bytes | None = None
+        self.shed = 0
+        self._max_pending = max(4, 2 * pool_workers())
+        self._next_submit = 0
+        self._next_emit = 0
+        self._ready: dict[int, _AuJob] = {}
+
+    # -- ladder membership -------------------------------------------------
+    def add_rendition(self, delta_qp: int) -> LadderRendition:
+        """Get-or-create the rung at ``delta_qp`` (multiples of 6, the
+        exact-shift window — SliceRequantizer validates)."""
+        out = self.renditions.get(delta_qp)
+        if out is None:
+            engine = SliceRequantizer(delta_qp, requant_fn=self._fn,
+                                      chroma_fn=self._cfn)
+            out = LadderRendition(self, delta_qp, engine,
+                                  target_duration=self.target_duration,
+                                  window=self.window, audio=self.audio)
+            self.renditions[delta_qp] = out
+        return out
+
+    @property
+    def pending(self) -> int:
+        """Submitted-but-not-yet-emitted AUs (in workers OR waiting in
+        the reorder buffer) — the admission gate and test barrier."""
+        return self._next_submit - self._next_emit
+
+    # -- ingest ------------------------------------------------------------
+    def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
+        if is_rtcp:
+            return WriteResult.OK
+        self.depack.push(data)
+        for au in self.depack.pop_units():
+            self._on_unit(au)
+        return WriteResult.OK
+
+    def _latch_ps(self, au: AccessUnit) -> None:
+        """Latch SPS/PPS at AU granularity on the ingest thread: the
+        depacketizer's out-of-band sets plus any in-band sets riding the
+        AU (parameter sets are config, not sample data — conformant
+        senders place them before the slices they govern)."""
+        from ..codecs.h264_intra import Pps, Sps
+        cands = [self.depack.sps, self.depack.pps]
+        cands += [n for n in au.nals if n and (n[0] & 0x1F) in (7, 8)]
+        for n in cands:
+            if not n:
+                continue
+            t = n[0] & 0x1F
+            try:
+                if t == 7 and n != self._sps_raw:
+                    self._sps, self._sps_raw = Sps.parse(n), n
+                elif t == 8 and n != self._pps_raw:
+                    self._pps, self._pps_raw = Pps.parse(n), n
+            except (ValueError, EOFError, IndexError):
+                if t == 7:
+                    self._sps = self._sps_raw = None
+                else:
+                    self._pps = self._pps_raw = None
+
+    def _on_unit(self, au: AccessUnit) -> None:
+        if not self.renditions:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        self._latch_ps(au)
+        deltas = tuple(sorted(self.renditions))
+        job = None
+        if loop is None:
+            # synchronous caller (tests, offline tools): run the SAME
+            # pipeline inline — sync and pooled output are byte-identical
+            job = _AuJob(self._next_submit, au, deltas, self._sps,
+                         self._pps)
+            self._next_submit += 1
+            self._run_job_inline(job)
+            self._emit(job)
+            return
+        if self.pending >= self._max_pending:
+            self.shed += 1               # backlogged: shed, stay live
+            REQUANT_SHED.inc()
+            return
+        job = _AuJob(self._next_submit, au, deltas, self._sps, self._pps)
+        self._next_submit += 1
+        if not job.slice_idx:
+            self._emit(job)              # SEI/PS-only AU: nothing to do,
+            return                       # but it keeps its emit slot
+        pool = _get_pool()
+        if self._use_native:
+            # unit granularity adapts to the pool: when the SLICES alone
+            # already saturate the workers, one unit per slice (looping
+            # the renditions) avoids paying submit/lock overhead for
+            # parallelism the pool cannot add; a few-slice AU on a wide
+            # pool keeps the full (slice x rendition) fan-out so every
+            # worker engages
+            if len(job.slice_idx) >= pool_workers():
+                job.remaining = len(job.slice_idx)
+                for pos in job.slice_idx:
+                    pool.submit(self._native_unit, loop, job, pos,
+                                deltas)
+            else:
+                job.remaining = len(job.slice_idx) * len(deltas)
+                for pos in job.slice_idx:
+                    for d in deltas:
+                        pool.submit(self._native_unit, loop, job, pos,
+                                    (d,))
+        else:
+            job.remaining = len(job.slice_idx)
+            for pos in job.slice_idx:
+                pool.submit(self._parse_unit, loop, job, pos)
+
+    # -- worker units ------------------------------------------------------
+    # Every unit takes ``loop``: the pooled path passes the event loop
+    # (completion notifies it thread-safely); the synchronous inline
+    # path passes None and the caller emits after the last unit — ONE
+    # implementation, so sync and pooled can never drift apart.
+    def _complete_unit(self, loop, job: _AuJob) -> None:
+        with job.lock:
+            job.remaining -= 1
+            done = job.remaining == 0
+        if done and loop is not None:
+            loop.call_soon_threadsafe(self._emit, job)
+
+    def _native_unit(self, loop, job: _AuJob, pos: int,
+                     unit_deltas: "tuple[int, ...]") -> None:
+        """One slice through the fused native walk (the serial entropy
+        state machines, decode+requant+recode in one pass) for one or
+        more renditions — the slice × rendition fan-out IS the native
+        ladder lever."""
+        nal = job.au.nals[pos]
+        for delta in unit_deltas:
+            engine = self.renditions[delta].requant
+            try:
+                t0 = time.perf_counter()
+                out, d = engine.requant_with(nal, job.sps, job.pps)
+                _stage("entropy", t0)
+            except Exception:
+                out = nal                # never strand the slot — and
+                d = RequantStats()       # count the pass-through, or
+                d.bytes_in += len(nal)   # bytes_out drifts away from
+                d.bytes_out += len(nal)  # the bytes actually emitted
+                d.slices_passed_through += 1
+            with job.lock:
+                job.outs[delta][pos] = out
+                job.stats[delta].append(d)
+        REQUANT_SLICES.inc(len(unit_deltas))
+        self._complete_unit(loop, job)
+
+    def _parse_unit(self, loop, job: _AuJob, pos: int) -> None:
+        """Shared parse of one slice (Python engines): entropy-decode
+        ONCE for the whole rendition ladder.  The worker that finishes
+        the AU's last parse runs the fused dispatch inline and fans the
+        per-(slice, rendition) recodes back across the pool."""
+        nal = job.au.nals[pos]
+        parsed = None
+        try:
+            t0 = time.perf_counter()
+            p = parse_slice_nal(nal, job.sps, job.pps)
+            parsed = (p, gather_slice(p))
+            _stage("parse", t0)
+        except Exception:
+            parsed = None                # out of scope: pass through
+        with job.lock:
+            if parsed is not None:
+                job.parsed[pos] = parsed
+            job.remaining -= 1
+            last = job.remaining == 0    # this was the AU's final parse
+        if last:
+            self._dispatch_unit(loop, job)
+
+    def _dispatch_unit(self, loop, job: _AuJob) -> None:
+        """The AU's single fused transform dispatch (slices × renditions
+        in one call; asynchronous on the device path, so device time
+        hides behind the NEXT AU's parses on other workers), then the
+        recode fan-out."""
+        order = sorted(job.parsed)
+        failed = [pos for pos in job.slice_idx if pos not in job.parsed]
+        dispatch = None
+        if order:
+            try:
+                t0 = time.perf_counter()
+                dispatch = FusedRequantDispatch(
+                    [job.parsed[pos][1] for pos in order],
+                    job.deltas, requant_fn=self._fn, chroma_fn=self._cfn,
+                    chroma_qp_offset=job.pps.chroma_qp_offset,
+                    use_device=self._use_device)
+                dispatch._harvested()    # device wait lands here, not in
+                _stage("transform_device", t0)   # a recode bracket
+            except Exception:
+                dispatch = None
+                failed = list(job.slice_idx)
+                order = []
+        for pos in failed:
+            d = RequantStats()
+            d.bytes_in += len(job.au.nals[pos])
+            d.slices_passed_through += 1
+            d.bytes_out += len(job.au.nals[pos])
+            with job.lock:
+                for delta in job.deltas:
+                    job.outs[delta][pos] = job.au.nals[pos]
+                    job.stats[delta].append(
+                        d if delta == job.deltas[0] else _copy_delta(d))
+        REQUANT_SLICES.inc(len(failed) * len(job.deltas))
+        if not order:
+            if loop is not None:
+                loop.call_soon_threadsafe(self._emit, job)
+            return
+        with job.lock:
+            # swap the exhausted parse budget for the recode budget: one
+            # unit per (slice, rendition)
+            job.remaining = len(order) * len(job.deltas)
+        if loop is None:
+            for s_i, pos in enumerate(order):
+                for d_i, delta in enumerate(job.deltas):
+                    self._recode_unit(None, job, dispatch, s_i, pos,
+                                      d_i, delta)
+            return
+        pool = _get_pool()
+        for s_i, pos in enumerate(order):
+            for d_i, delta in enumerate(job.deltas):
+                pool.submit(self._recode_unit, loop, job, dispatch,
+                            s_i, pos, d_i, delta)
+
+    def _recode_unit(self, loop, job: _AuJob, dispatch, s_i: int,
+                     pos: int, d_i: int, delta: int) -> None:
+        """One rendition's serial entropy re-encode of one slice over
+        the shared parse."""
+        nal = job.au.nals[pos]
+        parsed, gather = job.parsed[pos]
+        d = RequantStats()
+        d.bytes_in += len(nal)
+        try:
+            t0 = time.perf_counter()
+            out, n_blocks = recode_parsed(parsed, gather, dispatch,
+                                          s_i, d_i)
+            _stage("recode", t0)
+            d.slices_requantized += 1
+            d.blocks += n_blocks
+        except Exception:
+            out = nal
+            d.slices_passed_through += 1
+        d.bytes_out += len(out)
+        with job.lock:
+            job.outs[delta][pos] = out
+            job.stats[delta].append(d)
+        REQUANT_SLICES.inc()
+        self._complete_unit(loop, job)
+
+    # -- synchronous path --------------------------------------------------
+    def _run_job_inline(self, job: _AuJob) -> None:
+        """The pooled pipeline, single-threaded (no loop running): same
+        primitives, same order, same bytes."""
+        if not job.slice_idx:
+            return
+        if self._use_native:
+            job.remaining = len(job.slice_idx)
+            for pos in job.slice_idx:
+                self._native_unit(None, job, pos, job.deltas)
+            return
+        job.remaining = len(job.slice_idx)
+        for pos in job.slice_idx:
+            self._parse_unit(None, job, pos)
+
+    # -- reassembly --------------------------------------------------------
+    def _emit(self, job: _AuJob) -> None:
+        """Ordered per-AU reassembly (loop/caller thread): verify every
+        slice slot, merge each rendition's worker deltas into its stats
+        ONCE, and feed the muxers in source order."""
+        t0 = time.perf_counter()
+        for delta in job.deltas:
+            if any(n is None for n in job.outs[delta]):
+                # a pipeline bookkeeping bug, never silent corruption:
+                # count it, pass the source AU through for this rung,
+                # and drop its stats (output was discarded)
+                job.mismatch = True
+                job.outs[delta] = list(job.au.nals)
+                job.stats[delta] = []
+        if job.mismatch:
+            REQUANT_REASSEMBLY_MISMATCH.inc()
+        self._ready[job.seq] = job
+        while self._next_emit in self._ready:
+            j = self._ready.pop(self._next_emit)
+            self._next_emit += 1
+            REQUANT_AUS.inc()
+            REQUANT_RENDITIONS.inc(len(j.deltas))
+            for delta in j.deltas:
+                out = self.renditions.get(delta)
+                if out is None:
+                    continue
+                au_delta = RequantStats()
+                for d in j.stats[delta]:
+                    au_delta.merge(d)
+                out.requant.stats.merge(au_delta)
+                out._on_unit(AccessUnit(j.au.timestamp,
+                                        j.outs[delta]))
+        _stage("reassemble", t0)
+
+
+def _copy_delta(d: RequantStats) -> RequantStats:
+    c = RequantStats()
+    c.merge(d)
+    return c

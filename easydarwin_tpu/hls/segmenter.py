@@ -393,9 +393,15 @@ class _HlsEntry:
         self.audio_track = audio_track
         self.audio_cfg = audio_cfg
         #: rendition name → HlsOutput; "" = source frame rate, "rN" =
-        #: thinning level N (1 = half rate, 2 = keyframes only)
+        #: thinning level N (1 = half rate, 2 = keyframes only), "qN" =
+        #: requant rung (a LadderRendition fed by ``requant_ladder``)
         self.renditions: dict[str, HlsOutput] = {}
         self.audio_tap: HlsAudioTap | None = None
+        #: ONE RequantLadder serves every q-rung of the entry: the AU is
+        #: depacketized and entropy-decoded once, slices fan across the
+        #: shared pool, and all renditions ride one fused transform
+        #: dispatch (hls/requant.py, ISSUE 9)
+        self.requant_ladder = None
 
 
 #: default ladder for master.m3u8: temporal rungs only (frame-granular
@@ -442,19 +448,25 @@ class HlsService:
         out = entry.renditions.get(name)
         if out is None:
             if name.startswith("q"):
-                from .requant import RequantHlsOutput
-                out = RequantHlsOutput(int(name[1:]),
-                                       use_device=self.requant_on_device,
-                                       target_duration=self.target_duration,
-                                       window=self.window,
-                                       audio=entry.audio_cfg)
+                # every q-rung of a path shares ONE RequantLadder (the
+                # session output): one depacketize + one entropy decode
+                # per AU no matter how wide the ladder is
+                from .requant import RequantLadder
+                if entry.requant_ladder is None:
+                    entry.requant_ladder = RequantLadder(
+                        use_device=self.requant_on_device,
+                        target_duration=self.target_duration,
+                        window=self.window, audio=entry.audio_cfg)
+                    entry.sess.add_output(entry.track_id,
+                                          entry.requant_ladder)
+                out = entry.requant_ladder.add_rendition(int(name[1:]))
             else:
                 out = HlsOutput(target_duration=self.target_duration,
                                 window=self.window, audio=entry.audio_cfg)
                 if name:
                     out.thinning.controller.level = int(name[1:])
+                entry.sess.add_output(entry.track_id, out)
             entry.renditions[name] = out
-            entry.sess.add_output(entry.track_id, out)
             if entry.audio_track is not None and entry.audio_tap is None:
                 entry.audio_tap = HlsAudioTap(entry.audio_cfg,
                                               entry.renditions)
@@ -462,8 +474,12 @@ class HlsService:
         return out
 
     def _retire(self, key: str, entry: _HlsEntry) -> None:
+        from .requant import LadderRendition
         for out in entry.renditions.values():
-            entry.sess.remove_output(entry.track_id, out)
+            if not isinstance(out, LadderRendition):
+                entry.sess.remove_output(entry.track_id, out)
+        if entry.requant_ladder is not None:
+            entry.sess.remove_output(entry.track_id, entry.requant_ladder)
         if entry.audio_tap is not None and entry.audio_track is not None:
             entry.sess.remove_output(entry.audio_track, entry.audio_tap)
 

@@ -32,7 +32,9 @@ from .families import (  # noqa: F401  (re-exported inventory)
     MEGABATCH_FALLBACK, MEGABATCH_PASSES, MEGABATCH_STREAMS,
     MEGABATCH_WIRE_MISMATCH, PROFILE_PHASE_DRIFT, QOS_FRACTION_LOST,
     QOS_JITTER, QOS_THICKENS, QOS_THINS, REDIS_ERRORS, REGISTRY,
-    RELAY_INGEST_TO_WIRE,
+    RELAY_INGEST_TO_WIRE, REQUANT_AUS, REQUANT_REASSEMBLY_MISMATCH,
+    REQUANT_RENDITIONS, REQUANT_SHED, REQUANT_SLICES,
+    REQUANT_STAGE_SECONDS,
     RELAY_PHASE_SECONDS, RESILIENCE_CKPT_BYTES, RESILIENCE_CKPT_ERRORS,
     RESILIENCE_CKPT_RESTORES, RESILIENCE_CKPT_WRITES,
     RESILIENCE_LADDER_LEVEL, RESILIENCE_RETRIES, RESILIENCE_SHED_OUTPUTS,

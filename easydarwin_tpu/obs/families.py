@@ -233,6 +233,44 @@ INGEST_BUSY_SECONDS = REGISTRY.counter(
     "Cumulative wall time spent inside the native recvmmsg ring ingest "
     "(clock_gettime deltas in ed_stats)")
 
+# ---------------------------------------------------------- requant ladder
+# The HLS ABR requant ladder (hls/requant.py RequantLadder): slice-
+# parallel entropy recode + shared-parse multi-rendition fan-out +
+# device-overlapped transform (ISSUE 9).  The ``stage`` label vocabulary
+# is the CLOSED ``hls.requant.REQUANT_STAGES`` set —
+# tools/metrics_lint.py rejects any child outside it, and
+# ``tools/soak.py --hls-ladder`` keys on these families.
+REQUANT_AUS = REGISTRY.counter(
+    "requant_aus_total",
+    "Access units admitted into the requant ladder pipeline (each fans "
+    "out to every rendition of its source's q-rung ladder)")
+REQUANT_SLICES = REGISTRY.counter(
+    "requant_slices_total",
+    "Slice recode jobs completed by the ladder worker pool (one serial "
+    "CAVLC/CABAC state machine per slice per rendition, slices of one "
+    "AU fanned across workers)")
+REQUANT_RENDITIONS = REGISTRY.counter(
+    "requant_renditions_total",
+    "Rendition access units emitted by the ladder (renditions_total / "
+    "aus_total = mean ladder width actually served)")
+REQUANT_SHED = REGISTRY.counter(
+    "requant_shed_total",
+    "Access units shed at ladder admission because the pipeline was at "
+    "its in-flight bound (the rendition set degrades in frame rate "
+    "together, never in latency)")
+REQUANT_REASSEMBLY_MISMATCH = REGISTRY.counter(
+    "requant_reassembly_mismatch_total",
+    "Ladder AUs whose ordered per-AU reassembly finished with a missing "
+    "or duplicate slice slot (the AU passes through unrequanted; any "
+    "nonzero value is a pipeline bookkeeping bug, and soak fails on it)")
+REQUANT_STAGE_SECONDS = REGISTRY.histogram(
+    "requant_stage_seconds",
+    "Duration of one requant-ladder pipeline stage (parse = shared "
+    "entropy decode, entropy = fused native walk, transform_device = "
+    "fused device requant dispatch+harvest, recode = per-rendition "
+    "entropy re-encode, reassemble = ordered per-AU emit), by stage",
+    labels=("stage",), buckets=TIME_BUCKETS)
+
 # ------------------------------------------------------------------- QoS
 QOS_FRACTION_LOST = REGISTRY.gauge(
     "qos_fraction_lost_ratio",

@@ -120,6 +120,14 @@ class _Family:
         self.name = name
         self.help = help
         self.label_names = tuple(labels)
+        # Mutations are lock-free-looking read-modify-writes; until the
+        # requant ladder every observe/inc site was single-writer per
+        # key (the pump or one engine thread), so races could not drop
+        # counts.  The ladder's pool workers observe the SAME stage/
+        # counter keys concurrently — serialize writers per family
+        # (uncontended acquire is ~100 ns; the hot relay paths record
+        # per PASS, not per packet, so this is noise there).
+        self._mu = threading.Lock()
 
     def _key(self, kv: dict) -> tuple:
         if set(kv) != set(self.label_names):
@@ -146,14 +154,16 @@ class Counter(_Family):
 
     def inc(self, amount: float = 1, **labels) -> None:
         key = self._key(labels)
-        self._values[key] = self._values.get(key, 0) + amount
+        with self._mu:
+            self._values[key] = self._values.get(key, 0) + amount
 
     def labels(self, **labels) -> "_BoundCounter":
         return _BoundCounter(self, self._key(labels))
 
     def set_to(self, value: float, **labels) -> None:
         """Overwrite with an externally-maintained cumulative value."""
-        self._values[self._key(labels)] = value
+        with self._mu:
+            self._values[self._key(labels)] = value
 
     def value(self, **labels) -> float:
         return self._values.get(self._key(labels), 0)
@@ -179,8 +189,10 @@ class _BoundCounter:
         self._key = key
 
     def inc(self, amount: float = 1) -> None:
-        vals = self._fam._values
-        vals[self._key] = vals.get(self._key, 0) + amount
+        fam = self._fam
+        with fam._mu:
+            fam._values[self._key] = fam._values.get(self._key, 0) \
+                + amount
 
 
 class Gauge(_Family):
@@ -193,11 +205,13 @@ class Gauge(_Family):
             self._values[()] = 0
 
     def set(self, value: float, **labels) -> None:
-        self._values[self._key(labels)] = value
+        with self._mu:
+            self._values[self._key(labels)] = value
 
     def inc(self, amount: float = 1, **labels) -> None:
         key = self._key(labels)
-        self._values[key] = self._values.get(key, 0) + amount
+        with self._mu:
+            self._values[key] = self._values.get(key, 0) + amount
 
     def dec(self, amount: float = 1, **labels) -> None:
         self.inc(-amount, **labels)
@@ -254,10 +268,11 @@ class Histogram(_Family):
         return st
 
     def observe(self, value: float, **labels) -> None:
-        st = self._state(labels)
-        st.counts[bisect_left(self.bounds, value)] += 1
-        st.sum += value
-        st.count += 1
+        with self._mu:
+            st = self._state(labels)
+            st.counts[bisect_left(self.bounds, value)] += 1
+            st.sum += value
+            st.count += 1
 
     def observe_many(self, values: np.ndarray, **labels) -> None:
         """Vectorized bulk observe — the relay hot paths record one call
@@ -265,14 +280,15 @@ class Histogram(_Family):
         values = np.asarray(values, dtype=np.float64).ravel()
         if values.size == 0:
             return
-        st = self._state(labels)
         idx = np.searchsorted(self._bounds_arr, values, side="left")
         binned = np.bincount(idx, minlength=len(self.bounds) + 1)
-        for i, c in enumerate(binned):
-            if c:
-                st.counts[i] += int(c)
-        st.sum += float(values.sum())
-        st.count += int(values.size)
+        with self._mu:
+            st = self._state(labels)
+            for i, c in enumerate(binned):
+                if c:
+                    st.counts[i] += int(c)
+            st.sum += float(values.sum())
+            st.count += int(values.size)
 
     def count(self, **labels) -> int:
         st = self._states.get(self._key(labels))

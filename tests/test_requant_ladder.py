@@ -1,0 +1,435 @@
+"""ABR requant ladder tests (ISSUE 9): slice-parallel entropy recode,
+shared-parse multi-rendition fan-out, device-overlapped transform.
+
+The correctness contract is BYTE-IDENTITY three ways:
+
+* the pooled ladder pipeline (slice × rendition fan-out with ordered
+  reassembly) vs the proven serial ``RequantHlsOutput`` path, per
+  rendition, across CAVLC and CABAC streams, single- and multi-slice
+  AUs — on both the native and the Python/device engines;
+* ``requant_multi`` (parse once, recode N) vs N independent
+  ``SliceRequantizer``s with the same engine config;
+* the ladder's synchronous inline path vs its pooled path.
+
+Plus the RequantStats thread-safety regression (ISSUE 9 satellite: a
+lock-free merge under the worker pool can drop counts) and the
+lint/gate schema contracts.
+"""
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from easydarwin_tpu.codecs.h264_intra import (Pps, Sps, encode_iframe)
+from easydarwin_tpu.codecs.h264_requant import (RequantStats,
+                                                SliceRequantizer,
+                                                device_batch,
+                                                device_batch_chroma,
+                                                requant_multi)
+from easydarwin_tpu.hls.requant import (REQUANT_STAGES, RequantHlsOutput,
+                                        RequantLadder)
+from easydarwin_tpu.protocol import nalu
+from easydarwin_tpu.utils.synth import synth_luma
+
+DELTAS = (6, 12, 18)
+
+
+def _frames(slices, n_frames=6, n=96, entropy="cavlc"):
+    """Real coded frames as RTP packet bursts: ONE access unit per
+    frame (marker on the last packet only), multi-slice when asked."""
+    seq = 0
+    for f in range(n_frames):
+        img = synth_luma(n, f)
+        ts = int(f * 3000)
+        pkts = []
+        nals = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                             idr_pic_id=f % 2, slices=slices,
+                             entropy=entropy)
+        for j, nal in enumerate(nals):
+            for p in nalu.packetize_h264(nal, seq=seq, timestamp=ts,
+                                         ssrc=1,
+                                         marker_on_last=(j == len(nals)
+                                                         - 1)):
+                seq += 1
+                pkts.append(p)
+        yield pkts
+
+
+async def _ladder_vs_serial(slices, entropy, *, use_device=True,
+                            force_python=False, monkeypatch=None):
+    """Feed identical packets to N serial RequantHlsOutputs and one
+    pooled RequantLadder; every rendition must come out byte-identical
+    with matching stats, nothing shed, reorder buffer empty."""
+    if force_python:
+        from easydarwin_tpu import native as native_mod
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+    refs = {}
+    for d in DELTAS:
+        out = RequantHlsOutput(d, use_device=use_device,
+                               target_duration=0.1)
+        await asyncio.to_thread(
+            lambda o=out: [o.write_rtp(p)
+                           for fr in _frames(slices, entropy=entropy)
+                           for p in fr])
+        refs[d] = out
+    lad = RequantLadder(use_device=use_device, target_duration=0.1)
+    ch = {d: lad.add_rendition(d) for d in DELTAS}
+    for fr in _frames(slices, entropy=entropy):
+        while lad.pending + 1 >= lad._max_pending:   # backpressure,
+            await asyncio.sleep(0.005)               # don't shed
+        for p in fr:
+            lad.write_rtp(p)
+    for _ in range(800):
+        if lad.pending == 0:
+            break
+        await asyncio.sleep(0.02)
+    assert lad.pending == 0 and not lad._ready
+    assert lad.shed == 0
+    for d in DELTAS:
+        assert [s.data for s in ch[d].segments] \
+            == [s.data for s in refs[d].segments], (slices, entropy, d)
+        assert ch[d].init_segment == refs[d].init_segment
+        sa, sr = ch[d].requant.stats, refs[d].requant.stats
+        assert (sa.slices_requantized, sa.slices_passed_through,
+                sa.blocks, sa.bytes_out) \
+            == (sr.slices_requantized, sr.slices_passed_through,
+                sr.blocks, sr.bytes_out), (slices, entropy, d)
+    # the synchronous inline path is the SAME pipeline: same bytes
+    lad2 = RequantLadder(use_device=use_device, target_duration=0.1)
+    ch2 = {d: lad2.add_rendition(d) for d in DELTAS}
+    await asyncio.to_thread(
+        lambda: [lad2.write_rtp(p)
+                 for fr in _frames(slices, entropy=entropy) for p in fr])
+    for d in DELTAS:
+        assert [s.data for s in ch2[d].segments] \
+            == [s.data for s in ch[d].segments]
+
+
+@pytest.mark.parametrize("entropy", ["cavlc", "cabac"])
+@pytest.mark.parametrize("slices", [1, 3])
+async def test_parallel_slice_recode_byte_identical(slices, entropy):
+    """Tentpole (a): the pooled slice × rendition fan-out (native
+    engine) is byte-identical to the serial path — single-slice AUs
+    (the serial-fallback contract) and multi-slice AUs (true slice
+    parallelism) across both entropy layers."""
+    await _ladder_vs_serial(slices, entropy)
+
+
+@pytest.mark.parametrize("slices", [1, 3])
+async def test_python_engine_shared_parse_ladder_byte_identical(
+        slices, monkeypatch):
+    """Tentpoles (b)+(c) end to end: with the native walk masked, the
+    ladder runs the shared-parse pipeline — one parse per slice, one
+    FUSED asynchronous device dispatch per AU covering every
+    (slice, rendition), per-rendition recode — and still emits bytes
+    identical to N serial device-path requantizers."""
+    await _ladder_vs_serial(slices, "cavlc", use_device=True,
+                            force_python=True, monkeypatch=monkeypatch)
+
+
+@pytest.mark.parametrize("entropy", ["cavlc", "cabac"])
+@pytest.mark.parametrize("slices", [1, 2])
+def test_shared_parse_matches_independent_requantizers(slices, entropy):
+    """Tentpole (b) at the codec layer: ``requant_multi`` (parse once,
+    fan out to N delta_qp targets through one fused transform call) is
+    byte-identical to N independent SliceRequantizers — scalar AND
+    async-device transform engines."""
+    img = synth_luma(96)
+    nals = encode_iframe(img, 24, cb=img[::2, ::2], cr=img[1::2, 1::2],
+                         slices=slices, entropy=entropy)
+    sps, pps = Sps.parse(nals[0]), Pps.parse(nals[1])
+    for use_dev in (False, True):
+        kw = dict(requant_fn=device_batch if use_dev else None,
+                  chroma_fn=device_batch_chroma if use_dev else None)
+        inds = [SliceRequantizer(d, **kw) for d in DELTAS]
+        for rq in inds:
+            for n in nals[:2]:
+                rq.transform_nal(n)
+        for slice_nal in nals[2:]:
+            ref = [rq.requant_with(slice_nal, rq.sps, rq.pps)[0]
+                   for rq in inds]
+            got = [o for o, _ in requant_multi(
+                slice_nal, sps, pps, DELTAS, use_device=use_dev,
+                **({} if use_dev else kw))]
+            assert got == ref, (slices, entropy, use_dev)
+
+
+def test_shared_parse_ceiling_is_per_rendition():
+    """A delta that would push past QP 51 passes through for THAT
+    rendition only; the rest of the ladder still requants — and the
+    fused dispatch excludes a wholly-over-ceiling delta from the tile
+    (checked against independent requantizers, which must agree
+    byte-for-byte either way)."""
+    nals = encode_iframe(synth_luma(64), 40)
+    sps, pps = Sps.parse(nals[0]), Pps.parse(nals[1])
+    res = requant_multi(nals[2], sps, pps, (6, 12))
+    assert res[0][0] != nals[2] and res[0][1].slices_requantized == 1
+    assert res[1][0] == nals[2] and res[1][1].slices_passed_through == 1
+
+    # mixed per-slice ceilings across one fused AU dispatch: slice A at
+    # QP 40 rejects +12, slice B at QP 24 takes it — the under-ceiling
+    # slice must still get its own (correct) rows from the shared tile
+    from easydarwin_tpu.codecs.h264_requant import (
+        FusedRequantDispatch, gather_slice, parse_slice_nal,
+        recode_parsed)
+    hi = encode_iframe(synth_luma(64), 40)
+    lo = encode_iframe(synth_luma(64, 3), 24)
+    pa = parse_slice_nal(hi[2], Sps.parse(hi[0]), Pps.parse(hi[1]))
+    pb = parse_slice_nal(lo[2], Sps.parse(lo[0]), Pps.parse(lo[1]))
+    ga, gb = gather_slice(pa), gather_slice(pb)
+    disp = FusedRequantDispatch([ga, gb], (6, 12))
+    with pytest.raises(ValueError):
+        recode_parsed(pa, ga, disp, 0, 1)        # slice A rejects +12
+    out_b12, _ = recode_parsed(pb, gb, disp, 1, 1)
+    ref = SliceRequantizer(12)
+    for n in lo[:2]:
+        ref.transform_nal(n)
+    assert out_b12 == ref.requant_with(lo[2], ref.sps, ref.pps)[0]
+
+
+def test_requant_stats_merge_hammer():
+    """ISSUE 9 satellite: RequantStats.merge is thread-safe.  Hammer
+    one shared stats object from pool workers merging per-worker local
+    deltas (the production topology) — every count must land."""
+    shared = RequantStats()
+    n_workers, n_jobs, per_job = 8, 64, 25
+
+    def job(i):
+        local = RequantStats()          # per-worker accumulation...
+        for _ in range(per_job):
+            d = RequantStats()
+            d.slices_requantized = 1
+            d.blocks = 2
+            d.bytes_in = 3
+            d.bytes_out = 5
+            local.merge(d)
+        shared.merge(local)             # ...merged once at completion
+
+    with ThreadPoolExecutor(max_workers=n_workers) as pool:
+        list(pool.map(job, range(n_jobs)))
+    total = n_jobs * per_job
+    assert shared.slices_requantized == total
+    assert shared.blocks == 2 * total
+    assert shared.bytes_in == 3 * total
+    assert shared.bytes_out == 5 * total
+
+
+async def test_ladder_sheds_bounded_and_recovers():
+    """Flood the ladder past its admission bound with no pacing: whole
+    AUs shed (counted, for every rendition together), pending never
+    exceeds the bound, the pipeline drains, and the emitted segments
+    are still a valid prefix-free ordered stream (reorder buffer
+    empty)."""
+    lad = RequantLadder(target_duration=0.1)
+    lad._max_pending = 4
+    for d in (6, 12):
+        lad.add_rendition(d)
+    peak = 0
+    for fr in _frames(1, n_frames=24):
+        for p in fr:
+            lad.write_rtp(p)
+        peak = max(peak, lad.pending)
+    for _ in range(400):
+        if lad.pending == 0:
+            break
+        await asyncio.sleep(0.02)
+    assert lad.pending == 0 and not lad._ready
+    assert peak <= lad._max_pending
+    assert lad.shed > 0                  # the flood was real
+    s6 = lad.renditions[6].requant.stats
+    assert s6.slices_requantized > 0     # and so was the service
+
+
+@pytest.mark.parametrize("entropy", ["cavlc", "cabac"])
+def test_closed_loop_p_slice_drift_path_parallel(entropy):
+    """Closed-loop rung, P-slice drift path: I slices close the loop
+    IN ORDER (picture-spanning reconstruction state), but P slices ride
+    the stateless open-loop path — recoding them from pool workers,
+    out of order, must be byte-identical to the serial pass."""
+    import lavc_encode as le
+    if not le.available():
+        pytest.skip("x264 encode shim unavailable")
+    nals = le.encode_ippp(96, 96, 5, qp=26, cabac=(entropy == "cabac"),
+                          extra="no-deblock=1")
+    serial = SliceRequantizer(6, prefer_native=False, closed_loop=True)
+    out_serial = [serial.transform_nal(n) for n in nals]
+    assert serial.stats.slices_passed_through == 0
+
+    par = SliceRequantizer(6, prefer_native=False, closed_loop=True)
+    sps = pps = None
+    p_slices = []
+    for i, n in enumerate(nals):
+        t = n[0] & 0x1F
+        if t == 7:
+            sps = Sps.parse(n)
+        elif t == 8:
+            pps = Pps.parse(n)
+        if t == 1:
+            p_slices.append((i, n, sps, pps))
+    out_par = [None] * len(nals)
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        futs = {}
+        for i, n in enumerate(nals):
+            if (n[0] & 0x1F) == 1:
+                continue                 # P slices go to the pool below
+            out_par[i] = par.transform_nal(n)   # I/PS stay serial
+        for i, n, s, p in reversed(p_slices):   # deliberately reversed:
+            futs[i] = pool.submit(par.requant_with, n, s, p)   # order-free
+        for i, fut in futs.items():
+            out_b, delta = fut.result()
+            par.stats.merge(delta)
+            out_par[i] = out_b
+    assert out_par == out_serial
+    assert par.stats.slices_requantized == serial.stats.slices_requantized
+
+
+async def test_ladder_out_of_scope_slice_passes_through(monkeypatch):
+    """Python engine, a slice the parser rejects: every rendition gets
+    the SOURCE slice back (counted passed-through), no reassembly
+    mismatch is recorded, and the surrounding AUs keep flowing."""
+    from easydarwin_tpu import native as native_mod
+    from easydarwin_tpu import obs
+    monkeypatch.setattr(native_mod, "available", lambda: False)
+    mism0 = obs.REQUANT_REASSEMBLY_MISMATCH.as_value()
+    lad = RequantLadder(target_duration=0.1)
+    ch = {d: lad.add_rendition(d) for d in (6, 12)}
+    good = list(_frames(1, n_frames=2))
+    for p in good[0]:
+        lad.write_rtp(p)
+    # a type-5 slice NAL full of junk rides the next AU
+    bad_nal = bytes([0x65]) + b"\xff\x00\x03\x99" * 12
+    for p in nalu.packetize_h264(bad_nal, seq=9000, timestamp=70000,
+                                 ssrc=1, marker_on_last=True):
+        lad.write_rtp(p)
+    for p in good[1]:
+        lad.write_rtp(p)
+    for _ in range(400):
+        if lad.pending == 0:
+            break
+        await asyncio.sleep(0.02)
+    assert lad.pending == 0
+    for d in (6, 12):
+        st = ch[d].requant.stats
+        assert st.slices_passed_through == 1, st
+        assert st.slices_requantized >= 2, st
+    assert obs.REQUANT_REASSEMBLY_MISMATCH.as_value() == mism0
+
+
+def test_ladder_rendition_surface_for_admin_layers():
+    """The q-rung objects the admin/soak layers read keep their shape:
+    .requant.stats, .shed, .pending, playlists, codec strings."""
+    lad = RequantLadder(target_duration=0.1)
+    q6 = lad.add_rendition(6)
+    assert q6.requant.stats.slices_requantized == 0
+    assert q6.shed == 0 and q6.pending == 0
+    assert lad.add_rendition(6) is q6    # idempotent
+    with pytest.raises(ValueError):
+        lad.add_rendition(7)             # not a +6k step
+    with pytest.raises(RuntimeError):
+        q6.send_bytes(b"x", is_rtcp=False)   # fed AUs, never packets
+
+
+def test_hls_service_routes_q_rungs_through_one_ladder():
+    """Segmenter wiring: N q-rungs of one path share ONE RequantLadder
+    session output; temporal rungs stay plain outputs; retire removes
+    the ladder."""
+    from easydarwin_tpu.hls.segmenter import HlsService
+    from easydarwin_tpu.relay.session import SessionRegistry
+
+    VIDEO = ("v=0\r\nm=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+             "a=control:trackID=1\r\n")
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/ladder", VIDEO)
+    svc = HlsService(reg, target_duration=0.2)
+    svc.start("/ladder", ("q6", "q12", 1))
+    entry = svc.outputs["/ladder"]
+    lad = entry.requant_ladder
+    assert lad is not None
+    assert sorted(lad.renditions) == [6, 12]
+    assert entry.renditions["q6"] is lad.renditions[6]
+    track_outputs = sess.streams[1].outputs
+    assert lad in track_outputs
+    assert entry.renditions["q6"] not in track_outputs
+    assert entry.renditions["r1"] in track_outputs
+    svc.stop("/ladder")
+    assert lad not in sess.streams[1].outputs
+
+
+def test_metrics_lint_requant_contract():
+    """lint_requant: the family set + the closed stage vocabulary."""
+    from easydarwin_tpu import obs
+    from tools.metrics_lint import lint_requant
+    assert lint_requant(obs.REGISTRY) == []
+    assert set(REQUANT_STAGES) == {"parse", "entropy",
+                                   "transform_device", "recode",
+                                   "reassemble"}
+    # an out-of-vocabulary observed stage must be flagged
+    obs.REQUANT_STAGE_SECONDS.observe(0.001, stage="made_up_stage")
+    try:
+        errs = lint_requant(obs.REGISTRY)
+        assert any("made_up_stage" in e for e in errs)
+    finally:
+        obs.REQUANT_STAGE_SECONDS._states.pop(("made_up_stage",), None)
+    assert lint_requant(obs.REGISTRY) == []
+
+
+def test_bench_gate_validates_h264_requant_section(tmp_path):
+    """bench_gate --check-only: a well-formed h264_requant ladder
+    section passes; sheds or a disengaged multi-worker pool fail; old
+    rounds without the section stay valid."""
+    import json
+
+    from tools.bench_gate import check_trajectory
+
+    def round_with(rq):
+        parsed = {"metric": "m", "value": 1.0, "unit": "u",
+                  "vs_baseline": 1.0, "extra": {"h264_requant": rq}}
+        return [{"file": "BENCH_r9.json", "rc": 0, "parsed": parsed}]
+
+    good = {"renditions_requested": 3, "renditions_sustained": 0.4,
+            "workers": 2, "parallel_speedup": 0.9,
+            "worker_concurrency": 1.6, "shared_parse_amortization": 1.5,
+            "sheds": 0}
+    assert check_trajectory(round_with(good)) == []
+    bad_shed = dict(good, sheds=3)
+    assert any("sheds" in e for e in check_trajectory(round_with(bad_shed)))
+    disengaged = dict(good, worker_concurrency=1.0)
+    assert any("never actually engaged" in e
+               for e in check_trajectory(round_with(disengaged)))
+    no_section = round_with(None)
+    no_section[0]["parsed"]["extra"] = {}
+    assert check_trajectory(no_section) == []
+    # the real trajectory (with or without the new section) stays valid
+    from tools.bench_gate import load_trajectory
+    warnings = []
+    assert check_trajectory(load_trajectory(), warnings) == []
+
+
+async def test_ladder_stage_histogram_closed_vocab_observed():
+    """A pooled ladder run observes only closed-vocabulary stages, and
+    the pipeline counters advance coherently."""
+    from easydarwin_tpu import obs
+    aus0 = obs.REQUANT_AUS.as_value()
+    rend0 = obs.REQUANT_RENDITIONS.as_value()
+    mism0 = obs.REQUANT_REASSEMBLY_MISMATCH.as_value()
+    lad = RequantLadder(target_duration=0.1)
+    for d in (6, 12):
+        lad.add_rendition(d)
+    for fr in _frames(2, n_frames=4):
+        while lad.pending + 1 >= lad._max_pending:
+            await asyncio.sleep(0.005)
+        for p in fr:
+            lad.write_rtp(p)
+    for _ in range(400):
+        if lad.pending == 0:
+            break
+        await asyncio.sleep(0.02)
+    assert lad.pending == 0
+    for (stage,) in obs.REQUANT_STAGE_SECONDS._states:
+        assert stage in REQUANT_STAGES
+    d_aus = obs.REQUANT_AUS.as_value() - aus0
+    d_rend = obs.REQUANT_RENDITIONS.as_value() - rend0
+    assert d_aus >= 4
+    assert d_rend == 2 * d_aus
+    assert obs.REQUANT_REASSEMBLY_MISMATCH.as_value() == mism0

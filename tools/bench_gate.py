@@ -188,6 +188,44 @@ def check_trajectory(traj: list[dict],
             if eff is not None and eff not in known:
                 errs.append(f"{name}: egress_backends.effective {eff!r} "
                             f"outside the closed ladder {known}")
+        # ISSUE 9 requant-ladder section — OPTIONAL (rounds predating
+        # the ABR ladder carry only the flat h264_requant_* keys and
+        # stay valid), but when present: the rendition figures are
+        # positive finite, nothing shed under the bench's backpressure-
+        # paced feed, and a multi-worker pool must show its workers
+        # actually engaged (measured worker concurrency > 1 — the
+        # r04/r05 rounds shipped workers=1-equivalent behavior with no
+        # way to see it from the trajectory)
+        rql = extra.get("h264_requant")
+        if isinstance(rql, dict) and rql and "error" not in rql:
+            rr = rql.get("renditions_requested")
+            if not isinstance(rr, int) or rr < 1:
+                errs.append(f"{name}: h264_requant.renditions_requested "
+                            f"{rr!r} not a positive count")
+            for kf in ("renditions_sustained", "parallel_speedup",
+                       "worker_concurrency",
+                       "shared_parse_amortization"):
+                v2 = rql.get(kf)
+                if v2 is None and kf in ("worker_concurrency",):
+                    continue             # older shape of the section
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 <= 0:
+                    errs.append(f"{name}: h264_requant.{kf} {v2!r} not "
+                                "a positive finite figure")
+            w = rql.get("workers")
+            if not isinstance(w, int) or w < 1:
+                errs.append(f"{name}: h264_requant.workers {w!r} not a "
+                            "positive worker count")
+            if rql.get("sheds", 0):
+                errs.append(f"{name}: h264_requant recorded "
+                            f"{rql['sheds']} sheds under the paced "
+                            "bench feed (admission gate broken)")
+            conc = rql.get("worker_concurrency")
+            if isinstance(w, int) and w > 1 \
+                    and isinstance(conc, (int, float)) and conc < 1.05:
+                errs.append(f"{name}: h264_requant pool sized {w} "
+                            f"workers but measured concurrency {conc} "
+                            "(workers never actually engaged)")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

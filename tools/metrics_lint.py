@@ -360,6 +360,50 @@ def lint_cluster(registry, schema: dict) -> list[str]:
     return errs
 
 
+def lint_requant(registry) -> list[str]:
+    """The ABR-ladder requant contract (ISSUE 9): the pipeline families
+    exist with their exact label sets, and every observed ``stage``
+    label of ``requant_stage_seconds`` stays inside the CLOSED
+    ``hls.requant.REQUANT_STAGES`` vocabulary (parse / entropy /
+    transform_device / recode / reassemble) — an open vocabulary would
+    shard the stage histogram and break the ladder dashboards;
+    ``tools/soak.py --hls-ladder`` keys on these families."""
+    errs: list[str] = []
+    want_labels = {
+        "requant_aus_total": (),
+        "requant_slices_total": (),
+        "requant_renditions_total": (),
+        "requant_shed_total": (),
+        "requant_reassembly_mismatch_total": (),
+        "requant_stage_seconds": ("stage",),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"requant family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    from easydarwin_tpu.hls.requant import REQUANT_STAGES
+    for v in REQUANT_STAGES:
+        if not NAME_RE.match(v):
+            errs.append(f"requant stage vocabulary entry {v!r} not "
+                        "snake_case")
+    fam = fams.get("requant_stage_seconds")
+    if fam is not None:
+        for (stage,) in getattr(fam, "_states", {}):
+            if stage not in REQUANT_STAGES:
+                errs.append(f"requant_stage_seconds: observed stage "
+                            f"{stage!r} outside the closed set "
+                            f"{REQUANT_STAGES}")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -452,6 +496,9 @@ def main() -> int:
     # the egress-backend ladder's vocabulary (ISSUE 8): probe families,
     # closed backend labels, the fallback event, the io_uring phase
     errs += lint_egress_backends(obs.REGISTRY, ev.SCHEMA)
+    # the ABR requant ladder's vocabulary (ISSUE 9): pipeline counter
+    # families + the closed requant stage set
+    errs += lint_requant(obs.REGISTRY)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -96,7 +96,8 @@ def parse_metrics(text: str) -> dict[str, float]:
 def check_metrics(scrapes: list[dict[str, float]], *,
                   expect_megabatch: bool = False,
                   chaos: bool = False,
-                  forced_backend: str | None = None) -> list[str]:
+                  forced_backend: str | None = None,
+                  hls_ladder: int = 0) -> list[str]:
     """Counter-regression checks over the soak's periodic scrapes.
 
     ``chaos=True`` (a seeded FaultPlan was armed) skips exactly the
@@ -150,6 +151,29 @@ def check_metrics(scrapes: list[dict[str, float]], *,
     if expect_megabatch and last.get("megabatch_passes_total", 0) == 0:
         errs.append("multi-source soak ran zero megabatched passes "
                     "(scheduler disengaged)")
+    # requant-ladder invariants (ISSUE 9): a reassembly mismatch is a
+    # pipeline bookkeeping bug at ANY time; a ladder soak must actually
+    # have served AUs through every stage, and a CLEAN ladder soak must
+    # never shed (the pool is sized for the box; shedding under the
+    # soak's paced load means admission or sizing regressed)
+    if last.get("requant_reassembly_mismatch_total", 0) > 0:
+        errs.append(f"requant slice-reassembly mismatches: "
+                    f"{last['requant_reassembly_mismatch_total']:.0f}")
+    if hls_ladder:
+        if last.get("requant_aus_total", 0) == 0:
+            errs.append("hls-ladder soak requanted zero AUs")
+        aus = last.get("requant_aus_total", 0)
+        rend = last.get("requant_renditions_total", 0)
+        if aus and rend < aus * hls_ladder:
+            errs.append(f"ladder width shrank: {rend:.0f} rendition-AUs "
+                        f"from {aus:.0f} AUs at width {hls_ladder}")
+        stage_obs = sum(v for k, v in last.items()
+                        if k.startswith("requant_stage_seconds_count"))
+        if stage_obs == 0:
+            errs.append("requant_stage_seconds histograms stayed empty")
+        if not chaos and last.get("requant_shed_total", 0) > 0:
+            errs.append(f"ladder shed AUs during a clean soak: "
+                        f"{last['requant_shed_total']:.0f}")
     if last.get("ingest_oversize_dropped_total", 0) > 0:
         errs.append(f"ingest drops: "
                     f"{last['ingest_oversize_dropped_total']:.0f}")
@@ -376,8 +400,10 @@ def _check_chaos(app, clear_time: float, t_full: float | None,
 
 async def soak(seconds: float, n_sources: int = 0,
                chaos_seed: int | None = None, devices: int = 1,
-               egress_backend: str | None = None) -> int:
+               egress_backend: str | None = None,
+               hls_ladder: int = 0) -> int:
     chaos = chaos_seed is not None
+    hls_ladder = max(0, min(int(hls_ladder), 3))   # q6..q18 in 6-steps
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
                        access_log_enabled=False)
@@ -472,8 +498,25 @@ async def soak(seconds: float, n_sources: int = 0,
         async def rest_get(path):
             return await asyncio.to_thread(_get, path)
 
-        await rest_get("/api/v1/starthls?path=/live/a&rungs=1,q6")
-        await rest_get("/api/v1/starthls?path=/live/c&rungs=q6")
+        # --hls-ladder N widens the q-ladder on BOTH coded pushers: the
+        # N renditions share one RequantLadder per path (one parse per
+        # AU, slice x rendition fan-out across the pool)
+        ladder_rungs = ",".join(f"q{6 * (i + 1)}"
+                                for i in range(max(1, hls_ladder)))
+        await rest_get(f"/api/v1/starthls?path=/live/a&rungs=1,"
+                       f"{ladder_rungs}")
+        await rest_get(f"/api/v1/starthls?path=/live/c&rungs="
+                       f"{ladder_rungs}")
+        ladder_pending_peak = [0, 0]     # [/live/a, /live/c]
+
+        def _ladders():
+            out = []
+            for i, key in enumerate(("/live/a", "/live/c")):
+                e = app.hls.outputs.get(key)
+                lad = getattr(e, "requant_ladder", None) if e else None
+                if lad is not None:
+                    out.append((i, lad))
+            return out
 
         # pre-encode one GOP-ish cycle BEFORE the clock starts and before
         # the drain task runs (pure-Python encode per frame would
@@ -605,6 +648,10 @@ async def soak(seconds: float, n_sources: int = 0,
                 rr = struct.pack("!BBHIIIIIII", 0x81, 201, 7, 0x7B7B,
                                  plain_out.rewrite.ssrc, 0, 0, 0, 0, 0)
                 udp2_rtcp.sendto(rr, ("127.0.0.1", egress.rtcp_port))
+            if f % 10 == 7:            # ladder pipeline-bound sampling:
+                for li, lad in _ladders():   # pending must stay under the
+                    ladder_pending_peak[li] = max(   # admission bound
+                        ladder_pending_peak[li], lad.pending)
             if f % 30 == 10:           # periodic NADU (comfortable buffer)
                 from easydarwin_tpu.protocol.rtcp import Nadu, NaduBlock
                 udp_rtcp.sendto(Nadu(9, [NaduBlock(
@@ -642,6 +689,53 @@ async def soak(seconds: float, n_sources: int = 0,
         q6 = entry.renditions.get("q6") if entry else None
         entry_c = app.hls.outputs.get("/live/c")
         q6c = entry_c.renditions.get("q6") if entry_c else None
+        # drain the requant ladders before judging them: in-flight AUs
+        # at loop end are normal pipelining, stuck ones are a failure
+        for _ in range(100):
+            if all(lad.pending == 0 for _i, lad in _ladders()):
+                break
+            await asyncio.sleep(0.05)
+        if hls_ladder:
+            names = [f"q{6 * (i + 1)}" for i in range(hls_ladder)]
+            for key, ent in (("/live/a", entry), ("/live/c", entry_c)):
+                lad = getattr(ent, "requant_ladder", None) if ent else None
+                if lad is None:
+                    failures.append(f"{key}: no requant ladder built")
+                    continue
+                if sorted(lad.renditions) != [6 * (i + 1)
+                                              for i in range(hls_ladder)]:
+                    failures.append(f"{key}: ladder rungs "
+                                    f"{sorted(lad.renditions)}")
+                if lad.pending:
+                    failures.append(f"{key}: ladder pending stuck at "
+                                    f"{lad.pending} after drain")
+                if not chaos and lad.shed:
+                    failures.append(f"{key}: ladder shed {lad.shed} AUs "
+                                    "(pipeline over budget)")
+                for nm in names:
+                    rend = ent.renditions.get(nm)
+                    if rend is None or not rend.segments:
+                        failures.append(
+                            f"{key}: rendition {nm} produced no "
+                            "segments")
+                    elif not chaos \
+                            and rend.requant.stats.slices_requantized \
+                            < 5:
+                        failures.append(
+                            f"{key}: rendition {nm} requanted only "
+                            f"{rend.requant.stats.slices_requantized} "
+                            "slices")
+            for li, key in ((0, "/live/a"), (1, "/live/c")):
+                ent2 = app.hls.outputs.get(key)
+                lad = getattr(ent2, "requant_ladder", None) if ent2 \
+                    else None
+                if lad is not None \
+                        and ladder_pending_peak[li] > lad._max_pending:
+                    failures.append(
+                        f"{key}: ladder pending peaked at "
+                        f"{ladder_pending_peak[li]} above the "
+                        f"{lad._max_pending} admission bound "
+                        "(unbounded growth)")
         if not chaos:
             st, body = await rest_get("/hls/live/a/q6/index.m3u8")
             if b"#EXTINF" not in body:
@@ -701,7 +795,8 @@ async def soak(seconds: float, n_sources: int = 0,
         failures.extend(check_metrics(scrapes,
                                       expect_megabatch=n_sources >= 2,
                                       chaos=chaos,
-                                      forced_backend=egress_backend))
+                                      forced_backend=egress_backend,
+                                      hls_ladder=hls_ladder))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -718,6 +813,14 @@ async def soak(seconds: float, n_sources: int = 0,
             "retransmits": rel_out.resender.resent,
             "requant": str(q6.requant.stats) if q6 else None,
             "hls_shed": q6.shed if q6 else None,
+            "ladder_width": hls_ladder,
+            "ladder_pending_peak": ladder_pending_peak,
+            "ladder_aus": mlast.get("requant_aus_total"),
+            "ladder_rendition_aus": mlast.get("requant_renditions_total"),
+            "ladder_stage_counts": {
+                k[len("requant_stage_seconds_count"):]: v
+                for k, v in mlast.items()
+                if k.startswith("requant_stage_seconds_count")},
             "rtcp_in": egress.rtcp_in,
             "metrics_scrapes": len(scrapes),
             "wire_bytes": mlast.get("egress_bytes_total"),
@@ -1152,6 +1255,14 @@ def _parse_args(argv: list[str]):
                          "/metrics egress_backend_info) differs from "
                          "the forced one, or if zerocopy completions "
                          "hide their loopback copy verdicts")
+    ap.add_argument("--hls-ladder", type=int, default=0, metavar="N",
+                    help="serve an N-rendition requant ladder "
+                         "(q6,q12,q18 truncated to N, max 3) on the "
+                         "coded pushers end-to-end through the "
+                         "segmenter (ISSUE 9); fails on any AU "
+                         "shedding, unbounded ladder pending() growth, "
+                         "or a nonzero slice-reassembly mismatch "
+                         "counter")
     ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
                     metavar="SEED",
                     help="run under a seeded FaultPlan (resilience/"
@@ -1209,4 +1320,5 @@ if __name__ == "__main__":
                          _ns.chaos if _ns.chaos is not None else 7)))
     raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
                                       _ns.chaos, _ns.devices,
-                                      _ns.egress_backend)))
+                                      _ns.egress_backend,
+                                      _ns.hls_ladder)))
