@@ -16,6 +16,7 @@ The transcode ladder (ops.transform) will fan one ingest into N
 from __future__ import annotations
 
 import struct
+from collections import deque
 from dataclasses import dataclass, field
 
 from ..protocol.aac import AAC_SAMPLES_PER_FRAME, AacConfig
@@ -196,7 +197,9 @@ class HlsOutput(RelayOutput):
         #: AUs ride UNCHANGED through every rendition — thinning and
         #: requant are video-axis transforms (VERDICT r3 item 4)
         self.audio = audio
-        self._audio_pending: list[tuple[bytes, int]] = []
+        # deque: overflow shedding pops from the FRONT per AU, and
+        # list.pop(0) is O(P) per shed (the VOD pacer deque fix shape)
+        self._audio_pending: deque[tuple[bytes, int]] = deque()
         self._audio_dts = 0           # running tfdt, audio timescale
         self._audio_last_dur = AAC_SAMPLES_PER_FRAME
         self._audio_prev_ts: int | None = None
@@ -257,7 +260,7 @@ class HlsOutput(RelayOutput):
                           * self.audio.sample_rate
                           // AAC_SAMPLES_PER_FRAME)
         while len(self._audio_pending) > max_aus:
-            self._audio_pending.pop(0)
+            self._audio_pending.popleft()
             self.audio_dropped += 1
 
     def _drain_audio(self) -> tuple[list, int]:
@@ -268,8 +271,8 @@ class HlsOutput(RelayOutput):
         advance in lockstep."""
         if not self._audio_pending:
             return [], self._audio_dts
-        aus = self._audio_pending
-        self._audio_pending = []
+        aus = list(self._audio_pending)
+        self._audio_pending.clear()
         if self._audio_prev_ts is not None:
             # the previous batch's final AU got a GUESSED duration; the
             # real one is this batch's first ts minus its ts — reconcile

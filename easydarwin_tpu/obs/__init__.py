@@ -41,7 +41,9 @@ from .families import (  # noqa: F401  (re-exported inventory)
     RESILIENCE_TRANSITIONS, SLO_BUDGET_REMAINING, SLO_VIOLATIONS,
     STAGE_GATHER_BUSY_SECONDS, STAGE_GATHER_BYTES, TPU_D2H_BYTES,
     TPU_H2D_BYTES, TPU_HEADERS_RENDERED, TPU_PACKETS_SENT,
-    TPU_PARAM_REFRESHES, TPU_PASSES, TPU_PASS_SECONDS)
+    TPU_PARAM_REFRESHES, TPU_PASSES, TPU_PASS_SECONDS,
+    VOD_CACHE_BYTES, VOD_CACHE_EVICTIONS, VOD_CACHE_HITS,
+    VOD_CACHE_MISSES, VOD_PACKETS, VOD_SESSIONS)
 from .flight import FLIGHT, FlightRecorder  # noqa: F401
 from .metrics import (  # noqa: F401
     TIME_BUCKETS, Counter, Gauge, Histogram, Registry)

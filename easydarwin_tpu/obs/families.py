@@ -271,6 +271,40 @@ REQUANT_STAGE_SECONDS = REGISTRY.histogram(
     "entropy re-encode, reassemble = ordered per-AU emit), by stage",
     labels=("stage",), buckets=TIME_BUCKETS)
 
+# ------------------------------------------------------------ VOD cache
+# The device-resident VOD segment cache + shared group pacer (ISSUE 10:
+# vod/cache.py + vod/session.py).  tools/metrics_lint.py enforces this
+# family set (lint_vod: exact labels, path value vocabulary closed to
+# hot|cold) and tools/soak.py --vod keys on it.
+VOD_CACHE_HITS = REGISTRY.counter(
+    "vod_cache_hits_total",
+    "Segment-cache window lookups served from a packed entry (the "
+    "pacer's vectorized hot fill path)")
+VOD_CACHE_MISSES = REGISTRY.counter(
+    "vod_cache_misses_total",
+    "Segment-cache window lookups that found no packed entry (the "
+    "subscriber streams through the cold per-sample mmap path while a "
+    "background fill packs the window)")
+VOD_CACHE_EVICTIONS = REGISTRY.counter(
+    "vod_cache_evictions_total",
+    "Packed windows evicted by the byte-budgeted LRU (pinned windows — "
+    "currently serving a pacer cursor — are never evicted)")
+VOD_CACHE_BYTES = REGISTRY.gauge(
+    "vod_cache_bytes",
+    "Bytes currently held by the VOD segment cache (packed packet "
+    "slots + pre-staged upload rows + HBM-resident copies)")
+VOD_SESSIONS = REGISTRY.gauge(
+    "vod_sessions_count",
+    "Paced VOD sessions currently registered with the shared group "
+    "pacer (hot engine-served sessions only; cold FileSession players "
+    "are not pacer-owned)")
+VOD_PACKETS = REGISTRY.counter(
+    "vod_packets_total",
+    "RTP packets staged into VOD subscriber rings by the group pacer, "
+    "by serving path (hot = vectorized copy from a packed cache window, "
+    "cold = per-sample mmap packetization on a cache miss)",
+    labels=("path",))
+
 # ------------------------------------------------------------------- QoS
 QOS_FRACTION_LOST = REGISTRY.gauge(
     "qos_fraction_lost_ratio",

@@ -67,14 +67,18 @@ from .trace import TRACER
 #: ``egress_native``, filed under its own phase when the io_uring
 #: backend serves the pass — the backend-labelled attribution that lets
 #: a dashboard compare per-pass egress cost across backends directly
+#: ``cache_fill`` is the VOD segment cache's window pack (packetize +
+#: classify + staging-row pre-pack, vod/cache.py) — filed under the
+#: ``vod`` engine so a dashboard can see what hot-asset admission costs
 PHASES = ("wake_to_pass", "h2d", "device_step", "d2h", "egress_native",
-          "egress_io_uring", "rtcp_qos", "stage_gather", "h2d_overlap")
+          "egress_io_uring", "rtcp_qos", "stage_gather", "h2d_overlap",
+          "cache_fill")
 #: engines that record phases: the native sendmmsg fast path, the
 #: [S,P,12] batch-header path, the scalar oracle, the jitted model
 #: pipeline, the pump loop (wake→pass only), the cross-stream megabatch
-#: scheduler and test harnesses
+#: scheduler, the VOD pacer/cache tier and test harnesses
 ENGINES = ("native", "batch", "scalar", "pipeline", "pump", "megabatch",
-           "test")
+           "vod", "test")
 
 #: sessions tracked for top-N attribution (LRU beyond this)
 MAX_SESSIONS = 256

@@ -54,6 +54,29 @@ def rows_per_shard(n_rows: int, n_shards: int) -> int:
     return pow2((max(n_rows, 1) + n_shards - 1) // n_shards, 1)
 
 
+def pack_rows(data: np.ndarray, length: np.ndarray,
+              out_rows: np.ndarray | None = None,
+              prefix_width: int = PARSE_PREFIX) -> np.ndarray:
+    """Vectorized pack of ``[N, slot]`` packet bytes + lengths into fused
+    staging rows (``[N(+pad), ROW_STRIDE]``: prefix ∥ le32 length).
+
+    The VOD segment cache (``vod/cache.py``) pre-packs every window's
+    rows ONCE at fill time with this, so a megabatch gather over a
+    cache-fed ring is a plain row memcpy — the per-row length packing
+    is paid per asset window, not per (subscriber, wake)."""
+    n = len(length)
+    if out_rows is None:
+        out_rows = np.zeros((n, prefix_width + WINDOW_EXTRA), np.uint8)
+    w = min(prefix_width, data.shape[1])
+    out_rows[:n, :w] = data[:, :w]
+    lens = np.ascontiguousarray(length, "<u4")
+    out_rows[:n, prefix_width:prefix_width + 4] = \
+        lens[:, None].view(np.uint8)
+    out_rows[:n, prefix_width + 4:] = 0
+    out_rows[n:] = 0
+    return out_rows
+
+
 def gather_window(ring, start: int, count: int, out_rows: np.ndarray,
                   prefix_width: int = PARSE_PREFIX) -> int:
     """Pack ``count`` packets from absolute id ``start`` of ``ring`` (a
@@ -71,6 +94,13 @@ def gather_window(ring, start: int, count: int, out_rows: np.ndarray,
         out_rows[:] = 0
         return 0
     slots = (np.arange(start, stop) % ring.capacity).astype(np.int32)
+    staged = getattr(ring, "staged", None)
+    if staged is not None and prefix_width == PARSE_PREFIX:
+        # pre-staged ring (VOD cache fill keeps a parallel fused-row
+        # array current): one fancy-index row copy, no length packing
+        out_rows[:n] = staged[slots]
+        out_rows[n:] = 0
+        return n
     from .. import native
     if native.loaded():
         r = native.stage_gather(ring.data, ring.length, slots,

@@ -704,20 +704,34 @@ class TpuFanoutEngine:
             return 0
         t_h2d = time.perf_counter_ns() if PROFILER.enabled else 0
         idx = ids % ring.capacity
-        prefix = data[:, :self.prefix_width]
-        age = (now_ms - ring.arrival[idx]).astype(np.int32)
+        n = len(ids)
+        # pow2-pad the window axis (the ONE bucket-shape rounding rule):
+        # relay_batch_step re-traces per input shape, and a raw window
+        # length means every distinct backlog size pays a full
+        # recompile — the VOD catch-up path surfaced this as a compile
+        # storm (each ~0.7 s compile delayed the pump, which grew the
+        # next window, which was a NEW shape...).  Padding rows carry
+        # length 0, so the device marks them invalid and the per-output
+        # walk below never reaches them (j < n by construction).
+        p_pad = _pow2(n, 16)
+        prefix = np.zeros((p_pad, self.prefix_width), np.uint8)
+        prefix[:n] = data[:, :self.prefix_width]
+        lens_p = np.zeros(p_pad, np.int32)
+        lens_p[:n] = lengths
+        age = np.zeros(p_pad, np.int32)
+        age[:n] = (now_ms - ring.arrival[idx]).astype(np.int32)
         state = fanout_ops.pack_output_state([o for o, _ in flat])
         buckets = np.array([b for _, b in flat], dtype=np.int32)
 
         t_dev = time.perf_counter_ns() if t_h2d else 0
         res = fanout_ops.relay_batch_step(
-            prefix, lengths.astype(np.int32), age, state, buckets,
+            prefix, lens_p, age, state, buckets,
             np.int32(stream.settings.bucket_delay_ms))
         t_d2h = time.perf_counter_ns() if t_h2d else 0
         headers = np.asarray(res["headers"])     # blocks: the D2H wait
         if t_h2d:
             self._phase_add("h2d", t_dev - t_h2d, engine="batch")
-            shape_key = ("batch", prefix.shape, len(flat))
+            shape_key = ("batch", p_pad, self.prefix_width, len(flat))
             if shape_key not in self._traced_shapes:
                 # relay_batch_step re-traces per (window, outputs) shape
                 self._traced_shapes.add(shape_key)
@@ -729,12 +743,14 @@ class TpuFanoutEngine:
                                 engine="batch")
                 self._phase_add("d2h", time.perf_counter_ns() - t_d2h,
                                 engine="batch")
-        # the whole window's prefixes+metadata crossed to the device and
-        # the [S, P, 12] header block crossed back
-        obs.TPU_H2D_BYTES.inc(prefix.nbytes + lengths.nbytes + age.nbytes
+        # the whole PADDED window's prefixes+metadata crossed to the
+        # device and the [S, P_pad, 12] header block crossed back; only
+        # the n real rows count as rendered headers (padding rows are
+        # never read by the walk below)
+        obs.TPU_H2D_BYTES.inc(prefix.nbytes + lens_p.nbytes + age.nbytes
                               + np.asarray(state).nbytes)
         obs.TPU_D2H_BYTES.inc(headers.nbytes)
-        obs.TPU_HEADERS_RENDERED.inc(headers.shape[0] * headers.shape[1])
+        obs.TPU_HEADERS_RENDERED.inc(headers.shape[0] * n)
 
         sent = 0
         lat_ns: list[int] = []

@@ -138,6 +138,52 @@ class PacketRing:
         self.head = pid + 1
         return pid
 
+    def push_block(self, data: np.ndarray, length: np.ndarray,
+                   arrival_ms: np.ndarray, flags: np.ndarray,
+                   seq: np.ndarray, timestamp: np.ndarray,
+                   arrival_ns: np.ndarray | None = None) -> int:
+        """Vectorized multi-packet admit: copy ``n`` pre-classified
+        packets (``data [n, <=slot_size]`` uint8 rows, parallel
+        per-packet metadata arrays) into consecutive slots in a handful
+        of fancy-index numpy ops — the VOD pacer's hot fill (a packed
+        cache window needs no per-packet Python parse/classify; the
+        caller supplies the flags/seq/ts it packed once at cache-fill
+        time).  The RTP seq bytes of each row are restamped from ``seq``
+        so a shared canonical window serves per-subscriber rings.
+        Returns the absolute id of the first admitted packet."""
+        n = len(length)
+        if n == 0:
+            return self.head
+        if n > self.capacity:
+            raise ValueError(f"push_block of {n} > capacity "
+                             f"{self.capacity}")
+        overflow = len(self) + n - self.capacity
+        if overflow > 0:                 # overwrite-oldest, like push()
+            self.tail += overflow
+            self.total_dropped += overflow
+        first = self.head
+        slots = np.arange(first, first + n) % self.capacity
+        w = min(data.shape[1], self.slot_size)
+        self.data[slots, :w] = data[:, :w]
+        if w < self.slot_size:
+            self.data[slots, w:] = 0
+        sq = np.asarray(seq, np.uint32).astype(">u2")
+        self.data[slots, 2:4] = sq[:, None].view(np.uint8)
+        self.length[slots] = length
+        self.arrival[slots] = arrival_ms
+        # the high-res latency stamp: callers staging AHEAD of time
+        # (the VOD pacer fills up to its lookahead horizon) pass each
+        # packet's DUE instant so the ingest->wire histogram measures
+        # pacing delay, not the deliberate lookahead
+        self.arrival_ns[slots] = (time.perf_counter_ns()
+                                  if arrival_ns is None else arrival_ns)
+        self.flags[slots] = flags
+        self.seq[slots] = np.asarray(seq, np.int64) & 0xFFFF
+        self.timestamp[slots] = timestamp
+        self.ssrc[slots] = 0
+        self.head = first + n
+        return first
+
     def native_drain(self, fd: int, now_ms: int, max_pkts: int = 512) -> int:
         """Drain pending datagrams from ``fd`` straight into ring slots via
         the native recvmmsg batcher (``csrc ed_udp_ingest`` — one syscall

@@ -58,13 +58,17 @@ class StreamStats:
 
 
 class RelayStream:
-    def __init__(self, info: StreamInfo, settings: StreamSettings | None = None):
+    def __init__(self, info: StreamInfo,
+                 settings: StreamSettings | None = None, *,
+                 rtp_ring: PacketRing | None = None):
         self.info = info
         self.settings = settings or StreamSettings()
         is_video = info.media_type == "video"
-        self.rtp_ring = PacketRing(self.settings.ring_capacity,
-                                   is_video=is_video,
-                                   codec=info.codec or None)
+        #: callers with a specialized ring (the VOD pacer's staged
+        #: ring) inject it instead of paying for a discarded default
+        self.rtp_ring = rtp_ring if rtp_ring is not None else PacketRing(
+            self.settings.ring_capacity, is_video=is_video,
+            codec=info.codec or None)
         self.rtcp_ring = PacketRing(min(256, self.settings.ring_capacity))
         #: absolute id of the newest keyframe *run head* (video only).
         #: The reference keeps the newest keyframe-first packet

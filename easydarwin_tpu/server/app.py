@@ -141,6 +141,13 @@ class StreamingServer:
         #: start() so a bad device config fails loudly at boot, not on
         #: the first busy wake; None = single-device dispatch
         self.megabatch_mesh = None
+        #: VOD segment cache + shared group pacer (ISSUE 10): hot file
+        #: sessions become megabatch-eligible relay streams the pump
+        #: steps alongside live; built in start() (engines need the
+        #: egress probe's verdict), None = every player runs the cold
+        #: per-session FileSession
+        self.vod_cache = None
+        self.vod_pacer = None
         self.started_at = time.time()
         from .status import StatusMonitor
         self.status = StatusMonitor(self)
@@ -233,6 +240,39 @@ class StreamingServer:
                     self.error_log.warning(
                         f"megabatch mesh unavailable, serving "
                         f"single-device: {e!r}")
+        if self.config.vod_cache_enabled:
+            from ..vod.cache import SegmentCache
+            from ..vod.session import VodPacerGroup
+            self.vod_cache = SegmentCache(
+                budget_bytes=self.config.vod_cache_bytes,
+                window_samples=self.config.vod_cache_window_samples,
+                device=self.config.vod_cache_device)
+            self.vod_pacer = VodPacerGroup(
+                self.vod_cache,
+                engine_for=self._engine_for,
+                engine_drop=lambda s: self._engines.pop(id(s), None),
+                scheduler=lambda: self.megabatch,
+                settings=self.config.stream_settings(),
+                lookahead_ms=self.config.vod_cache_lookahead_ms,
+                device_prime=(self.config.vod_cache_device
+                              and self.config.tpu_fanout))
+            self.rtsp.vod_pacer = self.vod_pacer
+            if self.checkpoint is not None:
+                # re-warm the previous process's hot set (PR 5 shape:
+                # metadata only — windows re-pack in the background on
+                # each asset's first open)
+                import json
+                self._vod_ckpt_path = os.path.join(
+                    self.config.log_folder, "ckpt", "vod_cache.json")
+                try:
+                    with open(self._vod_ckpt_path,
+                              encoding="utf-8") as fh:
+                        n = self.vod_cache.restore(json.load(fh))
+                    if n and self.error_log:
+                        self.error_log.info(
+                            f"vod cache: re-warming {n} windows")
+                except (OSError, ValueError):
+                    pass
         self._tasks = [
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
@@ -282,6 +322,8 @@ class StreamingServer:
             # last state, not the last periodic interval
             try:
                 self.checkpoint.write(self.registry)
+                if self.vod_cache is not None:
+                    self._write_vod_cache_meta()
             except Exception:
                 pass
         if self._armed_faults:
@@ -308,6 +350,15 @@ class StreamingServer:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        if self.vod_pacer is not None:
+            self.rtsp.vod_pacer = None
+            try:
+                self.vod_pacer.close()
+                self.vod_cache.close()
+            except Exception:
+                pass
+            self.vod_pacer = None
+            self.vod_cache = None
         self.relay_source.close_all()
         self.transcodes.stop_all()
         await self.pulls.stop_all()
@@ -446,6 +497,24 @@ class StreamingServer:
             text = await self._user_describe_fallback(path)
         return text
 
+    def _write_vod_cache_meta(self) -> None:
+        """Atomic write of the segment cache's hot-set metadata next to
+        the relay checkpoint (same cadence, same tmp+rename rule)."""
+        import json
+        import os
+        path = getattr(self, "_vod_ckpt_path", None)
+        if path is None:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.vod_cache.snapshot(), fh,
+                          separators=(",", ":"))
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
     def _sweep_restored(self) -> None:
         """Reap restored subscribers whose player never proved itself:
         no ownership-proven RTCP for ``rtsp_timeout_sec`` (the same
@@ -556,6 +625,20 @@ class StreamingServer:
         # end_wake stages+dispatches the next pass after the loop.  Any
         # scheduler failure degrades to per-stream stepping, never to a
         # halted pump.
+        # VOD group pacer (ISSUE 10): fill every hot session's rings up
+        # to the lookahead horizon and collect its (stream, engine)
+        # pairs — paced VOD subscribers are first-class relay streams
+        # the pump steps below and the megabatch scheduler coalesces
+        # with live streams.  Any pacer failure degrades THIS wake's
+        # VOD service, never the pump.
+        vod_pairs = []
+        if self.vod_pacer is not None and self.vod_pacer.sessions:
+            try:
+                vod_pairs = self.vod_pacer.tick(t)
+            except Exception as e:
+                vod_pairs = []
+                if self.error_log:
+                    self.error_log.warning(f"vod pacer: {e!r}")
         mega_pairs = []
         lad = self.ladder
         if use_tpu and self.config.megabatch_enabled:
@@ -566,6 +649,11 @@ class StreamingServer:
                                  or lad.allows_megabatch(sess.path))):
                         mega_pairs.append((stream,
                                            self._engine_for(stream)))
+            # paced VOD streams are always megabatch-eligible when the
+            # engine tier is on: the affine rewrite is content-
+            # independent, and a 1-subscriber VOD stream costs one
+            # bucket row, not a device pass
+            mega_pairs.extend(vod_pairs)
             if len(mega_pairs) >= self.config.megabatch_min_streams:
                 if self.megabatch is None:
                     from ..relay.megabatch import MegabatchScheduler
@@ -641,6 +729,32 @@ class StreamingServer:
                 # time wake cannot unblock a full socket)
                 stream._last_pass_stalled = \
                     stream.stats.stalls > pre_stalls
+        # paced VOD streams: same per-stream guard discipline as live.
+        # The device gate ignores tpu_min_outputs — a VOD subscriber is
+        # one output by construction, and its device cost is a bucket
+        # row in the stacked pass, not a per-stream dispatch
+        for stream, eng in vod_pairs:
+            pre_stalls = stream.stats.stalls
+            try:
+                if use_tpu and eng is not None:
+                    eng.megabatch_owned = id(stream) in mega_ids
+                    sent += eng.step(stream, t)
+                else:
+                    sent += stream.reflect(t)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(
+                        f"vod reflect error on {stream.session_path}: "
+                        f"{e!r}")
+            try:
+                for out in stream.tickable_outputs:
+                    sent += out.tick(t)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(
+                        f"vod tick error on {stream.session_path}: {e!r}")
+            stream._last_pass_stalled = \
+                stream.stats.stalls > pre_stalls
         if mega_pairs:
             try:
                 self.megabatch.end_wake(mega_pairs, t)
@@ -730,7 +844,9 @@ class StreamingServer:
                             self.error_log.warning(f"ladder tick: {e!r}")
                 if self.checkpoint is not None:
                     try:
-                        self.checkpoint.maybe_write(self.registry)
+                        wrote = self.checkpoint.maybe_write(self.registry)
+                        if wrote and self.vod_cache is not None:
+                            self._write_vod_cache_meta()
                     except Exception as e:
                         if self.error_log:
                             self.error_log.warning(f"checkpoint: {e!r}")

@@ -38,6 +38,22 @@ class ServerConfig:
     timeout_sweep_sec: int = 15        # TimeoutTask.h:66 granularity
     # --- VOD
     movie_folder: str = "/tmp/movies"
+    # --- VOD segment cache (ISSUE 10: vod/cache.py + the group pacer).
+    # On: PLAY on a file path is served by the shared group pacer — hot
+    # assets' samples are pre-packed into the fixed-slot ring-window
+    # format once and every subscriber rides the same megabatch/affine
+    # engine as live relay; cache misses stream through the cold mmap
+    # path while a background fill packs the window.  Off: every player
+    # gets the per-session asyncio FileSession (the pre-ISSUE-10 path,
+    # still used for Scale/meta-info/hinted sessions either way).
+    vod_cache_enabled: bool = True
+    vod_cache_bytes: int = 268_435_456     # LRU byte budget (host + HBM)
+    vod_cache_window_samples: int = 64     # samples packed per window
+    vod_cache_lookahead_ms: int = 500      # pacer ring-fill horizon
+    # keep each packed window's staged rows HBM-resident (uploaded once,
+    # shared by every subscriber on that window) so a hot join's affine
+    # prime pass costs zero H2D; host-only caching when off
+    vod_cache_device: bool = True
     # --- dynamic modules (QTSServer::LoadModules / module_folder pref)
     module_folder: str = ""            # "" = no dynamic modules
     # --- device tier

@@ -100,11 +100,12 @@ class Mp3Service:
             rel = rel[:-4]
         cand = os.path.normpath(os.path.join(self.movie_folder, rel))
         root = os.path.normpath(self.movie_folder)
-        # separator-suffixed containment (relay/source.py precedent): a
-        # bare prefix check lets /media escape into /media_private
-        if cand != root and not cand.startswith(root + os.sep):
-            return None
-        if not os.path.isdir(cand):
+        # commonpath-over-realpaths containment (utils/paths, the same
+        # guard VodService.resolve uses): also catches symlinks inside
+        # the root pointing outside it, which prefix checks cannot
+        from ..utils.paths import under_root
+        if not os.path.isdir(cand) or not under_root(self.movie_folder,
+                                                     cand):
             return None
         names = sorted(n for n in os.listdir(cand)
                        if n.lower().endswith(".mp3"))
@@ -125,9 +126,9 @@ class Mp3Service:
             return None
         cand = os.path.normpath(
             os.path.join(self.movie_folder, path.lstrip("/")))
-        root = os.path.normpath(self.movie_folder)
-        if not cand.startswith(root + os.sep) \
-                or not os.path.isfile(cand):
+        from ..utils.paths import under_root
+        if not os.path.isfile(cand) \
+                or not under_root(self.movie_folder, cand):
             return None
         return cand
 

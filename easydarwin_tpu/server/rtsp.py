@@ -607,10 +607,25 @@ class RtspConnection:
                 ts_scale = f
             extra[hdr.capitalize()] = f"{f:g}"
         outputs = {tid: pt.output for tid, pt in self.player_tracks.items()}
-        self.vod_session = FileSession(self.vod_file, outputs,
-                                       start_npt=start_npt, speed=speed,
-                                       ts_scale=ts_scale)
-        self.vod_session.start()
+        # hot vs cold: the group pacer serves plain-RTP sessions through
+        # the cache + live engine tier (ISSUE 10); Scale (timestamp
+        # compression is not an affine offset) and x-RTP-Meta-Info
+        # sessions (ft/pn/pp come from the sample tables mid-send) keep
+        # the per-session FileSession
+        pacer = getattr(self.server, "vod_pacer", None)
+        hot = (pacer is not None and ts_scale == 1.0
+               and all(o.meta_field_ids is None for o in outputs.values()))
+        if hot:
+            self.vod_session = pacer.open(
+                self.vod_file, outputs, start_npt=start_npt,
+                speed=speed, path=self.path or req.uri)
+            self.server.wake_pump()
+        else:
+            self.vod_session = FileSession(self.vod_file, outputs,
+                                           start_npt=start_npt,
+                                           speed=speed,
+                                           ts_scale=ts_scale)
+            self.vod_session.start()
         self.playing = True
         self.server.stats["players"] += 1
         infos = ",".join(
@@ -775,6 +790,9 @@ class RtspServer:
         self.config = config
         self.registry = registry
         self.vod = vod                       # VodService or None
+        #: VodPacerGroup (ISSUE 10) — set by the app once the engine
+        #: tier is probed; None = every PLAY gets the cold FileSession
+        self.vod_pacer = None
         self.auth = auth                     # AuthService or None
         self.access_log = access_log         # AccessLog or None
         from .modules import ModuleRegistry

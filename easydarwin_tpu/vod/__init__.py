@@ -14,8 +14,13 @@ Modules:
                    AAC→mpeg4-generic (RFC 3640), plus hint-track samples
                    (RFC 3984-era 'rtp ' constructors) when present.
 * ``session``    — ``FileSession``: the RTPSendPackets-style paced sender
-                   feeding RelayOutput sinks.
+                   feeding RelayOutput sinks (cold path), plus
+                   ``PacedVodSession``/``VodPacerGroup``: cache-fed relay
+                   streams served through the live megabatch engine.
+* ``cache``      — ``SegmentCache``: the device-resident segment cache
+                   (packed fixed-slot windows, HBM LRU, background fill).
 """
 
+from .cache import SegmentCache  # noqa: F401
 from .mp4 import Mp4File  # noqa: F401
-from .session import FileSession  # noqa: F401
+from .session import FileSession, PacedVodSession, VodPacerGroup  # noqa: F401

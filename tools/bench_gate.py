@@ -226,6 +226,30 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: h264_requant pool sized {w} "
                             f"workers but measured concurrency {conc} "
                             "(workers never actually engaged)")
+        # ISSUE 10 VOD section — OPTIONAL (rounds predating the segment
+        # cache stay valid), but when present: the hot-cache and
+        # cold-mmap rates are positive finite, the cache hit rate is a
+        # real ratio, and the host-oracle wire-mismatch count is
+        # exactly 0 (any nonzero value is a device/host divergence on
+        # the VOD affine path)
+        vd = extra.get("vod")
+        if isinstance(vd, dict) and vd and "error" not in vd:
+            for kf in ("hot_pkts_per_sec", "cold_pkts_per_sec"):
+                v2 = vd.get(kf)
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 <= 0:
+                    errs.append(f"{name}: vod.{kf} {v2!r} not a "
+                                "positive finite rate")
+            hr = vd.get("cache_hit_rate")
+            if not isinstance(hr, (int, float)) or not math.isfinite(hr) \
+                    or not 0.0 <= hr <= 1.0:
+                errs.append(f"{name}: vod.cache_hit_rate {hr!r} not in "
+                            "[0, 1]")
+            mm = vd.get("wire_mismatches", 0)
+            if mm:
+                errs.append(f"{name}: vod recorded {mm} wire mismatches "
+                            "(device/host divergence on the VOD affine "
+                            "path)")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

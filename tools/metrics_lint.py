@@ -404,6 +404,53 @@ def lint_requant(registry) -> list[str]:
     return errs
 
 
+#: closed serving-path vocabulary of ``vod_packets_total``
+VOD_PATHS = ("hot", "cold")
+
+
+def lint_vod(registry) -> list[str]:
+    """The VOD segment-cache contract (ISSUE 10): the cache/pacer
+    families exist with their exact label sets, every observed ``path``
+    label of ``vod_packets_total`` stays inside the closed hot|cold
+    vocabulary, and the cache-fill phase / vod engine are declared in
+    the closed profiler sets — ``tools/soak.py --vod`` and the bench
+    ``extra.vod`` section key on these."""
+    errs: list[str] = []
+    want_labels = {
+        "vod_cache_hits_total": (),
+        "vod_cache_misses_total": (),
+        "vod_cache_evictions_total": (),
+        "vod_cache_bytes": (),
+        "vod_sessions_count": (),
+        "vod_packets_total": ("path",),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"vod family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    fam = fams.get("vod_packets_total")
+    if fam is not None:
+        for (path,) in getattr(fam, "_states", {}):
+            if path not in VOD_PATHS:
+                errs.append(f"vod_packets_total: observed path "
+                            f"{path!r} outside the closed set "
+                            f"{VOD_PATHS}")
+    from easydarwin_tpu.obs.profile import ENGINES, PHASES
+    if "cache_fill" not in PHASES:
+        errs.append("phase 'cache_fill' missing from obs.profile.PHASES")
+    if "vod" not in ENGINES:
+        errs.append("engine 'vod' missing from obs.profile.ENGINES")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -499,6 +546,9 @@ def main() -> int:
     # the ABR requant ladder's vocabulary (ISSUE 9): pipeline counter
     # families + the closed requant stage set
     errs += lint_requant(obs.REGISTRY)
+    # the VOD segment cache's vocabulary (ISSUE 10): cache/pacer
+    # families + the closed hot|cold path set + the cache_fill phase
+    errs += lint_vod(obs.REGISTRY)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -42,6 +42,7 @@ still works)
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 import struct
 import sys
@@ -93,11 +94,78 @@ def parse_metrics(text: str) -> dict[str, float]:
     return out
 
 
+def write_vod_assets(folder: str, n_assets: int,
+                     n_frames: int = 600, fps: int = 30) -> list[str]:
+    """Synthetic VOD fixtures for ``--vod``: H.264 (IDR each second) +
+    AAC, written with the repo's own muxer.  Returns the asset names."""
+    from easydarwin_tpu.vod.mp4_writer import Mp4Writer
+    sps = bytes((0x67, 0x42, 0x00, 0x1F, 0xAA, 0xBB, 0xCC, 0xDD))
+    pps = bytes((0x68, 0xCE, 0x3C, 0x80))
+    names = []
+    os.makedirs(folder, exist_ok=True)
+    for a in range(n_assets):
+        name = f"vodasset{a}.mp4"
+        w = Mp4Writer(os.path.join(folder, name))
+        v = w.add_h264_track(sps, pps, 640, 480, timescale=90000)
+        au = w.add_aac_track(bytes((0x11, 0x90)), 8000, 1)
+        dur = 90000 // fps
+        for i in range(n_frames):
+            idr = i % fps == 0
+            nal = bytes((0x65 if idr else 0x41,)) \
+                + bytes(((i + a) & 0xFF,)) * (900 if idr else 160)
+            w.write_sample(v, len(nal).to_bytes(4, "big") + nal, dur,
+                           sync=idr)
+        for i in range(int(n_frames / fps * 8000 / 1024)):
+            w.write_sample(au, bytes(((i & 0xFF),)) * 40, 1024,
+                           sync=True)
+        w.close()
+        names.append(name)
+    return names
+
+
+def prewarm_batch_shapes(pads=(16, 32, 64, 128)) -> None:
+    """Pre-trace the engine jit shapes a VOD soak exercises, BEFORE the
+    clock starts — the same cold-jit protection the multi-source
+    section applies to stacked shapes.  Traces the jitted steps
+    DIRECTLY (zero inputs, same jit cache keys) rather than stepping a
+    real stream: a stepped stream's sends would observe the compile
+    wall time into the very ingest→wire histograms the SLO reads."""
+    from easydarwin_tpu.models.relay_pipeline import megabatch_window_step
+    from easydarwin_tpu.ops import device_ring
+    from easydarwin_tpu.ops import fanout as fanout_ops
+    from easydarwin_tpu.ops.staging import ROW_STRIDE
+    # the batch-header step, per pow2 window pad (1 TCP subscriber)
+    for pad in sorted(pads):
+        fanout_ops.relay_batch_step(
+            np.zeros((pad, 96), np.uint8), np.zeros(pad, np.int32),
+            np.zeros(pad, np.int32),
+            np.zeros((1, fanout_ops.STATE_COLS), np.uint32),
+            np.zeros(1, np.int32), np.int32(10))
+    # the stacked megabatch step: VOD sessions push the eligible stream
+    # count past megabatch_min_streams, so the scheduler engages
+    # mid-soak — its first bucket shapes must not cold-jit inside a
+    # stamped wake either
+    import jax
+    for b in (1, 2):
+        for pp in (16, 32, 64):
+            np.asarray(megabatch_window_step(
+                jax.device_put(np.zeros((b, pp, ROW_STRIDE), np.uint8)),
+                np.zeros((b, 8, fanout_ops.STATE_COLS), np.uint32)))
+    # the per-stream resident-ring query (the megabatch fallback the
+    # plain-UDP player's engine takes at engagement)
+    ring = device_ring.init_ring(4096)
+    ring = device_ring.append(ring, np.zeros((16, 96), np.uint8),
+                              np.zeros(16, np.int32),
+                              np.zeros(16, np.int32), np.int32(1))
+    device_ring.query(ring, np.zeros((8, fanout_ops.STATE_COLS),
+                                     np.uint32), np.int32(0))
+
+
 def check_metrics(scrapes: list[dict[str, float]], *,
                   expect_megabatch: bool = False,
                   chaos: bool = False,
                   forced_backend: str | None = None,
-                  hls_ladder: int = 0) -> list[str]:
+                  hls_ladder: int = 0, vod: int = 0) -> list[str]:
     """Counter-regression checks over the soak's periodic scrapes.
 
     ``chaos=True`` (a seeded FaultPlan was armed) skips exactly the
@@ -174,6 +242,17 @@ def check_metrics(scrapes: list[dict[str, float]], *,
         if not chaos and last.get("requant_shed_total", 0) > 0:
             errs.append(f"ladder shed AUs during a clean soak: "
                         f"{last['requant_shed_total']:.0f}")
+    # VOD segment-cache invariants (ISSUE 10): a --vod soak must have
+    # actually served from packed windows (zero hits = the cache never
+    # engaged and the run proved nothing) and the hot path must have
+    # staged packets; the host-oracle mismatch counter is covered by
+    # the unconditional megabatch check above
+    if vod:
+        if last.get("vod_cache_hits_total", 0) == 0:
+            errs.append("vod soak recorded zero segment-cache hits "
+                        "(hot path never engaged)")
+        if last.get('vod_packets_total{path="hot"}', 0) == 0:
+            errs.append("vod soak staged zero hot-path packets")
     if last.get("ingest_oversize_dropped_total", 0) > 0:
         errs.append(f"ingest drops: "
                     f"{last['ingest_oversize_dropped_total']:.0f}")
@@ -401,12 +480,31 @@ def _check_chaos(app, clear_time: float, t_full: float | None,
 async def soak(seconds: float, n_sources: int = 0,
                chaos_seed: int | None = None, devices: int = 1,
                egress_backend: str | None = None,
-               hls_ladder: int = 0) -> int:
+               hls_ladder: int = 0, vod: int = 0) -> int:
     chaos = chaos_seed is not None
     hls_ladder = max(0, min(int(hls_ladder), 3))   # q6..q18 in 6-steps
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
                        access_log_enabled=False)
+    vod_assets: list[str] = []
+    if vod:
+        # --vod N: N RTSP players seeking across M synthetic assets
+        # served by the segment cache through the ENGINE paths (the
+        # --chaos shape: every output TPU-eligible so megabatch + the
+        # host-oracle install check actually run)
+        import tempfile
+        movies = tempfile.mkdtemp(prefix="edtpu_vod_soak_")
+        vod_assets = write_vod_assets(movies, n_assets=3)
+        cfg.movie_folder = movies
+        cfg.tpu_fanout = True
+        cfg.tpu_min_outputs = 1
+        # a VOD seek deliberately delivers faster than realtime: the
+        # sync snap starts up to a GOP behind the requested npt and the
+        # catch-up burst drains through TCP backpressure over a few
+        # hundred ms.  The live 50 ms objective would count every such
+        # burst as an SLO breach; sub-second is the bound a VOD seek is
+        # held to (the starved-player floor owns steady-state health)
+        cfg.slo_latency_objective_ms = 1000.0
     if egress_backend:
         # --egress-backend X: force the rung AND run the engine paths
         # (tpu_min_outputs=1, same shape as --chaos) so the forced
@@ -489,6 +587,45 @@ async def soak(seconds: float, n_sources: int = 0,
                            udp2_rtcp.getsockname()[1])])
         udp2_rx = [0]
 
+        # --- VOD players (ISSUE 10): N interleaved-TCP players across
+        # the synthetic assets, each re-PLAYing with a seeded Range
+        # seek every few seconds (the segment cache must keep serving
+        # across session reopens; starved players fail the soak)
+        vod_rx = [0] * max(vod, 0)
+        vod_tasks: list[asyncio.Task] = []
+        vod_clients: list[RtspClient] = []
+        if vod:
+            import random as _random
+            _vrng = _random.Random(11)
+            # cold-jit protection BEFORE the clock starts (PR 7 shape)
+            await asyncio.to_thread(prewarm_batch_shapes)
+
+            async def vod_player(i: int) -> None:
+                c = RtspClient()
+                vod_clients.append(c)
+                await c.connect("127.0.0.1", app.rtsp.port)
+                uri = f"{base}/{vod_assets[i % len(vod_assets)]}"
+                await c.play_start(uri)
+                next_seek = t0 + 4.0 + i * 1.5
+                while time.time() - t0 < seconds:
+                    try:
+                        await c.recv_interleaved(0, timeout=0.25)
+                        vod_rx[i] += 1
+                    except asyncio.TimeoutError:
+                        pass
+                    for _ in range(64):
+                        try:
+                            await c.recv_interleaved(0, timeout=0.002)
+                            vod_rx[i] += 1
+                        except asyncio.TimeoutError:
+                            break
+                    if time.time() >= next_seek:
+                        next_seek = time.time() + 5.0
+                        npt = _vrng.uniform(0.0, 15.0)
+                        r = await c.request(
+                            "PLAY", uri, {"range": f"npt={npt:.2f}-"})
+                        assert r.status == 200, r.status
+
         # --- HLS with the requant rung (REST calls must not block the
         # loop the server itself runs on)
         def _get(path):
@@ -559,6 +696,9 @@ async def soak(seconds: float, n_sources: int = 0,
                         break
 
         drain_task = asyncio.ensure_future(tcp_drain())
+        if vod:
+            vod_tasks = [asyncio.ensure_future(vod_player(i))
+                         for i in range(vod)]
         last_seen_out_seq = None
         # chaos timeline: faults stay armed until clear_at, then the
         # remainder of the soak (>= ~45 s at the default duration) is
@@ -680,6 +820,11 @@ async def soak(seconds: float, n_sources: int = 0,
             f += 1
             await asyncio.sleep(0.03)
         await drain_task
+        for vt in vod_tasks:
+            try:
+                await vt
+            except Exception as e:       # a died player is a failure,
+                failures.append(f"vod player crashed: {e!r}")  # not a hang
 
         # --- checks.  Feature-completeness checks (HLS muxing, requant
         # throughput, drained reliable windows) hold for the CLEAN soak;
@@ -764,6 +909,20 @@ async def soak(seconds: float, n_sources: int = 0,
         # "never stops serving": players keep progressing even under the
         # plan (threshold scaled to the injected 5% drop + shed risk)
         floor = 0.3 if chaos else 0.5
+        if vod:
+            # each player streams ~30 fps video + ~8 AU/s audio at 1x;
+            # a player that saw under ~5 pkts/s of soak time starved
+            vod_floor = seconds * 5
+            for i, n in enumerate(vod_rx):
+                if n < vod_floor:
+                    failures.append(
+                        f"vod player {i} starved: {n} pkts "
+                        f"(floor {vod_floor:.0f})")
+            if app.vod_pacer is not None \
+                    and app.vod_pacer.prime_failures:
+                failures.append(
+                    f"vod device-prime failures: "
+                    f"{app.vod_pacer.prime_failures}")
         if tcp_rx[0] < f * floor:
             failures.append(f"tcp player starved: {tcp_rx[0]}/{f}")
         if udp_rx[0] < f * floor:
@@ -796,7 +955,7 @@ async def soak(seconds: float, n_sources: int = 0,
                                       expect_megabatch=n_sources >= 2,
                                       chaos=chaos,
                                       forced_backend=egress_backend,
-                                      hls_ladder=hls_ladder))
+                                      hls_ladder=hls_ladder, vod=vod))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -847,12 +1006,25 @@ async def soak(seconds: float, n_sources: int = 0,
         }
         if chaos:
             stats["chaos"] = chaos_stats
+        if vod:
+            stats["vod"] = {
+                "players": vod, "assets": len(vod_assets),
+                "rx": vod_rx,
+                "cache_hits": mlast.get("vod_cache_hits_total"),
+                "cache_misses": mlast.get("vod_cache_misses_total"),
+                "hot_pkts": mlast.get('vod_packets_total{path="hot"}'),
+                "cold_pkts": mlast.get('vod_packets_total{path="cold"}'),
+                "pacer": (app.vod_pacer.stats()
+                          if app.vod_pacer is not None else None),
+            }
         print("SOAK", "FAIL" if failures else "OK", stats)
         for msg in failures:
             print("  -", msg)
         await tcp_player.close()
         await rel_player.close()
         await plain_player.close()
+        for c in vod_clients:
+            await c.close()
         await push_a.close()
         await push_c.close()
         await push_b.close()
@@ -1263,6 +1435,12 @@ def _parse_args(argv: list[str]):
                          "shedding, unbounded ladder pending() growth, "
                          "or a nonzero slice-reassembly mismatch "
                          "counter")
+    ap.add_argument("--vod", type=int, default=0, metavar="N",
+                    help="add N RTSP VOD players seeking across 3 "
+                         "synthetic assets served by the segment cache "
+                         "through the engine paths (ISSUE 10); fails "
+                         "on zero cache hits, any host-oracle wire "
+                         "mismatch, or a starved player")
     ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
                     metavar="SEED",
                     help="run under a seeded FaultPlan (resilience/"
@@ -1321,4 +1499,4 @@ if __name__ == "__main__":
     raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
                                       _ns.chaos, _ns.devices,
                                       _ns.egress_backend,
-                                      _ns.hls_ladder)))
+                                      _ns.hls_ladder, _ns.vod)))
