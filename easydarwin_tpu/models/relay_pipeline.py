@@ -263,6 +263,39 @@ def scatter_affine_segments(packed, n_subs):
     return out
 
 
+# ------------------------------------------------------------------- FEC
+# The lossy-WAN reliability tier's device kernel (ISSUE 11): per-window
+# GF(256) parity over fixed-slot ring rows as a log/antilog-table
+# matmul.  a·b in GF(256) is antilog[log a + log b] (zero operands
+# masked), so the whole parity block is two table gathers, one add and
+# an XOR reduction — the same elementwise shape XLA fuses for the
+# affine fan-out kernels.  The XOR row (GF(2) parity) is just the
+# all-ones coefficient row, so one kernel serves both kinds.  Every row
+# the kernel produces is compared against the independent numpy oracle
+# (relay.fec.gf_matmul) before it can reach the wire.
+
+@jax.jit
+def fec_parity_window_step(rows: jnp.ndarray,
+                           coeff: jnp.ndarray) -> jnp.ndarray:
+    """GF(256) parity matmul: ``rows [K, B] uint8`` (fixed-slot ring
+    rows, zero-padded) × ``coeff [R, K] uint8`` (Vandermonde rows from
+    ``relay.fec.coeff_rows``) → ``[R, B] uint8`` parity rows.
+
+    Shapes are pow2-padded by the caller so jit specializations latch
+    per (K, R, B) family; zero rows and zero coefficients contribute
+    nothing (gf_mul(0, ·) = 0), so window padding is free."""
+    from ..relay.fec import GF_EXP512, GF_LOG
+
+    log = jnp.asarray(GF_LOG)              # [256] int32 (log[0] sentinel)
+    exp = jnp.asarray(GF_EXP512)           # [512] int32 (no modulo needed)
+    lr = log[rows.astype(jnp.int32)]       # [K, B]
+    lc = log[coeff.astype(jnp.int32)]      # [R, K]
+    prod = exp[lc[:, :, None] + lr[None, :, :]]           # [R, K, B]
+    nz = (rows != 0)[None, :, :] & (coeff != 0)[:, :, None]
+    prod = jnp.where(nz, prod, 0).astype(jnp.uint8)
+    return jax.lax.reduce(prod, np.uint8(0), jax.lax.bitwise_xor, (1,))
+
+
 def _pipeline_step(prefix, length, age_ms, out_state, buckets, *,
                    use_pallas: bool, mode: str, bucket_delay_ms: int,
                    codec: str = "h264"):

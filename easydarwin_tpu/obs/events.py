@@ -110,6 +110,12 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # checkpoint lifecycle (resilience/checkpoint.py)
     "ckpt.save": ("sessions",),
     "ckpt.restore": ("sessions", "outputs"),
+    # lossy-WAN reliability tier (relay/fec.py, ISSUE 11): the oracle-
+    # mismatch latch is one event per stream (the stream serves host
+    # parity from then on); the RTX budget give-up is latched per
+    # output's FIRST exhaustion, never per NACKed seq
+    "fec.host_fallback": ("mismatches",),
+    "rtx.giveup": ("giveups",),
 }
 
 

@@ -305,6 +305,45 @@ VOD_PACKETS = REGISTRY.counter(
     "cold = per-sample mmap packetization on a cache miss)",
     labels=("path",))
 
+# ------------------------------------------------------- reliability tier
+# The lossy-WAN FEC + NACK/RTX tier (ISSUE 11: relay/fec.py).
+# tools/metrics_lint.py enforces this family set (lint_fec: exact
+# labels, the parity kind vocabulary closed to xor|rs) and
+# tools/soak.py --lossy keys on it.
+FEC_PARITY_PACKETS = REGISTRY.counter(
+    "fec_parity_packets_total",
+    "FEC parity packets emitted (RED/ULPFEC-shaped, one per parity row "
+    "per window per subscriber), by parity kind (xor = GF(2) all-ones "
+    "row, rs = GF(256) Reed-Solomon Vandermonde rows)",
+    labels=("kind",))
+FEC_RECOVERED = REGISTRY.counter(
+    "fec_recovered_total",
+    "Media packets reconstructed byte-exactly from FEC parity by the "
+    "receiver model (in-process receivers — the lossy soak player, the "
+    "bench — share this registry, so recovery is scrapeable)")
+FEC_PARITY_ORACLE_MISMATCH = REGISTRY.counter(
+    "fec_parity_oracle_mismatch_total",
+    "Device-computed parity rows that disagreed with the host GF "
+    "oracle for the same window (the device result is discarded and "
+    "the stream latches onto host-computed parity; any nonzero value "
+    "is a kernel/host divergence bug and fails bench/soak)")
+FEC_OVERHEAD_RATIO = REGISTRY.gauge(
+    "fec_overhead_ratio",
+    "Current closed-loop FEC overhead (parity/media ratio, 0..0.30) "
+    "per stream — the worst subscriber's rung, driven by RTCP RR "
+    "fraction_lost with NADU buffer distress shifting recovery toward "
+    "RTX instead", labels=("path", "track"))
+RTX_SENT = REGISTRY.counter(
+    "rtx_sent_total",
+    "NACKed packets replayed from live ring bookmarks through the "
+    "affine rewrite as RFC 4588-shaped RTX packets (OSN-prefixed, own "
+    "seq space)")
+RTX_GIVEUP = REGISTRY.counter(
+    "rtx_giveup_total",
+    "NACKed packets NOT replayed because the per-output RTX token "
+    "bucket was exhausted (a black-holed client cannot amplify); "
+    "give-ups charge the degradation ladder")
+
 # ------------------------------------------------------------------- QoS
 QOS_FRACTION_LOST = REGISTRY.gauge(
     "qos_fraction_lost_ratio",

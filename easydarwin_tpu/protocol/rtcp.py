@@ -13,6 +13,9 @@ import struct
 from dataclasses import dataclass, field
 
 SR, RR, SDES, BYE, APP = 200, 201, 202, 203, 204
+#: RFC 4585 transport-layer feedback (RTPFB); the count bits carry FMT
+RTPFB = 205
+FMT_GENERIC_NACK = 1
 
 NTP_EPOCH_DELTA = 2208988800  # seconds between 1900 (NTP) and 1970 (Unix)
 
@@ -32,7 +35,13 @@ class ReportBlock:
     dlsr: int
 
     def to_bytes(self) -> bytes:
-        lost = self.cumulative_lost & 0xFFFFFF
+        # RFC 3550 §6.4.1: cumulative_lost is a SIGNED 24-bit quantity —
+        # duplicate packets make received > expected, driving it
+        # negative, and it must round-trip as such.  Clamp to the signed
+        # range (the RFC's own rule) rather than letting a wild value
+        # alias into another report's fraction byte.
+        lost = max(-0x800000, min(self.cumulative_lost, 0x7FFFFF)) \
+            & 0xFFFFFF
         return struct.pack("!IIIIII", self.ssrc,
                            ((self.fraction_lost & 0xFF) << 24) | lost,
                            self.highest_seq, self.jitter, self.lsr, self.dlsr)
@@ -40,6 +49,9 @@ class ReportBlock:
     @classmethod
     def parse(cls, data: bytes, off: int) -> "ReportBlock":
         ssrc, frac_lost, hseq, jit, lsr, dlsr = struct.unpack_from("!IIIIII", data, off)
+        # sign-extend the 24-bit field: an unsigned read would report a
+        # duplicate-heavy receiver (-1 on the wire) as ~16.7M lost and
+        # poison every loss-driven controller downstream
         cum = frac_lost & 0xFFFFFF
         if cum >= 0x800000:
             cum -= 0x1000000
@@ -168,6 +180,50 @@ class Nadu:
                               for i in range(0, len(app.data), 12)])
 
 
+@dataclass
+class GenericNack:
+    """RFC 4585 §6.2.1 transport-layer generic NACK: the receiver's
+    list of lost MEDIA seqs, each FCI a (PID, BLP) pair — PID the first
+    lost seq, BLP a bitmask of the 16 following seqs also lost.  The
+    reliability tier (relay/fec.py) resolves these back to live ring
+    bookmarks for RTX replay."""
+
+    sender_ssrc: int
+    media_ssrc: int
+    pairs: list[tuple[int, int]] = field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        body = struct.pack("!II", self.sender_ssrc & 0xFFFFFFFF,
+                           self.media_ssrc & 0xFFFFFFFF)
+        for pid, blp in self.pairs:
+            body += struct.pack("!HH", pid & 0xFFFF, blp & 0xFFFF)
+        return _hdr(RTPFB, FMT_GENERIC_NACK, len(body)) + body
+
+    def lost_seqs(self) -> list[int]:
+        out: list[int] = []
+        for pid, blp in self.pairs:
+            out.append(pid & 0xFFFF)
+            for bit in range(16):
+                if blp & (1 << bit):
+                    out.append((pid + 1 + bit) & 0xFFFF)
+        return out
+
+    @classmethod
+    def from_seqs(cls, sender_ssrc: int, media_ssrc: int,
+                  seqs) -> "GenericNack":
+        """Pack lost seqs into minimal (PID, BLP) FCI pairs."""
+        pairs: list[tuple[int, int]] = []
+        for s in sorted({s & 0xFFFF for s in seqs}):
+            if pairs:
+                pid, blp = pairs[-1]
+                d = (s - pid) & 0xFFFF
+                if 1 <= d <= 16:
+                    pairs[-1] = (pid, blp | (1 << (d - 1)))
+                    continue
+            pairs.append((s, 0))
+        return cls(sender_ssrc, media_ssrc, pairs)
+
+
 def _hdr(ptype: int, count: int, body_len: int) -> bytes:
     if body_len % 4:
         raise RtcpError("RTCP body must be 32-bit aligned")
@@ -208,6 +264,13 @@ def parse_compound(data: bytes) -> list[object]:
                 rlen = body[roff]
                 bye.reason = body[roff + 1:roff + 1 + rlen].decode("utf-8", "replace")
             out.append(bye)
+        elif ptype == RTPFB and count == FMT_GENERIC_NACK \
+                and len(body) >= 8 and (len(body) - 8) % 4 == 0:
+            sender, media = struct.unpack_from("!II", body)
+            nack = GenericNack(sender, media)
+            nack.pairs = [struct.unpack_from("!HH", body, 8 + i * 4)
+                          for i in range((len(body) - 8) // 4)]
+            out.append(nack)
         elif ptype == APP and len(body) >= 8:
             ssrc = struct.unpack_from("!I", body)[0]
             app = App(ssrc, body[4:8].decode("ascii", "replace"),

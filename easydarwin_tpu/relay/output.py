@@ -89,11 +89,18 @@ class RelayOutput:
         """Send a device-rewritten packet: 12-byte header + original bytes
         from offset 12.  Default concatenates; socket-backed outputs override
         with vectored I/O so the shared payload is never copied."""
-        if INJECTOR.active and INJECTOR.slow_subscriber():
-            # chaos site: slow-subscriber backpressure — the engine's
-            # WOULD_BLOCK machinery (bookmark replay) handles it, the
-            # same as a genuinely full socket
-            return WriteResult.WOULD_BLOCK
+        if INJECTOR.active:
+            if INJECTOR.slow_subscriber():
+                # chaos site: slow-subscriber backpressure — the
+                # engine's WOULD_BLOCK machinery (bookmark replay)
+                # handles it, the same as a genuinely full socket
+                return WriteResult.WOULD_BLOCK
+            if INJECTOR.egress_drop():
+                # receiver-side loss site (ISSUE 11): the send is
+                # accounted OK but the wire "ate" the packet — only the
+                # receiver's RR/NACK feedback can surface it, which is
+                # exactly what the reliability tier must react to
+                return WriteResult.OK
         if self.meta_field_ids is not None:
             return self.send_bytes(self.wrap_meta(header, tail),
                                    is_rtcp=False)
@@ -140,6 +147,16 @@ class RelayOutput:
             ssrc=rw.ssrc)
         if self.meta_field_ids is not None:
             out = self.wrap_meta(out[:12], out[12:])
+        if INJECTOR.active and INJECTOR.egress_drop():
+            # receiver-side loss: sent-and-lost, so the OK accounting
+            # runs EXACTLY as for a real send — on the WRAPPED bytes,
+            # or the counters (and the SRs built from them) would
+            # drift from an identical non-dropped schedule and make
+            # the loss sender-visible
+            self.packets_sent += 1
+            self.bytes_sent += len(out)
+            self.payload_octets += max(len(packet) - 12, 0)
+            return WriteResult.OK
         res = self.send_bytes(out, is_rtcp=False)
         if res is WriteResult.OK:
             self.packets_sent += 1

@@ -117,6 +117,11 @@ class RelayStream:
         #: buffer an armed ingest_reorder fault parks a packet in —
         #: owned by the stream so a held packet dies with it
         self._chaos_hold: list = []
+        #: lossy-WAN reliability tier (relay/fec.py): built lazily when
+        #: the first FEC-negotiated output lands; ticked from the
+        #: engines' shared relay_rtcp tail so the scalar oracle and the
+        #: TPU engine emit identical parity bytes
+        self.fec = None
         #: reception accounting for those RRs (RFC 3550 A.3)
         self._rr_base_seq: int | None = None
         self._rr_max_seq = 0
@@ -212,6 +217,11 @@ class RelayStream:
         self._next_sr_due_ms = 0        # new output: SR due immediately
         if hasattr(output, "tick"):     # reliable-UDP retransmit sweeps
             self.tickable_outputs.append(output)
+        if getattr(output, "fec", None) is not None:
+            if self.fec is None:
+                from .fec import StreamFec
+                self.fec = StreamFec(self, output.fec.cfg)
+            self.fec.add_output(output)
         if bucket is not None:
             while len(self.buckets) <= bucket:
                 self.buckets.append([])
@@ -231,6 +241,8 @@ class RelayStream:
     def remove_output(self, output: RelayOutput) -> bool:
         if output in self.tickable_outputs:
             self.tickable_outputs.remove(output)
+        if self.fec is not None:
+            self.fec.remove_output(output)
         for bucket in self.buckets:
             if output in bucket:
                 bucket.remove(output)
@@ -348,6 +360,11 @@ class RelayStream:
         sync works) while absolute times are real NTP wall clock, matching
         the reference and this repo's VOD path.  Both engines share the
         stream object, so differential tests stay byte-identical."""
+        if self.fec is not None:
+            # the reliability tier's per-pass hook: window parity rides
+            # the SAME tail both engines share, so megabatch/native/
+            # scalar passes emit identical parity bytes by construction
+            self.fec.tick(now_ms)
         rring = self.rtcp_ring
         if len(rring) == 0 and now_ms < self._next_sr_due_ms:
             return                  # hot path: nothing buffered, none due
@@ -399,7 +416,11 @@ class RelayStream:
         self.last_upstream_rr_ms = now_ms
         ext_max = (self._rr_cycles << 16) | self._rr_max_seq
         expected = ext_max - self._rr_base_seq + 1
-        lost = max(expected - self._rr_received, 0)
+        # RFC 3550 A.3: cumulative lost is SIGNED — a duplicate-heavy
+        # push drives received past expected and the pusher should see
+        # the negative value, not a zero-clamp (ReportBlock handles the
+        # 24-bit clamp/sign round-trip)
+        lost = expected - self._rr_received
         d_exp = expected - self._rr_prev_expected
         d_rcv = self._rr_received - self._rr_prev_received
         self._rr_prev_expected = expected

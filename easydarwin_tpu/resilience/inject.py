@@ -53,10 +53,16 @@ from .. import obs
 #: streams), ``redis_partition`` makes a cluster tick's Redis access
 #: time out, ``pull_stall`` freezes a cross-server pull's read loop so
 #: the retry/backoff envelope must recover it.
+#: The receiver-side sites (ISSUE 11): ``egress_drop`` silently loses a
+#: Python-path delivered packet AFTER the send accounting (the wire ate
+#: it — the reliability tier must notice via RR/NACK, never the
+#: sender's counters), and ``rr_loss_spoof`` replaces the
+#: ``fraction_lost`` of every inbound receiver report so the closed-
+#: loop FEC controller can be driven without a lossy wire.
 SITES = ("ingest_drop", "ingest_reorder", "ingest_corrupt",
          "egress_native", "device_dispatch", "stale_params",
          "slow_subscriber", "lease_loss", "redis_partition",
-         "pull_stall")
+         "pull_stall", "egress_drop", "rr_loss_spoof")
 
 #: minimum seconds between ``fault.injected`` events per site
 EMIT_INTERVAL_S = 1.0
@@ -97,6 +103,11 @@ class FaultPlan:
     lease_loss_every: int = 0          # Nth heartbeat finds the lease gone
     redis_partition_every: int = 0     # Nth cluster tick's Redis times out
     pull_stall_every: int = 0          # Nth pull liveness probe stalls
+    # -- receiver-side loss (ISSUE 11): probability a delivered Python-
+    # path packet is silently lost after send accounting; the spoofed
+    # fraction_lost (0..1) stamped onto every inbound RR while armed ---
+    egress_drop: float = 0.0
+    rr_loss_spoof: float = 0.0
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -316,6 +327,29 @@ class FaultInjector:
             return False
         self._note("slow_subscriber")
         return True
+
+    def egress_drop(self) -> bool:
+        """True when a delivered Python-path packet should be silently
+        lost (receiver-side loss synthesized without touching the wire;
+        the seeded per-site stream makes one seed = one loss schedule).
+        Consumed by ``RelayOutput.write_rtp``/``send_rewritten`` AND by
+        harness-side receivers (the lossy soak player) — each caller
+        owns its own armed injector, so schedules never interleave."""
+        p = self.plan
+        if p is None or not self._fire("egress_drop", p.egress_drop):
+            return False
+        self._note("egress_drop")
+        return True
+
+    def rr_loss_spoof(self) -> float | None:
+        """The spoofed ``fraction_lost`` (0..1) to stamp onto an inbound
+        receiver report, or None when the site is disarmed — drives the
+        closed-loop FEC controller without a lossy wire."""
+        p = self.plan
+        if p is None or p.rr_loss_spoof <= 0.0:
+            return None
+        self._note("rr_loss_spoof")
+        return min(p.rr_loss_spoof, 1.0)
 
     # -- cluster sites ----------------------------------------------------
     def lease_loss(self) -> bool:

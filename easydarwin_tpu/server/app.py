@@ -114,6 +114,12 @@ class StreamingServer:
         if self.config.resilience_enabled:
             from ..resilience import DegradationLadder
             self.ladder = DegradationLadder(self.config.ladder_config())
+            # RTX budget exhaustion (relay/fec.py) is charged to the
+            # ladder: a black-holed client's NACK storm sheds load
+            # through the same machinery as any other overload
+            self.rtsp.on_rtx_giveup = (
+                lambda path: self.ladder.note_device_error(
+                    path, reason="rtx_giveup"))
         #: session checkpoint/hot-restore (resilience/checkpoint.py) —
         #: built in start() once log_folder is final
         self.checkpoint = None
@@ -188,6 +194,8 @@ class StreamingServer:
                     on_error=lambda f, e: self.error_log
                     and self.error_log.warning(f"module {f} failed: {e}")):
                 self.register_module(m)
+        if self.config.fec_enabled:
+            self.config.fec_config()    # raises at boot on a bad window/kind
         # chaos plan (resilience/inject.py): armed before anything serves
         # so the very first pass already runs under the fault schedule
         plan = self.config.fault_plan()

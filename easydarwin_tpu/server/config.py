@@ -87,6 +87,23 @@ class ServerConfig:
     # x-Retransmit (reliable UDP) negotiation in SETUP — the reference's
     # reliable_udp pref (QTSServerPrefs; RTPStream.cpp:448 gate)
     reliable_udp: bool = True
+    # --- lossy-WAN reliability tier (ISSUE 11: relay/fec.py).  On: every
+    # plain-UDP subscriber gets a closed-loop FEC encoder (overhead 0
+    # until its RRs report loss — a clean last mile costs nothing) and
+    # the RFC 4585 generic-NACK → ring-bookmark RTX replay rung.  The
+    # x-Retransmit reliable-UDP wrap supersedes it per output (its own
+    # ack-driven resend window already owns that subscriber's loss).
+    fec_enabled: bool = True
+    fec_window: int = 16               # media packets per parity window
+    fec_max_overhead: float = 0.30     # parity budget ceiling (ratio)
+    fec_kind: str = "rs"               # rs | xor (xor caps parity at 1 row)
+    fec_payload_type: int = 127        # parity packets' RTP PT
+    rtx_payload_type: int = 126        # RTX replays' RTP PT
+    rtx_budget_per_sec: float = 64.0   # per-output replay token refill
+    rtx_burst: int = 32                # token bucket depth
+    # device-side parity (host GF oracle checked per row; a mismatch
+    # degrades the stream to host parity).  Off = host parity only.
+    fec_device: bool = True
     # UDP push ingest via the native recvmmsg ring drain (one syscall per
     # 64 datagrams) instead of per-datagram asyncio callbacks; falls back
     # automatically when the native core is unavailable
@@ -253,6 +270,21 @@ class ServerConfig:
                 jitter_frac=self.cluster_pull_jitter_frac,
                 breaker_failures=self.cluster_pull_breaker_failures,
                 breaker_open_sec=self.cluster_pull_breaker_open_sec))
+
+    def fec_config(self):
+        """The validated reliability-tier config (raises at boot on a
+        bad window/kind — a typo'd tier silently protecting nothing
+        would void every lossy soak)."""
+        from ..relay.fec import FecConfig
+        return FecConfig(
+            window=self.fec_window,
+            max_overhead=self.fec_max_overhead,
+            kind=self.fec_kind,
+            payload_type=self.fec_payload_type,
+            rtx_payload_type=self.rtx_payload_type,
+            rtx_budget_per_sec=self.rtx_budget_per_sec,
+            rtx_burst=self.rtx_burst,
+            use_device=self.fec_device).validate()
 
     def ladder_config(self):
         from ..resilience.ladder import LadderConfig

@@ -410,6 +410,7 @@ class RtspConnection:
         extra = self._negotiate_meta_info(req, out)
         out, rel_extra = self._negotiate_retransmit(req, out, t)
         extra.update(rel_extra)
+        extra.update(self._attach_fec(req, out, t))
         self._install_player_track(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {
             "Transport": resp_t.to_header(), **extra}), req.cseq)
@@ -436,6 +437,33 @@ class RtspConnection:
         from ..relay.reliable import ReliableUdpOutput
         return (ReliableUdpOutput(out, window_kb=window_kb),
                 {"x-Retransmit": hdr})
+
+    def _attach_fec(self, req, out, t) -> dict:
+        """Arm the lossy-WAN reliability tier for one plain-UDP output
+        (ISSUE 11): a closed-loop FEC encoder (overhead 0 until the
+        subscriber's RRs report loss) + the NACK→RTX replay budget.
+
+        OPT-IN, negotiated like x-Retransmit: the SETUP must carry
+        ``x-FEC: parity`` and the grant is echoed back with the parity/
+        RTX payload types.  Parity and RTX packets ride the media SSRC
+        with their OWN seq spaces, which a non-FEC-aware RFC 3550
+        receiver would fold into one per-SSRC seq tracker — garbage
+        fraction_lost feeding back into the thinning controller — so
+        un-negotiated emission is never allowed.  TCP transports don't
+        lose packets; the reliable-UDP wrap owns its subscriber's loss
+        already; meta-info wrapping changes the wire format parity
+        would have to describe."""
+        hdr = req.headers.get("x-fec", "")
+        if (not self.server.config.fec_enabled or t.is_tcp
+                or "parity" not in hdr.lower()
+                or hasattr(out, "resender")
+                or out.meta_field_ids is not None):
+            return {}
+        from ..relay.fec import FecOutputState
+        cfg = self.server.config.fec_config()
+        out.fec = FecOutputState(cfg)
+        return {"x-FEC": f"parity;pt={cfg.payload_type}"
+                         f";rtx-pt={cfg.rtx_payload_type}"}
 
     def _install_player_track(self, track_id, out, pair) -> None:
         """Land a SETUP'd output, releasing any replaced track's transport
@@ -538,6 +566,11 @@ class RtspConnection:
         meta_extra = self._negotiate_meta_info(
             req, out, supported=self.META_SUPPORTED_VOD)
         out, rel_extra = self._negotiate_retransmit(req, out, t)
+        # x-FEC is NOT offered on VOD: the NACK handler resolves through
+        # conn.relay (None for file sessions) and the cold FileSession
+        # never registers with a RelayStream — granting a capability the
+        # server cannot honor would leave the client waiting on it
+        # (reliable-UDP is the VOD loss story, as in the reference)
         self._install_player_track(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {
             "Transport": resp_t.to_header(), **rel_extra, **meta_extra}),
@@ -745,8 +778,10 @@ class RtspConnection:
             # a departed player's QoS gauges must not linger in /metrics
             # (a surviving subscriber's next RR re-creates them)
             from ..relay import quality as quality_mod
+            from ..relay import fec as fec_mod
             for tid in self.player_tracks:
                 quality_mod.drop_qos(self.path, tid)
+                fec_mod.drop_overhead_gauge(self.path, tid)
         egress = self.server.shared_egress
         for pt in self.player_tracks.values():
             if pt.udp_pair:
@@ -949,13 +984,26 @@ class RtspServer:
                     addr_out = pt.output
                     break
         proven = addr_out is not None
+        from ..resilience.inject import INJECTOR
         for p in pkts:
             if isinstance(p, rtcp_mod.ReceiverReport):
                 for rb in p.reports:
                     out = outputs.get(rb.ssrc)
                     if out is not None:
                         proven = True
-                        out.on_receiver_report(rb.fraction_lost / 256.0)
+                        frac = rb.fraction_lost / 256.0
+                        if INJECTOR.active:
+                            # chaos site (ISSUE 11): drive the loss-fed
+                            # controllers without a lossy wire
+                            spoof = INJECTOR.rr_loss_spoof()
+                            if spoof is not None:
+                                frac = spoof
+                        out.on_receiver_report(frac)
+                        fec = getattr(out, "fec", None)
+                        if fec is not None:
+                            # closed-loop FEC overhead rides the SAME
+                            # RR stream the thinning controller reads
+                            fec.controller.on_receiver_report(frac)
                         # fold loss/jitter into the scrapeable per-stream
                         # QoS gauges (obs registry)
                         from ..relay import quality as quality_mod
@@ -964,8 +1012,7 @@ class RtspServer:
                         if conn.relay is not None and tid in conn.relay.streams:
                             rate = conn.relay.streams[tid].info.clock_rate
                         quality_mod.record_rr_qos(
-                            conn.path, tid, rb.fraction_lost / 256.0,
-                            rb.jitter, rate)
+                            conn.path, tid, frac, rb.jitter, rate)
             elif isinstance(p, rtcp_mod.Nadu):
                 # 3GPP NADU buffer state → per-output rate adaptation;
                 # each block names the media sender SSRC it reports on
@@ -975,6 +1022,21 @@ class RtspServer:
                         proven = True
                         out.on_nadu(blk.playout_delay_ms,
                                     blk.free_buffer_64b)
+                        fec = getattr(out, "fec", None)
+                        if fec is not None:
+                            # buffer distress shifts the NACK-vs-FEC
+                            # split toward RTX (parity is bitrate)
+                            fec.controller.on_nadu(blk.playout_delay_ms,
+                                                   blk.free_buffer_64b)
+            elif isinstance(p, rtcp_mod.GenericNack):
+                # RFC 4585 generic NACK → ring-bookmark RTX replay
+                # (relay/fec.py): the ring IS the retransmission buffer
+                out = outputs.get(p.media_ssrc)
+                if out is None and addr_out is not None \
+                        and getattr(addr_out, "fec", None) is not None:
+                    out = addr_out       # source-addr routed fallback
+                if out is not None and self._handle_nack(conn, out, p):
+                    proven = True
             elif isinstance(p, rtcp_mod.App):
                 # RTCPAckPacket → RTPPacketResender::AckPacket path.
                 # Route: exact track by RTCP source addr, else by the
@@ -1005,6 +1067,27 @@ class RtspServer:
                             proven = True
         if proven:
             conn.last_activity = time.monotonic()
+
+    def _handle_nack(self, conn: RtspConnection, out, nack) -> bool:
+        """Resolve one generic NACK's lost OUTPUT seqs to live ring
+        bookmarks and replay them as RTX (ISSUE 11).  Returns True when
+        the NACK matched a FEC-armed output (ownership proof — a
+        forged NACK for an unknown SSRC proves nothing)."""
+        if getattr(out, "fec", None) is None or conn.relay is None:
+            return False
+        tid = next((t for t, pt in conn.player_tracks.items()
+                    if pt.output is out), None)
+        stream = conn.relay.streams.get(tid) if tid is not None else None
+        if stream is None or stream.fec is None:
+            return False
+        stream.fec.replay_nacked(out, nack.lost_seqs(), now_ms(),
+                                 on_giveup=self.on_rtx_giveup)
+        return True
+
+    #: set by the app: a path whose RTX budget was exhausted is charged
+    #: to the PR 5 degradation ladder (a black-holed client must shed
+    #: load, never amplify)
+    on_rtx_giveup = None
 
     def wake_pump(self) -> None:
         if self._on_pump_wake is not None:

@@ -318,26 +318,34 @@ async def test_hls_http_serving_e2e(tmp_path):
 
         st, ct, body = await get("/hls/live/hlscam/index.m3u8")
         assert st == 200 and "mpegurl" in ct
-        # now push media so segments accumulate
+        # Now push media so segments accumulate — ONE GOP PER PUMP
+        # BEAT, then poll.  Bursting every GOP at once raced the relay:
+        # if all three landed before the first reflect pass, the HLS
+        # output fast-started from the NEWEST keyframe and only ever
+        # saw one IDR — and a segment is only cut when the NEXT IDR
+        # arrives, so seg0 never existed (the known tier-1 flake).
         seq = 0
-        for gop in range(3):
+        text = ""
+        for gop in range(40):                 # bounded: ~4 s of media
             for i in range(8):
                 ts = (gop * 8 + i) * 3000
                 if i == 0:
-                    for cfg in (SPS, PPS):
+                    for ps in (SPS, PPS):
                         pusher.push_packet(0, rtp.RtpPacket(
                             payload_type=96, seq=seq, timestamp=ts, ssrc=1,
-                            payload=cfg).to_bytes())
+                            payload=ps).to_bytes())
                         seq += 1
                 nal = bytes((0x65 if i == 0 else 0x41,)) + bytes(200)
                 pusher.push_packet(0, rtp.RtpPacket(
                     payload_type=96, seq=seq, timestamp=ts, ssrc=1,
                     marker=True, payload=nal).to_bytes())
                 seq += 1
-        await asyncio.sleep(0.1)
-        st, ct, body = await get("/hls/live/hlscam/index.m3u8")
-        assert st == 200
-        text = body.decode()
+            await asyncio.sleep(0.05)         # let the pump ingest the GOP
+            st, ct, body = await get("/hls/live/hlscam/index.m3u8")
+            assert st == 200
+            text = body.decode()
+            if "#EXTINF" in text and "seg0.m4s" in text:
+                break
         assert "#EXTINF" in text and "seg0.m4s" in text
         st, ct, body = await get("/hls/live/hlscam/init.mp4")
         assert st == 200 and ct.endswith("video/mp4") and body[4:8] == b"ftyp"

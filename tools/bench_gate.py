@@ -250,6 +250,35 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: vod recorded {mm} wire mismatches "
                             "(device/host divergence on the VOD affine "
                             "path)")
+        # ISSUE 11 reliability-tier section — OPTIONAL (rounds predating
+        # FEC stay valid), but when present: goodput (delivered +
+        # recovered) is a positive finite rate, the recovered-vs-lost
+        # ratio is a real ratio, the RTX replay p99 is a finite
+        # non-negative latency, and the device-vs-host parity oracle
+        # recorded exactly zero mismatches (any nonzero value is a
+        # kernel/host divergence on the parity matmul)
+        fc = extra.get("fec")
+        if isinstance(fc, dict) and fc and "error" not in fc:
+            gp = fc.get("goodput_pkts_per_sec")
+            if not isinstance(gp, (int, float)) or not math.isfinite(gp) \
+                    or gp <= 0:
+                errs.append(f"{name}: fec.goodput_pkts_per_sec {gp!r} "
+                            "not a positive finite rate")
+            rr2 = fc.get("recovered_ratio")
+            if not isinstance(rr2, (int, float)) \
+                    or not math.isfinite(rr2) or not 0.0 <= rr2 <= 1.0:
+                errs.append(f"{name}: fec.recovered_ratio {rr2!r} not "
+                            "in [0, 1]")
+            rp = fc.get("rtx_p99_ms")
+            if not isinstance(rp, (int, float)) or not math.isfinite(rp) \
+                    or rp < 0:
+                errs.append(f"{name}: fec.rtx_p99_ms {rp!r} not a "
+                            "finite non-negative latency")
+            mm = fc.get("oracle_mismatches", 0)
+            if mm:
+                errs.append(f"{name}: fec recorded {mm} parity oracle "
+                            "mismatches (device/host divergence on the "
+                            "GF parity matmul)")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

@@ -451,6 +451,56 @@ def lint_vod(registry) -> list[str]:
     return errs
 
 
+#: closed parity-kind vocabulary of ``fec_parity_packets_total``
+FEC_KINDS = ("xor", "rs")
+
+
+def lint_fec(registry, schema: dict) -> list[str]:
+    """The reliability-tier contract (ISSUE 11): the FEC/RTX families
+    exist with their exact label sets, every observed ``kind`` label
+    stays inside the closed xor|rs vocabulary, the receiver-side fault
+    sites ride the closed SITES vocabulary, and the ``fec.*``/``rtx.*``
+    event names are declared — ``tools/soak.py --lossy`` and the bench
+    ``extra.fec`` section key on these."""
+    errs: list[str] = []
+    want_labels = {
+        "fec_parity_packets_total": ("kind",),
+        "fec_recovered_total": (),
+        "fec_parity_oracle_mismatch_total": (),
+        "fec_overhead_ratio": ("path", "track"),
+        "rtx_sent_total": (),
+        "rtx_giveup_total": (),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"fec family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    fam = fams.get("fec_parity_packets_total")
+    if fam is not None:
+        for (kind,) in getattr(fam, "_values", {}):
+            if kind not in FEC_KINDS:
+                errs.append(f"fec_parity_packets_total: observed kind "
+                            f"{kind!r} outside the closed set "
+                            f"{FEC_KINDS}")
+    for name in ("fec.host_fallback", "rtx.giveup"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    from easydarwin_tpu.resilience.inject import SITES
+    for site in ("egress_drop", "rr_loss_spoof"):
+        if site not in SITES:
+            errs.append(f"receiver-side fault site {site!r} missing "
+                        "from the closed SITES vocabulary")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -549,6 +599,9 @@ def main() -> int:
     # the VOD segment cache's vocabulary (ISSUE 10): cache/pacer
     # families + the closed hot|cold path set + the cache_fill phase
     errs += lint_vod(obs.REGISTRY)
+    # the reliability tier's vocabulary (ISSUE 11): FEC/RTX families +
+    # the closed xor|rs kind set + receiver-side fault sites + events
+    errs += lint_fec(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -165,7 +165,8 @@ def check_metrics(scrapes: list[dict[str, float]], *,
                   expect_megabatch: bool = False,
                   chaos: bool = False,
                   forced_backend: str | None = None,
-                  hls_ladder: int = 0, vod: int = 0) -> list[str]:
+                  hls_ladder: int = 0, vod: int = 0,
+                  lossy: float = 0.0) -> list[str]:
     """Counter-regression checks over the soak's periodic scrapes.
 
     ``chaos=True`` (a seeded FaultPlan was armed) skips exactly the
@@ -253,6 +254,30 @@ def check_metrics(scrapes: list[dict[str, float]], *,
                         "(hot path never engaged)")
         if last.get('vod_packets_total{path="hot"}', 0) == 0:
             errs.append("vod soak staged zero hot-path packets")
+    # reliability-tier invariants (ISSUE 11): a device/host parity
+    # divergence is a wire-corruption bug at ANY time; a lossy soak
+    # must have actually recovered something, never exhausted an RTX
+    # budget, and the closed loop must have visibly raised overhead
+    if last.get("fec_parity_oracle_mismatch_total", 0) > 0:
+        errs.append(f"fec parity oracle mismatches: "
+                    f"{last['fec_parity_oracle_mismatch_total']:.0f} "
+                    "(device GF parity disagreed with the host oracle)")
+    if lossy:
+        rec = last.get("fec_recovered_total", 0) \
+            + last.get("rtx_sent_total", 0)
+        if rec == 0:
+            errs.append("lossy soak recovered zero packets "
+                        "(fec_recovered_total + rtx_sent_total == 0)")
+        if last.get("rtx_giveup_total", 0) > 0:
+            errs.append(f"RTX budget exhausted during the lossy soak: "
+                        f"{last['rtx_giveup_total']:.0f} give-ups")
+        overhead = max((v for k, v in last.items()
+                        if k.startswith("fec_overhead_ratio")),
+                       default=0.0)
+        if overhead <= 0.0:
+            errs.append("closed-loop FEC overhead never left 0 under "
+                        f"{lossy:.0f}% injected loss (controller not "
+                        "tracking)")
     if last.get("ingest_oversize_dropped_total", 0) > 0:
         errs.append(f"ingest drops: "
                     f"{last['ingest_oversize_dropped_total']:.0f}")
@@ -480,7 +505,8 @@ def _check_chaos(app, clear_time: float, t_full: float | None,
 async def soak(seconds: float, n_sources: int = 0,
                chaos_seed: int | None = None, devices: int = 1,
                egress_backend: str | None = None,
-               hls_ladder: int = 0, vod: int = 0) -> int:
+               hls_ladder: int = 0, vod: int = 0,
+               lossy: float = 0.0) -> int:
     chaos = chaos_seed is not None
     hls_ladder = max(0, min(int(hls_ladder), 3))   # q6..q18 in 6-steps
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
@@ -520,6 +546,20 @@ async def soak(seconds: float, n_sources: int = 0,
         cfg.tpu_fanout = True
         cfg.tpu_min_outputs = 1
         cfg.resilience_fault_plan = f"seed={chaos_seed},{CHAOS_PLAN}"
+    if lossy:
+        # --lossy PCT: the reliability tier under receiver-side loss,
+        # with the ENGINE paths on (parity windows ride the same
+        # relay_rtcp tail either way, but the device parity kernel +
+        # oracle must actually run against engine-served media)
+        cfg.tpu_fanout = True
+        cfg.tpu_min_outputs = 1
+        # the lossy harness adds a per-datagram Python receiver + the
+        # RR/NACK round-trips IN-PROCESS with the pump on this box's
+        # two cores, so tail noise past the live 50 ms objective is
+        # harness contention, not server regression (the --vod
+        # calibration precedent); the gapless-playback and
+        # starved-player verdicts own delivery health here
+        cfg.slo_latency_objective_ms = 200.0
     app = StreamingServer(cfg)
     await app.start()
     failures: list[str] = []
@@ -586,6 +626,102 @@ async def soak(seconds: float, n_sources: int = 0,
             client_ports=[(udp2_rtp.getsockname()[1],
                            udp2_rtcp.getsockname()[1])])
         udp2_rx = [0]
+
+        # --- lossy player (ISSUE 11): a plain-UDP subscriber on
+        # /live/b whose receiver LOSES a seeded fraction of everything
+        # it is sent (the wire is untouched — the egress_drop site's
+        # schedule runs receiver-side), sends HONEST RRs computed from
+        # its own loss accounting plus RFC 4585 generic NACKs, and
+        # reconstructs the stream through the FEC receiver model.  The
+        # verdicts: gapless playback after recovery, nonzero recovered
+        # packets, zero RTX budget exhaustion, zero parity-oracle
+        # mismatches, and the closed-loop overhead gauge visibly off 0.
+        lossy_state: dict = {}
+        if lossy:
+            from easydarwin_tpu.protocol.rtcp import (GenericNack,
+                                                      ReceiverReport,
+                                                      ReportBlock)
+            from easydarwin_tpu.relay.fec import FecReceiver
+            from easydarwin_tpu.resilience.inject import (FaultInjector,
+                                                          FaultPlan)
+            l_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            l_rtp.bind(("127.0.0.1", 0))
+            l_rtp.setblocking(False)
+            l_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            l_rtcp.bind(("127.0.0.1", 0))
+            l_rtcp.setblocking(False)
+            lossy_player = RtspClient()
+            await lossy_player.connect("127.0.0.1", app.rtsp.port)
+            await lossy_player.play_start(
+                f"{base}/live/b", tcp=False,
+                client_ports=[(l_rtp.getsockname()[1],
+                               l_rtcp.getsockname()[1])],
+                setup_headers={"x-fec": "parity"})
+            l_out = next(
+                cn for cn in app.rtsp.connections
+                if cn.player_tracks
+                and getattr(cn.player_tracks[1].output, "rtcp_addr",
+                            None) == ("127.0.0.1",
+                                      l_rtcp.getsockname()[1])
+            ).player_tracks[1].output
+            assert getattr(l_out, "fec", None) is not None, \
+                "lossy player's output was not FEC-armed"
+            # a PRIVATE injector instance: the seeded drop schedule
+            # must not interleave with any server-side armed plan
+            l_inj = FaultInjector()
+            l_inj.arm(FaultPlan.parse(
+                f"seed=23,egress_drop={lossy / 100.0}"))
+            l_rx = FecReceiver(media_pt=96,
+                               fec_pt=cfg.fec_payload_type,
+                               rtx_pt=cfg.rtx_payload_type)
+            lossy_state = {"rx": l_rx, "out": l_out, "inj": l_inj,
+                           "sock": l_rtp, "rtcp": l_rtcp,
+                           "player": lossy_player,
+                           "seen": 0, "dropped": 0,
+                           "int_seen": 0, "int_dropped": 0}
+
+            def lossy_drain() -> None:
+                st = lossy_state
+                while True:
+                    try:
+                        d = l_rtp.recv(65536)
+                    except BlockingIOError:
+                        break
+                    if len(d) < 12:
+                        continue
+                    st["seen"] += 1
+                    st["int_seen"] += 1
+                    if l_inj.egress_drop():
+                        # receiver-side loss: media, parity and RTX
+                        # all ride the same lossy last mile
+                        st["dropped"] += 1
+                        st["int_dropped"] += 1
+                        continue
+                    l_rx.on_packet(d)
+
+            def lossy_feedback() -> None:
+                """Honest RR (measured interval loss) + generic NACKs
+                for the gaps FEC has not solved yet."""
+                st = lossy_state
+                if not l_rx.media:
+                    return
+                seen, dropped = st["int_seen"], st["int_dropped"]
+                st["int_seen"] = st["int_dropped"] = 0
+                frac = min(int(min(dropped / seen, 1.0) * 256), 255) \
+                    if seen else 0
+                hi = max(l_rx.media)
+                rr = ReceiverReport(0x7C7C, [ReportBlock(
+                    l_out.rewrite.ssrc, frac, st["dropped"],
+                    hi & 0xFFFF, 0, 0, 0)]).to_bytes()
+                l_rtcp.sendto(rr, ("127.0.0.1", egress.rtcp_port))
+                # NACK the residue (skip the newest window: in flight)
+                miss = l_rx.missing(min(l_rx.media),
+                                    hi - cfg.fec_window)[-32:]
+                if miss:
+                    l_rtcp.sendto(GenericNack.from_seqs(
+                        0x7C7C, l_out.rewrite.ssrc,
+                        [m & 0xFFFF for m in miss]).to_bytes(),
+                        ("127.0.0.1", egress.rtcp_port))
 
         # --- VOD players (ISSUE 10): N interleaved-TCP players across
         # the synthetic assets, each re-PLAYing with a seeded Range
@@ -743,6 +879,10 @@ async def soak(seconds: float, n_sources: int = 0,
                     break
                 if len(d) >= 12:
                     udp2_rx[0] += 1
+            if lossy:
+                lossy_drain()
+                if f % 30 == 17:          # ~1 Hz honest RR + NACK round
+                    lossy_feedback()
             # drain UDP player + ack its packets (reliable window)
             acked = 0
             while True:
@@ -820,6 +960,21 @@ async def soak(seconds: float, n_sources: int = 0,
             f += 1
             await asyncio.sleep(0.03)
         await drain_task
+        if lossy:
+            # recovery grace: keep draining + NACKing the residue until
+            # playback is gapless (bounded — an unrecoverable gap is
+            # the failure the verdict below reports)
+            l_rx = lossy_state["rx"]
+            for _ in range(50):
+                lossy_drain()
+                if not l_rx.media:
+                    break
+                gaps = l_rx.missing(min(l_rx.media),
+                                    max(l_rx.media) - cfg.fec_window)
+                if not gaps:
+                    break
+                lossy_feedback()
+                await asyncio.sleep(0.1)
         for vt in vod_tasks:
             try:
                 await vt
@@ -937,6 +1092,25 @@ async def soak(seconds: float, n_sources: int = 0,
             for eng in app._engines.values():
                 if eng.send_errors:
                     failures.append(f"engine send errors: {eng.send_errors}")
+        if lossy:
+            # the ISSUE 11 acceptance: gapless playback at the injected
+            # loss rate with measurable recovery through FEC and/or RTX
+            l_rx = lossy_state["rx"]
+            if lossy_state["dropped"] == 0:
+                failures.append("lossy schedule dropped nothing (the "
+                                "run proved nothing)")
+            if not l_rx.media:
+                failures.append("lossy player received no media at all")
+            else:
+                gaps = l_rx.missing(min(l_rx.media),
+                                    max(l_rx.media) - cfg.fec_window)
+                if gaps:
+                    failures.append(
+                        f"lossy player playback gaps after recovery: "
+                        f"{len(gaps)} seqs (e.g. {gaps[:5]})")
+            if len(l_rx.recovered) + len(l_rx.rtx_restored) == 0:
+                failures.append("lossy player recovered zero packets "
+                                "(neither FEC nor RTX engaged)")
         chaos_stats: dict = {}
         if chaos:
             failures.extend(_check_chaos(app, clear_time, t_full,
@@ -955,7 +1129,8 @@ async def soak(seconds: float, n_sources: int = 0,
                                       expect_megabatch=n_sources >= 2,
                                       chaos=chaos,
                                       forced_backend=egress_backend,
-                                      hls_ladder=hls_ladder, vod=vod))
+                                      hls_ladder=hls_ladder, vod=vod,
+                                      lossy=lossy))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -1006,6 +1181,25 @@ async def soak(seconds: float, n_sources: int = 0,
         }
         if chaos:
             stats["chaos"] = chaos_stats
+        if lossy:
+            l_rx = lossy_state["rx"]
+            stats["lossy"] = {
+                "injected_pct": lossy,
+                "datagrams_seen": lossy_state["seen"],
+                "dropped": lossy_state["dropped"],
+                "media_received": len(l_rx.media),
+                "recovered_fec": len(l_rx.recovered),
+                "recovered_rtx": len(l_rx.rtx_restored),
+                "parity_sent": lossy_state["out"].fec.parity_sent,
+                "rtx_giveups": lossy_state["out"].fec.rtx_giveups,
+                "overhead_final":
+                    lossy_state["out"].fec.controller.overhead,
+                "fec_recovered_total":
+                    mlast.get("fec_recovered_total"),
+                "rtx_sent_total": mlast.get("rtx_sent_total"),
+                "oracle_mismatch_total":
+                    mlast.get("fec_parity_oracle_mismatch_total"),
+            }
         if vod:
             stats["vod"] = {
                 "players": vod, "assets": len(vod_assets),
@@ -1023,6 +1217,10 @@ async def soak(seconds: float, n_sources: int = 0,
         await tcp_player.close()
         await rel_player.close()
         await plain_player.close()
+        if lossy and lossy_state.get("player") is not None:
+            await lossy_state["player"].close()
+            lossy_state["sock"].close()
+            lossy_state["rtcp"].close()
         for c in vod_clients:
             await c.close()
         await push_a.close()
@@ -1441,6 +1639,16 @@ def _parse_args(argv: list[str]):
                          "through the engine paths (ISSUE 10); fails "
                          "on zero cache hits, any host-oracle wire "
                          "mismatch, or a starved player")
+    ap.add_argument("--lossy", type=float, nargs="?", const=8.0,
+                    default=0.0, metavar="PCT",
+                    help="add a plain-UDP player whose receiver loses "
+                         "PCT%% of everything on a seeded schedule "
+                         "(default 8), sending honest RRs + RFC 4585 "
+                         "NACKs (ISSUE 11); fails on playback gaps "
+                         "after FEC/RTX recovery, zero recovered "
+                         "packets, RTX budget exhaustion, any parity-"
+                         "oracle mismatch, or a closed-loop overhead "
+                         "that never tracked the loss")
     ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
                     metavar="SEED",
                     help="run under a seeded FaultPlan (resilience/"
@@ -1499,4 +1707,5 @@ if __name__ == "__main__":
     raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
                                       _ns.chaos, _ns.devices,
                                       _ns.egress_backend,
-                                      _ns.hls_ladder, _ns.vod)))
+                                      _ns.hls_ladder, _ns.vod,
+                                      _ns.lossy)))
