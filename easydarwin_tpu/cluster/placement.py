@@ -142,12 +142,18 @@ class PlacementService:
         self._observed.pop(path, None)
 
     # -- fenced claims -----------------------------------------------------
-    def claim_command(self, path: str, token: int, *, ttl: int = 0):
+    def claim_command(self, path: str, token: int, *, ttl: int = 0,
+                      extra: dict | None = None):
         """The pipeline-able form of :meth:`claim` (fenced EVAL fset);
-        pair each pipelined reply with :meth:`claim_result`."""
+        pair each pipelined reply with :meth:`claim_result`.  ``extra``
+        rides the record as its ``dvr`` key — the spilled-window
+        advertisement peers consult for cache peer-fill (ISSUE 12)."""
         from .redis_client import FENCE_SET_LUA
+        rec: dict = {"node": self.node_id}
+        if extra:
+            rec["dvr"] = extra
         return ("EVAL", FENCE_SET_LUA, 1, own_key(path), int(token),
-                json.dumps({"node": self.node_id}, separators=(",", ":")),
+                json.dumps(rec, separators=(",", ":")),
                 int(ttl))
 
     def claim_result(self, path: str, ok) -> bool:
@@ -162,11 +168,12 @@ class PlacementService:
                               stream=path)
         return bool(ok)
 
-    async def claim(self, path: str, token: int, *, ttl: int = 0) -> bool:
+    async def claim(self, path: str, token: int, *, ttl: int = 0,
+                    extra: dict | None = None) -> bool:
         """Record this node as ``path``'s owner, fenced by ``token``.
         False = a newer token holds the record (we are the zombie)."""
         ok = await self.redis.execute(
-            *self.claim_command(path, token, ttl=ttl))
+            *self.claim_command(path, token, ttl=ttl, extra=extra))
         return self.claim_result(path, ok)
 
     async def release(self, path: str, token: int) -> bool:

@@ -124,6 +124,16 @@ class ClusterService:
         self._running = False
         self.ticks = 0
         self.migrations = 0
+        #: app hook: ``() -> {path: {track: [win_lo, win_hi]}}`` — the
+        #: DVR tier's spilled-window spans, folded into this node's
+        #: fenced Own: records so a flash crowd on a peer warms from
+        #: THIS node's spill files instead of origin (ISSUE 12)
+        self.dvr_advertise = None
+        #: what the LAST ownership scan saw other LIVE nodes advertise:
+        #: path -> (ip, http_port, {track: [win_lo, win_hi]}).  Read
+        #: synchronously by the app's DVR peer-fill fetcher (the segment
+        #: cache calls it inline), refreshed once per cluster tick.
+        self.dvr_peers: dict[str, tuple[str, int, dict]] = {}
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -232,9 +242,18 @@ class ClusterService:
         pulled = set(self.pulls)
         return [p for p in self.registry.paths() if p not in pulled]
 
+    def _dvr_adverts(self) -> dict:
+        if self.dvr_advertise is None:
+            return {}
+        try:
+            return self.dvr_advertise() or {}
+        except Exception:
+            return {}
+
     async def _claim_local_sources(self, nodes: dict) -> None:
         cfg = self.config
         local = self.local_source_paths()
+        adv = self._dvr_adverts()
         # fresh claims (rare: a source just attached) stay individual —
         # they need a claimant read + a minted token first
         for path in local:
@@ -247,7 +266,8 @@ class ClusterService:
                 continue
             tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
             if await self.placement.claim(path, tok,
-                                          ttl=int(cfg.own_ttl_sec)):
+                                          ttl=int(cfg.own_ttl_sec),
+                                          extra=adv.get(path)):
                 self._claims[path] = tok
             else:
                 self._fence_lost(path)
@@ -257,7 +277,8 @@ class ClusterService:
         claimed = [(p, self._claims[p]) for p in local if p in self._claims]
         if claimed:
             replies = await self.redis.pipeline(
-                [self.placement.claim_command(p, t, ttl=int(cfg.own_ttl_sec))
+                [self.placement.claim_command(p, t, ttl=int(cfg.own_ttl_sec),
+                                              extra=adv.get(p))
                  for p, t in claimed])
             publishes = []
             for (path, tok), ok in zip(claimed, replies):
@@ -322,6 +343,7 @@ class ClusterService:
         cfg = self.config
         ring = self.placement.ring(nodes)
         records = await scan_fenced(self.redis, OWN_KEY_PREFIX)
+        dvr_peers: dict[str, tuple[str, int, dict]] = {}
         for key, (_token, payload) in records.items():
             try:
                 rec = json.loads(payload)
@@ -330,12 +352,23 @@ class ClusterService:
             if not isinstance(rec, dict) or not rec.get("node"):
                 continue            # corrupt record: skip, don't abort
             holder = str(rec["node"])
+            path = "/" + key[len(OWN_KEY_PREFIX):]
+            # DVR peer-fill map (ISSUE 12): a LIVE peer advertising
+            # spilled windows for this path can warm our cold opens
+            # through its spill files instead of origin
+            dvr = rec.get("dvr")
+            if (isinstance(dvr, dict) and dvr and holder != cfg.node_id
+                    and holder in nodes):
+                meta = nodes[holder]
+                host, port = meta.get("ip"), meta.get("http")
+                if host and port:
+                    dvr_peers[path] = (str(host), int(port), dvr)
             if holder == cfg.node_id or holder in nodes:
                 continue                      # live owner (or us)
-            path = "/" + key[len(OWN_KEY_PREFIX):]
             if ring.owner(path) != cfg.node_id:
                 continue                      # a different successor
             await self._adopt(path, holder)
+        self.dvr_peers = dvr_peers
 
     async def _adopt(self, path: str, from_node: str) -> None:
         cfg = self.config

@@ -1,0 +1,19 @@
+"""DVR / time-shift subsystem (ISSUE 12).
+
+Live relay rings spill completed absolute-id windows to disk already in
+the fixed-slot packed serving format (``spill``); pause/rewind/catch-up
+on live streams and instant stream-to-VOD replay are served by the
+shared VOD pacer against those windows (``timeshift``), managed and
+wired into the server by ``service``.  See ARCHITECTURE.md §9c.
+"""
+
+from .service import DVR_SUFFIX, DvrAsset, DvrManager  # noqa: F401
+from .spill import (SpilledTrack, SpillWriter,  # noqa: F401
+                    WindowRows, WindowSpiller, decode_blob, encode_blob,
+                    snapshot_window)
+from .timeshift import TimeShiftSession  # noqa: F401
+
+__all__ = ["DvrManager", "DvrAsset", "DVR_SUFFIX", "SpillWriter",
+           "SpilledTrack", "WindowSpiller", "WindowRows",
+           "TimeShiftSession", "snapshot_window", "encode_blob",
+           "decode_blob"]

@@ -1,0 +1,359 @@
+"""DVR manager: arm/spill/finalize lifecycle + time-shift serving.
+
+``DvrManager`` owns the recorder side of the subsystem: ANNOUNCE /
+RECORD / ``/api/v1/startrecord`` arm a per-stream ``WindowSpiller`` set
+writing under ``<movie_folder>/.dvr/<path>/track<id>/``; the pump tick
+drives the spillers; stopping (explicitly, or the pusher leaving)
+**finalizes** the asset — instant stream-to-VOD, because every window
+is already in the packed serving format (``index.json`` flips
+``complete``; nothing is re-encoded, re-muxed or re-packed).
+
+Serving: ``open_timeshift`` builds a :class:`TimeShiftSession` over an
+armed (live pause/rewind) or finalized (replay) asset and hands it to
+the shared VOD pacer.  Finalized assets are addressable as
+``<path>.dvr`` through the RTSP describe/setup chain.
+
+Cluster angle: each armed/finalized asset's spilled window span is
+advertised in the node's fenced ``Own:`` claim records (``advertise``),
+and the raw window blobs are served over REST
+(``/api/v1/dvrwindow``) — a flash crowd on node B for a stream
+recorded on node A peer-fills from A's spill files through the
+pluggable ``fetcher`` instead of hitting origin.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .. import obs
+from ..obs import EVENTS
+from ..protocol.sdp import _norm
+from ..utils.paths import confined_subpath
+from .spill import SpilledTrack, SpillError, SpillWriter, WindowSpiller
+from .timeshift import TimeShiftSession
+
+#: finalized/armed DVR assets are addressed as ``<live path>.dvr``
+DVR_SUFFIX = ".dvr"
+
+
+class _Armed:
+    __slots__ = ("session", "spillers", "dir", "sdp", "gen")
+
+    def __init__(self, session, spillers, dir_path, sdp, gen):
+        self.session = session
+        self.spillers = spillers         # track_id -> WindowSpiller
+        self.dir = dir_path
+        self.sdp = sdp
+        self.gen = gen                   # recording generation (meta)
+
+
+class DvrAsset:
+    """Read handle over one asset directory: per-track spilled indexes
+    + identity.  ``asset_key`` keys the segment cache's zero-repack
+    entries; ``close`` is the pacer-retire hook."""
+
+    def __init__(self, path: str, dir_path: str,
+                 tracks: dict[int, SpilledTrack], *, sdp: str = "",
+                 complete: bool = False, gen: int = 0):
+        self.path = path
+        self.dir = dir_path
+        self.tracks = tracks
+        self.sdp = sdp
+        self.complete = complete
+        #: the recording GENERATION rides the cache key: re-arming a
+        #: path truncates the spill files and restarts window ids at
+        #: the new ring's grid, so windows of the previous asset still
+        #: LRU-resident under the same (dir, track, win) must never
+        #: serve the new one
+        self.asset_key = ("dvr", dir_path, int(gen))
+
+    def duration_sec(self) -> float:
+        return max((sp.duration_sec() for sp in self.tracks.values()),
+                   default=0.0)
+
+    def close(self) -> None:
+        for sp in self.tracks.values():
+            sp.close()
+
+
+class DvrManager:
+    """Window-spill recorder + on-disk asset tree + time-shift opens."""
+
+    def __init__(self, root: str, cache, pacer, registry, *,
+                 window_pkts: int = 64,
+                 retention_bytes: int = 64 << 20,
+                 retention_sec: float = 300.0, error_log=None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.cache = cache
+        self.pacer = pacer
+        self.registry = registry
+        self.window_pkts = int(window_pkts)
+        self.retention_bytes = int(retention_bytes)
+        self.retention_sec = float(retention_sec)
+        self.error_log = error_log
+        self._armed: dict[str, _Armed] = {}
+        #: cluster peer-fill hook: (path, track_id, win) -> blob | None
+        self.fetcher = None
+        self.finalized_count = 0
+
+    # ------------------------------------------------------------ geometry
+    def _dir_for(self, path: str) -> str | None:
+        # crafted paths never escape the dvr root (shared guard)
+        return confined_subpath(self.root, _norm(path))
+
+    @staticmethod
+    def is_dvr_path(path: str) -> bool:
+        return _norm(path).endswith(DVR_SUFFIX)
+
+    @staticmethod
+    def live_path_of(path: str) -> str:
+        p = _norm(path)
+        return p[:-len(DVR_SUFFIX)] if p.endswith(DVR_SUFFIX) else p
+
+    # ----------------------------------------------------------------- arm
+    def arm(self, session, sdp_text: str = "") -> bool:
+        """Attach spillers to every stream of a live relay session.
+        Idempotent per path; re-arming after a finalize starts a fresh
+        asset (each track's spill file is truncated and its index
+        rewritten — the previous asset of the same path is gone)."""
+        path = session.path
+        if path in self._armed:
+            return False
+        dir_path = self._dir_for(path)
+        if dir_path is None:
+            return False
+        gen = self._read_gen(dir_path) + 1
+        spillers: dict[int, WindowSpiller] = {}
+        for tid, stream in session.streams.items():
+            w = SpillWriter(
+                os.path.join(dir_path, f"track{tid}"), stream.info,
+                window_pkts=self.window_pkts,
+                retention_bytes=self.retention_bytes,
+                retention_sec=self.retention_sec, gen=gen)
+            spillers[tid] = WindowSpiller(stream, w)
+        self._write_meta(dir_path, path, sdp_text, complete=False,
+                         gen=gen)
+        self._armed[path] = _Armed(session, spillers, dir_path, sdp_text,
+                                   gen)
+        EVENTS.emit("dvr.arm", stream=path, trace_id=session.trace_id,
+                    path=path, tracks=len(spillers))
+        return True
+
+    @staticmethod
+    def _read_gen(dir_path: str) -> int:
+        try:
+            with open(os.path.join(dir_path, "meta.json"),
+                      encoding="utf-8") as fh:
+                return int(json.load(fh).get("gen", 0))
+        except (OSError, ValueError, TypeError):
+            return 0
+
+    def _write_meta(self, dir_path: str, path: str, sdp_text: str, *,
+                    complete: bool, gen: int) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+        tmp = os.path.join(dir_path, "meta.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"path": path, "sdp": sdp_text,
+                       "complete": complete, "gen": int(gen)}, fh)
+        os.replace(tmp, os.path.join(dir_path, "meta.json"))
+
+    def armed(self, path: str) -> bool:
+        return _norm(path) in self._armed
+
+    # ---------------------------------------------------------------- tick
+    def tick(self, now_ms: int) -> int:
+        """Per pump wake: run every armed spiller (cheap no-op when no
+        window completed) and finalize assets whose session is gone."""
+        spilled = 0
+        for path, a in list(self._armed.items()):
+            if self.registry.find(path) is not a.session:
+                # pusher left / session replaced: the recording ends —
+                # instant stream-to-VOD
+                self.finalize(path)
+                continue
+            for sp in a.spillers.values():
+                spilled += sp.tick(now_ms)
+        if spilled:
+            self._update_bytes_gauge()
+        return spilled
+
+    def _update_bytes_gauge(self) -> None:
+        total = sum(sp.writer.live_bytes
+                    for a in self._armed.values()
+                    for sp in a.spillers.values())
+        obs.DVR_SPILL_BYTES.set(total)
+
+    # ------------------------------------------------------------ finalize
+    def finalize(self, path: str) -> dict | None:
+        """Stop spilling ``path`` and mark its asset complete.  The
+        asset is immediately servable (born pre-packed)."""
+        a = self._armed.pop(_norm(path), None)
+        if a is None:
+            return None
+        windows = 0
+        for tid, sp in a.spillers.items():
+            # flush EVERY window completed since the last tick — the
+            # per-wake max_windows cap does not apply to a finalize
+            try:
+                while sp.tick(1 << 62):
+                    pass
+            except Exception:
+                pass
+            windows += sp.writer.finalize()
+        self._write_meta(a.dir, a.session.path, a.sdp, complete=True,
+                         gen=a.gen)
+        self.finalized_count += 1
+        self._update_bytes_gauge()
+        EVENTS.emit("dvr.finalize", stream=a.session.path,
+                    trace_id=a.session.trace_id, path=a.session.path,
+                    windows=windows)
+        return {"path": a.session.path, "dir": a.dir,
+                "windows": windows}
+
+    def close(self) -> None:
+        for path in list(self._armed):
+            self.finalize(path)
+
+    # ------------------------------------------------------------- serving
+    def open_asset(self, path: str) -> DvrAsset | None:
+        """Read handle over an armed or finalized asset of ``path``
+        (the live path, without the .dvr suffix)."""
+        key = self.live_path_of(path)
+        dir_path = self._dir_for(key)
+        if dir_path is None or not os.path.isdir(dir_path):
+            return None
+        try:
+            with open(os.path.join(dir_path, "meta.json"),
+                      encoding="utf-8") as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            meta = {}
+        tracks: dict[int, SpilledTrack] = {}
+        for name in sorted(os.listdir(dir_path)):
+            if not name.startswith("track"):
+                continue
+            try:
+                tid = int(name[5:])
+            except ValueError:
+                continue
+            fetch = None
+            if self.fetcher is not None:
+                fetch = (lambda win, p=key, t=tid:
+                         self.fetcher(p, t, win))
+            try:
+                tracks[tid] = SpilledTrack(
+                    os.path.join(dir_path, name), fetch=fetch)
+            except SpillError:
+                continue
+        if not tracks:
+            return None
+        try:
+            gen = int(meta.get("gen", 0))
+        except (TypeError, ValueError):
+            gen = 0
+        return DvrAsset(key, dir_path, tracks,
+                        sdp=meta.get("sdp", ""),
+                        complete=bool(meta.get("complete")), gen=gen)
+
+    async def describe(self, path: str) -> str | None:
+        """SDP for a ``<path>.dvr`` request (the describe-chain hook —
+        the stored push SDP serves verbatim; track controls/ids match
+        the spilled track numbering by construction)."""
+        if not self.is_dvr_path(path):
+            return None
+        asset = self.open_asset(path)
+        if asset is None or not asset.sdp:
+            return None
+        try:
+            return asset.sdp
+        finally:
+            asset.close()
+
+    def open_timeshift(self, path: str, outputs: dict[int, object], *,
+                       start_npt: float | None = None,
+                       start_ids: dict[int, int] | None = None,
+                       speed: float = 1.0,
+                       now_ms: int | None = None) -> TimeShiftSession | None:
+        """Build + adopt a time-shift session.  For a live path the
+        session's streams become the hot tail and catch-up target; for
+        a finalized ``.dvr`` asset it is a pure replay."""
+        live_key = self.live_path_of(path)
+        asset = self.open_asset(live_key)
+        if asset is None:
+            return None
+        live_session = None
+        if not self.is_dvr_path(path):
+            live_session = self.registry.find(live_key)
+        sess = TimeShiftSession(
+            self.pacer, asset, outputs, live_session=live_session,
+            start_npt=start_npt, start_ids=start_ids, speed=speed,
+            path=live_key, now_ms=now_ms)
+        self.pacer.adopt(sess)
+        return sess
+
+    # ----------------------------------------------------------- peer fill
+    def window_blob(self, path: str, track_id: int,
+                    win: int) -> bytes | None:
+        """Raw spill blob of one window — what the REST peer-fill
+        endpoint serves to other cluster nodes.  Armed assets serve
+        their live index; finalized ones their directory."""
+        key = self.live_path_of(path)
+        a = self._armed.get(key)
+        if a is not None:
+            sp = a.spillers.get(int(track_id))
+            if sp is not None:
+                rec = next((r for r in sp.writer.windows
+                            if r["win"] == int(win)), None)
+                if rec is not None:
+                    sp.writer._f.flush()
+                    with open(sp.writer.bin_path, "rb") as fh:
+                        fh.seek(rec["off"])
+                        return fh.read(rec["nbytes"])
+        asset = self.open_asset(key)
+        if asset is None:
+            return None
+        try:
+            sp = asset.tracks.get(int(track_id))
+            return sp.window_blob(int(win)) if sp is not None else None
+        finally:
+            asset.close()
+
+    def advertise(self) -> dict:
+        """Spilled-window spans per ARMED path — folded into this
+        node's fenced ``Own:`` claim records so peers know which node's
+        spill files can warm a flash crowd.  Armed only by design: the
+        ``Own:`` vehicle lives exactly as long as the live stream's
+        claim, so a finalized asset's advertisement dies with its
+        record's TTL (``window_blob`` still serves finalized assets to
+        any peer that asks while a stale advert routes it here)."""
+        out: dict[str, dict] = {}
+        for path, a in self._armed.items():
+            spans = {}
+            for tid, sp in a.spillers.items():
+                if sp.writer.windows:
+                    spans[str(tid)] = [sp.writer.windows[0]["win"],
+                                       sp.writer.windows[-1]["win"]]
+            if spans:
+                out[path] = spans
+        return out
+
+    # ---------------------------------------------------------------- misc
+    def stats(self) -> dict:
+        return {
+            "armed": len(self._armed),
+            "finalized": self.finalized_count,
+            "spilled_windows": sum(
+                sp.spilled for a in self._armed.values()
+                for sp in a.spillers.values()),
+            "spill_bytes": sum(
+                sp.writer.live_bytes for a in self._armed.values()
+                for sp in a.spillers.values()),
+            "evictions": sum(
+                sp.writer.evictions for a in self._armed.values()
+                for sp in a.spillers.values()),
+        }
+
+
+__all__ = ["DvrManager", "DvrAsset", "DVR_SUFFIX"]

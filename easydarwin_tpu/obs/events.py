@@ -116,6 +116,17 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # output's FIRST exhaustion, never per NACKed seq
     "fec.host_fallback": ("mismatches",),
     "rtx.giveup": ("giveups",),
+    # DVR / time-shift subsystem (dvr/, ISSUE 12): arm/finalize are per
+    # asset lifecycle; catchup is latched once per joining track; a
+    # retention-evicted window under an active cursor is NOT an event
+    # (the eviction counter covers it — it is normal horizon movement)
+    "dvr.arm": ("path", "tracks"),
+    "dvr.finalize": ("path", "windows"),
+    "dvr.catchup": ("track", "join_id"),
+    # recording crash safety (vod/record.py): a leftover <file>.tmp
+    # found at boot means a recorder died mid-write — the orphan is
+    # reported, never silently deleted or served
+    "record.orphan": ("file",),
 }
 
 

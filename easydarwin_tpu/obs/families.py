@@ -305,6 +305,35 @@ VOD_PACKETS = REGISTRY.counter(
     "cold = per-sample mmap packetization on a cache miss)",
     labels=("path",))
 
+# ------------------------------------------------------------ DVR spill
+# The DVR / time-shift subsystem (ISSUE 12: dvr/).  Live ring windows
+# spill to disk in the fixed-slot packed format; pause/rewind/catch-up
+# is served by the VOD pacer against the spilled windows.
+# tools/metrics_lint.py enforces this family set (lint_dvr: closed set,
+# exact labels) and tools/soak.py --dvr keys on it.
+DVR_WINDOWS_SPILLED = REGISTRY.counter(
+    "dvr_windows_spilled_total",
+    "Completed live ring windows snapshot into a per-asset spill file "
+    "(fixed-slot rows + index record, the pack-at-record-time cost)")
+DVR_SPILL_BYTES = REGISTRY.gauge(
+    "dvr_spill_bytes",
+    "Bytes currently retained across all DVR spill files (live window "
+    "payloads + metadata, after retention eviction)")
+DVR_TIMESHIFT_SESSIONS = REGISTRY.gauge(
+    "dvr_timeshift_sessions_count",
+    "Time-shift sessions currently served by the group pacer (live "
+    "subscribers paused/rewound into the spill, plus finalized "
+    "stream-to-VOD assets being replayed)")
+DVR_CATCHUP_JOINS = REGISTRY.counter(
+    "dvr_catchup_joins_total",
+    "Time-shift sessions whose cursor reached the live ring head and "
+    "rejoined live fan-out gapless (same ssrc, contiguous seq via the "
+    "affine rewrite — the ring is the hot tail of one id space)")
+DVR_RETENTION_EVICTIONS = REGISTRY.counter(
+    "dvr_retention_evictions_total",
+    "Spilled windows dropped by the per-asset byte/duration retention "
+    "budget (oldest-first; the time-shift horizon moves forward)")
+
 # ------------------------------------------------------- reliability tier
 # The lossy-WAN FEC + NACK/RTX tier (ISSUE 11: relay/fec.py).
 # tools/metrics_lint.py enforces this family set (lint_fec: exact

@@ -70,15 +70,19 @@ from .trace import TRACER
 #: ``cache_fill`` is the VOD segment cache's window pack (packetize +
 #: classify + staging-row pre-pack, vod/cache.py) — filed under the
 #: ``vod`` engine so a dashboard can see what hot-asset admission costs
+#: ``spill`` is the DVR recorder's window snapshot+append (dvr/spill.py:
+#: ring rows → spill file + index update) — filed under the ``dvr``
+#: engine, so what continuous recording costs the pump is attributable
 PHASES = ("wake_to_pass", "h2d", "device_step", "d2h", "egress_native",
           "egress_io_uring", "rtcp_qos", "stage_gather", "h2d_overlap",
-          "cache_fill")
+          "cache_fill", "spill")
 #: engines that record phases: the native sendmmsg fast path, the
 #: [S,P,12] batch-header path, the scalar oracle, the jitted model
 #: pipeline, the pump loop (wake→pass only), the cross-stream megabatch
-#: scheduler, the VOD pacer/cache tier and test harnesses
+#: scheduler, the VOD pacer/cache tier, the DVR spill/time-shift tier
+#: and test harnesses
 ENGINES = ("native", "batch", "scalar", "pipeline", "pump", "megabatch",
-           "vod", "test")
+           "vod", "dvr", "test")
 
 #: sessions tracked for top-N attribution (LRU beyond this)
 MAX_SESSIONS = 256

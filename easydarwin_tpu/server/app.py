@@ -154,6 +154,14 @@ class StreamingServer:
         #: per-session FileSession
         self.vod_cache = None
         self.vod_pacer = None
+        #: DVR / time-shift tier (ISSUE 12: dvr/): window spill off the
+        #: live rings + pause/rewind/catch-up served by the VOD pacer;
+        #: built in start() after the cache/pacer exist, None = off
+        self.dvr = None
+        #: async peer-fill plumbing: (path, track, win) -> Future of the
+        #: helper-thread HTTP fetch (see _dvr_peer_fetch)
+        self._dvr_fetches: dict = {}
+        self._dvr_fetch_pool = None
         self.started_at = time.time()
         from .status import StatusMonitor
         self.status = StatusMonitor(self)
@@ -281,6 +289,29 @@ class StreamingServer:
                             f"vod cache: re-warming {n} windows")
                 except (OSError, ValueError):
                     pass
+        if self.config.dvr_enabled:
+            if self.vod_pacer is None:
+                if self.error_log:
+                    self.error_log.warning(
+                        "dvr_enabled needs vod_cache_enabled (the spill "
+                        "serves through the segment cache); DVR is OFF")
+            else:
+                from ..dvr import DvrManager
+                self.dvr = DvrManager(
+                    os.path.join(self.config.movie_folder, ".dvr"),
+                    self.vod_cache, self.vod_pacer, self.registry,
+                    window_pkts=self.config.dvr_window_pkts,
+                    retention_bytes=self.config.dvr_retention_bytes,
+                    retention_sec=self.config.dvr_retention_sec,
+                    error_log=self.error_log)
+                self.rtsp.dvr = self.dvr
+        # crash-safe recorder orphan sweep (vod/record.py): leftover
+        # <file>.mp4.tmp means a recorder died mid-write — report it
+        from ..vod.record import sweep_orphans
+        try:
+            sweep_orphans(self.config.movie_folder)
+        except OSError:
+            pass
         self._tasks = [
             asyncio.create_task(self._pump_loop(), name="relay-pump"),
             asyncio.create_task(self._sweep_loop(), name="timeout-sweep"),
@@ -306,6 +337,12 @@ class StreamingServer:
                 on_pull_failure=self._on_pull_failure,
                 on_fence_lost=self._cluster_fence_lost,
                 error_log=self.error_log)
+            if self.dvr is not None:
+                # spilled-window spans ride this node's fenced Own:
+                # records; cold DVR windows another node recorded
+                # peer-fill through its spill files, not origin
+                self.cluster.dvr_advertise = self.dvr.advertise
+                self.dvr.fetcher = self._dvr_peer_fetch
             await self.cluster.start()
             self.rtsp.describe_fallback = self._cluster_describe
         elif self.config.cloud_enabled:
@@ -358,6 +395,25 @@ class StreamingServer:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        # drain the recorder tier while sessions still exist: every
+        # in-flight MP4 finalizes (tmp→rename, playable moov) and every
+        # armed DVR asset flips complete — instant stream-to-VOD instead
+        # of an orphan sweep at next boot
+        try:
+            self.recordings.stop_all()
+        except Exception:
+            pass
+        if self.dvr is not None:
+            try:
+                self.dvr.close()
+            except Exception:
+                pass
+            self.rtsp.dvr = None
+            self.dvr = None
+        if self._dvr_fetch_pool is not None:
+            self._dvr_fetch_pool.shutdown(wait=False, cancel_futures=True)
+            self._dvr_fetch_pool = None
+            self._dvr_fetches.clear()
         if self.vod_pacer is not None:
             self.rtsp.vod_pacer = None
             try:
@@ -505,6 +561,90 @@ class StreamingServer:
             text = await self._user_describe_fallback(path)
         return text
 
+    #: in-flight DVR peer fetches we will still collect (bound: a slow
+    #: peer must not accumulate unbounded queued HTTP work)
+    _DVR_FETCH_INFLIGHT_MAX = 32
+
+    def _dvr_peer_fetch(self, path: str, track_id: int,
+                        win: int) -> bytes | None:
+        """Cluster peer-fill: fetch one spilled window blob from the
+        node whose fenced ``Own:`` record advertises it (the recording
+        node serves it over REST ``/api/v1/dvrwindow``).  The caller is
+        the segment cache's packed-fill path, INLINE ON THE PUMP — so
+        the HTTP round-trip runs on a helper thread and this returns
+        ``b""`` (fetch pending: retry next tick, the time-shift cursor
+        HOLDS) until the result lands; ``None`` means definitively
+        unavailable (no peer / outside the advertised span / fetch
+        failed) and the cursor hops the window."""
+        cluster = self.cluster
+        if cluster is None:
+            return None
+        from ..protocol.sdp import _norm
+        peer = cluster.dvr_peers.get(_norm(path))
+        if peer is None:
+            return None
+        host, port, spans = peer
+        span = spans.get(str(track_id))
+        if span is not None and not span[0] <= int(win) <= span[1]:
+            return None                 # advertised range excludes it
+        key = (_norm(path), int(track_id), int(win))
+        fut = self._dvr_fetches.get(key)
+        if fut is None:
+            if len(self._dvr_fetches) >= self._DVR_FETCH_INFLIGHT_MAX:
+                # reap done-but-unclaimed entries first: a session torn
+                # down mid-fetch never re-polls its key, and abandoned
+                # results must not pin the cap shut forever
+                for k in [k for k, f in self._dvr_fetches.items()
+                          if f.done()]:
+                    del self._dvr_fetches[k]
+                if len(self._dvr_fetches) >= self._DVR_FETCH_INFLIGHT_MAX:
+                    return None
+            if self._dvr_fetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._dvr_fetch_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="dvr-fetch")
+            self._dvr_fetches[key] = self._dvr_fetch_pool.submit(
+                self._dvr_fetch_blocking, host, int(port), path,
+                int(track_id), int(win))
+            return b""
+        if not fut.done():
+            return b""
+        del self._dvr_fetches[key]
+        try:
+            return fut.result()
+        except Exception:
+            return None
+
+    def _dvr_fetch_blocking(self, host: str, port: int, path: str,
+                            track_id: int, win: int) -> bytes | None:
+        """The actual HTTP GET — helper-thread only.  Sends this node's
+        REST credentials: on an auth-enabled cluster the peer's
+        ``/api/v1/dvrwindow`` sits behind the same shared config."""
+        import base64
+        import http.client
+        from urllib.parse import quote
+        headers = {}
+        if self.config.auth_enabled:
+            cred = (f"{self.config.rest_username}:"
+                    f"{self.config.rest_password}").encode()
+            headers["Authorization"] = \
+                "Basic " + base64.b64encode(cred).decode()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=2.0)
+            try:
+                conn.request(
+                    "GET", f"/api/v1/dvrwindow?path={quote(path)}"
+                           f"&track={track_id}&win={win}",
+                    headers=headers)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return resp.read()
+            finally:
+                conn.close()
+        except OSError:
+            return None
+
     def _write_vod_cache_meta(self) -> None:
         """Atomic write of the segment cache's hot-set metadata next to
         the relay checkpoint (same cadence, same tmp+rename rule)."""
@@ -647,6 +787,17 @@ class StreamingServer:
                 vod_pairs = []
                 if self.error_log:
                     self.error_log.warning(f"vod pacer: {e!r}")
+        # DVR window spill (ISSUE 12): snapshot any live ring window the
+        # head completed since the last wake (an integer compare per
+        # armed stream when nothing did).  Runs BEFORE the reflect pass
+        # so a time-shift cursor parked at the spill/ring seam sees the
+        # freshest cold tail.  Failures degrade recording, not relaying.
+        if self.dvr is not None and self.dvr._armed:
+            try:
+                self.dvr.tick(t)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(f"dvr spill: {e!r}")
         mega_pairs = []
         lad = self.ladder
         if use_tpu and self.config.megabatch_enabled:

@@ -54,6 +54,19 @@ class ServerConfig:
     # shared by every subscriber on that window) so a hot join's affine
     # prime pass costs zero H2D; host-only caching when off
     vod_cache_device: bool = True
+    # --- DVR / time-shift (ISSUE 12: dvr/).  On: every pushed live
+    # session's completed ring windows spill to
+    # <movie_folder>/.dvr/<path>/ already in the fixed-slot packed
+    # serving format (pack-at-record-time); live subscribers can PAUSE
+    # and PLAY with Range: into the past (served by the VOD pacer from
+    # the spill, catch-up rejoining live gapless), and stopping a
+    # recording finalizes an instantly-servable <path>.dvr asset.
+    # Requires vod_cache_enabled (the spill serves through the segment
+    # cache's zero-repack open path).
+    dvr_enabled: bool = False
+    dvr_window_pkts: int = 64              # packets per spill window
+    dvr_retention_bytes: int = 67_108_864  # per-track spill byte budget
+    dvr_retention_sec: float = 600.0       # per-track spill duration cap
     # --- dynamic modules (QTSServer::LoadModules / module_folder pref)
     module_folder: str = ""            # "" = no dynamic modules
     # --- device tier

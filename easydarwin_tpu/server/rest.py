@@ -264,33 +264,80 @@ class RestApi:
     _cmd_livedevicestream = _cmd_getdevicestream
 
     def _cmd_startrecord(self, params: dict, body: bytes) -> tuple[int, str]:
-        """Attach an MP4 recorder to a live session (RtspRecordModule)."""
+        """Attach an MP4 recorder to a live session (RtspRecordModule);
+        with the DVR tier on, also arm the window spiller (ISSUE 12) so
+        stop leaves BOTH an MP4 and an instantly-servable packed asset."""
         path = params.get("path", [""])[0]
         sess = self.app.registry.find(path) if path else None
         if sess is None:
             return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
         import os
+        from ..utils.paths import confined_subpath
         fname = params.get("file", [""])[0] or (
             sess.path.strip("/").replace("/", "_")
             + time.strftime("_%Y%m%d%H%M%S") + ".mp4")
-        full = os.path.join(self.config.movie_folder, os.path.basename(fname))
-        os.makedirs(self.config.movie_folder, exist_ok=True)
+        root = self.config.movie_folder
+        os.makedirs(root, exist_ok=True)
+        # confinement is commonpath-over-realpaths (utils/paths), the
+        # one test that rejects ALL the escape classes: `..` traversal,
+        # a sibling folder sharing the prefix string, and a symlink
+        # inside movie_folder pointing outside it
+        full = confined_subpath(root, fname)
+        if full is None:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": "file escapes movie_folder"})
+        os.makedirs(os.path.dirname(full), exist_ok=True)
         try:
             self.app.recordings.start(sess, full)
         except ValueError as e:
             return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
                                body={"Detail": str(e)})
+        dvr_armed = False
+        if self.app.dvr is not None:
+            sdp = self.app.registry.sdp_cache.get(sess.path) or ""
+            dvr_armed = self.app.dvr.arm(sess, sdp) or \
+                self.app.dvr.armed(sess.path)
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK,
-                           body={"Recording": sess.path, "File": full})
+                           body={"Recording": sess.path, "File": full,
+                                 "Dvr": "1" if dvr_armed else "0"})
 
     def _cmd_stoprecord(self, params: dict, body: bytes) -> tuple[int, str]:
         path = params.get("path", [""])[0]
+        dvr_res = (self.app.dvr.finalize(path)
+                   if self.app.dvr is not None else None)
         try:
             res = self.app.recordings.stop(path)
         except KeyError:
-            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+            if dvr_res is None:
+                return 404, ep.ack(ep.MSG_SC_EXCEPTION,
+                                   error=ep.ERR_NOT_FOUND)
+            # DVR-only recording (armed at RECORD time, no MP4 sink)
+            return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+                "DvrWindows": str(dvr_res["windows"])})
+        extra = ({"DvrWindows": str(dvr_res["windows"])}
+                 if dvr_res is not None else {})
         return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
-            "File": res["path"], "Samples": str(res["samples"])})
+            "File": res["path"], "Samples": str(res["samples"]), **extra})
+
+    def _cmd_dvrwindow(self, params: dict,
+                       body: bytes) -> tuple[int, object, str] | tuple[int, str]:
+        """GET /api/v1/dvrwindow?path=&track=&win= — one spilled window's
+        raw blob bytes, exactly as the spill file stores them.  This is
+        the cluster peer-fill wire: node B time-shifting a stream node A
+        recorded block-fills from A's spill files through here instead
+        of hitting origin (the fetch side is ``app._dvr_peer_fetch``)."""
+        if self.app.dvr is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        path = params.get("path", [""])[0]
+        try:
+            track = int(params.get("track", [""])[0])
+            win = int(params.get("win", [""])[0])
+        except ValueError:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST)
+        blob = self.app.dvr.window_blob(path, track, win)
+        if blob is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, blob, "application/octet-stream"
 
     async def _cmd_startpullrelay(self, params: dict,
                                   body: bytes) -> tuple[int, str]:

@@ -76,6 +76,13 @@ class RtspConnection:
         self.relay: RelaySession | None = None
         self.vod_file = None                 # Mp4File when playing VOD
         self.vod_session = None              # FileSession
+        #: the ``<live>.dvr`` asset path when SETUP landed on a spilled
+        #: DVR asset (pure replay through the time-shift tier)
+        self.dvr_path: str | None = None
+        #: per-track absolute resume cursors latched by a PAUSE under an
+        #: armed spiller: the next PLAY re-enters the past exactly here
+        #: (cleared by a successful resume or an explicit Range seek)
+        self.pause_ids: dict[int, int] | None = None
         self.is_pusher = False
         self.playing = False
         self.player_tracks: dict[int, _PlayerTrack] = {}
@@ -395,6 +402,11 @@ class RtspConnection:
                     req.cseq)
 
     async def _setup_play(self, req, base, track_id, t) -> None:
+        dvr = self.server.dvr
+        if (dvr is not None and dvr.is_dvr_path(base)
+                and self.vod_file is None):
+            await self._setup_play_dvr(req, base, track_id, t)
+            return
         relay = await self.server.open_for_play(base)
         if relay is None:
             await self._setup_play_vod(req, base, track_id, t)
@@ -576,18 +588,98 @@ class RtspConnection:
             "Transport": resp_t.to_header(), **rel_extra, **meta_extra}),
             req.cseq)
 
+    async def _setup_play_dvr(self, req, base, track_id, t) -> None:
+        """SETUP on a ``<live>.dvr`` asset path: the spilled per-track
+        indexes name the tracks; outputs are ordinary player outputs
+        the time-shift session block-fills at PLAY.  x-RTP-Meta-Info
+        and x-FEC are not offered here — ft/pn need mp4 sample tables
+        and FEC needs a live RelayStream, neither of which a spilled
+        asset has (reliable-UDP remains the replay loss story)."""
+        dvr = self.server.dvr
+        asset = dvr.open_asset(base)
+        if asset is None:
+            raise rtsp.RtspError(404)
+        try:
+            track_ids = sorted(asset.tracks)
+        finally:
+            asset.close()
+        if track_id is None:
+            avail = [i for i in track_ids if i not in self.player_tracks]
+            track_id = avail[0] if avail else None
+        if track_id is None or track_id not in track_ids:
+            raise rtsp.RtspError(404, f"unknown track {track_id}")
+        self.dvr_path = sdp._norm(base)
+        self.path = self.dvr_path
+        out, resp_t, pair = await self._make_output(t)
+        out, rel_extra = self._negotiate_retransmit(req, out, t)
+        self._install_player_track(track_id, out, pair)
+        self._reply(rtsp.RtspResponse(200, {
+            "Transport": resp_t.to_header(), **rel_extra}), req.cseq)
+
     async def _do_record(self, req: rtsp.RtspRequest) -> None:
         if not self.is_pusher or self.relay is None:
             raise rtsp.RtspError(455)
         self.relay.pusher_alive = True
+        if self.server.dvr is not None:
+            # dvr_enabled: every pushed broadcast records — completed
+            # ring windows spill to the packed-window store from the
+            # first full window on (idempotent re-arm on re-RECORD)
+            self.server.dvr.arm(
+                self.relay,
+                self.server.registry.sdp_cache.get(self.relay.path) or "")
         self._reply(rtsp.RtspResponse(200), req.cseq)
+
+    @staticmethod
+    def _range_npt(req: rtsp.RtspRequest) -> float | None:
+        """The numeric start of a ``Range: npt=…`` header, or None for
+        a missing/``now`` range (``npt=now-`` means the live edge, RFC
+        2326 §3.6 — only an explicit number asks for the past)."""
+        rng = req.headers.get("range", "")
+        if not rng.startswith("npt="):
+            return None
+        start = rng[4:].split("-")[0].strip()
+        if not start or start == "now":
+            return None
+        try:
+            return max(float(start), 0.0)
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _parse_speed(req: rtsp.RtspRequest) -> tuple[float, dict]:
+        """RFC 2326 §12.35 Speed on a time-shift PLAY: the catch-up
+        accelerator (delivery-rate factor; >1 is how a shifted viewer
+        reaches the live head and rejoins).  Out-of-range plays at 1×
+        and the response says so."""
+        v = req.headers.get("speed", "")
+        if not v:
+            return 1.0, {}
+        try:
+            f = float(v)
+        except ValueError:
+            f = None
+        if f is None or not 0.01 <= f <= 8.0:
+            return 1.0, {"Speed": "1"}
+        return f, {"Speed": f"{f:g}"}
 
     async def _do_play(self, req: rtsp.RtspRequest) -> None:
         if self.vod_file is not None:
             await self._do_play_vod(req)
             return
+        if self.dvr_path is not None:
+            await self._do_play_dvr(req)
+            return
         if self.relay is None or not self.player_tracks:
             raise rtsp.RtspError(455)
+        # live path under an armed spiller: an explicit numeric Range
+        # (rewind) or a latched PAUSE bookmark re-enters through the
+        # time-shift tier; ``npt=now-`` / no Range joins the live edge
+        dvr = self.server.dvr
+        start_npt = self._range_npt(req)
+        if (dvr is not None
+                and (start_npt is not None or self.pause_ids)
+                and self._play_timeshift(req, start_npt)):
+            return
         infos = []
         for tid, pt in self.player_tracks.items():
             if pt.output not in self.relay.streams[tid].outputs:
@@ -669,9 +761,94 @@ class RtspConnection:
             "Range": f"npt={start_npt:.3f}-", "RTP-Info": infos,
             **extra}), req.cseq)
 
-    async def _do_pause(self, req: rtsp.RtspRequest) -> None:
+    def _play_timeshift(self, req, start_npt: float | None) -> bool:
+        """PLAY into the past on a LIVE subscription: detach from the
+        live fan-out and hand the outputs (rewrite state intact — same
+        ssrc, contiguous seq across the shift and the eventual catch-up
+        join) to a pacer-driven TimeShiftSession over the spilled
+        windows.  An explicit Range wins over a pause bookmark; returns
+        False (caller joins live) when the asset has nothing yet."""
+        speed, extra = self._parse_speed(req)
+        outputs = {tid: pt.output
+                   for tid, pt in self.player_tracks.items()}
+        start_ids = None if start_npt is not None else self.pause_ids
+        self._detach_outputs()
         if self.vod_session is not None:
             self.vod_session.stop()
+            self.vod_session = None
+        sess = self.server.dvr.open_timeshift(
+            self.path, outputs, start_npt=start_npt,
+            start_ids=start_ids, speed=speed)
+        if sess is None:
+            return False
+        self.vod_session = sess
+        self.pause_ids = None
+        self.playing = True
+        self.server.stats["players"] += 1
+        self.server.wake_pump()
+        infos = ",".join(
+            f"url={req.uri.rstrip('/')}/trackID={tid}"
+            f";seq={pt.output.rewrite.out_seq_start}"
+            for tid, pt in self.player_tracks.items())
+        self._reply(rtsp.RtspResponse(200, {
+            "Range": f"npt={sess.position_npt() or sess.start_npt:.3f}-",
+            "RTP-Info": infos, **extra}), req.cseq)
+        return True
+
+    async def _do_play_dvr(self, req: rtsp.RtspRequest) -> None:
+        """PLAY a spilled ``.dvr`` asset: pure replay under the shared
+        VOD pacer (instant stream-to-VOD — nothing was re-muxed; live
+        pause/rewind uses ``_play_timeshift`` on the live path)."""
+        if not self.player_tracks:
+            raise rtsp.RtspError(455)
+        start_npt = self._range_npt(req)
+        # no explicit Range + a latched PAUSE bookmark = resume exactly
+        # there (the same contract as the live _play_timeshift path);
+        # an explicit Range always wins and discards the bookmark
+        start_ids = None if start_npt is not None else self.pause_ids
+        speed, extra = self._parse_speed(req)
+        if self.vod_session is not None:
+            self.vod_session.stop()
+            self.vod_session = None
+        outputs = {tid: pt.output
+                   for tid, pt in self.player_tracks.items()}
+        sess = self.server.dvr.open_timeshift(
+            self.dvr_path, outputs, start_npt=start_npt,
+            start_ids=start_ids, speed=speed)
+        if sess is None:
+            raise rtsp.RtspError(404)
+        self.vod_session = sess
+        self.pause_ids = None
+        self.playing = True
+        self.server.stats["players"] += 1
+        self.server.wake_pump()
+        infos = ",".join(
+            f"url={req.uri.rstrip('/')}/trackID={tid}"
+            f";seq={pt.output.rewrite.out_seq_start}"
+            for tid, pt in self.player_tracks.items())
+        self._reply(rtsp.RtspResponse(200, {
+            "Range": f"npt={sess.position_npt() or sess.start_npt:.3f}-",
+            "RTP-Info": infos, **extra}), req.cseq)
+
+    async def _do_pause(self, req: rtsp.RtspRequest) -> None:
+        sess = self.vod_session
+        if sess is not None and hasattr(sess, "pause_ids"):
+            # pausing a time-shift session: latch the exact resume
+            # cursors (next id the PLAYER has not received)
+            self.pause_ids = sess.pause_ids()
+        elif (self.relay is not None and self.playing
+                and self.server.dvr is not None
+                and self.server.dvr.armed(self.path)):
+            # live pause under an armed spiller: each output's ring
+            # bookmark is the next unsent absolute id, and the spill
+            # shares the ring's id space — the bookmark IS the resume
+            # cursor (a resume before the first reflect just re-joins)
+            ids = {tid: int(pt.output.bookmark)
+                   for tid, pt in self.player_tracks.items()
+                   if pt.output.bookmark is not None}
+            self.pause_ids = ids or None
+        if sess is not None:
+            sess.stop()
             self.vod_session = None
         self._detach_outputs()
         self.playing = False
@@ -828,6 +1005,10 @@ class RtspServer:
         #: VodPacerGroup (ISSUE 10) — set by the app once the engine
         #: tier is probed; None = every PLAY gets the cold FileSession
         self.vod_pacer = None
+        #: DvrManager (ISSUE 12) — set by the app when dvr_enabled; None
+        #: = PAUSE detaches (classic), ``.dvr`` paths 404, RECORD never
+        #: arms a spiller
+        self.dvr = None
         self.auth = auth                     # AuthService or None
         self.access_log = access_log         # AccessLog or None
         from .modules import ModuleRegistry
@@ -910,6 +1091,9 @@ class RtspServer:
             text = await self.relay_source.describe(path)
         if text is None and self.vod is not None:
             text = await self.vod.describe(path)
+        if text is None and self.dvr is not None:
+            # <live path>.dvr: the spilled asset's stored push SDP
+            text = await self.dvr.describe(path)
         if text is None and self.describe_fallback is not None:
             text = await self.describe_fallback(path)
         return text

@@ -24,4 +24,17 @@ def under_root(root: str, candidate: str) -> bool:
         return False
 
 
-__all__ = ["under_root"]
+def confined_subpath(root: str, relative: str) -> str | None:
+    """Join an untrusted ``relative`` under ``root`` and confine it:
+    the normalized path, or None when it escapes (``..`` traversal,
+    symlink, sibling-prefix) or resolves to the root itself.  The one
+    guard shared by every surface that maps request strings to files
+    (``startrecord`` targets, DVR asset directories)."""
+    cand = os.path.normpath(os.path.join(root, relative.lstrip("/\\")))
+    if not under_root(root, cand) \
+            or os.path.realpath(cand) == os.path.realpath(root):
+        return None
+    return cand
+
+
+__all__ = ["under_root", "confined_subpath"]

@@ -129,8 +129,8 @@ class CachedWindow:
 
     __slots__ = ("key", "lo", "hi", "data", "length", "flags", "ts",
                  "sample", "npt", "pkt_base", "sample_npt", "staged",
-                 "pins", "hits", "_device", "_on_device",
-                 "device_uploads", "nbytes")
+                 "seq", "arrival", "pins", "hits", "_device",
+                 "_on_device", "device_uploads", "nbytes")
 
     def __init__(self, key, lo, hi, pkts, samples, npts, tss, is_video,
                  sample_npts=None):
@@ -165,6 +165,17 @@ class CachedWindow:
             self.sample_npt = np.zeros(hi - lo, np.float64)
             if len(self.sample):
                 self.sample_npt[self.sample - lo] = self.npt
+        #: per-packet source seq / relay-arrival ms — populated only for
+        #: DVR-spilled windows (``from_packed``), where the original
+        #: wire-header seq space and the arrival clock drive the
+        #: time-shift pacer; canonical mp4 windows carry None
+        self.seq = None
+        self.arrival = None
+        self._finish_init()
+
+    def _finish_init(self) -> None:
+        from ..ops import staging
+        n = len(self.length)
         self.staged = staging.pack_rows(self.data, self.length)
         pad = staging.pow2(max(n, 1), 16)
         if pad > n:                      # pow2 rows so the HBM copy's
@@ -182,6 +193,38 @@ class CachedWindow:
                        + self.ts.nbytes + self.npt.nbytes
                        + self.sample.nbytes + self.pkt_base.nbytes
                        + self.sample_npt.nbytes)
+
+    @classmethod
+    def from_packed(cls, key, id_lo: int, data, length, flags, ts, *,
+                    seq=None, arrival=None) -> "CachedWindow":
+        """Zero-repack construction from rows that are ALREADY in the
+        fixed-slot packed format (a DVR spill window, ``dvr/spill.py``):
+        no packetizer runs, no classification — the parallel arrays are
+        adopted as-is and only the fused staging rows (a memcpy) are
+        derived.  ``lo``/``hi``/``sample`` carry absolute packet ids
+        (the live ring's id space), not mp4 sample indices."""
+        n = len(length)
+        w = object.__new__(cls)
+        w.key = key
+        w.lo, w.hi = id_lo, id_lo + n
+        w.data = np.ascontiguousarray(data, np.uint8)
+        w.length = np.ascontiguousarray(length, np.int32)
+        w.flags = np.ascontiguousarray(flags, np.int32)
+        w.ts = np.ascontiguousarray(ts, np.int64)
+        w.sample = np.arange(id_lo, id_lo + n, dtype=np.int32)
+        w.npt = np.zeros(n, np.float64)
+        w.pkt_base = np.arange(n + 1, dtype=np.int64)
+        w.sample_npt = np.zeros(n, np.float64)
+        w.seq = (np.ascontiguousarray(seq, np.int32)
+                 if seq is not None else None)
+        w.arrival = (np.ascontiguousarray(arrival, np.int64)
+                     if arrival is not None else None)
+        w._finish_init()
+        if w.seq is not None:
+            w.nbytes += w.seq.nbytes
+        if w.arrival is not None:
+            w.nbytes += w.arrival.nbytes
+        return w
 
     @property
     def n_pkts(self) -> int:
@@ -215,7 +258,12 @@ def pack_window(file: Mp4File, track: Track, lo: int, hi: int,
     window: the SAME packetizer classes the cold path uses (fresh, seq
     from 0, ssrc 0), so fragmentation/marker/parameter-set layout is
     structurally byte-identical to a ``FileSession`` serving the same
-    samples."""
+    samples.
+
+    ``pack_window.calls`` counts invocations — the DVR acceptance pin:
+    spilled assets re-open with ZERO repacks (their windows enter the
+    cache via ``CachedWindow.from_packed``, never through here)."""
+    pack_window.calls += 1
     is_video = track.info.handler == "vide"
     if is_video:
         pk = H264Packetizer(track, ssrc=0, seq_start=0, mtu=VOD_MTU)
@@ -237,6 +285,10 @@ def pack_window(file: Mp4File, track: Track, lo: int, hi: int,
     return CachedWindow(key, lo, hi, pkts, samples, npts, tss, is_video,
                         sample_npts=track.dts[lo:hi].astype(np.float64)
                         / scale)
+
+
+#: repack-counter pin (see docstring above)
+pack_window.calls = 0
 
 
 def _asset_id(file: Mp4File) -> tuple:
@@ -305,6 +357,67 @@ class SegmentCache:
             self._executor().submit(self._fill_job, file, track_no,
                                     track, win, key)
         return None
+
+    def get_packed(self, asset_id: tuple, track_no: int, win: int,
+                   loader, *,
+                   background_fill: bool = True) -> CachedWindow | None:
+        """The DVR zero-repack open path (ISSUE 12): same LRU / pin /
+        byte-budget / HBM-residency machinery as :meth:`get`, but a
+        miss is filled by ``loader(win) -> CachedWindow | None`` — a
+        spill-file memcpy via ``CachedWindow.from_packed`` — instead of
+        ``pack_window``.  The hit/miss counters tick identically, so a
+        time-shift join is measurably served at hot-cache rates."""
+        key = (asset_id, track_no, int(win))
+        with self._lock:
+            w = self._lru.get(key)
+            if w is not None:
+                self._lru.move_to_end(key)
+                w.hits += 1
+                self.hits += 1
+                obs.VOD_CACHE_HITS.inc()
+                return w
+            self.misses += 1
+            obs.VOD_CACHE_MISSES.inc()
+            if self._closed:
+                return None
+            schedule = background_fill and key not in self._filling
+            if schedule:
+                self._filling.add(key)
+        if not schedule:
+            return None
+        return self._fill_packed_job(key, loader)
+
+    def _fill_packed_job(self, key, loader) -> CachedWindow | None:
+        """Synchronous packed fill: the load is a spill-file read +
+        memcpy scatter (no packetizer, no classify), cheap enough to
+        run inline on the caller — a pacer tick never waits on a PACK,
+        only on a bounded disk read."""
+        t0 = time.perf_counter_ns()
+        try:
+            w = loader(key[2])
+        except Exception:
+            self.fill_errors += 1
+            w = None
+        finally:
+            with self._lock:
+                self._filling.discard(key)
+        if w is None:
+            return None
+        w.key = key
+        dur = time.perf_counter_ns() - t0
+        PROFILER.account_pass("dvr", dur, {"cache_fill": dur})
+        with self._lock:
+            cur = self._lru.get(key)
+            if cur is not None:
+                return cur
+            self._lru[key] = w
+            w._on_device = (lambda n, k=key:
+                            self._account_device_bytes(k, n))
+            self.bytes += w.nbytes
+            self.fills += 1
+            self._evict_over_budget(keep=key)
+            obs.VOD_CACHE_BYTES.set(self.bytes)
+        return w
 
     def fill_now(self, file: Mp4File, track_no: int, track: Track,
                  win: int) -> CachedWindow | None:

@@ -10,14 +10,45 @@ Started/stopped via REST (``/api/v1/startrecord`` / ``stoprecord``).
 
 from __future__ import annotations
 
+import os
 import time
 
+from ..obs import EVENTS
 from ..relay.output import RelayOutput, WriteResult
 from ..relay.session import RelaySession
 from .depacketize import H264Depacketizer
 from .mp4_writer import Mp4Writer
 
 VIDEO_CLOCK = 90000
+
+#: crash-safety suffix: ``Mp4Writer`` only writes moov at close, so a
+#: recorder that dies mid-write leaves an unplayable file — all writing
+#: happens under this suffix and ``finish()`` atomically renames the
+#: completed file into place.  A leftover ``.tmp`` at boot is an orphan
+#: from a crashed recorder (``sweep_orphans``).
+TMP_SUFFIX = ".tmp"
+
+
+def sweep_orphans(folder: str) -> list[str]:
+    """Report recorder tmp files a crashed process left behind (one
+    ``record.orphan`` event each).  They are never deleted or served —
+    an operator decides whether the truncated mdat is worth salvaging;
+    re-recording to the same path overwrites the tmp anyway.  The walk
+    recurses: ``startrecord`` accepts nested ``file=`` paths, so an
+    orphan can sit anywhere under the movie folder (the ``.dvr`` spill
+    tree is skipped — it holds no MP4s and may be large)."""
+    orphans: list[str] = []
+    try:
+        for root, dirs, names in os.walk(folder):
+            dirs[:] = sorted(d for d in dirs if d != ".dvr")
+            for name in sorted(names):
+                if name.endswith(".mp4" + TMP_SUFFIX):
+                    full = os.path.join(root, name)
+                    orphans.append(full)
+                    EVENTS.emit("record.orphan", level="warn", file=full)
+    except OSError:
+        pass
+    return orphans
 
 
 class RecorderOutput(RelayOutput):
@@ -47,7 +78,9 @@ class RecorderOutput(RelayOutput):
         if self.writer is None:
             if not (self.depack.sps and self.depack.pps and au.is_idr):
                 return                    # wait for config + first IDR
-            self.writer = Mp4Writer(self.path)
+            # write under .tmp; finish() renames — a crash mid-record
+            # never leaves a moov-less file at the published path
+            self.writer = Mp4Writer(self.path + TMP_SUFFIX)
             self._video_track = self.writer.add_h264_track(
                 self.depack.sps, self.depack.pps, 0, 0,
                 timescale=VIDEO_CLOCK)
@@ -66,7 +99,8 @@ class RecorderOutput(RelayOutput):
         for au in self.depack.flush():
             self._write_unit(au)
         if self.writer is not None:
-            self.writer.close()
+            self.writer.close()           # moov lands in the tmp file
+            os.replace(self.path + TMP_SUFFIX, self.path)
         return {"path": self.path, "samples": self.samples,
                 "duration_sec": time.time() - self.started_at,
                 "malformed": self.depack.malformed}

@@ -689,6 +689,17 @@ class VodPacerGroup:
         obs.VOD_SESSIONS.set(len(self.sessions))
         return sess
 
+    def adopt(self, sess) -> object:
+        """Register an externally-built paced session (the DVR tier's
+        ``TimeShiftSession``, ``dvr/timeshift.py``) under this pacer's
+        tick/step/retire lifecycle.  The duck-typed contract is what
+        ``tick``/``retire`` already consume: ``tick(now_ms)``, ``done``,
+        ``stopped``, ``tracks`` (each with ``.stream``/``.release``),
+        ``file.close()`` and an optional ``on_retire`` hook."""
+        self.sessions.append(sess)
+        obs.VOD_SESSIONS.set(len(self.sessions))
+        return sess
+
     def retire(self, sess: PacedVodSession) -> None:
         if sess in self.sessions:
             self.sessions.remove(sess)
@@ -700,6 +711,12 @@ class VodPacerGroup:
         if not sess.stopped:
             sess.stopped = True
             sess.file.close()
+            # inside the stopped guard: retire() runs again when the
+            # connection later stop()s an auto-retired session, and a
+            # second on_retire would double-decrement the session gauge
+            cb = getattr(sess, "on_retire", None)
+            if cb is not None:
+                cb()
         obs.VOD_SESSIONS.set(len(self.sessions))
 
     # ---------------------------------------------------------------- tick

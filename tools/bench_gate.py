@@ -279,6 +279,37 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: fec recorded {mm} parity oracle "
                             "mismatches (device/host divergence on the "
                             "GF parity matmul)")
+        # ISSUE 12 DVR section — OPTIONAL (rounds predating the DVR
+        # tier stay valid), but when present: time-shift joins must be
+        # served at hot-cache rates (a positive finite rate, within an
+        # order of magnitude of the live join rate — cold-path-shaped
+        # joins defeat the born-packed design), spill throughput is a
+        # positive finite rate, and a spilled-asset re-open invoked the
+        # canonical repack exactly zero times (the acceptance pin)
+        dv = extra.get("dvr")
+        if isinstance(dv, dict) and dv and "error" not in dv:
+            ts_r = dv.get("timeshift_join_pps")
+            lv_r = dv.get("live_join_pps")
+            for kf, v2 in (("timeshift_join_pps", ts_r),
+                           ("live_join_pps", lv_r),
+                           ("spill_mbps", dv.get("spill_mbps"))):
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 <= 0:
+                    errs.append(f"{name}: dvr.{kf} {v2!r} not a "
+                                "positive finite rate")
+            if (isinstance(ts_r, (int, float))
+                    and isinstance(lv_r, (int, float))
+                    and math.isfinite(ts_r) and math.isfinite(lv_r)
+                    and lv_r > 0 and ts_r < lv_r / 10.0):
+                errs.append(f"{name}: dvr.timeshift_join_pps {ts_r} is "
+                            f"cold-path-shaped vs live_join_pps {lv_r} "
+                            "(spilled windows must serve at hot-cache "
+                            "rates)")
+            rp2 = dv.get("reopen_repacks", 0)
+            if rp2:
+                errs.append(f"{name}: dvr.reopen_repacks {rp2} != 0 "
+                            "(a spilled asset re-open ran pack_window; "
+                            "the zero-repack contract is broken)")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

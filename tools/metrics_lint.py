@@ -501,6 +501,44 @@ def lint_fec(registry, schema: dict) -> list[str]:
     return errs
 
 
+def lint_dvr(registry) -> list[str]:
+    """The DVR / time-shift contract (ISSUE 12): the spill/time-shift
+    families exist with their exact (empty) label sets, the ``dvr.*`` /
+    ``record.orphan`` event names are declared, and the ``spill`` phase
+    / ``dvr`` engine are in the closed profiler sets —
+    ``tools/soak.py --dvr`` and the bench ``extra.dvr`` section key on
+    these."""
+    errs: list[str] = []
+    want_labels = {
+        "dvr_windows_spilled_total": (),
+        "dvr_spill_bytes": (),
+        "dvr_timeshift_sessions_count": (),
+        "dvr_catchup_joins_total": (),
+        "dvr_retention_evictions_total": (),
+    }
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"dvr family {fam_name} missing from the "
+                        "registry")
+            continue
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    from easydarwin_tpu.obs import events as ev
+    for name in ("dvr.arm", "dvr.finalize", "dvr.catchup",
+                 "record.orphan"):
+        if name not in ev.SCHEMA:
+            errs.append(f"event {name} missing from SCHEMA")
+    from easydarwin_tpu.obs.profile import ENGINES, PHASES
+    if "spill" not in PHASES:
+        errs.append("phase 'spill' missing from obs.profile.PHASES")
+    if "dvr" not in ENGINES:
+        errs.append("engine 'dvr' missing from obs.profile.ENGINES")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -602,6 +640,9 @@ def main() -> int:
     # the reliability tier's vocabulary (ISSUE 11): FEC/RTX families +
     # the closed xor|rs kind set + receiver-side fault sites + events
     errs += lint_fec(obs.REGISTRY, ev.SCHEMA)
+    # the DVR / time-shift tier's vocabulary (ISSUE 12): spill/session
+    # families + dvr.* events + the spill phase / dvr engine
+    errs += lint_dvr(obs.REGISTRY)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -23,6 +23,20 @@ clearance, nonzero megabatch wire mismatches, or starved players — the
 checks that the injected drops legitimately break (HLS muxing/requant
 stats) are asserted only by the clean soak.
 
+``--dvr N`` adds N interleaved time-shift subscribers on the armed live
+push (dvr_enabled: every pushed broadcast records) who continuously
+PAUSE and re-PLAY into the past — even players rewind with ``Range:
+npt=0.0-``, odd players resume from the PAUSE bookmark, both at Speed 4
+so the catch-up state machine rejoins live over and over — plus a
+mid-soak ``stoprecord`` whose finalized asset must re-open as instant
+VOD (``/live/a.dvr``).  Fails on: any forward out-seq jump at a player
+(lost playback across a shift or catch-up join; replays legitimately
+re-cover already-sent seqs — duplicates and backward hops are fine),
+more than one ssrc per player, any ``pack_window`` invocation (spilled
+opens are zero-repack by contract), a spill retention budget overrun,
+ring-eviction window loss, zero counted catch-up joins, or a starved
+player.
+
 ``--cluster N`` runs the multi-server robustness scenario instead
 (ISSUE 6): a mini Redis + N real server processes with the cluster tier
 on, one pushed stream placed by consistent hash, a UDP subscriber on the
@@ -166,7 +180,7 @@ def check_metrics(scrapes: list[dict[str, float]], *,
                   chaos: bool = False,
                   forced_backend: str | None = None,
                   hls_ladder: int = 0, vod: int = 0,
-                  lossy: float = 0.0) -> list[str]:
+                  lossy: float = 0.0, dvr: int = 0) -> list[str]:
     """Counter-regression checks over the soak's periodic scrapes.
 
     ``chaos=True`` (a seeded FaultPlan was armed) skips exactly the
@@ -278,6 +292,18 @@ def check_metrics(scrapes: list[dict[str, float]], *,
             errs.append("closed-loop FEC overhead never left 0 under "
                         f"{lossy:.0f}% injected loss (controller not "
                         "tracking)")
+    # DVR / time-shift invariants (ISSUE 12): a --dvr soak must have
+    # actually spilled windows, joined back to live at least once (the
+    # catch-up state machine is the thing under test), and served its
+    # time-shift sessions (gauge may be 0 at exit — all retired)
+    if dvr:
+        if last.get("dvr_windows_spilled_total", 0) == 0:
+            errs.append("dvr soak spilled zero windows (recorder never "
+                        "engaged)")
+        if last.get("dvr_catchup_joins_total", 0) == 0:
+            errs.append("dvr soak counted zero catch-up joins (no "
+                        "time-shift session ever rejoined live — the "
+                        "run proved nothing)")
     if last.get("ingest_oversize_dropped_total", 0) > 0:
         errs.append(f"ingest drops: "
                     f"{last['ingest_oversize_dropped_total']:.0f}")
@@ -506,12 +532,32 @@ async def soak(seconds: float, n_sources: int = 0,
                chaos_seed: int | None = None, devices: int = 1,
                egress_backend: str | None = None,
                hls_ladder: int = 0, vod: int = 0,
-               lossy: float = 0.0) -> int:
+               lossy: float = 0.0, dvr: int = 0) -> int:
     chaos = chaos_seed is not None
     hls_ladder = max(0, min(int(hls_ladder), 3))   # q6..q18 in 6-steps
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        reflect_interval_ms=10, bucket_delay_ms=10,
                        access_log_enabled=False)
+    if dvr:
+        # --dvr N: N time-shift subscribers on /live/b continuously
+        # pausing and seeking into the past while the pusher keeps
+        # pushing (ISSUE 12), plus a mid-soak stoprecord on /live/a
+        # whose finalized asset must re-open as instant VOD.  Window
+        # small enough that windows complete every ~second at the
+        # soak's ~33 pps push rate; the duration retention cap is
+        # shorter than the default soak so eviction actually runs.
+        import tempfile
+        cfg.movie_folder = tempfile.mkdtemp(prefix="edtpu_dvr_soak_")
+        cfg.dvr_enabled = True
+        cfg.dvr_window_pkts = 32
+        cfg.dvr_retention_bytes = 32 << 20
+        cfg.dvr_retention_sec = 60.0
+        # a speed-4 catch-up burst deliberately delivers faster than
+        # realtime (the --vod calibration precedent: the seek/replay
+        # burst drains through TCP backpressure over a few hundred ms;
+        # the gap/starvation verdicts own delivery health)
+        cfg.slo_latency_objective_ms = max(
+            cfg.slo_latency_objective_ms, 1000.0)
     vod_assets: list[str] = []
     if vod:
         # --vod N: N RTSP players seeking across M synthetic assets
@@ -762,6 +808,94 @@ async def soak(seconds: float, n_sources: int = 0,
                             "PLAY", uri, {"range": f"npt={npt:.2f}-"})
                         assert r.status == 200, r.status
 
+        # --- DVR time-shift players (ISSUE 12): N interleaved-TCP
+        # subscribers on the armed /live/b who continuously PAUSE and
+        # re-PLAY into the past (even index: Range npt=0 — full-history
+        # replay; odd: resume from the PAUSE bookmark) at Speed 4, so
+        # the catch-up state machine joins back to live over and over.
+        # Verdicts: gapless out-seq per player across every shift and
+        # join (the affine rewrite makes a replay re-cover already-sent
+        # seqs — duplicates, never forward gaps), one ssrc, zero window
+        # repacks process-wide, retention budget respected, nonzero
+        # catch-up joins counted.
+        dvr_rx = [0] * max(dvr, 0)
+        dvr_seqs: list[list[int]] = [[] for _ in range(max(dvr, 0))]
+        dvr_ssrcs: list[set] = [set() for _ in range(max(dvr, 0))]
+        dvr_tasks: list[asyncio.Task] = []
+        instant_vod_rx = [0]
+        dvr_stopped = [False]
+        repack_base = 0
+        if dvr:
+            from easydarwin_tpu.protocol.rtp import RtpPacket
+            from easydarwin_tpu.vod.cache import pack_window
+            repack_base = pack_window.calls
+
+            async def dvr_player(i: int) -> None:
+                c = RtspClient()
+                await c.connect("127.0.0.1", app.rtsp.port)
+                uri = f"{base}/live/b"
+                await c.play_start(uri)
+
+                def note(d: bytes) -> None:
+                    if len(d) >= 12:
+                        dvr_rx[i] += 1
+                        p = RtpPacket.parse(d)
+                        dvr_seqs[i].append(p.seq)
+                        dvr_ssrcs[i].add(p.ssrc)
+
+                mode_next = t0 + 8.0 + i * 3.0
+                while time.time() - t0 < seconds:
+                    try:
+                        note(await c.recv_interleaved(0, timeout=0.25))
+                    except asyncio.TimeoutError:
+                        pass
+                    for _ in range(64):
+                        try:
+                            note(await c.recv_interleaved(0,
+                                                          timeout=0.002))
+                        except asyncio.TimeoutError:
+                            break
+                    if time.time() >= mode_next:
+                        mode_next = time.time() + 10.0
+                        r = await c.request("PAUSE", uri)
+                        assert r.status == 200, f"PAUSE {r.status}"
+                        await asyncio.sleep(0.8)   # dwell in the past
+                        hdrs = {"speed": "4"}      # catch-up accelerator
+                        if i % 2 == 0:
+                            # rewind to the recording start: always at
+                            # or behind the delivered cursor, so the
+                            # replay can never force a forward seq jump
+                            hdrs["range"] = "npt=0.0-"
+                        r = await c.request("PLAY", uri, hdrs)
+                        assert r.status == 200, f"PLAY {r.status}"
+                await c.teardown(uri)
+                await c.close()
+
+            async def instant_vod_reopen() -> None:
+                """Mid-soak stoprecord on /live/a: the finalized asset
+                must DESCRIBE/SETUP/PLAY instantly as /live/a.dvr (born
+                pre-packed — nothing was muxed or repacked)."""
+                st, body = await rest_get(
+                    "/api/v1/stoprecord?path=/live/a")
+                assert st == 200, f"stoprecord {st}"
+                import json as _json
+                wins = int(_json.loads(body)["EasyDarwin"]["Body"]
+                           ["DvrWindows"])
+                assert wins > 0, "stoprecord finalized zero windows"
+                c = RtspClient()
+                await c.connect("127.0.0.1", app.rtsp.port)
+                await c.play_start(f"{base}/live/a.dvr")
+                t_end_replay = time.time() + 4.0
+                while time.time() < t_end_replay:
+                    try:
+                        d = await c.recv_interleaved(0, timeout=0.5)
+                    except asyncio.TimeoutError:
+                        continue
+                    if len(d) >= 12:
+                        instant_vod_rx[0] += 1
+                await c.teardown(f"{base}/live/a.dvr")
+                await c.close()
+
         # --- HLS with the requant rung (REST calls must not block the
         # loop the server itself runs on)
         def _get(path):
@@ -835,6 +969,9 @@ async def soak(seconds: float, n_sources: int = 0,
         if vod:
             vod_tasks = [asyncio.ensure_future(vod_player(i))
                          for i in range(vod)]
+        if dvr:
+            dvr_tasks = [asyncio.ensure_future(dvr_player(i))
+                         for i in range(dvr)]
         last_seen_out_seq = None
         # chaos timeline: faults stay armed until clear_at, then the
         # remainder of the soak (>= ~45 s at the default duration) is
@@ -947,6 +1084,13 @@ async def soak(seconds: float, n_sources: int = 0,
                 st, body = await rest_get("/metrics")
                 assert st == 200
                 scrapes.append(parse_metrics(body.decode()))
+            if (dvr and not dvr_stopped[0]
+                    and time.time() - t0 >= seconds * 0.6):
+                # mid-soak stop → instant stream-to-VOD re-open; runs as
+                # a task so the replay drain never blocks the push loop
+                dvr_stopped[0] = True
+                dvr_tasks.append(
+                    asyncio.ensure_future(instant_vod_reopen()))
             if chaos and not cleared and time.time() - t0 >= clear_at:
                 from easydarwin_tpu.resilience import INJECTOR
                 INJECTOR.disarm()
@@ -980,6 +1124,11 @@ async def soak(seconds: float, n_sources: int = 0,
                 await vt
             except Exception as e:       # a died player is a failure,
                 failures.append(f"vod player crashed: {e!r}")  # not a hang
+        for dt in dvr_tasks:
+            try:
+                await dt
+            except Exception as e:
+                failures.append(f"dvr player crashed: {e!r}")
 
         # --- checks.  Feature-completeness checks (HLS muxing, requant
         # throughput, drained reliable windows) hold for the CLEAN soak;
@@ -1078,6 +1227,53 @@ async def soak(seconds: float, n_sources: int = 0,
                 failures.append(
                     f"vod device-prime failures: "
                     f"{app.vod_pacer.prime_failures}")
+        if dvr:
+            # ISSUE 12 acceptance shape: gapless seq per player across
+            # every pause/seek/catch-up (a replay re-covers sent seqs —
+            # duplicates and backward hops are fine, a FORWARD jump is
+            # lost playback), one ssrc, zero repacks process-wide,
+            # retention budget respected, and the join machinery must
+            # actually have run
+            from easydarwin_tpu.vod.cache import pack_window
+            if pack_window.calls != repack_base:
+                failures.append(
+                    f"{pack_window.calls - repack_base} window repacks "
+                    "ran during a --dvr soak (spilled opens must be "
+                    "zero-repack)")
+            for i in range(dvr):
+                gap = _seq_gap(dvr_seqs[i])
+                if gap:
+                    failures.append(
+                        f"dvr player {i}: {gap} packets lost across "
+                        "pause/seek/catch-up (forward seq jumps)")
+                if len(dvr_ssrcs[i]) > 1:
+                    failures.append(
+                        f"dvr player {i}: ssrc changed across the "
+                        f"time-shift ({len(dvr_ssrcs[i])} identities)")
+                # /live/b pushes ~33 pps; a shifted player re-receives
+                # its replays on top — under ~5 pkts/s means starved
+                if dvr_rx[i] < seconds * 5:
+                    failures.append(f"dvr player {i} starved: "
+                                    f"{dvr_rx[i]} pkts")
+            if app.dvr is not None:
+                for path, a in app.dvr._armed.items():
+                    for tid, sp in a.spillers.items():
+                        if sp.writer.live_bytes > sp.writer.retention_bytes:
+                            failures.append(
+                                f"dvr retention overrun on {path} "
+                                f"track {tid}: {sp.writer.live_bytes} "
+                                f"> {sp.writer.retention_bytes}")
+                        if sp.skipped:
+                            failures.append(
+                                f"dvr spiller fell behind the ring on "
+                                f"{path} track {tid}: {sp.skipped} "
+                                "windows lost to ring eviction")
+            if not dvr_stopped[0]:
+                failures.append("mid-soak stoprecord never fired "
+                                "(duration too short for --dvr)")
+            elif instant_vod_rx[0] == 0:
+                failures.append("instant stream-to-VOD re-open served "
+                                "zero packets")
         if tcp_rx[0] < f * floor:
             failures.append(f"tcp player starved: {tcp_rx[0]}/{f}")
         if udp_rx[0] < f * floor:
@@ -1130,7 +1326,7 @@ async def soak(seconds: float, n_sources: int = 0,
                                       chaos=chaos,
                                       forced_backend=egress_backend,
                                       hls_ladder=hls_ladder, vod=vod,
-                                      lossy=lossy))
+                                      lossy=lossy, dvr=dvr))
         mlast = scrapes[-1] if scrapes else {}
         stats = {
             "frames": f,
@@ -1199,6 +1395,22 @@ async def soak(seconds: float, n_sources: int = 0,
                 "rtx_sent_total": mlast.get("rtx_sent_total"),
                 "oracle_mismatch_total":
                     mlast.get("fec_parity_oracle_mismatch_total"),
+            }
+        if dvr:
+            stats["dvr"] = {
+                "players": dvr,
+                "rx": dvr_rx,
+                "windows_spilled":
+                    mlast.get("dvr_windows_spilled_total"),
+                "spill_bytes": mlast.get("dvr_spill_bytes"),
+                "catchup_joins":
+                    mlast.get("dvr_catchup_joins_total"),
+                "retention_evictions":
+                    mlast.get("dvr_retention_evictions_total"),
+                "instant_vod_rx": instant_vod_rx[0],
+                "repacks": pack_window.calls - repack_base,
+                "manager": (app.dvr.stats()
+                            if app.dvr is not None else None),
             }
         if vod:
             stats["vod"] = {
@@ -1649,6 +1861,19 @@ def _parse_args(argv: list[str]):
                          "packets, RTX budget exhaustion, any parity-"
                          "oracle mismatch, or a closed-loop overhead "
                          "that never tracked the loss")
+    ap.add_argument("--dvr", type=int, nargs="?", const=2, default=0,
+                    metavar="N",
+                    help="add N interleaved time-shift subscribers on "
+                         "the armed live push who continuously PAUSE "
+                         "and re-PLAY into the past (Range rewinds and "
+                         "bookmark resumes, Speed-4 catch-up), plus a "
+                         "mid-soak stoprecord whose finalized asset "
+                         "must re-open as instant VOD (ISSUE 12); "
+                         "fails on forward seq gaps across a catch-up "
+                         "join, any window repack on a spilled-asset "
+                         "open, a retention budget overrun, zero "
+                         "catch-up joins, or a starved player "
+                         "(default 2)")
     ap.add_argument("--chaos", type=int, nargs="?", const=7, default=None,
                     metavar="SEED",
                     help="run under a seeded FaultPlan (resilience/"
@@ -1708,4 +1933,4 @@ if __name__ == "__main__":
                                       _ns.chaos, _ns.devices,
                                       _ns.egress_backend,
                                       _ns.hls_ladder, _ns.vod,
-                                      _ns.lossy)))
+                                      _ns.lossy, _ns.dvr)))
