@@ -33,6 +33,14 @@ OWN_KEY_PREFIX = "Own:"
 #: virtual points per node: enough that a 2..16-node ring splits paths
 #: evenly, few enough that building the ring stays trivial
 DEFAULT_VNODES = 64
+#: capacity weighting never inflates one node past this many times the
+#: base vnode count — a wild (or spoofed-high) capacity score must not
+#: balloon the ring or starve every peer of keyspace
+MAX_WEIGHT_FACTOR = 8
+#: eligible redirect edges a flash crowd is spread across (hashed by
+#: client key so one heartbeat's stale load ranking cannot funnel a
+#: whole crowd onto a single edge)
+EDGE_SPREAD = 4
 
 
 def _h(s: str) -> int:
@@ -45,14 +53,43 @@ def own_key(path: str) -> str:
 
 class HashRing:
     """Classic consistent-hash ring; order-insensitive in its node set
-    (the ring is sorted by point, not by insertion)."""
+    (the ring is sorted by point, not by insertion).
 
-    def __init__(self, nodes, vnodes: int = DEFAULT_VNODES):
+    ``capacities`` (node → published capacity score) weights each node's
+    vnode count by its capacity share: ``round(vnodes * cap / mean)``,
+    clamped to [1, vnodes*MAX_WEIGHT_FACTOR].  The weighting is
+    deterministic and order-insensitive (mean over the node set), and
+    EQUAL capacities reproduce the unweighted ring byte-for-byte — a
+    cluster of same-hardware peers upgrades with zero placement churn
+    (pinned by tests/test_control_plane.py).  A node's points are always
+    the prefix ``_h(f"{n}#{i}")`` for ``i < count``, so a capacity
+    change only adds/removes THAT node's highest-index points — keyspace
+    movement stays proportional to the capacity-share delta."""
+
+    def __init__(self, nodes, vnodes: int = DEFAULT_VNODES,
+                 capacities: dict | None = None):
         self.nodes = sorted(set(nodes))
         self.vnodes = vnodes
+        self.capacities = dict(capacities or {})
+        counts = self.vnode_counts()
         self._points: list[tuple[int, str]] = sorted(
-            (_h(f"{n}#{i}"), n) for n in self.nodes for i in range(vnodes))
+            (_h(f"{n}#{i}"), n)
+            for n in self.nodes for i in range(counts[n]))
         self._keys = [p for p, _ in self._points]
+
+    def vnode_counts(self) -> dict[str, int]:
+        """Virtual-point count per node.  Unweighted (every node missing
+        a positive capacity) → exactly ``vnodes`` each."""
+        if not self.nodes:
+            return {}
+        caps = self.capacities
+        if not caps or any(not isinstance(caps.get(n), (int, float))
+                           or caps.get(n, 0) <= 0 for n in self.nodes):
+            return {n: self.vnodes for n in self.nodes}
+        mean = sum(float(caps[n]) for n in self.nodes) / len(self.nodes)
+        return {n: max(1, min(round(self.vnodes * float(caps[n]) / mean),
+                              self.vnodes * MAX_WEIGHT_FACTOR))
+                for n in self.nodes}
 
     def rank(self, path: str) -> list[str]:
         """Every node, in deterministic preference order for ``path``
@@ -91,7 +128,71 @@ class PlacementService:
         return await ClusterRegistry.live_nodes(self.redis)
 
     def ring(self, nodes) -> HashRing:
-        return HashRing(nodes, self.vnodes)
+        """The placement ring over ``nodes`` — capacity-weighted when
+        EVERY live node publishes a positive ``cap`` in its lease record
+        (a mixed-version cluster mid-upgrade stays unweighted: every
+        peer computes the same verdict from the same records either
+        way)."""
+        caps = None
+        if isinstance(nodes, dict):
+            got = {n: m.get("cap") for n, m in nodes.items()
+                   if isinstance(m, dict)}
+            if len(got) == len(nodes) and all(
+                    isinstance(c, (int, float)) and c > 0
+                    for c in got.values()):
+                caps = {n: float(c) for n, c in got.items()}
+        return HashRing(nodes, self.vnodes, capacities=caps)
+
+    def successors(self, path: str, nodes: dict) -> list[str]:
+        """The load-ranked successor list for ``path``: the ring's
+        deterministic owner first (stickiness is resolve()'s job), then
+        every other live node ordered by published utilization (ties
+        broken by ring preference order) — the failover / relay-edge
+        candidate ordering."""
+        order = self.ring(nodes).rank(path)
+        if len(order) <= 1:
+            return order
+
+        def util(n: str) -> float:
+            u = (nodes.get(n) or {}).get("util")
+            return float(u) if isinstance(u, (int, float)) else 0.0
+
+        return [order[0]] + sorted(
+            order[1:], key=lambda n: (util(n), order.index(n)))
+
+    def edge_for(self, path: str, nodes: dict, *, client_key: str = "",
+                 exclude=(), high_water: float | None = None
+                 ) -> str | None:
+        """The placement-resolved EDGE node a refused subscriber is
+        redirected to: live successors under the utilization high-water
+        mark, load-ranked, with the client key hashed across the top
+        ``EDGE_SPREAD`` so a crowd fans over several edges.  Pure
+        function of (path, client_key, nodes) — the admission 305's
+        Location equals this resolution by construction (pinned by
+        test)."""
+        excl = set(exclude)
+        # mixed-version rule, mirroring ring(): in a cluster where ANY
+        # node publishes utilization, a node that doesn't is NOT a
+        # redirect target — unknown load is not headroom, and shipping
+        # a flash crowd onto an unreporting (possibly saturated) peer
+        # is the melt the admission gate exists to prevent.  When NO
+        # node publishes (pre-upgrade cluster) the filter is moot and
+        # placement stays load-blind, same verdict on every peer.
+        any_util = any(isinstance((nodes.get(n) or {}).get("util"),
+                                  (int, float)) for n in nodes)
+        cands = []
+        for n in self.successors(path, nodes):
+            if n in excl:
+                continue
+            u = (nodes.get(n) or {}).get("util")
+            if high_water is not None and any_util:
+                if not isinstance(u, (int, float)) or u >= high_water:
+                    continue
+            cands.append(n)
+        if not cands:
+            return None
+        spread = cands[:EDGE_SPREAD]
+        return spread[_h(f"{path.strip('/')}#{client_key}") % len(spread)]
 
     async def resolve(self, path: str,
                       nodes: dict[str, dict] | None = None
@@ -115,8 +216,12 @@ class PlacementService:
         self._note(path, owner)
         return owner, nodes[owner]
 
-    async def claimant(self, path: str) -> str | None:
-        """The node recorded in ``Own:{path}`` (live or not)."""
+    async def claim_record(self, path: str) -> tuple[int, dict] | None:
+        """The parsed ``Own:{path}`` record with its fencing token, or
+        None when absent/corrupt.  The record's ``handoff_to`` key
+        marks a planned rebalance hand-off (cluster/service.py): the
+        recorded node is still the SERVING source; the named target
+        flips the claimant only when its checkpoint adoption claims."""
         cur = await self.redis.fget(own_key(path))
         if cur is None:
             return None
@@ -124,11 +229,17 @@ class PlacementService:
             rec = json.loads(cur[1])
         except ValueError:
             return None
+        if not isinstance(rec, dict) or not rec.get("node"):
+            return None
+        return int(cur[0]), rec
+
+    async def claimant(self, path: str) -> str | None:
+        """The node recorded in ``Own:{path}`` (live or not)."""
         # non-dict JSON / missing node (a corrupt or operator-written
         # record) must read as "unclaimed", not crash the caller's tick
         # or fabricate a truthy "None" phantom node id
-        node = rec.get("node") if isinstance(rec, dict) else None
-        return str(node) if node else None
+        rec = await self.claim_record(path)
+        return str(rec[1]["node"]) if rec is not None else None
 
     def _note(self, path: str, owner: str) -> None:
         prev = self._observed.get(path)

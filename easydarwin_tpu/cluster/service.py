@@ -43,7 +43,7 @@ import time
 
 from .. import obs
 from ..resilience.checkpoint import CKPT_VERSION, snapshot_session
-from .placement import OWN_KEY_PREFIX, PlacementService
+from .placement import OWN_KEY_PREFIX, PlacementService, own_key
 from .presence import FENCE_COUNTER_KEY, LeaseManager, PresenceService
 from .pull import PullConfig, RemotePull
 from .redis_client import FENCE_SET_LUA, RedisTimeout
@@ -64,7 +64,14 @@ class ClusterConfig:
                  lease_ttl_sec: float = 5.0, heartbeat_sec: float = 1.0,
                  vnodes: int = 64, own_ttl_sec: float = 30.0,
                  migration_ttl_sec: float = 30.0,
-                 pull: PullConfig | None = None):
+                 pull: PullConfig | None = None,
+                 rebalance_enabled: bool = True,
+                 rebalance_high_water: float = 0.9,
+                 rebalance_low_water: float = 0.5,
+                 rebalance_burn_sec: float = 10.0,
+                 rebalance_cooldown_sec: float = 30.0,
+                 admission_enabled: bool = True,
+                 admission_high_water: float = 0.85):
         self.node_id = node_id
         self.ip = ip
         self.rtsp_port = rtsp_port
@@ -75,6 +82,122 @@ class ClusterConfig:
         self.own_ttl_sec = own_ttl_sec
         self.migration_ttl_sec = migration_ttl_sec
         self.pull = pull or PullConfig()
+        # load-aware control plane (ISSUE 13)
+        self.rebalance_enabled = rebalance_enabled
+        self.rebalance_high_water = rebalance_high_water
+        self.rebalance_low_water = rebalance_low_water
+        self.rebalance_burn_sec = rebalance_burn_sec
+        self.rebalance_cooldown_sec = rebalance_cooldown_sec
+        self.admission_enabled = admission_enabled
+        self.admission_high_water = admission_high_water
+
+
+class Rebalancer:
+    """Proactive SLO-drain rebalancing: drain a sustained-burning node's
+    hottest stream to the least-loaded live successor — the PR 6 crash
+    migration reused as a PLANNED move (fresh checkpoint publish +
+    fenced hand-off record; same ssrc, gapless seq at the player).
+
+    Hysteresis, PR 5 ladder-style — the rebalancer must never flap:
+
+    * **sustained burn** — the node must read past the high-water mark
+      (utilization ≥ ``rebalance_high_water`` OR the SLO watchdog's
+      multi-window burn latched) CONTINUOUSLY for ``rebalance_burn_sec``
+      before any move; one clean sample resets the window.  A reported
+      SLO burn only counts while utilization is at least the low-water
+      mark — an under-utilized node is a drain target by definition,
+      and its burn signal is not load it can shed;
+    * **headroom gate** — a move happens only toward a live peer under
+      ``rebalance_low_water`` (draining onto an equally-hot peer just
+      moves the fire);
+    * **cooldown** — at most one move per ``rebalance_cooldown_sec``,
+      so the post-move rate decay gets to land before re-evaluation.
+    """
+
+    def __init__(self, service: "ClusterService", *,
+                 clock=time.monotonic):
+        self.service = service
+        self._clock = clock
+        self._burn_since: float | None = None
+        self._last_move = float("-inf")
+        #: drains INITIATED (hand-off records published); the completed
+        #: count is the cluster_rebalance_moves_total metric
+        self.moves = 0
+
+    def _hottest_claim(self) -> str | None:
+        """The hottest stream this node owns: most subscriber outputs
+        (the load a drain actually sheds), ties by path for
+        determinism; None when nothing owned has an audience."""
+        svc = self.service
+        best: tuple[int, str] | None = None
+        for path in svc._claims:
+            sess = svc.registry.find(path)
+            if sess is None:
+                continue
+            n = sess.num_outputs
+            if n > 0 and (best is None or (n, path) > best):
+                best = (n, path)
+        return best[1] if best else None
+
+    async def tick(self, nodes: dict, load: dict | None) -> bool:
+        """One evaluation; True when a drain was INITIATED (the
+        hand-off record published; ``self.moves`` counts these).
+        Completion is booked by ``_check_draining`` when the target's
+        adoption flips the claimant — that is where the
+        ``cluster_rebalance_moves_total`` metric increments."""
+        cfg = self.service.config
+        if load is None:
+            # no sample: the burn window is no longer CONTINUOUS
+            # evidence — restart it rather than let a sampling outage
+            # bridge two non-adjacent burning samples into a move
+            self._burn_since = None
+            return False
+        now = self._clock()
+        util = load.get("util")
+        util = float(util) if isinstance(util, (int, float)) else 0.0
+        # a drain SOURCE must carry real load: under the low-water mark
+        # a node is by definition a drain TARGET, and whatever SLO burn
+        # it reports is not load-caused (a box-wide latency artifact, a
+        # cold-start burst) — moving a stream off it sheds nothing and
+        # just walks the stream around the cluster
+        burning = util >= cfg.rebalance_low_water and (
+            bool(load.get("burn")) or util >= cfg.rebalance_high_water)
+        if not burning:
+            self._burn_since = None
+            return False
+        if self._burn_since is None:
+            self._burn_since = now
+            return False
+        if now - self._burn_since < cfg.rebalance_burn_sec:
+            return False
+        if now - self._last_move < cfg.rebalance_cooldown_sec:
+            return False
+        # headroom gate: the least-loaded LIVE peer under the low-water
+        # mark; equal utilizations tie-break toward the HIGHEST
+        # published capacity (never hand the hot stream to the weakest
+        # idle node just because its name sorts first), then by name
+        # for determinism
+        cands = []
+        for n, meta in nodes.items():
+            if n == cfg.node_id or not isinstance(meta, dict):
+                continue
+            u = meta.get("util")
+            if isinstance(u, (int, float)) and u < cfg.rebalance_low_water:
+                cap = meta.get("cap")
+                cap = float(cap) if isinstance(cap, (int, float)) else 0.0
+                cands.append((float(u), -cap, n))
+        if not cands:
+            return False
+        target = min(cands)[2]
+        path = self._hottest_claim()
+        if path is None:
+            return False
+        if not await self.service._handoff(path, target):
+            return False
+        self._last_move = now
+        self._burn_since = None
+        self.moves += 1
+        return True
 
 
 class ClusterService:
@@ -134,6 +257,21 @@ class ClusterService:
         #: synchronously by the app's DVR peer-fill fetcher (the segment
         #: cache calls it inline), refreshed once per cluster tick.
         self.dvr_peers: dict[str, tuple[str, int, dict]] = {}
+        #: app hook (ISSUE 13): ``() -> {cap, util, burn, subs}`` — the
+        #: LoadTracker sample folded into the lease record each
+        #: heartbeat; None = no capacity/utilization published (the ring
+        #: stays unweighted, rebalance/admission stay idle)
+        self.load_status = None
+        #: the latest sampled load record + live-node snapshot, read
+        #: SYNCHRONOUSLY by the admission gate between ticks
+        self.last_load: dict | None = None
+        self.last_nodes: dict[str, dict] = {}
+        #: in-flight planned hand-offs: path -> (target, deadline) —
+        #: the source keeps serving until the target's adoption clears
+        #: the record's handoff marker (see _check_draining)
+        self._draining: dict[str, tuple[str, float]] = {}
+        self.rebalancer = Rebalancer(self) \
+            if config.rebalance_enabled else None
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -216,11 +354,29 @@ class ClusterService:
         if INJECTOR.active and INJECTOR.redis_partition():
             raise RedisTimeout("injected redis partition")
         self.ticks += 1
+        # capacity + utilization publishing (ISSUE 13): the load sample
+        # rides the fenced lease record so every peer's ring weighting,
+        # successor ranking and redirect targeting read the same truth
+        load = None
+        if self.load_status is not None:
+            try:
+                load = self.load_status()
+            except Exception as e:
+                self._warn(f"load sample: {e!r}")
+        if load:
+            self.lease.meta.update(
+                {k: load[k] for k in ("cap", "util", "burn", "subs")
+                 if k in load})
+        self.last_load = load
         await self.lease.heartbeat()
         nodes = await self.placement.live_nodes()
+        self.last_nodes = nodes
         await self._claim_local_sources(nodes)
         await self._retry_adoptions()
         await self._migration_scan(nodes)
+        await self._check_draining()
+        if self.rebalancer is not None:
+            await self.rebalancer.tick(nodes, load)
         await self._sweep_pulls()
         # reference-shaped presence for the CMS tier.  Only locally-
         # SOURCED paths are advertised: a pull replica writing (and on
@@ -257,7 +413,9 @@ class ClusterService:
         # fresh claims (rare: a source just attached) stay individual —
         # they need a claimant read + a minted token first
         for path in local:
-            if path in self._claims:
+            if path in self._claims or path in self._draining:
+                # a draining path is still a local source by design —
+                # re-claiming it here would cancel our own hand-off
                 continue
             claimant = await self.placement.claimant(path)
             if claimant and claimant != cfg.node_id and claimant in nodes:
@@ -334,6 +492,97 @@ class ClusterService:
         await self.redis.execute(*cmd)
         return True
 
+    # -- planned rebalance hand-off -----------------------------------------
+    #: seconds a hand-off may sit unadopted before the source reclaims
+    #: the stream (the drain must never strand it)
+    HANDOFF_TIMEOUT_SEC = 10.0
+
+    async def _handoff(self, path: str, target: str) -> bool:
+        """Drain one owned stream to ``target``: publish a FRESH
+        checkpoint and mark the fenced ``Own:`` record with
+        ``handoff_to`` — the record still names US as the claimant, so
+        ``resolve()`` and the pusher keep pointing at the serving
+        source.  The claimant flips to the target only when its
+        adoption CLAIMS after restoring the checkpoint — the same
+        restore-then-claim ordering the crash path has, which is what
+        makes the move gapless: a pusher that re-resolves mid-drain can
+        never land on a target that has not restored the subscribers
+        yet (packets pushed into such a fresh session would die when a
+        later restore reset the ring to the checkpoint id space).
+        ``_check_draining`` watches for the flip (then releases the
+        local data plane — the pusher re-announces onto the restored
+        session with its resend tail) or reclaims on timeout."""
+        tok = self._claims.get(path)
+        if tok is None:
+            return False
+        if not await self._publish_ckpt(path, tok):
+            return False                   # nothing restorable: no move
+        new_tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
+        rec = {"node": self.config.node_id, "handoff_to": target}
+        dvr = self._dvr_adverts().get(path)
+        if dvr:
+            # keep the spilled-window advertisement through the drain:
+            # peers rebuild dvr_peers from this record every tick, and a
+            # time-shifting viewer elsewhere must not lose peer-fill for
+            # the whole hand-off window
+            rec["dvr"] = dvr
+        ok = await self.redis.execute(
+            "EVAL", FENCE_SET_LUA, 1, own_key(path), new_tok,
+            json.dumps(rec, separators=(",", ":")),
+            int(self.config.own_ttl_sec))
+        if not ok:
+            # a newer token already holds the record: we were the
+            # zombie all along — the refresh path will fence us out
+            return False
+        self._claims.pop(path, None)
+        self._draining[path] = (target, time.monotonic()
+                                + self.HANDOFF_TIMEOUT_SEC)
+        util = (self.last_load or {}).get("util")
+        self._events.emit("cluster.rebalance", level="warn", stream=path,
+                          node=self.config.node_id, target=target,
+                          util=util)
+        return True
+
+    async def _check_draining(self) -> None:
+        """Advance in-flight hand-offs: release the local data plane
+        once the target's adoption flipped the claimant (restore landed
+        there first by construction), or reclaim the stream when the
+        target never adopted within the timeout — a drain must never
+        strand a stream."""
+        for path, (target, deadline) in list(self._draining.items()):
+            rec = await self.placement.claim_record(path)
+            if rec is not None and str(rec[1]["node"]) == target:
+                # adopted: the target restored + claimed.  NOW kick the
+                # local source — the pusher re-resolves the claimant
+                # (the restored target) and re-ANNOUNCEs there with its
+                # resend tail: the post-crash recovery flow, gapless.
+                # The moves counter lands HERE, not at initiation — a
+                # hand-off the target never adopted is a reclaim, not a
+                # completed drain
+                del self._draining[path]
+                obs.CLUSTER_REBALANCE_MOVES.inc()
+                self.placement.forget(path)
+                self._fence_lost(path)
+                continue
+            pending = (rec is not None
+                       and str(rec[1]["node"]) == self.config.node_id
+                       and rec[1].get("handoff_to") == target)
+            if pending and time.monotonic() < deadline:
+                continue
+            # timed out / record gone / a third party took it: reclaim
+            # if we still can, otherwise hand the data plane over too.
+            # (A target adopting CONCURRENTLY with this reclaim mints a
+            # newer token and wins the record back; our refresh batch
+            # then hits the fence rejection within a heartbeat and
+            # releases — bounded dual service, never a stranded stream.)
+            del self._draining[path]
+            tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
+            if await self.placement.claim(path, tok,
+                                          ttl=int(self.config.own_ttl_sec)):
+                self._claims[path] = tok
+            else:
+                self._fence_lost(path)
+
     # -- migration ---------------------------------------------------------
     async def _migration_scan(self, nodes: dict) -> None:
         """Adopt any stream whose recorded owner's lease is gone and
@@ -363,16 +612,60 @@ class ClusterService:
                 host, port = meta.get("ip"), meta.get("http")
                 if host and port:
                     dvr_peers[path] = (str(host), int(port), dvr)
-            if holder == cfg.node_id or holder in nodes:
-                continue                      # live owner (or us)
+            if holder == cfg.node_id:
+                continue                      # ours (serving or draining)
+            if holder in nodes:
+                # a LIVE holder draining this path to US (planned
+                # rebalance): adopt through the published checkpoint
+                # exactly like a crash migration.  The claim inside
+                # _adopt flips the claimant only AFTER restore, so a
+                # pusher re-resolving mid-drain always lands on a node
+                # that already holds the subscribers
+                if (rec.get("handoff_to") == cfg.node_id
+                        and path not in self._claims
+                        and path not in self._adopt_retry):
+                    await self._adopt(path, holder, planned=True)
+                continue
             if ring.owner(path) != cfg.node_id:
                 continue                      # a different successor
             await self._adopt(path, holder)
         self.dvr_peers = dvr_peers
 
-    async def _adopt(self, path: str, from_node: str) -> None:
+    async def _adopt(self, path: str, from_node: str, *,
+                     planned: bool = False) -> None:
         cfg = self.config
         raw_ckpt = await self.redis.fget(ckpt_key(path))
+        if planned:
+            # Planned drain: restore BEFORE claiming.  The gapless
+            # contract is that the claimant never names a node without
+            # the subscribers behind it — the source releases its data
+            # plane the moment it sees the flip.  No adoption race
+            # exists here (only the handoff_to target runs this branch),
+            # so the crash path's claim-first ordering isn't needed: a
+            # failed restore simply leaves the handoff record untouched
+            # for the next scan, and the source reclaims on timeout.
+            rp = self.pulls.pop(path, None)
+            if rp is not None:
+                await rp.stop()
+            n_out = self._try_restore(path, raw_ckpt)
+            if self.registry.find(path) is None:
+                return
+            tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
+            if not await self.placement.claim(path, tok,
+                                              ttl=int(cfg.own_ttl_sec)):
+                # a claim minted AFTER ours (rare: another writer's
+                # INCR interleaved) holds the record: stand down
+                self._fence_lost(path)
+                return
+            # NOTE: a source that timeout-reclaimed a beat earlier holds
+            # an OLDER token, so this freshly minted claim overrides it
+            # — the race is not prevented here, it is CONVERGED: the
+            # loser's next heartbeat refresh hits the fence rejection
+            # and releases (≤ one heartbeat of duplicate-seq dual
+            # service, the same bounded window every crash-path claim
+            # race has).  Single ownership within a tick either way.
+            await self._finish_adoption(path, tok, n_out, from_node)
+            return
         tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
         if not await self.placement.claim(path, tok,
                                           ttl=int(cfg.own_ttl_sec)):
@@ -477,6 +770,10 @@ class ClusterService:
         rp = self.pulls.get(path)
         if rp is None:
             import zlib
+            # this node just became an origin→edge relay-tree edge for
+            # ``path``: ONE pull upstream, local fan-out below it — the
+            # origin sees E pulls instead of E×S subscribers
+            obs.RELAY_TREE_EDGES.inc()
             rp = RemotePull(
                 path, lambda: self._owner_url(path), self.pull_manager,
                 self.config.pull,
@@ -545,4 +842,9 @@ class ClusterService:
                       for p, rp in self.pulls.items()},
             "migrations": self.migrations,
             "ticks": self.ticks,
+            "load": self.last_load,
+            # initiations, deliberately NOT named like the metric:
+            # cluster_rebalance_moves_total counts COMPLETED drains
+            "rebalance_initiated": (self.rebalancer.moves
+                                    if self.rebalancer is not None else 0),
         }

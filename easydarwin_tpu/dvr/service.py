@@ -96,6 +96,11 @@ class DvrManager:
         self._armed: dict[str, _Armed] = {}
         #: cluster peer-fill hook: (path, track_id, win) -> blob | None
         self.fetcher = None
+        #: fully-remote asset bootstrap hook (ISSUE 13 satellite):
+        #: ``async (path) -> bool`` — fetch + materialize a peer's
+        #: meta/index documents when a .dvr DESCRIBE finds no local
+        #: asset at all (closes the PR 12 open item)
+        self.meta_sync = None
         self.finalized_count = 0
 
     # ------------------------------------------------------------ geometry
@@ -260,10 +265,21 @@ class DvrManager:
     async def describe(self, path: str) -> str | None:
         """SDP for a ``<path>.dvr`` request (the describe-chain hook —
         the stored push SDP serves verbatim; track controls/ids match
-        the spilled track numbering by construction)."""
+        the spilled track numbering by construction).  A path with no
+        local asset at all tries the cluster meta-sync hook once: a
+        finalized recording another node holds is bootstrapped (index
+        documents + empty spill file) and then replays through the
+        normal chain with every window peer-filled."""
         if not self.is_dvr_path(path):
             return None
         asset = self.open_asset(path)
+        if asset is None and self.meta_sync is not None:
+            try:
+                if await self.meta_sync(self.live_path_of(path)):
+                    asset = self.open_asset(path)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.warning(f"dvr meta sync {path}: {e!r}")
         if asset is None or not asset.sdp:
             return None
         try:
@@ -319,6 +335,121 @@ class DvrManager:
             return sp.window_blob(int(win)) if sp is not None else None
         finally:
             asset.close()
+
+    def meta_doc(self, path: str) -> dict | None:
+        """The asset's meta + per-track index documents — what REST
+        ``/api/v1/dvrmeta`` serves so a peer with NO local copy can
+        bootstrap a fully-remote replay (window blobs then flow through
+        ``/api/v1/dvrwindow``).  Armed assets serve their live writer
+        docs; finalized ones their on-disk files."""
+        key = self.live_path_of(path)
+        a = self._armed.get(key)
+        if a is not None:
+            return {"path": key,
+                    "meta": {"path": key, "sdp": a.sdp,
+                             "complete": False, "gen": a.gen},
+                    "tracks": {str(tid): sp.writer._doc()
+                               for tid, sp in a.spillers.items()}}
+        dir_path = self._dir_for(key)
+        if dir_path is None or not os.path.isdir(dir_path):
+            return None
+        try:
+            with open(os.path.join(dir_path, "meta.json"),
+                      encoding="utf-8") as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        tracks: dict[str, dict] = {}
+        for name in sorted(os.listdir(dir_path)):
+            if not name.startswith("track"):
+                continue
+            try:
+                with open(os.path.join(dir_path, name, "index.json"),
+                          encoding="utf-8") as fh:
+                    tracks[name[5:]] = json.load(fh)
+            except (OSError, ValueError):
+                continue
+        if not tracks:
+            return None
+        return {"path": key, "meta": meta, "tracks": tracks}
+
+    def materialize(self, path: str, doc: dict) -> bool:
+        """Write a peer's meta/index documents as a local asset skeleton:
+        real index records (seek/duration/keyframe metadata work off
+        them alone) over an EMPTY spill file, so every window read
+        misses locally and degrades to the peer fetcher.  Refuses to
+        touch a path that already has a local asset — bootstrap fills a
+        void, it never clobbers a recording."""
+        key = self.live_path_of(path)
+        if key in self._armed:
+            return False
+        dir_path = self._dir_for(key)
+        if dir_path is None:
+            return False
+        meta = doc.get("meta")
+        tracks = doc.get("tracks")
+        if not isinstance(meta, dict) or not isinstance(tracks, dict) \
+                or not tracks:
+            return False
+        if not meta.get("complete"):
+            # a still-recording peer asset would freeze here as a
+            # truncated snapshot nothing ever refreshes (the local index
+            # never grows and the track-dir guard blocks re-sync);
+            # armed streams are peer-filled live through the fenced
+            # Own: advertisement instead — bootstrap only what is final
+            return False
+        if os.path.isdir(dir_path) and any(
+                n.startswith("track") for n in os.listdir(dir_path)):
+            if os.path.isfile(os.path.join(dir_path, "meta.json")):
+                return False      # real local asset: never clobber
+            # torn skeleton (crash between track writes and the
+            # meta.json commit — materialize and arm both write meta
+            # LAST): scrub and rebuild, or the guard above would lock
+            # this asset out of bootstrap forever
+            import shutil
+            for n in os.listdir(dir_path):
+                if n.startswith("track"):
+                    shutil.rmtree(os.path.join(dir_path, n),
+                                  ignore_errors=True)
+        wrote = 0
+        try:
+            for tid, idx in tracks.items():
+                if not isinstance(idx, dict) or not str(tid).isdigit():
+                    continue
+                tdir = os.path.join(dir_path, f"track{int(tid)}")
+                os.makedirs(tdir, exist_ok=True)
+                with open(os.path.join(tdir, "spill.bin"), "wb"):
+                    pass                 # empty: all reads -> fetcher
+                tmp = os.path.join(tdir, "index.json.tmp")
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(idx, fh, separators=(",", ":"))
+                os.replace(tmp, os.path.join(tdir, "index.json"))
+                wrote += 1
+            if not wrote:
+                return False
+            try:
+                gen = int(meta.get("gen", 0))
+            except (TypeError, ValueError):
+                gen = 0
+            self._write_meta(dir_path, key, str(meta.get("sdp", "")),
+                             complete=bool(meta.get("complete")), gen=gen)
+        except OSError:
+            # failure-atomicity: scrub the partial skeleton (track dirs
+            # without meta.json), or the track-dir refuse guard above
+            # would permanently lock this asset out of bootstrap
+            import shutil
+            for tid in tracks:
+                if str(tid).isdigit():
+                    shutil.rmtree(
+                        os.path.join(dir_path, f"track{int(tid)}"),
+                        ignore_errors=True)
+            try:
+                os.unlink(os.path.join(dir_path, "meta.json"))
+            except OSError:
+                pass
+            return False
+        EVENTS.emit("dvr.bootstrap", stream=key, path=key, tracks=wrote)
+        return True
 
     def advertise(self) -> dict:
         """Spilled-window spans per ARMED path — folded into this

@@ -87,6 +87,11 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "cluster.breaker_close": ("url",),
     "cluster.migrate": ("from_node", "outputs"),
     "cluster.drain": ("node", "streams"),
+    # load-aware control plane (ISSUE 13): a rebalance is the planned
+    # drain of one hot stream to a named target; a refuse is one new
+    # SETUP answered 453/305 at the admission gate
+    "cluster.rebalance": ("target",),
+    "cluster.refuse": ("action",),
     # egress backend probe ladder (server/app.py + relay/fanout.py,
     # ISSUE 8): ONE latched event per rung drop — backend = the rung
     # fallen from, fallback = the rung landed on, reason = the probe /
@@ -116,6 +121,9 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # output's FIRST exhaustion, never per NACKed seq
     "fec.host_fallback": ("mismatches",),
     "rtx.giveup": ("giveups",),
+    # a fully-remote asset bootstrapped from a peer's meta/index docs
+    # (ISSUE 13 satellite — the /api/v1/dvrmeta sync)
+    "dvr.bootstrap": ("tracks",),
     # DVR / time-shift subsystem (dvr/, ISSUE 12): arm/finalize are per
     # asset lifecycle; catchup is latched once per joining track; a
     # retention-evicted window under an active cursor is NOT an event

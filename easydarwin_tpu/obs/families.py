@@ -516,3 +516,43 @@ CLUSTER_MIGRATIONS = REGISTRY.counter(
     "owner's lease expired (or drained), restored its Redis-published "
     "checkpoint (same ssrc, gapless rewritten seq) and re-pointed the "
     "subscribers without re-SETUP")
+
+# ------------------------------------------------------ load-aware control
+# The load-aware control plane (ISSUE 13): boot-time capacity scoring +
+# live utilization published into the fenced lease records, capacity-
+# weighted ring placement, the proactive SLO-drain rebalancer, overload
+# admission (453/305) and origin->edge relay trees.
+# tools/metrics_lint.py enforces this family set (lint_control_plane:
+# exact labels, the admission action vocabulary closed to
+# refuse|redirect) and tools/soak.py --skewed keys on it.
+CLUSTER_CAPACITY_SCORE = REGISTRY.gauge(
+    "cluster_capacity_score",
+    "This node's published capacity score in relayed packets/second "
+    "(boot-time self-bench or the operator-pinned "
+    "cluster_capacity_score pref, quantized to a power of two so same-"
+    "hardware peers weigh the ring equally); the value riding the "
+    "fenced Node: lease record that peers weight placement with")
+CLUSTER_UTILIZATION_RATIO = REGISTRY.gauge(
+    "cluster_utilization_ratio",
+    "This node's live utilization (EWMA delivered-packet rate divided "
+    "by its effective capacity score, 0 = idle, >= 1 = past rated "
+    "capacity); published each heartbeat and read by the admission "
+    "gate and the rebalancer")
+CLUSTER_REBALANCE_MOVES = REGISTRY.counter(
+    "cluster_rebalance_moves_total",
+    "Proactive stream drains completed by the rebalancer: a sustained "
+    "SLO-burning/over-utilized node published a fresh checkpoint and "
+    "handed its hottest stream to the least-loaded live successor "
+    "(the PR 6 crash-migration path reused as a planned move)")
+CLUSTER_ADMISSION_REFUSED = REGISTRY.counter(
+    "cluster_admission_refused_total",
+    "New play SETUPs not admitted because this node was past its "
+    "utilization high-water mark, by action (redirect = RTSP 305 to "
+    "the placement-resolved edge, refuse = RTSP 453 Not Enough "
+    "Bandwidth when no eligible edge exists)", labels=("action",))
+RELAY_TREE_EDGES = REGISTRY.counter(
+    "relay_tree_edges_total",
+    "Origin->edge relay-tree edges established: cross-server pulls "
+    "started by this node to serve local subscribers of a stream "
+    "another node owns (E edges cost the origin E pulls instead of "
+    "E x S subscribers)")

@@ -59,10 +59,18 @@ from .. import obs
 #: sender's counters), and ``rr_loss_spoof`` replaces the
 #: ``fraction_lost`` of every inbound receiver report so the closed-
 #: loop FEC controller can be driven without a lossy wire.
+#: The control-plane sites (ISSUE 13): ``capacity_spoof`` replaces the
+#: capacity score a node believes in and publishes (lie low → the node
+#: over-reports utilization, burns, and the rebalancer/admission paths
+#: fire; lie high → it hoards keyspace on the weighted ring), and
+#: ``overload_spoof`` forces an admission check to read past the
+#: high-water mark (seeded probability stream) so the 453/redirect
+#: paths are chaos-testable without real load.
 SITES = ("ingest_drop", "ingest_reorder", "ingest_corrupt",
          "egress_native", "device_dispatch", "stale_params",
          "slow_subscriber", "lease_loss", "redis_partition",
-         "pull_stall", "egress_drop", "rr_loss_spoof")
+         "pull_stall", "egress_drop", "rr_loss_spoof",
+         "capacity_spoof", "overload_spoof")
 
 #: minimum seconds between ``fault.injected`` events per site
 EMIT_INTERVAL_S = 1.0
@@ -108,6 +116,13 @@ class FaultPlan:
     # fraction_lost (0..1) stamped onto every inbound RR while armed ---
     egress_drop: float = 0.0
     rr_loss_spoof: float = 0.0
+    # -- control plane (ISSUE 13): the capacity score this node believes
+    # in and publishes is REPLACED by this value when > 0 (deterministic
+    # — the skewed soak forces a heterogeneous cluster with it); the
+    # probability an admission check reads "past the high-water mark"
+    # regardless of real utilization -------------------------------------
+    capacity_spoof: float = 0.0
+    overload_spoof: float = 0.0
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -350,6 +365,26 @@ class FaultInjector:
             return None
         self._note("rr_loss_spoof")
         return min(p.rr_loss_spoof, 1.0)
+
+    def capacity_spoof(self) -> float | None:
+        """The lying capacity score (pps) to believe in and publish, or
+        None when the site is disarmed.  Counted once per application
+        (one per load sample — the heartbeat cadence)."""
+        p = self.plan
+        if p is None or p.capacity_spoof <= 0.0:
+            return None
+        self._note("capacity_spoof")
+        return float(p.capacity_spoof)
+
+    def overload_spoof(self) -> bool:
+        """True when this admission check should read the node as past
+        its high-water mark (seeded per-site probability stream — one
+        seed = one refusal schedule)."""
+        p = self.plan
+        if p is None or not self._fire("overload_spoof", p.overload_spoof):
+            return False
+        self._note("overload_spoof")
+        return True
 
     # -- cluster sites ----------------------------------------------------
     def lease_loss(self) -> bool:

@@ -169,6 +169,22 @@ class StreamingServer:
         #: fault-tolerant cluster tier (cluster/service.py) — built in
         #: start() once the listener ports are known
         self.cluster = None
+        #: load-aware control plane (ISSUE 13): capacity score + live
+        #: utilization tracker, built in start() under cluster mode
+        #: (the boot self-bench only runs when a cluster will read it)
+        self.load_tracker = None
+        #: remote DVR assets bootstrapped via /api/v1/dvrmeta:
+        #: path -> (host, http_port, {track: [win_lo, win_hi]}) —
+        #: consulted by _dvr_peer_fetch when the armed-asset Own:
+        #: advertisement (cluster.dvr_peers) has no entry (a finalized
+        #: asset's advert died with its live claim)
+        self._dvr_meta_peers: dict = {}
+        #: paths whose all-peer meta sweep found nothing: path ->
+        #: monotonic retry-after.  Without this a repeat DESCRIBE of the
+        #: same bogus .dvr path re-runs the full (N-1)-peer HTTP sweep
+        #: every time — the path-scan amplification the live describe()
+        #: gate exists to prevent
+        self._dvr_meta_misses: dict = {}
         self._user_describe_fallback = describe_fallback
         self._redis_client = redis_client
         self.config.on_change(self._on_config_change)
@@ -343,6 +359,25 @@ class StreamingServer:
                 # peer-fill through its spill files, not origin
                 self.cluster.dvr_advertise = self.dvr.advertise
                 self.dvr.fetcher = self._dvr_peer_fetch
+                # fully-remote asset bootstrap (ISSUE 13 satellite):
+                # a .dvr DESCRIBE on a node that never saw the stream
+                # syncs the recording node's meta/index documents first
+                self.dvr.meta_sync = self._dvr_meta_sync
+            # load-aware control plane (ISSUE 13): capacity published
+            # into the lease each heartbeat, admission gate on new
+            # SETUPs.  The self-bench is cached per boot; an operator-
+            # pinned cluster_capacity_score skips it entirely.
+            from ..cluster.capacity import LoadTracker, self_bench
+            cap = self.config.cluster_capacity_score or self_bench()
+            self.load_tracker = LoadTracker(
+                cap,
+                slo=self.slo if self.config.slo_enabled else None,
+                subscribers=lambda: sum(
+                    s.num_outputs
+                    for s in self.registry.sessions.values()))
+            self.cluster.load_status = self.load_tracker.sample
+            if ccfg.admission_enabled:
+                self.rtsp.admission = self._admission_verdict
             await self.cluster.start()
             self.rtsp.describe_fallback = self._cluster_describe
         elif self.config.cloud_enabled:
@@ -385,6 +420,8 @@ class StreamingServer:
             except Exception:
                 pass
             self.cluster = None
+            self.rtsp.admission = None
+            self.load_tracker = None
         if self.presence is not None:
             await self.presence.stop()
             self.presence = None
@@ -561,9 +598,54 @@ class StreamingServer:
             text = await self._user_describe_fallback(path)
         return text
 
+    def _admission_verdict(self, path: str, client_key: str
+                           ) -> tuple[str, str | None] | None:
+        """Overload admission (ISSUE 13): None = admit; otherwise
+        ``("redirect", url)`` — a placement-resolved edge exists, send
+        RTSP 305 — or ``("refuse", None)`` — RTSP 453.  Synchronous by
+        design: it reads the LAST heartbeat's load sample and node
+        snapshot (a SETUP must never wait on Redis); the
+        ``overload_spoof`` fault site forces the verdict for chaos
+        runs.  Shedding before burning: every refusal is counted and
+        evented."""
+        lt = self.load_tracker
+        if lt is None:
+            return None
+        from .. import obs
+        from ..resilience import INJECTOR
+        hw = self.config.cluster_admission_high_water
+        over = lt.last_util >= hw
+        if not over and INJECTOR.active:
+            over = INJECTOR.overload_spoof()
+        if not over:
+            return None
+        target = None
+        url = None
+        cl = self.cluster
+        if cl is not None and cl.last_nodes:
+            target = cl.placement.edge_for(
+                path, cl.last_nodes, client_key=client_key,
+                exclude=(cl.config.node_id,), high_water=hw)
+            if target is not None:
+                meta = cl.last_nodes.get(target) or {}
+                ip, port = meta.get("ip"), meta.get("rtsp")
+                if ip and port:
+                    p = path if path.startswith("/") else "/" + path
+                    url = f"rtsp://{ip}:{int(port)}{p}"
+        action = "redirect" if url else "refuse"
+        obs.CLUSTER_ADMISSION_REFUSED.inc(action=action)
+        from ..obs import EVENTS
+        EVENTS.emit("cluster.refuse", level="warn", stream=path,
+                    action=action, util=round(lt.last_util, 3),
+                    target=target)
+        return (action, url)
+
     #: in-flight DVR peer fetches we will still collect (bound: a slow
     #: peer must not accumulate unbounded queued HTTP work)
     _DVR_FETCH_INFLIGHT_MAX = 32
+    #: seconds an all-peer /api/v1/dvrmeta miss stays cached (a newly
+    #: finalized recording becomes peer-fillable within this bound)
+    _DVR_META_MISS_SEC = 10.0
 
     def _dvr_peer_fetch(self, path: str, track_id: int,
                         win: int) -> bytes | None:
@@ -580,7 +662,8 @@ class StreamingServer:
         if cluster is None:
             return None
         from ..protocol.sdp import _norm
-        peer = cluster.dvr_peers.get(_norm(path))
+        peer = cluster.dvr_peers.get(_norm(path)) \
+            or self._dvr_meta_peers.get(_norm(path))
         if peer is None:
             return None
         host, port, spans = peer
@@ -599,11 +682,7 @@ class StreamingServer:
                     del self._dvr_fetches[k]
                 if len(self._dvr_fetches) >= self._DVR_FETCH_INFLIGHT_MAX:
                     return None
-            if self._dvr_fetch_pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._dvr_fetch_pool = ThreadPoolExecutor(
-                    max_workers=2, thread_name_prefix="dvr-fetch")
-            self._dvr_fetches[key] = self._dvr_fetch_pool.submit(
+            self._dvr_fetches[key] = self._ensure_dvr_fetch_pool().submit(
                 self._dvr_fetch_blocking, host, int(port), path,
                 int(track_id), int(win))
             return b""
@@ -615,14 +694,21 @@ class StreamingServer:
         except Exception:
             return None
 
-    def _dvr_fetch_blocking(self, host: str, port: int, path: str,
-                            track_id: int, win: int) -> bytes | None:
-        """The actual HTTP GET — helper-thread only.  Sends this node's
-        REST credentials: on an auth-enabled cluster the peer's
-        ``/api/v1/dvrwindow`` sits behind the same shared config."""
+    def _ensure_dvr_fetch_pool(self):
+        if self._dvr_fetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._dvr_fetch_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="dvr-fetch")
+        return self._dvr_fetch_pool
+
+    def _peer_http_get(self, host: str, port: int,
+                       target: str) -> bytes | None:
+        """One peer REST GET — helper-thread only.  Sends this node's
+        REST credentials: on an auth-enabled cluster the peer's DVR
+        endpoints sit behind the same shared config.  None on any
+        non-200 / network failure."""
         import base64
         import http.client
-        from urllib.parse import quote
         headers = {}
         if self.config.auth_enabled:
             cred = (f"{self.config.rest_username}:"
@@ -632,10 +718,7 @@ class StreamingServer:
         try:
             conn = http.client.HTTPConnection(host, port, timeout=2.0)
             try:
-                conn.request(
-                    "GET", f"/api/v1/dvrwindow?path={quote(path)}"
-                           f"&track={track_id}&win={win}",
-                    headers=headers)
+                conn.request("GET", target, headers=headers)
                 resp = conn.getresponse()
                 if resp.status != 200:
                     return None
@@ -644,6 +727,81 @@ class StreamingServer:
                 conn.close()
         except OSError:
             return None
+
+    def _dvr_fetch_blocking(self, host: str, port: int, path: str,
+                            track_id: int, win: int) -> bytes | None:
+        from urllib.parse import quote
+        return self._peer_http_get(
+            host, port, f"/api/v1/dvrwindow?path={quote(path)}"
+                        f"&track={track_id}&win={win}")
+
+    async def _dvr_meta_sync(self, path: str) -> bool:
+        """Bootstrap a fully-remote ``.dvr`` asset (ISSUE 13 satellite,
+        closing the PR 12 open item): ask each live peer's REST
+        ``/api/v1/dvrmeta`` for the asset's meta + per-track index
+        documents, materialize them locally (index records + EMPTY spill
+        file, so every window read degrades to the peer fetcher), and
+        remember which peer answered so ``_dvr_peer_fetch`` can route
+        window fills there even without an armed-asset advertisement."""
+        cluster, dvr = self.cluster, self.dvr
+        if cluster is None or dvr is None:
+            return False
+        from ..protocol.sdp import _norm
+        # negative cache: a path no peer knew stays a miss for a while —
+        # one cheap scanning client must not turn every repeat DESCRIBE
+        # into a fresh cluster-wide HTTP sweep
+        now = time.monotonic()
+        until = self._dvr_meta_misses.get(_norm(path))
+        if until is not None:
+            if now < until:
+                return False
+            del self._dvr_meta_misses[_norm(path)]
+        nodes = dict(cluster.last_nodes)
+        if not nodes:
+            try:
+                nodes = await cluster.placement.live_nodes()
+            except Exception:
+                return False
+        loop = asyncio.get_running_loop()
+        for node, meta in nodes.items():
+            if node == cluster.config.node_id:
+                continue
+            host, port = meta.get("ip"), meta.get("http")
+            if not host or not port:
+                continue
+            doc = await loop.run_in_executor(
+                self._ensure_dvr_fetch_pool(), self._dvr_meta_blocking,
+                str(host), int(port), path)
+            if not doc or not dvr.materialize(path, doc):
+                continue
+            spans = {}
+            for tid, idx in (doc.get("tracks") or {}).items():
+                wins = [int(r["win"]) for r in idx.get("windows", ())
+                        if isinstance(r, dict) and "win" in r]
+                if wins:
+                    spans[str(tid)] = [min(wins), max(wins)]
+            self._dvr_meta_peers[_norm(path)] = (str(host), int(port),
+                                                 spans)
+            return True
+        if len(self._dvr_meta_misses) >= 512:     # bound scanner abuse
+            self._dvr_meta_misses.clear()
+        self._dvr_meta_misses[_norm(path)] = now + self._DVR_META_MISS_SEC
+        return False
+
+    def _dvr_meta_blocking(self, host: str, port: int,
+                           path: str) -> dict | None:
+        """HTTP GET of a peer's /api/v1/dvrmeta — helper-thread only."""
+        import json
+        from urllib.parse import quote
+        raw = self._peer_http_get(
+            host, port, f"/api/v1/dvrmeta?path={quote(path)}")
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw.decode("utf-8", "replace"))
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
 
     def _write_vod_cache_meta(self) -> None:
         """Atomic write of the segment cache's hot-set metadata next to

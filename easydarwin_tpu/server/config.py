@@ -147,6 +147,23 @@ class ServerConfig:
     cluster_pull_jitter_frac: float = 0.25       # ± anti-stampede jitter
     cluster_pull_breaker_failures: int = 5       # consecutive → open
     cluster_pull_breaker_open_sec: float = 10.0
+    # --- load-aware control plane (ISSUE 13: cluster/capacity.py + the
+    # Rebalancer in cluster/service.py).  Each node publishes a capacity
+    # score (boot-time self-bench of the relay fan-out path, in relayed
+    # pkts/sec; pin it here with a value > 0 to skip the bench) plus
+    # live utilization into its fenced lease record; the hash ring
+    # weights vnode counts by capacity, new SETUPs past the admission
+    # high-water mark answer 453 or a 305 redirect to the placement-
+    # resolved edge, and the rebalancer drains a sustained-burning
+    # node's hottest stream to the least-loaded peer.
+    cluster_capacity_score: float = 0.0          # 0 = boot self-bench
+    cluster_admission_enabled: bool = True
+    cluster_admission_high_water: float = 0.85   # util ratio gate
+    cluster_rebalance_enabled: bool = True
+    cluster_rebalance_high_water: float = 0.9    # sustained-burn level
+    cluster_rebalance_low_water: float = 0.5     # target headroom gate
+    cluster_rebalance_burn_sec: float = 10.0     # sustained-burn window
+    cluster_rebalance_cooldown_sec: float = 30.0  # min gap between moves
     # --- auth / misc
     auth_enabled: bool = False
     rest_username: str = "admin"
@@ -275,6 +292,13 @@ class ServerConfig:
             vnodes=self.cluster_vnodes,
             own_ttl_sec=self.cluster_own_ttl_sec,
             migration_ttl_sec=self.cluster_migration_ttl_sec,
+            rebalance_enabled=self.cluster_rebalance_enabled,
+            rebalance_high_water=self.cluster_rebalance_high_water,
+            rebalance_low_water=self.cluster_rebalance_low_water,
+            rebalance_burn_sec=self.cluster_rebalance_burn_sec,
+            rebalance_cooldown_sec=self.cluster_rebalance_cooldown_sec,
+            admission_enabled=self.cluster_admission_enabled,
+            admission_high_water=self.cluster_admission_high_water,
             pull=PullConfig(
                 connect_timeout_sec=self.cluster_pull_connect_timeout_sec,
                 read_timeout_sec=self.cluster_pull_read_timeout_sec,

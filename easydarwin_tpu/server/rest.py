@@ -339,6 +339,24 @@ class RestApi:
             return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
         return 200, blob, "application/octet-stream"
 
+    def _cmd_dvrmeta(self, params: dict,
+                     body: bytes) -> tuple[int, str] | tuple[int, str, str]:
+        """GET /api/v1/dvrmeta?path= — an asset's meta + per-track spill
+        index documents (ISSUE 13 satellite).  This is the bootstrap
+        half of cluster peer-fill: a node that never saw the stream
+        materializes these documents locally (``DvrManager.materialize``)
+        and then block-fills every window through ``/api/v1/dvrwindow``
+        — a fully-remote ``.dvr`` asset replays anywhere the cluster
+        routes a subscriber."""
+        if self.app.dvr is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        path = params.get("path", [""])[0]
+        doc = self.app.dvr.meta_doc(path) if path else None
+        if doc is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, json.dumps(doc, separators=(",", ":")), \
+            "application/json"
+
     async def _cmd_startpullrelay(self, params: dict,
                                   body: bytes) -> tuple[int, str]:
         """Pull a remote rtsp:// stream into a local path (EasyRelaySession

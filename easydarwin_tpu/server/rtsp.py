@@ -96,6 +96,10 @@ class RtspConnection:
         self.created_at = time.monotonic()
         peer = writer.get_extra_info("peername") or ("?", 0)
         self.client_ip = peer[0]
+        #: ip:port — the admission redirect's edge-spread key: thousands
+        #: of viewers behind one CGNAT ip must still fan across edges,
+        #: so the spread hashes the full 5-tuple-ish identity, not the ip
+        self.client_key = f"{peer[0]}:{peer[1]}"
         #: correlation id threaded through every span/event/flight record
         #: this connection produces (and stamped onto its relay session /
         #: outputs, so engine-pass and native-egress spans carry it too)
@@ -402,6 +406,30 @@ class RtspConnection:
                     req.cseq)
 
     async def _setup_play(self, req, base, track_id, t) -> None:
+        # overload admission (ISSUE 13): past the utilization high-water
+        # mark a node sheds NEW subscribers before it burns — 305 to the
+        # placement-resolved edge when one has headroom, 453 otherwise.
+        # Only the session's FIRST track gates: a half-set-up player
+        # must complete or tear down, never strand mid-session.  Plain
+        # local-file VOD is exempt: no peer can serve this node's movie
+        # folder (live relays migrate, .dvr assets bootstrap — files
+        # don't), so a redirect would turn overload into a hard 404.
+        adm = self.server.admission
+        vod = self.server.vod
+        is_dvr = (self.server.dvr is not None
+                  and self.server.dvr.is_dvr_path(base))
+        local_file = (not is_dvr and vod is not None
+                      and vod.resolve(base) is not None)
+        if adm is not None and not self.player_tracks and not local_file:
+            verdict = adm(base, self.client_key)
+            if verdict is not None:
+                action, url = verdict
+                if action == "redirect" and url:
+                    self._reply(rtsp.RtspResponse(
+                        305, {"Location": url}), req.cseq)
+                else:
+                    raise rtsp.RtspError(453)
+                return
         dvr = self.server.dvr
         if (dvr is not None and dvr.is_dvr_path(base)
                 and self.vod_file is None):
@@ -1011,6 +1039,10 @@ class RtspServer:
         self.dvr = None
         self.auth = auth                     # AuthService or None
         self.access_log = access_log         # AccessLog or None
+        #: overload admission hook (ISSUE 13) — set by the app under
+        #: cluster mode: ``(path, client_key) -> None | (action, url)``;
+        #: None = every SETUP admitted (standalone behavior)
+        self.admission = None
         from .modules import ModuleRegistry
         self.modules = ModuleRegistry()
         #: RTSP-over-HTTP tunnels: x-sessioncookie → GET-side connection

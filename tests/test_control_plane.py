@@ -1,0 +1,637 @@
+"""Load-aware control plane (ISSUE 13): capacity-weighted placement,
+proactive SLO-drain rebalancing, overload admission (453/305) and
+origin→edge relay trees.
+
+Pins the satellite contracts: equal capacities reproduce the unweighted
+ring byte-for-byte (no silent placement churn on upgrade), a capacity
+change moves only ~proportional keyspace, the admission redirect target
+equals the placement resolution, the rebalancer never flaps, and the
+capacity/overload spoof sites drive it all deterministically.
+"""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from easydarwin_tpu import obs
+from easydarwin_tpu.cluster.capacity import LoadTracker, quantize, self_bench
+from easydarwin_tpu.cluster.placement import HashRing, PlacementService
+from easydarwin_tpu.cluster.redis_client import InMemoryRedis
+from easydarwin_tpu.cluster.service import (ClusterConfig, ClusterService,
+                                            ckpt_key)
+from easydarwin_tpu.relay.output import CollectingOutput
+from easydarwin_tpu.relay.session import SessionRegistry
+from easydarwin_tpu.resilience import INJECTOR
+from easydarwin_tpu.resilience.inject import FaultPlan
+from easydarwin_tpu.server import ServerConfig, StreamingServer
+from easydarwin_tpu.utils.client import RtspClient
+
+SDP = ("v=0\r\no=- 1 1 IN IP4 127.0.0.1\r\ns=cp\r\nt=0 0\r\n"
+       "m=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+       "a=control:trackID=1\r\n")
+
+PATHS = [f"/live/cam{i}" for i in range(400)]
+
+
+# --------------------------------------------------------- weighted ring
+def test_weighted_ring_equal_caps_byte_identical():
+    """EQUAL capacities must reproduce today's unweighted ring
+    byte-for-byte — a same-hardware cluster upgrades with zero
+    placement churn (the acceptance pin)."""
+    nodes = ["a", "b", "c"]
+    plain = HashRing(nodes, 64)
+    for cap in (1.0, 5.0, 48000.0, 0.1):
+        weighted = HashRing(nodes, 64,
+                            capacities={n: cap for n in nodes})
+        assert weighted._points == plain._points
+        assert weighted.vnode_counts() == {n: 64 for n in nodes}
+        assert all(weighted.owner(p) == plain.owner(p) for p in PATHS)
+
+
+def test_weighted_ring_capacity_share_and_movement():
+    nodes = ["a", "b", "c"]
+    r_eq = HashRing(nodes, 64, capacities={"a": 1, "b": 1, "c": 1})
+    # doubling ONE node's capacity: deterministic, order-insensitive,
+    # counts follow the share formula
+    caps = {"a": 1, "b": 1, "c": 2}
+    r_w = HashRing(nodes, 64, capacities=caps)
+    assert r_w.vnode_counts() == {"a": 48, "b": 48, "c": 96}
+    assert HashRing(["c", "b", "a"], 64, capacities=caps)._points \
+        == r_w._points
+    # the doubled node's keyspace share grows toward 1/2; the movement
+    # stays bounded ~proportional to the share delta (1/3 → 1/2), far
+    # from a rehash-everything
+    share = {n: sum(1 for p in PATHS if r_w.owner(p) == n)
+             for n in nodes}
+    assert share["c"] > 1.5 * max(share["a"], share["b"]), share
+    moved = sum(1 for p in PATHS if r_w.owner(p) != r_eq.owner(p))
+    assert 0 < moved < len(PATHS) // 2, moved
+    # ONLY the ranked share moves: a path keeping its owner under the
+    # new weights was never touched; the weight change adds/removes
+    # only c-prefix points so a/b points are a strict subset
+    eq_ab = {pt for pt in r_eq._points if pt[1] != "c"}
+    w_ab = {pt for pt in r_w._points if pt[1] != "c"}
+    assert w_ab <= eq_ab
+    # clamps: a wild (spoofed-high) capacity cannot balloon the ring,
+    # a tiny one keeps at least one point
+    many = [f"n{i}" for i in range(9)]
+    caps9 = {n: 1.0 for n in many}
+    caps9["n0"] = 1e9
+    counts = HashRing(many, 64, capacities=caps9).vnode_counts()
+    assert counts["n0"] == 64 * 8            # MAX_WEIGHT_FACTOR clamp
+    assert all(counts[n] == 1 for n in many if n != "n0")
+
+
+def test_placement_ring_weighted_only_when_all_publish():
+    r = InMemoryRedis()
+    ps = PlacementService(r, "a")
+    full = {"a": {"cap": 64.0}, "b": {"cap": 128.0}}
+    assert ps.ring(full).vnode_counts() == {"a": 43, "b": 85}
+    # a mixed-version cluster (one node not publishing) stays unweighted
+    partial = {"a": {"cap": 64.0}, "b": {}}
+    assert ps.ring(partial).vnode_counts() == {"a": 64, "b": 64}
+
+
+def test_edge_for_load_ranked_and_deterministic():
+    ps = PlacementService(InMemoryRedis(), "a")
+    nodes = {"a": {"util": 2.0, "cap": 64.0},
+             "b": {"util": 0.1, "cap": 64.0},
+             "c": {"util": 0.3, "cap": 64.0},
+             "d": {"util": 0.95, "cap": 64.0}}
+    # overloaded peers (>= high water) are never edges; self excluded
+    for key in ("k1", "k2", "k3", "k4", "k5"):
+        e = ps.edge_for("/live/x", nodes, client_key=key,
+                        exclude=("a",), high_water=0.9)
+        assert e in ("b", "c")
+        # pure function: same inputs → same edge (the redirect target
+        # IS the placement resolution)
+        assert e == ps.edge_for("/live/x", nodes, client_key=key,
+                                exclude=("a",), high_water=0.9)
+    # successors are load-ranked behind the ring owner
+    succ = ps.successors("/live/x", nodes)
+    rest = succ[1:]
+    utils = [nodes[n]["util"] for n in rest]
+    assert utils == sorted(utils)
+    # nothing eligible → None (the caller answers 453)
+    assert ps.edge_for("/live/x", {"a": {"util": 2.0}}, exclude=("a",),
+                       high_water=0.9) is None
+
+
+# ------------------------------------------------------ capacity scoring
+def test_self_bench_positive_and_cached():
+    s1 = self_bench(seconds=0.03, cache=False)
+    assert s1 > 0
+    s2 = self_bench(seconds=0.03)           # cached per boot
+    assert s2 == self_bench(seconds=0.03)
+    assert quantize(100.0) == 128.0
+    assert quantize(48.0) == 64.0
+    assert quantize(0.0) == 0.0
+    # equal hardware lands equal buckets even with bench noise
+    assert quantize(48000.0) == quantize(51000.0)
+
+
+def test_load_tracker_util_burn_and_spoof():
+    vals = {"n": 0}
+    t = {"t": 0.0}
+
+    class _Slo:
+        def status(self):
+            return {"objectives": {"latency": {
+                "in_violation": True, "budget_remaining": 0.5}}}
+
+    lt = LoadTracker(100.0, clock=lambda: t["t"],
+                     source=lambda: vals["n"], slo=_Slo(),
+                     subscribers=lambda: 3)
+    lt.sample()                               # baseline
+    vals["n"], t["t"] = 100, 1.0
+    rec = lt.sample()                         # inst 100 pps, EWMA 40
+    assert abs(rec["util"] - 0.4) < 1e-6
+    assert rec["burn"] is True and rec["subs"] == 3
+    assert rec["cap"] == 128.0                # quantize(100)
+    assert obs.CLUSTER_UTILIZATION_RATIO.value() == rec["util"]
+    # capacity_spoof replaces the capacity the node believes in AND
+    # publishes — utilization inflates coherently
+    fi_before = obs.FAULT_INJECTED.value(site="capacity_spoof")
+    INJECTOR.arm(FaultPlan.parse("seed=5,capacity_spoof=50"))
+    try:
+        vals["n"], t["t"] = 200, 2.0
+        rec = lt.sample()
+        assert rec["cap"] == 64.0             # quantize(50): the lie
+        assert rec["util"] == round(lt.rate_pps / 50.0, 4)
+        assert obs.FAULT_INJECTED.value(site="capacity_spoof") \
+            == fi_before + 1
+    finally:
+        INJECTOR.disarm()
+
+
+# --------------------------------------------------- rebalancer state machine
+def _burning_load():
+    return {"cap": 64.0, "util": 2.0, "burn": False, "subs": 3}
+
+
+def _idle_load():
+    return {"cap": 131072.0, "util": 0.0, "burn": False, "subs": 0}
+
+
+async def test_rebalancer_drains_hottest_to_least_loaded():
+    """The planned move end-to-end at the service level: a burning
+    node's hottest stream is handed to the idle peer (fresh checkpoint
+    + fenced hand-off record + local data-plane release), the peer
+    adopts it through its normal scan, and the move is counted once."""
+    r = InMemoryRedis()
+    reg_a, reg_b = SessionRegistry(), SessionRegistry()
+    sess = reg_a.find_or_create("/live/hot", SDP)
+    sess.streams[1].add_output(CollectingOutput())
+    released: list[str] = []
+    restored: list[dict] = []
+
+    def _restore(doc):
+        restored.append(doc)
+        for srec in doc.get("sessions", ()):
+            reg_b.find_or_create(srec["path"], srec["sdp"])
+        return len(doc.get("sessions", ())), 0
+
+    cfg_a = ClusterConfig("a", lease_ttl_sec=5, rebalance_burn_sec=0.0,
+                          rebalance_cooldown_sec=1000.0)
+    svc_a = ClusterService(r, cfg_a, registry=reg_a,
+                           on_fence_lost=released.append)
+    svc_a.load_status = _burning_load
+    svc_b = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                           registry=reg_b, restore_doc=_restore)
+    svc_b.load_status = _idle_load
+    await svc_a.lease.acquire()
+    await svc_b.lease.acquire()
+    await svc_b.tick()                # b publishes util=0 into its lease
+    moves_before = obs.CLUSTER_REBALANCE_MOVES.value()
+    await svc_a.tick()                # claim + burn window opens
+    assert "/live/hot" in svc_a._claims
+    assert obs.CLUSTER_REBALANCE_MOVES.value() == moves_before
+    await svc_a.tick()                # sustained → drain
+    # initiation alone is NOT a completed move: the counter lands only
+    # when the target's adoption flips the claimant
+    assert obs.CLUSTER_REBALANCE_MOVES.value() == moves_before
+    assert "/live/hot" not in svc_a._claims
+    assert "/live/hot" in svc_a._draining
+    # the SOURCE keeps serving until the target adopts: releasing now
+    # would race the pusher's re-announce against the restore
+    assert released == []
+    # the record still names the SOURCE as claimant (a pusher
+    # re-resolving mid-drain must keep landing on the serving node);
+    # the target is named in the handoff_to marker
+    rec = await svc_a.placement.claim_record("/live/hot")
+    assert rec is not None and rec[1]["node"] == "a"
+    assert rec[1]["handoff_to"] == "b"
+    assert await svc_a.placement.claimant("/live/hot") == "a"
+    assert await r.fget(ckpt_key("/live/hot")) is not None
+
+    # the target's scan adopts the hand-off exactly like a crash
+    # migration: restore + fenced claim, marker cleared, counted once
+    mig_before = obs.CLUSTER_MIGRATIONS.value()
+    await svc_b.tick()
+    assert svc_b.migrations == 1
+    assert obs.CLUSTER_MIGRATIONS.value() == mig_before + 1
+    assert restored and restored[0]["sessions"][0]["path"] == "/live/hot"
+    rec2 = await svc_b.placement.claim_record("/live/hot")
+    assert rec2 is not None and rec2[1]["node"] == "b"
+    assert "handoff_to" not in rec2[1]
+    assert "/live/hot" in svc_b._claims
+    # the adoption cleared the marker → the source NOW releases its
+    # data plane (the pusher gets kicked toward the restored target)
+    # and books the COMPLETED move
+    await svc_a.tick()
+    assert released == ["/live/hot"]
+    assert "/live/hot" not in svc_a._draining
+    assert obs.CLUSTER_REBALANCE_MOVES.value() == moves_before + 1
+    await svc_b.tick()                # idempotent
+    assert svc_b.migrations == 1
+
+
+async def test_rebalancer_handoff_timeout_reclaims():
+    """A hand-off the target never adopts must not strand the stream:
+    past the timeout the source reclaims it (fenced fresh token) and
+    keeps serving."""
+    r = InMemoryRedis()
+    reg_a = SessionRegistry()
+    sess = reg_a.find_or_create("/live/tm", SDP)
+    sess.streams[1].add_output(CollectingOutput())
+    released: list[str] = []
+    svc_a = ClusterService(
+        r, ClusterConfig("a", lease_ttl_sec=5, rebalance_burn_sec=0.0,
+                         rebalance_cooldown_sec=1000.0),
+        registry=reg_a, on_fence_lost=released.append)
+    svc_a.load_status = _burning_load
+    # a peer that looks idle but never runs its adoption scan
+    svc_b = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                           registry=SessionRegistry())
+    svc_b.load_status = _idle_load
+    await svc_a.lease.acquire()
+    await svc_b.lease.acquire()
+    await svc_b.tick()
+    await svc_a.tick()
+    await svc_a.tick()                # drain fired, hand-off pending
+    assert "/live/tm" in svc_a._draining
+    target, _deadline = svc_a._draining["/live/tm"]
+    svc_a._draining["/live/tm"] = (target, 0.0)   # force expiry
+    await svc_a.tick()
+    assert "/live/tm" not in svc_a._draining
+    assert "/live/tm" in svc_a._claims            # reclaimed, fenced
+    assert released == []                         # never released
+    assert await svc_a.placement.claimant("/live/tm") == "a"
+
+
+async def test_rebalancer_handoff_target_already_has_session():
+    """A target that already carries a session for the path (an edge's
+    pull, or a pusher that raced ahead) adopts by MERGING the published
+    checkpoint into it — its subscribers must be restored, never
+    silently dropped by a bare claim."""
+    r = InMemoryRedis()
+    reg_a, reg_b = SessionRegistry(), SessionRegistry()
+    sess = reg_a.find_or_create("/live/h2", SDP)
+    sess.streams[1].add_output(CollectingOutput())
+    restored: list[dict] = []
+
+    def _restore(doc):
+        restored.append(doc)
+        for srec in doc.get("sessions", ()):
+            reg_b.find_or_create(srec["path"], srec["sdp"])
+        return 1, 0
+
+    svc_a = ClusterService(
+        r, ClusterConfig("a", lease_ttl_sec=5, rebalance_burn_sec=0.0,
+                         rebalance_cooldown_sec=1000.0),
+        registry=reg_a)
+    svc_a.load_status = _burning_load
+    svc_b = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                           registry=reg_b, restore_doc=_restore)
+    svc_b.load_status = _idle_load
+    await svc_a.lease.acquire()
+    await svc_b.lease.acquire()
+    await svc_b.tick()
+    await svc_a.tick()
+    await svc_a.tick()                # drain fired
+    rec = await svc_a.placement.claim_record("/live/h2")
+    assert rec is not None and rec[1].get("handoff_to") == "b"
+    # b already has a local session for the path (edge pull / racing
+    # pusher) — adoption must still run the checkpoint restore (merge)
+    reg_b.find_or_create("/live/h2", SDP)
+    mig_before = obs.CLUSTER_MIGRATIONS.value()
+    await svc_b.tick()
+    assert restored, "checkpoint restore skipped on pre-existing session"
+    assert obs.CLUSTER_MIGRATIONS.value() == mig_before + 1
+    assert "/live/h2" in svc_b._claims
+    rec2 = await svc_b.placement.claim_record("/live/h2")
+    assert rec2 is not None and "handoff_to" not in rec2[1]
+    # the source's drain watcher sees the adoption and releases
+    await svc_a.tick()
+    assert "/live/h2" not in svc_a._draining
+
+
+async def test_rebalancer_hysteresis_never_flaps():
+    """Intermittent burn must never move a stream: one clean sample
+    resets the sustained-burn window; no eligible low-water peer also
+    blocks the move."""
+    r = InMemoryRedis()
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/live/fl", SDP)
+    sess.streams[1].add_output(CollectingOutput())
+    load = {"rec": _burning_load()}
+    svc = ClusterService(
+        r, ClusterConfig("a", lease_ttl_sec=5, rebalance_burn_sec=0.0,
+                         rebalance_cooldown_sec=1000.0),
+        registry=reg)
+    svc.load_status = lambda: load["rec"]
+    # a busy peer exists but sits ABOVE the low-water mark
+    busy = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                          registry=SessionRegistry())
+    busy.load_status = lambda: {"cap": 64.0, "util": 0.7, "burn": False,
+                                "subs": 1}
+    await svc.lease.acquire()
+    await busy.lease.acquire()
+    await busy.tick()
+    moves_before = obs.CLUSTER_REBALANCE_MOVES.value()
+    await svc.tick()                  # burn window opens
+    load["rec"] = {"cap": 64.0, "util": 0.1, "burn": False, "subs": 3}
+    await svc.tick()                  # clean sample resets the window
+    assert svc.rebalancer._burn_since is None
+    load["rec"] = _burning_load()
+    await svc.tick()                  # window re-opens…
+    await svc.tick()                  # …sustained, but no low-water peer
+    assert obs.CLUSTER_REBALANCE_MOVES.value() == moves_before
+    assert "/live/fl" in svc._claims  # nothing moved
+
+
+async def test_rebalancer_idle_burn_never_drains():
+    """An under-utilized node reporting an SLO burn (a box-wide latency
+    artifact, not load) must NOT drain: a node under the low-water mark
+    is a drain target by definition — without this floor idle nodes
+    walk the hot stream around the cluster."""
+    r = InMemoryRedis()
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/live/ib", SDP)
+    sess.streams[1].add_output(CollectingOutput())
+    svc = ClusterService(
+        r, ClusterConfig("a", lease_ttl_sec=5, rebalance_burn_sec=0.0,
+                         rebalance_cooldown_sec=1000.0),
+        registry=reg)
+    svc.load_status = lambda: {"cap": 131072.0, "util": 0.001,
+                               "burn": True, "subs": 3}
+    idle = ClusterService(r, ClusterConfig("b", lease_ttl_sec=5),
+                          registry=SessionRegistry())
+    idle.load_status = _idle_load
+    await svc.lease.acquire()
+    await idle.lease.acquire()
+    await idle.tick()
+    for _ in range(3):
+        await svc.tick()
+    assert svc.rebalancer._burn_since is None     # never even opened
+    assert "/live/ib" in svc._claims              # nothing moved
+
+
+async def test_rebalancer_target_tiebreak_prefers_capacity():
+    """Equal-utilization drain candidates tie-break toward the HIGHEST
+    published capacity — the weak idle node must not win just because
+    its name sorts first."""
+    r = InMemoryRedis()
+    reg = SessionRegistry()
+    sess = reg.find_or_create("/live/tb", SDP)
+    sess.streams[1].add_output(CollectingOutput())
+    svc = ClusterService(
+        r, ClusterConfig("z", lease_ttl_sec=5, rebalance_burn_sec=0.0,
+                         rebalance_cooldown_sec=1000.0),
+        registry=reg)
+    svc.load_status = _burning_load
+    weak = ClusterService(r, ClusterConfig("a-weak", lease_ttl_sec=5),
+                          registry=SessionRegistry())
+    weak.load_status = lambda: {"cap": 64.0, "util": 0.0, "burn": False,
+                                "subs": 0}
+    strong = ClusterService(r, ClusterConfig("b-strong", lease_ttl_sec=5),
+                            registry=SessionRegistry())
+    strong.load_status = _idle_load
+    await svc.lease.acquire()
+    await weak.lease.acquire()
+    await strong.lease.acquire()
+    await weak.tick()
+    await strong.tick()
+    await svc.tick()                  # burn window opens
+    await svc.tick()                  # sustained → drain
+    rec = await svc.placement.claim_record("/live/tb")
+    assert rec is not None and rec[1].get("handoff_to") == "b-strong"
+
+
+# ----------------------------------------------------- overload admission
+def _cfg(tmp_path, node: str) -> ServerConfig:
+    return ServerConfig(
+        rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+        wan_ip="127.0.0.1", reflect_interval_ms=10, bucket_delay_ms=0,
+        log_folder=str(tmp_path / node), access_log_enabled=False,
+        server_id=node, cluster_enabled=True,
+        cluster_lease_ttl_sec=2.0, cluster_heartbeat_sec=0.3)
+
+
+async def test_admission_refuses_453_and_redirects_305(tmp_path):
+    """Past the high-water mark a node answers a new SETUP with 453 —
+    or 305 to the placement-resolved edge when one has headroom; the
+    Location target must EQUAL the placement resolution (the satellite
+    pin), and every refusal is counted by action."""
+    redis = InMemoryRedis()
+    app = StreamingServer(_cfg(tmp_path, "adm-a"), redis_client=redis)
+    await app.start()
+    player = pusher = None
+    try:
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/adm"
+        await pusher.push_start(uri, SDP)
+        await asyncio.sleep(0.5)              # claim + load published
+        assert app.load_tracker is not None
+        # force overload directly (deterministic — no real load needed)
+        app.load_tracker.last_util = 5.0
+        ref_before = obs.CLUSTER_ADMISSION_REFUSED.value(action="refuse")
+        player = RtspClient()
+        await player.connect("127.0.0.1", app.rtsp.port)
+        r = await player.request("DESCRIBE", uri,
+                                 {"accept": "application/sdp"})
+        assert r.status == 200                # DESCRIBE is never gated
+        r = await player.request(
+            "SETUP", f"{uri}/trackID=1",
+            {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+        assert r.status == 453                # no edge → refuse
+        assert obs.CLUSTER_ADMISSION_REFUSED.value(action="refuse") \
+            == ref_before + 1
+
+        # a live peer with headroom appears → 305, Location equals the
+        # placement-resolved edge
+        peer_meta = {"ip": "127.0.0.1", "rtsp": 9557, "http": 9558,
+                     "util": 0.0, "cap": 64.0}
+        app.cluster.last_nodes = {**app.cluster.last_nodes,
+                                  "adm-peer": peer_meta}
+        red_before = obs.CLUSTER_ADMISSION_REFUSED.value(
+            action="redirect")
+        r = await player.request(
+            "SETUP", f"{uri}/trackID=1",
+            {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+        assert r.status == 305
+        want = app.cluster.placement.edge_for(
+            "/live/adm", app.cluster.last_nodes,
+            client_key=next(iter(
+                c.client_key for c in app.rtsp.connections
+                if not c.is_pusher)),
+            exclude=("adm-a",),
+            high_water=app.config.cluster_admission_high_water)
+        assert want == "adm-peer"
+        assert r.headers.get("location") == \
+            "rtsp://127.0.0.1:9557/live/adm"
+        assert obs.CLUSTER_ADMISSION_REFUSED.value(action="redirect") \
+            == red_before + 1
+
+        # back under the mark: admitted normally
+        app.load_tracker.last_util = 0.0
+        r = await player.request(
+            "SETUP", f"{uri}/trackID=1",
+            {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+        assert r.status == 200
+    finally:
+        if player is not None:
+            await player.close()
+        if pusher is not None:
+            await pusher.close()
+        await app.stop()
+
+
+async def test_overload_spoof_forces_admission(tmp_path):
+    """The overload_spoof site makes the 453 path chaos-testable with
+    zero real load (seeded schedule, counted per injection)."""
+    redis = InMemoryRedis()
+    app = StreamingServer(_cfg(tmp_path, "adm-s"), redis_client=redis)
+    await app.start()
+    pusher = player = None
+    try:
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app.rtsp.port)
+        uri = f"rtsp://127.0.0.1:{app.rtsp.port}/live/sp"
+        await pusher.push_start(uri, SDP)
+        await asyncio.sleep(0.4)
+        fi_before = obs.FAULT_INJECTED.value(site="overload_spoof")
+        INJECTOR.arm(FaultPlan.parse("seed=9,overload_spoof=1"))
+        try:
+            player = RtspClient()
+            await player.connect("127.0.0.1", app.rtsp.port)
+            r = await player.request("DESCRIBE", uri,
+                                     {"accept": "application/sdp"})
+            assert r.status == 200
+            r = await player.request(
+                "SETUP", f"{uri}/trackID=1",
+                {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+            assert r.status == 453
+            assert obs.FAULT_INJECTED.value(site="overload_spoof") \
+                == fi_before + 1
+        finally:
+            INJECTOR.disarm()
+    finally:
+        if player is not None:
+            await player.close()
+        if pusher is not None:
+            await pusher.close()
+        await app.stop()
+
+
+# ------------------------------------------------------------ relay tree
+async def test_relay_tree_edge_counted_on_pull(tmp_path):
+    """A node starting a cross-server pull IS a relay-tree edge: one
+    pull upstream, local fan-out below it."""
+    redis = InMemoryRedis()
+    app_a = StreamingServer(_cfg(tmp_path, "rt-a"), redis_client=redis)
+    app_b = StreamingServer(_cfg(tmp_path, "rt-b"), redis_client=redis)
+    await app_a.start()
+    await app_b.start()
+    pusher = player = None
+    try:
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app_a.rtsp.port)
+        await pusher.push_start(
+            f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/rt", SDP)
+        await asyncio.sleep(0.6)
+        edges_before = obs.RELAY_TREE_EDGES.value()
+        player = RtspClient()
+        await player.connect("127.0.0.1", app_b.rtsp.port)
+        await player.play_start(
+            f"rtsp://127.0.0.1:{app_b.rtsp.port}/live/rt")
+        assert "/live/rt" in app_b.cluster.pulls
+        assert obs.RELAY_TREE_EDGES.value() == edges_before + 1
+    finally:
+        if player is not None:
+            await player.close()
+        if pusher is not None:
+            await pusher.close()
+        await app_a.stop()
+        await app_b.stop()
+
+
+# ------------------------------------------------- capacity in the lease
+async def test_cluster_tick_publishes_capacity_into_lease(tmp_path):
+    redis = InMemoryRedis()
+    app = StreamingServer(_cfg(tmp_path, "cap-a"), redis_client=redis)
+    await app.start()
+    try:
+        await asyncio.sleep(0.5)
+        nodes = await app.cluster.placement.live_nodes()
+        meta = nodes["cap-a"]
+        assert meta.get("cap", 0) > 0           # quantized self-bench
+        assert meta["cap"] == quantize(meta["cap"])
+        assert "util" in meta and "burn" in meta
+        assert obs.CLUSTER_CAPACITY_SCORE.value() == meta["cap"]
+    finally:
+        await app.stop()
+
+
+# ---------------------------------------------------------- lint + gate
+def test_control_plane_lint_contract():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.metrics_lint import lint, lint_control_plane
+    from easydarwin_tpu.obs import events as ev
+    assert lint_control_plane(obs.REGISTRY, ev.SCHEMA) == []
+    assert lint(obs.REGISTRY) == []
+
+
+def test_bench_gate_accepts_and_rejects_rebalance_section():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.bench_gate import check_trajectory
+
+    def entry(rb=None):
+        extra = {} if rb is None else {"rebalance": rb}
+        return {"file": "BENCH_r99.json", "rc": 0,
+                "parsed": {"metric": "m", "value": 1.0, "unit": "p/s",
+                           "vs_baseline": 1.0, "extra": extra}}
+
+    good = {"rebalance_gap_packets": 0, "refused_during_crowd": 9,
+            "tree_fanout_gain": 9.0}
+    assert check_trajectory([entry(good)]) == []
+    assert check_trajectory([entry()]) == []     # old rounds stay valid
+    assert any("rebalance_gap_packets" in e for e in check_trajectory(
+        [entry(dict(good, rebalance_gap_packets=3))]))
+    assert any("refused_during_crowd" in e for e in check_trajectory(
+        [entry(dict(good, refused_during_crowd=0))]))
+    assert any("tree_fanout_gain" in e for e in check_trajectory(
+        [entry(dict(good, tree_fanout_gain=1.0))]))
+
+
+def test_fault_plan_parses_control_plane_sites():
+    plan = FaultPlan.parse("seed=3,capacity_spoof=60,overload_spoof=0.5")
+    assert plan.capacity_spoof == 60.0
+    assert plan.overload_spoof == 0.5
+    assert plan.any_active()
+    # seeded determinism: same seed → same overload schedule
+    a, b = [], []
+    for out in (a, b):
+        INJECTOR.arm(plan)
+        try:
+            out.extend(INJECTOR.overload_spoof() for _ in range(64))
+        finally:
+            INJECTOR.disarm()
+    assert a == b and any(a) and not all(a)

@@ -1157,6 +1157,94 @@ async def test_server_pause_rewind_catchup_e2e(tmp_path):
 
 # -------------------------------------------------------- tooling contracts
 
+async def test_remote_dvr_asset_bootstrap_replay(tmp_path):
+    """ISSUE 13 satellite (closes the PR 12 open item): a finalized
+    recording replays from a node that NEVER saw the stream.  Node B
+    has no local ``.dvr`` state at all; its DESCRIBE bootstraps node
+    A's meta/index documents through ``/api/v1/dvrmeta``
+    (``DvrManager.materialize``), and PLAY block-fills every window
+    through the ``/api/v1/dvrwindow`` peer fetcher — zero repacks, SPS
+    fast-start, gapless seq."""
+    from easydarwin_tpu.cluster.redis_client import InMemoryRedis
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+
+    def _cfg(node):
+        d = tmp_path / node
+        return ServerConfig(
+            rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+            wan_ip="127.0.0.1", reflect_interval_ms=5,
+            bucket_delay_ms=0, access_log_enabled=False,
+            log_folder=str(d / "logs"), movie_folder=str(d / "movies"),
+            server_id=node, cluster_enabled=True,
+            cluster_lease_ttl_sec=2.0, cluster_heartbeat_sec=0.3,
+            dvr_enabled=True, dvr_window_pkts=16)
+
+    redis = InMemoryRedis()
+    app_a = StreamingServer(_cfg("dvr-a"), redis_client=redis)
+    app_b = StreamingServer(_cfg("dvr-b"), redis_client=redis)
+    await app_a.start()
+    await app_b.start()
+    pusher = replayer = None
+    try:
+        uri_a = f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/rb"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app_a.rtsp.port)
+        await pusher.push_start(uri_a, VIDEO_SDP)
+        assert app_a.dvr.armed("/live/rb")
+        seq = 0
+        for i in range(80):
+            pkts, _ = frame_packets(seq, seq * 3000, idr=(i % 8 == 0),
+                                    with_params=(i == 0), size=300)
+            for p in pkts:
+                pusher.push_packet(0, p)
+            seq += len(pkts)
+            await asyncio.sleep(0.004)
+        for _ in range(100):
+            if app_a.dvr.stats()["spilled_windows"] >= 3:
+                break
+            await asyncio.sleep(0.05)
+        assert app_a.dvr.stats()["spilled_windows"] >= 3
+        assert app_a.dvr.finalize("/live/rb") is not None
+        # B has never seen the stream and has NO local .dvr tree
+        assert not os.path.isdir(os.path.join(
+            app_b.config.movie_folder, ".dvr", "live"))
+        await asyncio.sleep(0.7)      # both leases + node snapshots live
+        packs_before = pack_window.calls
+
+        replayer = RtspClient()
+        await replayer.connect("127.0.0.1", app_b.rtsp.port)
+        uri_b = f"rtsp://127.0.0.1:{app_b.rtsp.port}/live/rb.dvr"
+        await replayer.play_start(uri_b)
+        got = []
+        try:
+            while len(got) < 40:
+                got.append(await replayer.recv_interleaved(0, timeout=5))
+        except asyncio.TimeoutError:
+            pass
+        assert len(got) >= 20, f"remote replay starved: {len(got)}"
+        # SPS fast-start, one ssrc, gapless out-seq — the same contract
+        # as a local replay
+        assert rtp.RtpPacket.parse(got[0]).payload[0] & 0x1F == 7
+        assert len({rtp.RtpPacket.parse(d).ssrc for d in got}) == 1
+        seqs = [rtp.RtpPacket.parse(d).seq for d in got]
+        for i, s in enumerate(seqs):
+            assert s == (seqs[0] + i) & 0xFFFF, f"gap at {i}"
+        # the asset was born packed and bootstrapped — NOBODY repacked
+        assert pack_window.calls == packs_before
+        # the bootstrap materialized B's local skeleton + peer route
+        assert app_b.dvr.open_asset("/live/rb.dvr") is not None
+        assert "/live/rb" in app_b._dvr_meta_peers
+        await replayer.teardown(uri_b)
+    finally:
+        if replayer is not None:
+            await replayer.close()
+        if pusher is not None:
+            await pusher.close()
+        await app_a.stop()
+        await app_b.stop()
+
+
 def test_lint_dvr_contract():
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))

@@ -310,6 +310,36 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: dvr.reopen_repacks {rp2} != 0 "
                             "(a spilled asset re-open ran pack_window; "
                             "the zero-repack contract is broken)")
+        # ISSUE 13 rebalance section — OPTIONAL (rounds predating the
+        # load-aware control plane stay valid), but when present: a
+        # planned rebalance drain must be GAPLESS at the player socket,
+        # a flash crowd must have been shed through admission (zero
+        # refusals means the gate never engaged and the run proves
+        # nothing), and the origin→edge relay tree must have served
+        # more subscribers than the origin admitted solo (gain > 1)
+        rb = extra.get("rebalance")
+        if isinstance(rb, dict) and rb and "error" not in rb:
+            gap = rb.get("rebalance_gap_packets")
+            if not isinstance(gap, (int, float)) or not math.isfinite(gap) \
+                    or gap < 0:
+                errs.append(f"{name}: rebalance.rebalance_gap_packets "
+                            f"{gap!r} not a finite non-negative count")
+            elif gap != 0:
+                errs.append(f"{name}: rebalance.rebalance_gap_packets "
+                            f"{gap:.0f} (a planned drain dropped packets "
+                            "at the player socket — must be exactly 0)")
+            ref = rb.get("refused_during_crowd")
+            if not isinstance(ref, (int, float)) \
+                    or not math.isfinite(ref) or ref <= 0:
+                errs.append(f"{name}: rebalance.refused_during_crowd "
+                            f"{ref!r} must be > 0 (the admission gate "
+                            "never fired during the flash crowd)")
+            fg = rb.get("tree_fanout_gain")
+            if not isinstance(fg, (int, float)) or not math.isfinite(fg) \
+                    or fg <= 1.0:
+                errs.append(f"{name}: rebalance.tree_fanout_gain {fg!r} "
+                            "must exceed 1 (the relay tree served no "
+                            "more than the origin alone)")
         # ISSUE 5 chaos section — OPTIONAL (rounds predating the
         # resilience subsystem stay valid), but when present its two
         # headline numbers must be sane: degraded-mode throughput and

@@ -43,9 +43,11 @@ NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
 #: ``_level`` is the degradation-ladder rung index (resilience/ladder.py)
 #: — a dimensionless ordinal, the same way ``_count`` is; ``_info`` is
 #: the Prometheus info-metric convention (a constant-1 gauge whose
-#: labels carry the payload — egress_backend_info)
+#: labels carry the payload — egress_backend_info); ``_score`` is the
+#: control plane's capacity figure (cluster_capacity_score — a
+#: benchmark-derived rating in pps, quantized, not a raw measurement)
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count",
-                 "_level", "_info")
+                 "_level", "_info", "_score")
 
 EVENT_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 #: emit("event.name", ...) — the positional literal, plain or f-string
@@ -360,6 +362,57 @@ def lint_cluster(registry, schema: dict) -> list[str]:
     return errs
 
 
+#: closed action vocabulary of ``cluster_admission_refused_total``
+ADMISSION_ACTIONS = ("refuse", "redirect")
+
+
+def lint_control_plane(registry, schema: dict) -> list[str]:
+    """The load-aware control-plane contract (ISSUE 13): the capacity/
+    utilization/rebalance/admission/relay-tree families exist with
+    their exact label sets, every observed ``action`` label stays
+    inside the closed refuse|redirect vocabulary, the
+    ``cluster.rebalance`` / ``cluster.refuse`` event names are
+    declared, and the control-plane fault sites ride the closed SITES
+    vocabulary — ``tools/soak.py --skewed`` and the bench
+    ``extra.rebalance`` section key on these."""
+    errs: list[str] = []
+    want_labels = {
+        "cluster_capacity_score": (),
+        "cluster_utilization_ratio": (),
+        "cluster_rebalance_moves_total": (),
+        "cluster_admission_refused_total": ("action",),
+        "relay_tree_edges_total": (),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"control-plane family {fam_name} missing from "
+                        "the registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    fam = fams.get("cluster_admission_refused_total")
+    if fam is not None:
+        for (action,) in getattr(fam, "_values", {}):
+            if action not in ADMISSION_ACTIONS:
+                errs.append(f"cluster_admission_refused_total: observed "
+                            f"action {action!r} outside the closed set "
+                            f"{ADMISSION_ACTIONS}")
+    for name in ("cluster.rebalance", "cluster.refuse"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    from easydarwin_tpu.resilience.inject import SITES
+    for site in ("capacity_spoof", "overload_spoof"):
+        if site not in SITES:
+            errs.append(f"control-plane fault site {site!r} missing "
+                        "from the closed SITES vocabulary")
+    return errs
+
+
 def lint_requant(registry) -> list[str]:
     """The ABR-ladder requant contract (ISSUE 9): the pipeline families
     exist with their exact label sets, and every observed ``stage``
@@ -628,6 +681,10 @@ def main() -> int:
     # the cluster tier's vocabulary (ISSUE 6): lease/placement/pull/
     # migration families + cluster.* events + cluster fault sites
     errs += lint_cluster(obs.REGISTRY, ev.SCHEMA)
+    # the load-aware control plane's vocabulary (ISSUE 13): capacity/
+    # utilization/rebalance/admission families + the closed admission
+    # action set + cluster.rebalance/refuse events + spoof fault sites
+    errs += lint_control_plane(obs.REGISTRY, ev.SCHEMA)
     # the egress-backend ladder's vocabulary (ISSUE 8): probe families,
     # closed backend labels, the fallback event, the io_uring phase
     errs += lint_egress_backends(obs.REGISTRY, ev.SCHEMA)

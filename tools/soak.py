@@ -1448,12 +1448,29 @@ async def soak(seconds: float, n_sources: int = 0,
 # ===================================================================== cluster
 # The multi-process cluster soak (ISSUE 6 acceptance scenario).
 
-async def _cluster_node_main(node_id: str, redis_port: int) -> None:
+async def _cluster_node_main(node_id: str, redis_port: int,
+                             fault_plan: str = "",
+                             skewed: bool = False) -> None:
     """Child-process entry: one cluster-enabled server that announces
-    its bound ports on stdout and serves until killed."""
+    its bound ports on stdout and serves until killed.  ``skewed``
+    (ISSUE 13) tightens the control-plane knobs so the rebalance /
+    admission machinery acts within a soak-scale run; ``fault_plan``
+    arms a per-node FaultPlan (the --skewed harness forces a lying
+    capacity on one node through the capacity_spoof site)."""
     import os
     log_dir = f"/tmp/edtpu_cluster_soak/{node_id}"
     os.makedirs(log_dir, exist_ok=True)
+    extra = {}
+    if skewed:
+        extra = dict(
+            cluster_admission_high_water=0.8,
+            cluster_rebalance_high_water=0.9,
+            cluster_rebalance_low_water=0.4,
+            # burn window long enough that the flash crowd (harness
+            # t≈12-18s) lands while the weak node still owns the hot
+            # stream; the drain fires right after, once per run
+            cluster_rebalance_burn_sec=22.0,
+            cluster_rebalance_cooldown_sec=60.0)
     cfg = ServerConfig(
         rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
         wan_ip="127.0.0.1", reflect_interval_ms=10, bucket_delay_ms=0,
@@ -1462,7 +1479,8 @@ async def _cluster_node_main(node_id: str, redis_port: int) -> None:
         cluster_lease_ttl_sec=2.0, cluster_heartbeat_sec=0.5,
         cluster_pull_connect_timeout_sec=3.0,
         cluster_pull_read_timeout_sec=1.5,
-        cluster_pull_backoff_ms=150.0)
+        cluster_pull_backoff_ms=150.0,
+        resilience_fault_plan=fault_plan, **extra)
     app = StreamingServer(cfg)
     await app.start()
     print(f"NODE_READY rtsp={app.rtsp.port} rest={app.rest.port}",
@@ -1813,6 +1831,406 @@ async def cluster_soak(n_nodes: int, seconds: float,
     return 1 if failures else 0
 
 
+async def skewed_soak(n_nodes: int, seconds: float,
+                      seed: int = 7) -> int:
+    """ISSUE 13: heterogeneous-capacity cluster under a zipfian stream
+    popularity curve with a flash crowd on the hottest stream.
+
+    Node 0's capacity is forced LOW through the ``capacity_spoof`` fault
+    site (it believes and publishes the lie), so a modest base load
+    drives it past the high-water marks: the flash crowd's new SETUPs
+    are answered with 305 redirects to placement-resolved edges (each
+    edge runs ONE pull from the origin and fans out locally — the
+    origin→edge relay tree), and the rebalancer then drains the hottest
+    stream to the least-loaded peer through the PR 6 live-migration
+    machinery (gapless seq, same ssrc at a plain-UDP player that never
+    re-SETUPs).
+
+    Fails if any node still burns while a peer sits under half
+    utilization at exit, on any migration gap packet, or on zero
+    admission refusals during the crowd.
+    """
+    import json as _json
+    import os
+
+    from easydarwin_tpu.cluster.placement import PlacementService
+    from easydarwin_tpu.cluster.redis_client import (AsyncRedis,
+                                                     MiniRedisServer)
+    from easydarwin_tpu.protocol import sdp as sdp_mod
+
+    assert n_nodes >= 3, "--skewed needs at least 3 nodes (origin + edges)"
+    seconds = max(seconds, 60.0)
+    failures: list[str] = []
+    mini = MiniRedisServer()
+    await mini.start()
+    redis = AsyncRedis("127.0.0.1", mini.port)
+    node_ids = [f"skew-node-{i}" for i in range(n_nodes)]
+    weak = node_ids[0]
+    #: the lying capacity (pps): 3 plain-UDP subscribers of a ~33 pps
+    #: push read as util ≈ 1.65 — far past both high-water marks, while
+    #: every honest peer benches in the tens of thousands
+    weak_cap = 60
+    procs: dict[str, asyncio.subprocess.Process] = {}
+    rtsp_ports: dict[str, int] = {}
+    rest_ports: dict[str, int] = {}
+    here = os.path.abspath(__file__)
+    for nid in node_ids:
+        args = [sys.executable, here, "--cluster-node", "--skewed-child",
+                "--node-id", nid, "--redis-port", str(mini.port)]
+        if nid == weak:
+            args += ["--fault-plan",
+                     f"seed={seed},capacity_spoof={weak_cap}"]
+        p = await asyncio.create_subprocess_exec(
+            *args, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL)
+        procs[nid] = p
+        line = await asyncio.wait_for(p.stdout.readline(), 60)
+        if not line.startswith(b"NODE_READY"):
+            raise RuntimeError(f"{nid} failed to boot: {line!r}")
+        kv = dict(t.split("=") for t in line.decode().split()[1:])
+        rtsp_ports[nid] = int(kv["rtsp"])
+        rest_ports[nid] = int(kv["rest"])
+
+    placement = PlacementService(redis, "soak-harness")
+
+    def _metrics(nid: str) -> dict[str, float]:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest_ports[nid]}/metrics",
+                timeout=5) as r:
+            return parse_metrics(r.read().decode())
+
+    def _fam(m: dict[str, float], prefix: str) -> float:
+        return sum(v for k, v in m.items() if k.startswith(prefix))
+
+    def _refused_total() -> float:
+        return sum(_fam(_metrics(n), "cluster_admission_refused_total")
+                   for n in node_ids)
+
+    # wait until every node publishes a capacity into its lease record
+    # (the control plane is live once caps + utils ride the records)
+    for _ in range(40):
+        nodes = await placement.live_nodes()
+        if len(nodes) == n_nodes and all(
+                isinstance(m.get("cap"), (int, float)) and m["cap"] > 0
+                for m in nodes.values()):
+            break
+        await asyncio.sleep(0.25)
+    else:
+        raise RuntimeError(f"capacity publishing never settled: {nodes}")
+    caps = {n: m["cap"] for n, m in nodes.items()}
+    if min(caps, key=caps.get) != weak:
+        failures.append(f"capacity spoof did not mark {weak} weakest: "
+                        f"{caps}")
+
+    # zipfian popularity: the hot stream carries 3 plain-UDP
+    # subscribers ON THE WEAK NODE (first-come claim — placement is
+    # sticky on the local source), the cold tail one subscriber each on
+    # healthy nodes
+    hot = "/live/hot"
+    colds = [f"/live/cold{i}" for i in range(max(n_nodes - 1, 2))]
+    pushers: dict[str, _ClusterPusher] = {}
+    pushers[hot] = _ClusterPusher(hot, redis, rtsp_ports)
+    await pushers[hot].connect_to(weak)
+    for i, path in enumerate(colds):
+        pushers[path] = _ClusterPusher(path, redis, rtsp_ports)
+        await pushers[path].connect_to(node_ids[1 + i % (n_nodes - 1)])
+    for _ in range(10):                 # prime before anyone subscribes
+        for pu in pushers.values():
+            pu.push()
+        await asyncio.sleep(0.02)
+    await asyncio.sleep(1.5)            # claims + first checkpoints up
+
+    udp_socks: list[socket.socket] = []
+
+    def _udp_pair() -> tuple[socket.socket, socket.socket]:
+        s1 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s1.bind(("127.0.0.1", 0))
+        s1.setblocking(False)
+        s2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s2.bind(("127.0.0.1", 0))
+        s2.setblocking(False)
+        udp_socks.extend((s1, s2))
+        return s1, s2
+
+    async def _udp_join(node: str, path: str
+                        ) -> tuple[RtspClient, socket.socket]:
+        rtp_s, rtcp_s = _udp_pair()
+        c = RtspClient()
+        await c.connect("127.0.0.1", rtsp_ports[node])
+        await c.play_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[node]}{path}", tcp=False,
+            client_ports=[(rtp_s.getsockname()[1],
+                           rtcp_s.getsockname()[1])])
+        return c, rtp_s
+
+    async def _try_play_tcp(port: int, path: str):
+        """One crowd join: ('ok', client) | ('redirect', location) |
+        ('refuse'|'fail', None)."""
+        c = RtspClient()
+        try:
+            await c.connect("127.0.0.1", port)
+            uri = f"rtsp://127.0.0.1:{port}{path}"
+            r = await c.request("DESCRIBE", uri,
+                                {"accept": "application/sdp"})
+            if r.status != 200:
+                await c.close()
+                return ("fail", None)
+            st = sdp_mod.parse(r.body).streams[0]
+            r = await c.request(
+                "SETUP", f"{uri}/trackID={st.track_id}",
+                {"transport": "RTP/AVP/TCP;unicast;interleaved=0-1"})
+            if r.status == 305:
+                loc = r.headers.get("location", "")
+                await c.close()
+                return ("redirect", loc)
+            if r.status != 200:
+                await c.close()
+                return ("refuse" if r.status == 453 else "fail", None)
+            r = await c.request("PLAY", uri)
+            if r.status != 200:
+                await c.close()
+                return ("fail", None)
+            return ("ok", c)
+        except Exception:
+            try:
+                await c.close()
+            except Exception:
+                pass
+            return ("fail", None)
+
+    crowd: list[RtspClient] = []
+    stats: dict = {"weak": weak, "caps": caps, "hot": hot}
+    try:
+        # base audience: 3 UDP subscribers on the hot stream at the weak
+        # owner (the one that must survive the drain without re-SETUP),
+        # one on each cold stream at its own owner
+        gap_player, gap_rtp = await _udp_join(weak, hot)
+        base_udp = [gap_player]
+        for _ in range(2):
+            c, _s = await _udp_join(weak, hot)
+            base_udp.append(c)
+        for path in colds:
+            owner = await placement.claimant(path)
+            c, _s = await _udp_join(owner or pushers[path].target, path)
+            base_udp.append(c)
+
+        t0 = time.time()
+        t_crowd_in, crowd_n = 12.0, 10
+        t_crowd_out = min(seconds * 0.7, 48.0)
+        crowd_started = crowd_done = False
+        crowd_next = t_crowd_in
+        crowd_direct = 0
+        crowd_edge = 0
+        crowd_refused_flat = 0
+        crowd_failed = 0
+        refused_before = refused_after = 0.0
+        drained_at: float | None = None
+        drain_check_at = 0.0
+        #: (t, claimant) transitions of the hot stream — the first thing
+        #: to read when a run fails on end-state balance
+        claimant_log: list[tuple[float, str | None]] = []
+        rx_seqs: list[int] = []
+        rx_ssrcs: set[bytes] = set()
+
+        while time.time() - t0 < seconds:
+            now = time.time() - t0
+            dead: set[str] = set()
+            for pu in pushers.values():
+                if await pu.ensure_connected(dead):
+                    pu.push()
+            while True:
+                try:
+                    d = gap_rtp.recv(65536)
+                except BlockingIOError:
+                    break
+                if len(d) >= 12:
+                    rx_seqs.append(struct.unpack("!H", d[2:4])[0])
+                    rx_ssrcs.add(d[8:12])
+            if not crowd_started and now >= t_crowd_in:
+                crowd_started = True
+                refused_before = _refused_total()
+            if (crowd_started and not crowd_done
+                    and len(crowd) + crowd_failed + crowd_refused_flat
+                    < crowd_n and now >= crowd_next):
+                crowd_next = now + 0.5
+                target = await placement.claimant(hot) or weak
+                verdict, payload = await _try_play_tcp(
+                    rtsp_ports[target], hot)
+                if verdict == "ok":
+                    crowd_direct += 1
+                    crowd.append(payload)
+                elif verdict == "redirect":
+                    # follow the 305 to the placement-resolved edge
+                    try:
+                        hostport = payload.split("//", 1)[1].split("/")[0]
+                        eport = int(hostport.rsplit(":", 1)[1])
+                    except (IndexError, ValueError):
+                        eport = None
+                    v2, c2 = ("fail", None)
+                    if eport is not None:
+                        v2, c2 = await _try_play_tcp(eport, hot)
+                    if v2 == "ok":
+                        crowd_edge += 1
+                        crowd.append(c2)
+                    else:
+                        crowd_failed += 1
+                elif verdict == "refuse":
+                    crowd_refused_flat += 1
+                else:
+                    crowd_failed += 1
+                if (len(crowd) + crowd_failed + crowd_refused_flat
+                        >= crowd_n):
+                    crowd_done = True
+                    refused_after = _refused_total()
+                    stats["crowd_direct"] = crowd_direct
+                    stats["crowd_edge"] = crowd_edge
+            if crowd and now >= t_crowd_out:
+                for c in crowd:
+                    try:
+                        stats.setdefault("crowd_rx", []).append(
+                            c.stats.packets)
+                        await c.close()
+                    except Exception:
+                        pass
+                crowd = []
+            if now >= drain_check_at:
+                drain_check_at = now + 1.0      # scrape at 1 Hz, not per wake
+                cl = await placement.claimant(hot)
+                if not claimant_log or claimant_log[-1][1] != cl:
+                    claimant_log.append((round(now, 1), cl))
+                if drained_at is None:
+                    try:
+                        if _fam(_metrics(weak),
+                                "cluster_rebalance_moves_total") >= 1:
+                            drained_at = now
+                            stats["drained_at"] = round(now, 1)
+                    except Exception:
+                        pass
+            await asyncio.sleep(0.03)
+
+        # ------------------------------------------------------ verdicts
+        if not crowd_done:
+            refused_after = _refused_total()
+        # server-side truth only: the counter delta already includes
+        # every 453 the harness saw (adding crowd_refused_flat on top
+        # would double-count them) plus the 305 redirects
+        refused_during_crowd = int(refused_after - refused_before)
+        gap = _seq_gap(rx_seqs)
+        served = crowd_direct + crowd_edge
+        gain = served / max(crowd_direct, 1)
+        crowd_rx = stats.get("crowd_rx", [])
+        m_weak = _metrics(weak)
+        moves = _fam(m_weak, "cluster_rebalance_moves_total")
+        edges = sum(_fam(_metrics(n), "relay_tree_edges_total")
+                    for n in node_ids if n != weak)
+        if moves < 1:
+            failures.append("the rebalancer never drained the burning "
+                            "node's hottest stream")
+        if drained_at is None and moves >= 1:
+            drained_at = seconds
+        if gap != 0:
+            failures.append(f"sequence gap across the planned drain: "
+                            f"{gap} packets missing at the player socket")
+        if len(rx_ssrcs) != 1:
+            failures.append(f"ssrc changed across the drain: "
+                            f"{len(rx_ssrcs)} identities seen")
+        if len(rx_seqs) < 200:
+            failures.append(f"hot UDP player starved: {len(rx_seqs)}")
+        if refused_during_crowd <= 0:
+            failures.append("zero admission refusals during the flash "
+                            "crowd (the overload gate never fired)")
+        if crowd_edge == 0:
+            failures.append("no crowd subscriber was served through an "
+                            "edge redirect (no relay tree formed)")
+        if edges < 1:
+            failures.append("no origin→edge relay-tree edge was "
+                            "established (relay_tree_edges_total == 0)")
+        if gain <= 1.0:
+            failures.append(f"tree_fanout_gain {gain:.2f} <= 1: the "
+                            "relay tree served no more than the origin")
+        starved = sum(1 for n in crowd_rx if n < 15)
+        if crowd_rx and starved:
+            failures.append(f"{starved}/{len(crowd_rx)} crowd "
+                            "subscribers starved (< 15 pkts via edges)")
+        # end-state balance: nobody burns while a peer idles
+        utils = {}
+        for nid in node_ids:
+            m = _metrics(nid)
+            utils[nid] = m.get("cluster_utilization_ratio", 0.0)
+        hw, half = 0.9, 0.45
+        if any(u >= hw for u in utils.values()) \
+                and any(u < half for u in utils.values()):
+            failures.append(f"a node still burns SLO while a peer sits "
+                            f"under half utilization: {utils}")
+        for nid in node_ids:
+            if procs[nid].returncode is not None:
+                failures.append(f"{nid} died unexpectedly "
+                                f"(rc={procs[nid].returncode})")
+        stats.update({
+            "udp_rx": len(rx_seqs),
+            "rebalance_moves": moves,
+            "relay_tree_edges": edges,
+            "hot_claimant": await placement.claimant(hot),
+            "migrations": {n: _fam(_metrics(n),
+                                   "cluster_migrations_total")
+                           for n in node_ids},
+            "lease_lost": {n: _fam(_metrics(n),
+                                   "cluster_lease_lost_total")
+                           for n in node_ids},
+            "refused_during_crowd": refused_during_crowd,
+            "utils": {k: round(v, 3) for k, v in utils.items()},
+            "pusher_reconnects": {p: pu.reconnects
+                                  for p, pu in pushers.items()},
+            "claimant_log": claimant_log,
+            # the bench extra.rebalance shape bench_gate --check-only
+            # validates: {rebalance_gap_packets == 0,
+            # refused_during_crowd > 0, tree_fanout_gain > 1}
+            "rebalance": {
+                "rebalance_gap_packets": gap,
+                "refused_during_crowd": refused_during_crowd,
+                "tree_fanout_gain": round(gain, 2),
+            },
+        })
+        if failures:
+            # post-mortem: every node's cluster.* event tail — the
+            # claimant_log says WHEN the hot stream moved, these say WHY
+            for nid in node_ids:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{rest_ports[nid]}"
+                            f"/api/v1/admin?command=events&n=512",
+                            timeout=5) as r:
+                        lines = r.read().decode().splitlines()
+                    for ln in lines:
+                        if '"cluster.' in ln or '"pull.' in ln:
+                            print(f"EV {nid} {ln}", file=sys.stderr)
+                except Exception:
+                    pass
+        print("SOAK SKEWED", "FAIL" if failures else "OK",
+              _json.dumps(stats))
+        for msg in failures:
+            print("  -", msg)
+    finally:
+        for c in crowd:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for nid, p in procs.items():
+            if p.returncode is None:
+                p.kill()
+        for p in procs.values():
+            try:
+                await asyncio.wait_for(p.wait(), 10)
+            except asyncio.TimeoutError:
+                pass
+        await redis.close()
+        await mini.stop()
+        for s in udp_socks:
+            s.close()
+    return 1 if failures else 0
+
+
 def _parse_args(argv: list[str]):
     import argparse
     ap = argparse.ArgumentParser(
@@ -1886,9 +2304,22 @@ def _parse_args(argv: list[str]):
                          "churn, a flash-crowd wave, and a seeded "
                          "owner SIGKILL that must recover via live "
                          "session migration (ISSUE 6)")
-    # hidden child-process mode (spawned by --cluster)
+    ap.add_argument("--skewed", type=int, default=0, metavar="N",
+                    help="load-aware control-plane scenario (ISSUE 13): "
+                         "N server processes + mini Redis with ONE "
+                         "node's capacity forced low via the "
+                         "capacity_spoof fault site, a zipfian stream "
+                         "popularity curve and a flash crowd on the "
+                         "hottest stream; asserts admission "
+                         "refusals/redirects during the crowd, an "
+                         "origin→edge relay tree serving the crowd, "
+                         "and a gapless proactive rebalance drain")
+    # hidden child-process mode (spawned by --cluster / --skewed)
     ap.add_argument("--cluster-node", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--skewed-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--fault-plan", default="", help=argparse.SUPPRESS)
     ap.add_argument("--node-id", default="", help=argparse.SUPPRESS)
     ap.add_argument("--redis-port", type=int, default=0,
                     help=argparse.SUPPRESS)
@@ -1924,11 +2355,16 @@ if __name__ == "__main__":
                 f"{max(_ns.devices, 8)}").strip()
     if _ns.cluster_node:
         raise SystemExit(asyncio.run(
-            _cluster_node_main(_ns.node_id, _ns.redis_port)))
+            _cluster_node_main(_ns.node_id, _ns.redis_port,
+                               _ns.fault_plan, _ns.skewed_child)))
     if _ns.cluster:
         raise SystemExit(asyncio.run(
             cluster_soak(_ns.cluster, _ns.duration,
                          _ns.chaos if _ns.chaos is not None else 7)))
+    if _ns.skewed:
+        raise SystemExit(asyncio.run(
+            skewed_soak(_ns.skewed, _ns.duration,
+                        _ns.chaos if _ns.chaos is not None else 7)))
     raise SystemExit(asyncio.run(soak(_ns.duration, _ns.sources,
                                       _ns.chaos, _ns.devices,
                                       _ns.egress_backend,
