@@ -14,6 +14,7 @@
 #include <sys/resource.h>
 #include <sys/socket.h>
 #include <sys/syscall.h>
+#include <sys/uio.h>
 #include <unistd.h>
 #include <vector>
 
@@ -62,7 +63,8 @@ struct StatCells {
       bytes_to_wire{0}, recvmmsg_calls{0}, recv_datagrams{0}, recv_bytes{0},
       oversize_dropped{0}, send_ns{0}, ingest_ns{0}, stage_gather_ns{0},
       staged_bytes{0}, fault_injections{0}, uring_sqes{0}, uring_cqes{0},
-      uring_submits{0}, uring_zc_completions{0}, uring_zc_copied{0};
+      uring_submits{0}, uring_zc_completions{0}, uring_zc_copied{0},
+      stream_writev_calls{0}, stream_packets{0}, stream_bytes{0};
 };
 StatCells g_stat;
 
@@ -179,6 +181,10 @@ void ed_get_stats(ed_stats *out) {
       g_stat.uring_zc_completions.load(std::memory_order_relaxed);
   out->uring_zc_copied =
       g_stat.uring_zc_copied.load(std::memory_order_relaxed);
+  out->stream_writev_calls =
+      g_stat.stream_writev_calls.load(std::memory_order_relaxed);
+  out->stream_packets = g_stat.stream_packets.load(std::memory_order_relaxed);
+  out->stream_bytes = g_stat.stream_bytes.load(std::memory_order_relaxed);
 }
 
 // Correct by construction: adding an ed_stats field updates this
@@ -211,6 +217,9 @@ void ed_reset_stats(void) {
   g_stat.uring_submits.store(0, std::memory_order_relaxed);
   g_stat.uring_zc_completions.store(0, std::memory_order_relaxed);
   g_stat.uring_zc_copied.store(0, std::memory_order_relaxed);
+  g_stat.stream_writev_calls.store(0, std::memory_order_relaxed);
+  g_stat.stream_packets.store(0, std::memory_order_relaxed);
+  g_stat.stream_bytes.store(0, std::memory_order_relaxed);
 }
 
 void ed_fault_set(int64_t eagain_every, int64_t enobufs_every,
@@ -598,6 +607,153 @@ int32_t ed_scalar_baseline_send(int fd, const uint8_t *ring_data,
     }
   }
   return n_ops;
+}
+
+// ---------------------------------------------------------- stream egress
+// Framed interleaved egress (ISSUE 14): the 4-byte $-channel frame is
+// affine in (len, channel) exactly as the RTP header is affine in the
+// rewrite params, so one renderer emits [frame | header] per packet and
+// writev scatters it with the shared payload — the stream sibling of
+// the sendmmsg path.  A short write tears at a BYTE boundary (TCP is a
+// byte sequence), reported via *partial_bytes_out so the caller can
+// finish the torn packet through its buffered transport.
+int32_t ed_stream_send(int fd, const uint8_t *ring_data,
+                       const int32_t *ring_len, int32_t capacity,
+                       int32_t slot_size, uint32_t seq_off,
+                       uint32_t ts_off, uint32_t ssrc, int32_t channel,
+                       const int32_t *slots, int32_t n_slots,
+                       int32_t *partial_bytes_out) {
+  g_stop_errno = 0;
+  if (partial_bytes_out) *partial_bytes_out = 0;
+  if (n_slots <= 0) return 0;
+  if (channel < 0 || channel > 255) return -EINVAL;
+  StatTimer timer(g_stat.send_ns);
+  constexpr int kStreamBatch = 256;       // 512 iovecs < IOV_MAX (1024)
+  std::vector<iovec> iovs(static_cast<size_t>(kStreamBatch) * 2);
+  std::vector<uint8_t> hdrs(static_cast<size_t>(kStreamBatch) * 16);
+  std::vector<int32_t> plens(kStreamBatch);  // framed length per packet
+  std::vector<iovec> window(static_cast<size_t>(kStreamBatch) * 2);
+  int32_t done = 0;
+  while (done < n_slots) {
+    int batch = 0;
+    size_t batch_bytes = 0;
+    for (; batch < kStreamBatch && done + batch < n_slots; ++batch) {
+      int32_t slot = slots[done + batch];
+      if (slot < 0 || slot >= capacity) {
+        g_stop_errno = EINVAL;
+        return done > 0 ? done : -EINVAL;
+      }
+      const uint8_t *pkt = ring_data + static_cast<size_t>(slot) * slot_size;
+      int32_t len = ring_len[slot];
+      if (len < 12 || len > slot_size || len > 0xFFFF) {
+        g_stop_errno = EINVAL;
+        return done > 0 ? done : -EINVAL;
+      }
+      uint8_t *h = hdrs.data() + static_cast<size_t>(batch) * 16;
+      h[0] = 0x24;  // '$'
+      h[1] = static_cast<uint8_t>(channel);
+      h[2] = static_cast<uint8_t>(len >> 8);
+      h[3] = static_cast<uint8_t>(len);
+      render_header(h + 4, pkt, seq_off, ts_off, ssrc);
+      iovec *iv = &iovs[static_cast<size_t>(batch) * 2];
+      iv[0].iov_base = h;
+      iv[0].iov_len = 16;
+      iv[1].iov_base = const_cast<uint8_t *>(pkt) + 12;
+      iv[1].iov_len = static_cast<size_t>(len - 12);
+      plens[batch] = len + 4;
+      batch_bytes += static_cast<size_t>(len) + 4;
+    }
+    size_t written = 0;
+    for (;;) {
+      int ferr = fault_egress_gate();
+      if (ferr) {
+        g_stop_errno = ferr;
+        stat_add(g_stat.stream_writev_calls, 1);
+        note_send_stop(ferr);
+        break;
+      }
+      // iovec window starting at `written` (rebuilt only on retry after
+      // a partial write — the hot path runs this once per batch)
+      size_t skip = written;
+      size_t first = 0;
+      const size_t n_iov = static_cast<size_t>(batch) * 2;
+      while (first < n_iov && skip >= iovs[first].iov_len)
+        skip -= iovs[first++].iov_len;
+      if (first >= n_iov) break;           // batch fully written
+      size_t n_cur = n_iov - first;
+      for (size_t i = 0; i < n_cur; ++i) window[i] = iovs[first + i];
+      window[0].iov_base = static_cast<uint8_t *>(window[0].iov_base) + skip;
+      window[0].iov_len -= skip;
+      ssize_t w = writev(fd, window.data(), static_cast<int>(n_cur));
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        g_stop_errno = errno;
+        stat_add(g_stat.stream_writev_calls, 1);
+        note_send_stop(errno);
+        break;
+      }
+      stat_add(g_stat.stream_writev_calls, 1);
+      stat_add(g_stat.stream_bytes, w);
+      written += static_cast<size_t>(w);
+      if (written >= batch_bytes) break;
+      // short write on a non-blocking stream socket: the send buffer is
+      // full — stop with flow-control semantics instead of spinning
+      // into a guaranteed EAGAIN
+      g_stop_errno = EAGAIN;
+      stat_add(g_stat.eagain_stops, 1);
+      break;
+    }
+    int full = 0;
+    size_t acc = 0;
+    while (full < batch && acc + static_cast<size_t>(plens[full]) <= written) {
+      acc += static_cast<size_t>(plens[full]);
+      ++full;
+    }
+    if (full) stat_add(g_stat.stream_packets, full);
+    done += full;
+    if (written < batch_bytes || g_stop_errno) {
+      if (partial_bytes_out)
+        *partial_bytes_out = static_cast<int32_t>(written - acc);
+      if (done == 0 && written == 0 && g_stop_errno &&
+          g_stop_errno != EAGAIN && g_stop_errno != EWOULDBLOCK)
+        return -g_stop_errno;
+      return done;
+    }
+  }
+  return done;
+}
+
+int64_t ed_stream_write(int fd, const uint8_t *buf, int64_t len) {
+  g_stop_errno = 0;
+  if (len <= 0) return 0;
+  StatTimer timer(g_stat.send_ns);
+  int64_t written = 0;
+  while (written < len) {
+    int ferr = fault_egress_gate();
+    if (ferr) {
+      g_stop_errno = ferr;
+      stat_add(g_stat.stream_writev_calls, 1);
+      note_send_stop(ferr);
+      break;
+    }
+    ssize_t w = send(fd, buf + written,
+                     static_cast<size_t>(len - written), MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      g_stop_errno = errno;
+      stat_add(g_stat.stream_writev_calls, 1);
+      note_send_stop(errno);
+      break;
+    }
+    stat_add(g_stat.stream_writev_calls, 1);
+    stat_add(g_stat.stream_bytes, w);
+    written += w;
+    if (w == 0) break;
+  }
+  if (written == 0 && g_stop_errno && g_stop_errno != EAGAIN &&
+      g_stop_errno != EWOULDBLOCK)
+    return -g_stop_errno;
+  return written;
 }
 
 int32_t ed_fanout_render(const uint8_t *ring_data, const int32_t *ring_len,
@@ -1472,6 +1628,183 @@ int32_t ed_uring_send_multi(ed_uring *u, const uint8_t *ring_data,
     total += r;
   }
   return static_cast<int32_t>(total);
+}
+
+// One SEND SQE over the FIRST `chunk` bytes of the ring's arena: a TCP
+// stream is a byte sequence, so one send of N framed packets is
+// wire-identical to per-packet writes — and a short completion is
+// simply a byte count, with none of the torn-chain hazard linked
+// per-packet SQEs would have (a partial SENDMSG counts as SUCCESS and
+// would not cancel its link).  `fd` rides the SQE itself, so one
+// shared ring serves every stream socket.  The caller renders/copies
+// into the arena BEFORE the call; this submits without touching the
+// bytes.  Returns bytes the kernel took, or -errno when nothing was.
+static int64_t uring_arena_submit(ed_uring *u, int fd, size_t chunk) {
+  int ferr = fault_egress_gate();
+  if (ferr) {
+    g_stop_errno = ferr;
+    stat_add(g_stat.uring_submits, 1);
+    note_send_stop(ferr);
+    return ferr == EAGAIN ? 0 : -ferr;
+  }
+  iovec *iv = &u->iovs[0];
+  iv->iov_base = u->arena.data();
+  iv->iov_len = chunk;
+  msghdr &m = u->msgs[0];
+  std::memset(&m, 0, sizeof(m));
+  m.msg_iov = iv;
+  m.msg_iovlen = 1;
+  EdSqe *sqe = get_sqe(u);
+  if (!sqe) {
+    g_stop_errno = EBUSY;
+    return -EBUSY;
+  }
+  sqe->opcode = kOpSendmsg;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(&m);
+  sqe->op_flags = MSG_DONTWAIT | MSG_NOSIGNAL;
+  sqe->user_data = 0xEDu;
+  int32_t res = INT32_MIN;
+  auto on_cqe = [&](const EdCqe &cqe) {
+    if (cqe.flags & kCqeFNotif) {
+      u->zc_pending--;
+      stat_add(g_stat.uring_zc_completions, 1);
+      return;
+    }
+    if (cqe.user_data == 0xEDu && res == INT32_MIN) res = cqe.res;
+  };
+  int sr = submit(u, u->sqpoll ? 0 : 1);
+  if (sr < 0) {
+    g_stop_errno = -sr;
+    note_send_stop(-sr);
+    return sr;
+  }
+  int rr = reap_until(u, on_cqe, [&] { return res != INT32_MIN; });
+  if (rr < 0) {
+    g_stop_errno = -rr;
+    note_send_stop(-rr);
+    return rr;
+  }
+  if (res < 0) {
+    g_stop_errno = -res;
+    note_send_stop(-res);
+    if (res == -EAGAIN || res == -EWOULDBLOCK) return 0;
+    return res;
+  }
+  stat_add(g_stat.stream_bytes, res);
+  if (static_cast<size_t>(res) < chunk) {
+    // short completion: stream send buffer full — flow control
+    g_stop_errno = EAGAIN;
+    stat_add(g_stat.eagain_stops, 1);
+  }
+  return res;
+}
+
+// External byte blob (HLS bodies): the one copy into the arena is
+// unavoidable — the source buffer is not ours to register.
+static int64_t uring_blob_send(ed_uring *u, int fd, const uint8_t *buf,
+                               int64_t len) {
+  if (!u || u->ingest) return -EINVAL;
+  g_stop_errno = 0;
+  if (len <= 0) return 0;
+  StatTimer timer(g_stat.send_ns);
+  const size_t arena_cap = u->arena.size();
+  int64_t written = 0;
+  while (written < len) {
+    size_t chunk = std::min<size_t>(arena_cap,
+                                    static_cast<size_t>(len - written));
+    std::memcpy(u->arena.data(), buf + written, chunk);
+    int64_t r = uring_arena_submit(u, fd, chunk);
+    if (r < 0) break;
+    written += r;
+    if (static_cast<size_t>(r) < chunk) break;   // flow control
+  }
+  if (written == 0 && g_stop_errno && g_stop_errno != EAGAIN &&
+      g_stop_errno != EWOULDBLOCK)
+    return -g_stop_errno;
+  return written;
+}
+
+int32_t ed_uring_stream_send(ed_uring *u, int fd,
+                             const uint8_t *ring_data,
+                             const int32_t *ring_len, int32_t capacity,
+                             int32_t slot_size, uint32_t seq_off,
+                             uint32_t ts_off, uint32_t ssrc,
+                             int32_t channel, const int32_t *slots,
+                             int32_t n_slots,
+                             int32_t *partial_bytes_out) {
+  if (partial_bytes_out) *partial_bytes_out = 0;
+  if (!u || u->ingest) return -EINVAL;
+  if (n_slots <= 0) return 0;
+  if (channel < 0 || channel > 255) return -EINVAL;
+  for (int32_t i = 0; i < n_slots; ++i) {
+    int32_t slot = slots[i];
+    if (slot < 0 || slot >= capacity) return -EINVAL;
+    int32_t len = ring_len[slot];
+    if (len < 12 || len > slot_size || len > 0xFFFF) return -EINVAL;
+  }
+  g_stop_errno = 0;
+  StatTimer timer(g_stat.send_ns);
+  // render framed packets DIRECTLY into the ring's arena, one
+  // packet-boundary chunk per SEND SQE (no intermediate blob — the
+  // payload bytes move once, ring → arena)
+  const size_t arena_cap = u->arena.size();
+  int32_t full = 0;
+  int64_t partial = 0;
+  int32_t i = 0;
+  while (i < n_slots) {
+    size_t chunk = 0;
+    int32_t first = i;
+    for (; i < n_slots; ++i) {
+      int32_t slot = slots[i];
+      const uint8_t *pkt = ring_data + static_cast<size_t>(slot) * slot_size;
+      int32_t len = ring_len[slot];
+      size_t framed = static_cast<size_t>(len) + 4;
+      if (chunk + framed > arena_cap) {
+        if (chunk == 0) {           // one packet larger than the arena
+          g_stop_errno = EINVAL;
+          return full > 0 ? full : -EINVAL;
+        }
+        break;                      // chunk full: submit what we have
+      }
+      uint8_t *h = u->arena.data() + chunk;
+      h[0] = 0x24;
+      h[1] = static_cast<uint8_t>(channel);
+      h[2] = static_cast<uint8_t>(len >> 8);
+      h[3] = static_cast<uint8_t>(len);
+      render_header(h + 4, pkt, seq_off, ts_off, ssrc);
+      std::memcpy(h + 16, pkt + 12, static_cast<size_t>(len - 12));
+      chunk += framed;
+    }
+    int64_t w = uring_arena_submit(u, fd, chunk);
+    if (w < 0) {
+      if (full > 0) return full;
+      if (partial_bytes_out) *partial_bytes_out = 0;
+      return static_cast<int32_t>(w);
+    }
+    // walk the chunk's packets past the bytes the kernel took
+    size_t acc = 0;
+    int32_t j = first;
+    while (j < i) {
+      size_t framed = static_cast<size_t>(ring_len[slots[j]]) + 4;
+      if (acc + framed > static_cast<size_t>(w)) break;
+      acc += framed;
+      ++j;
+    }
+    full += j - first;
+    partial = w - static_cast<int64_t>(acc);
+    if (static_cast<size_t>(w) < chunk) break;   // flow control stop
+    partial = 0;
+  }
+  if (full) stat_add(g_stat.stream_packets, full);
+  if (partial_bytes_out)
+    *partial_bytes_out = static_cast<int32_t>(partial);
+  return full;
+}
+
+int64_t ed_uring_stream_write(ed_uring *u, int fd, const uint8_t *buf,
+                              int64_t len) {
+  return uring_blob_send(u, fd, buf, len);
 }
 
 }  // extern "C"

@@ -78,6 +78,13 @@ typedef struct {
   int64_t uring_zc_copied;  /* ZC notifs reporting the kernel copied
                              * anyway (expected on loopback — counted,
                              * never hidden) */
+  /* Stream-socket egress tail (fifth ABI bump, fields 23-25; ISSUE 14):
+   * the framed interleave/HTTP-body writers behind the TCP delivery
+   * tier.  ed_stats_fields() now reports 25. */
+  int64_t stream_writev_calls; /* writev(2)/send syscalls on stream fds */
+  int64_t stream_packets;   /* framed packets fully written to streams */
+  int64_t stream_bytes;     /* bytes written to stream sockets (framing
+                             * included; partial-write bytes count) */
 } ed_stats;
 
 void ed_get_stats(ed_stats *out);
@@ -178,6 +185,36 @@ int32_t ed_fanout_send_multi(int fd, const uint8_t *ring_data,
                              int32_t n_outs, const ed_sendop *ops,
                              int32_t n_ops, int32_t use_gso);
 
+/* Framed interleaved-RTSP egress onto ONE stream (TCP) socket
+ * (ISSUE 14).  For each slot in `slots`: renders the 4-byte interleave
+ * frame ($ | channel | be16 packet-length) plus the 12-byte rewritten
+ * RTP header into a scratch arena and writes
+ * [frame | header | payload(12..len)] through writev(2) in IOV_MAX-
+ * bounded batches — the stream sibling of ed_fanout_send_udp (one
+ * affine render at memory bandwidth, no per-packet caller work, payload
+ * bytes never copied).
+ *
+ * Returns the count of packets FULLY written.  *partial_bytes_out
+ * reports how many bytes of the NEXT packet (index = return value) are
+ * already on the wire when a short write tore it — the caller MUST
+ * deliver that packet's remaining bytes before anything else on the
+ * connection (the engine hands them to the buffered transport, which
+ * then owns ordering).  EAGAIN stops the batch (bookmark replay);
+ * negative errno only when nothing was written and the stop was hard.
+ * ed_last_send_errno() explains any short return. */
+int32_t ed_stream_send(int fd, const uint8_t *ring_data,
+                       const int32_t *ring_len, int32_t capacity,
+                       int32_t slot_size, uint32_t seq_off,
+                       uint32_t ts_off, uint32_t ssrc, int32_t channel,
+                       const int32_t *slots, int32_t n_slots,
+                       int32_t *partial_bytes_out);
+
+/* Plain byte-blob write to a stream socket through the same accounting
+ * (HLS segment bodies ride the egress ladder too).  Returns bytes
+ * written (possibly short on EAGAIN), or negative errno when nothing
+ * was written and the stop was hard. */
+int64_t ed_stream_write(int fd, const uint8_t *buf, int64_t len);
+
 /* ----------------------------------------------------- io_uring backend */
 
 /* Capability bits reported by ed_uring_probe() (>= 0) and
@@ -244,6 +281,28 @@ int32_t ed_uring_send_multi(ed_uring *u, const uint8_t *ring_data,
                             int32_t n_src, int32_t param_stride,
                             const ed_dest *dest, int32_t n_outs,
                             const ed_sendop *ops, int32_t n_ops);
+
+/* ed_stream_send's contract over an io_uring ring: the whole framed
+ * batch is rendered into the ring's registered arena as ONE contiguous
+ * byte blob and submitted as a single SEND SQE per arena-sized chunk —
+ * a TCP stream is a byte sequence, so one send of N framed packets is
+ * wire-identical to N writes, and a short completion is simply a byte
+ * count (no torn-chain hazard).  `fd` is the TARGET stream socket (SQEs
+ * carry their own fd; the ring's bound socket is not used).  Same
+ * return/partial contract as ed_stream_send. */
+int32_t ed_uring_stream_send(ed_uring *u, int fd,
+                             const uint8_t *ring_data,
+                             const int32_t *ring_len, int32_t capacity,
+                             int32_t slot_size, uint32_t seq_off,
+                             uint32_t ts_off, uint32_t ssrc,
+                             int32_t channel, const int32_t *slots,
+                             int32_t n_slots,
+                             int32_t *partial_bytes_out);
+
+/* One byte blob through a single SEND SQE per chunk (HLS bodies on the
+ * io_uring rung).  Returns bytes written or negative errno. */
+int64_t ed_uring_stream_write(ed_uring *u, int fd, const uint8_t *buf,
+                              int64_t len);
 
 /* Multishot-recvmsg ingest ring for one UDP socket: a provided-buffer
  * pool of `max_pkt`-sized slots and one persistent multishot RECVMSG

@@ -208,6 +208,18 @@ class HlsOutput(RelayOutput):
         # rolling bitrate observation for the master playlist
         self._obs_bytes = 0
         self._obs_sec = 0.0
+        # serving-side caches (ISSUE 14): the playlist text is rebuilt
+        # only when a segment is cut/evicted (keyed by window identity),
+        # and segment bodies are served by reference — the counters pin
+        # the zero-per-request-copy property in the regression tests
+        self._playlist_cache: tuple | None = None  # (key, base, text)
+        self.playlist_builds = 0
+        #: per-OUTPUT generation token baked into every ETag: media_seq
+        #: and segment numbering restart from 0 on a server restart or
+        #: stream re-publish, so counter-only tags would let a surviving
+        #: player revalidate stale bytes with a false 304
+        import secrets as _secrets
+        self.etag_gen = _secrets.token_hex(4)
 
     def send_bytes(self, data: bytes, *, is_rtcp: bool) -> WriteResult:
         if is_rtcp:
@@ -326,7 +338,21 @@ class HlsOutput(RelayOutput):
             self.media_seq += 1
 
     # -- serving -----------------------------------------------------------
+    def playlist_key(self) -> tuple:
+        """Identity of the current sliding window — the playlist text
+        (and its ETag) is a pure function of this."""
+        return (self.media_seq, len(self.segments),
+                self.segments[-1].seq if self.segments else -1)
+
     def playlist(self, base_url: str = "") -> str:
+        """The live m3u8 — rebuilt only when the window changed (a
+        per-request rebuild was O(window) string work on every GET of
+        every player; the cache returns the SAME str object, which the
+        regression tests pin)."""
+        key = (self.playlist_key(), base_url)
+        cached = self._playlist_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
         lines = ["#EXTM3U", "#EXT-X-VERSION:7",
                  f"#EXT-X-TARGETDURATION:{int(self.target_duration + 1)}",
                  f"#EXT-X-MEDIA-SEQUENCE:{self.media_seq}",
@@ -334,9 +360,14 @@ class HlsOutput(RelayOutput):
         for s in self.segments:
             lines.append(f"#EXTINF:{s.duration_sec:.3f},")
             lines.append(f"{base_url}seg{s.seq}.m4s")
-        return "\n".join(lines) + "\n"
+        text = "\n".join(lines) + "\n"
+        self._playlist_cache = (key, text)
+        self.playlist_builds += 1
+        return text
 
     def get_segment(self, seq: int) -> bytes | None:
+        """Served BY REFERENCE — a cut segment is immutable, so every
+        GET shares the one bytes object (zero per-request copies)."""
         for s in self.segments:
             if s.seq == seq:
                 return s.data
@@ -593,10 +624,14 @@ class HlsService:
             lines.append(f"{name}/index.m3u8" if name else "index.m3u8")
         return "\n".join(lines) + "\n"
 
-    def serve(self, url_path: str) -> tuple[str, bytes | str] | None:
+    def serve(self, url_path: str
+              ) -> tuple[str, bytes | str, str | None] | None:
         """Resolve ``/hls/<stream-path>[/rN]/<file>`` → (content_type,
-        body).  ``master.m3u8`` auto-starts the default temporal ladder;
-        a rendition playlist auto-starts just that rendition."""
+        body, etag).  ``master.m3u8`` auto-starts the default temporal
+        ladder; a rendition playlist auto-starts just that rendition.
+        ``etag`` (None = uncacheable) lets the REST layer short-circuit
+        repeat GETs with 304 — playlists carry a weak window-identity
+        tag, segments a strong one (a cut segment is immutable)."""
         if not url_path.startswith("/hls/"):
             return None
         rest = url_path[5:]
@@ -636,21 +671,28 @@ class HlsService:
             return None
         if fname == "master.m3u8":
             return ("application/vnd.apple.mpegurl",
-                    self.master_playlist(entry))
+                    self.master_playlist(entry), None)
         out = entry.renditions.get(rendition)
         if out is None:
             return None
+        gen = out.etag_gen
         if fname in ("index.m3u8", "playlist.m3u8"):
-            return ("application/vnd.apple.mpegurl", out.playlist())
+            pk = out.playlist_key()
+            return ("application/vnd.apple.mpegurl", out.playlist(),
+                    f'W/"pl-{gen}-{pk[0]}-{pk[1]}-{pk[2]}"')
         if fname == "init.mp4":
             if out.init_segment is None:
                 return None
-            return ("video/mp4", out.init_segment)
+            return ("video/mp4", out.init_segment,
+                    f'"init-{gen}-{len(out.init_segment)}"')
         if fname.startswith("seg") and fname.endswith(".m4s"):
             try:
                 seq = int(fname[3:-4])
             except ValueError:
                 return None
             data = out.get_segment(seq)
-            return ("video/iso.segment", data) if data is not None else None
+            if data is None:
+                return None
+            return ("video/iso.segment", data,
+                    f'"seg-{gen}-{seq}-{len(data)}"')
         return None

@@ -179,8 +179,8 @@ def megabatch_window_step(window, out_state):
 
     ``window``: [B, P, 96+4] uint8 (``ops.staging`` fused rows, pow2-
     padded in every dimension) · ``out_state``: [B, S, STATE_COLS]
-    uint32 → packed egress params [B, 3·S + 1] uint32
-    (``seq_off[S] ∥ ts_off[S] ∥ ssrc[S] ∥ newest_keyframe``).
+    uint32 → packed egress params [B, 4·S + 1] uint32
+    (``seq_off[S] ∥ ts_off[S] ∥ ssrc[S] ∥ chan[S] ∥ newest_keyframe``).
 
     The window buffer is donated; XLA's "donated buffer was not usable"
     warning is filtered ONCE at import (below) because the uint8 input
@@ -243,23 +243,25 @@ def scatter_affine_segments(packed, n_subs):
     """Segment scatter: split one stacked packed result back into
     per-stream affine param sets.
 
-    ``packed``: the [B, 3·S_pad + 1] device result (any array-like) ·
+    ``packed``: the [B, 4·S_pad + 1] device result (any array-like) ·
     ``n_subs``: per-stream REAL subscriber counts (<= S_pad; extra rows
     beyond ``len(n_subs)`` are bucket padding and ignored).  Returns one
-    ``(seq_off[1, n], ts_off[1, n], ssrc[1, n], newest_kf)`` tuple per
-    stream — the exact ``TpuFanoutEngine._params`` shape, contiguous, so
-    the scheduler can install them without further massaging.
-    ``newest_kf`` is the per-stream newest-keyframe SLOT index within the
-    staged rows (-1 = none; the uint32 wire sentinel wraps back here)."""
+    ``(seq_off[1, n], ts_off[1, n], ssrc[1, n], chan[1, n], newest_kf)``
+    tuple per stream — the exact ``TpuFanoutEngine._params`` shape,
+    contiguous, so the scheduler can install them without further
+    massaging.  ``newest_kf`` is the per-stream newest-keyframe SLOT
+    index within the staged rows (-1 = none; the uint32 wire sentinel
+    wraps back here)."""
     arr = np.asarray(packed)
-    s_pad = (arr.shape[1] - 1) // 3
+    s_pad = (arr.shape[1] - 1) // 4
     out = []
     for row, n in zip(arr, n_subs):
         out.append((
             np.ascontiguousarray(row[None, 0:n]),
             np.ascontiguousarray(row[None, s_pad:s_pad + n]),
             np.ascontiguousarray(row[None, 2 * s_pad:2 * s_pad + n]),
-            int(row[3 * s_pad].astype(np.int32))))
+            np.ascontiguousarray(row[None, 3 * s_pad:3 * s_pad + n]),
+            int(row[4 * s_pad].astype(np.int32))))
     return out
 
 
@@ -320,8 +322,8 @@ def _pipeline_step(prefix, length, age_ms, out_state, buckets, *,
                  & (length >= 12)[None, :]),
     }
     if mode == "affine":
-        (out["seq_off"], out["ts_off"],
-         out["ssrc"]) = fanout_ops.affine_params(out_state)
+        (out["seq_off"], out["ts_off"], out["ssrc"],
+         out["chan"]) = fanout_ops.affine_params(out_state)
     else:
         out["headers"] = fanout_ops.fanout_headers(
             prefix[:, :2], fields["seq"], fields["timestamp"], out_state)

@@ -49,7 +49,9 @@ _STAT_FIELDS = ("sendmmsg_calls", "sendto_calls", "send_packets",
                 "recv_datagrams", "recv_bytes", "oversize_dropped",
                 "send_ns", "ingest_ns", "stage_gather_ns", "staged_bytes",
                 "fault_injections", "uring_sqes", "uring_cqes",
-                "uring_submits", "uring_zc_completions", "uring_zc_copied")
+                "uring_submits", "uring_zc_completions", "uring_zc_copied",
+                # stream-socket egress tail (fifth ABI bump, ISSUE 14)
+                "stream_writev_calls", "stream_packets", "stream_bytes")
 
 #: capability bits reported by ``uring_probe()`` (csrc ED_URING_CAP_*)
 URING_CAP_RING = 1
@@ -198,6 +200,22 @@ def _load():
             u32p, u32p, u32p, ctypes.c_int32, ctypes.c_int32,
             ctypes.POINTER(Dest), ctypes.c_int32, ctypes.POINTER(SendOp),
             ctypes.c_int32]
+        # stream-socket egress (ISSUE 14): framed interleave + byte blobs
+        lib.ed_stream_send.restype = ctypes.c_int32
+        lib.ed_stream_send.argtypes = [
+            ctypes.c_int, u8p, i32p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_int32, i32p, ctypes.c_int32, i32p]
+        lib.ed_stream_write.restype = ctypes.c_int64
+        lib.ed_stream_write.argtypes = [ctypes.c_int, u8p, ctypes.c_int64]
+        lib.ed_uring_stream_send.restype = ctypes.c_int32
+        lib.ed_uring_stream_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, u8p, i32p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_int32, i32p, ctypes.c_int32, i32p]
+        lib.ed_uring_stream_write.restype = ctypes.c_int64
+        lib.ed_uring_stream_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, u8p, ctypes.c_int64]
         lib.ed_uring_ingest_new.restype = ctypes.c_void_p
         lib.ed_uring_ingest_new.argtypes = [
             ctypes.c_int, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
@@ -362,6 +380,83 @@ class UringEgress:
             span_args["trace_id"] = trace_id
         TRACER.end("native.egress", t0, cat="native", **span_args)
         return int(r)
+
+    def stream_send(self, fd: int, ring_data: np.ndarray,
+                    ring_len: np.ndarray, seq_off: int, ts_off: int,
+                    ssrc: int, channel: int, slots: np.ndarray,
+                    *, trace_id: str | None = None) -> tuple[int, int]:
+        """``native.stream_send``'s contract over the ring: the framed
+        batch rides one SEND SQE per arena-sized chunk (``fd`` is the
+        TARGET stream socket — SQEs carry their own fd, so one shared
+        ring serves every TCP connection)."""
+        assert self._h, "closed"
+        assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+        slots32 = np.ascontiguousarray(slots, np.int32)
+        partial = ctypes.c_int32(0)
+        t0 = TRACER.begin()
+        r = self._lib.ed_uring_stream_send(
+            self._h, fd, _u8(ring_data),
+            _i32(np.ascontiguousarray(ring_len, np.int32)),
+            ring_data.shape[0], ring_data.shape[1],
+            seq_off & 0xFFFFFFFF, ts_off & 0xFFFFFFFF, ssrc & 0xFFFFFFFF,
+            channel, _i32(slots32), len(slots32), ctypes.byref(partial))
+        span_args = {"ops": int(len(slots32)), "sent": int(r),
+                     "backend": "io_uring"}
+        if trace_id is not None:
+            span_args["trace_id"] = trace_id
+        TRACER.end("native.stream_egress", t0, cat="native", **span_args)
+        return int(r), partial.value
+
+    def stream_write(self, fd: int, data) -> int:
+        """One byte blob through the ring (HLS bodies on the io_uring
+        rung).  Returns bytes written or negative errno."""
+        assert self._h, "closed"
+        buf = np.frombuffer(data, dtype=np.uint8)
+        return int(self._lib.ed_uring_stream_write(self._h, fd, _u8(buf),
+                                                   len(buf)))
+
+
+def stream_send(fd: int, ring_data: np.ndarray, ring_len: np.ndarray,
+                seq_off: int, ts_off: int, ssrc: int, channel: int,
+                slots: np.ndarray,
+                *, trace_id: str | None = None) -> tuple[int, int]:
+    """Framed interleaved egress onto one TCP connection: renders the
+    4-byte ``$``-channel frame + rewritten RTP header per ring slot in C
+    and writes the whole batch through writev — no per-packet Python.
+
+    Returns ``(packets_fully_written, partial_bytes)``; when
+    ``partial_bytes > 0`` the next packet is torn mid-frame on the wire
+    and the CALLER must deliver its remaining bytes before anything else
+    on the connection.  ``last_send_errno`` explains a short return; a
+    hard stop with nothing written returns ``(-errno, 0)``."""
+    lib = _load()
+    assert lib is not None
+    assert ring_data.dtype == np.uint8 and ring_data.flags.c_contiguous
+    slots32 = np.ascontiguousarray(slots, np.int32)
+    partial = ctypes.c_int32(0)
+    t0 = TRACER.begin()
+    r = lib.ed_stream_send(
+        fd, _u8(ring_data), _i32(np.ascontiguousarray(ring_len, np.int32)),
+        ring_data.shape[0], ring_data.shape[1],
+        seq_off & 0xFFFFFFFF, ts_off & 0xFFFFFFFF, ssrc & 0xFFFFFFFF,
+        channel, _i32(slots32), len(slots32), ctypes.byref(partial))
+    span_args = {"ops": int(len(slots32)), "sent": int(r),
+                 "backend": "writev"}
+    if trace_id is not None:
+        span_args["trace_id"] = trace_id
+    TRACER.end("native.stream_egress", t0, cat="native", **span_args)
+    return int(r), partial.value
+
+
+def stream_write(fd: int, data) -> int:
+    """Plain byte-blob write to a stream socket through the native
+    egress accounting (the HLS body path's writev rung).  Returns bytes
+    written (short on EAGAIN) or negative errno on a hard stop with
+    nothing written."""
+    lib = _load()
+    assert lib is not None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.ed_stream_write(fd, _u8(buf), len(buf)))
 
 
 class UringIngest:

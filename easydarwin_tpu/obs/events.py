@@ -115,6 +115,10 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # checkpoint lifecycle (resilience/checkpoint.py)
     "ckpt.save": ("sessions",),
     "ckpt.restore": ("sessions", "outputs"),
+    # interleaved-TCP checkpoint parity (ISSUE 14): a parked kind=tcp
+    # record was adopted by a re-connecting player / aged out unclaimed
+    "ckpt.tcp_reattach": ("track",),
+    "ckpt.tcp_orphan": ("reason",),
     # lossy-WAN reliability tier (relay/fec.py, ISSUE 11): the oracle-
     # mismatch latch is one event per stream (the stream serves host
     # parity from then on); the RTX budget give-up is latched per

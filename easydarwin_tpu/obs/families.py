@@ -216,6 +216,33 @@ IO_URING_ZC_COPIED = REGISTRY.counter(
     "anyway (expected on loopback and some NIC paths — counted so the "
     "zerocopy figure is honest, never hidden)")
 
+# ------------------------------------------------------- TCP/HTTP delivery
+# First-class stream-socket egress (ISSUE 14): interleaved-RTSP frames
+# leave through the engine's framed writev/io_uring batches; HLS segment
+# bodies leave through the same rung ladder.  ``backend``/``rung`` are
+# CLOSED vocabularies (io_uring / writev / buffered) — ``buffered`` is
+# the per-send asyncio fallback rung, counted so the totals are honest
+# across the whole ladder.
+TCP_EGRESS_PACKETS = REGISTRY.counter(
+    "tcp_egress_packets_total",
+    "Interleaved RTP packets framed and written to stream sockets, by "
+    "serving backend rung (io_uring / writev / buffered)",
+    labels=("backend",))
+TCP_EGRESS_BYTES = REGISTRY.counter(
+    "tcp_egress_bytes_total",
+    "Bytes written to interleaved stream sockets (4-byte $-framing "
+    "included), by serving backend rung", labels=("backend",))
+TCP_EGRESS_BACKPRESSURE_SHEDS = REGISTRY.counter(
+    "tcp_egress_backpressure_sheds_total",
+    "Packets shed (whole AUs, forward to the newest keyframe) because a "
+    "TCP reader's backlog crossed half the ring — frame-rate "
+    "degradation instead of a blocked pump wake", labels=("backend",))
+HLS_SEGMENT_EGRESS_BYTES = REGISTRY.counter(
+    "hls_segment_egress_bytes_total",
+    "HLS playlist/segment body bytes served, by egress rung (io_uring /"
+    " writev / buffered); 304 short-circuits send no body and count "
+    "nothing", labels=("rung",))
+
 # ------------------------------------------------------------ native ingest
 INGEST_RECVMMSG_CALLS = REGISTRY.counter(
     "ingest_recvmmsg_calls_total",
@@ -466,6 +493,13 @@ RESILIENCE_CKPT_ERRORS = REGISTRY.counter(
     "resilience_checkpoint_errors_total",
     "Checkpoint write/parse failures (full disk, version mismatch, "
     "malformed session record); the server keeps serving either way")
+RESILIENCE_CKPT_TCP_ORPHANS = REGISTRY.counter(
+    "resilience_checkpoint_tcp_orphans_total",
+    "Checkpointed interleaved-TCP subscriber records discarded because "
+    "no connection re-attached within the RTSP timeout (ISSUE 14: TCP "
+    "outputs are recorded with kind=tcp + channel ids and restored only "
+    "when the same session re-SETUPs; stale records age out counted, "
+    "never silently)")
 
 # --------------------------------------------------------------- cluster tier
 # The fault-tolerant cluster layer (easydarwin_tpu/cluster/): Redis

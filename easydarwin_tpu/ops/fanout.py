@@ -22,24 +22,35 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-#: columns of the per-output state matrix
-STATE_COLS = 5  # ssrc, base_src_seq, base_src_ts, out_seq_start, out_ts_start
+#: columns of the per-output state matrix: ssrc, base_src_seq,
+#: base_src_ts, out_seq_start, out_ts_start, chan (the RTSP-interleave
+#: channel byte for TCP outputs; CHAN_NONE for datagram subscribers).
+#: The channel rides the SAME device pass as the UDP rewrite params —
+#: the 4-byte ``$``-framing header is affine in (len, channel), so one
+#: stacked pass emits every subscriber's egress params, TCP included
+#: (ISSUE 14).
+STATE_COLS = 6
+#: chan column sentinel for outputs with no interleave framing
+CHAN_NONE = 0xFFFFFFFF
 
 
 def pack_output_state(outputs) -> jnp.ndarray:
-    """Host helper: RelayOutput list → [S, 5] uint32 state matrix."""
+    """Host helper: RelayOutput list → [S, STATE_COLS] uint32 state."""
     import numpy as np
     st = np.zeros((len(outputs), STATE_COLS), dtype=np.uint32)
     for i, o in enumerate(outputs):
         rw = o.rewrite
+        ch = getattr(o, "interleave_chan", None)
         st[i] = (rw.ssrc, max(rw.base_src_seq, 0), max(rw.base_src_ts, 0),
-                 rw.out_seq_start, rw.out_ts_start)
+                 rw.out_seq_start, rw.out_ts_start,
+                 CHAN_NONE if ch is None else (ch & 0xFF))
     return st
 
 
 def _rewrite_one(state: jnp.ndarray, seq: jnp.ndarray, ts: jnp.ndarray):
-    """One subscriber: state [5] uint32, seq/ts [P] → (seq', ts', ssrc) [P]."""
-    ssrc, base_seq, base_ts, seq0, ts0 = (state[i] for i in range(STATE_COLS))
+    """One subscriber: state [STATE_COLS] uint32, seq/ts [P] →
+    (seq', ts', ssrc) [P]."""
+    ssrc, base_seq, base_ts, seq0, ts0 = (state[i] for i in range(5))
     new_seq = (seq - base_seq + seq0) & jnp.uint32(0xFFFF)
     new_ts = ts - base_ts + ts0          # uint32 wraps naturally
     return new_seq, new_ts, jnp.broadcast_to(ssrc, seq.shape)
@@ -83,15 +94,19 @@ def eligibility(age_ms: jnp.ndarray, bucket_of_output: jnp.ndarray,
 
 
 def affine_params(out_state: jnp.ndarray):
-    """[S, STATE_COLS] state → per-output (seq_off, ts_off, ssrc) triples.
+    """[S, STATE_COLS] state → per-output (seq_off, ts_off, ssrc, chan).
 
     The single definition of the affine rewrite in terms of the state
     layout; every consumer (device step, flagship pipeline) goes through
-    here so the column meanings live in one place."""
+    here so the column meanings live in one place.  ``chan`` is the
+    interleave-framing channel byte (CHAN_NONE for UDP outputs) — a
+    pure passthrough on the device, but riding the pass means the host
+    oracle check covers the byte that frames the TCP wire."""
     st = out_state.astype(jnp.uint32)
     return ((st[:, 3] - st[:, 1]) & jnp.uint32(0xFFFF),
             st[:, 4] - st[:, 2],
-            st[:, 0])
+            st[:, 0],
+            st[:, 5])
 
 
 @jax.jit
@@ -114,7 +129,7 @@ def relay_affine_step(prefix: jnp.ndarray, length: jnp.ndarray,
     fields = parse_packets(prefix, length)
     valid = length > 0
     kf = fields["keyframe_first"] & valid
-    seq_off, ts_off, ssrc = affine_params(out_state)
+    seq_off, ts_off, ssrc, chan = affine_params(out_state)
     return {
         "seq": fields["seq"].astype(jnp.uint32),
         "timestamp": fields["timestamp"],
@@ -125,6 +140,7 @@ def relay_affine_step(prefix: jnp.ndarray, length: jnp.ndarray,
         "seq_off": seq_off,
         "ts_off": ts_off,
         "ssrc": ssrc,
+        "chan": chan,
     }
 
 
@@ -132,17 +148,18 @@ def relay_affine_step(prefix: jnp.ndarray, length: jnp.ndarray,
 def relay_affine_step_packed(prefix: jnp.ndarray, length: jnp.ndarray,
                              out_state: jnp.ndarray) -> jnp.ndarray:
     """``relay_affine_step`` over a leading source axis, with the egress
-    params packed into ONE uint32 array ``[N_SRC, 3·S + 1]``:
-    ``seq_off[S] ∥ ts_off[S] ∥ ssrc[S] ∥ newest_keyframe``.
+    params packed into ONE uint32 array ``[N_SRC, 4·S + 1]``:
+    ``seq_off[S] ∥ ts_off[S] ∥ ssrc[S] ∥ chan[S] ∥ newest_keyframe``.
 
     One array means one D2H transfer.  On a tunneled device each fetch is a
-    separate RPC with fixed ~latency, so 4 fetches → 1 fetch is a direct
-    4× cut in per-window latency; combined with ``copy_to_host_async`` the
+    separate RPC with fixed ~latency, so 5 fetches → 1 fetch is a direct
+    5× cut in per-window latency; combined with ``copy_to_host_async`` the
     whole fetch hides behind the previous window's egress."""
     out = jax.vmap(relay_affine_step)(prefix, length, out_state)
     kf = out["newest_keyframe"].astype(jnp.uint32)[:, None]
     return jnp.concatenate(
-        [out["seq_off"], out["ts_off"], out["ssrc"], kf], axis=-1)
+        [out["seq_off"], out["ts_off"], out["ssrc"], out["chan"], kf],
+        axis=-1)
 
 
 #: bytes appended to each packet prefix to carry its length (le32)
@@ -179,14 +196,16 @@ def relay_affine_step_window(window: jnp.ndarray,
 
 
 def unpack_affine(packed, n_sub: int):
-    """Host-side views into the packed egress params.
+    """Host-side views into the packed egress params:
+    ``(seq_off, ts_off, ssrc, chan, newest_keyframe)``.
 
     The newest-keyframe column is re-cast to int32 so the -1 "no keyframe
     in window" sentinel survives the uint32 wire format (it rides as
     0xFFFFFFFF and wraps back here)."""
     return (packed[:, :n_sub], packed[:, n_sub:2 * n_sub],
             packed[:, 2 * n_sub:3 * n_sub],
-            packed[:, 3 * n_sub].astype("int32"))
+            packed[:, 3 * n_sub:4 * n_sub],
+            packed[:, 4 * n_sub].astype("int32"))
 
 
 @jax.jit

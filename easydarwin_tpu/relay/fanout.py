@@ -63,14 +63,18 @@ EGRESS_BACKENDS = ("auto", "io_uring", "gso", "scalar")
 
 
 def params_key(outputs) -> tuple:
-    """The affine-params cache key: one 5-tuple of rewrite state per fast
-    output, in fast-list order.  The single definition shared by the
-    per-stream engine and the megabatch scheduler — a scheduler-computed
-    key that didn't match the engine's would silently force the slow
-    path on every pass."""
+    """The affine-params cache key: one 6-tuple of rewrite state per fast
+    output, in fast-list order (the 6th element is the interleave
+    channel byte, -1 for datagram outputs — set-once like the rest).
+    The single definition shared by the per-stream engine and the
+    megabatch scheduler — a scheduler-computed key that didn't match
+    the engine's would silently force the slow path on every pass."""
+    def _chan(o):
+        ch = getattr(o, "interleave_chan", None)
+        return -1 if ch is None else (ch & 0xFF)
     return tuple((o.rewrite.ssrc, o.rewrite.base_src_seq,
                   o.rewrite.base_src_ts, o.rewrite.out_seq_start,
-                  o.rewrite.out_ts_start) for o in outputs)
+                  o.rewrite.out_ts_start, _chan(o)) for o in outputs)
 
 
 def _native_mod():
@@ -130,8 +134,15 @@ class TpuFanoutEngine:
         # egress.backend_fallback event (the PR 4 GSO-probe fix shape)
         self._uring_disabled = False
         self._uring_strikes = 0
+        # the STREAM-socket rung strikes independently: a TCP-side ring
+        # failure must not demote healthy datagram sends (and vice versa)
+        self._uring_stream_disabled = False
+        self._uring_stream_strikes = 0
+        #: config.tcp_engine_enabled — off keeps interleaved outputs on
+        #: the per-session batch-header rung (the bench baseline)
+        self.tcp_fast_enabled = True
         self._params_key = None
-        self._params = None                 # ([1,S] seq_off, ts_off, ssrc)
+        self._params = None           # ([1,S] seq_off, ts_off, ssrc, chan)
         self._dests_key = None
         self._dests = None
         # HBM-resident GOP ring (SURVEY §5 long-context analogue): the
@@ -227,12 +238,40 @@ class TpuFanoutEngine:
                 and out.meta_field_ids is None
                 and out.thinning.passthrough())
 
+    def _tcp_eligible(self, out, native_ok: bool) -> bool:
+        """Interleaved-TCP fast-path predicate (ISSUE 14): a framed
+        stream-socket output whose connection is currently directly
+        writable (no asyncio transport backlog — raw fd writes must
+        never reorder around buffered RTSP/RTCP bytes).  A forced
+        ``scalar`` backend keeps TCP on the per-send batch-header rung,
+        the honest baseline the bench compares against.  Unlike the UDP
+        predicate this needs no shared egress fd — the connection IS
+        the transport — only the native library."""
+        return (self.tcp_fast_enabled
+                and _native_mod() is not None
+                and self.egress_backend != "scalar"
+                and out.bookmark is not None
+                and getattr(out, "interleave_chan", None) is not None
+                and getattr(out, "stream_fd", -1) >= 0
+                and out.meta_field_ids is None
+                and out.thinning.passthrough()
+                and out.engine_writable())
+
+    def fast_from_flat(self, flat) -> list:
+        """Canonical fast-list order over one output scan: every
+        UDP-fast output first, then every TCP-fast output.  BOTH the
+        engine and the megabatch scheduler build ``params_key`` and the
+        device state matrix in this order, so a scheduler-staged pass
+        lands on exactly the columns the engine will consume."""
+        ok = self._native_ok()
+        udp = [o for o, _ in flat if self._fast_eligible(o, ok)]
+        tcp = [o for o, _ in flat if self._tcp_eligible(o, ok)]
+        return udp + tcp
+
     def fast_outputs(self, stream: RelayStream) -> list:
         """This stream's native-fast outputs in fast-list order (the
         order ``params_key`` and the dest table are built in)."""
-        ok = self._native_ok()
-        return [out for out, _ in self._flat_outputs(stream)
-                if self._fast_eligible(out, ok)]
+        return self.fast_from_flat(self._flat_outputs(stream))
 
     def _flat_outputs(self, stream: RelayStream):
         flat: list[tuple[RelayOutput, int]] = []
@@ -293,16 +332,19 @@ class TpuFanoutEngine:
         self._pass_wire_bytes = 0
         self._prime(stream, flat, now_ms)
         fast: list[tuple[RelayOutput, int]] = []
+        tcp: list[tuple[RelayOutput, int]] = []
         slow: list[tuple[RelayOutput, int]] = []
         native_ok = self._native_ok()
         for out, b_idx in flat:
             if self._fast_eligible(out, native_ok):
                 fast.append((out, b_idx))
+            elif self._tcp_eligible(out, native_ok):
+                tcp.append((out, b_idx))
             else:
                 slow.append((out, b_idx))
         sent = 0
-        if fast:
-            sent += self._native_step(stream, fast, now_ms)
+        if fast or tcp:
+            sent += self._native_step(stream, fast, tcp, now_ms)
         if slow:
             sent += self._batch_header_step(stream, slow, now_ms)
         # RTCP relay + SR origination, identical to the scalar path
@@ -314,7 +356,7 @@ class TpuFanoutEngine:
             # splitting the bracket so a mixed pass neither hides the
             # batch path's share under "native" nor double-counts the
             # wall time in the session's phase_ns
-            engines = [e for e, ran in (("native", bool(fast)),
+            engines = [e for e, ran in (("native", bool(fast) or bool(tcp)),
                                         ("batch", bool(slow))) if ran]
             share = dt // len(engines)
             for i, e in enumerate(engines):
@@ -461,6 +503,7 @@ class TpuFanoutEngine:
         seq_off = np.asarray(res["seq_off"])[None, :S]
         ts_off = np.asarray(res["ts_off"])[None, :S]
         ssrc = np.asarray(res["ssrc"])[None, :S]
+        chan = np.asarray(res["chan"])[None, :S]
         kf_abs = int(res["newest_keyframe_abs"])
         t_d2h = time.perf_counter_ns()
         if PROFILER.enabled:
@@ -476,7 +519,8 @@ class TpuFanoutEngine:
                                      if kf_abs >= 0 else -1)
         self._params = (np.ascontiguousarray(seq_off),
                         np.ascontiguousarray(ts_off),
-                        np.ascontiguousarray(ssrc))
+                        np.ascontiguousarray(ssrc),
+                        np.ascontiguousarray(chan))
         self._params_key = key
         self.device_param_refreshes += 1
         obs.TPU_PARAM_REFRESHES.inc()
@@ -487,14 +531,17 @@ class TpuFanoutEngine:
                                      stage="device_params")
         return self._params
 
-    def _native_step(self, stream: RelayStream, fast, now_ms: int) -> int:
+    def _native_step(self, stream: RelayStream, fast, tcp,
+                     now_ms: int) -> int:
         """Send every eligible (packet, output) pair through the native
-        sendmmsg/GSO scatter — ONE C call for the whole stream pass."""
-        from .. import native
+        senders — ONE sendmmsg/GSO scatter for the UDP set, one framed
+        writev/io_uring batch per interleaved-TCP connection — all from
+        ONE device param pass (the affine rewrite plus the interleave
+        channel column ride the same query)."""
         ring = stream.rtp_ring
-        delay = stream.settings.bucket_delay_ms
         t_win = time.perf_counter_ns() if PROFILER.enabled else 0
-        start = min(o.bookmark for o, _ in fast)
+        combined = fast + tcp
+        start = min(o.bookmark for o, _ in combined)
         ids, lengths, _flags = ring.window_meta(start, ring.head - start)
         if len(ids) == 0:
             return 0
@@ -517,7 +564,26 @@ class TpuFanoutEngine:
         # actual.  The ratio is the device-ring saving (VERDICT r2 item 6).
         live_window = ring.head - max(ring.tail, ring.head - ring.capacity)
         self.h2d_window_equiv_bytes += live_window * (self.prefix_width + 8)
-        seq_off, ts_off, ssrc = self._device_params(fast, ring, now_ms)
+        seq_off, ts_off, ssrc, chan = self._device_params(combined, ring,
+                                                          now_ms)
+        sent = 0
+        if fast:
+            sent += self._udp_scatter(stream, fast, start, ids, idx,
+                                      arrivals, valid, lengths,
+                                      seq_off, ts_off, ssrc, now_ms)
+        if tcp:
+            sent += self._tcp_scatter(stream, tcp, len(fast), start, ids,
+                                      idx, arrivals, valid, lengths,
+                                      seq_off, ts_off, ssrc, chan, now_ms)
+        self.native_passes += 1
+        return sent
+
+    def _udp_scatter(self, stream: RelayStream, fast, start, ids, idx,
+                     arrivals, valid, lengths, seq_off, ts_off, ssrc,
+                     now_ms: int) -> int:
+        from .. import native
+        ring = stream.rtp_ring
+        delay = stream.settings.bucket_delay_ms
         # egress_native starts HERE: everything from params-in-hand to
         # wire — per-output span selection, the scatter op list, and the
         # native sendmmsg/GSO calls — is the egress stage (leaving the
@@ -688,8 +754,183 @@ class TpuFanoutEngine:
             # per-session attribution (top-by-p99 in command=top)
             PROFILER.account_latency(stream.session_path, lat_s)
         self.native_sent += r
-        self.native_passes += 1
         return int(r)
+
+    # -- interleaved-TCP fast path (ISSUE 14) ------------------------------
+    def stream_backend(self) -> str:
+        """The rung serving this engine's STREAM-socket writes.  No GSO
+        tier exists for TCP, so the ladder is io_uring → writev →
+        buffered (the per-send batch-header rung a forced ``scalar``
+        backend keeps)."""
+        if self.egress_backend == "scalar":
+            return "buffered"
+        if (self.egress_backend in ("auto", "io_uring")
+                and not self._uring_stream_disabled
+                and self.uring is not None
+                and getattr(self.uring, "active", False)):
+            return "io_uring"
+        return "writev"
+
+    def _note_uring_stream_failure(self, err: int) -> None:
+        """Same strike shape as the datagram rung: two whole-batch ring
+        failures while writev still delivers retire io_uring for this
+        engine's stream sends with ONE structured fallback event."""
+        if self._uring_stream_disabled:
+            return
+        self._uring_stream_strikes += 1
+        if self._uring_stream_strikes < 2:
+            return
+        self._uring_stream_disabled = True
+        reason = (errno_mod.errorcode.get(err, str(err)) if err
+                  else "unknown")
+        obs.EGRESS_BACKEND_FALLBACKS.inc(backend="io_uring")
+        obs.EVENTS.emit("egress.backend_fallback", level="warn",
+                        backend="io_uring", fallback="writev",
+                        reason=reason)
+
+    def _render_framed(self, ring, slot: int, out, chan: int) -> bytes:
+        """One framed interleaved packet rendered host-side (the partial-
+        write completion path): ``$ chan len16 | rewritten RTP`` —
+        byte-identical to the C renderer by the same affine formulas."""
+        from ..protocol import rtp
+        ln = int(ring.length[slot])
+        pkt = ring.data[slot, :ln].tobytes()
+        rw = out.rewrite
+        body = rtp.rewrite_header(
+            pkt, seq=rw.map_seq(rtp.peek_seq(pkt)),
+            timestamp=rw.map_ts(rtp.peek_timestamp(pkt)), ssrc=rw.ssrc)
+        return b"$" + bytes((chan & 0xFF,)) + ln.to_bytes(2, "big") + body
+
+    def _tcp_scatter(self, stream: RelayStream, tcp, col0: int, start,
+                     ids, idx, arrivals, valid, lengths, seq_off, ts_off,
+                     ssrc, chan, now_ms: int) -> int:
+        """Framed interleave egress: per connection, ONE native call
+        renders ``$``-framing + rewritten RTP headers in C and writes
+        the whole eligible span through writev (or one io_uring
+        submission) — no per-packet Python, payload bytes never copied
+        per-subscriber on the host.
+
+        Flow control maps onto the ladder, never onto the pump: a short
+        write's torn packet is completed through the asyncio transport
+        (which then owns ordering for the stalled tail), EAGAIN holds
+        the bookmark (replay next pass), and a reader stalled so far
+        behind that the backlog crosses half the ring is shed WHOLE AUs
+        forward to the newest keyframe — frame-rate degradation, not a
+        blocked wake."""
+        from .. import native
+        ring = stream.rtp_ring
+        delay = stream.settings.bucket_delay_ms
+        t_egress = time.perf_counter_ns() if PROFILER.enabled else 0
+        backend = self.stream_backend()
+        sent = 0
+        sent_slots: list[np.ndarray] = []
+        for j, (out, b_idx) in enumerate(tcp):
+            col = col0 + j
+            # deep-backlog shed BEFORE building the span: a reader this
+            # far behind gets whole AUs dropped (resume at the newest
+            # keyframe) instead of a doomed mega-writev
+            behind = ring.head - out.bookmark
+            if behind > ring.capacity // 2:
+                kf = stream.keyframe_id
+                if kf is None or kf <= out.bookmark:
+                    kf = ring.head - ring.capacity // 4
+                shed = int(kf - out.bookmark)
+                if shed > 0:
+                    out.bookmark = int(kf)
+                    out.stalls += 1
+                    stream.stats.stalls += 1
+                    obs.TCP_EGRESS_BACKPRESSURE_SHEDS.inc(
+                        shed, backend=backend)
+            lo = max(out.bookmark - start, 0)
+            hi = int(np.searchsorted(arrivals, now_ms - b_idx * delay,
+                                     side="right"))
+            if hi <= lo:
+                continue
+            sel = valid[lo:hi]
+            pids = ids[lo:hi][sel]
+            slots = np.ascontiguousarray(idx[lo:hi][sel])
+            lens = lengths[lo:hi][sel]
+            if len(pids) == 0:
+                out.bookmark = start + hi   # runt-only span: skip past it
+                continue
+            ch = int(chan[0, col]) & 0xFF
+            args = (out.stream_fd, ring.data, ring.length,
+                    int(seq_off[0, col]), int(ts_off[0, col]),
+                    int(ssrc[0, col]), ch, slots)
+            used = backend
+            r, partial = -1, 0
+            if backend == "io_uring":
+                r, partial = self.uring.stream_send(*args)
+                if r < 0 and native.last_send_errno() not in (
+                        errno_mod.EAGAIN, errno_mod.EWOULDBLOCK):
+                    uring_err = native.last_send_errno()
+                    used = "writev"
+                    r, partial = native.stream_send(*args)
+                    if r >= 0:
+                        self._note_uring_stream_failure(uring_err)
+            else:
+                r, partial = native.stream_send(*args)
+            if r < 0:
+                err = native.last_send_errno()
+                if err in (errno_mod.EAGAIN, errno_mod.EWOULDBLOCK):
+                    out.stalls += 1           # replay from bookmark
+                    stream.stats.stalls += 1
+                else:
+                    # hard connection error: ERROR semantics — skip the
+                    # span so a dead socket cannot starve the pass
+                    out.bookmark = start + hi
+                    self.send_errors += len(pids)
+                continue
+            k = int(r)
+            nbytes = int(lens[:k].sum()) if k else 0
+            dead = False
+            if partial > 0 and k < len(pids):
+                # the k-th packet is torn mid-frame on the wire: its
+                # remainder MUST be the connection's next bytes.  Hand
+                # it to the asyncio transport, which owns ordering for
+                # everything queued after (RTSP replies, RTCP) until
+                # the buffer drains and the fast path re-engages.
+                framed = self._render_framed(ring, int(slots[k]), out, ch)
+                if out.push_tail(framed[partial:]):
+                    nbytes += int(lens[k])
+                    k += 1
+                else:
+                    # transport died mid-pass: skip the span (ERROR
+                    # semantics) — it must NOT also be rescheduled as a
+                    # stall, or the torn packet would be re-sent in
+                    # full on a socket that already carries its prefix
+                    dead = True
+                    out.bookmark = start + hi
+                    self.send_errors += len(pids) - k
+            if dead:
+                pass                        # span skipped above
+            elif k == len(pids):
+                out.bookmark = start + hi
+            else:
+                out.bookmark = int(pids[k])  # first unsent packet
+                out.stalls += 1
+                stream.stats.stalls += 1
+            if k:
+                out.packets_sent += k
+                out.bytes_sent += nbytes
+                out.payload_octets += nbytes - 12 * k
+                self._pass_wire_bytes += nbytes
+                sent += k
+                sent_slots.append(slots[:k])
+                obs.TCP_EGRESS_PACKETS.inc(k, backend=used)
+                obs.TCP_EGRESS_BYTES.inc(nbytes + 4 * k, backend=used)
+        wire_ns = time.perf_counter_ns()
+        if t_egress:
+            self._phase_add("egress_io_uring" if backend == "io_uring"
+                            else "egress_native", wire_ns - t_egress)
+        if sent_slots:
+            all_slots = (sent_slots[0] if len(sent_slots) == 1
+                         else np.concatenate(sent_slots))
+            lat_s = (wire_ns - ring.arrival_ns[all_slots]) / 1e9
+            obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="native")
+            PROFILER.account_latency(stream.session_path, lat_s)
+        self.native_sent += sent
+        return sent
 
     # -- batch-header path (TCP/meta/thinned outputs) ----------------------
     def _batch_header_step(self, stream: RelayStream, flat,
@@ -760,6 +1001,7 @@ class TpuFanoutEngine:
             if pid is None:
                 continue
             deadline = now_ms - b_idx * delay
+            tcp_ok = tcp_bytes = 0      # buffered-rung interleave counts
             while pid < ring.head:
                 j = pid - start
                 if j < 0:
@@ -789,8 +1031,16 @@ class TpuFanoutEngine:
                     out.payload_octets += len(payload)
                     self._pass_wire_bytes += 12 + len(payload)
                     sent += 1
+                    tcp_ok += 1
+                    tcp_bytes += 16 + len(payload)
                     lat_ns.append(int(ring.arrival_ns[slot]))
             out.bookmark = pid
+            if tcp_ok and getattr(out, "interleave_chan", None) is not None:
+                # interleaved sends served from the per-session rung —
+                # counted so the tcp_egress families are an honest total
+                # across the whole ladder, engine rungs AND fallback
+                obs.TCP_EGRESS_PACKETS.inc(tcp_ok, backend="buffered")
+                obs.TCP_EGRESS_BYTES.inc(tcp_bytes, backend="buffered")
         if lat_ns:
             now_ns = time.perf_counter_ns()
             lat_s = (now_ns - np.asarray(lat_ns, dtype=np.int64)) / 1e9

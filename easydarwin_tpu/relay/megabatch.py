@@ -93,14 +93,19 @@ def _host_affine_params(key) -> tuple:
     """The affine rewrite computed by plain host arithmetic from a
     ``params_key`` — the oracle every harvested device segment is
     checked against (same uint32 formulas as ``ops.fanout.
-    affine_params`` over ``pack_output_state``'s max(·, 0) clamping)."""
-    st = np.asarray(key, dtype=np.int64).reshape(-1, 5)
+    affine_params`` over ``pack_output_state``'s max(·, 0) clamping).
+    The 6th column is the interleave channel byte (ISSUE 14): a pure
+    passthrough, so the oracle is identity — but checking it means a
+    device/transfer corruption can never re-channel a TCP frame."""
+    st = np.asarray(key, dtype=np.int64).reshape(-1, 6)
     ssrc = (st[:, 0] & 0xFFFFFFFF).astype(np.uint32)
     base_seq = np.maximum(st[:, 1], 0).astype(np.uint32)
     base_ts = np.maximum(st[:, 2], 0).astype(np.uint32)
     seq0 = (st[:, 3] & 0xFFFFFFFF).astype(np.uint32)
     ts0 = (st[:, 4] & 0xFFFFFFFF).astype(np.uint32)
-    return ((seq0 - base_seq) & np.uint32(0xFFFF), ts0 - base_ts, ssrc)
+    chan = (st[:, 5] & 0xFFFFFFFF).astype(np.uint32)
+    return ((seq0 - base_seq) & np.uint32(0xFFFF), ts0 - base_ts, ssrc,
+            chan)
 
 
 class _InFlight:
@@ -256,8 +261,7 @@ class MegabatchScheduler:
         for stream, eng in pairs:
             flat = eng._flat_outputs(stream)     # one scan: prime + filter
             eng._prime(stream, flat, now_ms)
-            ok = eng._native_ok()
-            fast = [o for o, _ in flat if eng._fast_eligible(o, ok)]
+            fast = eng.fast_from_flat(flat)
             key = params_key(fast) if fast else None
             self._wake_fast[id(stream)] = (fast, key)
             if not fast:
@@ -357,17 +361,18 @@ class MegabatchScheduler:
         segment (-1 = single-device/prime).  Returns False (and counts
         the mismatch) on device/host divergence; the stream then falls
         back to per-stream stepping."""
-        seq_off, ts_off, ssrc, kf = seg
+        seq_off, ts_off, ssrc, chan, kf = seg
         host = _host_affine_params(key)
         if not (np.array_equal(seq_off[0], host[0])
                 and np.array_equal(ts_off[0], host[1])
-                and np.array_equal(ssrc[0], host[2])):
+                and np.array_equal(ssrc[0], host[2])
+                and np.array_equal(chan[0], host[3])):
             self.mismatches += 1
             obs.MEGABATCH_WIRE_MISMATCH.inc()
             eng.megabatch_params = None
             eng.megabatch_shard = -1
             return False
-        eng.megabatch_params = (key, (seq_off, ts_off, ssrc))
+        eng.megabatch_params = (key, (seq_off, ts_off, ssrc, chan))
         eng.megabatch_shard = shard
         if base is not None and kf >= 0:
             # parity with the per-stream query, which maintains this

@@ -21,10 +21,16 @@ resumes live relays **without re-SETUP**:
 * **keyframe index** — restored as an id; ``ring.valid()`` guards the
   (gone) bytes, so late joiners simply fast-start from the next GOP.
 
-Only UDP subscribers restore (``kind="udp"``: the shared-egress address
-pair is the whole transport — the client never learns the server died).
-TCP/interleaved outputs die with their connections and are recorded for
-forensics but skipped on restore.  Time-domain fields (arrival clocks,
+UDP subscribers restore transparently (``kind="udp"``: the shared-
+egress address pair is the whole transport — the client never learns
+the server died).  Interleaved-TCP subscribers (``kind="tcp"``, ISSUE
+14) record their channel ids + RTSP session id; their connections died
+with the process, so the records PARK on the server and are adopted
+when the same player re-attaches (an interleaved SETUP carrying the old
+``Session`` id) — same ssrc, framed seq continuing gapless.  Records no
+player reclaims within the RTSP timeout are discarded, counted as
+``resilience_checkpoint_tcp_orphans_total`` with a ``ckpt.tcp_orphan``
+event.  Time-domain fields (arrival clocks,
 SR cadence, wall anchors) are deliberately NOT restored — the monotonic
 clock restarts with the process, so they re-latch on first use.
 
@@ -52,9 +58,14 @@ CKPT_FILE = "relay.json"
 # -- snapshot ------------------------------------------------------------
 def _snapshot_output(out, bucket_idx: int) -> dict:
     rw = out.rewrite
+    if getattr(out, "native_addr", None) is not None:
+        kind = "udp"
+    elif getattr(out, "interleave_chan", None) is not None:
+        kind = "tcp"
+    else:
+        kind = "opaque"
     rec = {
-        "kind": "udp" if getattr(out, "native_addr", None) is not None
-        else "opaque",
+        "kind": kind,
         "bucket": bucket_idx,
         "rewrite": [rw.ssrc, rw.base_src_seq, rw.base_src_ts,
                     rw.out_seq_start, rw.out_ts_start],
@@ -62,10 +73,19 @@ def _snapshot_output(out, bucket_idx: int) -> dict:
         "bytes_sent": out.bytes_sent,
         "payload_octets": out.payload_octets,
     }
-    if rec["kind"] == "udp":
+    if kind == "udp":
         rec["rtp_addr"] = list(out.native_addr)
         rtcp = getattr(out, "rtcp_addr", None)
         rec["rtcp_addr"] = list(rtcp) if rtcp else None
+    elif kind == "tcp":
+        # interleaved outputs CAN restore (ISSUE 14): the rewrite state
+        # is set-once ints, so when the same player re-attaches (its
+        # old Session id on a fresh interleaved SETUP) the framed seq
+        # space continues gapless.  The connection itself died with the
+        # process — the record parks until the re-attach or the orphan
+        # sweep.
+        rec["channels"] = [out.rtp_channel, out.rtcp_channel]
+        rec["session_id"] = getattr(out, "session_id", None)
     return rec
 
 
@@ -114,7 +134,8 @@ def snapshot_registry(registry) -> dict:
 
 
 # -- restore -------------------------------------------------------------
-def _restore_stream(st, rec: dict, output_factory) -> int:
+def _restore_stream(st, rec: dict, output_factory, *, path: str = "",
+                    tcp_sink=None) -> int:
     ring = st.rtp_ring
     head = int(rec.get("head", 0))
     # the bytes are gone; the id space continues — every bookmark and
@@ -140,6 +161,14 @@ def _restore_stream(st, rec: dict, output_factory) -> int:
     st.stats.packets_out = int(rec.get("packets_out", 0))
     restored = 0
     for orec in rec.get("outputs", ()):
+        if orec.get("kind") == "tcp":
+            # the connection died with the process; park the record for
+            # the re-attach path (rtsp SETUP with the old Session id)
+            # instead of dropping it — the long-standing "recorded but
+            # skipped" gap, closed (ISSUE 14)
+            if tcp_sink is not None:
+                tcp_sink(path, rec.get("track"), orec)
+            continue
         out = output_factory(orec) if output_factory is not None else None
         if out is None:
             continue
@@ -162,13 +191,17 @@ def _restore_stream(st, rec: dict, output_factory) -> int:
     return restored
 
 
-def restore_registry(registry, doc: dict, *, output_factory=None
-                     ) -> tuple[int, int]:
+def restore_registry(registry, doc: dict, *, output_factory=None,
+                     tcp_sink=None) -> tuple[int, int]:
     """Rebuild sessions/streams/outputs from a checkpoint document into
     ``registry``.  ``output_factory(record) -> RelayOutput | None``
     builds the transport for each recorded output (None skips it — the
-    default, since only the server knows its egress).  Returns
-    ``(sessions, outputs)`` restored."""
+    default, since only the server knows its egress).
+    ``tcp_sink(path, track_id, record)`` receives each ``kind=tcp``
+    record — interleaved outputs have no transport until their player
+    re-connects, so the server parks them for the SETUP re-attach path.
+    Returns ``(sessions, outputs)`` restored (parked TCP records are
+    not counted until they re-attach)."""
     n_out = 0
     n_sess = 0
     for srec in doc.get("sessions", ()):
@@ -185,7 +218,8 @@ def restore_registry(registry, doc: dict, *, output_factory=None
         for tid, st in sess.streams.items():
             rec = by_track.get(tid)
             if rec is not None:
-                n_out += _restore_stream(st, rec, output_factory)
+                n_out += _restore_stream(st, rec, output_factory,
+                                         path=path, tcp_sink=tcp_sink)
     return n_sess, n_out
 
 
@@ -251,14 +285,16 @@ class CheckpointManager:
             return None
         return doc
 
-    def restore(self, registry, *, output_factory=None) -> tuple[int, int]:
+    def restore(self, registry, *, output_factory=None,
+                tcp_sink=None) -> tuple[int, int]:
         """Load + rebuild; returns ``(sessions, outputs)`` restored
         (``(0, 0)`` when there is nothing usable)."""
         doc = self.load()
         if doc is None:
             return (0, 0)
         n_sess, n_out = restore_registry(registry, doc,
-                                         output_factory=output_factory)
+                                         output_factory=output_factory,
+                                         tcp_sink=tcp_sink)
         if n_sess:
             self.restores += 1
             obs.RESILIENCE_CKPT_RESTORES.inc()

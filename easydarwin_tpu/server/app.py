@@ -126,6 +126,11 @@ class StreamingServer:
         #: adapters owning hot-restored subscribers (RTCP demux +
         #: silence reaping); swept alongside the RTSP timeout sweep
         self._restored_subs: list[_RestoredSubscriber] = []
+        #: parked interleaved-TCP checkpoint records (ISSUE 14):
+        #: (path, track_id, session_id) → (record, parked_monotonic).
+        #: Claimed by the rtsp SETUP re-attach hook; unclaimed entries
+        #: age out via the sweep as counted ckpt.tcp_orphan events.
+        self._pending_tcp: dict = {}
         self._armed_faults = False
         self._tasks: list[asyncio.Task] = []
         self._running = False
@@ -238,9 +243,11 @@ class StreamingServer:
                 os.path.join(self.config.log_folder, "ckpt"),
                 interval_sec=self.config.resilience_checkpoint_interval_sec,
                 max_age_sec=self.config.resilience_checkpoint_max_age_sec)
+            self.rtsp.tcp_restore = self.claim_tcp_restore
             try:
                 n_sess, n_out = self.checkpoint.restore(
-                    self.registry, output_factory=self._restored_output)
+                    self.registry, output_factory=self._restored_output,
+                    tcp_sink=self._park_tcp_record)
                 if n_out:
                     self._adopt_restored_outputs()
                 if n_sess and self.error_log:
@@ -535,15 +542,21 @@ class StreamingServer:
         """Cluster migration hook: rebuild the adopted stream's sessions
         + UDP subscribers from its Redis-published checkpoint.  The
         subscribers' address pairs ARE their transport, so the players
-        are re-pointed at this node without re-SETUP."""
+        are re-pointed at this node without re-SETUP.  Interleaved-TCP
+        subscribers park for the re-attach path (their connection died
+        with the old owner; the player reconnects and presents its old
+        Session id — ISSUE 14 migration parity)."""
         from ..resilience.checkpoint import restore_registry
+        if self.rtsp.tcp_restore is None:
+            self.rtsp.tcp_restore = self.claim_tcp_restore
         paths = {s.get("path") for s in doc.get("sessions", ())}
         pre = {id(o)
                for p in paths if p
                for sess in (self.registry.find(p),) if sess is not None
                for st in sess.streams.values() for o in st.outputs}
         n_sess, n_out = restore_registry(
-            self.registry, doc, output_factory=self._restored_output)
+            self.registry, doc, output_factory=self._restored_output,
+            tcp_sink=self._park_tcp_record)
         if n_out:
             self._adopt_restored_outputs(paths=paths, exclude_ids=pre)
         self._wake()
@@ -821,11 +834,46 @@ class StreamingServer:
         except OSError:
             pass
 
+    def _park_tcp_record(self, path: str, track_id, rec: dict) -> None:
+        """Checkpoint restore sink for ``kind=tcp`` records: park until
+        the player re-attaches.  Records with no session id can never
+        be matched — counted orphan immediately instead of rotting."""
+        from .. import obs
+        sid = rec.get("session_id")
+        if not sid:
+            obs.RESILIENCE_CKPT_TCP_ORPHANS.inc()
+            obs.EVENTS.emit("ckpt.tcp_orphan", stream=path or "?",
+                            reason="no_session_id")
+            return
+        self._pending_tcp[(path, track_id, sid)] = (rec, time.monotonic())
+
+    def claim_tcp_restore(self, path: str, track_id, sid: str):
+        """The rtsp SETUP re-attach hook: pop-and-return the parked
+        record for (path, track, old Session id), or None."""
+        ent = self._pending_tcp.pop((path, track_id, sid), None)
+        return ent[0] if ent is not None else None
+
+    def _sweep_pending_tcp(self) -> None:
+        """Discard parked TCP records no player reclaimed within the
+        RTSP timeout — stale-connection records must not adopt into a
+        much later, unrelated subscriber."""
+        if not self._pending_tcp:
+            return
+        from .. import obs
+        now = time.monotonic()
+        for key in [k for k, (_r, t0) in self._pending_tcp.items()
+                    if now - t0 > self.config.rtsp_timeout_sec]:
+            del self._pending_tcp[key]
+            obs.RESILIENCE_CKPT_TCP_ORPHANS.inc()
+            obs.EVENTS.emit("ckpt.tcp_orphan", stream=key[0],
+                            reason="timeout", track=key[1])
+
     def _sweep_restored(self) -> None:
         """Reap restored subscribers whose player never proved itself:
         no ownership-proven RTCP for ``rtsp_timeout_sec`` (the same
         clock a live UDP player's connection is held to) removes the
         output — a vanished player cannot be relayed to forever."""
+        self._sweep_pending_tcp()
         if not self._restored_subs:
             return
         now = time.monotonic()
@@ -910,6 +958,7 @@ class StreamingServer:
         egress = self.rtsp.shared_egress
         eng.egress_fd = egress.fileno() if egress is not None else None
         eng.uring = self.uring_egress
+        eng.tcp_fast_enabled = self.config.tcp_engine_enabled
         return eng
 
     def _reflect_all(self) -> int:

@@ -41,6 +41,51 @@ class RestApi:
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
         self.started_at = time.time()
+        #: HLS serving health (ISSUE 14): 304 revalidations served and
+        #: body sends per egress rung — the regression tests pin the
+        #: zero-per-request-copy hot path on these
+        self.hls_not_modified = 0
+        self.hls_rungs = {"io_uring": 0, "writev": 0, "buffered": 0}
+
+    def _stream_body(self, writer: asyncio.StreamWriter, head: bytes,
+                     data) -> str:
+        """Write one HLS response through the stream-egress rung ladder
+        (io_uring → writev → buffered).  The header rides the transport
+        (tiny, flushes immediately); when the transport buffer is empty
+        the body goes straight to the socket through the native sender —
+        no per-request copy of the segment bytes, no per-chunk Python.
+        Any shortfall (EAGAIN, no raw socket, buffered header) hands the
+        REMAINDER to the transport, which owns ordering from then on."""
+        from .. import native, obs
+        tr = writer.transport
+        writer.write(head)
+        mv = data if isinstance(data, memoryview) else memoryview(data)
+        rung = "buffered"
+        sent = 0
+        try:
+            sock = tr.get_extra_info("socket")
+        except Exception:
+            sock = None
+        if (sock is not None and not tr.is_closing()
+                and tr.get_write_buffer_size() == 0
+                and native.loaded()):
+            fd = sock.fileno()
+            uring = getattr(self.app, "uring_egress", None)
+            if uring is not None and getattr(uring, "active", False):
+                rung = "io_uring"
+                sent = uring.stream_write(fd, mv)
+            else:
+                rung = "writev"
+                sent = native.stream_write(fd, mv)
+            if sent < 0:
+                rung, sent = "buffered", 0
+        if sent < len(mv):
+            # memoryview slice: the transport queues a VIEW of the same
+            # immutable bytes — still zero copies of the segment body
+            tr.write(mv[sent:])
+        self.hls_rungs[rung] = self.hls_rungs.get(rung, 0) + 1
+        obs.HLS_SEGMENT_EGRESS_BYTES.inc(len(mv), rung=rung)
+        return rung
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -75,16 +120,33 @@ class RestApi:
                 res = await self.route(method, target, headers, body)
                 status, payload = res[0], res[1]
                 ctype = res[2] if len(res) > 2 else None
+                extra = res[3] if len(res) > 3 else None
                 data = payload.encode() if isinstance(payload, str) else payload
                 if ctype is None:
                     ctype = ("text/html" if data[:2] in (b"<!", b"<h")
                              else "application/json")
-                writer.write(
-                    f"HTTP/1.1 {status} {'OK' if status == 200 else 'Error'}\r\n"
+                reason = {200: "OK", 304: "Not Modified"}.get(status,
+                                                              "Error")
+                head = (
+                    f"HTTP/1.1 {status} {reason}\r\n"
                     f"Server: {SERVER_NAME}\r\n"
                     f"Content-Type: {ctype}\r\n"
                     f"Content-Length: {len(data)}\r\n"
-                    "Connection: keep-alive\r\n\r\n".encode() + data)
+                    + "".join(f"{k}: {v}\r\n"
+                              for k, v in (extra or {}).items())
+                    + "Connection: keep-alive\r\n\r\n").encode()
+                if (status == 200 and data
+                        and target.split("?")[0].lower()
+                        .startswith("/hls/")):
+                    # HLS bodies ride the stream-egress rung ladder
+                    # (ISSUE 14): header + body written separately so
+                    # the segment bytes are never concatenated into a
+                    # per-request copy
+                    self._stream_body(writer, head, data)
+                else:
+                    writer.write(head)
+                    if data:
+                        writer.write(data)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.LimitOverrunError):
@@ -142,7 +204,15 @@ class RestApi:
             served = self.app.hls.serve(url.path)
             if served is None:
                 return 404, json.dumps({"error": "not found"})
-            ctype, data = served
+            ctype, data, etag = served
+            if etag is not None:
+                if headers.get("if-none-match") == etag:
+                    # revalidation short-circuit: a player polling the
+                    # playlist (or re-fetching an immutable segment)
+                    # costs a header round-trip, zero body bytes
+                    self.hls_not_modified += 1
+                    return 304, b"", ctype, {"ETag": etag}
+                return 200, data, ctype, {"ETag": etag}
             return 200, data, ctype
         if not path.startswith("/api/v1/"):
             return 404, json.dumps({"error": "not found"})

@@ -447,6 +447,8 @@ class RtspConnection:
         if track_id is None or track_id not in relay.streams:
             raise rtsp.RtspError(404, f"unknown track {track_id}")
         out, resp_t, pair = await self._make_output(t)
+        if t.is_tcp:
+            self._maybe_readopt_tcp(req, relay.path, track_id, out, resp_t)
         extra = self._negotiate_meta_info(req, out)
         out, rel_extra = self._negotiate_retransmit(req, out, t)
         extra.update(rel_extra)
@@ -454,6 +456,34 @@ class RtspConnection:
         self._install_player_track(track_id, out, pair)
         self._reply(rtsp.RtspResponse(200, {
             "Transport": resp_t.to_header(), **extra}), req.cseq)
+
+    def _maybe_readopt_tcp(self, req, path, track_id, out, resp_t) -> None:
+        """Checkpoint/migration parity for interleaved TCP (ISSUE 14):
+        a player re-connecting after a restart/migration presents its
+        old ``Session`` id; if a ``kind=tcp`` checkpoint record matches
+        (path, track, session), its set-once rewrite state is adopted —
+        same ssrc, framed seq continuing exactly where the dead
+        process's wire stopped.  No match = a fresh subscriber (stale
+        records age out counted as ``ckpt.tcp_orphan``)."""
+        hook = self.server.tcp_restore
+        sid = (req.headers.get("session") or "").strip()
+        if hook is None or not sid:
+            return
+        rec = hook(path, track_id, sid)
+        if rec is None:
+            return
+        rw = rec.get("rewrite") or [0, -1, -1, 0, 0]
+        out.rewrite.ssrc = int(rw[0])
+        out.rewrite.base_src_seq = int(rw[1])
+        out.rewrite.base_src_ts = int(rw[2])
+        out.rewrite.out_seq_start = int(rw[3])
+        out.rewrite.out_ts_start = int(rw[4])
+        out.packets_sent = int(rec.get("packets_sent", 0))
+        out.bytes_sent = int(rec.get("bytes_sent", 0))
+        out.payload_octets = int(rec.get("payload_octets", 0))
+        resp_t.ssrc = out.rewrite.ssrc      # Transport echoes the OLD ssrc
+        EVENTS.emit("ckpt.tcp_reattach", session_id=self.session_id,
+                    stream=path, trace_id=self.trace_id, track=track_id)
 
     def _negotiate_retransmit(self, req, out, t):
         """Reliable-UDP negotiation: a UDP SETUP carrying
@@ -1043,6 +1073,13 @@ class RtspServer:
         #: cluster mode: ``(path, client_key) -> None | (action, url)``;
         #: None = every SETUP admitted (standalone behavior)
         self.admission = None
+        #: interleaved-TCP checkpoint re-attach hook (ISSUE 14) — set by
+        #: the app when checkpointing is on: ``(path, track_id,
+        #: session_id) -> record | None``.  A re-connecting player that
+        #: presents its old Session id on an interleaved SETUP adopts
+        #: the recorded rewrite state, so the framed seq space continues
+        #: gapless across a restart/migration.
+        self.tcp_restore = None
         from .modules import ModuleRegistry
         self.modules = ModuleRegistry()
         #: RTSP-over-HTTP tunnels: x-sessioncookie → GET-side connection

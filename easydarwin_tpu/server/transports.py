@@ -24,7 +24,17 @@ HIGH_WATER = 256 * 1024
 
 
 class InterleavedOutput(RelayOutput):
-    """$-framed RTP/RTCP egress over the client's RTSP TCP connection."""
+    """$-framed RTP/RTCP egress over the client's RTSP TCP connection.
+
+    Engine fast path (ISSUE 14): the TPU engine recognizes these by
+    ``interleave_chan``/``stream_fd`` and frames whole ring spans
+    through the native writev/io_uring stream sender — byte-identical
+    to the per-packet ``_send`` below, differential-tested over real
+    sockets.  Raw fd writes are only legal while the asyncio transport
+    buffer is EMPTY (``engine_writable``): bytes queued in the
+    transport must never be overtaken mid-stream.  A short native write
+    hands the torn packet's remainder to ``push_tail`` (the transport),
+    which then owns ordering until the buffer drains."""
 
     def __init__(self, transport: asyncio.WriteTransport,
                  rtp_channel: int, rtcp_channel: int, **kw):
@@ -32,6 +42,43 @@ class InterleavedOutput(RelayOutput):
         self.transport = transport
         self.rtp_channel = rtp_channel
         self.rtcp_channel = rtcp_channel
+        sock = None
+        try:
+            sock = transport.get_extra_info("socket")
+        except Exception:
+            sock = None
+        #: raw stream-socket fd for the native framed sender; -1 when
+        #: the transport cannot expose one (TLS/tunnel/test harness) —
+        #: such outputs stay on the buffered batch-header rung
+        try:
+            self.stream_fd = sock.fileno() if sock is not None else -1
+        except (OSError, AttributeError):
+            self.stream_fd = -1
+
+    @property
+    def interleave_chan(self) -> int:
+        """The RTP interleave channel byte — the per-output framing
+        constant that rides the affine device pass (ops.fanout chan
+        column)."""
+        return self.rtp_channel
+
+    def engine_writable(self) -> bool:
+        """True when raw fd writes cannot reorder around buffered
+        bytes: transport open, fd known, and the transport's user-space
+        write buffer fully drained."""
+        tr = self.transport
+        return (self.stream_fd >= 0 and not tr.is_closing()
+                and tr.get_write_buffer_size() == 0)
+
+    def push_tail(self, data: bytes) -> bool:
+        """Queue a torn packet's remaining bytes through the transport
+        (which then owns connection ordering).  False when the
+        transport died — the caller accounts the span as errored."""
+        tr = self.transport
+        if tr.is_closing():
+            return False
+        tr.write(data)
+        return True
 
     def _send(self, channel: int, chunks: tuple[bytes, ...]) -> WriteResult:
         tr = self.transport

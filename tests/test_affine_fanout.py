@@ -66,14 +66,18 @@ def test_packed_step_equals_dict_step():
     state = np.broadcast_to(state1[None], (n_src,) + state1.shape).copy()
 
     packed = np.asarray(fanout.relay_affine_step_packed(pre, ln, state))
-    assert packed.shape == (n_src, 3 * n_sub + 1)
-    seq_off, ts_off, ssrc, kf = fanout.unpack_affine(packed, n_sub)
+    assert packed.shape == (n_src, 4 * n_sub + 1)
+    seq_off, ts_off, ssrc, chan, kf = fanout.unpack_affine(packed, n_sub)
 
     import jax
     ref = jax.vmap(fanout.relay_affine_step)(pre, ln, state)
     np.testing.assert_array_equal(seq_off, np.asarray(ref["seq_off"]))
     np.testing.assert_array_equal(ts_off, np.asarray(ref["ts_off"]))
     np.testing.assert_array_equal(ssrc, np.asarray(ref["ssrc"]))
+    np.testing.assert_array_equal(chan, np.asarray(ref["chan"]))
+    # no interleave channel on these outputs: the chan column reads the
+    # CHAN_NONE sentinel everywhere
+    assert (np.asarray(chan) == fanout.CHAN_NONE).all()
     np.testing.assert_array_equal(
         kf.astype(np.int32), np.asarray(ref["newest_keyframe"]).astype(np.int32))
 

@@ -173,7 +173,7 @@ def test_hls_rendition_timelines_aligned_and_service_hygiene():
     assert svc.serve("/hls/cam/r2/index.m3u8") is not None
     assert set(svc.outputs["/cam"].renditions) == {"r2"}
     # (b) master upgrades to the full ladder
-    ct, master = svc.serve("/hls/cam/master.m3u8")
+    ct, master, _etag = svc.serve("/hls/cam/master.m3u8")
     assert master.count("#EXT-X-STREAM-INF") == 3
     assert set(svc.outputs["/cam"].renditions) == {"", "r1", "r2"}
     # (a) aligned timelines: tfdt of each rendition's first segment uses

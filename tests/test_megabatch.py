@@ -216,18 +216,20 @@ def test_scatter_affine_segments_roundtrip():
     keyframe sentinel through the uint32 wire format."""
     from easydarwin_tpu.models.relay_pipeline import scatter_affine_segments
     s_pad = 8
-    packed = np.zeros((2, 3 * s_pad + 1), np.uint32)
+    packed = np.zeros((2, 4 * s_pad + 1), np.uint32)
     packed[0, 0:3] = (10, 11, 12)              # seq_off
     packed[0, s_pad:s_pad + 3] = (20, 21, 22)  # ts_off
     packed[0, 2 * s_pad:2 * s_pad + 3] = (30, 31, 32)
-    packed[0, 3 * s_pad] = np.uint32(0xFFFFFFFF)   # kf = -1
-    packed[1, 3 * s_pad] = 5
+    packed[0, 3 * s_pad:3 * s_pad + 3] = (0, 2, 0xFFFFFFFF)  # chan
+    packed[0, 4 * s_pad] = np.uint32(0xFFFFFFFF)   # kf = -1
+    packed[1, 4 * s_pad] = 5
     segs = scatter_affine_segments(packed, [3, 2])
-    (sq, ts, sc, kf), (_sq2, _ts2, _sc2, kf2) = segs
+    (sq, ts, sc, ch, kf), (_sq2, _ts2, _sc2, _ch2, kf2) = segs
     assert sq.shape == (1, 3) and sq.flags.c_contiguous
     assert list(sq[0]) == [10, 11, 12]
     assert list(ts[0]) == [20, 21, 22]
     assert list(sc[0]) == [30, 31, 32]
+    assert list(ch[0]) == [0, 2, 0xFFFFFFFF]
     assert kf == -1 and kf2 == 5
 
 

@@ -310,6 +310,34 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: dvr.reopen_repacks {rp2} != 0 "
                             "(a spilled asset re-open ran pack_window; "
                             "the zero-repack contract is broken)")
+        # ISSUE 14 TCP delivery section — OPTIONAL (rounds predating
+        # the TCP/HTTP engine path stay valid), but when present: the
+        # engine-framed interleave rate and the per-session baseline
+        # are positive finite rates, the engine path beats the baseline
+        # (>= 3x is the acceptance pin), and the socket-level framing
+        # comparison found ZERO wire mismatches
+        td = extra.get("tcp_delivery")
+        if isinstance(td, dict) and td and "error" not in td:
+            eng_r = td.get("engine_pkts_per_sec")
+            base_r = td.get("baseline_pkts_per_sec")
+            for kf, v2 in (("engine_pkts_per_sec", eng_r),
+                           ("baseline_pkts_per_sec", base_r)):
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 <= 0:
+                    errs.append(f"{name}: tcp_delivery.{kf} {v2!r} not "
+                                "a positive finite rate")
+            if (isinstance(eng_r, (int, float))
+                    and isinstance(base_r, (int, float))
+                    and math.isfinite(eng_r) and math.isfinite(base_r)
+                    and base_r > 0 and eng_r < base_r):
+                errs.append(f"{name}: tcp_delivery engine rate {eng_r} "
+                            f"below the per-session baseline {base_r} "
+                            "(the engine path must win)")
+            mm2 = td.get("wire_mismatches", 0)
+            if mm2:
+                errs.append(f"{name}: tcp_delivery recorded {mm2} wire "
+                            "mismatches (engine framing must be byte-"
+                            "identical to the per-session path)")
         # ISSUE 13 rebalance section — OPTIONAL (rounds predating the
         # load-aware control plane stay valid), but when present: a
         # planned rebalance drain must be GAPLESS at the player socket,

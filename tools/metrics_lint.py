@@ -592,6 +592,48 @@ def lint_dvr(registry) -> list[str]:
     return errs
 
 
+#: closed backend/rung vocabulary for the stream-socket egress ladder
+#: (ISSUE 14): io_uring → writev → buffered (the per-send asyncio rung)
+STREAM_BACKENDS = ("io_uring", "writev", "buffered")
+
+
+def lint_tcp_delivery(registry, schema: dict) -> list[str]:
+    """The TCP/HTTP delivery contract (ISSUE 14): the stream-egress
+    families exist with exactly a ``backend``/``rung`` label whose
+    observed children stay inside the closed STREAM_BACKENDS set, the
+    TCP checkpoint-parity counter exists, and the ``ckpt.tcp_*`` events
+    are declared — ``tools/soak.py --mixed`` and the bench
+    ``extra.tcp_delivery`` section key on these."""
+    errs: list[str] = []
+    want_labels = {
+        "tcp_egress_packets_total": ("backend",),
+        "tcp_egress_bytes_total": ("backend",),
+        "tcp_egress_backpressure_sheds_total": ("backend",),
+        "hls_segment_egress_bytes_total": ("rung",),
+        "resilience_checkpoint_tcp_orphans_total": (),
+    }
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"tcp-delivery family {fam_name} missing from "
+                        "the registry")
+            continue
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+            continue
+        if labels:
+            for key in getattr(fam, "_values", {}):
+                if key and key[0] not in STREAM_BACKENDS:
+                    errs.append(f"{fam_name}: {labels[0]}={key[0]!r} not "
+                                f"in the closed set {STREAM_BACKENDS}")
+    for name in ("ckpt.tcp_reattach", "ckpt.tcp_orphan"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -700,6 +742,10 @@ def main() -> int:
     # the DVR / time-shift tier's vocabulary (ISSUE 12): spill/session
     # families + dvr.* events + the spill phase / dvr engine
     errs += lint_dvr(obs.REGISTRY)
+    # the TCP/HTTP delivery tier's vocabulary (ISSUE 14): stream-egress
+    # families with the closed io_uring/writev/buffered rung set + the
+    # checkpoint-parity counter and ckpt.tcp_* events
+    errs += lint_tcp_delivery(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -2231,6 +2231,274 @@ async def skewed_soak(n_nodes: int, seconds: float,
     return 1 if failures else 0
 
 
+async def mixed_soak(seconds: float) -> int:
+    """``--mixed`` (ISSUE 14): a combined UDP + interleaved-TCP + HLS
+    audience on ONE server with the engine paths on, and a mid-run
+    checkpoint migration — the server restarts on the SAME ports, the
+    UDP subscriber hot-restores without re-SETUP, and the TCP player
+    re-attaches with its old Session id for a gapless framed seq space.
+
+    Fails on: any TCP session drop (seq gap or ssrc change at the
+    interleaved player across the migration), any megabatch wire
+    mismatch, zero engine-path TCP packets (the framed writev rung must
+    actually serve), a starved player, or an HLS audience that never
+    got a segment / whose ETag revalidation never short-circuited."""
+    import json as json_mod
+    import tempfile
+
+    from easydarwin_tpu.codecs.h264_intra import encode_iframe as enc
+    from easydarwin_tpu.protocol import nalu as nalu_mod
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    rtsp_port, rest_port = free_port(), free_port()
+    log_folder = tempfile.mkdtemp(prefix="edtpu_mixed_soak_")
+
+    def make_cfg() -> ServerConfig:
+        return ServerConfig(
+            rtsp_port=rtsp_port, service_port=rest_port,
+            bind_ip="127.0.0.1", reflect_interval_ms=10,
+            bucket_delay_ms=0, access_log_enabled=False,
+            log_folder=log_folder, tpu_fanout=True, tpu_min_outputs=1,
+            resilience_checkpoint_enabled=True,
+            resilience_checkpoint_interval_sec=1.0)
+
+    failures: list[str] = []
+    base = f"rtsp://127.0.0.1:{rtsp_port}"
+    rest = f"http://127.0.0.1:{rest_port}"
+    # pre-encode the HLS feed's GOP cycle before the clock starts
+    cycle = [enc(synth_frame(i), 24) for i in range(8)]
+    seq_a = seq_b = 0
+    frame = 0
+    tcp_seqs: list[int] = []
+    tcp_ssrcs: set = set()
+    udp_rx = [0]
+    hls_state = {"segment_bytes": 0, "etag_304": 0, "etag": None,
+                 "seg_url": None}
+
+    async def start_server():
+        app = StreamingServer(make_cfg())
+        await app.start()
+        return app
+
+    async def connect_pushers(app):
+        pa = RtspClient()
+        await pa.connect("127.0.0.1", rtsp_port)
+        await pa.push_start(f"{base}/live/a", SDP)       # HLS feed
+        pb = RtspClient()
+        await pb.connect("127.0.0.1", rtsp_port)
+        await pb.push_start(f"{base}/live/b", SDP)       # audience feed
+        return pa, pb
+
+    def http_get(path: str, etag: str | None = None):
+        req = urllib.request.Request(rest + path)
+        if etag:
+            req.add_header("If-None-Match", etag)
+        try:
+            with urllib.request.urlopen(req, timeout=2.0) as r:
+                return r.status, r.read(), r.headers.get("ETag")
+        except urllib.error.HTTPError as e:
+            return e.code, b"", None
+
+    async def aget(path: str, etag: str | None = None):
+        # urllib is BLOCKING and the server shares this event loop — a
+        # loop-thread fetch would deadlock against the response it waits
+        # for, so every HTTP round-trip rides a worker thread
+        return await asyncio.to_thread(http_get, path, etag)
+
+    async def hls_poll():
+        # the HLS audience: start the ladder once, then poll playlist +
+        # newest segment with conditional GETs (the 304 short-circuit
+        # must fire on an unchanged window)
+        await aget("/api/v1/starthls?path=/live/a")
+        while True:
+            await asyncio.sleep(0.5)
+            st, body, _e = await aget("/hls/live/a/index.m3u8")
+            if st != 200 or b"#EXTINF" not in body:
+                continue
+            seg = [ln for ln in body.decode().splitlines()
+                   if ln.endswith(".m4s")]
+            if not seg:
+                continue
+            url = f"/hls/live/a/{seg[-1]}"
+            st2, data, etag = await aget(url)
+            if st2 == 200 and data:
+                hls_state["segment_bytes"] += len(data)
+                if etag:
+                    st3, _b3, _e3 = await aget(url, etag=etag)
+                    if st3 == 304:
+                        hls_state["etag_304"] += 1
+
+    def push_tick(pa, pb):
+        nonlocal seq_a, seq_b, frame
+        ts = int(frame * 3000)
+        for nal in cycle[frame % 8]:
+            for p in nalu_mod.packetize_h264(
+                    nal, seq=seq_a, timestamp=ts, ssrc=1,
+                    marker_on_last=(nal[0] & 0x1F == 5)):
+                seq_a += 1
+                pa.push_packet(0, p)
+        pkt = (struct.pack("!BBHII", 0x80, 96, seq_b & 0xFFFF, ts, 0xB)
+               + bytes([0x65]) + bytes(120))
+        seq_b += 1
+        pb.push_packet(0, pkt)
+        frame += 1
+
+    async def tcp_drain(player):
+        while True:
+            try:
+                p = await player.recv_interleaved(0, timeout=0.25)
+            except asyncio.TimeoutError:
+                continue
+            except Exception:
+                return
+            if len(p) >= 12:
+                tcp_seqs.append(struct.unpack("!H", p[2:4])[0])
+                tcp_ssrcs.add(p[8:12])
+
+    async def udp_drain(sock):
+        while True:
+            try:
+                sock.recv(65536)
+                udp_rx[0] += 1
+            except BlockingIOError:
+                await asyncio.sleep(0.01)
+            except OSError:
+                return
+
+    app = await start_server()
+    push_a, push_b = await connect_pushers(app)
+    tcp_player = RtspClient()
+    await tcp_player.connect("127.0.0.1", rtsp_port)
+    await tcp_player.play_start(f"{base}/live/b", tcp=True)
+    old_sid = tcp_player.session_id
+    u_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    u_rtp.bind(("127.0.0.1", 0))
+    u_rtp.setblocking(False)
+    u_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    u_rtcp.bind(("127.0.0.1", 0))
+    u_rtcp.setblocking(False)
+    udp_player = RtspClient()
+    await udp_player.connect("127.0.0.1", rtsp_port)
+    await udp_player.play_start(
+        f"{base}/live/b", tcp=False,
+        client_ports=[(u_rtp.getsockname()[1], u_rtcp.getsockname()[1])])
+    tasks = [asyncio.ensure_future(tcp_drain(tcp_player)),
+             asyncio.ensure_future(udp_drain(u_rtp)),
+             asyncio.ensure_future(hls_poll())]
+    t0 = time.time()
+    migrate_at = t0 + max(5.0, seconds * 0.45)
+    migrated = False
+    udp_rx_at_migration = 0
+    tcp_rx_at_migration = 0
+    try:
+        while time.time() - t0 < seconds:
+            push_tick(push_a, push_b)
+            await asyncio.sleep(0.03)
+            if not migrated and time.time() >= migrate_at:
+                migrated = True
+                # --- the migration: checkpoint + restart on same ports
+                assert app.checkpoint.write(app.registry)
+                tasks[0].cancel()
+                await push_a.close()
+                await push_b.close()
+                await tcp_player.close()
+                await app.stop()
+                udp_rx_at_migration = udp_rx[0]
+                tcp_rx_at_migration = len(tcp_seqs)
+                app = await start_server()
+                if app.registry.find("/live/b") is None:
+                    failures.append("migration: /live/b not restored")
+                if not app._pending_tcp:
+                    failures.append("migration: no kind=tcp record "
+                                    "parked for re-attach")
+                # TCP player re-attaches FIRST (old Session id), then
+                # the pushers resume their numbering
+                tcp_player = RtspClient()
+                await tcp_player.connect("127.0.0.1", rtsp_port)
+                tcp_player.session_id = old_sid
+                await tcp_player.play_start(f"{base}/live/b", tcp=True)
+                tasks[0] = asyncio.ensure_future(tcp_drain(tcp_player))
+                push_a, push_b = await connect_pushers(app)
+                await aget("/api/v1/starthls?path=/live/a")
+        await asyncio.sleep(0.5)
+    finally:
+        for t in tasks:
+            t.cancel()
+        try:
+            _st, _body, _e = await aget("/metrics")
+            metrics = parse_metrics(_body.decode())
+        except Exception:
+            metrics = {}
+        try:
+            await tcp_player.close()
+            await udp_player.close()
+            await push_a.close()
+            await push_b.close()
+        except Exception:
+            pass
+        await app.stop()
+        u_rtp.close()
+        u_rtcp.close()
+
+    # ---- verdicts ------------------------------------------------------
+    if not migrated:
+        failures.append("migration never ran (duration too short)")
+    if len(tcp_seqs) < 50:
+        failures.append(f"starved TCP player: {len(tcp_seqs)} pkts")
+    if len(tcp_seqs) - tcp_rx_at_migration < 10:
+        failures.append("TCP session dropped: no packets after the "
+                        "migration re-attach")
+    if udp_rx[0] - udp_rx_at_migration < 10:
+        failures.append("UDP subscriber starved after hot-restore")
+    if len(tcp_ssrcs) != 1:
+        failures.append(f"TCP player saw {len(tcp_ssrcs)} ssrcs "
+                        "(re-attach lost the subscriber identity)")
+    deltas = {(b - a) & 0xFFFF for a, b in zip(tcp_seqs, tcp_seqs[1:])}
+    if not deltas <= {1}:
+        failures.append(f"TCP seq gap/dup across migration: "
+                        f"{sorted(deltas)[:8]}")
+    mm = metrics.get("megabatch_wire_mismatch_total", 0.0)
+    if mm:
+        failures.append(f"megabatch_wire_mismatch_total = {mm}")
+    tcp_fast = sum(v for k, v in metrics.items()
+                   if k.startswith("tcp_egress_packets_total")
+                   and 'backend="buffered"' not in k)
+    if tcp_fast <= 0:
+        failures.append("zero engine-path TCP packets (framed "
+                        "writev/io_uring rung never served)")
+    if hls_state["segment_bytes"] <= 0:
+        failures.append("HLS audience never received a segment")
+    if hls_state["etag_304"] <= 0:
+        failures.append("HLS ETag revalidation never short-circuited")
+    hls_bytes = sum(v for k, v in metrics.items()
+                    if k.startswith("hls_segment_egress_bytes_total"))
+    if hls_bytes <= 0:
+        failures.append("hls_segment_egress_bytes_total never moved")
+
+    stats = {
+        "tcp_pkts": len(tcp_seqs), "udp_pkts": udp_rx[0],
+        "tcp_pkts_post_migration": len(tcp_seqs) - tcp_rx_at_migration,
+        "engine_tcp_pkts": tcp_fast,
+        "hls_segment_bytes": hls_state["segment_bytes"],
+        "hls_etag_304": hls_state["etag_304"],
+        "wire_mismatches": mm,
+    }
+    print("MIXED STATS", json_mod.dumps(stats))
+    if failures:
+        print("SOAK MIXED FAILURES:")
+        for f_ in failures:
+            print(f"  - {f_}")
+        return 1
+    print("SOAK MIXED OK")
+    return 0
+
+
 def _parse_args(argv: list[str]):
     import argparse
     ap = argparse.ArgumentParser(
@@ -2298,6 +2566,15 @@ def _parse_args(argv: list[str]):
                          "inject.py) and assert the degradation ladder "
                          "recovers to full service; same seed → same "
                          "injection schedule (default seed 7)")
+    ap.add_argument("--mixed", action="store_true",
+                    help="combined UDP + interleaved-TCP + HLS audience "
+                         "on one server with a mid-run checkpoint "
+                         "migration (ISSUE 14): the UDP subscriber "
+                         "hot-restores, the TCP player re-attaches with "
+                         "its old Session id; fails on any TCP session "
+                         "drop, seq gap, megabatch wire mismatch, "
+                         "starved player, or an HLS audience whose "
+                         "ETag revalidation never fired")
     ap.add_argument("--cluster", type=int, default=0, metavar="N",
                     help="multi-process cluster scenario instead: N "
                          "server processes + mini Redis, subscriber "
@@ -2357,6 +2634,8 @@ if __name__ == "__main__":
         raise SystemExit(asyncio.run(
             _cluster_node_main(_ns.node_id, _ns.redis_port,
                                _ns.fault_plan, _ns.skewed_child)))
+    if _ns.mixed:
+        raise SystemExit(asyncio.run(mixed_soak(_ns.duration)))
     if _ns.cluster:
         raise SystemExit(asyncio.run(
             cluster_soak(_ns.cluster, _ns.duration,
