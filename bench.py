@@ -250,7 +250,14 @@ def paired_rates(ring, lens, addrs, drain, *, force_cpu=False,
     sent_base = settle(drain)            # warmup fully drained first
     t0 = time.perf_counter()
     passes = 0
-    while time.perf_counter() - t0 < seconds:
+    # a starved host (2 vCPUs, drain thread sharing the send core) can
+    # take >10 s per pass+barrier cycle; the headline needs at least a
+    # few pairs (the first pass is discarded cold), so the window
+    # stretches on such boxes — bounded, and a no-op on any host that
+    # clears multiple passes inside the nominal window
+    MIN_PASSES = 4
+    while (time.perf_counter() - t0 < seconds or passes < MIN_PASSES) \
+            and time.perf_counter() - t0 < seconds * 5:
         # -- timed TPU pass ------------------------------------------------
         c0 = time.perf_counter()
         res_dev, t_dispatch = queue.pop(0)
@@ -1802,6 +1809,45 @@ def requant_drift_stats() -> dict:
     return out
 
 
+def composed_section(*, n_nodes: int = 2, seconds: float = 45.0) -> dict:
+    """ISSUE 15: the composed-workload observatory round — every engine
+    serving together across N REAL server processes (live relay +
+    3-rung HLS ladder + hot/cold VOD + DVR time-shift + TCP-interleaved
+    + a lossy-UDP player, flash crowd, mid-run owner SIGKILL), measured
+    and validated through the fleet observability layer itself.
+
+    The round IS ``tools/soak.py --composed`` (multi-process by
+    definition — per-tier rates, scaling efficiency and the gapless
+    migration can only be measured against real processes), so this
+    section runs it as a child and folds its ``COMPOSED STATS`` JSON
+    line into ``extra.composed``.  Any failure verdict in the soak
+    fails the section — a composed figure from a broken round would
+    poison the trajectory."""
+    import os
+    import sys
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "soak.py"),
+         "--composed", str(n_nodes), "--duration", str(seconds)],
+        capture_output=True, text=True, timeout=seconds + 240)
+    stats_line = verdict = None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("COMPOSED STATS "):
+            stats_line = line[len("COMPOSED STATS "):]
+        elif line.startswith("SOAK COMPOSED"):
+            verdict = line.split()[2] if len(line.split()) > 2 else "?"
+    if stats_line is None:
+        tail = (out.stdout or out.stderr or "")[-400:]
+        return {"error": f"composed soak produced no stats "
+                         f"(rc={out.returncode}): {tail!r}"}
+    doc = json.loads(stats_line)
+    if verdict != "OK":
+        fails = [ln.strip() for ln in (out.stdout or "").splitlines()
+                 if ln.startswith("  - ")]
+        doc["error"] = f"composed soak verdict {verdict}: {fails[:4]}"
+    return doc
+
+
 def run_with_timeout(fn, args, timeout_s, **kw):
     box = {}
 
@@ -1989,6 +2035,16 @@ def main():
     td_extra = td_box.get("result",
                           {"error": td_box.get("error", "unavailable")})
 
+    # ISSUE 15 composed-observatory section: the full mixed workload
+    # across 2 real server processes with a mid-run owner kill, measured
+    # through the fleet endpoint (BENCH_r06's new round).  Runs LAST of
+    # the heavy sections so its child processes never share the box with
+    # a timed in-process window.
+    cp_box = run_with_timeout(composed_section, (), 420.0) \
+        if have_native else {}
+    cp_extra = cp_box.get("result",
+                          {"error": cp_box.get("error", "unavailable")})
+
     rq_extra = rq_box.get("result",
                           {"h264_requant_note":
                            rq_box.get("error", "unavailable")})
@@ -2086,6 +2142,7 @@ def main():
             "dvr": dv2_extra,
             "fec": fc_extra,
             "tcp_delivery": td_extra,
+            "composed": cp_extra,
             **eng_extra,
             **rq_extra,
             **info,
@@ -2198,6 +2255,18 @@ def main():
             # multi_source's do
             "wire_mismatches", "error")
         if k in td}
+    cp = ex.get("composed") or {}
+    compact_extra["composed"] = {
+        k: cp[k] for k in (
+            "nodes", "tier_rates", "scaling_efficiency",
+            "migration_gap_packets", "mixed_p99_ms",
+            "e2e_freshness_p99_s", "unresolved_traces",
+            "fleet_nodes_live",
+            # the mismatch scalar and the error marker survive the
+            # compact projection for the same trajectory-gate reason
+            # multi_source's do
+            "wire_mismatches", "error")
+        if k in cp}
     compact_extra["details_file"] = "bench_details.json"
     print(json.dumps({
         "metric": details["metric"],

@@ -122,14 +122,23 @@ class RemotePull:
     """One locally-served remote stream: owns the restart loop around
     ``PullRelayManager`` for one path."""
 
+    #: seconds between upstream freshness polls (GET_PARAMETER
+    #: x-freshness on the live pull connection) — the chain feeds
+    #: relay_e2e_freshness_seconds{hops} and the fleet rollup
+    FRESHNESS_POLL_SEC = 1.0
+
     def __init__(self, path: str, resolve_url, manager,
                  config: PullConfig | None = None, *, seed: int = 0,
-                 on_failure=None, events=None):
+                 on_failure=None, events=None,
+                 peer_headers: dict | None = None):
         self.path = path
         self.resolve_url = resolve_url        # async () -> str | None
         self.manager = manager                # relay.pull.PullRelayManager
         self.config = config or PullConfig()
         self.on_failure = on_failure
+        #: cluster-peer identity headers forwarded to every pull's RTSP
+        #: requests (the upstream trace-acceptance gate, ISSUE 15)
+        self.peer_headers = dict(peer_headers or {})
         self._events = events if events is not None else obs.EVENTS
         self.backoff = Backoff(self.config, seed)
         self.breaker = CircuitBreaker(self.config.breaker_failures,
@@ -177,6 +186,14 @@ class RemotePull:
         pull = self.manager.pulls.get(self.path)
         return pull is not None and pull.alive
 
+    @property
+    def upstream_chain(self) -> list:
+        """The envelope re-owns the relay session, so the freshness
+        reader (obs.fleet.freshness_chain) finds the chain through the
+        session's owner — delegate to the live pull's polled copy."""
+        pull = self._pull
+        return getattr(pull, "upstream_chain", None) or []
+
     # -- the restart loop --------------------------------------------------
     async def _run(self) -> None:
         while not self._stopped:
@@ -196,7 +213,8 @@ class RemotePull:
             self.url = url
             try:
                 pull = await asyncio.wait_for(
-                    self.manager.start_pull(self.path, url, adopt=True),
+                    self.manager.start_pull(self.path, url, adopt=True,
+                                            peer_headers=self.peer_headers),
                     self.config.connect_timeout_sec)
             except Exception:
                 self._failure(url)
@@ -223,6 +241,7 @@ class RemotePull:
         poll = max(min(cfg.read_timeout_sec / 4, 1.0), 0.05)
         last_n = -1
         last_progress = time.monotonic()
+        last_fresh = 0.0
         settled = False
         from ..resilience import INJECTOR
         while pull.alive and not self._stopped:
@@ -230,6 +249,10 @@ class RemotePull:
             n = pull.client.stats.packets
             if INJECTOR.active and INJECTOR.pull_stall():
                 return True
+            now_f = time.monotonic()
+            if settled and now_f - last_fresh >= self.FRESHNESS_POLL_SEC:
+                last_fresh = now_f
+                await self._poll_freshness(pull)
             if n != last_n:
                 last_n = n
                 last_progress = time.monotonic()
@@ -243,6 +266,31 @@ class RemotePull:
             elif time.monotonic() - last_progress >= cfg.read_timeout_sec:
                 return True
         return False
+
+    async def _poll_freshness(self, pull) -> None:
+        """Fetch the upstream's per-stream freshness chain (RTSP
+        GET_PARAMETER ``x-freshness`` on the live pull connection) —
+        the ISSUE 15 hop-stamp transport.  Each answer is the origin's
+        chain for this path; the local session appends its own ingest
+        stamp on read (obs.fleet.freshness_chain).  Failures are
+        silent: freshness is telemetry, never pull health."""
+        import json
+        try:
+            r = await pull.client.request(
+                "GET_PARAMETER", self.url or pull.url,
+                {"content-type": "text/parameters"},
+                b"x-freshness", timeout=2.0)
+        except Exception:
+            return
+        if r.status != 200 or not r.body:
+            return
+        try:
+            chain = json.loads(r.body)
+        except ValueError:
+            return
+        if isinstance(chain, list):
+            pull.upstream_chain = [h for h in chain
+                                   if isinstance(h, dict)][:8]
 
     def _failure(self, url: str, *, stalled: bool = False) -> None:
         self.retries += 1

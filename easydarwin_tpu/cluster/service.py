@@ -42,6 +42,7 @@ import json
 import time
 
 from .. import obs
+from ..obs import fleet as fleet_mod
 from ..resilience.checkpoint import CKPT_VERSION, snapshot_session
 from .placement import OWN_KEY_PREFIX, PlacementService, own_key
 from .presence import FENCE_COUNTER_KEY, LeaseManager, PresenceService
@@ -266,6 +267,20 @@ class ClusterService:
         #: SYNCHRONOUSLY by the admission gate between ticks
         self.last_load: dict | None = None
         self.last_nodes: dict[str, dict] = {}
+        #: app hook (ISSUE 15): ``() -> dict`` — obs.fleet.build_rollup,
+        #: published into the fenced TTL'd Fleet:{node} record each
+        #: heartbeat; None = no federation (rollups stay per-process)
+        self.fleet_status = None
+        #: the last fleet aggregation (every peer's rollup + liveness/
+        #: staleness verdicts), read SYNCHRONOUSLY by /api/v1/fleet and
+        #: admin command=fleet — a scrape must never wait on Redis
+        self.last_fleet: dict = {}
+        #: nodes currently latched stale (lease dead, rollup persists)
+        #: so fleet.node_stale/node_live fire per TRANSITION, not tick
+        self._fleet_stale: set[str] = set()
+        #: what the LAST ownership scan recorded as each path's claim
+        #: holder — the trace stitcher's synchronous upstream map
+        self.owners: dict[str, str] = {}
         #: in-flight planned hand-offs: path -> (target, deadline) —
         #: the source keeps serving until the target's adoption clears
         #: the record's handoff marker (see _check_draining)
@@ -369,6 +384,10 @@ class ClusterService:
                  if k in load})
         self.last_load = load
         await self.lease.heartbeat()
+        # refresh the process-wide identity stamp (events/flight dumps):
+        # a lease loss re-acquires under a NEW fencing token, and the
+        # dedupe/attribution layers must see the current one
+        obs.set_node(self.config.node_id, self.lease.token or 0)
         nodes = await self.placement.live_nodes()
         self.last_nodes = nodes
         await self._claim_local_sources(nodes)
@@ -378,6 +397,7 @@ class ClusterService:
         if self.rebalancer is not None:
             await self.rebalancer.tick(nodes, load)
         await self._sweep_pulls()
+        await self._publish_fleet(nodes)
         # reference-shaped presence for the CMS tier.  Only locally-
         # SOURCED paths are advertised: a pull replica writing (and on
         # retirement DELETing) the owner's Live:{name} record would flap
@@ -474,7 +494,8 @@ class ClusterService:
     def _publish_cmd(self, path: str, token: int):
         """The pipeline-able checkpoint publish (fenced EVAL fset), or
         None when the session has nothing restorable."""
-        sess_doc = snapshot_session(self.registry, path)
+        sess_doc = snapshot_session(self.registry, path,
+                                    node_id=self.config.node_id)
         if sess_doc is None:
             return None
         doc = {"version": CKPT_VERSION,
@@ -583,6 +604,64 @@ class ClusterService:
             else:
                 self._fence_lost(path)
 
+    # -- fleet federation (ISSUE 15) ---------------------------------------
+    async def _publish_fleet(self, nodes: dict) -> None:
+        """Publish this node's rollup into the fenced TTL'd
+        ``Fleet:{node}`` record, then refresh the cached aggregate every
+        reader serves: each live peer's latest rollup plus the
+        staleness-marked last rollup of any node whose lease died while
+        its record's TTL still holds (last-known state, flagged — never
+        a fresh lie, never a silent hole)."""
+        if self.fleet_status is None:
+            return
+        from .redis_client import scan_fenced
+        cfg = self.config
+        try:
+            roll = self.fleet_status() or {}
+        except Exception as e:
+            self._warn(f"fleet rollup: {e!r}")
+            return
+        roll.update({"node": cfg.node_id, "fence": self.lease.token or 0,
+                     "ip": cfg.ip, "rtsp": cfg.rtsp_port,
+                     "http": cfg.http_port})
+        ttl = max(int(cfg.lease_ttl_sec * 3), int(cfg.heartbeat_sec * 3) + 1)
+        await self.redis.execute(
+            "EVAL", FENCE_SET_LUA, 1, fleet_mod.fleet_key(cfg.node_id),
+            int(self.lease.token or 0),
+            json.dumps(roll, separators=(",", ":")), ttl)
+        obs.FLEET_PUBLISHES.inc()
+        records = await scan_fenced(self.redis, fleet_mod.FLEET_KEY_PREFIX)
+        now = time.time()
+        agg: dict[str, dict] = {}
+        for key, (_tok, payload) in records.items():
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or not rec.get("node"):
+                continue
+            nid = str(rec["node"])
+            live = nid in nodes
+            rec["live"] = live
+            rec["age_sec"] = round(max(now - float(rec.get("ts") or now),
+                                       0.0), 1)
+            if not live:
+                rec["stale"] = True
+                if nid not in self._fleet_stale:
+                    self._fleet_stale.add(nid)
+                    self._events.emit("fleet.node_stale", level="warn",
+                                      node=nid, age=rec["age_sec"])
+            elif nid in self._fleet_stale:
+                self._fleet_stale.discard(nid)
+                self._events.emit("fleet.node_live", node=nid)
+            agg[nid] = rec
+        self.last_fleet = {"source": cfg.node_id,
+                           "ts": round(now, 3),
+                           "nodes": agg,
+                           "nodes_live": sum(1 for r in agg.values()
+                                             if r.get("live"))}
+        fleet_mod.refresh_gauges(agg)
+
     # -- migration ---------------------------------------------------------
     async def _migration_scan(self, nodes: dict) -> None:
         """Adopt any stream whose recorded owner's lease is gone and
@@ -593,6 +672,7 @@ class ClusterService:
         ring = self.placement.ring(nodes)
         records = await scan_fenced(self.redis, OWN_KEY_PREFIX)
         dvr_peers: dict[str, tuple[str, int, dict]] = {}
+        owners: dict[str, str] = {}
         for key, (_token, payload) in records.items():
             try:
                 rec = json.loads(payload)
@@ -602,6 +682,7 @@ class ClusterService:
                 continue            # corrupt record: skip, don't abort
             holder = str(rec["node"])
             path = "/" + key[len(OWN_KEY_PREFIX):]
+            owners[path] = holder
             # DVR peer-fill map (ISSUE 12): a LIVE peer advertising
             # spilled windows for this path can warm our cold opens
             # through its spill files instead of origin
@@ -630,6 +711,7 @@ class ClusterService:
                 continue                      # a different successor
             await self._adopt(path, holder)
         self.dvr_peers = dvr_peers
+        self.owners = owners
 
     async def _adopt(self, path: str, from_node: str, *,
                      planned: bool = False) -> None:
@@ -781,7 +863,11 @@ class ClusterService:
                 # same across processes (hash() is salt-randomized)
                 seed=zlib.crc32(
                     f"{self.config.node_id}#{path}".encode()) & 0xFFFF,
-                on_failure=self.on_pull_failure)
+                on_failure=self.on_pull_failure,
+                # cluster-peer identity for the upstream's trace gate:
+                # the origin tags its serving spans with OUR X-Trace-Id
+                # only when this header names a live lease (ISSUE 15)
+                peer_headers={"x-cluster-node": self.config.node_id})
             self.pulls[path] = rp
             rp.start()
         return rp

@@ -30,9 +30,31 @@ import threading
 import time
 from collections import deque
 
-#: envelope keys an event's free-form fields may never shadow
+#: envelope keys an event's free-form fields may never shadow.
+#: ``seq`` is the per-process monotonic record number (the NDJSON
+#: cursor a federating scraper pages with ``since=`` and uses to COUNT
+#: gaps instead of silently missing drops); ``node_id`` is the serving
+#: node's cluster identity (set once via :func:`set_node`) so a cluster
+#: soak's merged event streams stay attributable per node.
 RESERVED_KEYS = frozenset(("ts", "level", "event", "session", "stream",
-                           "trace", "invalid"))
+                           "trace", "invalid", "seq", "node_id"))
+
+#: process-wide node identity stamped onto every event record and
+#: flight dump: ``id`` = the cluster node id (ServerConfig.server_id),
+#: ``fence`` = the node's current lease fencing token (0 = no lease).
+#: Like REGISTRY/TRACER/FLIGHT this is process-global — only a server
+#: actually STARTING claims it (app.start), and the cluster service
+#: refreshes the fence each heartbeat.
+NODE: dict = {"id": None, "fence": 0}
+
+
+def set_node(node_id: str | None, fence: int | None = None) -> None:
+    """Claim the process's node identity (and optionally its current
+    lease fencing token) for event/flight attribution."""
+    if node_id is not None:
+        NODE["id"] = str(node_id)
+    if fence is not None:
+        NODE["fence"] = int(fence)
 
 LEVELS = ("debug", "info", "warn", "error")
 
@@ -139,6 +161,13 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # found at boot means a recorder died mid-write — the orphan is
     # reported, never silently deleted or served
     "record.orphan": ("file",),
+    # fleet federation (ISSUE 15, cluster/service.py): a peer whose
+    # lease died while its Fleet:{node} rollup still lives flips to
+    # stale (latched per transition, never per tick); coming back flips
+    # it live again.  The aggregate endpoint marks such rollups
+    # ``stale`` so dashboards show last-known state, never fresh lies.
+    "fleet.node_stale": ("node",),
+    "fleet.node_live": ("node",),
 }
 
 
@@ -150,6 +179,10 @@ class EventLog:
         self._lock = threading.Lock()
         self._sinks: list = []
         self.dropped = 0
+        #: last assigned per-process sequence number (record envelope
+        #: ``seq`` — assigned under the ring lock, so ring order and seq
+        #: order agree and a ``since=`` cursor slices correctly)
+        self.seq = 0
 
     # -- wiring ------------------------------------------------------
     def add_sink(self, fn) -> None:
@@ -183,7 +216,11 @@ class EventLog:
         for k in RESERVED_KEYS:
             fields.pop(k, None)         # envelope keys stay authoritative
         rec.update(fields)
+        if NODE["id"] is not None:
+            rec["node_id"] = NODE["id"]
         with self._lock:
+            self.seq += 1
+            rec["seq"] = self.seq
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
                 families.EVENTS_DROPPED.inc()
@@ -201,19 +238,35 @@ class EventLog:
     def __len__(self) -> int:
         return len(self._ring)
 
-    def tail(self, n: int | None = None) -> list[dict]:
+    def tail(self, n: int | None = None,
+             since: int | None = None) -> list[dict]:
         """Newest-last snapshot of the last ``n`` records (all if None;
-        n <= 0 is empty — recs[-0:] would be the whole ring)."""
+        n <= 0 is empty — recs[-0:] would be the whole ring).  ``since``
+        keeps only records with ``seq > since`` — the NDJSON cursor: a
+        scraper pages with the last seq it saw, and a jump in seq
+        numbers (or ``self.dropped`` growing) tells it exactly how many
+        records the bounded ring evicted before it came back.
+
+        With a cursor the page is the OLDEST ``n`` matching records —
+        a scraper more than ``n`` behind advances through everything
+        still in the ring instead of skipping to the newest page and
+        miscounting the skipped middle as drops.  Without a cursor the
+        call is a tail (newest ``n``), as before."""
         with self._lock:
             recs = list(self._ring)
+        if since is not None:
+            recs = [r for r in recs if r.get("seq", 0) > since]
         if n is None:
             return recs
-        return recs[-n:] if n > 0 else []
+        if n <= 0:
+            return []
+        return recs[:n] if since is not None else recs[-n:]
 
-    def dump_lines(self, n: int | None = None) -> list[str]:
+    def dump_lines(self, n: int | None = None,
+                   since: int | None = None) -> list[str]:
         """JSON-lines rendering (one compact JSON object per record)."""
         return [json.dumps(r, separators=(",", ":"), default=str)
-                for r in self.tail(n)]
+                for r in self.tail(n, since)]
 
     def clear(self) -> None:
         with self._lock:

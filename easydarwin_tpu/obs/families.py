@@ -443,6 +443,43 @@ FLIGHT_DUMPS = REGISTRY.counter(
     "flight_dumps_total",
     "Per-session flight-recorder dumps written on abnormal teardown "
     "(timeout sweep, uncaught exception, hard protocol error)")
+FLIGHT_DUMPS_DEDUPED = REGISTRY.counter(
+    "flight_dumps_deduped_total",
+    "Flight dumps skipped because another node already holds the same "
+    "session's dump under a newer-or-equal fencing token (the "
+    "migration dedupe guard — one black box per dead session, never a "
+    "shadowing duplicate)")
+
+# ---------------------------------------------------- fleet observability
+# Cross-node federation (ISSUE 15: obs/fleet.py + cluster/service.py).
+# Each node publishes a compact rollup into a TTL'd fenced Fleet:{node}
+# record every heartbeat; any node's GET /api/v1/fleet aggregates the
+# live topology.  tools/metrics_lint.py enforces this family set
+# (lint_fleet: exact labels, tier vocabulary closed to FLEET_TIERS,
+# digit-only hop labels) and tools/soak.py --composed keys on it.
+FLEET_NODES_LIVE = REGISTRY.gauge(
+    "fleet_nodes_live",
+    "Cluster nodes with a live lease at the last fleet aggregation "
+    "(dead nodes' rollups persist staleness-marked until their "
+    "Fleet:{node} TTL expires)")
+FLEET_STREAMS = REGISTRY.gauge(
+    "fleet_streams_total",
+    "Streams currently served across all LIVE nodes' fleet rollups, by "
+    "serving tier (live = locally-sourced relays, pull = relay-tree "
+    "edge pulls, vod = pacer-served file sessions, dvr = time-shift "
+    "sessions, hls = segmenter outputs)", labels=("tier",))
+FLEET_PUBLISHES = REGISTRY.counter(
+    "fleet_publishes_total",
+    "Fleet rollup records published into the fenced Fleet:{node} key "
+    "(one per cluster heartbeat while the lease holds)")
+RELAY_E2E_FRESHNESS = REGISTRY.histogram(
+    "relay_e2e_freshness_seconds",
+    "End-to-end staleness of each actively-relaying stream measured "
+    "against the FIRST hop of its freshness chain (pusher ingest at "
+    "the origin -> this node's wire), by chain length; hops=1 is a "
+    "locally-sourced stream, hops>=2 a relay-tree edge reading the "
+    "origin's stamp through the pull's freshness poll",
+    labels=("hops",))
 
 # ------------------------------------------------------------- resilience
 # The fault-injection / degradation-ladder / checkpoint subsystem

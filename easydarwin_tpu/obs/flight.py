@@ -25,7 +25,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 
-from .events import EVENTS
+from .events import EVENTS, NODE
 from .trace import TRACER
 
 #: events kept per live session (the ISSUE's ~256 black-box window)
@@ -103,6 +103,12 @@ class FlightRecorder:
             "trace": box.trace_id,
             "reason": reason,
             "ts": round(time.time(), 6),
+            # node identity + fencing token (ISSUE 15): a cluster soak
+            # collects dumps from N nodes into one place — without
+            # these, two nodes' dumps for one migrated session are
+            # indistinguishable
+            "node_id": NODE["id"],
+            "fence": NODE["fence"],
             "meta": box.meta,
             "events": list(box.ring) if events is None else events,
             "spans": self._span_summaries(box.trace_id),
@@ -127,14 +133,37 @@ class FlightRecorder:
             else:
                 box = self._live.pop(session_id, None)
                 events = None
+            # migration dedupe guard (ISSUE 15): during a live migration
+            # the SAME session id can be flagged on two nodes (the dying
+            # owner's sweep and the adopter's SLO flag race each other);
+            # a dump already held under a NEWER-or-equal fence from a
+            # DIFFERENT node is the authoritative black box — a second
+            # document would just shadow it in every by-session lookup.
+            # Scope: this guards the SHARED-recorder topology (multiple
+            # in-process servers — the e2e/test shape — or a merged
+            # collection the operator loads back); separate processes
+            # never collide in memory, and their on-disk dumps are
+            # disambiguated by the node id in the filename instead.
+            prior = self.dumps.get(session_id)
+            if (box is not None and prior is not None
+                    and prior.get("node_id") not in (None, NODE["id"])
+                    and int(prior.get("fence") or 0)
+                    >= int(NODE["fence"] or 0)):
+                families.FLIGHT_DUMPS_DEDUPED.inc()
+                return prior
         if box is None:
             return None
         doc = self._doc(session_id, box, reason, events)
         path = None
+        node_tag = f"{NODE['id']}_" if NODE["id"] else ""
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
+            # node id + timestamp in the name: a cluster soak's shared
+            # collection directory never collides two nodes' dumps for
+            # one migrated session
             path = os.path.join(
-                self.dump_dir, f"flight_{session_id}_{int(time.time())}.json")
+                self.dump_dir,
+                f"flight_{node_tag}{session_id}_{int(time.time())}.json")
             # compact, one write: this runs on the event loop during
             # teardown (timeout sweeps dump several sessions per pass),
             # so the file must cost one small sequential write, not a

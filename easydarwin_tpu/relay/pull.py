@@ -54,13 +54,24 @@ class PullRelay:
     """One upstream pull session (EasyRelaySession equivalent)."""
 
     def __init__(self, local_path: str, url: str, registry: SessionRegistry,
-                 *, on_packet=None):
+                 *, on_packet=None, peer_headers: dict | None = None):
         self.local_path = local_path
         self.url = url
         self.registry = registry
         self.on_packet = on_packet          # pump-wake hook
-        #: correlation id for this pull's session/spans/events
+        #: correlation id for this pull's session/spans/events.  Minted
+        #: locally, then REPLACED by the upstream stream's trace when
+        #: the DESCRIBE reply carries one (ISSUE 15): every hop of a
+        #: relay tree correlates under the ORIGIN's trace id.
         self.trace_id = secrets.token_hex(8)
+        #: the upstream freshness chain (origin hop first), refreshed by
+        #: the cluster envelope's GET_PARAMETER x-freshness poll; the
+        #: local session's chain = this + the local ingest stamp
+        self.upstream_chain: list[dict] = []
+        #: cluster-peer identification headers (X-Cluster-Node) the
+        #: upstream's trace-acceptance gate requires; {} outside the
+        #: cluster envelope (a plain startpullrelay sends none)
+        self.peer_headers = dict(peer_headers or {})
         self.client = RtspClient()
         self.session: RelaySession | None = None
         self.started_at = time.time()
@@ -72,6 +83,12 @@ class PullRelay:
     async def start(self, timeout: float = 10.0) -> None:
         host, port, _path = parse_rtsp_url(self.url)
         self.client.enable_any_queue()      # before any packet can arrive
+        # carry the trace upstream on every request: the owner's serving
+        # connection tags its spans/events with the SAME id this edge
+        # serves under (accepted only when peer_headers prove cluster
+        # membership — see rtsp._adopt_peer_trace)
+        self.client.default_headers = {**self.peer_headers,
+                                       "x-trace-id": self.trace_id}
         try:
             await asyncio.wait_for(self.client.connect(host, port), timeout)
             sd = await self.client.play_start(self.url, tcp=True)
@@ -87,6 +104,14 @@ class PullRelay:
         if not sd.streams:
             await self.client.close()
             raise PullError(f"upstream {self.url}: SDP has no streams")
+        # downstream trace adoption (ISSUE 15): play_start swapped the
+        # client's X-Trace-Id to the upstream STREAM's id (from the
+        # DESCRIBE reply) before the SETUPs went out — serve the local
+        # replica under the same id, so subscriber-facing spans here and
+        # the origin's pusher spans stitch as one trace
+        up = self.client.default_headers.get("x-trace-id", "")
+        if up and up != self.trace_id:
+            self.trace_id = up
         for i, st in enumerate(sd.streams):
             self._channel_map[2 * i] = (st.track_id, False)
             self._channel_map[2 * i + 1] = (st.track_id, True)
@@ -177,7 +202,8 @@ class PullRelayManager:
         self._lock = asyncio.Lock()         # concurrent REST start/stop
 
     async def start_pull(self, local_path: str, url: str, *,
-                         adopt: bool = False) -> PullRelay:
+                         adopt: bool = False,
+                         peer_headers: dict | None = None) -> PullRelay:
         """``adopt=True`` (the cluster pull envelope) reuses an existing
         session on the path instead of refusing it: a restarted pull
         must feed the SAME session so local subscribers survive the
@@ -196,7 +222,8 @@ class PullRelayManager:
             elif not adopt and self.registry.find(key) is not None:
                 raise PullError(f"{key} already has a live session")
             pull = PullRelay(key, url, self.registry,
-                             on_packet=self.on_packet)
+                             on_packet=self.on_packet,
+                             peer_headers=peer_headers)
             try:
                 await pull.start()
             except asyncio.CancelledError:

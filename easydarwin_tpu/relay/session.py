@@ -37,6 +37,10 @@ class RelaySession:
         #: span and lifecycle event of this source.  A feeder that owns a
         #: trace (ANNOUNCE pusher, pull relay) re-stamps via set_trace().
         self.trace_id = secrets.token_hex(8)
+        #: node ids this stream's trace has lived on (ISSUE 15): grown
+        #: by checkpoint restore/migration so a stitched trace names
+        #: every server that ever carried the stream under this id
+        self.trace_nodes: list[str] = []
         self.streams: dict[int, RelayStream] = {}
         for info in description.streams:
             self.streams[info.track_id] = RelayStream(info, self.settings)

@@ -106,19 +106,33 @@ def _snapshot_stream(st) -> dict:
     }
 
 
-def snapshot_session(registry, path: str) -> dict | None:
+def snapshot_session(registry, path: str, *,
+                     node_id: str | None = None) -> dict | None:
     """One session's serializable record (the cluster tier publishes
     these per-stream to Redis for migration); None when the session is
-    missing or not restorable (no cached SDP)."""
+    missing or not restorable (no cached SDP).
+
+    Trace lineage (ISSUE 15): the record carries the stream's trace id
+    and the node ids it has lived on (``node_id`` appended when given),
+    so an adoption/hot-restore keeps correlating under the SAME trace —
+    a stitched multi-hop trace spans the migration instead of breaking
+    at it."""
     sess = registry.find(path)
     if sess is None:
         return None
     sdp = registry.sdp_cache.get(sess.path)
     if sdp is None:
         return None
+    lineage = list(getattr(sess, "trace_nodes", ()) or ())
+    if node_id is None:
+        node_id = obs.NODE["id"]
+    if node_id and (not lineage or lineage[-1] != node_id):
+        lineage.append(str(node_id))
     return {
         "path": sess.path,
         "sdp": sdp,
+        "trace": sess.trace_id,
+        "trace_nodes": lineage,
         "streams": [_snapshot_stream(st) for st in sess.streams.values()],
     }
 
@@ -214,6 +228,14 @@ def restore_registry(registry, doc: dict, *, output_factory=None,
             obs.RESILIENCE_CKPT_ERRORS.inc()
             continue
         n_sess += 1
+        # trace lineage survives the restore: the stream keeps the trace
+        # id it was born with, so spans/events recorded on the previous
+        # owner and on this node stitch under ONE id (ISSUE 15)
+        trace = srec.get("trace")
+        if trace:
+            sess.set_trace(str(trace))
+            sess.trace_nodes = [str(n) for n in
+                                (srec.get("trace_nodes") or ())]
         by_track = {s.get("track"): s for s in srec.get("streams", ())}
         for tid, st in sess.streams.items():
             rec = by_track.get(tid)

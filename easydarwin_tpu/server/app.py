@@ -211,8 +211,12 @@ class StreamingServer:
         # so only a server actually STARTING claims the directory; a
         # merely-constructed instance never redirects a running one's
         import os
-        from ..obs import FLIGHT
+        from ..obs import FLIGHT, set_node
         FLIGHT.dump_dir = os.path.join(self.config.log_folder, "flight")
+        # claim the process-wide node identity for event/flight
+        # attribution (ISSUE 15) — same starting-server-wins rule as the
+        # dump dir; the cluster heartbeat refreshes the fence token
+        set_node(self.config.server_id)
         # plugins register before the listeners accept anything, so their
         # filter/authorize hooks cover every request (the reference loads
         # modules before CreateListeners' ports go live too)
@@ -385,6 +389,13 @@ class StreamingServer:
             self.cluster.load_status = self.load_tracker.sample
             if ccfg.admission_enabled:
                 self.rtsp.admission = self._admission_verdict
+            # fleet federation (ISSUE 15): the rollup published into
+            # Fleet:{node} each heartbeat, and the gate that lets live
+            # peers' pulls thread their trace ids into this node
+            from ..obs import fleet as fleet_mod
+            self.cluster.fleet_status = \
+                lambda: fleet_mod.build_rollup(self)
+            self.rtsp.peer_trace_gate = self._peer_trace_gate
             await self.cluster.start()
             self.rtsp.describe_fallback = self._cluster_describe
         elif self.config.cloud_enabled:
@@ -557,6 +568,15 @@ class StreamingServer:
         n_sess, n_out = restore_registry(
             self.registry, doc, output_factory=self._restored_output,
             tcp_sink=self._park_tcp_record)
+        # trace lineage (ISSUE 15): the adopted streams now live HERE —
+        # extend their node lineage so a stitched trace names both the
+        # dead owner and this adopter under the one preserved trace id
+        for p in paths:
+            sess = self.registry.find(p) if p else None
+            if sess is not None and (not sess.trace_nodes
+                                     or sess.trace_nodes[-1]
+                                     != self.config.server_id):
+                sess.trace_nodes.append(self.config.server_id)
         if n_out:
             self._adopt_restored_outputs(paths=paths, exclude_ids=pre)
         self._wake()
@@ -610,6 +630,19 @@ class StreamingServer:
         if text is None and self._user_describe_fallback is not None:
             text = await self._user_describe_fallback(path)
         return text
+
+    def _peer_trace_gate(self, node_id: str, client_ip: str) -> bool:
+        """X-Trace-Id acceptance (ISSUE 15): the request must name a
+        LIVE-leased cluster node in X-Cluster-Node AND arrive from that
+        node's registered lease address — node ids are public (the
+        fleet endpoint lists them), so the name alone is forgeable; the
+        source address binds the claim to the peer's actual socket.
+        (Co-located nodes sharing one address — the test topology —
+        still cannot be forged from off-box.)"""
+        if not node_id or self.cluster is None:
+            return False
+        meta = self.cluster.last_nodes.get(node_id)
+        return isinstance(meta, dict) and meta.get("ip") == client_ip
 
     def _admission_verdict(self, path: str, client_key: str
                            ) -> tuple[str, str | None] | None:
@@ -1202,6 +1235,15 @@ class StreamingServer:
                     except Exception as e:
                         if self.error_log:
                             self.error_log.warning(f"slo tick: {e!r}")
+                try:
+                    # per-stream end-to-end freshness (ISSUE 15): one
+                    # observation per actively-relaying stream per
+                    # second, hop count from the freshness chain
+                    from ..obs import fleet as fleet_mod
+                    fleet_mod.observe_freshness(self)
+                except Exception as e:
+                    if self.error_log:
+                        self.error_log.warning(f"freshness: {e!r}")
                 if self.ladder is not None:
                     try:
                         self._ladder_maintenance()

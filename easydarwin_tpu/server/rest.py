@@ -87,6 +87,35 @@ class RestApi:
         obs.HLS_SEGMENT_EGRESS_BYTES.inc(len(mv), rung=rung)
         return rung
 
+    #: content types the scrape-compression satellite covers: the
+    #: Prometheus exposition and the NDJSON event feeds (big, highly
+    #: repetitive, fetched every few seconds by federating scrapers).
+    #: HLS bodies must NOT be here (the zero-copy stream-egress path
+    #: sends them verbatim) and the pprof endpoint is already gzipped.
+    _GZIP_CTYPES = ("text/plain", "application/x-ndjson")
+    #: below this a gzip header costs more than it saves
+    _GZIP_MIN_BYTES = 256
+
+    def _maybe_gzip(self, headers: dict, status: int, ctype: str,
+                    data: bytes) -> tuple[bytes, dict | None]:
+        """Compress a /metrics or NDJSON response body when the client
+        asked for it (``Accept-Encoding: gzip``).  Returns the (possibly
+        compressed) body + the extra response headers; identity when
+        compression would not help or does not apply."""
+        if (status != 200 or not data or len(data) < self._GZIP_MIN_BYTES
+                or not (ctype or "").startswith(self._GZIP_CTYPES)):
+            return data, None
+        accept = headers.get("accept-encoding", "")
+        if "gzip" not in accept.lower():
+            return data, None
+        import gzip
+        # mtime=0: deterministic bytes, so scrape-cost tests can pin size
+        packed = gzip.compress(data, 6, mtime=0)
+        if len(packed) >= len(data):
+            return data, None
+        return packed, {"Content-Encoding": "gzip",
+                        "Vary": "Accept-Encoding"}
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._on_connection, self.config.bind_ip,
@@ -125,6 +154,9 @@ class RestApi:
                 if ctype is None:
                     ctype = ("text/html" if data[:2] in (b"<!", b"<h")
                              else "application/json")
+                data, enc_hdrs = self._maybe_gzip(headers, status, ctype,
+                                                  data)
+                extra = {**(extra or {}), **enc_hdrs} if enc_hdrs else extra
                 reason = {200: "OK", 304: "Not Modified"}.get(status,
                                                               "Error")
                 head = (
@@ -225,11 +257,22 @@ class RestApi:
             return 401, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_UNAUTHORIZED)
         # per-session trace retrieval: GET /api/v1/sessions/<id>/trace
         # (the flight recorder's REST face; raw JSON, not the envelope,
-        # so operators can pipe it straight to jq / a file)
+        # so operators can pipe it straight to jq / a file).  Under
+        # cluster mode the document is STITCHED (ISSUE 15): the local
+        # hop plus every upstream hop of the stream's relay tree,
+        # fetched through the peers' /api/v1/streamtrace endpoints —
+        # ``local=1`` skips the stitch (the inter-node fetch uses it).
         m = _SESSION_TRACE_RE.match(cmd)
         if m is not None:
             from . import admin
             status, doc = admin.flight_query(self.app, m.group(1))
+            if status == 200 and params.get("local", ["0"])[0] \
+                    not in ("1", "true"):
+                from ..obs import fleet
+                try:
+                    doc = await fleet.stitch_trace(self.app, doc)
+                except Exception:
+                    pass            # the local document still answers
             return status, json.dumps(doc, default=str), "application/json"
         if self.config.auth_enabled and self._mutates(cmd, params) \
                 and headers.get("x-token") not in self.tokens:
@@ -288,6 +331,64 @@ class RestApi:
         from . import admin
         return (200, json.dumps(admin.profile_snapshot(self.app),
                                 default=str), "application/json")
+
+    def _cmd_fleet(self, params: dict,
+                   body: bytes) -> tuple[int, str, str]:
+        """GET /api/v1/fleet — the aggregated cluster topology (ISSUE
+        15): every node's latest rollup with liveness/staleness
+        verdicts, served from the cluster tick's cache (a read never
+        waits on Redis).  Standalone servers answer a single-node
+        fleet of the same shape.  Raw JSON for jq pipelines."""
+        from ..obs import fleet
+        return (200, json.dumps(fleet.fleet_snapshot(self.app),
+                                default=str), "application/json")
+
+    def _cmd_streamtrace(self, params: dict,
+                         body: bytes) -> tuple[int, str, str] | tuple[int, str]:
+        """GET /api/v1/streamtrace?path= — this node's single hop of a
+        stream's stitched trace (trace id, lineage, freshness chain,
+        trace-tagged spans/events, the upstream node when pulled).
+        This is the inter-node stitching wire the sessions/<id>/trace
+        endpoint follows hop by hop."""
+        from ..obs import fleet
+        path = params.get("path", [""])[0]
+        if not path:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST)
+        doc = fleet.local_hop_doc(self.app, path)
+        status = 404 if doc.get("error") else 200
+        return status, json.dumps(doc, default=str), "application/json"
+
+    @staticmethod
+    def _page_params(params: dict) -> tuple[int, int | None]:
+        """The ONE parser for the event log's (n, since) paging query —
+        /api/v1/events and admin command=events must never drift on
+        cursor semantics."""
+        try:
+            n = int(params.get("n", ["256"])[0])
+        except ValueError:
+            n = 256
+        since = None
+        try:
+            if "since" in params:
+                since = int(params["since"][0])
+        except ValueError:
+            since = None
+        return n, since
+
+    def _cmd_events(self, params: dict,
+                    body: bytes) -> tuple[int, str, str]:
+        """GET /api/v1/events?n=&since= — the structured event log as
+        NDJSON.  Every record carries a monotonic per-process ``seq``;
+        a federating scraper pages with ``since=<last seq seen>``
+        (oldest-first pages, so a scraper far behind catches up through
+        the ring) and COUNTS gaps from the seq jumps (plus
+        events_dropped_total) instead of silently missing ring
+        evictions."""
+        from ..obs import EVENTS
+        n, since = self._page_params(params)
+        lines = EVENTS.dump_lines(n, since)
+        return (200, "\n".join(lines) + ("\n" if lines else ""),
+                "application/x-ndjson")
 
     def _cmd_getserverinfo(self, params: dict, body: bytes) -> tuple[int, str]:
         st = self.app.server_info()
@@ -550,14 +651,19 @@ class RestApi:
                 self.app, params.get("session", [""])[0])
             return status, json.dumps(doc, default=str), "application/json"
         if command == "events":
-            # structured event log tail as JSON lines (newest last)
+            # structured event log as JSON lines; since=<seq> pages
+            # from a cursor exactly like /api/v1/events (one parser)
             from ..obs import EVENTS
-            try:
-                n = int(params.get("n", ["256"])[0])
-            except ValueError:
-                n = 256
-            return (200, "\n".join(EVENTS.dump_lines(n)) + "\n",
+            n, since = self._page_params(params)
+            lines = EVENTS.dump_lines(n, since)
+            return (200, "\n".join(lines) + ("\n" if lines else ""),
                     "application/x-ndjson")
+        if command == "fleet":
+            # aggregated cluster topology (ISSUE 15) — raw JSON for the
+            # same pipe-to-jq reason as command=trace
+            from ..obs import fleet
+            return (200, json.dumps(fleet.fleet_snapshot(self.app),
+                                    default=str), "application/json")
         if command == "top":
             # live phase/session attribution snapshot (raw JSON for the
             # same pipe-to-jq reason as command=trace)

@@ -242,8 +242,30 @@ class RtspConnection:
         self.writer.write(resp.to_bytes())
 
     # ----------------------------------------------------------- dispatch
+    def _adopt_peer_trace(self, req: rtsp.RtspRequest) -> None:
+        """Cross-node trace propagation (ISSUE 15): a cluster peer's
+        pull carries the stream's trace id upstream as ``X-Trace-Id``;
+        this connection adopts it so its spans/events/flight box stitch
+        into the same multi-hop trace.  Accepted ONLY from cluster
+        peers: the request must name a live-leased node in
+        ``X-Cluster-Node`` AND arrive from that node's registered lease
+        address (node ids are public, so the name alone would be
+        forgeable — see app._peer_trace_gate)."""
+        from ..utils.client import hexish
+        tid = req.headers.get("x-trace-id", "").strip()
+        if not tid or tid == self.trace_id:
+            return
+        gate = getattr(self.server, "peer_trace_gate", None)
+        if gate is None or not gate(req.headers.get("x-cluster-node", ""),
+                                    self.client_ip):
+            return
+        if not hexish(tid):
+            return
+        self.trace_id = tid
+
     async def _dispatch(self, req: rtsp.RtspRequest) -> None:
         self.server.stats["requests"] += 1
+        self._adopt_peer_trace(req)
         handler = getattr(self, f"_do_{req.method.lower()}", None)
         if handler is None:
             self._reply(rtsp.RtspResponse(501), req.cseq)
@@ -306,6 +328,23 @@ class RtspConnection:
         self._reply(rtsp.RtspResponse(200, {"Public": ALLOWED}), req.cseq)
 
     async def _do_get_parameter(self, req: rtsp.RtspRequest) -> None:
+        body = (req.body or b"").decode("utf-8", "replace").lower()
+        if "x-freshness" in body:
+            # the freshness-chain hop transport (ISSUE 15): answer this
+            # stream's chain (origin hop first) so a downstream relay-
+            # tree edge can append its own stamp — no media-wire change
+            import json as json_mod
+            from ..protocol.sdp import _norm
+            path = self.path or _norm(req.path())
+            sess = self.server.registry.find(path)
+            if sess is not None:
+                from ..obs import fleet
+                chain = fleet.freshness_chain(
+                    sess, self.server.config.server_id)
+                self._reply(rtsp.RtspResponse(
+                    200, {"Content-Type": "application/json"},
+                    json_mod.dumps(chain).encode()), req.cseq)
+                return
         self._reply(rtsp.RtspResponse(200), req.cseq)
 
     async def _do_set_parameter(self, req: rtsp.RtspRequest) -> None:
@@ -318,21 +357,40 @@ class RtspConnection:
             self._reply(rtsp.RtspResponse(404), req.cseq)
             return
         self.path = sdp._norm(path)
+        extra = {}
+        sess = self.server.registry.find(self.path)
+        if sess is not None:
+            # downstream trace propagation (ISSUE 15): the reply names
+            # the stream's trace id so a pulling edge serves its local
+            # replica under the SAME id — informational for everyone
+            # else (an id grants nothing; acceptance upstream is gated)
+            extra["X-Trace-Id"] = sess.trace_id
         self._reply(rtsp.RtspResponse(200, {
             "Content-Type": "application/sdp",
             "Content-Base": req.uri.rstrip("/") + "/",
+            **extra,
         }, text.encode()), req.cseq)
 
     async def _do_announce(self, req: rtsp.RtspRequest) -> None:
         if not req.body:
             raise rtsp.RtspError(400, "ANNOUNCE without SDP")
         path = req.path()
+        existing = self.server.registry.find(sdp._norm(path))
         self.relay = self.server.registry.find_or_create(
             path, req.body.decode("utf-8", "replace"))
         self.relay.owner = self         # ANNOUNCE takes ownership (adoption)
-        # ownership carries the trace: engine-pass / native-egress spans
-        # for this broadcast now correlate to THIS pusher connection
-        self.relay.set_trace(self.trace_id)
+        if existing is self.relay:
+            # adopting a live session (re-ANNOUNCE after a migration /
+            # restart / pull supersede): the STREAM's trace id is minted
+            # once and survives feeder changes — the connection adopts
+            # it, so a stitched trace spans the handover instead of
+            # breaking at it (ISSUE 15 lineage)
+            self.trace_id = self.relay.trace_id
+        else:
+            # fresh session: ownership carries the trace — engine-pass /
+            # native-egress spans for this broadcast correlate to THIS
+            # pusher connection
+            self.relay.set_trace(self.trace_id)
         self.path = self.relay.path
         self.is_pusher = True
         self.server.stats["pushers"] += 1
@@ -1073,6 +1131,10 @@ class RtspServer:
         #: cluster mode: ``(path, client_key) -> None | (action, url)``;
         #: None = every SETUP admitted (standalone behavior)
         self.admission = None
+        #: cross-node trace acceptance gate (ISSUE 15) — set by the app
+        #: under cluster mode: ``(x_cluster_node_header) -> bool``;
+        #: None = X-Trace-Id headers are never adopted (standalone)
+        self.peer_trace_gate = None
         #: interleaved-TCP checkpoint re-attach hook (ISSUE 14) — set by
         #: the app when checkpointing is on: ``(path, track_id,
         #: session_id) -> record | None``.  A re-connecting player that

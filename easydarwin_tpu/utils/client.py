@@ -47,6 +47,12 @@ class ReceiverStats:
             self._last_seq = seq
 
 
+def hexish(s: str) -> bool:
+    """A plausible trace id: 8-64 lowercase hex chars (token_hex shape).
+    Anything else must not become a correlation key."""
+    return 8 <= len(s) <= 64 and all(c in "0123456789abcdef" for c in s)
+
+
 class RtspClient:
     def __init__(self):
         self.reader: asyncio.StreamReader | None = None
@@ -54,6 +60,13 @@ class RtspClient:
         self.wire = rtsp.RtspWireReader(parse_responses=True)
         self.cseq = 0
         self.session_id: str | None = None
+        #: headers merged into EVERY request (overridable per call) —
+        #: the pull-relay envelope sets the cluster-peer correlation
+        #: pair here (X-Trace-Id / X-Cluster-Node, ISSUE 15)
+        self.default_headers: dict = {}
+        #: the last DESCRIBE response (play_start) — carries the
+        #: upstream stream's X-Trace-Id for downstream trace adoption
+        self.describe_response: rtsp.RtspResponse | None = None
         self._responses: asyncio.Queue = asyncio.Queue()
         #: interleaved channel → asyncio.Queue of payload bytes
         self.channels: dict[int, asyncio.Queue] = {}
@@ -108,13 +121,29 @@ class RtspClient:
                       body: bytes = b"", timeout: float = 5.0
                       ) -> rtsp.RtspResponse:
         self.cseq += 1
-        hdrs = {"cseq": str(self.cseq)}
+        want = self.cseq
+        hdrs = {"cseq": str(want)}
         if self.session_id:
             hdrs["session"] = self.session_id
+        hdrs.update(self.default_headers)
         hdrs.update(headers or {})
         req = rtsp.RtspRequest(method, uri, hdrs, body)
         self.writer.write(req.to_bytes())
-        resp = await asyncio.wait_for(self._responses.get(), timeout)
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            left = deadline - asyncio.get_running_loop().time()
+            resp = await asyncio.wait_for(self._responses.get(),
+                                          max(left, 0.001))
+            # CSeq matching: a previously timed-out request's late reply
+            # must not pair with THIS request (the queue is FIFO; one
+            # desync would shift every later pairing) — drop stale ones
+            rc = resp.headers.get("cseq")
+            try:
+                if rc is not None and int(rc) < want:
+                    continue
+            except ValueError:
+                pass
+            break
         if sid := resp.headers.get("session"):
             self.session_id = sid.split(";")[0].strip()
         return resp
@@ -168,6 +197,14 @@ class RtspClient:
                          ) -> sdp.SessionDescription:
         r = await self.request("DESCRIBE", uri, {"accept": "application/sdp"})
         assert r.status == 200, r.status
+        self.describe_response = r
+        up_trace = r.headers.get("x-trace-id", "").strip()
+        if "x-trace-id" in self.default_headers and hexish(up_trace):
+            # trace-propagating caller (the pull-relay envelope): adopt
+            # the upstream STREAM's trace before the SETUPs go out, so
+            # the serving connection upstream is tagged with the same id
+            # this edge will serve under (ISSUE 15)
+            self.default_headers["x-trace-id"] = up_trace
         sd = sdp.parse(r.body)
         self.transports = []
         self.setup_responses = []

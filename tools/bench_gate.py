@@ -338,6 +338,58 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: tcp_delivery recorded {mm2} wire "
                             "mismatches (engine framing must be byte-"
                             "identical to the per-session path)")
+        # ISSUE 15 composed-observatory section — OPTIONAL (rounds
+        # predating the fleet round stay valid), but when present: the
+        # per-tier delivered rates are positive finite (a tier that
+        # served nothing proves nothing about composition), the scaling
+        # efficiency is a positive finite ratio (sub-linear is honest
+        # on a shared-core box; zero/NaN means the aggregation lied),
+        # the mid-run owner kill was GAPLESS at the player socket, the
+        # mixed-load p99 and end-to-end freshness p99 are finite
+        # non-negative, and every subscriber's stitched trace resolved
+        cp = extra.get("composed")
+        if isinstance(cp, dict) and cp and "error" not in cp:
+            tr = cp.get("tier_rates")
+            if not isinstance(tr, dict) or not tr:
+                errs.append(f"{name}: composed.tier_rates missing or "
+                            "empty")
+            else:
+                for tier, v2 in tr.items():
+                    if not isinstance(v2, (int, float)) \
+                            or not math.isfinite(v2) or v2 <= 0:
+                        errs.append(f"{name}: composed.tier_rates"
+                                    f"[{tier!r}] {v2!r} not a positive "
+                                    "finite rate")
+            se = cp.get("scaling_efficiency")
+            if not isinstance(se, (int, float)) or not math.isfinite(se) \
+                    or se <= 0:
+                errs.append(f"{name}: composed.scaling_efficiency "
+                            f"{se!r} not a positive finite ratio")
+            gap = cp.get("migration_gap_packets")
+            if not isinstance(gap, (int, float)) or not math.isfinite(gap) \
+                    or gap < 0:
+                errs.append(f"{name}: composed.migration_gap_packets "
+                            f"{gap!r} not a finite non-negative count")
+            elif gap != 0:
+                errs.append(f"{name}: composed.migration_gap_packets "
+                            f"{gap:.0f} (the composed owner kill "
+                            "dropped packets at the player socket — "
+                            "must be exactly 0)")
+            for kf in ("mixed_p99_ms", "e2e_freshness_p99_s"):
+                v2 = cp.get(kf)
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 < 0:
+                    errs.append(f"{name}: composed.{kf} {v2!r} not a "
+                                "finite non-negative figure")
+            ut = cp.get("unresolved_traces", 0)
+            if ut:
+                errs.append(f"{name}: composed recorded {ut} "
+                            "subscriber traces that failed to stitch "
+                            "across their hops")
+            mm3 = cp.get("wire_mismatches", 0)
+            if mm3:
+                errs.append(f"{name}: composed recorded {mm3} wire/"
+                            "oracle mismatches with every engine on")
         # ISSUE 13 rebalance section — OPTIONAL (rounds predating the
         # load-aware control plane stay valid), but when present: a
         # planned rebalance drain must be GAPLESS at the player socket,
@@ -431,12 +483,40 @@ def _median(xs: list[float]) -> float:
     return ys[len(ys) // 2]
 
 
+def _device_class(parsed: dict) -> str | None:
+    """The round's device environment: "tpu" / "cpu" / None (unknown —
+    pre-contract rounds, comparable with everything).  BENCH_r06 is the
+    first round recorded on a no-TPU host (device TFRT_CPU_0): a CPU
+    host legitimately runs ~100x below the r01-r05 TPU-box headlines,
+    and cross-class comparison is an environment delta, not a code
+    regression."""
+    ex = parsed.get("extra") or {}
+    dev = str(ex.get("device") or "")
+    if not dev:
+        return None
+    if ex.get("device_fallback_cpu") or "cpu" in dev.lower():
+        return "cpu"
+    return "tpu"
+
+
 def gate(fresh: dict, traj: list[dict], *, tolerance: float,
          window: int) -> list[str]:
-    """Regression verdicts for one fresh run vs the trajectory tail."""
+    """Regression verdicts for one fresh run vs the trajectory tail.
+
+    Only LIKE environments compare: the fresh run gates against the
+    trajectory rounds of its own device class (unknown-device rounds
+    stay comparable with everything), so a CPU-host run is measured
+    against CPU-host history instead of being flagged "regressed" from
+    a TPU box it never was."""
     usable = [t["parsed"] for t in traj if isinstance(t["parsed"], dict)
               and isinstance(t["parsed"].get("value"), (int, float))
               and t["parsed"]["value"] > 0]
+    fresh_cls = _device_class(fresh)
+    if fresh_cls is not None:
+        same = [p for p in usable
+                if _device_class(p) in (None, fresh_cls)]
+        if same:
+            usable = same
     if not usable:
         return ["no usable trajectory entries to gate against"]
     tail = usable[-window:]

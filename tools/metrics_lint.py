@@ -45,9 +45,11 @@ NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
 #: the Prometheus info-metric convention (a constant-1 gauge whose
 #: labels carry the payload — egress_backend_info); ``_score`` is the
 #: control plane's capacity figure (cluster_capacity_score — a
-#: benchmark-derived rating in pps, quantized, not a raw measurement)
+#: benchmark-derived rating in pps, quantized, not a raw measurement);
+#: ``_live`` is the fleet federation's liveness-qualified node count
+#: (fleet_nodes_live — a count qualified by state, like _count)
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count",
-                 "_level", "_info", "_score")
+                 "_level", "_info", "_score", "_live")
 
 EVENT_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 #: emit("event.name", ...) — the positional literal, plain or f-string
@@ -634,6 +636,78 @@ def lint_tcp_delivery(registry, schema: dict) -> list[str]:
     return errs
 
 
+#: closed serving-tier vocabulary of ``fleet_streams_total`` (mirrors
+#: obs.fleet.FLEET_TIERS — an open set would shard the federation gauge
+#: per typo and break every cross-node dashboard sum)
+FLEET_TIERS = ("live", "pull", "vod", "dvr", "hls")
+#: freshness chains deeper than this are truncated by the stitcher; a
+#: bigger hop label means the chain transport leaked garbage
+MAX_FRESHNESS_HOPS = 16
+
+
+def lint_fleet(registry, schema: dict) -> list[str]:
+    """The fleet-observability contract (ISSUE 15): the federation /
+    freshness / flight-dedupe families exist with their exact label
+    sets, every observed ``tier`` label stays inside the closed
+    FLEET_TIERS vocabulary, every observed ``hops`` label is a small
+    decimal chain length, the ``fleet.*`` event names are declared,
+    and the event envelope reserves the ``seq``/``node_id`` cursor and
+    attribution keys — ``tools/soak.py --composed`` and the bench
+    ``extra.composed`` section key on these."""
+    errs: list[str] = []
+    want_labels = {
+        "fleet_nodes_live": (),
+        "fleet_streams_total": ("tier",),
+        "fleet_publishes_total": (),
+        "relay_e2e_freshness_seconds": ("hops",),
+        "flight_dumps_deduped_total": (),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"fleet family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    fam = fams.get("fleet_streams_total")
+    if fam is not None:
+        for (tier,) in getattr(fam, "_values", {}):
+            if tier not in FLEET_TIERS:
+                errs.append(f"fleet_streams_total: observed tier "
+                            f"{tier!r} outside the closed set "
+                            f"{FLEET_TIERS}")
+    fam = fams.get("relay_e2e_freshness_seconds")
+    if fam is not None:
+        for (hops,) in getattr(fam, "_states", {}):
+            if not hops.isdigit() or not 1 <= int(hops) \
+                    <= MAX_FRESHNESS_HOPS:
+                errs.append(f"relay_e2e_freshness_seconds: observed "
+                            f"hops label {hops!r} is not a chain "
+                            f"length in [1, {MAX_FRESHNESS_HOPS}]")
+    for name in ("fleet.node_stale", "fleet.node_live"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    from easydarwin_tpu.obs.events import RESERVED_KEYS
+    for key in ("seq", "node_id"):
+        if key not in RESERVED_KEYS:
+            errs.append(f"event envelope key {key!r} missing from "
+                        "RESERVED_KEYS (a free-form field could shadow "
+                        "the cursor/attribution envelope)")
+    try:
+        from easydarwin_tpu.obs.fleet import FLEET_TIERS as SRC_TIERS
+        if tuple(SRC_TIERS) != FLEET_TIERS:
+            errs.append(f"obs.fleet.FLEET_TIERS {tuple(SRC_TIERS)} out "
+                        f"of sync with the lint's {FLEET_TIERS}")
+    except ImportError:
+        errs.append("obs.fleet module missing")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -746,6 +820,10 @@ def main() -> int:
     # families with the closed io_uring/writev/buffered rung set + the
     # checkpoint-parity counter and ckpt.tcp_* events
     errs += lint_tcp_delivery(obs.REGISTRY, ev.SCHEMA)
+    # the fleet observability layer's vocabulary (ISSUE 15): federation
+    # gauges with the closed tier set, the freshness chain histogram,
+    # fleet.* events and the seq/node_id event envelope
+    errs += lint_fleet(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

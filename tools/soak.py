@@ -1450,15 +1450,21 @@ async def soak(seconds: float, n_sources: int = 0,
 
 async def _cluster_node_main(node_id: str, redis_port: int,
                              fault_plan: str = "",
-                             skewed: bool = False) -> None:
+                             skewed: bool = False,
+                             composed: bool = False) -> None:
     """Child-process entry: one cluster-enabled server that announces
     its bound ports on stdout and serves until killed.  ``skewed``
     (ISSUE 13) tightens the control-plane knobs so the rebalance /
     admission machinery acts within a soak-scale run; ``fault_plan``
     arms a per-node FaultPlan (the --skewed harness forces a lying
-    capacity on one node through the capacity_spoof site)."""
+    capacity on one node through the capacity_spoof site).
+    ``composed`` (ISSUE 15) runs the observatory-round shape: EVERY
+    engine on — device fan-out, VOD segment cache + pacer, DVR spill,
+    FEC — with a per-node movie folder, so the mixed workload crosses
+    nodes with full observability."""
     import os
-    log_dir = f"/tmp/edtpu_cluster_soak/{node_id}"
+    base = "edtpu_composed_soak" if composed else "edtpu_cluster_soak"
+    log_dir = f"/tmp/{base}/{node_id}"
     os.makedirs(log_dir, exist_ok=True)
     extra = {}
     if skewed:
@@ -1471,17 +1477,37 @@ async def _cluster_node_main(node_id: str, redis_port: int,
             # stream; the drain fires right after, once per run
             cluster_rebalance_burn_sec=22.0,
             cluster_rebalance_cooldown_sec=60.0)
+    if composed:
+        extra = dict(
+            tpu_fanout=True, tpu_min_outputs=2,
+            dvr_enabled=True,
+            # error logs on: the observatory round's whole point is
+            # attributable cross-node failures
+            access_log_enabled=True,
+            movie_folder=os.path.join(log_dir, "movies"),
+            # the rebalancer would fight the harness's deliberate
+            # workload placement on a 2-core box; the observatory round
+            # exercises the CRASH migration, not the planned drain
+            cluster_rebalance_enabled=False,
+            cluster_admission_enabled=False)
     cfg = ServerConfig(
         rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
         wan_ip="127.0.0.1", reflect_interval_ms=10, bucket_delay_ms=0,
-        access_log_enabled=False, log_folder=log_dir, server_id=node_id,
+        log_folder=log_dir, server_id=node_id,
         redis_port=redis_port, cluster_enabled=True,
         cluster_lease_ttl_sec=2.0, cluster_heartbeat_sec=0.5,
         cluster_pull_connect_timeout_sec=3.0,
         cluster_pull_read_timeout_sec=1.5,
         cluster_pull_backoff_ms=150.0,
-        resilience_fault_plan=fault_plan, **extra)
+        resilience_fault_plan=fault_plan,
+        **{"access_log_enabled": False, **extra})
     app = StreamingServer(cfg)
+    if composed:
+        # cold-jit protection (the PR 7 discipline): the first device
+        # pass would otherwise block the pump for the whole compile —
+        # long enough to starve a peer's pull DESCRIBE window and burn
+        # the latency SLO before the soak clock even starts
+        await asyncio.to_thread(prewarm_batch_shapes)
     await app.start()
     print(f"NODE_READY rtsp={app.rtsp.port} rest={app.rest.port}",
           flush=True)
@@ -1828,6 +1854,674 @@ async def cluster_soak(n_nodes: int, seconds: float,
         await mini.stop()
         udp_rtp.close()
         udp_rtcp.close()
+    return 1 if failures else 0
+
+
+async def composed_soak(n_nodes: int, seconds: float,
+                        seed: int = 7) -> int:
+    """``--composed N`` (ISSUE 15): the observatory round — the FULL
+    mixed workload across N real server processes with every engine on,
+    a flash-crowd wave and a mid-run owner SIGKILL, validated through
+    the fleet observability layer itself.
+
+    Workload: a live relay (/live/m on the ring owner) with a UDP
+    subscriber, an interleaved-TCP subscriber and a relay-tree edge
+    pull on a non-owner; a 3-rung requant HLS ladder (/live/h) with a
+    polling HTTP audience; hot/cold VOD with seek churn; a DVR
+    time-shift subscriber pausing/rewinding/catching up on /live/d;
+    and one lossy-UDP player (x-FEC negotiated, seeded receiver-side
+    loss, honest RRs + NACKs) — all on the work node.
+
+    Verdicts: every hop of the relay-tree subscriber's trace stitches
+    under ONE trace_id via ``GET /api/v1/sessions/<id>/trace``; the
+    fleet endpoint shows every live node, marks the killed owner's
+    rollup STALE inside its TTL window, shows zero idle-peer SLO burn
+    and zero wire/oracle mismatches; the owner kill is gapless at the
+    UDP player (migration gap 0, same ssrc) and the adopted stream
+    keeps its trace id with both nodes in its lineage; the DVR player
+    counts a catch-up join, the VOD cache shows hits AND misses, the
+    HLS ladder serves 3 renditions, and the FEC tier engages under the
+    injected loss.  Exports the ``COMPOSED STATS`` JSON line bench.py
+    folds into ``extra.composed`` (BENCH_r06)."""
+    import json as _json
+    import random
+    import shutil
+    import urllib.error
+
+    from easydarwin_tpu.cluster.placement import HashRing
+    from easydarwin_tpu.cluster.redis_client import (AsyncRedis,
+                                                     MiniRedisServer)
+    from easydarwin_tpu.codecs.h264_intra import encode_iframe as enc
+    from easydarwin_tpu.protocol import nalu as nalu_mod
+    from easydarwin_tpu.protocol.rtcp import (GenericNack, ReceiverReport,
+                                              ReportBlock)
+    from easydarwin_tpu.relay.fec import FecReceiver
+    from easydarwin_tpu import obs as _obs
+
+    assert n_nodes >= 2, "--composed needs at least 2 nodes"
+    seconds = max(seconds, 40.0)
+    rng = random.Random(seed)
+    failures: list[str] = []
+    stats: dict = {}
+    shutil.rmtree("/tmp/edtpu_composed_soak", ignore_errors=True)
+    node_ids = [f"comp-node-{i}" for i in range(n_nodes)]
+    # VOD fixtures land in each node's movie folder BEFORE boot (the
+    # children serve from <log_dir>/movies)
+    vod_assets: list[str] = []
+    for nid in node_ids:
+        vod_assets = write_vod_assets(
+            f"/tmp/edtpu_composed_soak/{nid}/movies", 2, n_frames=450)
+    mini = MiniRedisServer()
+    await mini.start()
+    redis = AsyncRedis("127.0.0.1", mini.port)
+    procs: dict[str, asyncio.subprocess.Process] = {}
+    rtsp_ports: dict[str, int] = {}
+    rest_ports: dict[str, int] = {}
+    here = os.path.abspath(__file__)
+    for nid in node_ids:
+        # child stderr lands next to the node's logs — the composed
+        # round exists to make cross-node failures attributable
+        err = open(f"/tmp/edtpu_composed_soak/{nid}/stderr.log", "wb")
+        p = await asyncio.create_subprocess_exec(
+            sys.executable, here, "--cluster-node", "--composed-child",
+            "--node-id", nid, "--redis-port", str(mini.port),
+            stdout=asyncio.subprocess.PIPE, stderr=err)
+        err.close()
+        procs[nid] = p
+        line = await asyncio.wait_for(p.stdout.readline(), 90)
+        if not line.startswith(b"NODE_READY"):
+            raise RuntimeError(f"{nid} failed to boot: {line!r}")
+        kv = dict(t.split("=") for t in line.decode().split()[1:])
+        rtsp_ports[nid] = int(kv["rtsp"])
+        rest_ports[nid] = int(kv["rest"])
+
+    ring = HashRing(node_ids, 64)
+    owner = ring.owner("/live/m")
+    pull_node = [n for n in ring.rank("/live/m") if n != owner][0]
+    work = pull_node                    # HLS/VOD/DVR/lossy host; never killed
+    dead: set[str] = set()
+    stats.update({"owner": owner, "work": work})
+
+    def http_get(nid: str, path: str, timeout: float = 5.0):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rest_ports[nid]}{path}",
+                    timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, b""
+        except OSError:
+            return 0, b""
+
+    async def aget(nid: str, path: str, timeout: float = 5.0):
+        return await asyncio.to_thread(http_get, nid, path, timeout)
+
+    async def metrics_of(nid: str) -> dict[str, float]:
+        _st, body = await aget(nid, "/metrics")
+        return parse_metrics(body.decode("utf-8", "replace"))
+
+    async def fleet_of(nid: str) -> dict:
+        _st, body = await aget(nid, "/api/v1/fleet")
+        try:
+            return _json.loads(body.decode("utf-8", "replace"))
+        except ValueError:
+            return {}
+
+    # ------------------------------------------------------- the audience
+    udp_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    udp_rtp.bind(("127.0.0.1", 0))
+    udp_rtp.setblocking(False)
+    udp_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    udp_rtcp.bind(("127.0.0.1", 0))
+    udp_rtcp.setblocking(False)
+    l_rtp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    l_rtp.bind(("127.0.0.1", 0))
+    l_rtp.setblocking(False)
+    l_rtcp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    l_rtcp.bind(("127.0.0.1", 0))
+    l_rtcp.setblocking(False)
+    pusher_m = _ClusterPusher("/live/m", redis, rtsp_ports)
+    pusher_d = _ClusterPusher("/live/d", redis, rtsp_ports)
+    cycle = [enc(synth_frame(i), 24) for i in range(8)]
+    hls_state = {"seq": 0, "frame": 0, "bytes": 0, "renditions": set()}
+    counters = {"udp": 0, "tcp": 0, "pull": 0, "vod": 0, "dvr": 0,
+                "lossy_seen": 0, "lossy_dropped": 0, "catchups": 0}
+    rx_seqs: list[int] = []
+    rx_ssrcs: set[bytes] = set()
+    tcp_seqs: list[int] = []
+    flash: list[RtspClient] = []
+    tasks: list[asyncio.Task] = []
+    clients: list[RtspClient] = []
+    lrng = random.Random(seed ^ 0x5A5A)
+    fec_rx = FecReceiver(media_pt=96, fec_pt=127, rtx_pt=126)
+    lossy_media_ssrc = [0]
+    lossy_rtcp_dst = [0]
+    killed = [False]
+    kill_mono = [0.0]
+    recovery_sec: list[float | None] = [None]
+    t0 = time.time()
+
+    async def drain_tcp(player: RtspClient, key: str,
+                        seqs: list[int] | None = None) -> None:
+        while time.time() - t0 < seconds:
+            try:
+                p = await player.recv_interleaved(0, timeout=0.25)
+            except asyncio.TimeoutError:
+                continue
+            except Exception:
+                return
+            counters[key] += 1
+            if seqs is not None and len(p) >= 12:
+                seqs.append(struct.unpack("!H", p[2:4])[0])
+
+    def drain_udp() -> None:
+        while True:
+            try:
+                d = udp_rtp.recv(65536)
+            except (BlockingIOError, OSError):
+                break
+            if len(d) >= 12:
+                counters["udp"] += 1
+                rx_seqs.append(struct.unpack("!H", d[2:4])[0])
+                rx_ssrcs.add(d[8:12])
+                if killed[0] and recovery_sec[0] is None:
+                    recovery_sec[0] = time.monotonic() - kill_mono[0]
+
+    def drain_lossy() -> None:
+        while True:
+            try:
+                d = l_rtp.recv(65536)
+            except (BlockingIOError, OSError):
+                break
+            if len(d) < 12:
+                continue
+            counters["lossy_seen"] += 1
+            if lrng.random() < 0.08:    # seeded receiver-side last mile
+                counters["lossy_dropped"] += 1
+                continue
+            fec_rx.on_packet(d)
+
+    def lossy_feedback() -> None:
+        if not fec_rx.media or not lossy_media_ssrc[0]:
+            return
+        seen, dropped = counters["lossy_seen"], counters["lossy_dropped"]
+        frac = min(int(min(dropped / seen, 1.0) * 256), 255) if seen else 0
+        hi = max(fec_rx.media)
+        rr = ReceiverReport(0x7C7C, [ReportBlock(
+            lossy_media_ssrc[0], frac, dropped, hi & 0xFFFF,
+            0, 0, 0)]).to_bytes()
+        l_rtcp.sendto(rr, ("127.0.0.1", lossy_rtcp_dst[0]))
+        miss = fec_rx.missing(min(fec_rx.media), hi - 16)[-32:]
+        if miss:
+            l_rtcp.sendto(GenericNack.from_seqs(
+                0x7C7C, lossy_media_ssrc[0],
+                [m & 0xFFFF for m in miss]).to_bytes(),
+                ("127.0.0.1", lossy_rtcp_dst[0]))
+
+    def push_hls(pusher: RtspClient) -> None:
+        st = hls_state
+        ts = int(st["frame"] * 11250)           # ~8 fps cadence
+        for nal in cycle[st["frame"] % 8]:
+            for p in nalu_mod.packetize_h264(
+                    nal, seq=st["seq"], timestamp=ts, ssrc=7,
+                    marker_on_last=(nal[0] & 0x1F == 5)):
+                st["seq"] += 1
+                pusher.push_packet(0, p)
+        st["frame"] += 1
+
+    async def hls_poll() -> None:
+        await asyncio.sleep(3.0)
+        while time.time() - t0 < seconds:
+            await asyncio.sleep(1.0)
+            st, body = await aget(work, "/hls/live/h/master.m3u8")
+            if st != 200:
+                continue
+            rungs = [ln for ln in body.decode().splitlines()
+                     if ln.endswith("index.m3u8")]
+            fetched = False
+            for rel in rungs:
+                st2, idx = await aget(work, f"/hls/live/h/{rel}")
+                if st2 != 200 or b"#EXTINF" not in idx:
+                    continue
+                # a cut segment in the playlist IS the rendition serving
+                # (the body fetch below is rationed to one rung per
+                # cycle — on a loaded box fetching every rung's segment
+                # every second starves the sweep and under-counts the
+                # ladder width)
+                hls_state["renditions"].add(rel)
+                segs = [ln for ln in idx.decode().splitlines()
+                        if ln.endswith(".m4s")]
+                if not segs or fetched:
+                    continue
+                base_dir = rel.rsplit("/", 1)[0] + "/" if "/" in rel else ""
+                st3, data = await aget(
+                    work, f"/hls/live/h/{base_dir}{segs[-1]}")
+                if st3 == 200 and data:
+                    hls_state["bytes"] += len(data)
+                    fetched = True
+
+    async def _join_retry(c: RtspClient, uri: str, tries: int = 4,
+                          **kw) -> None:
+        """play_start with a real player's retry patience: a 404/45x on
+        a loaded box mid-claim is 'not ready yet', and a request
+        timeout is a pump busy compiling/serving — neither is a
+        failure until it repeats (the CSeq matcher drops any late
+        reply, so a timed-out request cannot desync the retry)."""
+        for attempt in range(tries):
+            try:
+                await c.play_start(uri, **kw)
+                return
+            except (AssertionError, asyncio.TimeoutError):
+                if attempt == tries - 1:
+                    raise
+                await asyncio.sleep(2.0)
+
+    async def vod_player() -> None:
+        c = RtspClient()
+        clients.append(c)
+        await c.connect("127.0.0.1", rtsp_ports[work])
+        uri = f"rtsp://127.0.0.1:{rtsp_ports[work]}/{vod_assets[0]}"
+        await _join_retry(c, uri)
+        next_seek = time.time() + 4.0
+        while time.time() - t0 < seconds:
+            try:
+                await c.recv_interleaved(0, timeout=0.25)
+                counters["vod"] += 1
+            except asyncio.TimeoutError:
+                pass
+            except Exception:
+                return
+            if time.time() >= next_seek:
+                next_seek = time.time() + 5.0
+                npt = rng.uniform(0.0, 10.0)
+                try:
+                    await c.request("PLAY", uri,
+                                    {"range": f"npt={npt:.2f}-"})
+                except Exception:
+                    return
+
+    async def dvr_player() -> None:
+        """PAUSE → rewind to npt=0 at Speed 4 → catch up → repeat."""
+        await asyncio.sleep(5.0)        # let windows spill first
+        c = RtspClient()
+        clients.append(c)
+        await c.connect("127.0.0.1", rtsp_ports[work])
+        uri = f"rtsp://127.0.0.1:{rtsp_ports[work]}/live/d"
+        await _join_retry(c, uri)
+        phase_live_until = time.time() + 4.0
+        while time.time() - t0 < seconds - 6.0:
+            try:
+                await c.recv_interleaved(0, timeout=0.25)
+                counters["dvr"] += 1
+            except asyncio.TimeoutError:
+                pass
+            except Exception:
+                return
+            if time.time() >= phase_live_until:
+                try:
+                    await c.request("PAUSE", uri)
+                    await asyncio.sleep(0.6)
+                    r = await c.request("PLAY", uri,
+                                        {"range": "npt=0.0-",
+                                         "speed": "4"})
+                    assert r.status == 200, r.status
+                except Exception:
+                    return
+                counters["catchups"] += 1
+                phase_live_until = time.time() + 10.0
+
+    try:
+        # ------------------------------------------------ bring-up
+        await pusher_m.connect_to(owner)
+        await pusher_d.connect_to(work)
+        hls_pusher = RtspClient()
+        clients.append(hls_pusher)
+        await hls_pusher.connect("127.0.0.1", rtsp_ports[work])
+        await hls_pusher.push_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[work]}/live/h", SDP)
+        for _ in range(10):
+            pusher_m.push()
+            pusher_d.push()
+            push_hls(hls_pusher)
+            await asyncio.sleep(0.02)
+        await asyncio.sleep(1.5)        # claims + checkpoints up
+        st, _b = await aget(work, "/api/v1/starthls?path=/live/h"
+                                  "&rungs=q6,q12,q18")
+        if st != 200:
+            failures.append(f"starthls rungs failed: {st}")
+
+        udp_player = RtspClient()
+        clients.append(udp_player)
+        await udp_player.connect("127.0.0.1", rtsp_ports[owner])
+        await udp_player.play_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[owner]}/live/m", tcp=False,
+            client_ports=[(udp_rtp.getsockname()[1],
+                           udp_rtcp.getsockname()[1])])
+        udp_sid = udp_player.session_id
+        tcp_player = RtspClient()
+        clients.append(tcp_player)
+        await tcp_player.connect("127.0.0.1", rtsp_ports[owner])
+        await tcp_player.play_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[owner]}/live/m")
+        pull_player = RtspClient()
+        clients.append(pull_player)
+        await pull_player.connect("127.0.0.1", rtsp_ports[pull_node])
+        # the edge's first DESCRIBE races the origin's claim tick + the
+        # pull's upstream handshake; a 404 here means "not pulled yet"
+        await _join_retry(
+            pull_player,
+            f"rtsp://127.0.0.1:{rtsp_ports[pull_node]}/live/m")
+        pull_sid = pull_player.session_id
+        lossy_player = RtspClient()
+        clients.append(lossy_player)
+        await lossy_player.connect("127.0.0.1", rtsp_ports[work])
+        await lossy_player.play_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[work]}/live/d", tcp=False,
+            client_ports=[(l_rtp.getsockname()[1],
+                           l_rtcp.getsockname()[1])],
+            setup_headers={"x-fec": "parity"})
+        tr = lossy_player.transports[0]
+        lossy_media_ssrc[0] = tr.ssrc or 0
+        lossy_rtcp_dst[0] = (tr.server_port or (0, 0))[1]
+        if not lossy_player.setup_responses[0].headers.get("x-fec"):
+            failures.append("lossy player's x-FEC was not granted")
+
+        tasks = [
+            asyncio.ensure_future(drain_tcp(tcp_player, "tcp", tcp_seqs)),
+            asyncio.ensure_future(drain_tcp(pull_player, "pull")),
+            asyncio.ensure_future(hls_poll()),
+            asyncio.ensure_future(vod_player()),
+            asyncio.ensure_future(dvr_player()),
+        ]
+
+        t_kill = max(seconds * 0.55, seconds - 20.0)
+        t_flash_in, t_flash_out = seconds * 0.25, seconds * 0.7
+        t_trace = seconds * 0.40
+        last_fb = 0.0
+        traced = False
+        eff_sample = None
+        stale_seen = [False]
+        pre_kill_trace = [None]
+
+        async def check_traces() -> int:
+            """Every subscriber's trace must resolve across its hops."""
+            bad = 0
+            st, body = await aget(pull_node,
+                                  f"/api/v1/sessions/{pull_sid}/trace")
+            doc = {}
+            try:
+                doc = _json.loads(body.decode("utf-8", "replace"))
+            except ValueError:
+                pass
+            hops = doc.get("hops") or []
+            if st != 200 or len(hops) < 2:
+                bad += 1
+                failures.append(
+                    f"pull subscriber trace did not stitch across hops "
+                    f"(status {st}, hops {[h.get('node') for h in hops]})")
+            else:
+                if not doc.get("trace_stitched"):
+                    bad += 1
+                    failures.append(
+                        "pull subscriber hops disagree on trace_id: "
+                        + str([h.get("trace") for h in hops]))
+                if hops[0].get("node") != owner \
+                        or hops[-1].get("node") != pull_node:
+                    bad += 1
+                    failures.append(
+                        f"stitched hop order wrong: "
+                        f"{[h.get('node') for h in hops]}")
+                pre_kill_trace[0] = doc.get("stream_trace")
+            st2, body2 = await aget(owner,
+                                    f"/api/v1/sessions/{udp_sid}/trace")
+            doc2 = {}
+            try:
+                doc2 = _json.loads(body2.decode("utf-8", "replace"))
+            except ValueError:
+                pass
+            if st2 != 200 or not (doc2.get("hops") or []):
+                bad += 1
+                failures.append(
+                    f"udp subscriber trace did not resolve ({st2})")
+            return bad
+
+        async def fleet_stale_poll() -> None:
+            """The killed owner's rollup must appear STALE on a
+            survivor inside its Fleet TTL window."""
+            for _ in range(14):
+                doc = await fleet_of(work)
+                rec = (doc.get("nodes") or {}).get(owner)
+                if isinstance(rec, dict) and rec.get("stale"):
+                    stale_seen[0] = True
+                    return
+                await asyncio.sleep(0.5)
+
+        unresolved = 0
+        while time.time() - t0 < seconds:
+            now = time.time() - t0
+            if await pusher_m.ensure_connected(dead):
+                pusher_m.push()
+            if await pusher_d.ensure_connected(dead):
+                pusher_d.push()
+            if int(now * 8) > hls_state["frame"]:
+                push_hls(hls_pusher)
+            drain_udp()
+            drain_lossy()
+            if time.time() - last_fb >= 1.0:
+                last_fb = time.time()
+                lossy_feedback()
+            if not traced and now >= t_trace:
+                traced = True
+                unresolved = await check_traces()
+                eff_sample = {n: await fleet_of(n)
+                              for n in node_ids if n not in dead}
+            if "flash_joined" not in stats and now >= t_flash_in:
+                for _ in range(6):
+                    c = RtspClient()
+                    await c.connect("127.0.0.1", rtsp_ports[pull_node])
+                    await c.play_start(
+                        f"rtsp://127.0.0.1:{rtsp_ports[pull_node]}/live/m")
+                    flash.append(c)
+                stats["flash_joined"] = len(flash)
+            if flash and now >= t_flash_out:
+                for c in flash:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+                flash = []
+            if not killed[0] and now >= t_kill:
+                procs[owner].kill()
+                dead.add(owner)
+                killed[0] = True
+                kill_mono[0] = time.monotonic()
+                stats["killed_at"] = round(now, 1)
+                tasks.append(asyncio.ensure_future(fleet_stale_poll()))
+            await asyncio.sleep(0.03)
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+
+        # --------------------------------------------------- verdicts
+        survivors = [n for n in node_ids if n not in dead]
+        metrics = {n: await metrics_of(n) for n in survivors}
+        fleets = await fleet_of(survivors[0])
+        if not killed[0]:
+            failures.append("owner kill never fired (duration too short)")
+        gap = _seq_gap(rx_seqs)
+        if recovery_sec[0] is None:
+            failures.append("UDP player never resumed after the kill")
+        elif recovery_sec[0] > 10.0:
+            failures.append(f"failover recovery {recovery_sec[0]:.1f}s "
+                            "exceeds the 10 s budget")
+        if gap != 0:
+            failures.append(f"migration gap: {gap} packets missing at "
+                            "the UDP player")
+        if len(rx_ssrcs) != 1:
+            failures.append(f"ssrc changed across migration: "
+                            f"{len(rx_ssrcs)}")
+        if unresolved:
+            failures.append(f"{unresolved} subscriber traces failed to "
+                            "stitch")
+        if not stale_seen[0]:
+            failures.append("killed owner's fleet rollup never showed "
+                            "stale on a survivor")
+        # post-kill trace lineage: the adopted stream keeps its trace id
+        # with both nodes in its lineage
+        adopt_doc = {}
+        for n in survivors:
+            st, body = await aget(n, "/api/v1/streamtrace?path=/live/m")
+            if st == 200:
+                try:
+                    cand = _json.loads(body.decode("utf-8", "replace"))
+                except ValueError:
+                    continue
+                if cand.get("trace"):
+                    adopt_doc = cand
+                    break
+        if pre_kill_trace[0] and adopt_doc:
+            if adopt_doc.get("trace") != pre_kill_trace[0]:
+                failures.append(
+                    f"adopted stream lost its trace id: "
+                    f"{adopt_doc.get('trace')} != {pre_kill_trace[0]}")
+            lineage = adopt_doc.get("lineage") or []
+            if owner not in lineage or adopt_doc.get("node") not in lineage:
+                failures.append(f"adopted stream lineage {lineage} does "
+                                f"not span both nodes")
+        elif pre_kill_trace[0]:
+            failures.append("adopted stream's trace not retrievable on "
+                            "any survivor")
+        # fleet health: nodes live, zero idle-peer SLO burn, zero
+        # wire/oracle mismatches anywhere
+        nodes_doc = fleets.get("nodes") or {}
+        live_docs = {n: r for n, r in nodes_doc.items()
+                     if isinstance(r, dict) and r.get("live")}
+        if len(live_docs) != len(survivors):
+            failures.append(f"fleet shows {len(live_docs)} live nodes, "
+                            f"expected {len(survivors)}")
+        for n, rec in live_docs.items():
+            head = rec.get("headline") or {}
+            slo = rec.get("slo") or {}
+            if not head.get("subscribers") and slo.get("violations"):
+                failures.append(f"idle peer {n} burned SLO: "
+                                f"{slo['violations']} violations")
+            mm = rec.get("mismatches") or {}
+            for k, v in mm.items():
+                if v:
+                    failures.append(f"{n} recorded {v} {k} mismatches")
+        # workload health per tier
+        if counters["udp"] < 100:
+            failures.append(f"UDP player starved: {counters['udp']}")
+        if counters["pull"] < 50:
+            failures.append(f"pull subscriber starved: {counters['pull']}")
+        if counters["tcp"] < 50:
+            failures.append(f"TCP player starved: {counters['tcp']}")
+        if counters["vod"] < 50:
+            failures.append(f"VOD player starved: {counters['vod']}")
+        if counters["dvr"] < 50:
+            failures.append(f"DVR player starved: {counters['dvr']}")
+        if hls_state["bytes"] <= 0:
+            failures.append("HLS audience never received a segment")
+        if len(hls_state["renditions"]) < 3:
+            failures.append(f"HLS ladder served "
+                            f"{len(hls_state['renditions'])} renditions, "
+                            "wanted 3")
+        wm = metrics.get(work, {})
+        if wm.get("vod_cache_hits_total", 0) <= 0 \
+                or wm.get("vod_cache_misses_total", 0) <= 0:
+            failures.append("VOD cache did not serve both hot and cold "
+                            f"(hits {wm.get('vod_cache_hits_total')}, "
+                            f"misses {wm.get('vod_cache_misses_total')})")
+        if wm.get("dvr_windows_spilled_total", 0) <= 0:
+            failures.append("DVR spilled zero windows")
+        if wm.get("dvr_catchup_joins_total", 0) <= 0:
+            failures.append("DVR time-shift never caught up to live")
+        fec_engaged = (wm.get('fec_parity_packets_total{kind="rs"}', 0)
+                       + wm.get('fec_parity_packets_total{kind="xor"}', 0)
+                       + wm.get("rtx_sent_total", 0))
+        if counters["lossy_dropped"] > 10 and fec_engaged <= 0:
+            failures.append("FEC/RTX tier never engaged under "
+                            f"{counters['lossy_dropped']} dropped pkts")
+        recovered = int(_obs.FEC_RECOVERED.value())
+        freshness2 = sum(
+            v for k, v in metrics.get(pull_node, {}).items()
+            if k.startswith('relay_e2e_freshness_seconds_count')
+            and 'hops="2"' in k)
+        if freshness2 <= 0:
+            failures.append("relay-tree edge never observed a 2-hop "
+                            "freshness chain")
+        # ------------------------------------------------ bench figures
+        eff = 0.0
+        if eff_sample:
+            rates = []
+            for n, doc in eff_sample.items():
+                rec = (doc.get("nodes") or {}).get(n) or {}
+                rates.append(float((rec.get("headline") or {})
+                                   .get("out_pps", 0.0)))
+            if rates and max(rates) > 0:
+                eff = sum(rates) / (len(rates) * max(rates))
+        p99s = [float((r.get("headline") or {}).get("itw_p99_ms", 0.0))
+                for r in live_docs.values()]
+        fresh_p99 = max(
+            (float(r.get("freshness_p99_s", 0.0))
+             for r in live_docs.values()), default=0.0)
+        dur = max(time.time() - t0, 1.0)
+        composed = {
+            "nodes": n_nodes,
+            "tier_rates": {
+                "live": round((counters["udp"] + counters["pull"]
+                               + counters["tcp"]) / dur, 1),
+                "hls": round(hls_state["bytes"] / dur, 1),
+                "vod": round(counters["vod"] / dur, 1),
+                "dvr": round(counters["dvr"] / dur, 1),
+                "tcp": round(counters["tcp"] / dur, 1),
+            },
+            "scaling_efficiency": round(eff, 4),
+            "migration_gap_packets": gap,
+            "mixed_p99_ms": round(max(p99s, default=0.0), 3),
+            "e2e_freshness_p99_s": round(fresh_p99, 4),
+            "unresolved_traces": unresolved,
+            "wire_mismatches": int(sum(
+                m.get("megabatch_wire_mismatch_total", 0)
+                + m.get("fec_parity_oracle_mismatch_total", 0)
+                for m in metrics.values())),
+            "fec_recovered": recovered,
+            "fleet_nodes_live": len(live_docs),
+        }
+        stats.update({
+            "counters": counters,
+            "hls_renditions": len(hls_state["renditions"]),
+            "recovery_sec": (round(recovery_sec[0], 2)
+                             if recovery_sec[0] is not None else None),
+            "freshness_2hop_obs": freshness2,
+            "composed": composed,
+        })
+        print("COMPOSED STATS", _json.dumps(composed))
+        print("SOAK COMPOSED", "FAIL" if failures else "OK",
+              _json.dumps(stats, default=str))
+        for msg in failures:
+            print("  -", msg)
+    finally:
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+        for c in flash + clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for nid, p in procs.items():
+            if p.returncode is None:
+                p.kill()
+        for p in procs.values():
+            try:
+                await asyncio.wait_for(p.wait(), 10)
+            except asyncio.TimeoutError:
+                pass
+        await redis.close()
+        await mini.stop()
+        for s in (udp_rtp, udp_rtcp, l_rtp, l_rtcp):
+            s.close()
     return 1 if failures else 0
 
 
@@ -2581,6 +3275,18 @@ def _parse_args(argv: list[str]):
                          "churn, a flash-crowd wave, and a seeded "
                          "owner SIGKILL that must recover via live "
                          "session migration (ISSUE 6)")
+    ap.add_argument("--composed", type=int, default=0, metavar="N",
+                    help="the observatory round (ISSUE 15): N server "
+                         "processes + mini Redis with EVERY engine on, "
+                         "serving the full mixed workload (live relay "
+                         "+ 3-rung HLS ladder + hot/cold VOD with seek "
+                         "churn + DVR time-shift + TCP-interleaved + "
+                         "one lossy-UDP player) with a flash-crowd "
+                         "wave and a mid-run owner SIGKILL; validated "
+                         "via /api/v1/fleet (stale-marked dead node, "
+                         "zero idle-peer SLO burn, zero wire/oracle "
+                         "mismatches), gapless migration, and every "
+                         "subscriber's trace stitching across its hops")
     ap.add_argument("--skewed", type=int, default=0, metavar="N",
                     help="load-aware control-plane scenario (ISSUE 13): "
                          "N server processes + mini Redis with ONE "
@@ -2595,6 +3301,8 @@ def _parse_args(argv: list[str]):
     ap.add_argument("--cluster-node", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--skewed-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--composed-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--fault-plan", default="", help=argparse.SUPPRESS)
     ap.add_argument("--node-id", default="", help=argparse.SUPPRESS)
@@ -2633,9 +3341,14 @@ if __name__ == "__main__":
     if _ns.cluster_node:
         raise SystemExit(asyncio.run(
             _cluster_node_main(_ns.node_id, _ns.redis_port,
-                               _ns.fault_plan, _ns.skewed_child)))
+                               _ns.fault_plan, _ns.skewed_child,
+                               _ns.composed_child)))
     if _ns.mixed:
         raise SystemExit(asyncio.run(mixed_soak(_ns.duration)))
+    if _ns.composed:
+        raise SystemExit(asyncio.run(
+            composed_soak(_ns.composed, _ns.duration,
+                          _ns.chaos if _ns.chaos is not None else 7)))
     if _ns.cluster:
         raise SystemExit(asyncio.run(
             cluster_soak(_ns.cluster, _ns.duration,
