@@ -1845,6 +1845,15 @@ def composed_section(*, n_nodes: int = 2, seconds: float = 45.0) -> dict:
         fails = [ln.strip() for ln in (out.stdout or "").splitlines()
                  if ln.startswith("  - ")]
         doc["error"] = f"composed soak verdict {verdict}: {fails[:4]}"
+    # ISSUE 16: the wake-ledger decomposition must CONSERVE — the
+    # per-class wait+service attribution accounts for >= 90% of the
+    # measured mixed p99, or the blame table is naming the wrong
+    # suspect and the figure would poison the trajectory
+    lb = doc.get("latency_blame") or {}
+    cons = lb.get("conservation")
+    if "error" not in doc and cons is not None and cons < 0.9:
+        doc["error"] = (f"latency blame conserves only {cons:.2f} of "
+                        f"the measured mixed p99 (need >= 0.9)")
     return doc
 
 
@@ -2267,6 +2276,15 @@ def main():
             # multi_source's do
             "wire_mismatches", "error")
         if k in cp}
+    lb = cp.get("latency_blame") or {}
+    if lb:
+        # the blame headline survives the compact projection: WHO owns
+        # the p99 and how much of it the ledger accounts for
+        compact_extra["composed"]["latency_blame"] = {
+            k: lb[k] for k in (
+                "top_offender", "attributed_p99_ms", "measured_p99_ms",
+                "conservation")
+            if k in lb}
     compact_extra["details_file"] = "bench_details.json"
     print(json.dumps({
         "metric": details["metric"],

@@ -103,6 +103,32 @@ def _count_error() -> None:
     obs.REDIS_ERRORS.inc()
 
 
+class _RoundtripStats:
+    """Process-wide Redis roundtrip accounting (ISSUE 16): every
+    ``AsyncRedis._guarded`` batch counts one roundtrip plus its wall
+    time here, so the cluster tick can read before/after deltas and
+    hand the wake ledger per-tick sub-accounting (roundtrips per tick,
+    latency per roundtrip — the item-5 cross-node suspect figures).
+    Plain int adds on the event-loop thread: no locks, no metric-family
+    cost on the Redis hot path."""
+
+    __slots__ = ("count", "ns")
+
+    def __init__(self):
+        self.count = 0
+        self.ns = 0
+
+    def delta_since(self, mark: tuple[int, int]) -> tuple[int, int]:
+        return self.count - mark[0], self.ns - mark[1]
+
+    def mark(self) -> tuple[int, int]:
+        return (self.count, self.ns)
+
+
+#: the one roundtrip ledger every AsyncRedis in the process feeds
+ROUNDTRIPS = _RoundtripStats()
+
+
 # --------------------------------------------------------------- wire codec
 def encode_command(*args) -> bytes:
     out = [b"*%d\r\n" % len(args)]
@@ -181,9 +207,13 @@ class AsyncRedis:
         (``-ERR ...``) are protocol-level and never retried."""
         async with self._lock:
             for attempt in (0, 1):
+                t0 = time.monotonic_ns()
                 try:
-                    return await asyncio.wait_for(
+                    replies = await asyncio.wait_for(
                         self._roundtrip(commands), self.timeout)
+                    ROUNDTRIPS.count += 1
+                    ROUNDTRIPS.ns += time.monotonic_ns() - t0
+                    return replies
                 except RedisError:
                     # a protocol-level error reply (-ERR ...) mid-batch
                     # leaves the REMAINING replies unread in the socket
@@ -200,6 +230,12 @@ class AsyncRedis:
                     await self.close()
                     raise
                 except asyncio.TimeoutError:
+                    # failed roundtrips still count: a timed-out command
+                    # cost its caller the full timeout of wall time —
+                    # exactly the per-tick figure the wake ledger's
+                    # sub-accounting exists to expose
+                    ROUNDTRIPS.count += 1
+                    ROUNDTRIPS.ns += time.monotonic_ns() - t0
                     _count_error()
                     await self.close()
                     if attempt:
@@ -210,6 +246,8 @@ class AsyncRedis:
                         OSError) as e:
                     # RedisTimeout/RedisError subclass none of these, so
                     # protocol errors propagate immediately
+                    ROUNDTRIPS.count += 1
+                    ROUNDTRIPS.ns += time.monotonic_ns() - t0
                     _count_error()
                     await self.close()
                     if attempt:

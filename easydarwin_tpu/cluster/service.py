@@ -353,6 +353,10 @@ class ClusterService:
     # -- the tick ----------------------------------------------------------
     async def _loop(self) -> None:
         while self._running:
+            # schedule-due stamp for the wake ledger: a tick that starts
+            # late queued behind other event-loop work — that lateness
+            # is its enqueue→start wait
+            self._tick_due_ns = time.monotonic_ns()
             try:
                 await self.tick()
             except asyncio.CancelledError:
@@ -365,6 +369,29 @@ class ClusterService:
             await asyncio.sleep(self.config.heartbeat_sec)
 
     async def tick(self) -> None:
+        from .redis_client import ROUNDTRIPS
+        # wake-ledger accounting (ISSUE 16): the tick runs as its own
+        # coroutine on the SAME event loop as the pump — its service
+        # time is queueing delay for every relay class, and its Redis
+        # roundtrips are THE cross-node suspect figure, so both are
+        # recorded even when the tick aborts on a (real or injected)
+        # partition — the timeout path is the expensive one.
+        led = obs.LEDGER if obs.LEDGER.enabled else None
+        t0_ns = time.monotonic_ns() if led else 0
+        rt_mark = ROUNDTRIPS.mark() if led else (0, 0)
+        try:
+            await self._tick_inner()
+        finally:
+            if led:
+                d_ops, d_ns = ROUNDTRIPS.delta_since(rt_mark)
+                due = getattr(self, "_tick_due_ns", t0_ns)
+                led.record(
+                    "cluster_tick",
+                    wait_ns=max(t0_ns - due, 0),
+                    service_ns=time.monotonic_ns() - t0_ns,
+                    redis_ops=d_ops, redis_ns=d_ns)
+
+    async def _tick_inner(self) -> None:
         from ..resilience import INJECTOR
         if INJECTOR.active and INJECTOR.redis_partition():
             raise RedisTimeout("injected redis partition")

@@ -322,6 +322,8 @@ class RequantHlsOutput(HlsOutput):
         # rate, never in latency" contract)
         if self.pending >= self._max_pending:
             self.shed += 1                 # backlogged: shed, stay live
+            from ..obs.ledger import LEDGER
+            LEDGER.defer("hls_requant")
             return
         # latch the sets on the loop thread and snapshot the PARSED
         # objects for the worker (requant_with is stateless)
@@ -527,8 +529,18 @@ class RequantLadder(RelayOutput):
         if is_rtcp:
             return WriteResult.OK
         self.depack.push(data)
-        for au in self.depack.pop_units():
+        units = self.depack.pop_units()
+        if not units:
+            return WriteResult.OK
+        # wake-ledger unit (ISSUE 16): AU admission runs nested inside
+        # the pump's live-relay pass — bracketing it here (per completed
+        # AU, never per packet) lets the ledger subtract it from
+        # live_relay and charge the requant class with its own service
+        from ..obs.ledger import LEDGER
+        tok = LEDGER.unit_start()
+        for au in units:
             self._on_unit(au)
+        LEDGER.unit_end(tok, "hls_requant", items=len(units))
         return WriteResult.OK
 
     def _latch_ps(self, au: AccessUnit) -> None:
@@ -576,6 +588,8 @@ class RequantLadder(RelayOutput):
         if self.pending >= self._max_pending:
             self.shed += 1               # backlogged: shed, stay live
             REQUANT_SHED.inc()
+            from ..obs.ledger import LEDGER
+            LEDGER.defer("hls_requant")
             return
         job = _AuJob(self._next_submit, au, deltas, self._sps, self._pps)
         self._next_submit += 1

@@ -23,7 +23,7 @@ REGISTRY = Registry()
 
 # ------------------------------------------------------------- relay latency
 #: packet bytes are log-spaced 2^k; device pass times are sub-ms — the
-#: shared TIME_BUCKETS ladder covers 100 µs…60 s for both
+#: shared TIME_BUCKETS ladder covers 100 µs…900 s for both
 RELAY_INGEST_TO_WIRE = REGISTRY.histogram(
     "relay_ingest_to_wire_seconds",
     "In-server ingest(arrival stamp at push_rtp)->wire latency per relayed "
@@ -47,6 +47,32 @@ PROFILE_PHASE_DRIFT = REGISTRY.counter(
     "Passes whose summed phase durations disagreed with the bracketing "
     "pass total beyond tolerance (instrumentation covering different "
     "work than the pass timer — a profiler bug, not a server bug)")
+
+# ------------------------------------------------------------- wake ledger
+#: causal latency attribution for the pump wake loop (obs/ledger.py,
+#: ISSUE 16): every unit of work a wake services carries a work class
+#: from the CLOSED set obs.ledger.WORK_CLASSES — tools/metrics_lint.py
+#: rejects any child outside it.  One wait/service observation per
+#: class per wake (the per-wake worst, not per-packet), so a p99 here
+#: reads as "the p99 WAKE's queueing delay for this class".
+PUMP_WAIT_SECONDS = REGISTRY.histogram(
+    "pump_wait_seconds",
+    "Enqueue->start queueing delay of one work class inside a pump wake "
+    "(time from the wake request / schedule-due stamp to the moment the "
+    "class's unit actually started running), by work class",
+    labels=("work_class",), buckets=TIME_BUCKETS)
+PUMP_SERVICE_SECONDS = REGISTRY.histogram(
+    "pump_service_seconds",
+    "Self service time of one work class inside a pump wake (nested "
+    "classes subtracted, so per-class figures sum to the wake duration "
+    "instead of double-counting), by work class",
+    labels=("work_class",), buckets=TIME_BUCKETS)
+PUMP_DEFERRED_TOTAL = REGISTRY.counter(
+    "pump_deferred_total",
+    "Units a work class deferred or shed instead of servicing this wake "
+    "(megabatch dispatch skipped at the in-flight cap, HLS requant AUs "
+    "shed at the admission gate, ...), by work class",
+    labels=("work_class",))
 
 # -------------------------------------------------------------- SLO watchdog
 SLO_VIOLATIONS = REGISTRY.counter(

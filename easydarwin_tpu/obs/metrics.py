@@ -75,10 +75,15 @@ def _labelstr(names: tuple, values: tuple) -> str:
     return "{" + inner + "}"
 
 
-#: default log-spaced latency bounds: 100 µs … 60 s on a 1-2.5-5 ladder
+#: default log-spaced latency bounds: 100 µs … 900 s on a 1-2.5-5 ladder
+#: densified through the multi-second regime (ISSUE 16: a composed-soak
+#: 8.1 s p99 must resolve to a bucket, not saturate into (5, 10]), and
+#: topped above the SLO watchdog's worst burn window (600 s slow window)
+#: so a wait that outlives the entire evaluation horizon still lands in
+#: a finite bucket — metrics_lint asserts that ordering.
 TIME_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
-                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
-                60.0)
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 1.5, 2.5, 4.0, 6.0,
+                8.0, 10.0, 15.0, 30.0, 60.0, 120.0, 300.0, 900.0)
 
 
 def bucket_quantile(counts, total: int, bounds, q: float) -> float:
@@ -267,12 +272,17 @@ class Histogram(_Family):
             st = self._states[key] = _HistState(len(self.bounds) + 1)
         return st
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, n: int = 1, **labels) -> None:
+        """``n`` is an observation weight (n identical observations in
+        one bucket update) — the wake ledger uses it to weight a work
+        unit's queue delay by the items the unit serviced, so the wait
+        distribution matches the per-item latency the operator measures
+        (``n`` is therefore reserved as a label name)."""
         with self._mu:
             st = self._state(labels)
-            st.counts[bisect_left(self.bounds, value)] += 1
-            st.sum += value
-            st.count += 1
+            st.counts[bisect_left(self.bounds, value)] += n
+            st.sum += value * n
+            st.count += n
 
     def observe_many(self, values: np.ndarray, **labels) -> None:
         """Vectorized bulk observe — the relay hot paths record one call

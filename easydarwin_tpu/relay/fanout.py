@@ -751,6 +751,8 @@ class TpuFanoutEngine:
                          else np.concatenate(sent_slots))
             lat_s = (wire_ns - ring.arrival_ns[all_slots]) / 1e9
             obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="native")
+            if obs.LEDGER.enabled:
+                obs.LEDGER.note_queue_age(float(lat_s.max()), lat_s.size)
             # per-session attribution (top-by-p99 in command=top)
             PROFILER.account_latency(stream.session_path, lat_s)
         self.native_sent += r
@@ -928,6 +930,8 @@ class TpuFanoutEngine:
                          else np.concatenate(sent_slots))
             lat_s = (wire_ns - ring.arrival_ns[all_slots]) / 1e9
             obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="native")
+            if obs.LEDGER.enabled:
+                obs.LEDGER.note_queue_age(float(lat_s.max()), lat_s.size)
             PROFILER.account_latency(stream.session_path, lat_s)
         self.native_sent += sent
         return sent
@@ -1045,5 +1049,7 @@ class TpuFanoutEngine:
             now_ns = time.perf_counter_ns()
             lat_s = (now_ns - np.asarray(lat_ns, dtype=np.int64)) / 1e9
             obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="batch")
+            if obs.LEDGER.enabled:
+                obs.LEDGER.note_queue_age(float(lat_s.max()), lat_s.size)
             PROFILER.account_latency(stream.session_path, lat_s)
         return sent

@@ -225,6 +225,12 @@ class MegabatchScheduler:
             del self._tracked[sid]
             self._state_cache.pop(sid, None)
         if len(self._inflight) >= self.MAX_INFLIGHT:
+            # saturated: this wake's dispatch is DEFERRED — every pair's
+            # fresh packets wait at least one more wake for device
+            # service.  The wake ledger counts the skip per stream (the
+            # queue-delay decomposition's megabatch deferral signal).
+            from ..obs.ledger import LEDGER
+            LEDGER.defer("megabatch", len(pairs))
             return
         work = self._collect(pairs)
         if not work:

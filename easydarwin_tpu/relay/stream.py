@@ -323,6 +323,8 @@ class RelayStream:
             lat_s = (time.perf_counter_ns()
                      - np.asarray(lat_ns, dtype=np.int64)) / 1e9
             obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="scalar")
+            if obs.LEDGER.enabled:
+                obs.LEDGER.note_queue_age(float(lat_s.max()), lat_s.size)
             # per-session attribution (command=top) works on the scalar
             # oracle too — small fan-outs are still sessions operators ask
             # about, and the SLO watchdog's offender lookup reads this
@@ -363,8 +365,13 @@ class RelayStream:
         if self.fec is not None:
             # the reliability tier's per-pass hook: window parity rides
             # the SAME tail both engines share, so megabatch/native/
-            # scalar passes emit identical parity bytes by construction
+            # scalar passes emit identical parity bytes by construction.
+            # Ledger-bracketed (ISSUE 16): parity windows run nested in
+            # the live-relay pass — charge fec_parity its own service so
+            # live_relay's figure stays conserved.
+            _tok = obs.LEDGER.unit_start()
             self.fec.tick(now_ms)
+            obs.LEDGER.unit_end(_tok, "fec_parity")
         rring = self.rtcp_ring
         if len(rring) == 0 and now_ms < self._next_sr_due_ms:
             return                  # hot path: nothing buffered, none due

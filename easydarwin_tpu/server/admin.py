@@ -193,6 +193,36 @@ def profile_snapshot(app) -> dict:
     return doc
 
 
+def ledger_snapshot(app) -> dict:
+    """``GET /api/v1/ledger`` — the wake-loop ledger's live document
+    (ISSUE 16): per-work-class wait/service aggregates, deferred/shed
+    counts, the worst wait's trace correlation, and the cluster tick's
+    Redis roundtrip sub-accounting.  The node id rides along so a
+    multi-node capture (blame_report, soak post-mortem) stays
+    attributable after aggregation."""
+    from ..obs import LEDGER, events
+    doc = LEDGER.snapshot()
+    doc["node"] = events.NODE.get("id") or ""
+    return doc
+
+
+def blame_snapshot(app) -> dict:
+    """``command=blame`` — the "why is p99 high" table: the ledger
+    snapshot ranked by wait-p99 blame through obs.ledger.blame_doc,
+    with the live ingest→wire p50/p99 as the measured figures the
+    attribution must conserve against (the same estimator bench's
+    composed round pins at ≥ 90 %)."""
+    from ..obs import LEDGER, RELAY_INGEST_TO_WIRE, blame_doc
+    p50 = RELAY_INGEST_TO_WIRE.quantile(0.50) * 1e3
+    p99 = RELAY_INGEST_TO_WIRE.quantile(0.99) * 1e3
+    snap = ledger_snapshot(app)
+    doc = blame_doc(snap, measured_p99_ms=p99 or None,
+                    baseline_p50_ms=p50)
+    doc["node"] = snap.get("node", "")
+    doc["ledger"] = snap
+    return doc
+
+
 def set_pref(app, path: str, value: str) -> tuple[int, Any]:
     """``command=set`` — write one pref through the prefs AttrStore
     (``server/prefs/<name>`` or ``server/prefs/@<id>``; the reference

@@ -997,6 +997,7 @@ class StreamingServer:
     def _reflect_all(self) -> int:
         t = now_ms()
         wake_ns, self._wake_ns = self._wake_ns, None
+        from ..obs import LEDGER
         if wake_ns is not None:
             # wake→pass queueing delay: ingest set the event at wake_ns,
             # the loop got scheduled and reached the pass now — event-loop
@@ -1004,6 +1005,13 @@ class StreamingServer:
             from ..obs import PROFILER
             PROFILER.observe("wake_to_pass", "pump",
                              time.perf_counter_ns() - wake_ns)
+        # wake ledger (ISSUE 16): one record per wake, every unit below
+        # tagged with its work class.  The record stays open through the
+        # 1 Hz maintenance block in _pump_loop (end_wake there); direct
+        # callers (tests, bench) are covered by begin_wake folding any
+        # unclosed predecessor.
+        LEDGER.begin_wake(wake_ns)
+        led_on = LEDGER.enabled
         sent = 0
         use_tpu = self.config.tpu_fanout
         # megabatch: coalesce every engine-eligible stream's device work
@@ -1021,23 +1029,27 @@ class StreamingServer:
         # VOD service, never the pump.
         vod_pairs = []
         if self.vod_pacer is not None and self.vod_pacer.sessions:
+            _u = LEDGER.unit_start()
             try:
                 vod_pairs = self.vod_pacer.tick(t)
             except Exception as e:
                 vod_pairs = []
                 if self.error_log:
                     self.error_log.warning(f"vod pacer: {e!r}")
+            LEDGER.unit_end(_u, "vod_fill", items=max(len(vod_pairs), 1))
         # DVR window spill (ISSUE 12): snapshot any live ring window the
         # head completed since the last wake (an integer compare per
         # armed stream when nothing did).  Runs BEFORE the reflect pass
         # so a time-shift cursor parked at the spill/ring seam sees the
         # freshest cold tail.  Failures degrade recording, not relaying.
         if self.dvr is not None and self.dvr._armed:
+            _u = LEDGER.unit_start()
             try:
                 self.dvr.tick(t)
             except Exception as e:
                 if self.error_log:
                     self.error_log.warning(f"dvr spill: {e!r}")
+            LEDGER.unit_end(_u, "dvr_spill")
         mega_pairs = []
         lad = self.ladder
         if use_tpu and self.config.megabatch_enabled:
@@ -1058,6 +1070,7 @@ class StreamingServer:
                     from ..relay.megabatch import MegabatchScheduler
                     self.megabatch = MegabatchScheduler(
                         mesh=self.megabatch_mesh)
+                _u = LEDGER.unit_start()
                 try:
                     self.megabatch.begin_wake(mega_pairs, t)
                 except Exception as e:
@@ -1067,20 +1080,32 @@ class StreamingServer:
                     mega_pairs = []
                     if self.error_log:
                         self.error_log.warning(f"megabatch harvest: {e!r}")
+                LEDGER.unit_end(_u, "megabatch",
+                                items=max(len(mega_pairs), 1))
             else:
                 mega_pairs = []
         if not mega_pairs and self.megabatch is not None:
             # scheduler built but not engaged this wake (mass teardown,
             # megabatch disabled): keep harvesting in-flight passes so
             # they can't pin torn-down streams and staging buffers
+            _u = LEDGER.unit_start()
             try:
                 self.megabatch.idle_wake()
             except Exception as e:
                 if self.error_log:
                     self.error_log.warning(f"megabatch idle: {e!r}")
+            LEDGER.unit_end(_u, "megabatch")
         mega_ids = {id(s) for s, _ in mega_pairs}
+        # live relay pass: ONE ledger unit covering every live stream's
+        # step/reflect; the slowest stream's trace_id rides the record
+        # (the critical-path correlation a p99 sample decomposes by)
+        _lu = LEDGER.unit_start()
+        _n_live = 0
+        _worst_ns, _worst_trace = -1, None
         for sess in list(self.registry.sessions.values()):
             for stream in sess.streams.values():
+                _s0 = time.perf_counter_ns() if led_on else 0
+                _n_live += 1
                 # per-stream guard: one bad output (broken socket, buggy
                 # transcoder tap) must never halt fan-out for the rest
                 pre_stalls = stream.stats.stalls
@@ -1128,10 +1153,17 @@ class StreamingServer:
                 # time wake cannot unblock a full socket)
                 stream._last_pass_stalled = \
                     stream.stats.stalls > pre_stalls
+                if led_on:
+                    _el = time.perf_counter_ns() - _s0
+                    if _el > _worst_ns:
+                        _worst_ns, _worst_trace = _el, stream.trace_id
+        LEDGER.unit_end(_lu, "live_relay", items=max(_n_live, 1),
+                        trace_id=_worst_trace)
         # paced VOD streams: same per-stream guard discipline as live.
         # The device gate ignores tpu_min_outputs — a VOD subscriber is
         # one output by construction, and its device cost is a bucket
         # row in the stacked pass, not a per-stream dispatch
+        _vu = LEDGER.unit_start() if vod_pairs else None
         for stream, eng in vod_pairs:
             pre_stalls = stream.stats.stalls
             try:
@@ -1154,7 +1186,10 @@ class StreamingServer:
                         f"vod tick error on {stream.session_path}: {e!r}")
             stream._last_pass_stalled = \
                 stream.stats.stalls > pre_stalls
+        if _vu is not None:
+            LEDGER.unit_end(_vu, "vod_fill", items=len(vod_pairs))
         if mega_pairs:
+            _u = LEDGER.unit_start()
             try:
                 self.megabatch.end_wake(mega_pairs, t)
             except Exception as e:
@@ -1163,6 +1198,7 @@ class StreamingServer:
                         [s.session_path for s, _ in mega_pairs])
                 if self.error_log:
                     self.error_log.warning(f"megabatch stage: {e!r}")
+            LEDGER.unit_end(_u, "megabatch", items=len(mega_pairs))
         return sent
 
     def _make_pump_wheel(self):
@@ -1251,6 +1287,8 @@ class StreamingServer:
                         if self.error_log:
                             self.error_log.warning(f"ladder tick: {e!r}")
                 if self.checkpoint is not None:
+                    from ..obs import LEDGER
+                    _u = LEDGER.unit_start()
                     try:
                         wrote = self.checkpoint.maybe_write(self.registry)
                         if wrote and self.vod_cache is not None:
@@ -1258,6 +1296,7 @@ class StreamingServer:
                     except Exception as e:
                         if self.error_log:
                             self.error_log.warning(f"checkpoint: {e!r}")
+                    LEDGER.unit_end(_u, "checkpoint")
                 if self.presence is not None:
                     self.presence.set_load(sum(
                         s.num_outputs
@@ -1266,6 +1305,12 @@ class StreamingServer:
                         await self.presence.sync_streams(self.registry.paths())
                     except Exception:
                         pass
+            # close this wake's ledger record AFTER the maintenance
+            # block: the 1 Hz duties ran on the same wake's thread time,
+            # so their service belongs to the record a queued packet's
+            # wait decomposes against
+            from ..obs import LEDGER
+            LEDGER.end_wake()
 
     def _ladder_maintenance(self) -> None:
         """1 Hz ladder duties: evaluate recovery/SLO pressure, then shed
@@ -1405,6 +1450,10 @@ class StreamingServer:
             "OutRatePps": str(d["out_rate"]),
             "IngestToWireP99Ms": str(d["ingest_to_wire_p99_ms"]),
             "TpuFanout": "1" if self.config.tpu_fanout else "0",
+            # wake-ledger summary (ISSUE 16): the console's "is the pump
+            # starving" answer without a /metrics scrape
+            "LedgerTopWaitClass": str(d.get("ledger_top_wait_class", "")),
+            "LedgerLastWakeMs": str(d.get("ledger_last_wake_ms", 0.0)),
         }
 
     def live_sessions(self) -> list[dict]:

@@ -332,6 +332,18 @@ class RestApi:
         return (200, json.dumps(admin.profile_snapshot(self.app),
                                 default=str), "application/json")
 
+    def _cmd_ledger(self, params: dict,
+                    body: bytes) -> tuple[int, str, str]:
+        """GET /api/v1/ledger — the wake-loop ledger's live snapshot
+        (ISSUE 16): per-work-class wait/service aggregates, deferred
+        counts, worst-wait trace correlation, and the cluster tick's
+        Redis roundtrip sub-accounting.  Raw JSON (same pipe-to-jq
+        convention as /api/v1/profile); ``tools/blame_report.py`` and
+        the soak post-mortems read exactly this document."""
+        from . import admin
+        return (200, json.dumps(admin.ledger_snapshot(self.app),
+                                default=str), "application/json")
+
     def _cmd_fleet(self, params: dict,
                    body: bytes) -> tuple[int, str, str]:
         """GET /api/v1/fleet — the aggregated cluster topology (ISSUE
@@ -668,6 +680,12 @@ class RestApi:
             # live phase/session attribution snapshot (raw JSON for the
             # same pipe-to-jq reason as command=trace)
             return (200, json.dumps(admin.profile_snapshot(self.app),
+                                    default=str), "application/json")
+        if command == "blame":
+            # the wake ledger's "why is p99 high" decomposition (ISSUE
+            # 16): per-class wait/service attribution ranked by blame,
+            # with cross-node suspect flags — raw JSON for jq
+            return (200, json.dumps(admin.blame_snapshot(self.app),
                                     default=str), "application/json")
         if command == "set":
             status, payload = admin.set_pref(

@@ -104,6 +104,13 @@ class StatusMonitor:
         snap["ingest_to_wire_p99_ms"] = round(lat.quantile(0.99) * 1e3, 3)
         snap["wire_bytes"] = int(obs.EGRESS_BYTES.value())
         snap["tpu_passes"] = int(obs.TPU_PASSES.value())
+        # wake-ledger summary (ISSUE 16): "is the pump starving" answered
+        # from the console/getserverinfo without a scrape — the class
+        # that waited longest in the latest wake and that wake's duration
+        led = obs.LEDGER
+        snap["ledger_top_wait_class"] = led.last_top_class
+        snap["ledger_last_wake_ms"] = round(led.last_wake_ms, 3)
+        snap["ledger_wakes"] = led.wakes
         return snap
 
     # -- console (the -S display) -----------------------------------------

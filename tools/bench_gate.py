@@ -390,6 +390,45 @@ def check_trajectory(traj: list[dict],
             if mm3:
                 errs.append(f"{name}: composed recorded {mm3} wire/"
                             "oracle mismatches with every engine on")
+            # ISSUE 16 wake-ledger decomposition — OPTIONAL (rounds
+            # predating the ledger stay valid), but when present: the
+            # blame doc names exactly one top offender from the closed
+            # work-class vocabulary, every per-class figure is finite
+            # non-negative, and the attribution CONSERVES — per-class
+            # wait+service accounts for >= 90% of the measured mixed
+            # p99 (an estimator that explains less is blaming the
+            # wrong class)
+            lb = cp.get("latency_blame")
+            if isinstance(lb, dict) and lb and "error" not in lb:
+                top = lb.get("top_offender")
+                if not isinstance(top, str) or not top:
+                    errs.append(f"{name}: composed.latency_blame "
+                                "names no top offender")
+                for kf in ("baseline_p50_ms", "worst_wait_p99_ms",
+                           "relay_service_p99_ms", "attributed_p99_ms"):
+                    v2 = lb.get(kf)
+                    if not isinstance(v2, (int, float)) \
+                            or not math.isfinite(v2) or v2 < 0:
+                        errs.append(f"{name}: composed.latency_blame."
+                                    f"{kf} {v2!r} not a finite non-"
+                                    "negative figure")
+                for row in (lb.get("rows") or []):
+                    for kf in ("wait_p99_ms", "service_p99_ms"):
+                        v2 = row.get(kf)
+                        if not isinstance(v2, (int, float)) \
+                                or not math.isfinite(v2) or v2 < 0:
+                            errs.append(
+                                f"{name}: composed.latency_blame row "
+                                f"{row.get('work_class')!r}.{kf} "
+                                f"{v2!r} not finite non-negative")
+                cons = lb.get("conservation")
+                if cons is not None and (
+                        not isinstance(cons, (int, float))
+                        or not math.isfinite(cons) or cons < 0.9):
+                    errs.append(f"{name}: composed.latency_blame."
+                                f"conservation {cons!r} below the 0.9 "
+                                "floor (the decomposition must account "
+                                "for >= 90% of the measured mixed p99)")
         # ISSUE 13 rebalance section — OPTIONAL (rounds predating the
         # load-aware control plane stay valid), but when present: a
         # planned rebalance drain must be GAPLESS at the player socket,

@@ -1,0 +1,259 @@
+"""blame_report: the "why is p99 high" table from the wake ledger.
+
+Renders the causal latency attribution the wake-loop ledger
+(``easydarwin_tpu/obs/ledger.py``) accumulates: per work-class
+enqueue→start wait and exclusive service quantiles, deferred/shed
+counts, and the cross-node suspect flags (Redis roundtrips per cluster
+tick, roundtrip latency, auxiliary ticks dominating relay service).
+
+Sources, in order of preference:
+
+* ``--url http://host:port`` (repeatable) — a LIVE server: fetches
+  ``/api/v1/admin?command=blame`` per node (falls back to the raw
+  ``/api/v1/ledger`` snapshot when the admin surface is older).
+* ``--capture file.json`` (repeatable) — an offline capture: a bench
+  result (``extra.composed.latency_blame``), a soak ``COMPOSED STATS``
+  dict (``latency_blame``), a blame doc, or a bare ledger snapshot.
+  A soak/bench stdout log also works: the last ``COMPOSED STATS`` line
+  is parsed out of it.
+
+The report always names a SINGLE top offender — the class whose wait
+p99 contributes most to the mixed p99 — and, when the source carried a
+measured p99, prints the conservation ratio (attributed / measured;
+the composed bench round gates this at >= 0.9).
+
+Exit status: 0 on a rendered report, 1 when no source yielded a usable
+document (so CI wrappers can tell "no data" from "healthy").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+#: columns of the per-class table: (header, row key, format)
+_COLS = (
+    ("class", "work_class", "{:<12}"),
+    ("wait_p50", "wait_p50_ms", "{:>10.2f}"),
+    ("wait_p99", "wait_p99_ms", "{:>10.2f}"),
+    ("wait_max", "wait_max_ms", "{:>10.2f}"),
+    ("svc_p99", "service_p99_ms", "{:>9.2f}"),
+    ("count", "count", "{:>9d}"),
+    ("deferred", "deferred", "{:>8d}"),
+)
+
+
+def _fetch(url: str, timeout: float) -> dict | None:
+    """One node's blame doc: ``command=blame`` preferred, raw ledger
+    snapshot as the fallback (older server) — the caller wraps the
+    snapshot into a doc via blame_doc-equivalent rows."""
+    base = url.rstrip("/")
+    for path in ("/api/v1/admin?command=blame", "/api/v1/ledger"):
+        try:
+            with urllib.request.urlopen(base + path, timeout=timeout) as r:
+                doc = json.loads(r.read().decode())
+        except Exception:
+            continue
+        if isinstance(doc, dict) and ("rows" in doc or "classes" in doc):
+            return doc
+    return None
+
+
+def _rows_from_snapshot(snap: dict) -> list[dict]:
+    """blame_doc-shaped rows from a bare ledger snapshot (offline
+    capture or a server without the blame command)."""
+    rows = []
+    for wc, st in (snap.get("classes") or {}).items():
+        rows.append({"work_class": wc, **st})
+    rows.sort(key=lambda r: (-float(r.get("wait_p99_ms", 0.0) or 0.0),
+                             -float(r.get("service_p99_ms", 0.0) or 0.0)))
+    return rows
+
+
+def _coerce_doc(obj: dict) -> dict | None:
+    """Accept any of the capture shapes and return a blame-doc-like
+    dict with at least ``rows`` (and optionally ``top_offender``,
+    ``conservation``, ``measured_p99_ms``, ``ledger``)."""
+    if not isinstance(obj, dict):
+        return None
+    # bench result → extra.composed.latency_blame; soak stats →
+    # latency_blame; blame doc → rows; ledger snapshot → classes
+    for path in (("extra", "composed", "latency_blame"),
+                 ("composed", "latency_blame"),
+                 ("latency_blame",)):
+        node = obj
+        for key in path:
+            node = node.get(key) if isinstance(node, dict) else None
+            if node is None:
+                break
+        if isinstance(node, dict) and ("rows" in node or "classes" in node):
+            obj = node
+            break
+    if "rows" in obj:
+        return obj
+    if "classes" in obj:
+        doc = {"rows": _rows_from_snapshot(obj), "ledger": obj}
+        if doc["rows"]:
+            doc["top_offender"] = doc["rows"][0]["work_class"]
+        return doc
+    return None
+
+
+def _load_capture(path: str) -> dict | None:
+    """A capture file: JSON document, or a soak/bench stdout log whose
+    last ``COMPOSED STATS`` line carries the stats dict."""
+    try:
+        text = open(path, encoding="utf-8", errors="replace").read()
+    except OSError as e:
+        print(f"blame_report: {path}: {e}", file=sys.stderr)
+        return None
+    try:
+        return _coerce_doc(json.loads(text))
+    except ValueError:
+        pass
+    for line in reversed(text.splitlines()):
+        if line.startswith("COMPOSED STATS "):
+            try:
+                return _coerce_doc(json.loads(line[len("COMPOSED STATS "):]))
+            except ValueError:
+                return None
+    return None
+
+
+def _suspects(doc: dict) -> list[str]:
+    """Cross-node suspect lines: prefer the doc's own (server-side
+    suspect_flags rode along), else re-derive what the capture allows."""
+    flags = doc.get("suspects")
+    if isinstance(flags, list) and flags:
+        return [str(f) for f in flags]
+    out = []
+    led = doc.get("ledger") or {}
+    redis = led.get("redis") or doc.get("redis") or {}
+    rpt = float(redis.get("roundtrips_per_tick", 0.0) or 0.0)
+    lat = float(redis.get("latency_ms_mean", 0.0) or 0.0)
+    if rpt > 8:
+        out.append(f"redis: {rpt:.1f} roundtrips per cluster tick "
+                   "(> 8) — chatty control plane")
+    if lat > 5:
+        out.append(f"redis: {lat:.2f} ms mean roundtrip (> 5 ms) — "
+                   "slow or distant control plane")
+    by = {r.get("work_class"): r for r in doc.get("rows", [])}
+    aux = by.get("cluster_tick") or {}
+    relay = by.get("live_relay") or {}
+    if float(aux.get("service_p99_ms", 0) or 0) \
+            > float(relay.get("service_p99_ms", 0) or 0) > 0:
+        out.append("cluster_tick service p99 exceeds live_relay's — "
+                   "auxiliary ticks starving the data path")
+    return out
+
+
+def _render(doc: dict, *, node: str = "") -> None:
+    rows = doc.get("rows") or []
+    title = f"wake-ledger blame{f' — node {node}' if node else ''}"
+    print(title)
+    print("-" * len(title))
+    # header cells reuse each column's width (strip the numeric type)
+    print("  ".join(fmt.replace(".2f", "").replace("d", "").format(h)
+                    for h, _, fmt in _COLS))
+    for r in rows:
+        cells = []
+        for h, key, fmt in _COLS:
+            v = r.get(key, 0)
+            if "d" in fmt:
+                cells.append(fmt.format(int(v or 0)))
+            elif "f" in fmt:
+                cells.append(fmt.format(float(v or 0.0)))
+            else:
+                cells.append(fmt.format(str(v)))
+        print("  ".join(cells))
+    top = doc.get("top_offender") or (rows[0]["work_class"] if rows
+                                      else "(none)")
+    print(f"top offender: {top}")
+    measured = doc.get("measured_p99_ms")
+    cons = doc.get("conservation")
+    if measured is not None:
+        line = f"measured p99: {float(measured):.2f} ms"
+        if doc.get("attributed_p99_ms") is not None:
+            line += (f"  attributed: "
+                     f"{float(doc['attributed_p99_ms']):.2f} ms")
+        if cons is not None:
+            line += (f"  conservation: {float(cons):.2f} "
+                     f"({'OK' if float(cons) >= 0.9 else 'LEAK'})")
+        print(line)
+    worst = doc.get("worst_trace_id") \
+        or (doc.get("ledger") or {}).get("worst_trace_id")
+    if worst:
+        print(f"worst-wait trace: {worst}")
+    for s in _suspects(doc):
+        print(f"suspect: {s}")
+    print()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="blame_report",
+        description="Render the wake-ledger 'why is p99 high' table "
+                    "from live servers or soak/bench captures.")
+    ap.add_argument("--url", action="append", default=[],
+                    help="live server base URL (repeatable; fetches "
+                         "/api/v1/admin?command=blame per node)")
+    ap.add_argument("--capture", action="append", default=[],
+                    help="offline capture: bench result JSON, soak "
+                         "COMPOSED STATS (JSON or stdout log), blame "
+                         "doc, or ledger snapshot (repeatable)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged docs as JSON instead of the "
+                         "rendered table")
+    args = ap.parse_args(argv)
+    if not args.url and not args.capture:
+        ap.error("need at least one --url or --capture")
+
+    docs: list[tuple[str, dict]] = []
+    for url in args.url:
+        doc = _fetch(url, args.timeout)
+        if doc is None:
+            print(f"blame_report: {url}: no ledger surface answered",
+                  file=sys.stderr)
+            continue
+        doc = _coerce_doc(doc) or doc
+        docs.append((doc.get("node") or url, doc))
+    for path in args.capture:
+        doc = _load_capture(path)
+        if doc is None:
+            print(f"blame_report: {path}: no blame/ledger document "
+                  "found", file=sys.stderr)
+            continue
+        docs.append((doc.get("node") or path, doc))
+    if not docs:
+        return 1
+
+    if args.json:
+        print(json.dumps({node: doc for node, doc in docs}, indent=1,
+                         default=str))
+        return 0
+    for node, doc in docs:
+        _render(doc, node=node)
+    if len(docs) > 1:
+        # the fleet-level single answer: the worst per-node top
+        # offender by its wait p99 contribution
+        worst_node, worst_doc, worst_wait = "", None, -1.0
+        for node, doc in docs:
+            rows = doc.get("rows") or []
+            if not rows:
+                continue
+            w = float(rows[0].get("wait_p99_ms", 0.0) or 0.0)
+            if w > worst_wait:
+                worst_node, worst_doc, worst_wait = node, doc, w
+        if worst_doc is not None:
+            top = worst_doc.get("top_offender") \
+                or worst_doc["rows"][0]["work_class"]
+            print(f"fleet top offender: {top} on {worst_node} "
+                  f"(wait p99 {worst_wait:.2f} ms)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

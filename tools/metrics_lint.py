@@ -83,6 +83,9 @@ def lint(registry) -> list[str]:
             if ln == "le":
                 errs.append(f"{n}: label 'le' is reserved for histogram "
                             "buckets")
+            if ln == "n":
+                errs.append(f"{n}: label 'n' is reserved (the weighted-"
+                            "observe parameter)")
         bounds = getattr(fam, "bounds", None)
         if bounds is not None:
             if any(b != b or b in (float("inf"), float("-inf"))
@@ -708,6 +711,73 @@ def lint_fleet(registry, schema: dict) -> list[str]:
     return errs
 
 
+def lint_ledger(registry) -> list[str]:
+    """The wake-ledger contract (ISSUE 16): the ``pump_*`` families
+    exist with exactly a ``work_class`` label, every observed child
+    stays inside the CLOSED ``obs.ledger.WORK_CLASSES`` vocabulary (an
+    open set would shard the wait/service histograms and break every
+    blame ratio), the ledger histograms ride the full shared
+    TIME_BUCKETS ladder, and the ladder's top bucket exceeds the SLO
+    watchdog's worst window — a wait that outlives the slow window must
+    still resolve into a finite bucket, not the +Inf catch-all, or the
+    blame report's p99 saturates exactly when it matters most."""
+    errs: list[str] = []
+    from easydarwin_tpu.obs.ledger import WORK_CLASSES
+    from easydarwin_tpu.obs.metrics import TIME_BUCKETS
+    from easydarwin_tpu.obs.slo import SloConfig
+    for v in WORK_CLASSES:
+        if not NAME_RE.match(v):
+            errs.append(f"work-class vocabulary entry {v!r} not "
+                        "snake_case")
+    want_labels = {
+        "pump_wait_seconds": ("work_class",),
+        "pump_service_seconds": ("work_class",),
+        "pump_deferred_total": ("work_class",),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"ledger family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    for fam_name in ("pump_wait_seconds", "pump_service_seconds"):
+        fam = fams.get(fam_name)
+        if fam is None:
+            continue
+        bounds = getattr(fam, "bounds", ())
+        if not bounds or bounds[0] > TIME_BUCKETS[0] \
+                or bounds[-1] < TIME_BUCKETS[-1]:
+            errs.append(f"{fam_name}: bucket bounds do not cover the "
+                        f"TIME_BUCKETS range [{TIME_BUCKETS[0]}, "
+                        f"{TIME_BUCKETS[-1]}]")
+        for (wc,) in getattr(fam, "_states", {}):
+            if wc not in WORK_CLASSES:
+                errs.append(f"{fam_name}: observed work_class {wc!r} "
+                            f"outside the closed set {WORK_CLASSES}")
+    fam = fams.get("pump_deferred_total")
+    if fam is not None:
+        for (wc,) in getattr(fam, "_values", {}):
+            if wc not in WORK_CLASSES:
+                errs.append(f"pump_deferred_total: observed work_class "
+                            f"{wc!r} outside the closed set "
+                            f"{WORK_CLASSES}")
+    # the multi-second regime (ISSUE 16 satellite 1): the ladder's top
+    # finite bucket must exceed the watchdog's worst window
+    cfg = SloConfig()
+    worst = max(cfg.fast_window_s, cfg.slow_window_s)
+    if TIME_BUCKETS[-1] <= worst:
+        errs.append(f"TIME_BUCKETS top bucket {TIME_BUCKETS[-1]}s does "
+                    f"not exceed the SLO watchdog's worst window "
+                    f"{worst}s — ledger waits would saturate into +Inf")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -824,6 +894,10 @@ def main() -> int:
     # gauges with the closed tier set, the freshness chain histogram,
     # fleet.* events and the seq/node_id event envelope
     errs += lint_fleet(obs.REGISTRY, ev.SCHEMA)
+    # the wake ledger's vocabulary (ISSUE 16): pump_* families with the
+    # closed work_class set + the multi-second bucket ladder whose top
+    # exceeds the SLO watchdog's worst window
+    errs += lint_ledger(obs.REGISTRY)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:
