@@ -2285,6 +2285,16 @@ def main():
                 "top_offender", "attributed_p99_ms", "measured_p99_ms",
                 "conservation")
             if k in lb}
+    aud = cp.get("audience") or {}
+    if aud:
+        # the audience headline survives the compact projection: how
+        # the VIEWERS fared (QoE distribution, stall pressure) next to
+        # the engine-side figures
+        compact_extra["composed"]["audience"] = {
+            k: aud[k] for k in (
+                "subscribers", "qoe_p50", "qoe_p10", "stall_ratio",
+                "stall_storms", "columns_bytes_per_subscriber")
+            if k in aud}
     compact_extra["details_file"] = "bench_details.json"
     print(json.dumps({
         "metric": details["metric"],

@@ -66,6 +66,7 @@ class _ShiftTrack:
             codec=spilled.info.codec or None)
         self.stream = VodStream(spilled.info, settings, ring)
         self.stream.session_path = sess.path
+        self.stream.audience_tier = "dvr"
         # the output's rewrite is PRESERVED: a live subscriber keeps its
         # latched base (seq/ts continuity through the shift and back); a
         # fresh subscriber latches from the first replayed packet

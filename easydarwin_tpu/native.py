@@ -546,6 +546,14 @@ def uring_ingest_disarm(fd: int | None = None) -> None:
         ing.close()
 
 
+def uring_ingest_armed(fd: int) -> bool:
+    """True while ``fd`` still routes through an armed ingest ring.
+    Watchers poll this after a drain: ``udp_ingest`` disarms (and closes
+    the ring fd) on any io_uring failure, and the closed fd number must
+    be dropped from the event loop before a new socket recycles it."""
+    return fd in _uring_ingests
+
+
 def _u8(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 

@@ -168,6 +168,13 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # ``stale`` so dashboards show last-known state, never fresh lies.
     "fleet.node_stale": ("node",),
     "fleet.node_live": ("node",),
+    # audience observatory (ISSUE 18, obs/audience.py): one latched
+    # event per stall-storm rising edge — k-of-n subscribers of one
+    # stream entered stall inside the storm window; ``blamed`` carries
+    # the wake ledger's current top wait class so the viewer-facing
+    # symptom names the server-side cause.  Never per subscriber,
+    # never per tick.
+    "audience.stall_storm": ("stalled", "subscribers", "blamed"),
 }
 
 

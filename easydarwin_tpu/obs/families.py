@@ -653,3 +653,35 @@ RELAY_TREE_EDGES = REGISTRY.counter(
     "started by this node to serve local subscribers of a stream "
     "another node owns (E edges cost the origin E pulls instead of "
     "E x S subscribers)")
+
+# --------------------------------------------------------------- audience
+# The audience observatory (ISSUE 18): per-subscriber QoE derived from
+# the columnar store in obs/audience.py.  tools/metrics_lint.py
+# (lint_audience) enforces this family set, the closed tier/band
+# vocabularies and the [0, 1] QoE bucket ladder; tools/soak.py
+# --composed keys its viewer-experience gate on the same figures.
+from .audience import QOE_BUCKETS as _QOE_BUCKETS  # noqa: E402
+
+AUDIENCE_QOE_SCORE = REGISTRY.histogram(
+    "audience_qoe_score",
+    "Per-subscriber QoE score distribution, one sample per subscriber "
+    "per maintenance tick (delivery ratio x freshness x stall penalty, "
+    "bounded [0, 1] — the closed formula in ARCHITECTURE.md "
+    "'Audience observatory')", labels=("tier",),
+    buckets=_QOE_BUCKETS)
+AUDIENCE_STALL_SECONDS = REGISTRY.counter(
+    "audience_stall_seconds_total",
+    "Cumulative viewer-frozen seconds per tier: inter-delivery gaps "
+    "beyond the stall threshold, summed across every subscriber "
+    "(derived on the maintenance tick from the columnar last-wire "
+    "stamps, never measured per packet)", labels=("tier",))
+AUDIENCE_SUBSCRIBERS = REGISTRY.gauge(
+    "audience_subscribers",
+    "Current subscriber census by tier and QoE band (good/fair/poor — "
+    "the closed band vocabulary over the same closed tier set the "
+    "fleet rollup uses)", labels=("tier", "band"))
+AUDIENCE_STALL_STORMS = REGISTRY.counter(
+    "audience_stall_storms_total",
+    "Stall-storm rising edges: k-of-n subscribers of one stream "
+    "entered stall inside the storm window (each latched edge also "
+    "emits audience.stall_storm carrying the ledger-blamed work class)")

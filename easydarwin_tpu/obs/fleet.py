@@ -106,6 +106,16 @@ def _stream_tier(app, sess) -> str:
     return "live"
 
 
+def _audience_rollup() -> dict:
+    """The audience store's compact aggregate, never raising from the
+    cluster tick (a broken column pass must not stop federation)."""
+    try:
+        from .audience import AUDIENCE
+        return AUDIENCE.rollup()
+    except Exception:
+        return {}
+
+
 def build_rollup(app) -> dict:
     """One node's compact federation rollup (the ``Fleet:{node}``
     payload): headline counters, SLO budget, ladder rungs, per-tier
@@ -193,6 +203,10 @@ def build_rollup(app) -> dict:
         },
         "freshness_p99_s":
             round(families.RELAY_E2E_FRESHNESS.quantile(0.99), 4),
+        # audience observatory (ISSUE 18): the viewer-experience
+        # aggregate rides every rollup so /api/v1/fleet answers "how
+        # is the audience doing" cluster-wide without extra RPCs
+        "audience": _audience_rollup(),
     }
     if lt is not None:
         doc["util"] = round(getattr(lt, "last_util", 0.0), 4)

@@ -716,6 +716,17 @@ class TpuFanoutEngine:
         taken = 0
         hard_consumed = False
         sent_slots: list[np.ndarray] = []   # → ingest→wire histogram
+        # audience aggregates (obs/audience.py): assembled inside this
+        # existing accounting walk, applied as ONE vectorized column
+        # pass below; disabled = one attribute check
+        aud = obs.AUDIENCE
+        ablk = stream.audience if aud.enabled else None
+        a_rows: list[int] = []
+        a_pkts: list[int] = []
+        a_byts: list[int] = []
+        a_first: list[int] = []
+        a_last: list[int] = []
+        a_slots: list[np.ndarray] = []
         for (out, hi, pids, slots, lens), n in zip(per_out, counts):
             k = min(max(r - taken, 0), n)
             taken += n
@@ -743,6 +754,21 @@ class TpuFanoutEngine:
                 out.payload_octets += sent_bytes - 12 * k
                 self._pass_wire_bytes += sent_bytes
                 sent_slots.append(slots[:k])
+                if ablk is not None:
+                    row = getattr(out, "audience_row", -1)
+                    if row >= 0:
+                        a_rows.append(row)
+                        a_pkts.append(k)
+                        a_byts.append(sent_bytes)
+                        a_first.append(int(pids[0]))
+                        a_last.append(int(pids[k - 1]))
+                        a_slots.append(slots[:k])
+        if a_rows:
+            a_cat = (a_slots[0] if len(a_slots) == 1
+                     else np.concatenate(a_slots))
+            aud.note_pass(ablk, a_rows, a_pkts, a_byts, a_first, a_last,
+                          (wire_ns - ring.arrival_ns[a_cat]) / 1e9,
+                          wire_ns)
         if sent_slots:
             # one vectorized observe per pass: perf_counter stamp at
             # push_rtp minus the send-return instant, per delivered
@@ -826,6 +852,16 @@ class TpuFanoutEngine:
         backend = self.stream_backend()
         sent = 0
         sent_slots: list[np.ndarray] = []
+        # audience aggregates — same ONE-vectorized-pass discipline as
+        # the UDP scatter (obs/audience.py)
+        aud = obs.AUDIENCE
+        ablk = stream.audience if aud.enabled else None
+        a_rows: list[int] = []
+        a_pkts: list[int] = []
+        a_byts: list[int] = []
+        a_first: list[int] = []
+        a_last: list[int] = []
+        a_slots: list[np.ndarray] = []
         for j, (out, b_idx) in enumerate(tcp):
             col = col0 + j
             # deep-backlog shed BEFORE building the span: a reader this
@@ -921,7 +957,22 @@ class TpuFanoutEngine:
                 sent_slots.append(slots[:k])
                 obs.TCP_EGRESS_PACKETS.inc(k, backend=used)
                 obs.TCP_EGRESS_BYTES.inc(nbytes + 4 * k, backend=used)
+                if ablk is not None:
+                    row = getattr(out, "audience_row", -1)
+                    if row >= 0:
+                        a_rows.append(row)
+                        a_pkts.append(k)
+                        a_byts.append(nbytes)
+                        a_first.append(int(pids[0]))
+                        a_last.append(int(pids[k - 1]))
+                        a_slots.append(slots[:k])
         wire_ns = time.perf_counter_ns()
+        if a_rows:
+            a_cat = (a_slots[0] if len(a_slots) == 1
+                     else np.concatenate(a_slots))
+            aud.note_pass(ablk, a_rows, a_pkts, a_byts, a_first, a_last,
+                          (wire_ns - ring.arrival_ns[a_cat]) / 1e9,
+                          wire_ns)
         if t_egress:
             self._phase_add("egress_io_uring" if backend == "io_uring"
                             else "egress_native", wire_ns - t_egress)
@@ -1000,12 +1051,26 @@ class TpuFanoutEngine:
         sent = 0
         lat_ns: list[int] = []
         delay = stream.settings.bucket_delay_ms
+        # audience aggregates — assembled in the existing walk, ONE
+        # vectorized column pass at the bottom (obs/audience.py)
+        aud = obs.AUDIENCE
+        ablk = stream.audience if aud.enabled else None
+        a_rows: list[int] = []
+        a_pkts: list[int] = []
+        a_byts: list[int] = []
+        a_first: list[int] = []
+        a_last: list[int] = []
+        a_lat: list[int] = []
         for s, (out, b_idx) in enumerate(flat):
             pid = out.bookmark
             if pid is None:
                 continue
             deadline = now_ms - b_idx * delay
             tcp_ok = tcp_bytes = 0      # buffered-rung interleave counts
+            o_row = (getattr(out, "audience_row", -1)
+                     if ablk is not None else -1)
+            o_sent = o_byts = 0
+            o_first = o_last = -1
             while pid < ring.head:
                 j = pid - start
                 if j < 0:
@@ -1037,8 +1102,22 @@ class TpuFanoutEngine:
                     sent += 1
                     tcp_ok += 1
                     tcp_bytes += 16 + len(payload)
-                    lat_ns.append(int(ring.arrival_ns[slot]))
+                    stamp = int(ring.arrival_ns[slot])
+                    lat_ns.append(stamp)
+                    if o_row >= 0:
+                        o_sent += 1
+                        o_byts += 12 + len(payload)
+                        if o_first < 0:
+                            o_first = pid - 1
+                        o_last = pid - 1
+                        a_lat.append(stamp)
             out.bookmark = pid
+            if o_sent:
+                a_rows.append(o_row)
+                a_pkts.append(o_sent)
+                a_byts.append(o_byts)
+                a_first.append(o_first)
+                a_last.append(o_last)
             if tcp_ok and getattr(out, "interleave_chan", None) is not None:
                 # interleaved sends served from the per-session rung —
                 # counted so the tcp_egress families are an honest total
@@ -1048,6 +1127,11 @@ class TpuFanoutEngine:
         if lat_ns:
             now_ns = time.perf_counter_ns()
             lat_s = (now_ns - np.asarray(lat_ns, dtype=np.int64)) / 1e9
+            if a_rows:
+                aud.note_pass(
+                    ablk, a_rows, a_pkts, a_byts, a_first, a_last,
+                    (now_ns - np.asarray(a_lat, np.int64)) / 1e9,
+                    now_ns)
             obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="batch")
             if obs.LEDGER.enabled:
                 obs.LEDGER.note_queue_age(float(lat_s.max()), lat_s.size)

@@ -726,6 +726,10 @@ class StreamFec:
                 f.rtx_sent += 1
                 sent += 1
                 obs.RTX_SENT.inc()
+        if sent:
+            # credit the repairs to this subscriber's audience row
+            # (one call per NACK batch — cold control path)
+            obs.AUDIENCE.note_credit(out, rtx=sent)
         return sent
 
 
@@ -760,10 +764,16 @@ class FecReceiver:
     registry, so recovery is a scrapeable quantity)."""
 
     def __init__(self, *, media_pt: int = 96, fec_pt: int = 127,
-                 rtx_pt: int = 126):
+                 rtx_pt: int = 126, subscriber=None):
         self.media_pt = media_pt
         self.fec_pt = fec_pt
         self.rtx_pt = rtx_pt
+        #: optional audience binding (an object carrying
+        #: ``audience_block``/``audience_row`` — typically the server-
+        #: side RelayOutput serving this receiver): parity recoveries
+        #: are credited to that subscriber's ``fec`` column so QoE
+        #: accounts repairs the viewer actually benefited from
+        self.subscriber = subscriber
         self.media: dict[int, bytes] = {}      # ext seq → wire bytes
         self.recovered: dict[int, bytes] = {}  # via FEC solve
         self.rtx_restored: dict[int, bytes] = {}
@@ -903,6 +913,9 @@ class FecReceiver:
                 obs.FEC_RECOVERED.inc()
             self._groups.pop(key, None)
             self._group_kind.pop(key, None)
+        if solved and self.subscriber is not None:
+            # audience credit: one call per solve batch, never per row
+            obs.AUDIENCE.note_credit(self.subscriber, fec=solved)
         return solved
 
 

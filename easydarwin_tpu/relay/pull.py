@@ -118,6 +118,8 @@ class PullRelay:
         self.session = self.registry.find_or_create(self.local_path, sd.raw)
         self.session.owner = self
         self.session.set_trace(self.trace_id)
+        for st in self.session.streams.values():
+            st.audience_tier = "pull"   # subscribers here are pull-fed
         self.alive = True
         EVENTS.emit("pull.start", stream=self.local_path,
                     trace_id=self.trace_id, url=self.url)

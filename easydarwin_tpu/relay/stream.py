@@ -85,6 +85,14 @@ class RelayStream:
         self.trace_id: str | None = None
         self.session_path: str | None = None
         self.buckets: list[list[RelayOutput]] = []
+        #: this stream's audience column block (obs/audience.py) — set
+        #: by AUDIENCE.register on the first subscriber; None keeps the
+        #: egress hooks to one attribute check per pass
+        self.audience = None
+        #: tier label new subscribers register under (closed
+        #: obs.audience.AUDIENCE_TIERS vocabulary); creators of pull/
+        #: vod/dvr streams override it
+        self.audience_tier = "live"
         #: outputs needing per-pass retransmit sweeps (reliable-UDP); kept
         #: separately so the pump pays nothing when none exist
         self.tickable_outputs: list[RelayOutput] = []
@@ -233,6 +241,7 @@ class RelayStream:
                     break
             else:
                 self.buckets.append([output])
+        obs.AUDIENCE.register(self, output)
         obs.EVENTS.emit("stream.output_add", stream=self.session_path,
                         trace_id=self.trace_id,
                         session_id=getattr(output, "session_id", None),
@@ -246,6 +255,7 @@ class RelayStream:
         for bucket in self.buckets:
             if output in bucket:
                 bucket.remove(output)
+                obs.AUDIENCE.unregister(output)
                 obs.EVENTS.emit(
                     "stream.output_remove", stream=self.session_path,
                     trace_id=self.trace_id,
@@ -288,6 +298,17 @@ class RelayStream:
         sent = 0
         bytes_out = 0
         lat_ns: list[int] = []          # ingest stamps of delivered packets
+        # audience aggregates (obs/audience.py): per-OUTPUT figures
+        # assembled inside the existing walk, applied as ONE vectorized
+        # column pass below; disabled costs one attribute check
+        aud = obs.AUDIENCE
+        ablk = self.audience if aud.enabled else None
+        a_rows: list[int] = []
+        a_pkts: list[int] = []
+        a_byts: list[int] = []
+        a_first: list[int] = []
+        a_last: list[int] = []
+        a_lat: list[int] = []           # stamps, audience rows only
         for b_idx, bucket in enumerate(self.buckets):
             deadline = now_ms - b_idx * self.settings.bucket_delay_ms
             for out in bucket:
@@ -298,6 +319,10 @@ class RelayStream:
                 if out.bookmark < ring.tail:   # evicted from under a stalled output
                     out.bookmark = ring.tail
                 pid = out.bookmark
+                o_row = (getattr(out, "audience_row", -1)
+                         if ablk is not None else -1)
+                o_sent = o_byts = 0
+                o_first = o_last = -1
                 while pid < ring.head:
                     if ring.get_arrival(pid) > deadline:
                         break
@@ -316,12 +341,32 @@ class RelayStream:
                     if res is WriteResult.OK:
                         sent += 1
                         bytes_out += len(data)
-                        lat_ns.append(int(ring.arrival_ns[ring.slot(pid - 1)]))
+                        stamp = int(ring.arrival_ns[ring.slot(pid - 1)])
+                        lat_ns.append(stamp)
+                        if o_row >= 0:
+                            o_sent += 1
+                            o_byts += len(data)
+                            if o_first < 0:
+                                o_first = pid - 1
+                            o_last = pid - 1
+                            a_lat.append(stamp)
                 out.bookmark = pid
+                if o_sent:
+                    a_rows.append(o_row)
+                    a_pkts.append(o_sent)
+                    a_byts.append(o_byts)
+                    a_first.append(o_first)
+                    a_last.append(o_last)
         self.stats.packets_out += sent
         if lat_ns:
-            lat_s = (time.perf_counter_ns()
+            wire_ns = time.perf_counter_ns()
+            lat_s = (wire_ns
                      - np.asarray(lat_ns, dtype=np.int64)) / 1e9
+            if a_rows:
+                aud.note_pass(
+                    ablk, a_rows, a_pkts, a_byts, a_first, a_last,
+                    (wire_ns - np.asarray(a_lat, np.int64)) / 1e9,
+                    wire_ns)
             obs.RELAY_INGEST_TO_WIRE.observe_many(lat_s, engine="scalar")
             if obs.LEDGER.enabled:
                 obs.LEDGER.note_queue_age(float(lat_s.max()), lat_s.size)

@@ -44,6 +44,7 @@ week's sessions).  Families: ``resilience_checkpoint_writes_total``,
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 
@@ -143,7 +144,11 @@ def snapshot_registry(registry) -> dict:
     sessions = [doc for sess in registry.sessions.values()
                 if (doc := snapshot_session(registry, sess.path))
                 is not None]
-    return {"version": CKPT_VERSION, "saved_wall": round(time.time(), 3),
+    # truncate, never round: round() can stamp up to 0.5 ms in the
+    # FUTURE, and a load() inside that window computes a negative age
+    # and rejects the checkpoint it just wrote
+    return {"version": CKPT_VERSION,
+            "saved_wall": math.floor(time.time() * 1000) / 1000.0,
             "sessions": sessions}
 
 
@@ -303,7 +308,10 @@ class CheckpointManager:
             obs.RESILIENCE_CKPT_ERRORS.inc()
             return None
         age = time.time() - float(doc.get("saved_wall", 0))
-        if not 0 <= age <= self.max_age_sec:
+        # -1 s tolerance: a small NTP step between write and load must
+        # not make a just-written checkpoint look future-dated; a file
+        # from a genuinely wrong clock is still rejected
+        if not -1.0 <= age <= self.max_age_sec:
             return None
         return doc
 

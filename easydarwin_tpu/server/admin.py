@@ -220,6 +220,28 @@ def blame_snapshot(app) -> dict:
                     baseline_p50_ms=p50)
     doc["node"] = snap.get("node", "")
     doc["ledger"] = snap
+    # audience suspect source (ISSUE 18): viewer impact joins the
+    # cause — stall storms / collapsed QoE p10 become suspect lines
+    # alongside the ledger's, and the rollup rides the doc so
+    # tools/blame_report.py can re-derive them from a capture
+    from ..obs import AUDIENCE
+    from ..obs import audience as audience_mod
+    roll = AUDIENCE.rollup()
+    doc["audience"] = roll
+    doc["suspects"] = list(doc.get("suspects") or []) \
+        + audience_mod.suspect_flags(roll)
+    return doc
+
+
+def audience_snapshot(app, worst_n: int = 5) -> dict:
+    """``GET /api/v1/audience`` / ``command=audience`` — the columnar
+    per-subscriber QoE store's drill-down doc (ISSUE 18): per-stream
+    rollup (QoE p50/p10, drops/late/RTX/FEC totals, stall figures,
+    storm latches) + the worst-N subscribers of each stream.  The node
+    id rides along so multi-node captures stay attributable."""
+    from ..obs import AUDIENCE, events
+    doc = AUDIENCE.snapshot(worst_n=worst_n)
+    doc["node"] = events.NODE.get("id") or ""
     return doc
 
 

@@ -1280,6 +1280,15 @@ class StreamingServer:
                 except Exception as e:
                     if self.error_log:
                         self.error_log.warning(f"freshness: {e!r}")
+                try:
+                    # audience observatory (ISSUE 18): derive stalls /
+                    # QoE / storm latches from the columnar store —
+                    # array passes per stream block, never per packet
+                    from ..obs import AUDIENCE
+                    AUDIENCE.tick()
+                except Exception as e:
+                    if self.error_log:
+                        self.error_log.warning(f"audience tick: {e!r}")
                 if self.ladder is not None:
                     try:
                         self._ladder_maintenance()

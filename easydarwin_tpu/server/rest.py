@@ -344,6 +344,22 @@ class RestApi:
         return (200, json.dumps(admin.ledger_snapshot(self.app),
                                 default=str), "application/json")
 
+    def _cmd_audience(self, params: dict,
+                      body: bytes) -> tuple[int, str, str]:
+        """GET /api/v1/audience — the columnar per-subscriber QoE
+        store's drill-down (ISSUE 18): per-stream rollup + worst-N
+        subscribers (``?n=`` overrides the default 5).  Raw JSON for
+        jq pipelines; the composed soak's viewer-experience gate and
+        ``tools/blame_report.py`` read exactly this document."""
+        from . import admin
+        try:
+            n = int(params.get("n", ["5"])[0])
+        except ValueError:
+            n = 5
+        return (200, json.dumps(
+            admin.audience_snapshot(self.app, worst_n=max(0, min(n, 100))),
+            default=str), "application/json")
+
     def _cmd_fleet(self, params: dict,
                    body: bytes) -> tuple[int, str, str]:
         """GET /api/v1/fleet — the aggregated cluster topology (ISSUE
@@ -687,6 +703,11 @@ class RestApi:
             # with cross-node suspect flags — raw JSON for jq
             return (200, json.dumps(admin.blame_snapshot(self.app),
                                     default=str), "application/json")
+        if command == "audience":
+            # the audience observatory's per-subscriber QoE drill-down
+            # (ISSUE 18) — raw JSON for the same pipe-to-jq reason;
+            # honors the same ?n= worst-N clamp as /api/v1/audience
+            return self._cmd_audience(params, b"")
         if command == "set":
             status, payload = admin.set_pref(
                 self.app, path, params.get("value", [""])[0])

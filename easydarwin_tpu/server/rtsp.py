@@ -1012,6 +1012,13 @@ class RtspConnection:
             except (OSError, ValueError):
                 pass
             return
+        # the drain may have disarmed a failing io_uring ring (native
+        # fallback to recvmmsg): its now-closed ring fd must stop being
+        # watched before another socket recycles the number
+        pt = self.pusher_tracks.get(track_id)
+        pair = pt.udp_pair if pt is not None else None
+        if pair is not None and getattr(pair, "_uring_armed", False):
+            pair.prune_ring_watch()
         if n:
             self.last_activity = time.monotonic()
             self.server.stats["packets_in"] += n

@@ -111,6 +111,14 @@ class StatusMonitor:
         snap["ledger_top_wait_class"] = led.last_top_class
         snap["ledger_last_wake_ms"] = round(led.last_wake_ms, 3)
         snap["ledger_wakes"] = led.wakes
+        # audience summary (ISSUE 18): "how are the viewers doing"
+        # answered from the console surface without a scrape
+        aud = obs.AUDIENCE.rollup()
+        snap["audience_subscribers"] = aud["subscribers"]
+        snap["audience_qoe_p50"] = aud["qoe_p50"]
+        snap["audience_qoe_p10"] = aud["qoe_p10"]
+        snap["audience_stalled_now"] = aud["stalled_now"]
+        snap["audience_stall_storms"] = aud["stall_storms"]
         return snap
 
     # -- console (the -S display) -----------------------------------------

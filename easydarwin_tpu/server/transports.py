@@ -211,6 +211,28 @@ class NativeIngestPair(UdpPair):
         for wfd in self._watch_fds:
             loop.add_reader(wfd, on_readable, self._fd)
 
+    def prune_ring_watch(self) -> None:
+        """Drop the ring-fd watch after a native-level fallback disarm.
+
+        ``native.udp_ingest`` closes a failing ring mid-drain (io_uring
+        degradation → recvmmsg); the freed fd NUMBER must leave the
+        event loop immediately — epoll auto-drops a closed fd but
+        asyncio's Python-side key map does not, so the next socket that
+        recycles the number inherits a stale registration and dies in
+        ``selector.modify`` (FileNotFoundError)."""
+        if not self._uring_armed:
+            return
+        from .. import native
+        if native.uring_ingest_armed(self._fd):
+            return
+        for wfd in self._watch_fds[1:]:
+            try:
+                self._loop.remove_reader(wfd)
+            except Exception:
+                pass
+        self._watch_fds = [self._fd]
+        self._uring_armed = False
+
     def close(self) -> None:
         if self.rtp_sock is not None:
             for wfd in self._watch_fds:

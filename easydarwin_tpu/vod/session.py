@@ -368,6 +368,7 @@ class _PacedTrack:
                                 codec="H264" if self.is_video else None)
         self.stream = VodStream(info, settings, ring)
         self.stream.session_path = sess.path
+        self.stream.audience_tier = "vod"
         # thinning split: the engine sees passthrough, the pacer thins
         # at fill with the cold path's per-sample semantics; both views
         # share the output's quality controller (RR/NADU feedback)

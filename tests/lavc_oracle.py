@@ -17,6 +17,20 @@ import numpy as np
 _AV_CODEC_ID_H264 = 27
 
 
+def lavc_available() -> bool:
+    """True when the system libavcodec/libavutil the oracle binds are
+    actually loadable.  Importing this module never dlopens (the CDLL
+    happens in ``LavcH264Decoder.__init__``), so skip marks must pin to
+    THIS probe — an import-success check passes on hosts without the
+    libraries and the test then dies at runtime instead of skipping."""
+    try:
+        ctypes.CDLL("libavcodec.so.59")
+        ctypes.CDLL("libavutil.so.57")
+        return True
+    except OSError:
+        return False
+
+
 class LavcH264Decoder:
     def __init__(self):
         self.avc = ctypes.CDLL("libavcodec.so.59")

@@ -244,14 +244,21 @@ async def test_rest_fleet_events_gzip_trace(tmp_path):
         for i in range(512):
             obs.RELAY_INGEST_TO_WIRE.observe((i % 37) * 1e-4,
                                              engine="scalar")
-        st, plain, hdrs = await asyncio.to_thread(
-            _http, port, "/metrics")
-        assert st == 200 and hdrs.get("Content-Encoding") is None
-        st, packed, hdrs = await asyncio.to_thread(
-            _http, port, "/metrics", {"Accept-Encoding": "gzip"})
-        assert st == 200 and hdrs.get("Content-Encoding") == "gzip"
-        assert hdrs.get("Vary") == "Accept-Encoding"
-        unpacked = gzip.decompress(packed)
+        # the pump keeps mutating pump_*/relay_* families between two
+        # scrapes of a LIVE server, so a plain/gzip pair taken 10 ms
+        # apart can legitimately differ — retry until a stable pair
+        # proves the encoding itself changes nothing
+        for _ in range(5):
+            st, plain, hdrs = await asyncio.to_thread(
+                _http, port, "/metrics")
+            assert st == 200 and hdrs.get("Content-Encoding") is None
+            st, packed, hdrs = await asyncio.to_thread(
+                _http, port, "/metrics", {"Accept-Encoding": "gzip"})
+            assert st == 200 and hdrs.get("Content-Encoding") == "gzip"
+            assert hdrs.get("Vary") == "Accept-Encoding"
+            unpacked = gzip.decompress(packed)
+            if unpacked == plain:
+                break
         assert unpacked == plain            # content identical
         assert len(plain) > 4096            # genuinely loaded exposition
         assert len(packed) < len(plain) * 0.5, \

@@ -27,6 +27,12 @@ from easydarwin_tpu.codecs.h264_requant import SliceRequantizer
 pytestmark = pytest.mark.skipif(not le.available(),
                                 reason="x264 encode shim unavailable")
 
+try:
+    from lavc_oracle import lavc_available
+    _HAVE_LAVC = lavc_available()       # real dlopen probe, not import
+except ImportError:
+    _HAVE_LAVC = False
+
 W = H = 192
 
 
@@ -85,6 +91,7 @@ def test_p_slice_roundtrip_static_scene_mostly_skip():
     assert _roundtrip_all(nals) == 6
 
 
+@pytest.mark.skipif(not _HAVE_LAVC, reason="system libavcodec unavailable")
 def test_ippp_requant_decodes_clean_and_sheds_bitrate():
     """The flagship gap (VERDICT r4 #1): a real IPPP stream must flow
     through the rung with P slices REQUANTED (zero pass-through), decode
@@ -168,6 +175,7 @@ def test_cabac_p_slice_roundtrip_multislice_multiref():
     assert _cabac_roundtrip(nals) == 16
 
 
+@pytest.mark.skipif(not _HAVE_LAVC, reason="system libavcodec unavailable")
 def test_cabac_ippp_requant_decodes_clean():
     """CABAC IPPP through the rung: zero pass-through, bit-clean decode
     via the explode oracle, real bitrate drop on P frames."""

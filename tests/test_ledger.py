@@ -419,22 +419,49 @@ async def test_rest_ledger_and_blame_surfaces():
 async def test_status_monitor_surfaces_ledger_summary(monkeypatch):
     from easydarwin_tpu.server import ServerConfig, StreamingServer
     from easydarwin_tpu.server.status import StatusMonitor
-    led, _, _, _ = _private_ledger()
-    monkeypatch.setattr(obs, "LEDGER", led)
-    led.begin_wake()
-    u = led.unit_start()
-    time.sleep(0.002)
-    led.unit_end(u, "hls_requant")
-    led.end_wake()
     cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
                        access_log_enabled=False)
     app = StreamingServer(cfg)
     await app.start()
     try:
+        # patch + book + sample with NO await in between: the live
+        # server's pump books wakes into whatever obs.LEDGER points at
+        # (see test_pump_books_into_the_global_ledger below), so doing
+        # this before/across app.start() let a pump wake race the
+        # wakes==1 assertion — the suite-flaky failure PR 16 noted
+        led, _, _, _ = _private_ledger()
+        monkeypatch.setattr(obs, "LEDGER", led)
+        led.begin_wake()
+        u = led.unit_start()
+        time.sleep(0.002)
+        led.unit_end(u, "hls_requant")
+        led.end_wake()
         d = StatusMonitor(app).sample()
         assert d["ledger_top_wait_class"] == "hls_requant"
         assert d["ledger_wakes"] == 1
         assert d["ledger_last_wake_ms"] >= 0.0
+    finally:
+        await app.stop()
+
+
+async def test_pump_books_into_the_global_ledger(monkeypatch):
+    """Regression pin for the shared-global hazard: a LIVE server's
+    pump books wakes into ``obs.LEDGER`` — whatever it points at.  A
+    test that patches the global and then awaits (server startup, a
+    client roundtrip) shares its 'private' ledger with the pump and
+    must not assert exact wake counts across that boundary."""
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    led, _, _, _ = _private_ledger()
+    monkeypatch.setattr(obs, "LEDGER", led)
+    cfg = ServerConfig(rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+                       reflect_interval_ms=5, access_log_enabled=False)
+    app = StreamingServer(cfg)
+    await app.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and led.wakes == 0:
+            await asyncio.sleep(0.02)
+        assert led.wakes > 0, "pump never booked into the patched global"
     finally:
         await app.stop()
 

@@ -539,6 +539,21 @@ def test_checkpoint_manager_staleness_and_version(tmp_path):
     assert mgr.load() is None
 
 
+def test_checkpoint_write_never_stamps_the_future(tmp_path, monkeypatch):
+    """Regression: ``round(time.time(), 3)`` could stamp ``saved_wall``
+    up to 0.5 ms in the FUTURE, so a load() inside that window computed
+    a negative age and rejected the checkpoint it just wrote (the
+    suite-flaky failure mode of the staleness test above)."""
+    reg, _ = _mk_registry(1, 1)
+    mgr = ckpt_mod.CheckpointManager(str(tmp_path), max_age_sec=60.0)
+    frozen = 1_700_000_000.0004999    # round() would stamp .001 — future
+    monkeypatch.setattr(ckpt_mod.time, "time", lambda: frozen)
+    assert mgr.write(reg)
+    doc = json.load(open(mgr.path))
+    assert doc["saved_wall"] <= frozen
+    assert mgr.load() is not None     # load at the same instant succeeds
+
+
 def test_checkpoint_maybe_write_throttles(tmp_path):
     clk = _Clock()
     reg, _ = _mk_registry(1, 1)

@@ -429,6 +429,42 @@ def check_trajectory(traj: list[dict],
                                 f"conservation {cons!r} below the 0.9 "
                                 "floor (the decomposition must account "
                                 "for >= 90% of the measured mixed p99)")
+            # ISSUE 18 audience observatory — OPTIONAL (rounds
+            # predating the audience round stay valid), but when
+            # present: QoE quantiles are bounded scores in [0, 1] with
+            # p10 <= p50 (a quantile inversion means the aggregation
+            # lied), the stall ratio is finite non-negative, and the
+            # per-subscriber column footprint is positive finite (zero
+            # would mean the store measured nobody)
+            aud = cp.get("audience")
+            if isinstance(aud, dict) and aud and "error" not in aud:
+                q50, q10 = aud.get("qoe_p50"), aud.get("qoe_p10")
+                for kf, v2 in (("qoe_p50", q50), ("qoe_p10", q10)):
+                    if not isinstance(v2, (int, float)) \
+                            or not math.isfinite(v2) \
+                            or not 0.0 <= v2 <= 1.0:
+                        errs.append(f"{name}: composed.audience.{kf} "
+                                    f"{v2!r} not a QoE score in [0, 1]")
+                if isinstance(q50, (int, float)) \
+                        and isinstance(q10, (int, float)) \
+                        and math.isfinite(q50) and math.isfinite(q10) \
+                        and q10 > q50:
+                    errs.append(f"{name}: composed.audience qoe_p10 "
+                                f"{q10!r} above qoe_p50 {q50!r} "
+                                "(quantile inversion)")
+                sr = aud.get("stall_ratio")
+                if not isinstance(sr, (int, float)) \
+                        or not math.isfinite(sr) or sr < 0:
+                    errs.append(f"{name}: composed.audience.stall_ratio "
+                                f"{sr!r} not finite non-negative")
+                cb = aud.get("columns_bytes_per_subscriber")
+                if aud.get("subscribers") and (
+                        not isinstance(cb, (int, float))
+                        or not math.isfinite(cb) or cb <= 0):
+                    errs.append(f"{name}: composed.audience."
+                                f"columns_bytes_per_subscriber {cb!r} "
+                                "not positive finite with subscribers "
+                                "present")
         # ISSUE 13 rebalance section — OPTIONAL (rounds predating the
         # load-aware control plane stay valid), but when present: a
         # planned rebalance drain must be GAPLESS at the player socket,

@@ -146,6 +146,38 @@ def _suspects(doc: dict) -> list[str]:
             > float(relay.get("service_p99_ms", 0) or 0) > 0:
         out.append("cluster_tick service p99 exceeds live_relay's — "
                    "auxiliary ticks starving the data path")
+    out.extend(_audience_suspects(doc.get("audience")
+                                  or doc.get("audience_rollup")))
+    return out
+
+
+def _audience_suspects(aud) -> list[str]:
+    """Audience suspect source: viewer impact joins the cause.  Mirrors
+    ``easydarwin_tpu.obs.audience.suspect_flags`` (the server attaches
+    those when live) so an offline capture that carried only the
+    audience rollup still names stall storms / collapsed QoE — the tool
+    stays import-free, hence the inline copy of the thresholds."""
+    out: list[str] = []
+    if not isinstance(aud, dict):
+        return out
+    storms = aud.get("stall_storms") or 0
+    if storms:
+        out.append(
+            f"audience: {storms} stall storm(s) latched — k-of-n "
+            "subscribers of one stream froze together; see "
+            "audience.stall_storm events for the blamed work class")
+    p10 = aud.get("qoe_p10")
+    if isinstance(p10, (int, float)) and p10 < 0.5:
+        out.append(
+            f"audience: QoE p10 {p10:.2f} below the 0.5 floor — the "
+            "worst decile of viewers is degraded (drops, staleness or "
+            "stalls); correlate with the ledger's top offender")
+    stalled = aud.get("stalled_now") or 0
+    subs = aud.get("subscribers") or 0
+    if subs and stalled and stalled * 2 >= subs:
+        out.append(
+            f"audience: {stalled}/{subs} subscribers stalled right "
+            "now — delivery is frozen for at least half the audience")
     return out
 
 
@@ -186,6 +218,13 @@ def _render(doc: dict, *, node: str = "") -> None:
         or (doc.get("ledger") or {}).get("worst_trace_id")
     if worst:
         print(f"worst-wait trace: {worst}")
+    aud = doc.get("audience")
+    if isinstance(aud, dict) and aud.get("subscribers") is not None:
+        print(f"audience: {int(aud.get('subscribers') or 0)} subscribers"
+              f"  qoe p50 {float(aud.get('qoe_p50') or 0.0):.2f}"
+              f"  p10 {float(aud.get('qoe_p10') or 0.0):.2f}"
+              f"  stalled {int(aud.get('stalled_now') or 0)}"
+              f"  storms {int(aud.get('stall_storms') or 0)}")
     for s in _suspects(doc):
         print(f"suspect: {s}")
     print()

@@ -47,9 +47,11 @@ NAME_RE = re.compile(r"[a-z][a-z0-9_]*$")
 #: control plane's capacity figure (cluster_capacity_score — a
 #: benchmark-derived rating in pps, quantized, not a raw measurement);
 #: ``_live`` is the fleet federation's liveness-qualified node count
-#: (fleet_nodes_live — a count qualified by state, like _count)
+#: (fleet_nodes_live — a count qualified by state, like _count);
+#: ``_subscribers`` is the audience observatory's population gauge
+#: (audience_subscribers{tier,band} — a census count, like _live)
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_total", "_count",
-                 "_level", "_info", "_score", "_live")
+                 "_level", "_info", "_score", "_live", "_subscribers")
 
 EVENT_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
 #: emit("event.name", ...) — the positional literal, plain or f-string
@@ -778,6 +780,92 @@ def lint_ledger(registry) -> list[str]:
     return errs
 
 
+def lint_audience(registry, schema: dict | None = None) -> list[str]:
+    """The audience observatory's contract (ISSUE 18): the four
+    ``audience_*`` families exist with exactly the declared labels,
+    every observed ``tier`` stays inside the CLOSED vocabulary (which
+    must itself stay in sync with ``obs.fleet.FLEET_TIERS`` — one axis
+    for fleet and audience dashboards), every observed ``band`` stays
+    inside the closed good/fair/poor set, the QoE histogram's bucket
+    ladder is bounded [0, 1] (the score formula clips there — a bucket
+    past 1 would hide a formula regression), no audience family uses a
+    reserved label, and the stall-storm event is declared."""
+    errs: list[str] = []
+    from easydarwin_tpu.obs.audience import (
+        AUDIENCE_TIERS, BANDS, QOE_BUCKETS)
+    try:
+        from easydarwin_tpu.obs.fleet import FLEET_TIERS
+        if tuple(FLEET_TIERS) != tuple(AUDIENCE_TIERS):
+            errs.append(f"obs.audience.AUDIENCE_TIERS "
+                        f"{tuple(AUDIENCE_TIERS)} out of sync with "
+                        f"obs.fleet.FLEET_TIERS {tuple(FLEET_TIERS)}")
+    except ImportError:
+        errs.append("obs.fleet module missing")
+    for v in AUDIENCE_TIERS + BANDS:
+        if not NAME_RE.match(v):
+            errs.append(f"audience vocabulary entry {v!r} not "
+                        "snake_case")
+    want_labels = {
+        "audience_qoe_score": ("tier",),
+        "audience_stall_seconds_total": ("tier",),
+        "audience_subscribers": ("tier", "band"),
+        "audience_stall_storms_total": (),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"audience family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+        for ln in fam.label_names:
+            if ln == "le":
+                errs.append(f"{fam_name}: reserved label 'le'")
+    qoe = fams.get("audience_qoe_score")
+    if qoe is not None:
+        bounds = getattr(qoe, "bounds", ())
+        if tuple(bounds) != tuple(sorted(float(b) for b in QOE_BUCKETS)):
+            errs.append("audience_qoe_score: bucket bounds out of sync "
+                        "with obs.audience.QOE_BUCKETS")
+        if bounds and (bounds[0] <= 0.0 or bounds[-1] != 1.0):
+            errs.append(f"audience_qoe_score: bounds must span (0, 1] "
+                        f"with a closing 1.0 bucket, got "
+                        f"[{bounds[0]}, {bounds[-1]}] — the QoE score "
+                        "is clipped to [0, 1] by construction")
+        for key in getattr(qoe, "_states", {}):
+            (tier,) = key
+            if tier not in AUDIENCE_TIERS:
+                errs.append(f"audience_qoe_score: observed tier "
+                            f"{tier!r} outside the closed set "
+                            f"{tuple(AUDIENCE_TIERS)}")
+    fam = fams.get("audience_stall_seconds_total")
+    if fam is not None:
+        for (tier,) in getattr(fam, "_values", {}):
+            if tier not in AUDIENCE_TIERS:
+                errs.append(f"audience_stall_seconds_total: observed "
+                            f"tier {tier!r} outside the closed set "
+                            f"{tuple(AUDIENCE_TIERS)}")
+    fam = fams.get("audience_subscribers")
+    if fam is not None:
+        for tier, band in getattr(fam, "_values", {}):
+            if tier not in AUDIENCE_TIERS:
+                errs.append(f"audience_subscribers: observed tier "
+                            f"{tier!r} outside the closed set "
+                            f"{tuple(AUDIENCE_TIERS)}")
+            if band not in BANDS:
+                errs.append(f"audience_subscribers: observed band "
+                            f"{band!r} outside the closed set "
+                            f"{tuple(BANDS)}")
+    if schema is not None and "audience.stall_storm" not in schema:
+        errs.append("event audience.stall_storm missing from SCHEMA")
+    return errs
+
+
 def lint_events(schema: dict, reserved=None) -> list[str]:
     """Validate the structured-event vocabulary table itself."""
     if reserved is None:
@@ -898,6 +986,11 @@ def main() -> int:
     # closed work_class set + the multi-second bucket ladder whose top
     # exceeds the SLO watchdog's worst window
     errs += lint_ledger(obs.REGISTRY)
+    # the audience observatory's vocabulary (ISSUE 18): audience_*
+    # families with closed tier/band sets (tier synced with the fleet
+    # vocabulary), the [0, 1] QoE bucket ladder and the stall-storm
+    # event declaration
+    errs += lint_audience(obs.REGISTRY, ev.SCHEMA)
     for e in errs:
         print(f"metrics_lint: {e}", file=sys.stderr)
     if not errs:

@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import re
 import socket
 import struct
 import sys
@@ -1866,6 +1867,74 @@ async def cluster_soak(n_nodes: int, seconds: float,
 #: genuinely wedged pump (a wait past ~20× the mixed p99's own order).
 LEDGER_WAIT_SLO_SCALE = 600.0
 
+#: viewer-experience gate floor (ISSUE 18): a live-tier QoE p10 below
+#: this without a matching admission/shed event fails the composed soak
+AUDIENCE_QOE_FLOOR = 0.5
+
+
+def qoe_tiers(metrics_docs) -> dict[str, dict]:
+    """Per-tier QoE distributions merged across nodes from the
+    ``audience_qoe_score_bucket`` series of parsed ``/metrics`` exports
+    (cumulative Prometheus buckets; the quantile is the smallest bound
+    whose cumulative count reaches q·total — the same upper-bound
+    estimate the registry's own ``bucket_quantile`` makes)."""
+    pat = re.compile(
+        r'audience_qoe_score_bucket\{tier="([^"]+)",le="([^"]+)"\}')
+    acc: dict[str, dict[float, float]] = {}
+    for m in metrics_docs:
+        for k, v in m.items():
+            mt = pat.fullmatch(k)
+            if not mt:
+                continue
+            le = mt.group(2)
+            bound = float("inf") if le == "+Inf" else float(le)
+            d = acc.setdefault(mt.group(1), {})
+            d[bound] = d.get(bound, 0.0) + v
+    out: dict[str, dict] = {}
+    for tier, cum in acc.items():
+        bounds = sorted(cum)
+        total = cum.get(float("inf"), 0.0)
+        if total <= 0:
+            continue
+
+        def q_at(q: float) -> float:
+            want = q * total
+            for b in bounds:
+                if cum[b] >= want:
+                    return 1.0 if b == float("inf") else b
+            return 1.0
+
+        out[tier] = {"count": int(total), "p50": round(q_at(0.50), 4),
+                     "p10": round(q_at(0.10), 4)}
+    return out
+
+
+def audience_verdicts(aud: dict, *, shed_evidence: bool,
+                      storm_blamed: str = "",
+                      qoe_floor: float = AUDIENCE_QOE_FLOOR) -> list[str]:
+    """The viewer-experience gate (ISSUE 18): a collapsed live-tier QoE
+    p10 is acceptable ONLY when the cluster itself said "shed" —
+    admission refusals and ladder/resilience sheds name a deliberate
+    trade recorded in counters and events; a bare collapse means the
+    viewers silently suffered with no decision on record.  Pure (takes
+    the composed audience doc + pre-derived evidence) so tests drive it
+    with synthetic rollups."""
+    out: list[str] = []
+    if not isinstance(aud, dict):
+        return out
+    live = (aud.get("tiers") or {}).get("live") or {}
+    p10 = live.get("p10", aud.get("qoe_p10"))
+    watched = live.get("count") or aud.get("subscribers") or 0
+    if watched and isinstance(p10, (int, float)) and p10 < qoe_floor \
+            and not shed_evidence:
+        msg = (f"viewer experience: live-tier QoE p10 {p10:.2f} below "
+               f"the {qoe_floor:.2f} floor with no admission/shed "
+               "event naming a deliberate trade")
+        if storm_blamed:
+            msg += f" (stall storm blamed work class: {storm_blamed})"
+        out.append(msg)
+    return out
+
 
 async def composed_soak(n_nodes: int, seconds: float,
                         seed: int = 7) -> int:
@@ -2367,6 +2436,17 @@ async def composed_soak(n_nodes: int, seconds: float,
                                                         "replace"))
                 except ValueError:
                     pass
+        # per-node audience drill-down docs (ISSUE 18): the columnar
+        # QoE store's rollup + worst subscribers, composed below
+        audiences: dict[str, dict] = {}
+        for n in survivors:
+            _st, body = await aget(n, "/api/v1/audience?n=3")
+            if _st == 200:
+                try:
+                    audiences[n] = _json.loads(body.decode("utf-8",
+                                                           "replace"))
+                except ValueError:
+                    pass
         if not killed[0]:
             failures.append("owner kill never fired (duration too short)")
         gap = _seq_gap(rx_seqs)
@@ -2549,6 +2629,61 @@ async def composed_soak(n_nodes: int, seconds: float,
                     "worst_wait_p99_ms": d.get("worst_wait_p99_ms")}
                 for n, d in blames.items()}
             composed["latency_blame"] = lb
+        # audience observatory (ISSUE 18): per-tier QoE distributions
+        # merged across nodes from the histogram export, the headline
+        # p50/p10 as the WORST populated node's figure (conservative —
+        # the gate cares about the suffering node, not the average),
+        # and the stall ratio normalised to subscriber-seconds
+        aud_subs = sum(int(d.get("subscribers") or 0)
+                       for d in audiences.values())
+        stall_s = sum(v for m in metrics.values() for k, v in m.items()
+                      if k.startswith("audience_stall_seconds_total"))
+        aud_doc = {
+            "subscribers": aud_subs,
+            "qoe_p50": round(min(
+                (float(d.get("qoe_p50") or 0.0)
+                 for d in audiences.values() if d.get("subscribers")),
+                default=1.0), 4),
+            "qoe_p10": round(min(
+                (float(d.get("qoe_p10") or 0.0)
+                 for d in audiences.values() if d.get("subscribers")),
+                default=1.0), 4),
+            "tiers": qoe_tiers(metrics.values()),
+            "stall_ratio": (round(stall_s / (aud_subs * dur), 6)
+                            if aud_subs else 0.0),
+            "stall_storms": sum(int(d.get("stall_storms") or 0)
+                                for d in audiences.values()),
+            "columns_bytes_per_subscriber": round(max(
+                (float(d.get("columns_bytes_per_subscriber") or 0.0)
+                 for d in audiences.values()), default=0.0), 1),
+        }
+        composed["audience"] = aud_doc
+        # the viewer-experience gate: shed evidence = any node's
+        # admission or shed counters moved (the deliberate-trade record)
+        shed_evidence = any(
+            v > 0 for m in metrics.values() for k, v in m.items()
+            if k.startswith("cluster_admission_refused_total")
+            or k.startswith("resilience_shed_outputs_total")
+            or k.startswith("requant_shed_total"))
+        storm_blamed = ""
+        if aud_doc["stall_storms"]:
+            for n in survivors:
+                _st, body = await aget(
+                    n, "/api/v1/admin?command=events&n=512")
+                if _st != 200:
+                    continue
+                for ln in body.decode("utf-8", "replace").splitlines():
+                    if '"audience.stall_storm"' not in ln:
+                        continue
+                    try:
+                        ev = _json.loads(ln)
+                    except ValueError:
+                        continue
+                    storm_blamed = str(ev.get("blamed")
+                                       or storm_blamed)
+        failures.extend(audience_verdicts(
+            aud_doc, shed_evidence=shed_evidence,
+            storm_blamed=storm_blamed))
         stats.update({
             "counters": counters,
             "hls_renditions": len(hls_state["renditions"]),
@@ -2574,7 +2709,8 @@ async def composed_soak(n_nodes: int, seconds: float,
                 if _st != 200:
                     continue
                 for ln in body.decode("utf-8", "replace").splitlines():
-                    if '"cluster.' in ln or '"pull.' in ln:
+                    if '"cluster.' in ln or '"pull.' in ln \
+                            or '"audience.' in ln:
                         print(f"EV {nid} {ln}", file=sys.stderr)
         print("SOAK COMPOSED", "FAIL" if failures else "OK",
               _json.dumps(stats, default=str))
