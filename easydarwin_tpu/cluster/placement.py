@@ -30,6 +30,11 @@ from .. import obs
 from .presence import ClusterRegistry
 
 OWN_KEY_PREFIX = "Own:"
+#: fenced erasure-shard claims (ISSUE 20): ``Shard:{asset}/t{t}/s{s}.{i}``
+#: records ``{"node": holder}`` — the ring decides who SHOULD hold a
+#: shard, the fence decides whose shard writes COUNT, exactly as with
+#: stream ownership above
+SHARD_KEY_PREFIX = "Shard:"
 #: virtual points per node: enough that a 2..16-node ring splits paths
 #: evenly, few enough that building the ring stays trivial
 DEFAULT_VNODES = 64
@@ -49,6 +54,12 @@ def _h(s: str) -> int:
 
 def own_key(path: str) -> str:
     return f"{OWN_KEY_PREFIX}{path.strip('/')}"
+
+
+def shard_key(asset: str, name: str) -> str:
+    """Fenced claim key of one erasure shard of ``asset`` (``name`` is
+    the ``t{track}/s{stripe}.{idx}`` relative shard name)."""
+    return f"{SHARD_KEY_PREFIX}{asset.strip('/')}/{name}"
 
 
 class HashRing:
@@ -266,6 +277,15 @@ class PlacementService:
         return ("EVAL", FENCE_SET_LUA, 1, own_key(path), int(token),
                 json.dumps(rec, separators=(",", ":")),
                 int(ttl))
+
+    def fenced_set_command(self, key: str, token: int, record: dict, *,
+                           ttl: int = 0):
+        """A pipeline-able fenced EVAL fset over an ARBITRARY key (the
+        ``Shard:`` claim writes ride this through the cluster tick) —
+        same Lua, same token discipline as :meth:`claim_command`."""
+        from .redis_client import FENCE_SET_LUA
+        return ("EVAL", FENCE_SET_LUA, 1, key, int(token),
+                json.dumps(record, separators=(",", ":")), int(ttl))
 
     def claim_result(self, path: str, ok) -> bool:
         """Book one claim attempt's outcome (move note / rejection

@@ -281,6 +281,13 @@ class ClusterService:
         #: what the LAST ownership scan recorded as each path's claim
         #: holder — the trace stitcher's synchronous upstream map
         self.owners: dict[str, str] = {}
+        #: storage hooks (ISSUE 20): ``storage_claims() -> [(key, rec)]``
+        #: drains the erasure tier's pending fenced ``Shard:`` claims
+        #: (this tick mints the tokens and writes them — storage never
+        #: touches Redis itself); ``storage_repair(live_nodes, records)``
+        #: hands the parsed shard records over for dead-holder repair
+        self.storage_claims = None
+        self.storage_repair = None
         #: in-flight planned hand-offs: path -> (target, deadline) —
         #: the source keeps serving until the target's adoption clears
         #: the record's handoff marker (see _check_draining)
@@ -424,6 +431,7 @@ class ClusterService:
         if self.rebalancer is not None:
             await self.rebalancer.tick(nodes, load)
         await self._sweep_pulls()
+        await self._storage_tick(nodes)
         await self._publish_fleet(nodes)
         # reference-shaped presence for the CMS tier.  Only locally-
         # SOURCED paths are advertised: a pull replica writing (and on
@@ -630,6 +638,47 @@ class ClusterService:
                 self._claims[path] = tok
             else:
                 self._fence_lost(path)
+
+    # -- erasure storage (ISSUE 20) ----------------------------------------
+    async def _storage_tick(self, nodes: dict) -> None:
+        """The storage tier's Redis face: write its pending fenced
+        ``Shard:`` claims (one freshly minted token each — the same
+        counter the stream claims use, so a zombie ex-holder's stale
+        shard claim loses identically), then hand the full parsed shard
+        record set plus the live lease set to the repair scanner."""
+        if self.storage_claims is None and self.storage_repair is None:
+            return
+        if self.storage_claims is not None:
+            try:
+                pending = self.storage_claims() or []
+            except Exception as e:
+                self._warn(f"storage claims: {e!r}")
+                pending = []
+            for key, rec in pending:
+                tok = int(await self.redis.incr(FENCE_COUNTER_KEY))
+                ok = await self.redis.execute(
+                    *self.placement.fenced_set_command(key, tok, rec))
+                if not ok:
+                    obs.CLUSTER_LEASE_FENCE_REJECTED.inc()
+                    self._events.emit("cluster.fence_rejected",
+                                      level="warn",
+                                      node=self.config.node_id, key=key)
+        if self.storage_repair is not None:
+            from .placement import SHARD_KEY_PREFIX
+            from .redis_client import scan_fenced
+            records = await scan_fenced(self.redis, SHARD_KEY_PREFIX)
+            parsed: dict[str, dict] = {}
+            for key, (_tok, payload) in records.items():
+                try:
+                    rec = json.loads(payload)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("node"):
+                    parsed[key] = rec
+            try:
+                self.storage_repair(nodes, parsed)
+            except Exception as e:
+                self._warn(f"storage repair scan: {e!r}")
 
     # -- fleet federation (ISSUE 15) ---------------------------------------
     async def _publish_fleet(self, nodes: dict) -> None:

@@ -101,6 +101,12 @@ class DvrManager:
         #: meta/index documents when a .dvr DESCRIBE finds no local
         #: asset at all (closes the PR 12 open item)
         self.meta_sync = None
+        #: erasure-storage hooks (ISSUE 20): ``on_finalize(result)``
+        #: shards the finished asset across the fleet;
+        #: ``restorer(path, track_id, win) -> blob | None | b""`` is the
+        #: spill chain's last resort — reconstruct from k survivors
+        self.on_finalize = None
+        self.restorer = None
         self.finalized_count = 0
 
     # ------------------------------------------------------------ geometry
@@ -214,8 +220,17 @@ class DvrManager:
         EVENTS.emit("dvr.finalize", stream=a.session.path,
                     trace_id=a.session.trace_id, path=a.session.path,
                     windows=windows)
-        return {"path": a.session.path, "dir": a.dir,
-                "windows": windows}
+        result = {"path": a.session.path, "dir": a.dir,
+                  "windows": windows}
+        if self.on_finalize is not None and windows:
+            # durability must never break the finalize itself
+            try:
+                self.on_finalize(result)
+            except Exception as e:
+                if self.error_log:
+                    self.error_log.error(
+                        f"dvr on_finalize({a.session.path}): {e!r}")
+        return result
 
     def close(self) -> None:
         for path in list(self._armed):
@@ -247,9 +262,14 @@ class DvrManager:
             if self.fetcher is not None:
                 fetch = (lambda win, p=key, t=tid:
                          self.fetcher(p, t, win))
+            restore = None
+            if self.restorer is not None:
+                restore = (lambda win, p=key, t=tid:
+                           self.restorer(p, t, win))
             try:
                 tracks[tid] = SpilledTrack(
-                    os.path.join(dir_path, name), fetch=fetch)
+                    os.path.join(dir_path, name), fetch=fetch,
+                    restore=restore)
             except SpillError:
                 continue
         if not tracks:

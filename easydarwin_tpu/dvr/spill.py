@@ -33,6 +33,7 @@ import json
 import os
 import struct
 import time
+import zlib
 
 import numpy as np
 
@@ -59,11 +60,14 @@ class WindowRows:
     continuous id space."""
 
     __slots__ = ("id_lo", "data", "length", "flags", "ts", "seq",
-                 "arrival")
+                 "arrival", "restored")
 
     def __init__(self, id_lo: int, data, length, flags, ts, seq,
                  arrival):
         self.id_lo = id_lo
+        #: True when these rows were erasure-RECONSTRUCTED from fleet
+        #: shards rather than read from a spill file / live peer
+        self.restored = False
         self.data = data                # [n, SLOT_SIZE] uint8
         self.length = length            # int32 [n]
         self.flags = flags              # int32 [n]
@@ -196,6 +200,7 @@ class SpillWriter:
         self._f.write(blob)
         self._f.flush()
         rec = {"win": int(win), "off": off, "nbytes": len(blob),
+               "crc": zlib.crc32(blob) & 0xFFFFFFFF,
                "n": rows.n, "id_lo": int(rows.id_lo),
                "ts_lo": int(rows.ts[0]) if rows.n else 0,
                "ts_hi": int(rows.ts[-1]) if rows.n else 0,
@@ -277,17 +282,27 @@ class SpilledTrack:
     """Read side of one track's spill directory.  ``fetch`` is the
     cluster peer-fill hook: a window absent from the LOCAL index (this
     node never recorded it) may still be served by the recording node's
-    spill file — the fetcher returns the raw blob bytes or None."""
+    spill file — the fetcher returns the raw blob bytes or None.
+    ``restore`` is the erasure-coded storage tier's last-resort hook
+    (ISSUE 20): when the local file AND the live peer both miss, the
+    window blob may still be reconstructable from k surviving fleet
+    shards — same ``bytes | b"" (in flight) | None`` protocol."""
 
-    def __init__(self, dir_path: str, *, fetch=None):
+    def __init__(self, dir_path: str, *, fetch=None, restore=None):
         self.dir = dir_path
         self.bin_path = os.path.join(dir_path, "spill.bin")
         self.index_path = os.path.join(dir_path, "index.json")
         self.fetch = fetch
+        self.restore = restore
         #: latched by read_window: the last miss had a peer fetch IN
         #: FLIGHT (fetcher returned b"") — the caller should hold its
         #: cursor and retry, not hop the window as unavailable
         self.fetch_pending = False
+        #: windows whose on-disk bytes failed the index crc32 (ISSUE 20
+        #: satellite: truncated/compacted-under-us reads surface here
+        #: instead of as decode errors — and the storage scrub leans on
+        #: the same checksum)
+        self.crc_errors = 0
         #: the asset was re-recorded under this reader (generation
         #: changed on reload): local windows are gone, offsets invalid
         self.superseded = False
@@ -341,13 +356,27 @@ class SpilledTrack:
 
     def window_blob(self, win: int) -> bytes | None:
         """Raw blob bytes of one indexed window (the REST peer-fill
-        endpoint serves exactly this)."""
+        endpoint serves exactly this), verified against the index's
+        per-window crc32 — a truncated or compacted-under-us read
+        returns None (a local miss) instead of bytes that decode into
+        garbage or ship corrupt to a peer.  Pre-crc indexes (no ``crc``
+        key) read unverified, so old assets stay servable."""
         rec = self.windows.get(int(win))
         if rec is None:
             return None
-        with open(self.bin_path, "rb") as fh:
-            fh.seek(rec["off"])
-            return fh.read(rec["nbytes"])
+        try:
+            with open(self.bin_path, "rb") as fh:
+                fh.seek(rec["off"])
+                blob = fh.read(rec["nbytes"])
+        except OSError:
+            # spill bytes evicted or lost out from under the index: a
+            # local miss, so read_window falls through to peer/storage
+            return None
+        crc = rec.get("crc")
+        if crc is not None and (zlib.crc32(blob) & 0xFFFFFFFF) != int(crc):
+            self.crc_errors += 1
+            return None
+        return blob
 
     def read_window(self, win: int) -> WindowRows | None:
         """Window ``win``'s rows — local spill file first, then the
@@ -355,7 +384,10 @@ class SpilledTrack:
         asset's writer keeps appending after this reader opened (the
         live time-shift case), so staleness is normal, not an error.
         A fetcher returning ``b""`` means the peer round-trip is still
-        in flight: ``fetch_pending`` latches and the caller retries."""
+        in flight: ``fetch_pending`` latches and the caller retries.
+        When both local file and peer miss, the storage tier's
+        ``restore`` hook gets the last word — an erasure reconstruct
+        from surviving fleet shards, same in-flight protocol."""
         self.fetch_pending = False
         rec = self.windows.get(int(win))
         if rec is None:
@@ -382,6 +414,17 @@ class SpilledTrack:
                     return decode_blob(blob, int(win) * self.k)
                 except (SpillError, struct.error, ValueError):
                     return None          # malformed peer blob = a miss
+            if blob == b"":
+                self.fetch_pending = True
+        if self.restore is not None:
+            blob = self.restore(int(win))
+            if blob:
+                try:
+                    rows = decode_blob(blob, int(win) * self.k)
+                except (SpillError, struct.error, ValueError):
+                    return None      # malformed reconstruct = a miss
+                rows.restored = True
+                return rows
             if blob == b"":
                 self.fetch_pending = True
         return None

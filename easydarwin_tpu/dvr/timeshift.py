@@ -99,7 +99,8 @@ class _ShiftTrack:
             return None
         return CachedWindow.from_packed(
             None, rows.id_lo, rows.data, rows.length, rows.flags,
-            rows.ts, seq=rows.seq, arrival=rows.arrival)
+            rows.ts, seq=rows.seq, arrival=rows.arrival,
+            restored=getattr(rows, "restored", False))
 
     def _rows_for(self, sess: "TimeShiftSession",
                   win: int) -> WindowRows | None:

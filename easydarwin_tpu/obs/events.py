@@ -157,6 +157,20 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "dvr.arm": ("path", "tracks"),
     "dvr.finalize": ("path", "windows"),
     "dvr.catchup": ("track", "join_id"),
+    # erasure-coded storage tier (storage/, ISSUE 20): store is per
+    # finalized asset (one event carrying the shard fan-out); a
+    # reconstruct event fires per stripe SOLVE (a rare degraded read),
+    # never per direct shard read; repair is per repair-tick batch;
+    # scrub_error and solve_singular are per detected corruption /
+    # unsolvable read — loud by design, any occurrence is a bug or a
+    # real loss beyond the parity budget.  The device/oracle parity
+    # divergence latch reuses fec.host_fallback semantics.
+    "storage.store": ("asset", "shards"),
+    "storage.reconstruct": ("asset", "missing"),
+    "storage.repair": ("asset", "shards"),
+    "storage.scrub_error": ("asset", "shard"),
+    "storage.solve_singular": ("asset", "missing"),
+    "storage.host_fallback": ("mismatches",),
     # recording crash safety (vod/record.py): a leftover <file>.tmp
     # found at boot means a recorder died mid-write — the orphan is
     # reported, never silently deleted or served

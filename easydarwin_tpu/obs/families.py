@@ -387,6 +387,42 @@ DVR_RETENTION_EVICTIONS = REGISTRY.counter(
     "Spilled windows dropped by the per-asset byte/duration retention "
     "budget (oldest-first; the time-shift horizon moves forward)")
 
+# ------------------------------------------------- erasure-coded storage
+# The durable CDN-origin tier (ISSUE 20: storage/).  Finalized DVR/VOD
+# assets shard into k data + m parity window shards striped across the
+# fleet; parity is the GF(256) Vandermonde matmul (device, host-oracle
+# checked) and a read missing <= m shards reconstructs via gf_solve.
+# tools/metrics_lint.py enforces this family set (lint_storage: closed
+# set, exact labels) and tools/soak.py --cluster keys on it.
+STORAGE_SHARDS = REGISTRY.counter(
+    "storage_shards_total",
+    "Window shards materialized by the storage tier, by kind (data = "
+    "the raw spill window blob, parity = one GF(256) Vandermonde row "
+    "over the stripe's padded data blobs)", labels=("kind",))
+STORAGE_RECONSTRUCTS = REGISTRY.counter(
+    "storage_reconstructs_total",
+    "Stripe reads that could not serve the data shard directly and ran "
+    "the Gaussian gf_solve reconstruction over k survivors, by result "
+    "(ok = byte-exact blob recovered, failed = > m shards missing or a "
+    "singular coefficient subset — the read fails LOUDLY, never "
+    "silently partial)", labels=("result",))
+STORAGE_REPAIRS = REGISTRY.counter(
+    "storage_repairs_total",
+    "Shards re-materialized onto this node by the background repair "
+    "tick after a holder loss (a re-keyed GF matmul / solve over "
+    "survivors, not a byte copy), by kind", labels=("kind",))
+STORAGE_REPAIR_BYTES = REGISTRY.counter(
+    "storage_repair_bytes_total",
+    "Bytes of shard payload re-materialized by the background repair "
+    "tick (the repair-MB/s numerator bench/soak report)")
+STORAGE_SCRUB_ERRORS = REGISTRY.counter(
+    "storage_scrub_errors_total",
+    "Local shards the background scrub found corrupt (manifest crc32 "
+    "mismatch, or a parity shard that disagrees with the host GF "
+    "oracle recomputed over locally-present data); the shard is "
+    "quarantined and queued for repair — any nonzero value fails "
+    "bench/soak")
+
 # ------------------------------------------------------- reliability tier
 # The lossy-WAN FEC + NACK/RTX tier (ISSUE 11: relay/fec.py).
 # tools/metrics_lint.py enforces this family set (lint_fec: exact
@@ -409,6 +445,13 @@ FEC_PARITY_ORACLE_MISMATCH = REGISTRY.counter(
     "oracle for the same window (the device result is discarded and "
     "the stream latches onto host-computed parity; any nonzero value "
     "is a kernel/host divergence bug and fails bench/soak)")
+FEC_SOLVE_SINGULAR = REGISTRY.counter(
+    "fec_solve_singular_total",
+    "gf_solve calls that hit a singular coefficient matrix and "
+    "returned no solution, by caller (fec_receiver = the lossy-WAN "
+    "recovery path retrying with another parity subset, storage = an "
+    "erasure-coded stripe read that must fail loudly) — previously "
+    "this was an unaccounted silent None", labels=("caller",))
 FEC_OVERHEAD_RATIO = REGISTRY.gauge(
     "fec_overhead_ratio",
     "Current closed-loop FEC overhead (parity/media ratio, 0..0.30) "

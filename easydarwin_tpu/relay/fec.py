@@ -143,17 +143,23 @@ def coeff_rows(deltas, n_parity: int) -> np.ndarray:
     return GF_EXP512[(np.outer(p, d)) % 255].astype(np.uint8)
 
 
-def gf_solve(a: np.ndarray, b: np.ndarray) -> np.ndarray | None:
+def gf_solve(a: np.ndarray, b: np.ndarray, *,
+             caller: str = "unlabeled") -> np.ndarray | None:
     """Solve ``A · x = b`` over GF(256) (A ``[m, m]``, b ``[m, B]``) by
     Gaussian elimination; None when singular (cannot happen for the
-    Vandermonde systems :func:`coeff_rows` produces, kept as a guard
-    against a corrupt parity group)."""
+    consecutive-from-0 Vandermonde systems :func:`coeff_rows` produces,
+    but an arbitrary parity-index subset CAN be).  Singular returns are
+    no longer silent: each one counts ``fec_solve_singular_total`` under
+    ``caller`` so a storage read that cannot solve fails loudly and a
+    receiver waiting for more parity rows is distinguishable from one
+    that never will get them."""
     a = np.array(a, np.uint8)
     b = np.array(b, np.uint8)
     m = a.shape[0]
     for col in range(m):
         piv = next((r for r in range(col, m) if a[r, col]), None)
         if piv is None:
+            obs.FEC_SOLVE_SINGULAR.inc(caller=caller)
             return None
         if piv != col:
             a[[col, piv]] = a[[piv, col]]
@@ -894,7 +900,7 @@ class FecReceiver:
             miss_d = [d for s, d in zip(prot, deltas)
                       if self.have(s) is None]
             a = coeff_for_indices(miss_d, idxs)
-            rows = gf_solve(a, synd)
+            rows = gf_solve(a, synd, caller="fec_receiver")
             if rows is None:
                 continue
             ok = True

@@ -167,6 +167,15 @@ class StreamingServer:
         #: helper-thread HTTP fetch (see _dvr_peer_fetch)
         self._dvr_fetches: dict = {}
         self._dvr_fetch_pool = None
+        #: erasure-coded storage tier (ISSUE 20: storage/): finalized
+        #: DVR assets sharded k+m across the fleet, reads reconstruct
+        #: from any k survivors; built in start() after the DVR tier,
+        #: None = off
+        self.storage = None
+        #: in-flight erasure restores: (path, track, win) -> Future of
+        #: the helper-thread reconstruct (see _storage_restore)
+        self._storage_fetches: dict = {}
+        self._storage_scrub_due = 0.0
         self.started_at = time.time()
         from .status import StatusMonitor
         self.status = StatusMonitor(self)
@@ -332,6 +341,24 @@ class StreamingServer:
                     retention_sec=self.config.dvr_retention_sec,
                     error_log=self.error_log)
                 self.rtsp.dvr = self.dvr
+        if self.config.storage_enabled:
+            if self.dvr is None:
+                if self.error_log:
+                    self.error_log.warning(
+                        "storage_enabled needs dvr_enabled (only "
+                        "finalized DVR assets are sharded); storage is "
+                        "OFF")
+            else:
+                from ..storage import StorageService
+                self.storage = StorageService(
+                    os.path.join(self.config.movie_folder, ".shards"),
+                    self.config.server_id,
+                    k=self.config.storage_data_shards,
+                    m=self.config.storage_parity_shards,
+                    use_device=self.config.storage_device,
+                    error_log=self.error_log)
+                self.dvr.on_finalize = self._storage_on_finalize
+                self.dvr.restorer = self._storage_restore
         # crash-safe recorder orphan sweep (vod/record.py): leftover
         # <file>.mp4.tmp means a recorder died mid-write — report it
         from ..vod.record import sweep_orphans
@@ -374,6 +401,23 @@ class StreamingServer:
                 # a .dvr DESCRIBE on a node that never saw the stream
                 # syncs the recording node's meta/index documents first
                 self.dvr.meta_sync = self._dvr_meta_sync
+            if self.storage is not None:
+                # the erasure tier rides the cluster: shards place on
+                # the capacity-weighted ring, claims write through the
+                # tick as fenced Shard: records, and repair watches the
+                # live lease set for dead holders (ISSUE 20)
+                self.storage.node_id = ccfg.node_id
+                self.storage.peer_nodes = \
+                    lambda: dict(self.cluster.last_nodes) \
+                    if self.cluster is not None else {}
+                self.storage.ring_for = self.cluster.placement.ring
+                self.storage.push_shard = self._storage_push_blocking
+                self.storage.fetch_shard = self._storage_fetch_blocking
+                self.storage.fetch_manifest = \
+                    self._storage_manifest_blocking
+                self.cluster.storage_claims = \
+                    self.storage.pending_claims
+                self.cluster.storage_repair = self.storage.repair_scan
             # load-aware control plane (ISSUE 13): capacity published
             # into the lease each heartbeat, admission gate on new
             # SETUPs.  The self-bench is cached per boot; an operator-
@@ -465,6 +509,13 @@ class StreamingServer:
                 pass
             self.rtsp.dvr = None
             self.dvr = None
+        if self.storage is not None:
+            try:
+                self.storage.close()
+            except Exception:
+                pass
+            self.storage = None
+            self._storage_fetches.clear()
         if self._dvr_fetch_pool is not None:
             self._dvr_fetch_pool.shutdown(wait=False, cancel_futures=True)
             self._dvr_fetch_pool = None
@@ -841,6 +892,113 @@ class StreamingServer:
         from urllib.parse import quote
         raw = self._peer_http_get(
             host, port, f"/api/v1/dvrmeta?path={quote(path)}")
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw.decode("utf-8", "replace"))
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    # -- erasure storage plumbing (ISSUE 20) -------------------------------
+    #: in-flight restore cap — same bound and reasoning as the DVR
+    #: peer-fill cap above
+    _STORAGE_RESTORE_INFLIGHT_MAX = 32
+
+    def _storage_on_finalize(self, result: dict) -> None:
+        """DvrManager finalize hook: shard the finished asset on a
+        storage worker thread (parity matmuls + peer pushes are
+        blocking; finalize runs on the event loop)."""
+        if self.storage is not None and self.dvr is not None:
+            self.storage.store_async(result["path"], self.dvr)
+
+    def _storage_restore(self, path: str, track_id: int,
+                         win: int) -> bytes | None:
+        """The spill chain's last resort, INLINE ON THE PUMP: kick the
+        blocking shard-gather + GF reconstruct onto a storage worker and
+        speak the fetch-pending protocol — ``b""`` while the future
+        runs (the time-shift cursor HOLDS), the reconstructed blob when
+        it lands, ``None`` when the stripe is beyond the parity budget."""
+        st = self.storage
+        if st is None:
+            return None
+        from ..protocol.sdp import _norm
+        key = (_norm(path), int(track_id), int(win))
+        fut = self._storage_fetches.get(key)
+        if fut is None:
+            if len(self._storage_fetches) >= \
+                    self._STORAGE_RESTORE_INFLIGHT_MAX:
+                for k in [k for k, f in self._storage_fetches.items()
+                          if f.done()]:
+                    del self._storage_fetches[k]
+                if len(self._storage_fetches) >= \
+                        self._STORAGE_RESTORE_INFLIGHT_MAX:
+                    return None
+            self._storage_fetches[key] = st.restore_async(
+                path, int(track_id), int(win))
+            return b""
+        if not fut.done():
+            return b""
+        del self._storage_fetches[key]
+        try:
+            return fut.result()
+        except Exception:
+            return None
+
+    def _peer_http_post(self, host: str, port: int, target: str,
+                        body: bytes) -> bool:
+        """One peer REST POST — helper-thread only, same auth rules as
+        :meth:`_peer_http_get`."""
+        import base64
+        import http.client
+        headers = {"Content-Type": "application/octet-stream"}
+        if self.config.auth_enabled:
+            cred = (f"{self.config.rest_username}:"
+                    f"{self.config.rest_password}").encode()
+            headers["Authorization"] = \
+                "Basic " + base64.b64encode(cred).decode()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=2.0)
+            try:
+                conn.request("POST", target, body=body, headers=headers)
+                return conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False
+
+    def _storage_push_blocking(self, node_meta: dict, asset: str,
+                               name: str, payload: bytes,
+                               manifest_json: str) -> bool:
+        from urllib.parse import quote
+        host, port = node_meta.get("ip"), node_meta.get("http")
+        if not host or not port:
+            return False
+        return self._peer_http_post(
+            str(host), int(port),
+            f"/api/v1/shardpush?path={quote(asset)}&name={quote(name)}",
+            manifest_json.encode() + b"\n\n" + payload)
+
+    def _storage_fetch_blocking(self, node_meta: dict, asset: str,
+                                name: str) -> bytes | None:
+        from urllib.parse import quote
+        host, port = node_meta.get("ip"), node_meta.get("http")
+        if not host or not port:
+            return None
+        return self._peer_http_get(
+            str(host), int(port),
+            f"/api/v1/shard?path={quote(asset)}&name={quote(name)}")
+
+    def _storage_manifest_blocking(self, node_meta: dict,
+                                   asset: str) -> dict | None:
+        import json
+        from urllib.parse import quote
+        host, port = node_meta.get("ip"), node_meta.get("http")
+        if not host or not port:
+            return None
+        raw = self._peer_http_get(
+            str(host), int(port),
+            f"/api/v1/shardmeta?path={quote(asset)}")
         if raw is None:
             return None
         try:
@@ -1396,6 +1554,17 @@ class StreamingServer:
             self.transcodes.sweep()
             self.hls.sweep()
             await self.pulls.sweep()
+            # background scrub (ISSUE 20): a bounded batch of local
+            # shard crc32 / parity-oracle verifications per interval,
+            # off the event loop — corruption is found BEFORE a reader
+            # needs the shard
+            if self.storage is not None:
+                now = time.monotonic()
+                if now >= self._storage_scrub_due:
+                    self._storage_scrub_due = (
+                        now + self.config.storage_scrub_interval_sec)
+                    st = self.storage
+                    st._executor().submit(st.scrub_tick)
 
     async def _rtsp_port_http_get(self, conn, target: str,
                                   headers: dict) -> bool:

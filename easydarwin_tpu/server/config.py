@@ -67,6 +67,21 @@ class ServerConfig:
     dvr_window_pkts: int = 64              # packets per spill window
     dvr_retention_bytes: int = 67_108_864  # per-track spill byte budget
     dvr_retention_sec: float = 600.0       # per-track spill duration cap
+    # --- erasure-coded fleet storage (ISSUE 20: storage/).  On: every
+    # FINALIZED .dvr asset is sharded into k data + m parity window
+    # shards (the GF(256) engine's device matmul, host-oracle-checked)
+    # striped across the live lease set under fenced Shard: claims; a
+    # read missing <= m shards reconstructs transparently through the
+    # spill chain's restore hook, scrub re-verifies local shards against
+    # manifest crc32s, and a dead holder's shards are re-derived onto
+    # ring successors as background math, not byte copies.  Requires
+    # dvr_enabled; works single-node (all shards local — still gives
+    # crc-scrubbed, reconstruct-on-corruption durability).
+    storage_enabled: bool = False
+    storage_data_shards: int = 4           # k: data shards per stripe
+    storage_parity_shards: int = 2         # m: parity shards (loss budget)
+    storage_scrub_interval_sec: float = 30.0
+    storage_device: bool = True            # parity on device w/ host oracle
     # --- dynamic modules (QTSServer::LoadModules / module_folder pref)
     module_folder: str = ""            # "" = no dynamic modules
     # --- device tier

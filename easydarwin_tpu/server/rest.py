@@ -551,8 +551,92 @@ class RestApi:
             return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
         path = params.get("path", [""])[0]
         doc = self.app.dvr.meta_doc(path) if path else None
+        if doc is None and path \
+                and getattr(self.app, "storage", None) is not None:
+            # erasure-tier fallback (ISSUE 20): the recording node is
+            # gone, but the asset's DVR documents ride every shard
+            # manifest — ANY surviving shard holder answers the
+            # bootstrap sweep, so a fully-remote asset replays even
+            # with its owner dead
+            doc = self.app.storage.meta_doc(path)
         if doc is None:
             return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, json.dumps(doc, separators=(",", ":")), \
+            "application/json"
+
+    # -- erasure storage wire (ISSUE 20) -----------------------------------
+    def _cmd_shard(self, params: dict,
+                   body: bytes) -> tuple[int, object, str] | tuple[int, str]:
+        """GET /api/v1/shard?path=&name= — one local erasure shard's
+        payload (crc-verified against the manifest before it ships; a
+        corrupt local copy 404s and self-queues repair)."""
+        st = getattr(self.app, "storage", None)
+        if st is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        path = params.get("path", [""])[0]
+        name = params.get("name", [""])[0]
+        if not path or not name:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST)
+        payload = st.serve_shard(path, name)
+        if payload is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, payload, "application/octet-stream"
+
+    def _cmd_shardmeta(self, params: dict,
+                       body: bytes) -> tuple[int, str] | tuple[int, str, str]:
+        """GET /api/v1/shardmeta?path= — the asset's shard manifest
+        (stripe geometry, per-shard crc32s, holder map, embedded DVR
+        documents)."""
+        st = getattr(self.app, "storage", None)
+        if st is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        path = params.get("path", [""])[0]
+        man = st.manifest(path) if path else None
+        if man is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        return 200, json.dumps(man, separators=(",", ":")), \
+            "application/json"
+
+    def _cmd_shardpush(self, params: dict,
+                       body: bytes) -> tuple[int, str]:
+        """POST /api/v1/shardpush?path=&name= — a peer placing one shard
+        here at store/repair time; the body is ``manifest-json\\n\\n``
+        followed by the raw payload.  Not in _MUTATING: the push rides
+        Basic auth like every peer call, and the payload is fenced by
+        the manifest crc32 — a corrupt or cross-gen push is refused, so
+        the CSRF login-token dance would only couple node bring-up
+        order."""
+        st = getattr(self.app, "storage", None)
+        if st is None:
+            return 404, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_NOT_FOUND)
+        path = params.get("path", [""])[0]
+        name = params.get("name", [""])[0]
+        sep = body.find(b"\n\n")
+        if not path or not name or sep < 0:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST)
+        try:
+            man = json.loads(body[:sep]) if sep > 0 else None
+        except ValueError:
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST)
+        if not st.receive_shard(path, name, body[sep + 2:], man):
+            return 400, ep.ack(ep.MSG_SC_EXCEPTION, error=ep.ERR_BAD_REQUEST,
+                               body={"Detail": "shard refused (crc/gen)"})
+        return 200, ep.ack(ep.MSG_SC_SERVER_INFO_ACK, body={
+            "Shard": name})
+
+    def _cmd_storagestats(self, params: dict,
+                          body: bytes) -> tuple[int, str, str]:
+        """GET /api/v1/storagestats — the storage tier's counters plus
+        the zero-repack witness (``vod.cache.pack_window.calls``): the
+        cluster soak reads this on every survivor after the holder
+        kill to assert shards reconstructed with no repacketization
+        and no scrub errors."""
+        from ..vod.cache import pack_window
+        st = getattr(self.app, "storage", None)
+        doc: dict = {"enabled": st is not None,
+                     "pack_window_calls": int(pack_window.calls)}
+        if st is not None:
+            doc.update(st.stats())
         return 200, json.dumps(doc, separators=(",", ":")), \
             "application/json"
 

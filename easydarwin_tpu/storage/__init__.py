@@ -1,0 +1,15 @@
+"""Erasure-coded fleet storage: finalized DVR/VOD assets sharded into
+k data + m parity window shards striped across the cluster (ISSUE 20).
+
+:mod:`.codec` holds the GF(256) stripe math (device matmul + host
+oracle, receiver-path Gaussian reconstruct); :mod:`.service` holds the
+node-local shard store, placement/push, fenced claims, scrub and repair.
+"""
+
+from .codec import StorageError, StripeCodec
+from .service import (MANIFEST_VERSION, SHARD_KEY_PREFIX, StorageService,
+                      shard_key, shard_name)
+
+__all__ = ["StorageError", "StripeCodec", "StorageService",
+           "SHARD_KEY_PREFIX", "MANIFEST_VERSION", "shard_key",
+           "shard_name"]

@@ -1,0 +1,851 @@
+"""Erasure-coded fleet storage: the durable CDN-origin tier (ISSUE 20).
+
+Every finalized DVR asset is sharded into ``k`` data + ``m`` parity
+*window shards* per track: data shard ``j`` of stripe ``s`` is the raw
+spill blob of the stripe's ``j``-th window (byte-identical to what
+``/api/v1/dvrwindow`` serves), parity shards are the
+:class:`~..storage.codec.StripeCodec` device matmuls.  Placement rides
+the capacity-weighted HashRing over the live lease set — shard key
+``{asset}/t{track}/s{stripe}.{idx}`` — and ownership is materialized as
+fenced ``Shard:{asset}/...`` records written through the cluster tick
+(the claim drain), so a zombie ex-holder's stale writes lose exactly
+like stream claims do.
+
+Reads are transparent: the spill read chain (local file → live peer →
+``restore``) ends here — a window blob is served from the local shard
+file when this node holds it, otherwise the stripe is gathered from any
+``k`` survivors and the missing rows are solved back byte-exactly
+(``storage_reconstructs_total``).  Background **scrub** re-verifies
+local shards against the manifest crc32s and — when a stripe's data
+shards are all local — re-derives parity through the host GF oracle;
+**repair** watches the fenced shard records for dead holders and
+re-materializes orphaned shards onto the ring successor as a re-keyed
+matmul/solve over survivors (``storage_repairs_total`` +
+``storage_repair_bytes_total``), not a byte copy.
+
+The manifest (``manifest.json`` per asset, replicated alongside every
+pushed shard) carries the stripe geometry, per-shard lengths + crc32s,
+the store-time holder map, and the asset's full DVR meta/index document
+— which is what lets ``/api/v1/dvrmeta`` answer for an asset whose
+recording node is already dead: any shard holder can bootstrap a
+replay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+
+from .. import obs
+from ..cluster.placement import SHARD_KEY_PREFIX, shard_key
+from ..protocol.sdp import _norm
+from ..utils.paths import confined_subpath
+from .codec import StorageError, StripeCodec
+
+MANIFEST_VERSION = 1
+
+
+def shard_name(track: int, stripe: int, idx: int) -> str:
+    return f"t{int(track)}/s{int(stripe)}.{int(idx)}"
+
+
+class StorageService:
+    """One node's shard store + scrub/repair workers + restore reads."""
+
+    #: local shards crc-verified per scrub tick (incremental cursor —
+    #: a big store must not stall the sweep loop)
+    SCRUB_BATCH = 32
+
+    def __init__(self, root: str, node_id: str, *, k: int = 4,
+                 m: int = 2, use_device: bool = True,
+                 error_log=None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.node_id = str(node_id)
+        self.codec = StripeCodec(k, m, use_device=use_device)
+        self.k, self.m = self.codec.k, self.codec.m
+        self.error_log = error_log
+        # -- cluster hooks (all optional: None = single-node store) --
+        #: callable() -> dict[node_id, lease_meta] of LIVE nodes
+        self.peer_nodes = None
+        #: callable(nodes_dict) -> HashRing (capacity-weighted when the
+        #: fleet publishes capacities — cluster.placement ring())
+        self.ring_for = None
+        #: callable(node_meta, asset, name, payload, manifest_json)
+        #: -> bool — blocking HTTP push of one shard to a peer
+        self.push_shard = None
+        #: callable(node_meta, asset, name) -> bytes | None — blocking
+        #: HTTP fetch of one shard from a peer
+        self.fetch_shard = None
+        #: callable(node_meta, asset) -> dict | None — blocking HTTP
+        #: fetch of a peer's manifest
+        self.fetch_manifest = None
+        # -- state --
+        self._lock = threading.Lock()
+        self._manifests: dict[str, dict] = {}
+        #: fenced claims awaiting the cluster tick's drain:
+        #: [(redis key, record dict)]
+        self._pending_claims: list[tuple[str, dict]] = []
+        #: repair jobs awaiting a worker: {(asset, name)}
+        self._repair_queue: list[tuple[str, str]] = []
+        self._repair_inflight: set[tuple[str, str]] = set()
+        self._pool = None
+        self._scrub_cursor: list[tuple[str, str]] = []
+        self._closed = False
+        #: one solve serves the whole stripe: {(asset, tid, s, gen):
+        #: {data_idx: blob}} — a replay walking a timeline hits every
+        #: missing window of a stripe back-to-back, so the sibling
+        #: windows ride the first reconstruct instead of re-gathering
+        #: and re-solving (FIFO-bounded; gen key retires stale entries)
+        self._stripe_cache: dict[tuple, dict[int, bytes]] = {}
+        self._stripe_cache_max = 8
+        #: confined_subpath → realpath() is measurably hot on the
+        #: reconstruct read path; path confinement is stable, so cache
+        #: both asset→dir and (dir, shard name)→file resolutions
+        self._dir_cache: dict = {}
+        # -- stats (bench/tests read these; metrics are the fleet view)
+        self.stored_assets = 0
+        self.shards_local = 0
+        self.shards_pushed = 0
+        self.push_failures = 0
+        self.reconstructs = 0
+        self.reconstruct_failures = 0
+        self.repairs = 0
+        self.repair_bytes = 0
+        self.scrub_errors = 0
+        self.scrubbed = 0
+
+    # ------------------------------------------------------------ geometry
+    def _dir_for(self, asset: str) -> str | None:
+        key = _norm(asset)
+        try:
+            return self._dir_cache[key]
+        except KeyError:
+            pass
+        p = confined_subpath(self.root, key)
+        if len(self._dir_cache) >= 1024:
+            self._dir_cache.clear()
+        self._dir_cache[key] = p
+        return p
+
+    def _placement_target(self, ring, key: str, name: str) -> str:
+        """Distinct-node-per-stripe placement: rank the STRIPE on the
+        capacity-weighted ring and deal shard ``idx`` round-robin down
+        the candidate list — a fleet at least ``k+m`` wide then loses
+        at most ONE shard of any stripe per node death, which is
+        exactly what ``m`` parity rows insure against."""
+        stem, _, idx_s = name.rpartition(".")
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            idx = 0
+        rank = ring.rank(f"{key}/{stem}")
+        if not rank:
+            return self.node_id
+        return rank[idx % len(rank)]
+
+    def _shard_path(self, asset: str, name: str) -> str | None:
+        adir = self._dir_for(asset)
+        if adir is None:
+            return None
+        ck = (adir, name)
+        try:
+            return self._dir_cache[ck]      # type: ignore[index]
+        except KeyError:
+            pass
+        p = confined_subpath(adir, name)
+        if len(self._dir_cache) >= 1024:
+            self._dir_cache.clear()
+        self._dir_cache[ck] = p             # type: ignore[index]
+        return p
+
+    # ------------------------------------------------------------ manifest
+    def manifest(self, asset: str) -> dict | None:
+        """The asset's manifest — memory cache, then disk."""
+        key = _norm(asset)
+        with self._lock:
+            doc = self._manifests.get(key)
+        if doc is not None:
+            return doc
+        adir = self._dir_for(asset)
+        if adir is None:
+            return None
+        try:
+            with open(os.path.join(adir, "manifest.json"),
+                      encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(doc, dict) \
+                or doc.get("version") != MANIFEST_VERSION:
+            return None
+        with self._lock:
+            self._manifests[key] = doc
+        return doc
+
+    def _write_manifest(self, asset: str, doc: dict) -> bool:
+        adir = self._dir_for(asset)
+        if adir is None:
+            return False
+        os.makedirs(adir, exist_ok=True)
+        tmp = os.path.join(adir, "manifest.json.tmp")
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, separators=(",", ":"))
+            os.replace(tmp, os.path.join(adir, "manifest.json"))
+        except OSError:
+            return False
+        with self._lock:
+            self._manifests[_norm(asset)] = doc
+        return True
+
+    def meta_doc(self, asset: str) -> dict | None:
+        """The asset's DVR meta/index document carried by the manifest —
+        the ``/api/v1/dvrmeta`` fallback that answers for a DEAD
+        recording node (ISSUE 20 satellite: any shard holder can
+        bootstrap a fully-remote replay)."""
+        man = self.manifest(asset)
+        if man is None:
+            man = self._sync_manifest(asset)
+        doc = (man or {}).get("dvr")
+        return doc if isinstance(doc, dict) else None
+
+    # --------------------------------------------------------------- store
+    def store_asset(self, path: str, dvr) -> dict | None:
+        """Shard one finalized asset (the ``DvrManager.on_finalize``
+        hook): encode every track's windows into k+m stripes, keep the
+        ring-assigned local shards, push the rest to their holders, and
+        queue one fenced ``Shard:`` claim per shard.  A push failure
+        keeps the shard local (the manifest holder map records reality,
+        and repair re-places it later) — finalize never loses bytes."""
+        key = _norm(path)
+        doc = dvr.meta_doc(key)
+        if doc is None or not isinstance(doc.get("tracks"), dict):
+            return None
+        adir = self._dir_for(key)
+        if adir is None:
+            return None
+        nodes = {}
+        if self.peer_nodes is not None:
+            try:
+                nodes = dict(self.peer_nodes() or {})
+            except Exception:
+                nodes = {}
+        ring_nodes = nodes if nodes else {self.node_id: {}}
+        ring = (self.ring_for(ring_nodes) if self.ring_for is not None
+                else None)
+        try:
+            gen = int((doc.get("meta") or {}).get("gen", 0))
+        except (TypeError, ValueError):
+            gen = 0
+        # fresh tree per generation: a re-recorded asset's stale shards
+        # must never mix with the new stripes
+        if os.path.isdir(adir):
+            shutil.rmtree(adir, ignore_errors=True)
+        man = {"version": MANIFEST_VERSION, "path": key, "gen": gen,
+               "k": self.k, "m": self.m, "tracks": {},
+               "holders": {}, "dvr": doc}
+        shards: list[tuple[str, int, bytes]] = []   # (name, idx, payload)
+        for tid_s, idx_doc in doc["tracks"].items():
+            try:
+                tid = int(tid_s)
+            except (TypeError, ValueError):
+                continue
+            wins = sorted(int(r["win"]) for r in
+                          (idx_doc.get("windows") or ())
+                          if isinstance(r, dict) and "win" in r)
+            if not wins:
+                continue
+            trec = {"wins": wins, "stripes": []}
+            for s in range(0, (len(wins) + self.k - 1) // self.k):
+                grp = wins[s * self.k:(s + 1) * self.k]
+                blobs = []
+                for w in grp:
+                    b = dvr.window_blob(key, tid, w)
+                    blobs.append(b or b"")
+                blobs += [b""] * (self.k - len(blobs))
+                parity = self.codec.parity(blobs)
+                srec = {"lens": [len(b) for b in blobs],
+                        "crcs": [zlib.crc32(b) & 0xFFFFFFFF
+                                 for b in blobs],
+                        "pcrcs": [zlib.crc32(p) & 0xFFFFFFFF
+                                  for p in parity],
+                        "width": max([len(b) for b in blobs] + [1])}
+                trec["stripes"].append(srec)
+                for j, b in enumerate(blobs):
+                    if b:
+                        shards.append((shard_name(tid, s, j), j, b))
+                for p, pb in enumerate(parity):
+                    shards.append(
+                        (shard_name(tid, s, self.k + p), self.k + p, pb))
+            man["tracks"][str(tid)] = trec
+        if not shards:
+            return None
+        man_json = json.dumps(man, separators=(",", ":"))
+        placed = {"data": 0, "parity": 0}
+        for name, idx, payload in shards:
+            target = self.node_id
+            if ring is not None and len(ring_nodes) > 1:
+                target = self._placement_target(ring, key, name)
+            kind = "data" if idx < self.k else "parity"
+            if target != self.node_id and self.push_shard is not None:
+                ok = False
+                try:
+                    ok = bool(self.push_shard(
+                        ring_nodes.get(target) or {}, key, name,
+                        payload, man_json))
+                except Exception:
+                    ok = False
+                if not ok:
+                    self.push_failures += 1
+                    target = self.node_id       # keep it: never lose bytes
+            if target == self.node_id:
+                if not self._write_shard(key, name, payload):
+                    continue
+                self.shards_local += 1
+            else:
+                self.shards_pushed += 1
+            obs.STORAGE_SHARDS.inc(kind=kind)
+            placed[kind] += 1
+            man["holders"][name] = target
+            self._queue_claim(key, name, target)
+        self._write_manifest(key, man)
+        self.stored_assets += 1
+        obs.EVENTS.emit("storage.store", stream=key, asset=key,
+                        shards=placed["data"] + placed["parity"],
+                        parity=placed["parity"])
+        return man
+
+    def _write_shard(self, asset: str, name: str, payload: bytes) -> bool:
+        p = self._shard_path(asset, name)
+        if p is None:
+            return False
+        try:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            tmp = p + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+            os.replace(tmp, p)
+        except OSError:
+            return False
+        return True
+
+    def _queue_claim(self, asset: str, name: str, holder: str) -> None:
+        with self._lock:
+            self._pending_claims.append(
+                (shard_key(asset, name), {"node": holder}))
+
+    def pending_claims(self) -> list[tuple[str, dict]]:
+        """Drain the fenced-claim queue (the cluster tick writes these
+        with freshly minted tokens — storage itself never touches
+        redis)."""
+        with self._lock:
+            out, self._pending_claims = self._pending_claims, []
+        return out
+
+    # ---------------------------------------------------------- peer faces
+    def serve_shard(self, asset: str, name: str) -> bytes | None:
+        """One local shard's payload (the REST ``/api/v1/shard`` body),
+        crc-verified against the manifest — corrupt bytes are counted,
+        quarantined and never shipped."""
+        payload = self._read_local(asset, name)
+        return payload
+
+    def receive_shard(self, asset: str, name: str, payload: bytes,
+                      manifest_doc: dict | None) -> bool:
+        """A peer pushed one shard at store/repair time: adopt the
+        manifest (first write wins per gen; a newer gen replaces), crc-
+        verify the payload against it, persist, queue our claim."""
+        key = _norm(asset)
+        if manifest_doc is not None:
+            cur = self.manifest(key)
+            try:
+                new_gen = int(manifest_doc.get("gen", 0))
+            except (TypeError, ValueError):
+                return False
+            if cur is None or int(cur.get("gen", -1)) != new_gen:
+                adir = self._dir_for(key)
+                if adir is not None and os.path.isdir(adir) \
+                        and cur is not None \
+                        and int(cur.get("gen", -1)) < new_gen:
+                    shutil.rmtree(adir, ignore_errors=True)
+                    with self._lock:
+                        self._manifests.pop(key, None)
+                if not self._write_manifest(key, manifest_doc):
+                    return False
+        man = self.manifest(key)
+        if man is None:
+            return False
+        want = self._expected_crc(man, name)
+        if want is None \
+                or (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+            return False
+        if not self._write_shard(key, name, payload):
+            return False
+        self.shards_local += 1
+        self._queue_claim(key, name, self.node_id)
+        return True
+
+    @staticmethod
+    def _parse_name(name: str) -> tuple[int, int, int] | None:
+        try:
+            tpart, spart = name.split("/", 1)
+            tid = int(tpart[1:])
+            stripe_s, idx_s = spart[1:].split(".", 1)
+            return tid, int(stripe_s), int(idx_s)
+        except (ValueError, IndexError):
+            return None
+
+    def _expected_crc(self, man: dict, name: str) -> int | None:
+        parsed = self._parse_name(name)
+        if parsed is None:
+            return None
+        tid, stripe, idx = parsed
+        trec = (man.get("tracks") or {}).get(str(tid))
+        if not isinstance(trec, dict):
+            return None
+        stripes = trec.get("stripes") or []
+        if not 0 <= stripe < len(stripes):
+            return None
+        srec = stripes[stripe]
+        try:
+            if idx < int(man.get("k", self.k)):
+                return int(srec["crcs"][idx])
+            return int(srec["pcrcs"][idx - int(man.get("k", self.k))])
+        except (KeyError, IndexError, TypeError, ValueError):
+            return None
+
+    def _read_local(self, asset: str, name: str) -> bytes | None:
+        """Local shard bytes, crc-verified.  A mismatch counts a scrub
+        error, quarantines the file and queues repair — today's
+        truncated read is tomorrow's background fix."""
+        p = self._shard_path(asset, name)
+        if p is None or not os.path.isfile(p):
+            return None
+        try:
+            with open(p, "rb") as fh:
+                payload = fh.read()
+        except OSError:
+            return None
+        man = self.manifest(asset)
+        want = self._expected_crc(man, name) if man else None
+        if want is not None \
+                and (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+            self._note_corrupt(asset, name, p)
+            return None
+        return payload
+
+    def _note_corrupt(self, asset: str, name: str, path: str) -> None:
+        self.scrub_errors += 1
+        obs.STORAGE_SCRUB_ERRORS.inc()
+        obs.EVENTS.emit("storage.scrub_error", level="error",
+                        stream=asset, asset=asset, shard=name)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        with self._lock:
+            if (asset, name) not in self._repair_inflight:
+                self._repair_queue.append((_norm(asset), name))
+
+    # -------------------------------------------------------------- restore
+    def restore_window(self, path: str, track: int,
+                       win: int) -> bytes | None:
+        """The spill chain's last resort (BLOCKING — helper threads
+        only): the raw window blob from the local shard file, or a
+        byte-exact reconstruct from any k surviving shards of its
+        stripe.  None = beyond the parity budget (the failure already
+        counted loudly)."""
+        key = _norm(path)
+        man = self.manifest(key) or self._sync_manifest(key)
+        if man is None:
+            return None
+        trec = (man.get("tracks") or {}).get(str(int(track)))
+        if not isinstance(trec, dict):
+            return None
+        wins = trec.get("wins") or []
+        try:
+            pos = wins.index(int(win))
+        except ValueError:
+            return None
+        k = int(man.get("k", self.k))
+        s, j = divmod(pos, k)
+        name = shard_name(int(track), s, j)
+        # stripe cache first: one gather+solve serves the WHOLE stripe
+        # (solved rows AND the survivors it read), so a degraded replay
+        # touches each shard once, like a healthy one
+        ck = (key, int(track), s, int(man.get("gen", 0)))
+        with self._lock:
+            cached = self._stripe_cache.get(ck)
+        if cached is not None and j in cached:
+            self.reconstructs += 1
+            return cached[j]
+        local = self._read_local(key, name)
+        if local is not None:
+            return local
+        try:
+            srec = (trec.get("stripes") or [])[s]
+            lens = [int(x) for x in srec["lens"]]
+        except (IndexError, KeyError, TypeError, ValueError):
+            return None
+        present = self._gather_stripe(key, man, int(track), s, lens,
+                                      skip=j)
+        try:
+            out = self.codec.reconstruct(
+                present, lens, asset=f"{key}/{name}",
+                crcs=[int(x) for x in srec.get("crcs") or ()] or None)
+        except StorageError as e:
+            self.reconstruct_failures += 1
+            if self.error_log:
+                self.error_log.error(f"storage restore failed: {e}")
+            return None
+        self.reconstructs += 1
+        entry = dict(out)
+        for i, blob in present.items():
+            if i < k:                   # survivors ride along (exact
+                entry[i] = blob         # blob bytes, crc-verified)
+        with self._lock:
+            while len(self._stripe_cache) >= self._stripe_cache_max:
+                self._stripe_cache.pop(next(iter(self._stripe_cache)))
+            self._stripe_cache[ck] = entry
+        return out.get(j)
+
+    def _gather_stripe(self, asset: str, man: dict, tid: int, s: int,
+                       lens: list[int], *, skip: int) -> dict[int, bytes]:
+        """Every shard of one stripe this node can lay hands on: local
+        files first, then the manifest's holders, then a live-peer
+        sweep.  Stops fetching parity once enough rows survive."""
+        k, m = int(man.get("k", self.k)), int(man.get("m", self.m))
+        present: dict[int, bytes] = {}
+        nodes = {}
+        if self.peer_nodes is not None:
+            try:
+                nodes = dict(self.peer_nodes() or {})
+            except Exception:
+                nodes = {}
+        holders = man.get("holders") or {}
+        missing_data = 0
+        for idx in range(k):
+            if idx == skip and idx < k and lens[idx] > 0:
+                missing_data += 1
+                continue                   # the one we are rebuilding
+            if idx < len(lens) and lens[idx] == 0:
+                continue                   # tail padding: known-zero
+            payload = self._fetch_any(asset, shard_name(tid, s, idx),
+                                      nodes, holders)
+            if payload is not None:
+                present[idx] = payload
+            else:
+                missing_data += 1
+        got_parity = 0
+        for p in range(m):
+            if got_parity >= missing_data:
+                break
+            payload = self._fetch_any(asset, shard_name(tid, s, k + p),
+                                      nodes, holders)
+            if payload is not None:
+                present[k + p] = payload
+                got_parity += 1
+        return present
+
+    def _fetch_any(self, asset: str, name: str, nodes: dict,
+                   holders: dict) -> bytes | None:
+        local = self._read_local(asset, name)
+        if local is not None:
+            return local
+        if self.fetch_shard is None:
+            return None
+        man = self.manifest(asset)
+        order = []
+        h = holders.get(name)
+        if h and h in nodes and h != self.node_id:
+            order.append(h)
+        order += [n for n in nodes
+                  if n != self.node_id and n not in order]
+        for node in order:
+            try:
+                payload = self.fetch_shard(nodes.get(node) or {},
+                                           asset, name)
+            except Exception:
+                payload = None
+            if not payload:
+                continue
+            want = self._expected_crc(man, name) if man else None
+            if want is not None \
+                    and (zlib.crc32(payload) & 0xFFFFFFFF) != want:
+                continue                   # corrupt peer copy: keep looking
+            return payload
+        return None
+
+    def _sync_manifest(self, asset: str) -> dict | None:
+        """No local manifest: sweep live peers for one (BLOCKING)."""
+        if self.fetch_manifest is None or self.peer_nodes is None:
+            return None
+        try:
+            nodes = dict(self.peer_nodes() or {})
+        except Exception:
+            return None
+        for node, meta in nodes.items():
+            if node == self.node_id:
+                continue
+            try:
+                doc = self.fetch_manifest(meta or {}, asset)
+            except Exception:
+                doc = None
+            if isinstance(doc, dict) \
+                    and doc.get("version") == MANIFEST_VERSION:
+                self._write_manifest(_norm(asset), doc)
+                return doc
+        return None
+
+    # ----------------------------------------------------------- scrubbing
+    def scrub_tick(self, *, batch: int | None = None) -> int:
+        """Verify up to ``batch`` local shards against the manifest
+        crc32s; for parity shards whose stripe's data shards are ALL
+        local, also re-derive the row through the host GF oracle.
+        Corruption counts ``storage_scrub_errors_total``, quarantines
+        the file and queues repair.  Returns shards verified."""
+        if self._closed:
+            return 0
+        n = batch or self.SCRUB_BATCH
+        if not self._scrub_cursor:
+            self._scrub_cursor = self._walk_shards()
+        done = 0
+        while self._scrub_cursor and done < n:
+            asset, name = self._scrub_cursor.pop()
+            man = self.manifest(asset)
+            if man is None:
+                continue
+            payload = self._read_local(asset, name)   # counts crc errors
+            done += 1
+            self.scrubbed += 1
+            if payload is None:
+                continue
+            parsed = self._parse_name(name)
+            if parsed is None:
+                continue
+            tid, s, idx = parsed
+            k = int(man.get("k", self.k))
+            if idx < k:
+                continue
+            # host-oracle parity verify when the whole stripe is local
+            try:
+                srec = man["tracks"][str(tid)]["stripes"][s]
+                lens = [int(x) for x in srec["lens"]]
+            except (KeyError, IndexError, TypeError, ValueError):
+                continue
+            blobs = []
+            for j in range(k):
+                if lens[j] == 0:
+                    blobs.append(b"")
+                    continue
+                b = self._read_local(asset, shard_name(tid, s, j))
+                if b is None:
+                    blobs = None
+                    break
+                blobs.append(b)
+            if blobs is None:
+                continue
+            from ..relay.fec import coeff_rows, gf_matmul
+            import numpy as np
+            width = max([len(b) for b in blobs] + [1])
+            rows = np.zeros((k, width), np.uint8)
+            for j, b in enumerate(blobs):
+                if b:
+                    rows[j, :len(b)] = np.frombuffer(b, np.uint8)
+            host = gf_matmul(coeff_rows(range(k), idx - k + 1), rows)
+            if host[idx - k, :len(payload)].tobytes() != payload:
+                p = self._shard_path(asset, name)
+                self._note_corrupt(asset, name, p or "")
+        return done
+
+    def _walk_shards(self) -> list[tuple[str, str]]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for f in files:
+                if not f.startswith("s") or "." not in f:
+                    continue
+                full = os.path.join(dirpath, f)
+                rel = os.path.relpath(full, self.root)
+                parts = rel.split(os.sep)
+                if len(parts) < 2 or not parts[-2].startswith("t"):
+                    continue
+                asset = "/" + "/".join(parts[:-2])
+                out.append((asset, f"{parts[-2]}/{f}"))
+        return out
+
+    # -------------------------------------------------------------- repair
+    def repair_scan(self, live_nodes: dict,
+                    shard_records: dict[str, dict]) -> int:
+        """The cluster tick hands us the live lease set and the parsed
+        fenced ``Shard:`` records: every shard whose recorded holder is
+        DEAD and whose ring successor over the survivors is THIS node
+        gets queued for re-materialization.  Returns jobs queued."""
+        if self._closed or not shard_records:
+            return 0
+        ring = (self.ring_for(live_nodes) if self.ring_for is not None
+                else None)
+        queued = 0
+        for key, rec in shard_records.items():
+            holder = rec.get("node") if isinstance(rec, dict) else None
+            if holder in live_nodes:
+                continue
+            rel = key[len(SHARD_KEY_PREFIX):]
+            asset, _, name = rel.rpartition("/t")
+            if not asset or not name:
+                continue
+            asset, name = "/" + asset, "t" + name
+            if ring is not None:
+                # same stripe-ranked placement store_asset used, over
+                # the survivor ring: the shard's new home elects itself
+                if self._placement_target(ring, asset, name) \
+                        != self.node_id:
+                    continue
+            p = self._shard_path(asset, name)
+            if p is not None and os.path.isfile(p):
+                # already local (e.g. the push failed at store time and
+                # the finalizer kept it): just re-claim under our name
+                self._queue_claim(asset, name, self.node_id)
+                continue
+            job = (_norm(asset), name)
+            with self._lock:
+                if job in self._repair_inflight:
+                    continue
+                self._repair_inflight.add(job)
+            self._executor().submit(self._repair_job, *job)
+            queued += 1
+        return queued
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                2, thread_name_prefix="storage")
+        return self._pool
+
+    def store_async(self, path: str, dvr):
+        """Submit :meth:`store_asset` to the worker pool (the finalize
+        hook runs on the event loop; sharding + pushes are blocking)."""
+        return self._executor().submit(self.store_asset, path, dvr)
+
+    def restore_async(self, path: str, track: int, win: int):
+        """Submit :meth:`restore_window` to the worker pool (the spill
+        read chain calls inline on the pump and polls the future)."""
+        return self._executor().submit(self.restore_window, path,
+                                       int(track), int(win))
+
+    def repair_now(self, asset: str, name: str) -> int | None:
+        """Synchronously re-materialize one shard, with full repair
+        accounting (bench/tests; the background path is
+        :meth:`repair_scan` → worker).  Returns bytes written, or None
+        when the stripe cannot be repaired yet."""
+        nbytes = self._repair_one(asset, name)
+        if nbytes is None:
+            return None
+        self.repairs += 1
+        self.repair_bytes += nbytes
+        parsed = self._parse_name(name)
+        kind = "parity" if parsed and parsed[2] >= self.k else "data"
+        obs.STORAGE_REPAIRS.inc(kind=kind)
+        obs.STORAGE_REPAIR_BYTES.inc(nbytes)
+        obs.STORAGE_SHARDS.inc(kind=kind)
+        obs.EVENTS.emit("storage.repair", stream=asset, asset=asset,
+                        shards=1, shard=name)
+        return nbytes
+
+    def _repair_job(self, asset: str, name: str) -> None:
+        try:
+            self.repair_now(asset, name)
+        except Exception as e:
+            if self.error_log:
+                self.error_log.error(f"storage repair {asset}/{name}: "
+                                     f"{e!r}")
+        finally:
+            with self._lock:
+                self._repair_inflight.discard((asset, name))
+
+    def _repair_one(self, asset: str, name: str) -> int | None:
+        """Re-materialize one shard from survivors: a missing DATA shard
+        is a gf_solve reconstruct; a missing PARITY shard is the
+        Vandermonde matmul re-run over the k data blobs — math, not a
+        byte copy."""
+        man = self.manifest(asset) or self._sync_manifest(asset)
+        if man is None:
+            return None
+        parsed = self._parse_name(name)
+        if parsed is None:
+            return None
+        tid, s, idx = parsed
+        k = int(man.get("k", self.k))
+        try:
+            srec = man["tracks"][str(tid)]["stripes"][s]
+            lens = [int(x) for x in srec["lens"]]
+        except (KeyError, IndexError, TypeError, ValueError):
+            return None
+        if idx < k:
+            if lens[idx] == 0:
+                return None                # tail padding: nothing to fix
+            present = self._gather_stripe(asset, man, tid, s, lens,
+                                          skip=idx)
+            out = self.codec.reconstruct(
+                present, lens, asset=f"{asset}/{name}",
+                crcs=[int(x) for x in srec.get("crcs") or ()] or None)
+            self.reconstructs += 1
+            payload = out.get(idx)
+        else:
+            nodes = {}
+            if self.peer_nodes is not None:
+                try:
+                    nodes = dict(self.peer_nodes() or {})
+                except Exception:
+                    nodes = {}
+            blobs = []
+            for j in range(k):
+                if lens[j] == 0:
+                    blobs.append(b"")
+                    continue
+                b = self._fetch_any(asset, shard_name(tid, s, j), nodes,
+                                    man.get("holders") or {})
+                if b is None:
+                    return None            # data gone too: repair later
+                blobs.append(b)
+            payload = self.codec.parity(blobs)[idx - k]
+        if not payload:
+            return None
+        if not self._write_shard(asset, name, payload):
+            return None
+        self.shards_local += 1
+        self._queue_claim(asset, name, self.node_id)
+        return len(payload)
+
+    # ----------------------------------------------------------------- misc
+    def stats(self) -> dict:
+        return {
+            "assets": self.stored_assets,
+            "shards_local": self.shards_local,
+            "shards_pushed": self.shards_pushed,
+            "push_failures": self.push_failures,
+            "reconstructs": self.reconstructs,
+            "reconstruct_failures": self.reconstruct_failures,
+            "repairs": self.repairs,
+            "repair_bytes": self.repair_bytes,
+            "scrub_errors": self.scrub_errors,
+            "scrubbed": self.scrubbed,
+            "oracle_mismatches": self.codec.oracle_mismatches,
+            "host_fallback": self.codec.host_fallback,
+            "device_passes": self.codec.device_passes,
+        }
+
+    def close(self) -> None:
+        self._closed = True
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+
+__all__ = ["StorageService", "SHARD_KEY_PREFIX", "shard_key",
+           "shard_name", "MANIFEST_VERSION"]

@@ -130,7 +130,7 @@ class CachedWindow:
     __slots__ = ("key", "lo", "hi", "data", "length", "flags", "ts",
                  "sample", "npt", "pkt_base", "sample_npt", "staged",
                  "seq", "arrival", "pins", "hits", "_device",
-                 "_on_device", "device_uploads", "nbytes")
+                 "_on_device", "device_uploads", "nbytes", "restored")
 
     def __init__(self, key, lo, hi, pkts, samples, npts, tss, is_video,
                  sample_npts=None):
@@ -184,6 +184,9 @@ class CachedWindow:
                                        np.uint8)])
         self.pins = 0
         self.hits = 0
+        #: True when the rows came back through an erasure reconstruct
+        #: (storage tier) rather than a local/peer spill read
+        self.restored = False
         self._device = None
         #: cache hook accounting the HBM copy into the byte budget
         self._on_device = None
@@ -196,7 +199,8 @@ class CachedWindow:
 
     @classmethod
     def from_packed(cls, key, id_lo: int, data, length, flags, ts, *,
-                    seq=None, arrival=None) -> "CachedWindow":
+                    seq=None, arrival=None,
+                    restored: bool = False) -> "CachedWindow":
         """Zero-repack construction from rows that are ALREADY in the
         fixed-slot packed format (a DVR spill window, ``dvr/spill.py``):
         no packetizer runs, no classification — the parallel arrays are
@@ -220,6 +224,7 @@ class CachedWindow:
         w.arrival = (np.ascontiguousarray(arrival, np.int64)
                      if arrival is not None else None)
         w._finish_init()
+        w.restored = bool(restored)
         if w.seq is not None:
             w.nbytes += w.seq.nbytes
         if w.arrival is not None:
@@ -319,6 +324,10 @@ class SegmentCache:
         self.evictions = 0
         self.fills = 0
         self.fill_errors = 0
+        #: fills whose rows came back via erasure reconstruct (the
+        #: storage tier) — "zero repacks" stays checkable even when the
+        #: bytes were re-derived from parity instead of read from disk
+        self.restored_fills = 0
         self._closed = False
 
     # ---------------------------------------------------------------- keys
@@ -415,6 +424,8 @@ class SegmentCache:
                             self._account_device_bytes(k, n))
             self.bytes += w.nbytes
             self.fills += 1
+            if getattr(w, "restored", False):
+                self.restored_fills += 1
             self._evict_over_budget(keep=key)
             obs.VOD_CACHE_BYTES.set(self.bytes)
         return w
@@ -590,6 +601,7 @@ class SegmentCache:
                 "windows": len(self._lru), "bytes": self.bytes,
                 "hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions, "fills": self.fills,
+                "restored_fills": self.restored_fills,
                 "device_uploads": sum(w.device_uploads
                                       for w in self._lru.values()),
                 "pinned": sum(1 for w in self._lru.values() if w.pins),

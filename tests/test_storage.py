@@ -1,0 +1,525 @@
+"""ISSUE 20: erasure-coded fleet storage — the GF(256) engine as a
+durable CDN origin.
+
+The acceptance core is byte identity under loss: a finalized asset's
+window blobs must come back byte-exact from any ``k`` surviving shards
+of a stripe (XOR fast path for single losses, the Gaussian ``gf_solve``
+for multi-loss, the device matmul crc-oracle-checked end-to-end), a
+read beyond the parity budget must fail LOUDLY
+(``storage_reconstructs_total{result="failed"}`` + the ``gf_solve``
+singular accounting satellite), scrub must quarantine corrupt shards
+and repair must re-materialize them as math, not byte copies.  Plus the
+stripe-ranked distinct-node placement, the ``/api/v1/dvrmeta``
+dead-owner bootstrap satellite and the tooling contracts.
+"""
+
+import asyncio
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from easydarwin_tpu import obs
+from easydarwin_tpu.storage import StorageService
+from easydarwin_tpu.storage.codec import StorageError, StripeCodec
+from easydarwin_tpu.storage.service import shard_name
+
+VIDEO_SDP = ("v=0\r\nm=video 0 RTP/AVP 96\r\na=rtpmap:96 H264/90000\r\n"
+             "a=fmtp:96 packetization-mode=1\r\n"
+             "a=control:trackID=1\r\n")
+SPS = bytes((0x67, 0x42, 0x00, 0x1F)) + bytes(range(8))
+PPS = bytes((0x68, 0xCE, 0x3C, 0x80, 1, 2, 3, 4))
+
+
+def _frame_packets(seq, ts, *, idr=False, size=300, with_params=False):
+    from easydarwin_tpu.protocol import nalu
+    pkts = []
+    if with_params:
+        for cfg in (SPS, PPS):
+            pkts += nalu.packetize_h264(cfg, seq=seq, timestamp=ts,
+                                        ssrc=7, marker_on_last=False)
+            seq += 1
+    nal = bytes((0x65 if idr else 0x41,)) \
+        + bytes(i & 0xFF for i in range(size))
+    pkts += nalu.packetize_h264(nal, seq=seq, timestamp=ts, ssrc=7,
+                                mtu=1400)
+    return pkts
+
+
+def _blobs(n, *, base=317, seed=7):
+    """Deterministic varied-length window blobs (no two equal)."""
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, size=base + 41 * i,
+                         dtype=np.uint8).tobytes() for i in range(n)]
+
+
+class _FakeDvr:
+    """The two-method surface ``store_asset`` needs from DvrManager."""
+
+    def __init__(self, blobs, *, gen=1):
+        self.blobs = blobs               # {tid: {win: bytes}}
+        self.gen = gen
+
+    def meta_doc(self, path):
+        return {"path": path, "meta": {"gen": self.gen},
+                "tracks": {str(t): {"windows": [{"win": w}
+                                                for w in sorted(ws)]}
+                           for t, ws in self.blobs.items()}}
+
+    def window_blob(self, path, tid, win):
+        return self.blobs.get(int(tid), {}).get(int(win))
+
+
+def _store(tmp_path, blobs, *, k=2, m=1, use_device=False,
+           node="node-a"):
+    st = StorageService(str(tmp_path / "shards"), node, k=k, m=m,
+                        use_device=use_device)
+    dvr = _FakeDvr({1: dict(enumerate(blobs))})
+    man = st.store_asset("/live/sa", dvr)
+    assert man is not None
+    return st, man
+
+
+def _device_available():
+    try:
+        from easydarwin_tpu.models.relay_pipeline import \
+            fec_parity_window_step
+        fec_parity_window_step(np.zeros((2, 256), np.uint8),
+                               np.zeros((1, 2), np.uint8))
+        return True
+    except Exception:
+        return False
+
+
+# ================================================================= codec
+
+def test_codec_parity_and_multi_loss_roundtrip_host():
+    k, m = 4, 2
+    codec = StripeCodec(k, m, use_device=False)
+    blobs = _blobs(k)
+    parity = codec.parity(blobs)
+    assert len(parity) == m
+    width = max(len(b) for b in blobs)
+    assert all(len(p) == width for p in parity)
+    # parity row 0 is the XOR row: verifiable without any GF table
+    acc = np.zeros(width, np.uint8)
+    for b in blobs:
+        acc[:len(b)] ^= np.frombuffer(b, np.uint8)
+    assert parity[0] == acc.tobytes()
+    lens = [len(b) for b in blobs]
+    # lose m data shards -> the RS Gaussian path, byte-exact
+    present = {2: blobs[2], 3: blobs[3],
+               k: parity[0], k + 1: parity[1]}
+    out = codec.reconstruct(present, lens, asset="t")
+    assert out == {0: blobs[0], 1: blobs[1]}
+    # short stripe: b"" tail padding encodes and never reconstructs
+    short = blobs[:2] + [b"", b""]
+    p2 = codec.parity(short)
+    out = codec.reconstruct({1: short[1], k: p2[0]},
+                            [len(b) for b in short], asset="t")
+    assert out == {0: short[0], 2: b"", 3: b""}
+
+
+def test_codec_single_loss_xor_fast_path(monkeypatch):
+    """A single-loss stripe solves through the all-ones parity row:
+    every combined coefficient is 0/1 and the apply is pure XOR — the
+    wide-matmul stage must never run."""
+    from easydarwin_tpu.storage import codec as codec_mod
+    k, m = 4, 2
+    codec = StripeCodec(k, m, use_device=False)
+    blobs = _blobs(k)
+    parity = codec.parity(blobs)
+    lens = [len(b) for b in blobs]
+
+    def _boom(*a, **kw):
+        raise AssertionError("single loss must take the XOR fast path")
+    monkeypatch.setattr(StripeCodec, "_wide_matmul", _boom)
+    present = {0: blobs[0], 2: blobs[2], 3: blobs[3], k: parity[0]}
+    assert codec.reconstruct(present, lens, asset="t") == {1: blobs[1]}
+    # parity row 0 gone -> the survivor set forces a true RS solve
+    monkeypatch.undo()
+    present = {0: blobs[0], 2: blobs[2], 3: blobs[3], k + 1: parity[1]}
+    assert codec.reconstruct(present, lens, asset="t") == {1: blobs[1]}
+
+
+def test_codec_device_reconstruct_crc_oracle():
+    """With the manifest crc32s in hand the wide reconstruct matmul
+    runs on the SAME jitted kernel that writes parity, and the crcs are
+    the independent oracle: a divergence counts, latches host fallback
+    and recomputes — bytes stay exact either way."""
+    if not _device_available():
+        pytest.skip("no jax backend for the device parity kernel")
+    k, m = 4, 2
+    codec = StripeCodec(k, m, use_device=True)
+    blobs = _blobs(k)
+    parity = codec.parity(blobs)
+    assert codec.oracle_mismatches == 0 and not codec.host_fallback
+    lens = [len(b) for b in blobs]
+    crcs = [zlib.crc32(b) & 0xFFFFFFFF for b in blobs]
+    present = {2: blobs[2], 3: blobs[3], k: parity[0], k + 1: parity[1]}
+    passes0 = codec.device_passes
+    out = codec.reconstruct(present, lens, asset="t", crcs=crcs)
+    assert out == {0: blobs[0], 1: blobs[1]}
+    assert codec.device_passes > passes0      # the kernel served it
+    # corrupt crcs: device result fails the oracle -> counted, host
+    # fallback latched, and the HOST recompute still returns the right
+    # bytes (the crcs only gate the device result, the math is exact)
+    mm0 = obs.FEC_PARITY_ORACLE_MISMATCH.value()
+    out = codec.reconstruct(present, lens, asset="t",
+                            crcs=[c ^ 1 for c in crcs])
+    assert out == {0: blobs[0], 1: blobs[1]}
+    assert codec.oracle_mismatches == 1 and codec.host_fallback
+    assert obs.FEC_PARITY_ORACLE_MISMATCH.value() == mm0 + 1
+
+
+def test_codec_loud_failure_beyond_parity_budget():
+    """ISSUE 20 satellite: > m losses (or a singular subset) raises and
+    counts — never a silently partial read."""
+    k, m = 4, 2
+    codec = StripeCodec(k, m, use_device=False)
+    blobs = _blobs(k)
+    parity = codec.parity(blobs)
+    lens = [len(b) for b in blobs]
+    f0 = obs.STORAGE_RECONSTRUCTS.value(result="failed")
+    with pytest.raises(StorageError):
+        codec.reconstruct({3: blobs[3], k: parity[0], k + 1: parity[1]},
+                          lens, asset="t")   # 3 missing > m=2
+    assert obs.STORAGE_RECONSTRUCTS.value(result="failed") == f0 + 1
+
+
+def test_gf_solve_singular_accounting(monkeypatch):
+    """ISSUE 20 satellite: a singular ``gf_solve`` is no longer a
+    silent None — it counts ``fec_solve_singular_total{caller}``, and
+    the codec surfaces it as a loud failed reconstruct."""
+    from easydarwin_tpu.relay.fec import gf_solve
+    s0 = obs.FEC_SOLVE_SINGULAR.value(caller="storage")
+    a = np.array([[1, 1], [1, 1]], np.uint8)       # rank 1: singular
+    assert gf_solve(a, np.eye(2, dtype=np.uint8),
+                    caller="storage") is None
+    assert obs.FEC_SOLVE_SINGULAR.value(caller="storage") == s0 + 1
+    # the codec's branch: gf_solve -> None must raise + count failed
+    from easydarwin_tpu.storage import codec as codec_mod
+    codec = StripeCodec(2, 1, use_device=False)
+    blobs = _blobs(2)
+    parity = codec.parity(blobs)
+    monkeypatch.setattr(codec_mod, "gf_solve", lambda *a, **kw: None)
+    f0 = obs.STORAGE_RECONSTRUCTS.value(result="failed")
+    with pytest.raises(StorageError):
+        codec.reconstruct({1: blobs[1], 2: parity[0]},
+                          [len(b) for b in blobs], asset="t")
+    assert obs.STORAGE_RECONSTRUCTS.value(result="failed") == f0 + 1
+
+
+# =============================================================== service
+
+def test_store_restore_and_stripe_cache(tmp_path):
+    """Single-node store: shards + manifest land on disk, a direct read
+    serves the exact blob, a missing shard reconstructs byte-exactly,
+    and the sibling windows of the stripe ride the first solve (the
+    stripe cache) instead of re-gathering."""
+    blobs = _blobs(4)
+    st, man = _store(tmp_path, blobs, k=2, m=1)
+    # 4 data + 2 parity shards, all local (no peers)
+    assert st.shards_local == 6 and st.stored_assets == 1
+    assert man["holders"][shard_name(1, 0, 0)] == "node-a"
+    # manifest carries the full DVR doc -> the dead-owner dvrmeta answer
+    assert st.meta_doc("/live/sa")["tracks"]["1"]["windows"]
+    # fenced Shard: claims queued for the cluster tick to drain
+    claims = st.pending_claims()
+    assert len(claims) == 6
+    assert all(key.startswith("Shard:live/sa/t1/") for key, _ in claims)
+    assert st.pending_claims() == []               # drained
+    # direct read: the exact window blob, no reconstruct
+    for w, b in enumerate(blobs):
+        assert st.restore_window("/live/sa", 1, w) == b
+    assert st.reconstructs == 0
+    # kill stripe 0's first data shard -> reconstruct, byte-exact
+    os.unlink(st._shard_path("/live/sa", shard_name(1, 0, 0)))
+    ok0 = obs.STORAGE_RECONSTRUCTS.value(result="ok")
+    assert st.restore_window("/live/sa", 1, 0) == blobs[0]
+    assert st.reconstructs == 1
+    assert obs.STORAGE_RECONSTRUCTS.value(result="ok") == ok0 + 1
+    # the stripe cache now holds BOTH rows of stripe 0 (solved + the
+    # survivor that rode along): delete the survivor too — window 1
+    # still serves, though the stripe on disk is beyond m=1 losses
+    os.unlink(st._shard_path("/live/sa", shard_name(1, 0, 1)))
+    assert st.restore_window("/live/sa", 1, 1) == blobs[1]
+    # cold read of the now-2-loss stripe fails LOUDLY, returns None
+    st._stripe_cache.clear()
+    f0 = obs.STORAGE_RECONSTRUCTS.value(result="failed")
+    assert st.restore_window("/live/sa", 1, 0) is None
+    assert st.reconstruct_failures == 1
+    assert obs.STORAGE_RECONSTRUCTS.value(result="failed") == f0 + 1
+    # stripe 1 is untouched and still serves directly
+    assert st.restore_window("/live/sa", 1, 3) == blobs[3]
+
+
+def test_scrub_quarantines_and_repair_rematerializes(tmp_path):
+    """Scrub catches a flipped byte via the manifest crc32, quarantines
+    the shard and queues repair; ``repair_now`` re-derives the payload
+    from survivors (parity = the Vandermonde matmul re-run, data = a
+    solve) and the file comes back byte-identical."""
+    blobs = _blobs(2)
+    st, man = _store(tmp_path, blobs, k=2, m=1)
+    pname = shard_name(1, 0, 2)                    # the parity shard
+    p = st._shard_path("/live/sa", pname)
+    good = open(p, "rb").read()
+    with open(p, "r+b") as fh:
+        fh.seek(3)
+        fh.write(bytes([good[3] ^ 0xFF]))
+    se0 = obs.STORAGE_SCRUB_ERRORS.value()
+    st._scrub_cursor = []
+    assert st.scrub_tick(batch=64) > 0
+    assert st.scrub_errors == 1 and not os.path.isfile(p)
+    assert obs.STORAGE_SCRUB_ERRORS.value() == se0 + 1
+    assert ("/live/sa", pname) in st._repair_queue
+    rp0 = obs.STORAGE_REPAIRS.value(kind="parity")
+    rb0 = obs.STORAGE_REPAIR_BYTES.value()
+    nbytes = st.repair_now("/live/sa", pname)
+    assert nbytes == len(good)
+    assert open(p, "rb").read() == good            # math == original
+    assert st.repairs == 1 and st.repair_bytes == len(good)
+    assert obs.STORAGE_REPAIRS.value(kind="parity") == rp0 + 1
+    assert obs.STORAGE_REPAIR_BYTES.value() == rb0 + len(good)
+    # repair of a LOST DATA shard is a solve over the survivors
+    dname = shard_name(1, 0, 0)
+    os.unlink(st._shard_path("/live/sa", dname))
+    st._stripe_cache.clear()
+    assert st.repair_now("/live/sa", dname) == len(blobs[0])
+    assert open(st._shard_path("/live/sa", dname), "rb").read() \
+        == blobs[0]
+    assert obs.STORAGE_REPAIRS.value(kind="data") >= 1
+
+
+def test_scrub_host_oracle_catches_crc_consistent_tamper(tmp_path):
+    """A parity shard whose bytes AND manifest crc were both tampered
+    passes the crc gate — the scrub's host GF oracle (re-deriving the
+    row from the locally-present data shards) still catches it."""
+    blobs = _blobs(2)
+    st, man = _store(tmp_path, blobs, k=2, m=1)
+    pname = shard_name(1, 0, 2)
+    p = st._shard_path("/live/sa", pname)
+    bad = bytearray(open(p, "rb").read())
+    bad[0] ^= 0x55
+    with open(p, "wb") as fh:
+        fh.write(bytes(bad))
+    man["tracks"]["1"]["stripes"][0]["pcrcs"][0] = \
+        zlib.crc32(bytes(bad)) & 0xFFFFFFFF
+    st._write_manifest("/live/sa", man)
+    st._scrub_cursor = []
+    st.scrub_tick(batch=64)
+    assert st.scrub_errors == 1 and not os.path.isfile(p)
+
+
+def test_stripe_ranked_placement_spreads_one_stripe(tmp_path):
+    """Distinct-node-per-stripe placement: the k+m shards of any stripe
+    deal round-robin down the stripe's OWN ring ranking, so one node
+    death costs a stripe at most one shard — exactly what m parity rows
+    insure against."""
+    from easydarwin_tpu.cluster.placement import HashRing
+    st = StorageService(str(tmp_path / "s"), "n0", k=2, m=1,
+                        use_device=False)
+    ring = HashRing([f"n{i}" for i in range(5)])
+    for s in range(6):
+        targets = [st._placement_target(ring, "/live/pl",
+                                        shard_name(1, s, j))
+                   for j in range(3)]
+        assert len(set(targets)) == 3, targets
+        assert targets == ring.rank(f"/live/pl/t1/s{s}")[:3]
+    # the same election drives repair_scan: a survivor ring elects the
+    # same successor every peer computes
+    surv = HashRing(["n0", "n1"])
+    t = st._placement_target(surv, "/live/pl", shard_name(1, 0, 1))
+    assert t == surv.rank("/live/pl/t1/s0")[1 % 2]
+
+
+def test_receive_shard_crc_gate_and_gen_replace(tmp_path):
+    """A pushed shard is crc-verified against the adopted manifest
+    before it persists; a newer-generation manifest replaces the old
+    tree (a re-recorded asset never mixes stripes across gens)."""
+    blobs = _blobs(2)
+    st, man = _store(tmp_path, blobs, k=2, m=1)
+    other = StorageService(str(tmp_path / "other"), "node-b", k=2, m=1,
+                           use_device=False)
+    name = shard_name(1, 0, 0)
+    man_doc = json.loads(json.dumps(man))
+    assert other.receive_shard("/live/sa", name, blobs[0], man_doc)
+    assert other.shards_local == 1
+    # corrupt payload: refused, nothing persisted
+    assert not other.receive_shard("/live/sa", shard_name(1, 0, 1),
+                                   blobs[1][:-1] + b"\x00", man_doc)
+    # a NEWER gen wipes the stale tree and adopts the new manifest
+    dvr2 = _FakeDvr({1: dict(enumerate(_blobs(2, seed=9)))}, gen=2)
+    man2 = st.store_asset("/live/sa", dvr2)
+    assert man2["gen"] == 2
+    b2 = dvr2.window_blob("/live/sa", 1, 0)
+    assert other.receive_shard("/live/sa", name, b2,
+                               json.loads(json.dumps(man2)))
+    assert int(other.manifest("/live/sa")["gen"]) == 2
+    with open(other._shard_path("/live/sa", name), "rb") as fh:
+        assert fh.read() == b2               # gen-1 bytes are gone
+
+
+# ======================================================== fleet bootstrap
+
+async def test_dead_owner_dvrmeta_bootstrap_and_replay(tmp_path):
+    """ISSUE 20 satellite + the acceptance scenario in-process: the
+    recording node dies AFTER finalize; its ``.dvr`` asset stays
+    playable from the surviving shards.  ``/api/v1/dvrmeta`` on a
+    survivor answers from the shard manifest (the storage fallback —
+    the owner's DvrManager is gone), the replay node materializes the
+    meta through that answer, and every window block-fills through the
+    erasure restore chain — zero repacks, gapless seq, one ssrc."""
+    from easydarwin_tpu.cluster.redis_client import InMemoryRedis
+    from easydarwin_tpu.protocol import rtp
+    from easydarwin_tpu.server import ServerConfig, StreamingServer
+    from easydarwin_tpu.utils.client import RtspClient
+    from easydarwin_tpu.vod.cache import pack_window
+
+    def _cfg(node):
+        d = tmp_path / node
+        return ServerConfig(
+            rtsp_port=0, service_port=0, bind_ip="127.0.0.1",
+            wan_ip="127.0.0.1", reflect_interval_ms=5,
+            bucket_delay_ms=0, access_log_enabled=False,
+            log_folder=str(d / "logs"), movie_folder=str(d / "movies"),
+            server_id=node, cluster_enabled=True,
+            cluster_lease_ttl_sec=2.0, cluster_heartbeat_sec=0.3,
+            dvr_enabled=True, dvr_window_pkts=16,
+            storage_enabled=True, storage_data_shards=2,
+            storage_parity_shards=1, storage_device=False)
+
+    redis = InMemoryRedis()
+    apps = [StreamingServer(_cfg(f"st-{c}"), redis_client=redis)
+            for c in "abc"]
+    app_a, app_b, app_c = apps
+    for app in apps:
+        await app.start()
+    a_stopped = False
+    pusher = replayer = None
+    try:
+        await asyncio.sleep(0.7)          # all three leases live
+        uri_a = f"rtsp://127.0.0.1:{app_a.rtsp.port}/live/do"
+        pusher = RtspClient()
+        await pusher.connect("127.0.0.1", app_a.rtsp.port)
+        await pusher.push_start(uri_a, VIDEO_SDP)
+        seq = 0
+        for i in range(80):
+            pkts = _frame_packets(seq, seq * 3000, idr=(i % 8 == 0),
+                                  with_params=(i == 0))
+            for p in pkts:
+                pusher.push_packet(0, p)
+            seq += len(pkts)
+            await asyncio.sleep(0.004)
+        for _ in range(100):
+            if app_a.dvr.stats()["spilled_windows"] >= 3:
+                break
+            await asyncio.sleep(0.05)
+        assert app_a.dvr.finalize("/live/do") is not None
+        await pusher.close()
+        pusher = None
+        # finalize sharded the asset across the fleet: wait for every
+        # survivor to hold shards + the manifest (the pushes are
+        # blocking worker-thread HTTP)
+        for _ in range(200):
+            if (app_b.storage.manifest("/live/do") is not None
+                    and app_c.storage.manifest("/live/do") is not None
+                    and app_b.storage.shards_local > 0
+                    and app_c.storage.shards_local > 0):
+                break
+            await asyncio.sleep(0.05)
+        assert app_a.storage.stored_assets == 1
+        assert app_b.storage.shards_local > 0
+        assert app_c.storage.shards_local > 0
+        # ---- the owner dies -----------------------------------------
+        await app_a.stop()
+        a_stopped = True
+        # satellite: a survivor's /api/v1/dvrmeta answers for the dead
+        # owner's asset out of the shard manifest
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", app_b.rest.port)
+        writer.write(b"GET /api/v1/dvrmeta?path=/live/do HTTP/1.1\r\n"
+                     b"Host: x\r\n\r\n")
+        head = await reader.readuntil(b"\r\n\r\n")
+        assert int(head.split(b" ")[1]) == 200, head
+        clen = int([ln for ln in head.split(b"\r\n")
+                    if ln.lower().startswith(b"content-length")][0]
+                   .split(b":")[1])
+        doc = json.loads(await reader.readexactly(clen))
+        writer.close()
+        assert doc["tracks"]["1"]["windows"]
+        assert app_b.dvr.meta_doc("/live/do") is None  # NOT local dvr
+        # ---- full replay from a survivor ----------------------------
+        packs_before = pack_window.calls
+        replayer = RtspClient()
+        await replayer.connect("127.0.0.1", app_b.rtsp.port)
+        uri_b = f"rtsp://127.0.0.1:{app_b.rtsp.port}/live/do.dvr"
+        await replayer.play_start(uri_b)
+        got = []
+        try:
+            while len(got) < 40:
+                got.append(await replayer.recv_interleaved(0, timeout=5))
+        except asyncio.TimeoutError:
+            pass
+        assert len(got) >= 20, f"replay starved: {len(got)}"
+        assert rtp.RtpPacket.parse(got[0]).payload[0] & 0x1F == 7
+        assert len({rtp.RtpPacket.parse(d).ssrc for d in got}) == 1
+        seqs = [rtp.RtpPacket.parse(d).seq for d in got]
+        for i, s in enumerate(seqs):
+            assert s == (seqs[0] + i) & 0xFFFF, f"gap at {i}"
+        assert pack_window.calls == packs_before   # zero repacks
+        # the windows came through the erasure tier, not a live peer
+        assert app_b.storage.reconstructs + app_c.storage.reconstructs \
+            > 0 or app_b.storage.shards_local > 0
+        assert app_b.storage.scrub_errors == 0
+        assert app_b.storage.codec.oracle_mismatches == 0
+        await replayer.teardown(uri_b)
+    finally:
+        if replayer is not None:
+            await replayer.close()
+        if pusher is not None:
+            await pusher.close()
+        if not a_stopped:
+            await app_a.stop()
+        await app_b.stop()
+        await app_c.stop()
+
+
+# ====================================================== tooling contracts
+
+def test_lint_storage_contract():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from easydarwin_tpu.obs import events as ev
+    from tools.metrics_lint import lint_storage
+    assert lint_storage(obs.REGISTRY, ev.SCHEMA) == []
+
+
+def test_bench_gate_accepts_and_rejects_storage_section(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.bench_gate import check_trajectory
+
+    def entry(storage=None):
+        extra = {} if storage is None else {"storage": storage}
+        return {"file": "BENCH_r99.json", "rc": 0,
+                "parsed": {"metric": "m", "value": 1.0, "unit": "p/s",
+                           "vs_baseline": 1.0, "extra": extra}}
+
+    good = {"direct_pps": 4000.0, "reconstruct_pps": 2400.0,
+            "repair_mbps": 80.0, "scrub_errors": 0,
+            "oracle_mismatches": 0}
+    assert check_trajectory([entry(good)]) == []
+    assert check_trajectory([entry()]) == []     # old rounds stay valid
+    bad = dict(good, reconstruct_pps=1000.0)     # < 0.5x direct
+    assert any("0.5x" in e for e in check_trajectory([entry(bad)]))
+    bad = dict(good, repair_mbps=0.0)
+    assert any("repair_mbps" in e for e in check_trajectory([entry(bad)]))
+    bad = dict(good, scrub_errors=2)
+    assert any("scrub" in e for e in check_trajectory([entry(bad)]))
+    bad = dict(good, oracle_mismatches=1)
+    assert any("oracle" in e for e in check_trajectory([entry(bad)]))
+    bad = dict(good, direct_pps=float("nan"))
+    assert any("direct_pps" in e for e in check_trajectory([entry(bad)]))

@@ -310,6 +310,47 @@ def check_trajectory(traj: list[dict],
                 errs.append(f"{name}: dvr.reopen_repacks {rp2} != 0 "
                             "(a spilled asset re-open ran pack_window; "
                             "the zero-repack contract is broken)")
+        # ISSUE 20 erasure-storage section — OPTIONAL (rounds predating
+        # the storage tier stay valid), but when present: direct and
+        # reconstruct read rates are positive finite, a reconstruct-
+        # served read runs at >= 0.5x the direct-read rate (the
+        # transparent-restore acceptance pin), background repair moved
+        # real bytes (MB/s > 0), and the scrub pass found exactly zero
+        # errors on freshly written shards
+        sg = extra.get("storage")
+        if isinstance(sg, dict) and sg and "error" not in sg:
+            dr = sg.get("direct_pps")
+            rr3 = sg.get("reconstruct_pps")
+            for kf, v2 in (("direct_pps", dr),
+                           ("reconstruct_pps", rr3)):
+                if not isinstance(v2, (int, float)) \
+                        or not math.isfinite(v2) or v2 <= 0:
+                    errs.append(f"{name}: storage.{kf} {v2!r} not a "
+                                "positive finite rate")
+            if (isinstance(dr, (int, float))
+                    and isinstance(rr3, (int, float))
+                    and math.isfinite(dr) and math.isfinite(rr3)
+                    and dr > 0 and rr3 < dr * 0.5):
+                errs.append(f"{name}: storage.reconstruct_pps {rr3} "
+                            f"below 0.5x direct_pps {dr} (a read "
+                            "missing <= m shards must stay within 2x "
+                            "of a direct read)")
+            rmb = sg.get("repair_mbps")
+            if not isinstance(rmb, (int, float)) \
+                    or not math.isfinite(rmb) or rmb <= 0:
+                errs.append(f"{name}: storage.repair_mbps {rmb!r} not "
+                            "a positive finite rate (the dead-holder "
+                            "re-materialization must move real bytes)")
+            se2 = sg.get("scrub_errors", 0)
+            if se2:
+                errs.append(f"{name}: storage recorded {se2} scrub "
+                            "errors on freshly written shards (crc/"
+                            "oracle corruption in the write path)")
+            mm4 = sg.get("oracle_mismatches", 0)
+            if mm4:
+                errs.append(f"{name}: storage recorded {mm4} parity "
+                            "oracle mismatches (device/host divergence "
+                            "on the storage parity matmul)")
         # ISSUE 14 TCP delivery section — OPTIONAL (rounds predating
         # the TCP/HTTP engine path stay valid), but when present: the
         # engine-framed interleave rate and the per-session baseline

@@ -599,6 +599,61 @@ def lint_dvr(registry) -> list[str]:
     return errs
 
 
+#: closed shard-kind vocabulary of ``storage_{shards,repairs}_total``
+STORAGE_KINDS = ("data", "parity")
+#: closed result vocabulary of ``storage_reconstructs_total``
+STORAGE_RESULTS = ("ok", "failed")
+
+
+def lint_storage(registry, schema: dict) -> list[str]:
+    """The erasure-storage tier's contract (ISSUE 20): the storage_*
+    families exist with their exact label sets, observed ``kind`` /
+    ``result`` children stay inside the closed data|parity / ok|failed
+    vocabularies, the ``fec_solve_singular_total`` caller-labeled
+    counter exists (the gf_solve accounting satellite), and the
+    ``storage.*`` event names are declared — the bench
+    ``extra.storage`` section and the cluster soak's owner-kill
+    assertions key on these."""
+    errs: list[str] = []
+    want_labels = {
+        "storage_shards_total": ("kind",),
+        "storage_reconstructs_total": ("result",),
+        "storage_repairs_total": ("kind",),
+        "storage_repair_bytes_total": (),
+        "storage_scrub_errors_total": (),
+        "fec_solve_singular_total": ("caller",),
+    }
+    fams = {}
+    for fam_name, labels in want_labels.items():
+        try:
+            fam = registry.get(fam_name)
+        except KeyError:
+            errs.append(f"storage family {fam_name} missing from the "
+                        "registry")
+            continue
+        fams[fam_name] = fam
+        if tuple(fam.label_names) != labels:
+            errs.append(f"{fam_name}: labels must be {labels}, got "
+                        f"{tuple(fam.label_names)}")
+    closed = {"storage_shards_total": STORAGE_KINDS,
+              "storage_repairs_total": STORAGE_KINDS,
+              "storage_reconstructs_total": STORAGE_RESULTS}
+    for fam_name, vocab in closed.items():
+        fam = fams.get(fam_name)
+        if fam is None:
+            continue
+        for (val,) in getattr(fam, "_values", {}):
+            if val not in vocab:
+                errs.append(f"{fam_name}: observed label {val!r} "
+                            f"outside the closed set {vocab}")
+    for name in ("storage.store", "storage.reconstruct",
+                 "storage.repair", "storage.scrub_error",
+                 "storage.solve_singular", "storage.host_fallback"):
+        if name not in schema:
+            errs.append(f"event {name} missing from SCHEMA")
+    return errs
+
+
 #: closed backend/rung vocabulary for the stream-socket egress ladder
 #: (ISSUE 14): io_uring → writev → buffered (the per-send asyncio rung)
 STREAM_BACKENDS = ("io_uring", "writev", "buffered")
@@ -974,6 +1029,10 @@ def main() -> int:
     # the DVR / time-shift tier's vocabulary (ISSUE 12): spill/session
     # families + dvr.* events + the spill phase / dvr engine
     errs += lint_dvr(obs.REGISTRY)
+    # the erasure-storage tier's vocabulary (ISSUE 20): storage_*
+    # families with closed data|parity / ok|failed sets, the gf_solve
+    # singular accounting counter, and the storage.* events
+    errs += lint_storage(obs.REGISTRY, ev.SCHEMA)
     # the TCP/HTTP delivery tier's vocabulary (ISSUE 14): stream-egress
     # families with the closed io_uring/writev/buffered rung set + the
     # checkpoint-parity counter and ckpt.tcp_* events

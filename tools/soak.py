@@ -1468,6 +1468,23 @@ async def _cluster_node_main(node_id: str, redis_port: int,
     log_dir = f"/tmp/{base}/{node_id}"
     os.makedirs(log_dir, exist_ok=True)
     extra = {}
+    if not skewed and not composed:
+        # ISSUE 20: the plain cluster scenario also records every
+        # pushed broadcast and erasure-shards finalized assets across
+        # the fleet (k=2+1 spreads a stripe over 3 distinct nodes), so
+        # the seeded owner kill doubles as the durability scenario —
+        # its finalized .dvr assets must replay from the survivors
+        import shutil as _shutil
+        movies = os.path.join(log_dir, "movies")
+        _shutil.rmtree(movies, ignore_errors=True)   # stale-run assets
+        extra = dict(
+            dvr_enabled=True,
+            movie_folder=movies,
+            dvr_window_pkts=32,
+            storage_enabled=True,
+            storage_data_shards=2,
+            storage_parity_shards=1,
+            storage_scrub_interval_sec=3.0)
     if skewed:
         extra = dict(
             cluster_admission_high_water=0.8,
@@ -1665,6 +1682,24 @@ async def cluster_soak(n_nodes: int, seconds: float,
             await asyncio.sleep(0.02)
         await asyncio.sleep(1.2)      # ≥2 cluster ticks: claim + ckpt up
 
+        # ISSUE 20: record a short broadcast ON THE OWNER, tear it down
+        # so the DVR finalizes and the storage tier stripes the asset
+        # across the fleet — after the seeded SIGKILL it must replay
+        # from the survivors' shards alone (zero repacks, zero wire
+        # mismatches)
+        rec = RtspClient()
+        await rec.connect("127.0.0.1", rtsp_ports[owner])
+        await rec.push_start(
+            f"rtsp://127.0.0.1:{rtsp_ports[owner]}/live/s", SDP)
+        for i in range(160):
+            rec.push_packet(0, struct.pack(
+                "!BBHII", 0x80, 96, i & 0xFFFF, i * 90, 0xAB)
+                + bytes([0x65]) + bytes(100))
+            if i % 8 == 7:
+                await asyncio.sleep(0.01)
+        await asyncio.sleep(0.3)      # let the spiller drain the ring
+        await rec.close()
+
         # the subscriber that must survive the kill WITHOUT re-SETUP
         udp_player = RtspClient()
         await udp_player.connect("127.0.0.1", rtsp_ports[owner])
@@ -1803,6 +1838,70 @@ async def cluster_soak(n_nodes: int, seconds: float,
                             "kill (adoption/pull re-resolution failed)")
         if churn_ok[0] == 0:
             failures.append("zero churn subscribers completed SETUP/PLAY")
+        # ---- ISSUE 20 durability: the dead owner's finalized .dvr
+        # asset replays from the survivors' erasure shards alone
+        from easydarwin_tpu.protocol.rtp import RtpPacket
+        s_rx = 0
+        s_seqs: list[int] = []
+        s_ssrcs: set[int] = set()
+        if killed and n_nodes >= 3:
+            rp = RtspClient()
+            try:
+                await rp.connect("127.0.0.1", rtsp_ports[pull_node])
+                await rp.play_start(f"rtsp://127.0.0.1:"
+                                    f"{rtsp_ports[pull_node]}/live/s.dvr")
+                t_end = time.monotonic() + 15.0
+                while time.monotonic() < t_end and s_rx < 160:
+                    try:
+                        d = await rp.recv_interleaved(0, timeout=1.0)
+                    except asyncio.TimeoutError:
+                        continue
+                    if len(d) >= 12:
+                        s_rx += 1
+                        p = RtpPacket.parse(d)
+                        s_seqs.append(p.seq)
+                        s_ssrcs.add(p.ssrc)
+            except Exception as e:
+                failures.append(
+                    f"dvr replay from survivors failed to start: {e!r}")
+            finally:
+                try:
+                    await rp.close()
+                except Exception:
+                    pass
+            if s_rx < 32:             # at least one full spill window
+                failures.append(
+                    f"dead owner's .dvr asset not playable from the "
+                    f"surviving shards: {s_rx} packets")
+            if _seq_gap(s_seqs) != 0:
+                failures.append(
+                    f"byte-exactness hole in the shard replay: "
+                    f"{_seq_gap(s_seqs)} packets missing")
+            if len(s_ssrcs) > 1:
+                failures.append("ssrc changed across the shard replay")
+            for nid in node_ids:
+                if nid in dead:
+                    continue
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{rest_ports[nid]}"
+                        f"/api/v1/storagestats", timeout=5) as r:
+                    sst = _json.loads(r.read().decode())
+                if sst.get("pack_window_calls", 0) != 0:
+                    failures.append(
+                        f"{nid}: {sst['pack_window_calls']} repacks "
+                        "during the shard replay (must be zero)")
+                if sst.get("scrub_errors", 0) != 0:
+                    failures.append(f"{nid}: storage scrub errors "
+                                    f"{sst['scrub_errors']}")
+                if sst.get("oracle_mismatches", 0) != 0:
+                    failures.append(f"{nid}: storage oracle mismatches "
+                                    f"{sst['oracle_mismatches']}")
+                stats.setdefault("storage", {})[nid] = {
+                    k: sst.get(k, 0) for k in (
+                        "shards_local", "reconstructs", "repairs",
+                        "scrubbed")}
+            stats["dvr_replay_rx"] = s_rx
+
         m = _metrics(successor)
         if m.get("cluster_migrations_total", 0) == 0:
             failures.append("survivor counted zero cluster_migrations_total")
